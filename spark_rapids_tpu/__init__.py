@@ -1,0 +1,21 @@
+"""spark_rapids_tpu: a TPU-native Spark-SQL columnar accelerator framework.
+
+Re-creation of the capability surface of NVIDIA's RAPIDS Accelerator for
+Apache Spark (reference: andygrove/spark-rapids v0.2.0-SNAPSHOT), designed
+TPU-first: columnar batches are static-shape JAX arrays in HBM, operators
+compile to fused XLA executables cached per batch bucket, shuffle rides
+ICI collectives under shard_map, and spill management is an explicit
+host-driven tier chain (HBM -> host -> disk).
+
+Spark parity requires 64-bit longs/doubles, so x64 is enabled at import
+(the reference's cuDF kernels are 64-bit native; on TPU f64 is emulated --
+performance-sensitive pipelines should prefer f32/bf16 columns).
+"""
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.2.0"
+
+from spark_rapids_tpu import types  # noqa: E402,F401
+from spark_rapids_tpu.config import RapidsConf  # noqa: E402,F401

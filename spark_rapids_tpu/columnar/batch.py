@@ -1,0 +1,259 @@
+"""ColumnarBatch: the unit of execution, mirroring Spark's ColumnarBatch of
+`GpuColumnVector`s (reference `GpuColumnVector.java:252-261` converters and
+`GpuCoalesceBatches.scala` concat).
+
+A batch is host-orchestrated: `num_rows` is a Python int (the driver of
+bucketed compilation); the device payload is a pytree of padded arrays, so a
+whole batch can be passed into one jitted kernel.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import (
+    ColumnVector, align_char_caps, bucket_capacity)
+
+
+@dataclasses.dataclass
+class ColumnarBatch:
+    schema: T.Schema
+    columns: list[ColumnVector]
+    num_rows: int
+
+    def __post_init__(self):
+        assert len(self.columns) == len(self.schema.fields)
+        caps = {c.capacity for c in self.columns}
+        assert len(caps) <= 1, f"ragged capacities {caps}"
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else bucket_capacity(
+            self.num_rows)
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, name_or_idx) -> ColumnVector:
+        if isinstance(name_or_idx, str):
+            return self.columns[self.schema.index(name_or_idx)]
+        return self.columns[name_or_idx]
+
+    def row_mask(self) -> jnp.ndarray:
+        return jnp.arange(self.capacity) < self.num_rows
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def from_numpy(data: dict[str, np.ndarray],
+                   schema: Optional[T.Schema] = None,
+                   validity: Optional[dict[str, np.ndarray]] = None,
+                   capacity: Optional[int] = None) -> "ColumnarBatch":
+        names = list(data)
+        n = len(next(iter(data.values()))) if data else 0
+        cap = capacity or bucket_capacity(n)
+        cols, fields = [], []
+        for name in names:
+            dt = schema.field(name).dtype if schema else None
+            v = validity.get(name) if validity else None
+            col = ColumnVector.from_numpy(np.asarray(data[name]), dt, v, cap)
+            cols.append(col)
+            fields.append(T.Field(name, col.dtype))
+        return ColumnarBatch(schema or T.Schema(tuple(fields)), cols, n)
+
+    @staticmethod
+    def from_pandas(df) -> "ColumnarBatch":
+        data, validity = {}, {}
+        for name in df.columns:
+            s = df[name]
+            if s.dtype == object or str(s.dtype) in ("string", "str"):
+                vals = np.array(
+                    [None if v is None or (isinstance(v, float) and np.isnan(v))
+                     else v for v in s.tolist()], dtype=object)
+                data[name] = vals
+            else:
+                mask = s.isna().to_numpy()
+                arr = s.to_numpy()
+                if mask.any() and arr.dtype.kind == "f":
+                    arr = np.where(mask, 0.0, arr)
+                data[name] = arr
+                validity[name] = ~mask
+        return ColumnarBatch.from_numpy(data, validity=validity or None)
+
+    @staticmethod
+    def from_arrow(table) -> "ColumnarBatch":
+        """Arrow table/record-batch → device batch (the scan upload path,
+        reference `Table.readParquet` + `GpuColumnVector.from`)."""
+        data, validity, fields = {}, {}, []
+        for i, name in enumerate(table.schema.names):
+            col = table.column(i)
+            if hasattr(col, "combine_chunks"):
+                col = col.combine_chunks()
+            dt = T.from_arrow(col.type)
+            fields.append(T.Field(name, dt))
+            np_valid = ~np.asarray(col.is_null())
+            if dt.is_string:
+                data[name] = np.array(
+                    [v.as_py() for v in col], dtype=object)
+            elif dt.id == T.TypeId.TIMESTAMP_US:
+                import pyarrow.compute as pc
+                import pyarrow as pa
+                c = col.cast(pa.timestamp("us"))
+                arr = c.to_numpy(zero_copy_only=False)
+                arr = arr.astype("datetime64[us]").astype(np.int64)
+                arr = np.where(np_valid, arr, 0)
+                data[name] = arr
+            else:
+                arr = col.to_numpy(zero_copy_only=False)
+                if arr.dtype.kind == "f" and (~np_valid).any():
+                    arr = np.where(np_valid, arr, 0.0)
+                arr = np.asarray(arr, dt.storage_dtype)
+                data[name] = arr
+            validity[name] = np_valid
+        return ColumnarBatch.from_numpy(
+            data, T.Schema(tuple(fields)), validity)
+
+    # -- host conversion ----------------------------------------------------
+    def to_pandas(self):
+        import pandas as pd
+        out = {}
+        for f, c in zip(self.schema.fields, self.columns):
+            vals, validity = c.to_numpy(self.num_rows)
+            if f.dtype.is_string:
+                out[f.name] = pd.Series(list(vals), dtype=object)
+            elif f.dtype.id == T.TypeId.TIMESTAMP_US:
+                s = pd.Series(vals.astype("datetime64[us]"))
+                s[~validity] = pd.NaT
+                out[f.name] = s
+            elif validity.all():
+                out[f.name] = pd.Series(vals)
+            else:
+                s = pd.Series(vals).astype(object)
+                s[~validity] = None
+                out[f.name] = s
+        return pd.DataFrame(out)
+
+    def to_pylist(self) -> list[dict]:
+        cols = {f.name: c.to_pylist(self.num_rows)
+                for f, c in zip(self.schema.fields, self.columns)}
+        return [{k: v[i] for k, v in cols.items()}
+                for i in range(self.num_rows)]
+
+    def to_arrow(self):
+        import pyarrow as pa
+        arrays = []
+        for f, c in zip(self.schema.fields, self.columns):
+            vals, validity = c.to_numpy(self.num_rows)
+            if f.dtype.is_string:
+                arrays.append(pa.array(list(vals), T.to_arrow(f.dtype)))
+            else:
+                mask = None if validity.all() else ~validity
+                if f.dtype.id == T.TypeId.TIMESTAMP_US:
+                    arrays.append(pa.array(vals, pa.int64(), mask=mask).cast(
+                        T.to_arrow(f.dtype)))
+                else:
+                    arrays.append(
+                        pa.array(vals, T.to_arrow(f.dtype), mask=mask))
+        return pa.table(arrays, names=list(self.schema.names))
+
+    # -- structural ---------------------------------------------------------
+    def select(self, names: Iterable[str]) -> "ColumnarBatch":
+        names = list(names)
+        cols = [self.column(n) for n in names]
+        fields = tuple(self.schema.field(n) for n in names)
+        return ColumnarBatch(T.Schema(fields), cols, self.num_rows)
+
+    def with_capacity(self, capacity: int) -> "ColumnarBatch":
+        if capacity == self.capacity:
+            return self
+        return ColumnarBatch(
+            self.schema, [c.with_capacity(capacity) for c in self.columns],
+            min(self.num_rows, capacity))
+
+    def gather(self, indices: jnp.ndarray, index_valid: jnp.ndarray,
+               new_num_rows: int) -> "ColumnarBatch":
+        cols = [c.gather(indices, index_valid) for c in self.columns]
+        return ColumnarBatch(self.schema, cols, new_num_rows)
+
+    def slice(self, start: int, length: int) -> "ColumnarBatch":
+        """Host-side row slice (reference SlicedGpuColumnVector)."""
+        length = max(0, min(length, self.num_rows - start))
+        cap = bucket_capacity(length)
+        idx = jnp.arange(cap) + start
+        valid = jnp.arange(cap) < length
+        cols = [c.gather(jnp.where(valid, idx, 0), valid)
+                for c in self.columns]
+        return ColumnarBatch(self.schema, cols, length)
+
+    def device_size_bytes(self) -> int:
+        total = 0
+        for c in self.columns:
+            total += c.data.size * c.data.dtype.itemsize
+            total += c.validity.size
+            if c.lengths is not None:
+                total += c.lengths.size * 4
+        return total
+
+
+def empty_batch(schema: T.Schema) -> ColumnarBatch:
+    """Zero-row batch with properly-typed zero-filled columns."""
+    from spark_rapids_tpu.columnar.vector import MIN_CAPACITY, MIN_CHAR_CAP
+    cols = []
+    for f in schema.fields:
+        validity = jnp.zeros(MIN_CAPACITY, bool)
+        if f.dtype.is_string:
+            cols.append(ColumnVector(
+                f.dtype, jnp.zeros((MIN_CAPACITY, MIN_CHAR_CAP), jnp.uint8),
+                validity, jnp.zeros(MIN_CAPACITY, jnp.int32)))
+        else:
+            cols.append(ColumnVector(
+                f.dtype, jnp.zeros(MIN_CAPACITY, f.dtype.storage_dtype),
+                validity))
+    return ColumnarBatch(schema, cols, 0)
+
+
+def concat_batches(batches: list[ColumnarBatch]) -> ColumnarBatch:
+    """Device-side concat (reference `Table.concatenate`,
+    `GpuCoalesceBatches.scala:53`): stack padded columns then gather the
+    valid rows of each input into a fresh bucketed batch."""
+    assert batches
+    if len(batches) == 1:
+        return batches[0]
+    schema = batches[0].schema
+    total = sum(b.num_rows for b in batches)
+    cap = bucket_capacity(total)
+    out_cols = []
+    for ci, f in enumerate(schema.fields):
+        vecs = [b.columns[ci] for b in batches]
+        if f.dtype.is_string:
+            cc = max(v.char_cap for v in vecs)
+            from spark_rapids_tpu.columnar.vector import _pad_chars
+            vecs = [_pad_chars(v, cc) for v in vecs]
+        data = jnp.concatenate([v.data for v in vecs])
+        validity = jnp.concatenate([v.validity for v in vecs])
+        lengths = (jnp.concatenate([v.lengths for v in vecs])
+                   if vecs[0].lengths is not None else None)
+        # build gather indices mapping output row -> stacked row
+        out_cols.append((data, validity, lengths))
+    # gather indices: for each batch, rows [0, num_rows) at its offset
+    idx_parts, off = [], 0
+    for b in batches:
+        idx_parts.append(np.arange(b.num_rows) + off)
+        off += b.capacity
+    idx = np.concatenate(idx_parts) if idx_parts else np.zeros(0, np.int64)
+    idx = np.pad(idx, (0, cap - len(idx)))
+    jidx = jnp.asarray(idx)
+    valid = jnp.arange(cap) < total
+    cols = []
+    for (data, validity, lengths), f in zip(out_cols, schema.fields):
+        cols.append(ColumnVector(
+            f.dtype,
+            jnp.take(data, jidx, axis=0, mode="clip"),
+            jnp.take(validity, jidx, mode="clip") & valid,
+            None if lengths is None else jnp.take(lengths, jidx, mode="clip")))
+    return ColumnarBatch(schema, cols, total)

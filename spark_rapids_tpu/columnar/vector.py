@@ -1,0 +1,234 @@
+"""TPU column vectors: static-shape, validity-masked JAX arrays.
+
+Role parallel to the reference's `GpuColumnVector.java:39` (a Spark
+ColumnVector wrapping a cuDF device column).  The TPU twist: XLA compiles
+per shape, so every vector is padded to a *bucketed capacity* (powers of two)
+and carries an explicit validity mask.  A batch's logical row count lives on
+the host (`ColumnarBatch.num_rows`); inside jitted kernels the row mask is
+derived from an iota < num_rows operand so the same executable serves every
+batch in the bucket.
+
+Strings (reference: cuDF string columns) are a uint8[capacity, char_cap]
+byte tensor plus int32 lengths — fixed-width so string kernels vectorize on
+the VPU (see exprs/strings.py).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+
+# ---------------------------------------------------------------------------
+# capacity bucketing — the compile-cache key discipline (SURVEY.md §7 hard
+# part (a)): batches are padded to the next bucket so XLA executables are
+# reused across batches.
+MIN_CAPACITY = 32
+MIN_CHAR_CAP = 8
+
+
+def bucket_capacity(n: int, minimum: int = MIN_CAPACITY) -> int:
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def bucket_char_cap(n: int) -> int:
+    return bucket_capacity(max(n, 1), MIN_CHAR_CAP)
+
+
+def _pad_to(arr: np.ndarray, capacity: int, axis: int = 0) -> np.ndarray:
+    n = arr.shape[axis]
+    if n == capacity:
+        return arr
+    pad = [(0, 0)] * arr.ndim
+    pad[axis] = (0, capacity - n)
+    return np.pad(arr, pad)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ColumnVector:
+    """One column: `data` padded to capacity, `validity` True where non-null.
+
+    For STRING columns `data` is uint8[capacity, char_cap] and `lengths`
+    int32[capacity]; otherwise `lengths` is None.
+    """
+    dtype: T.DataType
+    data: jnp.ndarray
+    validity: jnp.ndarray
+    lengths: Optional[jnp.ndarray] = None
+
+    # -- pytree protocol so vectors flow through jit/shard_map --------------
+    def tree_flatten(self):
+        children = (self.data, self.validity, self.lengths)
+        return children, self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, validity, lengths = children
+        return cls(aux, data, validity, lengths)
+
+    # -----------------------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def char_cap(self) -> int:
+        assert self.dtype.is_string
+        return self.data.shape[1]
+
+    def has_nulls_upto(self, num_rows: int) -> bool:
+        v = np.asarray(self.validity[:num_rows])
+        return not bool(v.all())
+
+    # -- host <-> device ----------------------------------------------------
+    @staticmethod
+    def from_numpy(values: np.ndarray, dtype: Optional[T.DataType] = None,
+                   validity: Optional[np.ndarray] = None,
+                   capacity: Optional[int] = None) -> "ColumnVector":
+        if dtype is None:
+            dtype = T.from_numpy_dtype(values.dtype)
+        n = len(values)
+        cap = capacity or bucket_capacity(n)
+        if validity is None:
+            if values.dtype == object:
+                validity = np.array([v is not None for v in values], bool)
+            elif np.issubdtype(values.dtype, np.floating):
+                validity = np.ones(n, bool)  # NaN is a value, not null (Spark)
+            else:
+                validity = np.ones(n, bool)
+        validity = _pad_to(np.asarray(validity, bool), cap)
+
+        if dtype.is_string:
+            return _strings_from_host(values, validity, cap)
+
+        storage = dtype.storage_dtype
+        if values.dtype == object:
+            safe = np.array([v if v is not None else 0 for v in values],
+                            dtype=storage)
+        elif values.dtype.kind == "M":
+            safe = values.astype("datetime64[us]").astype(np.int64)
+        else:
+            safe = np.asarray(values).astype(storage, copy=False)
+        safe = _pad_to(safe, cap)
+        return ColumnVector(dtype, jnp.asarray(safe), jnp.asarray(validity))
+
+    @staticmethod
+    def from_scalar(value: Any, dtype: T.DataType, capacity: int,
+                    num_rows: int) -> "ColumnVector":
+        """Broadcast a scalar to a column (partition values, literals)."""
+        if value is None:
+            validity = jnp.zeros(capacity, bool)
+            if dtype.is_string:
+                data = jnp.zeros((capacity, MIN_CHAR_CAP), jnp.uint8)
+                return ColumnVector(dtype, data, validity,
+                                    jnp.zeros(capacity, jnp.int32))
+            return ColumnVector(
+                dtype, jnp.zeros(capacity, dtype.storage_dtype), validity)
+        validity = jnp.arange(capacity) < num_rows
+        if dtype.is_string:
+            raw = np.frombuffer(str(value).encode("utf-8"), np.uint8)
+            cc = bucket_char_cap(len(raw))
+            data = np.zeros((capacity, cc), np.uint8)
+            data[:, : len(raw)] = raw
+            lengths = jnp.where(validity, len(raw), 0).astype(jnp.int32)
+            return ColumnVector(dtype, jnp.asarray(data), validity, lengths)
+        data = jnp.full(capacity, value, dtype.storage_dtype)
+        return ColumnVector(dtype, data, validity)
+
+    def to_numpy(self, num_rows: int) -> tuple[np.ndarray, np.ndarray]:
+        """Return (values, validity) trimmed to num_rows; strings decode to
+        an object array of python str (None for nulls)."""
+        validity = np.asarray(self.validity)[:num_rows]
+        if self.dtype.is_string:
+            raw = np.asarray(self.data)[:num_rows]
+            lens = np.asarray(self.lengths)[:num_rows]
+            out = np.empty(num_rows, object)
+            for i in range(num_rows):
+                out[i] = (raw[i, : lens[i]].tobytes().decode("utf-8", "replace")
+                          if validity[i] else None)
+            return out, validity
+        vals = np.asarray(self.data)[:num_rows]
+        if self.dtype.id == T.TypeId.TIMESTAMP_US:
+            pass  # keep int64 micros; callers convert for display
+        return vals, validity
+
+    def to_pylist(self, num_rows: int) -> list:
+        vals, validity = self.to_numpy(num_rows)
+        if self.dtype.is_string:
+            return list(vals)
+        return [vals[i].item() if validity[i] else None
+                for i in range(num_rows)]
+
+    # -- structural ops (host orchestration; device work stays in kernels) --
+    def with_capacity(self, capacity: int) -> "ColumnVector":
+        if capacity == self.capacity:
+            return self
+        if capacity < self.capacity:
+            data = self.data[:capacity]
+            validity = self.validity[:capacity]
+            lengths = None if self.lengths is None else self.lengths[:capacity]
+        else:
+            extra = capacity - self.capacity
+            data = jnp.concatenate(
+                [self.data, jnp.zeros((extra,) + self.data.shape[1:],
+                                      self.data.dtype)])
+            validity = jnp.concatenate([self.validity,
+                                        jnp.zeros(extra, bool)])
+            lengths = (None if self.lengths is None else
+                       jnp.concatenate([self.lengths,
+                                        jnp.zeros(extra, jnp.int32)]))
+        return ColumnVector(self.dtype, data, validity, lengths)
+
+    def gather(self, indices: jnp.ndarray,
+               index_valid: Optional[jnp.ndarray] = None) -> "ColumnVector":
+        """Take rows by index (cuDF gather analog). indices beyond num_rows
+        must point at padded/zero rows; index_valid marks rows kept."""
+        data = jnp.take(self.data, indices, axis=0, mode="clip")
+        validity = jnp.take(self.validity, indices, mode="clip")
+        if index_valid is not None:
+            validity = validity & index_valid
+        lengths = (None if self.lengths is None
+                   else jnp.take(self.lengths, indices, mode="clip"))
+        return ColumnVector(self.dtype, data, validity, lengths)
+
+
+def _strings_from_host(values: np.ndarray, validity_padded: np.ndarray,
+                       cap: int) -> ColumnVector:
+    enc = [(v.encode("utf-8") if isinstance(v, str)
+            else (v if isinstance(v, (bytes, bytearray)) else
+                  (str(v).encode("utf-8") if v is not None else b"")))
+           for v in values]
+    max_len = max((len(e) for e in enc), default=0)
+    cc = bucket_char_cap(max_len)
+    data = np.zeros((cap, cc), np.uint8)
+    lengths = np.zeros(cap, np.int32)
+    for i, e in enumerate(enc):
+        data[i, : len(e)] = np.frombuffer(e, np.uint8)
+        lengths[i] = len(e)
+    lengths = np.where(validity_padded, lengths, 0).astype(np.int32)
+    return ColumnVector(T.STRING, jnp.asarray(data),
+                        jnp.asarray(validity_padded), jnp.asarray(lengths))
+
+
+def align_char_caps(a: ColumnVector, b: ColumnVector
+                    ) -> tuple[ColumnVector, ColumnVector]:
+    """Pad two string vectors to a shared char capacity (for concat etc.)."""
+    assert a.dtype.is_string and b.dtype.is_string
+    cc = max(a.char_cap, b.char_cap)
+    return _pad_chars(a, cc), _pad_chars(b, cc)
+
+
+def _pad_chars(v: ColumnVector, cc: int) -> ColumnVector:
+    if v.char_cap == cc:
+        return v
+    pad = jnp.zeros((v.capacity, cc - v.char_cap), jnp.uint8)
+    return ColumnVector(v.dtype, jnp.concatenate([v.data, pad], axis=1),
+                        v.validity, v.lengths)

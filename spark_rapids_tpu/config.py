@@ -1,0 +1,207 @@
+"""Typed configuration registry.
+
+Mirrors the reference's `RapidsConf.scala` (SURVEY.md §2.14): typed entries
+with defaults, per-operator auto-derived enable keys, and self-documenting
+`help()` output that generates docs/configs.md.  Keys keep the
+`spark.rapids.*` naming so users of the reference find the same surface.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Optional
+
+_REGISTRY: dict[str, "ConfEntry"] = {}
+
+
+@dataclasses.dataclass
+class ConfEntry:
+    key: str
+    default: Any
+    doc: str
+    converter: Callable[[str], Any]
+    internal: bool = False
+
+    def get(self, conf: "RapidsConf") -> Any:
+        return conf.get(self.key, self.default)
+
+
+def _register(entry: ConfEntry) -> ConfEntry:
+    _REGISTRY[entry.key] = entry
+    return entry
+
+
+def _bool(s):
+    return s if isinstance(s, bool) else str(s).lower() in ("true", "1", "yes")
+
+
+def conf(key: str, default: Any, doc: str, internal: bool = False) -> ConfEntry:
+    conv = {bool: _bool, int: int, float: float, str: str}[type(default)]
+    return _register(ConfEntry(key, default, doc, conv, internal))
+
+
+# --- core enables (reference RapidsConf.scala:271-690) ----------------------
+SQL_ENABLED = conf("spark.rapids.sql.enabled", True,
+                   "Enable or disable TPU SQL acceleration entirely.")
+EXPLAIN = conf("spark.rapids.sql.explain", "NONE",
+               "Explain why parts of a plan were not placed on the TPU: "
+               "NONE, NOT_ON_GPU, ALL.")
+INCOMPATIBLE_OPS = conf("spark.rapids.sql.incompatibleOps.enabled", False,
+                        "Enable operators producing results that differ "
+                        "slightly from Spark (e.g. float aggregation order).")
+IMPROVED_FLOAT = conf("spark.rapids.sql.improvedFloatOps.enabled", False,
+                      "Enable improved-precision float transcendental ops.")
+HAS_NANS = conf("spark.rapids.sql.hasNans", True,
+                "Assume floating point data may contain NaNs.")
+VARIABLE_FLOAT_AGG = conf("spark.rapids.sql.variableFloatAgg.enabled", False,
+                          "Allow float aggregations whose result can vary "
+                          "with evaluation order.")
+CASTS_FLOAT_TO_STRING = conf("spark.rapids.sql.castFloatToString.enabled",
+                             False, "Enable float->string cast (formatting "
+                             "differs slightly from Spark).")
+CASTS_STRING_TO_FLOAT = conf("spark.rapids.sql.castStringToFloat.enabled",
+                             False, "Enable string->float cast.")
+CASTS_STRING_TO_TS = conf("spark.rapids.sql.castStringToTimestamp.enabled",
+                          False, "Enable string->timestamp cast.")
+REPLACE_SORT_MERGE_JOIN = conf(
+    "spark.rapids.sql.replaceSortMergeJoin.enabled", True,
+    "Replace SortMergeJoin with a TPU shuffled hash join.")
+TEST_ENABLED = conf("spark.rapids.sql.test.enabled", False,
+                    "Testing hook: fail if an op expected on TPU falls back.",
+                    internal=True)
+TEST_ALLOWED_NONGPU = conf("spark.rapids.sql.test.allowedNonGpu", "",
+                           "Comma-separated ops allowed on CPU in test mode.",
+                           internal=True)
+EXPORT_COLUMNAR_RDD = conf("spark.rapids.sql.exportColumnarRdd", False,
+                           "Expose the final columnar output for ML "
+                           "integration (ColumnarRdd).")
+
+# --- batch sizing / memory (reference :271-360) -----------------------------
+BATCH_SIZE_BYTES = conf("spark.rapids.sql.batchSizeBytes", 2147483136,
+                        "Target device batch size in bytes for coalescing.")
+MAX_READER_BATCH_ROWS = conf("spark.rapids.sql.reader.batchSizeRows",
+                             2147483647, "Max rows per scan batch.")
+MAX_READER_BATCH_BYTES = conf("spark.rapids.sql.reader.batchSizeBytes",
+                              2147483136, "Soft max bytes per scan batch.")
+CONCURRENT_TPU_TASKS = conf("spark.rapids.sql.concurrentGpuTasks", 1,
+                            "Number of tasks that may hold the accelerator "
+                            "concurrently (GpuSemaphore analog).")
+HBM_ALLOC_FRACTION = conf("spark.rapids.memory.gpu.allocFraction", 0.9,
+                          "Fraction of HBM to dedicate to the arena pool.")
+HBM_RESERVE = conf("spark.rapids.memory.gpu.reserve", 1073741824,
+                   "HBM bytes kept free for XLA scratch/fusion temporaries.")
+HOST_SPILL_STORAGE = conf("spark.rapids.memory.host.spillStorageSize",
+                          1073741824, "Host memory for spilled device data.")
+PINNED_POOL_SIZE = conf("spark.rapids.memory.pinnedPool.size", 0,
+                        "Pinned host staging pool bytes (0 = disabled).")
+HBM_DEBUG = conf("spark.rapids.memory.gpu.debug", "NONE",
+                 "Arena allocation debug logging: NONE, STDOUT, STDERR.")
+
+# --- shuffle (reference :592-631) -------------------------------------------
+SHUFFLE_TRANSPORT_CLASS = conf(
+    "spark.rapids.shuffle.transport.class",
+    "spark_rapids_tpu.shuffle.ici_transport.IciShuffleTransport",
+    "Fully-qualified RapidsShuffleTransport implementation.")
+SHUFFLE_MAX_RECV_INFLIGHT = conf(
+    "spark.rapids.shuffle.maxMetadataFetchSize", 1073741824,
+    "Max in-flight receive bytes per client (throttle).")
+SHUFFLE_BOUNCE_BUFFER_SIZE = conf(
+    "spark.rapids.shuffle.bounceBuffers.size", 4194304,
+    "Bounce/staging buffer size for cross-slice (DCN) transfers.")
+SHUFFLE_BOUNCE_BUFFER_COUNT = conf(
+    "spark.rapids.shuffle.bounceBuffers.count", 32,
+    "Number of staging buffers per transport direction.")
+SHUFFLE_COMPRESSION_CODEC = conf(
+    "spark.rapids.shuffle.compression.codec", "none",
+    "Codec for shuffle payloads: none, copy (testing), lz4-host.")
+
+# --- python / udf -----------------------------------------------------------
+PYTHON_CONCURRENT_WORKERS = conf(
+    "spark.rapids.python.concurrentPythonWorkers", 0,
+    "Cap on concurrent accelerated python UDF workers (0 = unlimited).")
+UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled", True,
+                            "Compile Python UDF bytecode to expressions.")
+
+METRICS_LEVEL = conf("spark.rapids.sql.metrics.level", "MODERATE",
+                     "Operator metric detail: ESSENTIAL, MODERATE, DEBUG.")
+
+
+def op_enable_key(kind: str, name: str) -> str:
+    """Auto-derived per-operator enable key
+    (reference GpuOverrides.scala:129-137)."""
+    return f"spark.rapids.sql.{kind}.{name}"
+
+
+class RapidsConf:
+    """Immutable snapshot of config values, read once at plan time
+    (reference reads per-query: GpuOverrides.scala:1885)."""
+
+    def __init__(self, settings: Optional[dict[str, Any]] = None):
+        self._settings = dict(settings or {})
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self._settings:
+            val = self._settings[key]
+            entry = _REGISTRY.get(key)
+            if entry is not None and isinstance(val, str):
+                return entry.converter(val)
+            return val
+        entry = _REGISTRY.get(key)
+        if entry is not None:
+            return entry.default
+        return default
+
+    def __getitem__(self, entry: ConfEntry) -> Any:
+        return self.get(entry.key, entry.default)
+
+    def is_op_enabled(self, kind: str, name: str, default: bool = True) -> bool:
+        return _bool(self.get(op_enable_key(kind, name), default))
+
+    def with_overrides(self, **kv) -> "RapidsConf":
+        s = dict(self._settings)
+        s.update({k.replace("__", "."): v for k, v in kv.items()})
+        return RapidsConf(s)
+
+    def set(self, key: str, value: Any) -> "RapidsConf":
+        s = dict(self._settings)
+        s[key] = value
+        return RapidsConf(s)
+
+    @property
+    def sql_enabled(self) -> bool:
+        return self[SQL_ENABLED]
+
+
+_active = threading.local()
+
+
+def get_active_conf() -> RapidsConf:
+    c = getattr(_active, "conf", None)
+    if c is None:
+        c = RapidsConf()
+        _active.conf = c
+    return c
+
+
+def set_active_conf(conf_: RapidsConf) -> None:
+    _active.conf = conf_
+
+
+def help_text() -> str:
+    """Generate docs/configs.md content (reference ConfHelper.makeConfAnchor,
+    RapidsConf.scala help())."""
+    lines = ["# Configuration", "",
+             "| Key | Default | Description |", "|---|---|---|"]
+    for key in sorted(_REGISTRY):
+        e = _REGISTRY[key]
+        if e.internal:
+            continue
+        lines.append(f"| `{e.key}` | {e.default} | {e.doc} |")
+    return "\n".join(lines) + "\n"
+
+
+def write_docs(path: str = "docs/configs.md") -> None:
+    import os
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(help_text())

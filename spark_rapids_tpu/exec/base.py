@@ -1,0 +1,199 @@
+"""Physical operator base (reference `GpuExec.scala:58-123`).
+
+A `TpuExec` produces an iterator of `ColumnarBatch` — the TPU analog of
+`doExecuteColumnar(): RDD[ColumnarBatch]`.  The engine is host-driven like
+Spark tasks: Python orchestrates batch flow, while all per-batch compute
+runs in jitted XLA executables.
+
+The kernel compile cache is the central XLA-fit mechanism (SURVEY.md §7
+hard part (a)): executables are keyed on (plan node, batch shape signature)
+so ragged Spark batches hit a small set of bucketed compilations.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.vector import ColumnVector
+from spark_rapids_tpu.exprs.base import EvalContext, Expression
+from spark_rapids_tpu.utils import metrics as M
+from spark_rapids_tpu.utils.tracing import trace_range
+
+
+# ---------------------------------------------------------------------------
+# coalesce goals (reference GpuCoalesceBatches.scala:91-113)
+@dataclasses.dataclass(frozen=True)
+class CoalesceGoal:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class TargetSize(CoalesceGoal):
+    bytes: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RequireSingleBatch(CoalesceGoal):
+    pass
+
+
+def max_goal(a: Optional[CoalesceGoal], b: Optional[CoalesceGoal]
+             ) -> Optional[CoalesceGoal]:
+    if isinstance(a, RequireSingleBatch) or isinstance(b, RequireSingleBatch):
+        return RequireSingleBatch()
+    if isinstance(a, TargetSize) and isinstance(b, TargetSize):
+        return TargetSize(max(a.bytes, b.bytes))
+    return a or b
+
+
+# ---------------------------------------------------------------------------
+def batch_signature(batch: ColumnarBatch) -> tuple:
+    """Shape signature for the compile cache: capacity + per-column
+    (dtype, char_cap)."""
+    sig = [batch.capacity]
+    for f, c in zip(batch.schema.fields, batch.columns):
+        sig.append((f.dtype.id.value,
+                    c.char_cap if f.dtype.is_string else 0))
+    return tuple(sig)
+
+
+class KernelCache:
+    """Caches jitted executables per (node-key, signature)."""
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    def get_or_build(self, key: tuple, builder: Callable[[], Callable]):
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = builder()
+            self._cache[key] = fn
+        return fn
+
+    def __len__(self):
+        return len(self._cache)
+
+
+
+
+def make_eval_context(columns: list[ColumnVector], capacity: int,
+                      num_rows) -> EvalContext:
+    row_mask = jnp.arange(capacity) < num_rows
+    return EvalContext(columns, capacity, num_rows, row_mask)
+
+
+import itertools
+
+_EXEC_IDS = itertools.count()
+
+
+class TpuExec:
+    """Base physical operator."""
+
+    def __init__(self, *children: "TpuExec"):
+        self._children = list(children)
+        self.metrics = M.MetricSet()
+        self.exec_id = next(_EXEC_IDS)
+        # per-instance cache: executables are freed with the plan instead of
+        # accumulating in a process-global map
+        self.kernels = KernelCache()
+
+    @property
+    def children(self) -> list["TpuExec"]:
+        return self._children
+
+    @property
+    def child(self) -> "TpuExec":
+        return self._children[0]
+
+    def output_schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    # coalesce contract (reference GpuExec.coalesceAfter /
+    # childrenCoalesceGoal)
+    @property
+    def coalesce_after(self) -> bool:
+        return False
+
+    def children_coalesce_goal(self) -> list[Optional[CoalesceGoal]]:
+        return [None] * len(self._children)
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError
+
+    def execute_partitions(self) -> list[Iterator[ColumnarBatch]]:
+        """Partitioned execution (RDD analog).  Default: operators that are
+        partition-local map themselves over each child partition."""
+        kids = [c.execute_partitions() for c in self._children]
+        if not kids:
+            return [self.execute_columnar()]
+        n = len(kids[0])
+        return [self._execute_partition(i, [k[i] for k in kids])
+                for i in range(n)]
+
+    def _execute_partition(self, idx: int, child_iters
+                           ) -> Iterator[ColumnarBatch]:
+        # default: single-child partition-local operators override
+        # execute_columnar using self.child; rebuild with a shim child.
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support partitioned execution")
+
+    def collect(self) -> ColumnarBatch:
+        from spark_rapids_tpu.columnar.batch import concat_batches, empty_batch
+        batches = list(self.execute_columnar())
+        if not batches:
+            return empty_batch(self.output_schema())
+        return concat_batches(batches)
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    def update_output_metrics(self, batch: ColumnarBatch) -> None:
+        self.metrics.add(M.NUM_OUTPUT_ROWS, batch.num_rows)
+        self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + self.describe()
+        for c in self._children:
+            s += "\n" + c.tree_string(indent + 1)
+        return s
+
+    def describe(self) -> str:
+        return self.name()
+
+    def __repr__(self):
+        return self.tree_string()
+
+
+class LeafExec(TpuExec):
+    def execute_partitions(self):
+        return [self.execute_columnar()]
+
+
+class UnaryExecBase(TpuExec):
+    """Partition-local single-child operator: processes one child batch
+    iterator into an output iterator."""
+
+    def process_partition(self, batches: Iterator[ColumnarBatch]
+                          ) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        return self.process_partition(self.child.execute_columnar())
+
+    def execute_partitions(self):
+        return [self.process_partition(it)
+                for it in self.child.execute_partitions()]
+
+
+def bind_exprs(exprs: Sequence[Expression], schema: T.Schema
+               ) -> list[Expression]:
+    return [e.bind(schema) for e in exprs]

@@ -1,0 +1,171 @@
+"""Arithmetic expressions (reference `org/.../rapids/arithmetic.scala`).
+
+Spark parity notes:
+  - `/` always yields double; x/0 -> null (non-ANSI).
+  - `%` keeps the dividend's sign (Java semantics) -> lax.rem.
+  - pmod yields a non-negative result.
+  - Integer overflow wraps (Java two's-complement), which jnp int ops match.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import ColumnVector
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, EvalContext, Expression, UnaryExpression,
+    numeric_result_type, promote)
+
+
+def _arith_result(schema, l, r):
+    return numeric_result_type(schema, l, r)
+
+
+@dataclasses.dataclass(eq=False)
+class _BinaryArith(BinaryExpression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return _arith_result(schema, self.left, self.right)
+
+    def do_columnar(self, l: ColumnVector, r: ColumnVector, ctx):
+        dt = T.common_type(l.dtype, r.dtype)
+        l, r = promote(l, dt), promote(r, dt)
+        validity = l.validity & r.validity
+        data = self.op(l.data, r.data)
+        return ColumnVector(dt, data, validity)
+
+
+class Add(_BinaryArith):
+    def op(self, a, b):
+        return a + b
+
+
+class Subtract(_BinaryArith):
+    def op(self, a, b):
+        return a - b
+
+
+class Multiply(_BinaryArith):
+    def op(self, a, b):
+        return a * b
+
+
+@dataclasses.dataclass(eq=False)
+class Divide(BinaryExpression):
+    """Double division; divide-by-zero -> null (Spark non-ANSI)."""
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return T.FLOAT64
+
+    def do_columnar(self, l, r, ctx):
+        a = l.data.astype(jnp.float64)
+        b = r.data.astype(jnp.float64)
+        zero = b == 0.0
+        validity = l.validity & r.validity & ~zero
+        data = a / jnp.where(zero, 1.0, b)
+        return ColumnVector(T.FLOAT64, data, validity)
+
+
+@dataclasses.dataclass(eq=False)
+class IntegralDivide(BinaryExpression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return T.INT64
+
+    def do_columnar(self, l, r, ctx):
+        a = l.data.astype(jnp.int64)
+        b = r.data.astype(jnp.int64)
+        zero = b == 0
+        validity = l.validity & r.validity & ~zero
+        safe_b = jnp.where(zero, 1, b)
+        q = lax.div(a, safe_b)  # trunc toward zero = Java / Spark div
+        return ColumnVector(T.INT64, q, validity)
+
+
+@dataclasses.dataclass(eq=False)
+class Remainder(BinaryExpression):
+    """x % 0 -> null; result sign follows dividend (Java %)."""
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return _arith_result(schema, self.left, self.right)
+
+    def do_columnar(self, l, r, ctx):
+        dt = T.common_type(l.dtype, r.dtype)
+        l, r = promote(l, dt), promote(r, dt)
+        if dt.is_floating:
+            zero = r.data == 0.0
+            validity = l.validity & r.validity & ~zero
+            data = lax.rem(l.data, jnp.where(zero, 1.0, r.data))
+        else:
+            zero = r.data == 0
+            validity = l.validity & r.validity & ~zero
+            data = lax.rem(l.data, jnp.where(zero, 1, r.data))
+        return ColumnVector(dt, data, validity)
+
+
+@dataclasses.dataclass(eq=False)
+class Pmod(BinaryExpression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return _arith_result(schema, self.left, self.right)
+
+    def do_columnar(self, l, r, ctx):
+        dt = T.common_type(l.dtype, r.dtype)
+        l, r = promote(l, dt), promote(r, dt)
+        if dt.is_floating:
+            zero = r.data == 0.0
+            safe = jnp.where(zero, 1.0, r.data)
+        else:
+            zero = r.data == 0
+            safe = jnp.where(zero, 1, r.data)
+        rem = lax.rem(l.data, safe)
+        data = jnp.where((rem != 0) & ((rem < 0) != (safe < 0)),
+                         rem + safe, rem)
+        validity = l.validity & r.validity & ~zero
+        return ColumnVector(dt, data, validity)
+
+
+@dataclasses.dataclass(eq=False)
+class UnaryMinus(UnaryExpression):
+    child: Expression
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def do_columnar(self, c, ctx):
+        return ColumnVector(c.dtype, -c.data, c.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class UnaryPositive(UnaryExpression):
+    child: Expression
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def do_columnar(self, c, ctx):
+        return c
+
+
+@dataclasses.dataclass(eq=False)
+class Abs(UnaryExpression):
+    child: Expression
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def do_columnar(self, c, ctx):
+        return ColumnVector(c.dtype, jnp.abs(c.data), c.validity)

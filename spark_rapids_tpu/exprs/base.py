@@ -1,0 +1,299 @@
+"""Expression protocol (reference `GpuExpressions.scala:69-93`).
+
+`Expression.eval(ctx)` returns a `ColumnVector` whose arrays are JAX values —
+evaluation happens *inside* a jitted kernel built by the exec layer, so the
+whole expression tree fuses into one XLA computation (the TPU answer to
+cuDF's kernel-per-op launches: XLA fuses elementwise chains into single
+VPU loops over the batch).
+
+Null semantics follow Spark: most ops propagate nulls (result validity =
+AND of child validities); special cases (IsNull, Coalesce, And/Or Kleene
+logic) override `eval` entirely.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import (
+    ColumnVector, bucket_char_cap)
+
+
+@dataclasses.dataclass(eq=False)
+class EvalContext:
+    """Per-kernel evaluation context: the input columns (traced), static
+    capacity, and the traced valid-row mask."""
+    columns: list[ColumnVector]
+    capacity: int
+    num_rows: Any  # traced int32 scalar
+    row_mask: Any  # traced bool[capacity]
+
+
+class Expression:
+    """Base of the columnar expression tree."""
+
+    def data_type(self, input_schema: T.Schema) -> T.DataType:
+        raise NotImplementedError
+
+    def children(self) -> Sequence["Expression"]:
+        return ()
+
+    def eval(self, ctx: EvalContext) -> ColumnVector:
+        raise NotImplementedError
+
+    def bind(self, schema: T.Schema) -> "Expression":
+        """Resolve column names to positions (reference
+        `GpuBoundAttribute.scala:97` GpuBindReferences)."""
+        return self.map_children(lambda c: c.bind(schema))
+
+    def map_children(self, fn) -> "Expression":
+        kids = self.children()
+        if not kids:
+            return self
+        new = [fn(c) for c in kids]
+        return self.with_children(new)
+
+    def with_children(self, new_children) -> "Expression":
+        raise NotImplementedError(type(self))
+
+    # sugar -----------------------------------------------------------------
+    def __add__(self, o): return _binop("Add", self, _lit(o))
+    def __sub__(self, o): return _binop("Subtract", self, _lit(o))
+    def __mul__(self, o): return _binop("Multiply", self, _lit(o))
+    def __truediv__(self, o): return _binop("Divide", self, _lit(o))
+    def __mod__(self, o): return _binop("Remainder", self, _lit(o))
+    def __gt__(self, o): return _binop("GreaterThan", self, _lit(o))
+    def __ge__(self, o): return _binop("GreaterThanOrEqual", self, _lit(o))
+    def __lt__(self, o): return _binop("LessThan", self, _lit(o))
+    def __le__(self, o): return _binop("LessThanOrEqual", self, _lit(o))
+    def eq(self, o): return _binop("EqualTo", self, _lit(o))
+    def ne(self, o):
+        from spark_rapids_tpu.exprs.predicates import Not
+        return Not(_binop("EqualTo", self, _lit(o)))
+    # __eq__/__ne__ build expressions too (all expr dataclasses use eq=False
+    # so these aren't shadowed); `col("a") == 0` therefore works like Spark
+    def __eq__(self, o): return _binop("EqualTo", self, _lit(o))
+    def __ne__(self, o):
+        from spark_rapids_tpu.exprs.predicates import Not
+        return Not(_binop("EqualTo", self, _lit(o)))
+    __hash__ = object.__hash__
+    def __and__(self, o):
+        from spark_rapids_tpu.exprs.predicates import And
+        return And(self, _lit(o))
+    def __or__(self, o):
+        from spark_rapids_tpu.exprs.predicates import Or
+        return Or(self, _lit(o))
+    def alias(self, name: str) -> "Alias":
+        return Alias(self, name)
+    def cast(self, dt: T.DataType, ansi: bool = False):
+        from spark_rapids_tpu.exprs.cast import Cast
+        return Cast(self, dt, ansi)
+
+
+def _lit(v):
+    return v if isinstance(v, Expression) else Literal.of(v)
+
+
+def _binop(name, l, r):
+    from spark_rapids_tpu.exprs import arithmetic, predicates
+    for mod in (arithmetic, predicates):
+        if hasattr(mod, name):
+            return getattr(mod, name)(l, r)
+    raise KeyError(name)
+
+
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(eq=False)
+class AttributeReference(Expression):
+    """Unresolved column-by-name; becomes BoundReference at bind time."""
+    name: str
+
+    def data_type(self, schema: T.Schema) -> T.DataType:
+        return schema.field(self.name).dtype
+
+    def bind(self, schema: T.Schema) -> Expression:
+        return BoundReference(schema.index(self.name),
+                              schema.field(self.name).dtype)
+
+    def eval(self, ctx):
+        raise RuntimeError(f"unbound attribute {self.name}")
+
+    def __repr__(self):
+        return self.name
+
+
+def col(name: str) -> AttributeReference:
+    return AttributeReference(name)
+
+
+@dataclasses.dataclass(eq=False)
+class BoundReference(Expression):
+    """Positional column reference (reference GpuBoundReference)."""
+    ordinal: int
+    dtype: T.DataType
+
+    def data_type(self, schema) -> T.DataType:
+        return self.dtype
+
+    def bind(self, schema):
+        return self
+
+    def eval(self, ctx: EvalContext) -> ColumnVector:
+        return ctx.columns[self.ordinal]
+
+    def __repr__(self):
+        return f"input[{self.ordinal}]"
+
+
+@dataclasses.dataclass(eq=False)
+class Literal(Expression):
+    """Typed literal, broadcast to the batch capacity at eval (XLA fuses the
+    broadcast away).  Reference `literals.scala` GpuLiteral."""
+    value: Any
+    dtype: T.DataType
+
+    @staticmethod
+    def of(v: Any, dtype: Optional[T.DataType] = None) -> "Literal":
+        if dtype is None:
+            if v is None:
+                raise TypeError("null literal needs explicit dtype")
+            if isinstance(v, bool):
+                dtype = T.BOOL
+            elif isinstance(v, int):
+                dtype = T.INT32 if -2**31 <= v < 2**31 else T.INT64
+            elif isinstance(v, float):
+                dtype = T.FLOAT64
+            elif isinstance(v, str):
+                dtype = T.STRING
+            else:
+                raise TypeError(f"unsupported literal {v!r}")
+        return Literal(v, dtype)
+
+    def data_type(self, schema) -> T.DataType:
+        return self.dtype
+
+    def bind(self, schema):
+        return self
+
+    def eval(self, ctx: EvalContext) -> ColumnVector:
+        cap = ctx.capacity
+        if self.value is None:
+            validity = jnp.zeros(cap, bool)
+            if self.dtype.is_string:
+                return ColumnVector(self.dtype,
+                                    jnp.zeros((cap, 8), jnp.uint8), validity,
+                                    jnp.zeros(cap, jnp.int32))
+            return ColumnVector(self.dtype,
+                                jnp.zeros(cap, self.dtype.storage_dtype),
+                                validity)
+        validity = ctx.row_mask
+        if self.dtype.is_string:
+            raw = np.frombuffer(str(self.value).encode("utf-8"), np.uint8)
+            cc = bucket_char_cap(len(raw))
+            host = np.zeros((1, cc), np.uint8)
+            host[0, : len(raw)] = raw
+            data = jnp.broadcast_to(jnp.asarray(host), (cap, cc))
+            lengths = jnp.where(validity, np.int32(len(raw)), 0)
+            return ColumnVector(self.dtype, data, validity,
+                                lengths.astype(jnp.int32))
+        data = jnp.full(cap, self.value, self.dtype.storage_dtype)
+        return ColumnVector(self.dtype, data, validity)
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+def lit(v: Any, dtype: Optional[T.DataType] = None) -> Literal:
+    return Literal.of(v, dtype)
+
+
+@dataclasses.dataclass(eq=False)
+class Alias(Expression):
+    child: Expression
+    name: str
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, kids):
+        return Alias(kids[0], self.name)
+
+    def eval(self, ctx):
+        return self.child.eval(ctx)
+
+    def __repr__(self):
+        return f"{self.child!r} AS {self.name}"
+
+
+def output_name(e: Expression, idx: int) -> str:
+    if isinstance(e, Alias):
+        return e.name
+    if isinstance(e, AttributeReference):
+        return e.name
+    return f"col{idx}"
+
+
+# -- helper bases -----------------------------------------------------------
+class UnaryExpression(Expression):
+    """Null-propagating unary op (reference GpuUnaryExpression)."""
+    child: Expression
+
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, kids):
+        return type(self)(kids[0])
+
+    def eval(self, ctx: EvalContext) -> ColumnVector:
+        c = self.child.eval(ctx)
+        return self.do_columnar(c, ctx)
+
+    def do_columnar(self, c: ColumnVector, ctx: EvalContext) -> ColumnVector:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.child!r})"
+
+
+class BinaryExpression(Expression):
+    """Null-propagating binary op (reference GpuBinaryExpression)."""
+    left: Expression
+    right: Expression
+
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, kids):
+        return type(self)(kids[0], kids[1])
+
+    def eval(self, ctx: EvalContext) -> ColumnVector:
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        return self.do_columnar(l, r, ctx)
+
+    def do_columnar(self, l, r, ctx) -> ColumnVector:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.left!r}, {self.right!r})"
+
+
+def numeric_result_type(schema, *exprs) -> T.DataType:
+    dts = [e.data_type(schema) for e in exprs]
+    out = dts[0]
+    for dt in dts[1:]:
+        out = T.common_type(out, dt)
+    return out
+
+
+def promote(v: ColumnVector, dt: T.DataType) -> ColumnVector:
+    if v.dtype == dt:
+        return v
+    return ColumnVector(dt, v.data.astype(dt.storage_dtype), v.validity)

@@ -1,0 +1,101 @@
+"""Bitwise expressions (reference `bitwise.scala`): and/or/xor/not/shifts.
+
+Shift semantics match Java/Spark: the shift distance is masked to the bit
+width of the value (x << 33 on int32 == x << 1)."""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import ColumnVector
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, Expression, UnaryExpression, promote)
+
+
+@dataclasses.dataclass(eq=False)
+class _BitwiseBin(BinaryExpression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return T.common_type(self.left.data_type(schema),
+                             self.right.data_type(schema))
+
+    def do_columnar(self, l, r, ctx):
+        dt = T.common_type(l.dtype, r.dtype)
+        l, r = promote(l, dt), promote(r, dt)
+        return ColumnVector(dt, self.op(l.data, r.data),
+                            l.validity & r.validity)
+
+
+class BitwiseAnd(_BitwiseBin):
+    def op(self, a, b): return a & b
+
+
+class BitwiseOr(_BitwiseBin):
+    def op(self, a, b): return a | b
+
+
+class BitwiseXor(_BitwiseBin):
+    def op(self, a, b): return a ^ b
+
+
+@dataclasses.dataclass(eq=False)
+class BitwiseNot(UnaryExpression):
+    child: Expression
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def do_columnar(self, c, ctx):
+        return ColumnVector(c.dtype, ~c.data, c.validity)
+
+
+def _mask_shift(data, shift):
+    bits = data.dtype.itemsize * 8
+    return (shift & (bits - 1)).astype(data.dtype)
+
+
+@dataclasses.dataclass(eq=False)
+class ShiftLeft(BinaryExpression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return self.left.data_type(schema)
+
+    def do_columnar(self, l, r, ctx):
+        s = _mask_shift(l.data, r.data)
+        return ColumnVector(l.dtype, lax.shift_left(l.data, s),
+                            l.validity & r.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class ShiftRight(BinaryExpression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return self.left.data_type(schema)
+
+    def do_columnar(self, l, r, ctx):
+        s = _mask_shift(l.data, r.data)
+        return ColumnVector(l.dtype, lax.shift_right_arithmetic(l.data, s),
+                            l.validity & r.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class ShiftRightUnsigned(BinaryExpression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return self.left.data_type(schema)
+
+    def do_columnar(self, l, r, ctx):
+        s = _mask_shift(l.data, r.data)
+        return ColumnVector(l.dtype, lax.shift_right_logical(l.data, s),
+                            l.validity & r.validity)

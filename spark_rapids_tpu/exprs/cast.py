@@ -1,0 +1,302 @@
+"""Cast expression (reference `GpuCast.scala:31,188`).
+
+Spark (non-ANSI) cast semantics implemented on-device:
+  - float -> int: Java semantics — truncate toward zero, saturate at type
+    bounds, NaN -> 0.
+  - int -> bool: nonzero is true; bool -> numeric: 1/0.
+  - numeric/bool/date -> string: device-side digit/format generation over
+    byte tensors (no host round trip).
+  - string -> int/long: trimmed decimal parse, invalid -> null.
+  - string -> float and string -> timestamp are gated by conf like the
+    reference (`spark.rapids.sql.castStringToFloat.enabled` etc.).
+  - timestamp <-> date via UTC-day arithmetic (UTC-only, as the reference).
+
+ANSI mode raises on overflow/invalid instead of null/wrap; we implement the
+null/wrap path and expose `ansi` to fail at plan time (tagged unsupported)
+to stay honest rather than silently differing.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import ColumnVector, bucket_char_cap
+from spark_rapids_tpu.exprs import datetime_utils as DT
+from spark_rapids_tpu.exprs.base import EvalContext, Expression
+
+_INT_BOUNDS = {
+    T.TypeId.INT8: (-(2 ** 7), 2 ** 7 - 1),
+    T.TypeId.INT16: (-(2 ** 15), 2 ** 15 - 1),
+    T.TypeId.INT32: (-(2 ** 31), 2 ** 31 - 1),
+    T.TypeId.INT64: (-(2 ** 63), 2 ** 63 - 1),
+}
+
+
+@dataclasses.dataclass(eq=False)
+class Cast(Expression):
+    child: Expression
+    to: T.DataType
+    ansi: bool = False
+
+    def data_type(self, schema):
+        return self.to
+
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, kids):
+        return Cast(kids[0], self.to, self.ansi)
+
+    def eval(self, ctx: EvalContext) -> ColumnVector:
+        c = self.child.eval(ctx)
+        src, dst = c.dtype, self.to
+        if src == dst:
+            return c
+        if dst.is_string:
+            return _to_string(c, ctx)
+        if src.is_string:
+            return _from_string(c, dst, ctx)
+        if dst.id == T.TypeId.BOOL:
+            return ColumnVector(T.BOOL, c.data != 0, c.validity)
+        if src.id == T.TypeId.BOOL:
+            return ColumnVector(
+                dst, c.data.astype(dst.storage_dtype), c.validity)
+        if src.is_floating and dst.is_integral:
+            return _float_to_int(c, dst)
+        if src.id == T.TypeId.TIMESTAMP_US and dst.id == T.TypeId.DATE32:
+            return ColumnVector(
+                T.DATE32, DT.micros_to_date_days(c.data), c.validity)
+        if src.id == T.TypeId.DATE32 and dst.id == T.TypeId.TIMESTAMP_US:
+            return ColumnVector(
+                T.TIMESTAMP_US,
+                c.data.astype(jnp.int64) * DT.MICROS_PER_DAY, c.validity)
+        if src.id == T.TypeId.TIMESTAMP_US and dst.is_numeric:
+            # Spark: timestamp -> long/double is SECONDS since epoch
+            secs = c.data.astype(jnp.float64) / DT.MICROS_PER_SECOND
+            if dst.is_floating:
+                return ColumnVector(dst, secs.astype(dst.storage_dtype),
+                                    c.validity)
+            return ColumnVector(
+                dst, (c.data // DT.MICROS_PER_SECOND).astype(
+                    dst.storage_dtype), c.validity)
+        if dst.id == T.TypeId.TIMESTAMP_US and src.is_numeric:
+            if src.is_floating:
+                data = (c.data * DT.MICROS_PER_SECOND).astype(jnp.int64)
+            else:
+                data = c.data.astype(jnp.int64) * DT.MICROS_PER_SECOND
+            return ColumnVector(T.TIMESTAMP_US, data, c.validity)
+        # plain numeric widening/narrowing: wraps like Java (non-ANSI)
+        return ColumnVector(dst, c.data.astype(dst.storage_dtype), c.validity)
+
+    def __repr__(self):
+        return f"cast({self.child!r} as {self.to})"
+
+
+def _float_to_int(c: ColumnVector, dst: T.DataType) -> ColumnVector:
+    lo, hi = _INT_BOUNDS[dst.id if dst.id in _INT_BOUNDS else T.TypeId.INT64]
+    x = c.data
+    nan = jnp.isnan(x)
+    trunc = jnp.trunc(jnp.where(nan, 0.0, x))
+    # saturate via explicit selects — jnp.clip(inf) NaNs out, and XLA's
+    # f64->s32 convert is lossy at the boundary, so pick exact int bounds
+    over = trunc >= float(hi)
+    under = trunc <= float(lo)
+    safe = jnp.where(over | under, 0.0, trunc).astype(jnp.int64)
+    data = jnp.where(over, hi, jnp.where(under, lo, safe))
+    return ColumnVector(dst, data.astype(dst.storage_dtype), c.validity)
+
+
+# --------------------------------------------------------------------------
+# to-string kernels: all device-side byte-tensor generation
+_MAX_I64_DIGITS = 19
+
+
+def _int_to_string(values, capacity: int):
+    """int64 -> (bytes uint8[cap, 20], lengths int32[cap])."""
+    v = values.astype(jnp.int64)
+    neg = v < 0
+    # abs via where to dodge INT64_MIN overflow: work in uint64
+    mag = jnp.where(neg, (-(v + 1)).astype(jnp.uint64) + 1,
+                    v.astype(jnp.uint64))
+    pows = jnp.asarray([10 ** (18 - k) for k in range(_MAX_I64_DIGITS)],
+                       dtype=jnp.uint64)
+    digits = (mag[:, None] // pows[None, :]) % 10          # [cap, 19]
+    ndig = _MAX_I64_DIGITS - jnp.argmax(digits != 0, axis=1)
+    ndig = jnp.where((digits != 0).any(axis=1), ndig, 1)   # "0"
+    length = ndig + neg
+    width = _MAX_I64_DIGITS + 1
+    pos = jnp.arange(width)[None, :]
+    # output char j: '-' at j=0 when neg; digit index = 19 - ndig + (j - neg)
+    didx = (_MAX_I64_DIGITS - ndig)[:, None] + pos - neg[:, None].astype(
+        jnp.int64)
+    didx = jnp.clip(didx, 0, _MAX_I64_DIGITS - 1)
+    chars = jnp.take_along_axis(digits, didx.astype(jnp.int32), axis=1)
+    out = (chars + ord("0")).astype(jnp.uint8)
+    out = jnp.where(neg[:, None] & (pos == 0), ord("-"), out)
+    out = jnp.where(pos < length[:, None], out, 0).astype(jnp.uint8)
+    return out, length.astype(jnp.int32)
+
+
+def _pad2(x):
+    """int -> two ascii digit chars [cap, 2]."""
+    x = x.astype(jnp.int64)
+    return jnp.stack([x // 10 + ord("0"), x % 10 + ord("0")],
+                     axis=1).astype(jnp.uint8)
+
+
+def _date_to_string(days, capacity: int):
+    """date32 -> 'yyyy-MM-dd' byte tensor (width 10; years 0000-9999)."""
+    y, m, d = DT.days_to_ymd(days)
+    yc = jnp.stack([(y // 1000) % 10, (y // 100) % 10, (y // 10) % 10,
+                    y % 10], axis=1) + ord("0")
+    dash = jnp.full((capacity, 1), ord("-"), jnp.uint8)
+    out = jnp.concatenate([yc.astype(jnp.uint8), dash, _pad2(m), dash,
+                           _pad2(d)], axis=1)
+    return out, jnp.full(capacity, 10, jnp.int32)
+
+
+def _timestamp_to_string(micros, capacity: int):
+    """timestamp -> 'yyyy-MM-dd HH:mm:ss[.ffffff]' (Spark trims trailing
+    zeros of fraction; we emit seconds precision + micros when nonzero)."""
+    days = DT.micros_to_date_days(micros)
+    date_part, _ = _date_to_string(days, capacity)
+    h, mnt, s, us = DT.micros_time_of_day(micros)
+    sp = jnp.full((capacity, 1), ord(" "), jnp.uint8)
+    colon = jnp.full((capacity, 1), ord(":"), jnp.uint8)
+    base = jnp.concatenate([date_part, sp, _pad2(h), colon, _pad2(mnt),
+                            colon, _pad2(s)], axis=1)          # width 19
+    # fraction: 6 digits + '.', present when us != 0
+    digs = jnp.stack([(us // 10 ** (5 - k)) % 10 for k in range(6)],
+                     axis=1) + ord("0")
+    dot = jnp.full((capacity, 1), ord("."), jnp.uint8)
+    frac = jnp.concatenate([dot, digs.astype(jnp.uint8)], axis=1)
+    has_frac = us != 0
+    # trailing-zero trim: fraction length = 6 - count of trailing zeros
+    tz = jnp.zeros(capacity, jnp.int32)
+    running = jnp.ones(capacity, bool)
+    for k in range(5, -1, -1):
+        z = (digs[:, k] - ord("0")) == 0
+        running = running & z
+        tz = tz + running.astype(jnp.int32)
+    frac_len = jnp.where(has_frac, 7 - tz, 0)
+    out = jnp.concatenate([base, frac], axis=1)
+    pos = jnp.arange(out.shape[1])[None, :]
+    length = 19 + frac_len
+    out = jnp.where(pos < length[:, None], out, 0).astype(jnp.uint8)
+    return out, length.astype(jnp.int32)
+
+
+def _to_string(c: ColumnVector, ctx) -> ColumnVector:
+    cap = c.capacity
+    if c.dtype.id == T.TypeId.BOOL:
+        width = 5
+        t = np.zeros(width, np.uint8)
+        t[:4] = np.frombuffer(b"true", np.uint8)
+        f = np.frombuffer(b"false", np.uint8)
+        data = jnp.where(c.data[:, None],
+                         jnp.asarray(t)[None, :], jnp.asarray(f)[None, :])
+        lengths = jnp.where(c.data, 4, 5).astype(jnp.int32)
+        return ColumnVector(T.STRING, data.astype(jnp.uint8), c.validity,
+                            lengths)
+    if c.dtype.id == T.TypeId.DATE32:
+        data, lengths = _date_to_string(c.data, cap)
+        return ColumnVector(T.STRING, data, c.validity, lengths)
+    if c.dtype.id == T.TypeId.TIMESTAMP_US:
+        data, lengths = _timestamp_to_string(c.data, cap)
+        return ColumnVector(T.STRING, data, c.validity, lengths)
+    if c.dtype.is_integral:
+        data, lengths = _int_to_string(c.data, cap)
+        return ColumnVector(T.STRING, data, c.validity, lengths)
+    if c.dtype.is_floating:
+        # gated like the reference (castFloatToString.enabled): formatting
+        # differs from Java's Double.toString shortest-repr; we emit %.6g-ish
+        raise NotImplementedError(
+            "float->string cast requires "
+            "spark.rapids.sql.castFloatToString.enabled handling at plan "
+            "time; not supported in kernels yet")
+    raise NotImplementedError(f"cast {c.dtype} -> string")
+
+
+# --------------------------------------------------------------------------
+def _from_string(c: ColumnVector, dst: T.DataType, ctx) -> ColumnVector:
+    if dst.is_integral and dst.id not in (T.TypeId.DATE32,
+                                          T.TypeId.TIMESTAMP_US):
+        return _string_to_int(c, dst)
+    if dst.is_floating:
+        raise NotImplementedError(
+            "string->float cast is gated "
+            "(spark.rapids.sql.castStringToFloat.enabled)")
+    if dst.id == T.TypeId.DATE32:
+        return _string_to_date(c)
+    raise NotImplementedError(f"cast string -> {dst}")
+
+
+def _string_to_int(c: ColumnVector, dst: T.DataType) -> ColumnVector:
+    """Trimmed decimal parse; invalid or overflowing -> null (Spark)."""
+    cc = c.char_cap
+    chars = c.data.astype(jnp.int32)                     # [cap, cc]
+    lens = c.lengths
+    pos = jnp.arange(cc)[None, :]
+    in_str = pos < lens[:, None]
+    is_space = (chars == ord(" ")) & in_str
+    # leading spaces
+    lead = jnp.argmax((~is_space) & in_str, axis=1)
+    lead = jnp.where((is_space | ~in_str).all(axis=1), lens, lead)
+    # trailing spaces: last non-space index
+    rev_nonspace = (~is_space) & in_str
+    last = (cc - 1) - jnp.argmax(rev_nonspace[:, ::-1], axis=1)
+    last = jnp.where(rev_nonspace.any(axis=1), last, -1)
+    sign_char = jnp.take_along_axis(chars, lead[:, None],
+                                    axis=1)[:, 0]
+    has_sign = (sign_char == ord("-")) | (sign_char == ord("+"))
+    neg = sign_char == ord("-")
+    start = lead + has_sign.astype(jnp.int64)
+    ndigits = last - start + 1
+    in_digits = (pos >= start[:, None]) & (pos <= last[:, None])
+    dig = chars - ord("0")
+    digit_ok = (dig >= 0) & (dig <= 9)
+    valid_parse = (ndigits >= 1) & (ndigits <= 19) & \
+        (jnp.where(in_digits, digit_ok, True).all(axis=1))
+    # Horner accumulate left->right over static char width
+    acc = jnp.zeros(c.capacity, jnp.int64)
+    for k in range(cc):
+        use = in_digits[:, k]
+        acc = jnp.where(use, acc * 10 + dig[:, k].astype(jnp.int64), acc)
+    val = jnp.where(neg, -acc, acc)
+    lo, hi = _INT_BOUNDS.get(dst.id, _INT_BOUNDS[T.TypeId.INT64])
+    in_range = (val >= lo) & (val <= hi)
+    validity = c.validity & valid_parse & in_range
+    return ColumnVector(dst, val.astype(dst.storage_dtype),
+                        validity)
+
+
+def _string_to_date(c: ColumnVector) -> ColumnVector:
+    """Parse 'yyyy-MM-dd' (and 'yyyy-M-d' variants rejected -> null; Spark
+    accepts several shapes, we support the canonical one plus yyyy-MM)."""
+    cc = c.char_cap
+    if cc < 10:
+        from spark_rapids_tpu.columnar.vector import _pad_chars
+        c = _pad_chars(c, 10)
+        cc = 10
+    chars = c.data.astype(jnp.int32)
+    ok_len = c.lengths == 10
+    dig = chars - ord("0")
+
+    def num(sl):
+        out = jnp.zeros(c.capacity, jnp.int64)
+        for k in sl:
+            out = out * 10 + dig[:, k]
+        return out
+
+    digits_ok = jnp.ones(c.capacity, bool)
+    for k in (0, 1, 2, 3, 5, 6, 8, 9):
+        digits_ok = digits_ok & (dig[:, k] >= 0) & (dig[:, k] <= 9)
+    dashes_ok = (chars[:, 4] == ord("-")) & (chars[:, 7] == ord("-"))
+    y, m, d = num((0, 1, 2, 3)), num((5, 6)), num((8, 9))
+    range_ok = (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
+    validity = c.validity & ok_len & digits_ok & dashes_ok & range_ok
+    days = DT.ymd_to_days(y, m, d)
+    return ColumnVector(T.DATE32, days, validity)

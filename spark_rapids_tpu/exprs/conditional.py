@@ -1,0 +1,223 @@
+"""Conditional & null-handling expressions (reference
+`conditionalExpressions.scala`, `nullExpressions.scala`)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import ColumnVector, align_char_caps
+from spark_rapids_tpu.exprs.base import EvalContext, Expression
+
+
+def _select(cond: jnp.ndarray, a: ColumnVector, b: ColumnVector
+            ) -> ColumnVector:
+    """where(cond, a, b) over ColumnVectors, string-aware."""
+    if a.dtype.is_string:
+        a, b = align_char_caps(a, b)
+        data = jnp.where(cond[:, None], a.data, b.data)
+        lengths = jnp.where(cond, a.lengths, b.lengths)
+        validity = jnp.where(cond, a.validity, b.validity)
+        return ColumnVector(a.dtype, data, validity, lengths)
+    dt = a.dtype if a.dtype == b.dtype else T.common_type(a.dtype, b.dtype)
+    from spark_rapids_tpu.exprs.base import promote
+    a, b = promote(a, dt), promote(b, dt)
+    data = jnp.where(cond, a.data, b.data)
+    validity = jnp.where(cond, a.validity, b.validity)
+    return ColumnVector(dt, data, validity)
+
+
+def _branch_type(schema, *exprs) -> T.DataType:
+    """Common result type across branches — must agree with what _select
+    produces at eval time (numeric promotion)."""
+    out = exprs[0].data_type(schema)
+    for e in exprs[1:]:
+        dt = e.data_type(schema)
+        if dt != out:
+            out = T.common_type(out, dt)
+    return out
+
+
+@dataclasses.dataclass(eq=False)
+class If(Expression):
+    predicate: Expression
+    true_value: Expression
+    false_value: Expression
+
+    def data_type(self, schema):
+        return _branch_type(schema, self.true_value, self.false_value)
+
+    def children(self):
+        return (self.predicate, self.true_value, self.false_value)
+
+    def with_children(self, kids):
+        return If(*kids)
+
+    def eval(self, ctx: EvalContext):
+        p = self.predicate.eval(ctx)
+        t = self.true_value.eval(ctx)
+        f = self.false_value.eval(ctx)
+        cond = p.validity & p.data.astype(bool)  # null predicate -> else
+        return _select(cond, t, f)
+
+
+@dataclasses.dataclass(eq=False)
+class CaseWhen(Expression):
+    branches: tuple  # ((cond, value), ...)
+    else_value: Optional[Expression] = None
+
+    def data_type(self, schema):
+        vals = [v for _, v in self.branches]
+        if self.else_value is not None:
+            vals.append(self.else_value)
+        return _branch_type(schema, *vals)
+
+    def children(self):
+        out = []
+        for c, v in self.branches:
+            out += [c, v]
+        if self.else_value is not None:
+            out.append(self.else_value)
+        return tuple(out)
+
+    def with_children(self, kids):
+        n = len(self.branches)
+        branches = tuple((kids[2 * i], kids[2 * i + 1]) for i in range(n))
+        else_v = kids[2 * n] if len(kids) > 2 * n else None
+        return CaseWhen(branches, else_v)
+
+    def eval(self, ctx: EvalContext):
+        from spark_rapids_tpu.exprs.base import Literal
+        dt = None
+        evaluated = []
+        for cond, val in self.branches:
+            c = cond.eval(ctx)
+            v = val.eval(ctx)
+            dt = v.dtype if dt is None else dt
+            evaluated.append((c.validity & c.data.astype(bool), v))
+        if self.else_value is not None:
+            out = self.else_value.eval(ctx)
+        else:
+            out = Literal(None, dt).eval(ctx)
+        for cond, v in reversed(evaluated):
+            out = _select(cond, v, out)
+        return out
+
+
+@dataclasses.dataclass(eq=False)
+class Coalesce(Expression):
+    exprs: tuple
+
+    def data_type(self, schema):
+        return _branch_type(schema, *self.exprs)
+
+    def children(self):
+        return self.exprs
+
+    def with_children(self, kids):
+        return Coalesce(tuple(kids))
+
+    def eval(self, ctx: EvalContext):
+        out = self.exprs[0].eval(ctx)
+        for e in self.exprs[1:]:
+            v = e.eval(ctx)
+            out = _select(out.validity, out, v)
+        return out
+
+
+def Nvl(a: Expression, b: Expression) -> Coalesce:
+    return Coalesce((a, b))
+
+
+@dataclasses.dataclass(eq=False)
+class NullIf(Expression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return self.left.data_type(schema)
+
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, kids):
+        return NullIf(*kids)
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.exprs.predicates import EqualTo
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        eq = EqualTo(self.left, self.right).do_columnar(l, r, ctx)
+        validity = l.validity & ~(eq.validity & eq.data)
+        return ColumnVector(l.dtype, l.data, validity, l.lengths)
+
+
+@dataclasses.dataclass(eq=False)
+class Nvl2(Expression):
+    expr: Expression
+    not_null_val: Expression
+    null_val: Expression
+
+    def data_type(self, schema):
+        return self.not_null_val.data_type(schema)
+
+    def children(self):
+        return (self.expr, self.not_null_val, self.null_val)
+
+    def with_children(self, kids):
+        return Nvl2(*kids)
+
+    def eval(self, ctx):
+        e = self.expr.eval(ctx)
+        a = self.not_null_val.eval(ctx)
+        b = self.null_val.eval(ctx)
+        return _select(e.validity, a, b)
+
+
+@dataclasses.dataclass(eq=False)
+class AtLeastNNonNulls(Expression):
+    """Reference nullExpressions.scala GpuAtLeastNNonNulls: true when at
+    least n of the children are non-null and non-NaN."""
+    n: int
+    exprs: tuple
+
+    def data_type(self, schema):
+        return T.BOOL
+
+    def children(self):
+        return self.exprs
+
+    def with_children(self, kids):
+        return AtLeastNNonNulls(self.n, tuple(kids))
+
+    def eval(self, ctx: EvalContext):
+        count = jnp.zeros(ctx.capacity, jnp.int32)
+        for e in self.exprs:
+            v = e.eval(ctx)
+            ok = v.validity
+            if v.dtype.is_floating:
+                ok = ok & ~jnp.isnan(v.data)
+            count = count + ok.astype(jnp.int32)
+        return ColumnVector(T.BOOL, count >= self.n, ctx.row_mask)
+
+
+@dataclasses.dataclass(eq=False)
+class NaNvl(Expression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return self.left.data_type(schema)
+
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, kids):
+        return NaNvl(*kids)
+
+    def eval(self, ctx):
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        return _select(~jnp.isnan(l.data), l, r)

@@ -1,0 +1,85 @@
+"""Vectorized civil-calendar arithmetic (proleptic Gregorian), used by the
+cast and datetime expression kernels.
+
+Implements Howard Hinnant's days<->civil algorithms with pure int ops so the
+whole thing lowers to fused XLA integer arithmetic (no host round-trips).
+Reference counterpart: cuDF's datetime kernels used via
+`datetimeExpressions.scala` / `GpuCast.scala`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MICROS_PER_SECOND = 1_000_000
+MICROS_PER_DAY = 86_400 * MICROS_PER_SECOND
+
+
+def days_to_ymd(days):
+    """int32 days-since-epoch -> (year, month, day), vectorized."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097                        # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)  # [0, 365]
+    mp = (5 * doy + 2) // 153                     # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1             # [1, 31]
+    m = jnp.where(mp < 10, mp + 3, mp - 9)        # [1, 12]
+    year = y + (m <= 2)
+    return year, m, d
+
+
+def ymd_to_days(y, m, d):
+    """(year, month, day) -> int32 days-since-epoch, vectorized."""
+    y = y.astype(jnp.int64)
+    m = m.astype(jnp.int64)
+    d = d.astype(jnp.int64)
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400                           # [0, 399]
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1             # [0, 365]
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy  # [0, 146096]
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def micros_to_date_days(micros):
+    """timestamp micros -> date days (floor division, handles pre-epoch)."""
+    return (micros // MICROS_PER_DAY).astype(jnp.int32)
+
+
+def micros_time_of_day(micros):
+    """-> (hour, minute, second, microsecond), all non-negative."""
+    tod = micros - (micros // MICROS_PER_DAY) * MICROS_PER_DAY
+    sec = tod // MICROS_PER_SECOND
+    us = tod - sec * MICROS_PER_SECOND
+    h = sec // 3600
+    mnt = (sec - h * 3600) // 60
+    s = sec - h * 3600 - mnt * 60
+    return h, mnt, s, us
+
+
+def day_of_week(days):
+    """ISO-ish: 1=Sunday ... 7=Saturday (Spark dayofweek)."""
+    # 1970-01-01 was a Thursday (=5 in Spark's 1..7 Sunday-first scheme)
+    d = days.astype(jnp.int64)
+    return ((d + 4) % 7) + 1
+
+
+def day_of_year(days):
+    y, m, d = days_to_ymd(days)
+    jan1 = ymd_to_days(y, jnp.ones_like(m), jnp.ones_like(d))
+    return (days.astype(jnp.int64) - jan1 + 1).astype(jnp.int32)
+
+
+def quarter(days):
+    _, m, _ = days_to_ymd(days)
+    return ((m - 1) // 3 + 1).astype(jnp.int32)
+
+
+def last_day_of_month(days):
+    y, m, _ = days_to_ymd(days)
+    ny = jnp.where(m == 12, y + 1, y)
+    nm = jnp.where(m == 12, 1, m + 1)
+    first_next = ymd_to_days(ny, nm, jnp.ones_like(nm))
+    return (first_next - 1).astype(jnp.int32)

@@ -1,0 +1,266 @@
+"""Predicates and comparisons (reference `predicates.scala`, `GpuInSet.scala`).
+
+Spark parity:
+  - NaN ordering: NaN is greater than every other value and NaN == NaN.
+  - And/Or use Kleene three-valued logic (false AND null = false, etc.).
+  - EqualNullSafe (<=>) treats two nulls as equal.
+  - String comparisons are lexicographic over UTF-8 bytes.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import ColumnVector
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, Expression, UnaryExpression, promote)
+
+
+def _string_cmp(l: ColumnVector, r: ColumnVector):
+    """Lexicographic three-way compare of byte-tensor strings: returns
+    (lt, eq) bool arrays.  Vectorized over the char axis."""
+    cc = max(l.char_cap, r.char_cap)
+    from spark_rapids_tpu.columnar.vector import _pad_chars
+    a, b = _pad_chars(l, cc), _pad_chars(r, cc)
+    la = a.lengths[:, None]
+    lb = b.lengths[:, None]
+    pos = jnp.arange(cc)[None, :]
+    av = jnp.where(pos < la, a.data.astype(jnp.int32), -1)
+    bv = jnp.where(pos < lb, b.data.astype(jnp.int32), -1)
+    diff = av != bv
+    # first differing position decides; all-equal -> equal
+    any_diff = diff.any(axis=1)
+    first = jnp.argmax(diff, axis=1)
+    rows = jnp.arange(a.capacity)
+    lt = jnp.where(any_diff, av[rows, first] < bv[rows, first], False)
+    eq = ~any_diff
+    return lt, eq
+
+
+def _compare(l: ColumnVector, r: ColumnVector):
+    """Returns (lt, eq) with Spark NaN semantics for floats."""
+    if l.dtype.is_string:
+        return _string_cmp(l, r)
+    dt = l.dtype if l.dtype == r.dtype else T.common_type(l.dtype, r.dtype)
+    l, r = promote(l, dt), promote(r, dt)
+    a, b = l.data, r.data
+    if dt.is_floating:
+        na, nb = jnp.isnan(a), jnp.isnan(b)
+        eq = jnp.where(na & nb, True, a == b)
+        lt = jnp.where(na, False, jnp.where(nb, True, a < b))
+        return lt, eq
+    return a < b, a == b
+
+
+@dataclasses.dataclass(eq=False)
+class _Comparison(BinaryExpression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return T.BOOL
+
+    def do_columnar(self, l, r, ctx):
+        lt, eq = _compare(l, r)
+        return ColumnVector(T.BOOL, self.pick(lt, eq),
+                            l.validity & r.validity)
+
+
+class EqualTo(_Comparison):
+    def pick(self, lt, eq):
+        return eq
+
+
+class LessThan(_Comparison):
+    def pick(self, lt, eq):
+        return lt
+
+
+class LessThanOrEqual(_Comparison):
+    def pick(self, lt, eq):
+        return lt | eq
+
+
+class GreaterThan(_Comparison):
+    def pick(self, lt, eq):
+        return ~(lt | eq)
+
+
+class GreaterThanOrEqual(_Comparison):
+    def pick(self, lt, eq):
+        return ~lt
+
+
+@dataclasses.dataclass(eq=False)
+class EqualNullSafe(BinaryExpression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return T.BOOL
+
+    def do_columnar(self, l, r, ctx):
+        _, eq = _compare(l, r)
+        both_null = ~l.validity & ~r.validity
+        one_null = l.validity != r.validity
+        data = jnp.where(both_null, True, jnp.where(one_null, False, eq))
+        return ColumnVector(T.BOOL, data, ctx.row_mask)
+
+
+@dataclasses.dataclass(eq=False)
+class And(Expression):
+    """Kleene: F AND x = F even if x is null."""
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return T.BOOL
+
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, kids):
+        return And(*kids)
+
+    def eval(self, ctx):
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        lv = l.validity & l.data.astype(bool)
+        rv = r.validity & r.data.astype(bool)
+        lf = l.validity & ~l.data.astype(bool)
+        rf = r.validity & ~r.data.astype(bool)
+        data = lv & rv
+        validity = (lf | rf) | (l.validity & r.validity)
+        return ColumnVector(T.BOOL, data, validity)
+
+
+@dataclasses.dataclass(eq=False)
+class Or(Expression):
+    """Kleene: T OR x = T even if x is null."""
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return T.BOOL
+
+    def children(self):
+        return (self.left, self.right)
+
+    def with_children(self, kids):
+        return Or(*kids)
+
+    def eval(self, ctx):
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        lt_ = l.validity & l.data.astype(bool)
+        rt_ = r.validity & r.data.astype(bool)
+        data = lt_ | rt_
+        validity = (lt_ | rt_) | (l.validity & r.validity)
+        return ColumnVector(T.BOOL, data, validity)
+
+
+@dataclasses.dataclass(eq=False)
+class Not(UnaryExpression):
+    child: Expression
+
+    def data_type(self, schema):
+        return T.BOOL
+
+    def do_columnar(self, c, ctx):
+        return ColumnVector(T.BOOL, ~c.data.astype(bool), c.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class IsNull(Expression):
+    child: Expression
+
+    def data_type(self, schema):
+        return T.BOOL
+
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, kids):
+        return IsNull(kids[0])
+
+    def eval(self, ctx):
+        c = self.child.eval(ctx)
+        return ColumnVector(T.BOOL, ~c.validity & ctx.row_mask, ctx.row_mask)
+
+
+@dataclasses.dataclass(eq=False)
+class IsNotNull(Expression):
+    child: Expression
+
+    def data_type(self, schema):
+        return T.BOOL
+
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, kids):
+        return IsNotNull(kids[0])
+
+    def eval(self, ctx):
+        c = self.child.eval(ctx)
+        return ColumnVector(T.BOOL, c.validity & ctx.row_mask, ctx.row_mask)
+
+
+@dataclasses.dataclass(eq=False)
+class IsNaN(UnaryExpression):
+    child: Expression
+
+    def data_type(self, schema):
+        return T.BOOL
+
+    def do_columnar(self, c, ctx):
+        # Spark's IsNaN is non-nullable: null input -> false
+        return ColumnVector(T.BOOL, jnp.isnan(c.data) & c.validity,
+                            ctx.row_mask)
+
+
+@dataclasses.dataclass(eq=False)
+class InSet(Expression):
+    """value IN (literal set) — reference `GpuInSet.scala:98`.  The literal
+    set is baked into the executable as a constant vector; membership is a
+    broadcast-compare-any, which XLA lowers to one fused loop."""
+    child: Expression
+    values: tuple
+
+    def data_type(self, schema):
+        return T.BOOL
+
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, kids):
+        return InSet(kids[0], self.values)
+
+    def eval(self, ctx):
+        c = self.child.eval(ctx)
+        has_null_in_list = any(v is None for v in self.values)
+        vals = [v for v in self.values if v is not None]
+        if c.dtype.is_string:
+            from spark_rapids_tpu.exprs.base import Literal
+            hit = jnp.zeros(c.capacity, bool)
+            for v in vals:
+                lv = Literal.of(str(v), T.STRING).eval(ctx)
+                _, eq = _string_cmp(c, lv)
+                hit = hit | eq
+        else:
+            arr = np.asarray(vals, c.dtype.storage_dtype)
+            if len(arr) == 0:
+                hit = jnp.zeros(c.capacity, bool)
+            else:
+                hit = (c.data[:, None] == jnp.asarray(arr)[None, :]).any(
+                    axis=1)
+        # Spark: x IN (...) is null if x is null, or no match and list has null
+        validity = c.validity & ~(~hit & has_null_in_list)
+        return ColumnVector(T.BOOL, hit, validity)
+
+
+def In(child: Expression, values) -> InSet:
+    return InSet(child, tuple(values))

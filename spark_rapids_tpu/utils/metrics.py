@@ -1,0 +1,46 @@
+"""Operator metrics (reference `GpuExec.scala:27-56` GpuMetricNames +
+Spark SQLMetrics): numOutputRows/numOutputBatches/totalTime plus per-op
+extras, surfaced by `TpuExec.metrics`."""
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+NUM_OUTPUT_ROWS = "numOutputRows"
+NUM_OUTPUT_BATCHES = "numOutputBatches"
+NUM_INPUT_ROWS = "numInputRows"
+NUM_INPUT_BATCHES = "numInputBatches"
+TOTAL_TIME = "totalTime"
+PEAK_DEVICE_MEMORY = "peakDevMemory"
+BUFFER_TIME = "bufferTime"
+DECODE_TIME = "tpuDecodeTime"
+COMPILE_TIME = "compileTime"
+
+
+class MetricSet:
+    def __init__(self):
+        self._values = defaultdict(float)
+
+    def add(self, name: str, value: float) -> None:
+        self._values[name] += value
+
+    def set_max(self, name: str, value: float) -> None:
+        self._values[name] = max(self._values[name], value)
+
+    def value(self, name: str) -> float:
+        return self._values[name]
+
+    @contextmanager
+    def timed(self, name: str = TOTAL_TIME):
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter_ns() - t0)
+
+    def as_dict(self) -> dict:
+        return dict(self._values)
+
+    def __repr__(self):
+        return f"MetricSet({dict(self._values)})"

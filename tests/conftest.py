@@ -1,0 +1,24 @@
+"""Test harness config: force an 8-device virtual CPU mesh so multi-chip
+sharding paths are exercised without TPU hardware (the reference tests
+multi-node shuffle with mocked transports — SURVEY.md §4 tier 2; we test
+multi-chip with virtual devices)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
