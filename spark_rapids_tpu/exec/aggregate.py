@@ -1,0 +1,360 @@
+"""Hash aggregation (reference `aggregate.scala:312` GpuHashAggregateExec).
+
+The reference runs cuDF groupby per batch, then concatenates partial
+results and re-merges until one batch remains.  The TPU version keeps the
+same two-phase shape with sort-based segments:
+
+  per input batch : sort rows by group keys -> segment ids -> update aggs
+  on exhaustion   : concat partials -> sort -> merge aggs -> evaluate
+
+Modes mirror Spark: Partial (update only, emits keys+intermediates),
+Final (merge intermediates, evaluate), Complete (update+evaluate in one
+node — used for single-stage local plans).  The reduction path (no group
+keys) skips the sort entirely and uses masked whole-batch reductions.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.columnar.vector import ColumnVector, bucket_capacity
+from spark_rapids_tpu.exec.base import (
+    TpuExec, UnaryExecBase, batch_signature, make_eval_context)
+from spark_rapids_tpu.exprs.aggregates import (
+    AggAlias, AggContext, AggregateFunction)
+from spark_rapids_tpu.exprs.base import Expression, output_name
+from spark_rapids_tpu.ops.sort_encode import (
+    multi_key_argsort, segment_boundaries)
+from spark_rapids_tpu.utils import metrics as M
+
+
+class AggMode(enum.Enum):
+    PARTIAL = "partial"
+    FINAL = "final"
+    COMPLETE = "complete"
+
+
+def _to_alias(a, i: int) -> AggAlias:
+    if isinstance(a, AggAlias):
+        return a
+    return AggAlias(a, f"agg{i}")
+
+
+class HashAggregateExec(UnaryExecBase):
+    def __init__(self, group_exprs: Sequence[Expression],
+                 aggregates: Sequence,
+                 child: TpuExec,
+                 mode: AggMode = AggMode.COMPLETE):
+        super().__init__(child)
+        self.mode = mode
+        self.group_exprs = list(group_exprs)
+        self.aggregates = [_to_alias(a, i) for i, a in enumerate(aggregates)]
+        child_schema = child.output_schema()
+        self._child_schema = child_schema
+        self._bound_groups = [e.bind(child_schema) for e in self.group_exprs]
+        self._group_fields = tuple(
+            T.Field(output_name(e, i), b.data_type(child_schema))
+            for i, (e, b) in enumerate(
+                zip(self.group_exprs, self._bound_groups)))
+
+        self._funcs = [a.func for a in self.aggregates]
+        self._inter_offsets = []
+        if mode == AggMode.FINAL:
+            # child emits keys + intermediates; resolve types positionally
+            # (original input columns are gone from the partial schema)
+            off = len(self._group_fields)
+            self._inter_types = []
+            for f in self._funcs:
+                n = f.num_intermediates
+                self._inter_offsets.append((off, off + n))
+                self._inter_types.append(tuple(
+                    child_schema.fields[i].dtype for i in range(off, off + n)))
+                off += n
+        else:
+            self._bound_inputs = [
+                [e.bind(child_schema) for e in f.input_exprs()]
+                for f in self._funcs]
+            self._inter_types = [
+                tuple(f.intermediate_types(child_schema))
+                for f in self._funcs]
+            off = len(self._group_fields)
+            for ts in self._inter_types:
+                self._inter_offsets.append((off, off + len(ts)))
+                off += len(ts)
+
+        # output schema
+        fields = list(self._group_fields)
+        if mode == AggMode.PARTIAL:
+            for a, ts in zip(self.aggregates, self._inter_types):
+                for j, it in enumerate(ts):
+                    fields.append(T.Field(f"{a.name}#{j}", it))
+        elif mode == AggMode.FINAL:
+            for a, ts in zip(self.aggregates, self._inter_types):
+                fields.append(
+                    T.Field(a.name, a.func.result_from_intermediates(ts)))
+        else:
+            for a in self.aggregates:
+                fields.append(
+                    T.Field(a.name, a.func.result_type(child_schema)))
+        self._schema = T.Schema(tuple(fields))
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def describe(self):
+        keys = ", ".join(f.name for f in self._group_fields)
+        aggs = ", ".join(a.name for a in self.aggregates)
+        return (f"HashAggregateExec(mode={self.mode.value}, "
+                f"keys=[{keys}], aggs=[{aggs}])")
+
+    # -- kernels ------------------------------------------------------------
+    def _groupby_kernel(self, batch: ColumnarBatch, phase: str):
+        """phase: 'update' (raw inputs) or 'merge' (intermediates)."""
+        key = ("agg", phase, batch_signature(batch))
+
+        def build():
+            cap = batch.capacity
+            bound_groups = self._bound_groups
+            funcs = self._funcs
+
+            @jax.jit
+            def kernel(columns, num_rows):
+                ctx = make_eval_context(columns, cap, num_rows)
+                keys = [e.eval(ctx) for e in bound_groups]
+                perm = multi_key_argsort(
+                    [(k, True, True) for k in keys], ctx.row_mask)
+                sorted_keys = [k.gather(perm, jnp.take(ctx.row_mask, perm))
+                               for k in keys]
+                bounds = segment_boundaries(keys, perm, ctx.row_mask)
+                seg_ids = jnp.cumsum(bounds.astype(jnp.int32)) - 1
+                num_groups = bounds.sum().astype(jnp.int32)
+                sorted_valid = jnp.take(ctx.row_mask, perm)
+                actx = AggContext(seg_ids, cap, sorted_valid)
+
+                out_cols = []
+                # group key representatives: first row of each segment
+                (first_idx,) = jnp.nonzero(bounds, size=cap,
+                                           fill_value=cap - 1)
+                grp_valid = jnp.arange(cap) < num_groups
+                for k in sorted_keys:
+                    out_cols.append(k.gather(first_idx, grp_valid))
+
+                if phase == "update":
+                    for f, bins in zip(funcs, self._bound_inputs):
+                        inputs = [e.eval(ctx) for e in bins]
+                        sorted_inputs = [
+                            v.gather(perm, sorted_valid) for v in inputs]
+                        outs = f.update(actx, sorted_inputs)
+                        out_cols.extend(
+                            ColumnVector(o.dtype,
+                                         o.data,
+                                         o.validity & grp_valid,
+                                         o.lengths) for o in outs)
+                else:
+                    for f, (lo, hi) in zip(funcs, self._inter_offsets):
+                        parts = [ctx.columns[i].gather(perm, sorted_valid)
+                                 for i in range(lo, hi)]
+                        outs = f.merge(actx, parts)
+                        out_cols.extend(
+                            ColumnVector(o.dtype, o.data,
+                                         o.validity & grp_valid,
+                                         o.lengths) for o in outs)
+                return out_cols, num_groups
+
+            return kernel
+
+        return self.kernels.get_or_build(key, build)
+
+    def _evaluate_kernel(self, batch: ColumnarBatch):
+        """Final projection: intermediates -> results (no regrouping)."""
+        key = ("agg-eval", batch_signature(batch))
+
+        def build():
+            cap = batch.capacity
+            funcs = self._funcs
+            n_groups_cols = len(self._group_fields)
+
+            @jax.jit
+            def kernel(columns, num_rows):
+                out = list(columns[:n_groups_cols])
+                off = n_groups_cols
+                for f in funcs:
+                    n = f.num_intermediates
+                    parts = columns[off: off + n]
+                    off += n
+                    out.append(f.evaluate(parts, self._child_schema))
+                return out
+
+            return kernel
+
+        return self.kernels.get_or_build(key, build)
+
+    # -- execution ----------------------------------------------------------
+    def process_partition(self, batches) -> Iterator[ColumnarBatch]:
+        if not self.group_exprs:
+            yield from self._reduction_path(batches)
+            return
+
+        phase = "merge" if self.mode == AggMode.FINAL else "update"
+        inter_fields = self._partial_schema()
+        partials: list[ColumnarBatch] = []
+        for batch in batches:
+            if batch.num_rows == 0:
+                continue
+            with self.metrics.timed(M.TOTAL_TIME):
+                kern = self._groupby_kernel(batch, phase)
+                cols, n = kern(batch.columns, jnp.int32(batch.num_rows))
+                partials.append(
+                    ColumnarBatch(inter_fields, list(cols), int(n)))
+
+        if not partials:
+            return
+        # concat + re-merge loop until a single batch of groups remains
+        merged = partials[0] if len(partials) == 1 else \
+            self._merge_partials(partials, inter_fields)
+
+        if self.mode == AggMode.PARTIAL:
+            out = merged
+        else:
+            with self.metrics.timed(M.TOTAL_TIME):
+                kern = self._evaluate_kernel(merged)
+                cols = kern(merged.columns, jnp.int32(merged.num_rows))
+                out = ColumnarBatch(self._schema, list(cols),
+                                    merged.num_rows)
+        out = out.with_capacity(bucket_capacity(out.num_rows))
+        self.update_output_metrics(out)
+        yield out
+
+    def _get_merge_exec(self, inter_schema) -> "HashAggregateExec":
+        """Cached internal FINAL-mode exec so merge kernels are compiled
+        once per batch signature, not once per partition."""
+        me = getattr(self, "_merge_exec", None)
+        if me is None:
+            me = HashAggregateExec(
+                [GroupRef(i, f.dtype)
+                 for i, f in enumerate(self._group_fields)],
+                [AggAlias(f, a.name) for f, a in
+                 zip(self._funcs, self.aggregates)],
+                _SchemaOnly(inter_schema), mode=AggMode.FINAL)
+            self._merge_exec = me
+        return me
+
+    def _merge_partials(self, partials, inter_schema) -> ColumnarBatch:
+        merged = concat_batches(partials)
+        merge_exec = self._get_merge_exec(inter_schema)
+        with self.metrics.timed(M.TOTAL_TIME):
+            kern = merge_exec._groupby_kernel(merged, "merge")
+            cols, n = kern(merged.columns, jnp.int32(merged.num_rows))
+        return ColumnarBatch(inter_schema, list(cols), int(n))
+
+    def _partial_schema(self) -> T.Schema:
+        if self.mode == AggMode.FINAL:
+            return self._child_schema  # child already emits partial layout
+        fields = list(self._group_fields)
+        for a, ts in zip(self.aggregates, self._inter_types):
+            for j, it in enumerate(ts):
+                fields.append(T.Field(f"{a.name}#{j}", it))
+        return T.Schema(tuple(fields))
+
+    # -- no-group-key reduction (reference aggregate.scala reduction path) --
+    def _reduction_path(self, batches) -> Iterator[ColumnarBatch]:
+        inter_schema = self._partial_schema()
+        partials = []
+        phase = "merge" if self.mode == AggMode.FINAL else "update"
+        for batch in batches:
+            with self.metrics.timed(M.TOTAL_TIME):
+                kern = self._reduce_kernel(batch, phase)
+                cols = kern(batch.columns, jnp.int32(batch.num_rows))
+                partials.append(ColumnarBatch(inter_schema, list(cols), 1))
+        if not partials:
+            # SQL: aggregate of empty input yields one row (e.g. COUNT=0)
+            partials = [self._empty_partial(inter_schema)]
+        # always merge (even a single partial): normalizes e.g. an
+        # all-invalid empty-input count intermediate into a valid 0
+        merged = self._merge_reduction(partials, inter_schema)
+        if self.mode == AggMode.PARTIAL:
+            out = merged
+        else:
+            kern = self._evaluate_kernel(merged)
+            cols = kern(merged.columns, jnp.int32(merged.num_rows))
+            out = ColumnarBatch(self._schema, list(cols), 1)
+        self.update_output_metrics(out)
+        yield out
+
+    def _reduce_kernel(self, batch: ColumnarBatch, phase: str):
+        key = ("agg-reduce", phase, batch_signature(batch))
+
+        def build():
+            cap = batch.capacity
+            funcs = self._funcs
+
+            @jax.jit
+            def kernel(columns, num_rows):
+                ctx = make_eval_context(columns, cap, num_rows)
+                seg_ids = jnp.zeros(cap, jnp.int32)
+                actx = AggContext(seg_ids, cap, ctx.row_mask)
+                out_cols = []
+                if phase == "update":
+                    for f, bins in zip(funcs, self._bound_inputs):
+                        inputs = [e.eval(ctx) for e in bins]
+                        outs = f.update(actx, inputs)
+                        out_cols.extend(outs)
+                else:
+                    off = len(self._group_fields)
+                    for f in funcs:
+                        n = f.num_intermediates
+                        outs = f.merge(actx, columns[off: off + n])
+                        off += n
+                        out_cols.extend(outs)
+                return out_cols
+
+            return kernel
+
+        return self.kernels.get_or_build(key, build)
+
+    def _merge_reduction(self, partials, inter_schema) -> ColumnarBatch:
+        merged = concat_batches(partials)
+        agg = self._get_merge_exec(inter_schema)
+        kern = agg._reduce_kernel(merged, "merge")
+        cols = kern(merged.columns, jnp.int32(merged.num_rows))
+        return ColumnarBatch(inter_schema, list(cols), 1)
+
+    def _empty_partial(self, inter_schema) -> ColumnarBatch:
+        from spark_rapids_tpu.columnar.batch import empty_batch
+        e = empty_batch(inter_schema)
+        # one row of "no inputs seen": validity false, counts zero
+        return ColumnarBatch(inter_schema, e.columns, 1)
+
+
+@dataclasses.dataclass(eq=False)
+class GroupRef(Expression):
+    """Positional reference used by the merge stage (keys are at fixed
+    positions in partial batches)."""
+    ordinal: int
+    dtype: T.DataType
+
+    def data_type(self, schema):
+        return self.dtype
+
+    def bind(self, schema):
+        return self
+
+    def eval(self, ctx):
+        return ctx.columns[self.ordinal]
+
+
+class _SchemaOnly(TpuExec):
+    """Placeholder child carrying just a schema (for internal merge nodes)."""
+
+    def __init__(self, schema: T.Schema):
+        super().__init__()
+        self._schema = schema
+
+    def output_schema(self):
+        return self._schema

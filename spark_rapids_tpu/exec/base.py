@@ -187,7 +187,10 @@ class UnaryExecBase(TpuExec):
         raise NotImplementedError
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
-        return self.process_partition(self.child.execute_columnar())
+        # preserve partition-local semantics (RDD mapPartitions): process
+        # each child partition separately, then chain
+        for it in self.execute_partitions():
+            yield from it
 
     def execute_partitions(self):
         return [self.process_partition(it)

@@ -1,0 +1,96 @@
+"""Batch coalescing (reference `GpuCoalesceBatches.scala`): concatenate
+small batches up to a CoalesceGoal — TargetSize(bytes) or
+RequireSingleBatch.  On TPU this additionally *re-buckets* capacity, which
+is what keeps the kernel compile cache small after filters shrink batches.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.columnar.vector import bucket_capacity
+from spark_rapids_tpu.exec.base import (
+    CoalesceGoal, RequireSingleBatch, TargetSize, TpuExec, UnaryExecBase)
+from spark_rapids_tpu.utils import metrics as M
+
+
+def coalesce_iterator(batches: Iterator[ColumnarBatch],
+                      goal: CoalesceGoal,
+                      schema: T.Schema,
+                      metrics) -> Iterator[ColumnarBatch]:
+    """The AbstractGpuCoalesceIterator analog."""
+    if isinstance(goal, RequireSingleBatch):
+        got = [b for b in batches if b.num_rows > 0]
+        if not got:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+            yield empty_batch(schema)
+            return
+        out = concat_batches(got) if len(got) > 1 else _rebucket(got[0])
+        metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+        metrics.add(M.NUM_OUTPUT_ROWS, out.num_rows)
+        yield out
+        return
+
+    target = goal.bytes if isinstance(goal, TargetSize) else 1 << 31
+    pending: list[ColumnarBatch] = []
+    pending_bytes = 0
+    for b in batches:
+        metrics.add(M.NUM_INPUT_BATCHES, 1)
+        metrics.add(M.NUM_INPUT_ROWS, b.num_rows)
+        if b.num_rows == 0:
+            continue
+        est = _row_bytes(b) * b.num_rows
+        if pending and pending_bytes + est > target:
+            yield _emit(pending, metrics)
+            pending, pending_bytes = [], 0
+        pending.append(b)
+        pending_bytes += est
+    if pending:
+        yield _emit(pending, metrics)
+
+
+def _row_bytes(b: ColumnarBatch) -> int:
+    total = 0
+    for f, c in zip(b.schema.fields, b.columns):
+        if f.dtype.is_string:
+            total += c.char_cap + 5
+        else:
+            total += f.dtype.storage_dtype.itemsize + 1
+    return max(total, 1)
+
+
+def _rebucket(b: ColumnarBatch) -> ColumnarBatch:
+    """Shrink an over-padded batch into its tight bucket (e.g. after a
+    selective filter) so downstream kernels compile for a smaller shape."""
+    tight = bucket_capacity(b.num_rows)
+    if tight < b.capacity:
+        return b.with_capacity(tight)
+    return b
+
+
+def _emit(pending: list[ColumnarBatch], metrics) -> ColumnarBatch:
+    out = concat_batches(pending) if len(pending) > 1 else \
+        _rebucket(pending[0])
+    metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+    metrics.add(M.NUM_OUTPUT_ROWS, out.num_rows)
+    return out
+
+
+class CoalesceBatchesExec(UnaryExecBase):
+    """Reference GpuCoalesceBatches exec node, inserted by the transition
+    pass per each operator's childrenCoalesceGoal."""
+
+    def __init__(self, goal: CoalesceGoal, child: TpuExec):
+        super().__init__(child)
+        self.goal = goal
+
+    def output_schema(self):
+        return self.child.output_schema()
+
+    def describe(self):
+        return f"CoalesceBatchesExec({self.goal})"
+
+    def process_partition(self, batches):
+        return coalesce_iterator(batches, self.goal,
+                                 self.output_schema(), self.metrics)

@@ -1,0 +1,71 @@
+"""Limit operators (reference `limit.scala`: GpuLocalLimitExec,
+GpuGlobalLimitExec, GpuCollectLimitExec)."""
+from __future__ import annotations
+
+from typing import Iterator
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExecBase
+
+
+class LocalLimitExec(UnaryExecBase):
+    """Per-partition limit: slice batches until n rows emitted."""
+
+    def __init__(self, n: int, child: TpuExec):
+        super().__init__(child)
+        self.n = n
+
+    def output_schema(self):
+        return self.child.output_schema()
+
+    def describe(self):
+        return f"LocalLimitExec({self.n})"
+
+    def process_partition(self, batches) -> Iterator[ColumnarBatch]:
+        remaining = self.n
+        for b in batches:
+            if remaining <= 0:
+                break
+            if b.num_rows <= remaining:
+                remaining -= b.num_rows
+                self.update_output_metrics(b)
+                yield b
+            else:
+                out = b.slice(0, remaining)
+                remaining = 0
+                self.update_output_metrics(out)
+                yield out
+
+
+class GlobalLimitExec(UnaryExecBase):
+    """Whole-query limit; requires a single upstream partition (planner
+    inserts a single-partition exchange below, like Spark)."""
+
+    def __init__(self, n: int, child: TpuExec):
+        super().__init__(child)
+        self.n = n
+
+    def output_schema(self):
+        return self.child.output_schema()
+
+    def describe(self):
+        return f"GlobalLimitExec({self.n})"
+
+    def execute_columnar(self):
+        remaining = self.n
+        for part in self.child.execute_partitions():
+            for b in part:
+                if remaining <= 0:
+                    return
+                out = b if b.num_rows <= remaining else b.slice(0, remaining)
+                remaining -= out.num_rows
+                self.update_output_metrics(out)
+                yield out
+
+    def execute_partitions(self):
+        return [self.execute_columnar()]
+
+
+def CollectLimitExec(n: int, child: TpuExec) -> GlobalLimitExec:
+    """Reference GpuCollectLimitExec: limit + single-partition collect."""
+    return GlobalLimitExec(n, child)

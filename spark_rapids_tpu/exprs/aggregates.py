@@ -1,0 +1,378 @@
+"""Aggregate functions (reference `AggregateFunctions.scala`:
+GpuAggregateExpression / CudfAggregate bridge; Min/Max/Sum/Count/Average/
+First/Last).
+
+TPU design: aggregation is *segment ops over sorted groups*.  The exec
+sorts rows by group key, computes segment ids, and each AggregateFunction
+contributes three stages mirroring the reference's update/merge/evaluate
+split so partial (map-side) and final (reduce-side) aggregation distribute
+exactly like Spark's:
+
+  update(values per row)    -> per-segment intermediates   [map side]
+  merge(intermediates)      -> combined intermediates      [reduce side]
+  evaluate(intermediates)   -> final column
+
+All stages are static-shape: `num_segments == capacity`, with invalid rows
+routed to segment id == capacity (dropped by XLA scatter semantics).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import ColumnVector
+from spark_rapids_tpu.exprs.base import Expression, Literal
+
+_INT_MIN = {
+    T.TypeId.INT8: -(2 ** 7), T.TypeId.INT16: -(2 ** 15),
+    T.TypeId.INT32: -(2 ** 31), T.TypeId.INT64: -(2 ** 63),
+    T.TypeId.DATE32: -(2 ** 31), T.TypeId.TIMESTAMP_US: -(2 ** 63),
+    T.TypeId.BOOL: 0,
+}
+_INT_MAX = {
+    T.TypeId.INT8: 2 ** 7 - 1, T.TypeId.INT16: 2 ** 15 - 1,
+    T.TypeId.INT32: 2 ** 31 - 1, T.TypeId.INT64: 2 ** 63 - 1,
+    T.TypeId.DATE32: 2 ** 31 - 1, T.TypeId.TIMESTAMP_US: 2 ** 63 - 1,
+    T.TypeId.BOOL: 1,
+}
+
+
+def _seg_sum(vals, seg, n):
+    return jax.ops.segment_sum(vals, seg, num_segments=n)
+
+
+def _seg_min(vals, seg, n):
+    return jax.ops.segment_min(vals, seg, num_segments=n)
+
+
+def _seg_max(vals, seg, n):
+    return jax.ops.segment_max(vals, seg, num_segments=n)
+
+
+def _drop_invalid(seg_ids, valid, capacity):
+    """Invalid rows -> segment id == capacity (out of range => dropped)."""
+    return jnp.where(valid, seg_ids, capacity)
+
+
+@dataclasses.dataclass
+class AggContext:
+    seg_ids: jnp.ndarray     # per sorted row
+    capacity: int            # == num_segments
+    row_valid: jnp.ndarray   # sorted row mask
+
+
+class AggregateFunction:
+    """One aggregate; `child` may be None for Count(*)."""
+    child: Optional[Expression]
+
+    def input_exprs(self) -> Sequence[Expression]:
+        return () if self.child is None else (self.child,)
+
+    def result_type(self, schema: T.Schema) -> T.DataType:
+        raise NotImplementedError
+
+    def intermediate_types(self, schema: T.Schema) -> Sequence[T.DataType]:
+        raise NotImplementedError
+
+    # FINAL-mode type resolution: a merge-side exec sees only the partial
+    # schema (keys + intermediates), where the original input columns are
+    # gone — so counts and result types must be derivable positionally.
+    @property
+    def num_intermediates(self) -> int:
+        return 1
+
+    def result_from_intermediates(
+            self, inter: Sequence[T.DataType]) -> T.DataType:
+        return inter[0]
+
+    def update(self, ctx: AggContext, inputs: Sequence[ColumnVector]
+               ) -> Sequence[ColumnVector]:
+        raise NotImplementedError
+
+    def merge(self, ctx: AggContext, partials: Sequence[ColumnVector]
+              ) -> Sequence[ColumnVector]:
+        raise NotImplementedError
+
+    def evaluate(self, partials: Sequence[ColumnVector],
+                 schema: T.Schema) -> ColumnVector:
+        raise NotImplementedError
+
+    def alias(self, name: str):
+        return AggAlias(self, name)
+
+
+@dataclasses.dataclass
+class AggAlias:
+    func: AggregateFunction
+    name: str
+
+
+def _sum_type(dt: T.DataType) -> T.DataType:
+    return T.FLOAT64 if dt.is_floating else T.INT64
+
+
+@dataclasses.dataclass
+class Sum(AggregateFunction):
+    """Spark: sum(int*) -> long, sum(float*) -> double; result is null
+    only when every input in the group is null."""
+    child: Expression
+
+    def result_type(self, schema):
+        return _sum_type(self.child.data_type(schema))
+
+    def intermediate_types(self, schema):
+        return (self.result_type(schema),)
+
+    def update(self, ctx, inputs):
+        (v,) = inputs
+        dt = _sum_type(v.dtype)
+        acc = v.data.astype(dt.storage_dtype)
+        ok = v.validity & ctx.row_valid
+        seg = _drop_invalid(ctx.seg_ids, ok, ctx.capacity)
+        s = _seg_sum(jnp.where(ok, acc, 0), seg, ctx.capacity)
+        cnt = _seg_sum(ok.astype(jnp.int64), seg, ctx.capacity)
+        return (ColumnVector(dt, s, cnt > 0),)
+
+    def merge(self, ctx, partials):
+        (p,) = partials
+        ok = p.validity & ctx.row_valid
+        seg = _drop_invalid(ctx.seg_ids, ok, ctx.capacity)
+        s = _seg_sum(jnp.where(ok, p.data, 0), seg, ctx.capacity)
+        cnt = _seg_sum(ok.astype(jnp.int64), seg, ctx.capacity)
+        return (ColumnVector(p.dtype, s, cnt > 0),)
+
+    def evaluate(self, partials, schema):
+        return partials[0]
+
+
+@dataclasses.dataclass
+class Count(AggregateFunction):
+    """Count(expr) counts non-null; Count(None) == COUNT(*)."""
+    child: Optional[Expression] = None
+
+    def result_type(self, schema):
+        return T.INT64
+
+    def intermediate_types(self, schema):
+        return (T.INT64,)
+
+    def update(self, ctx, inputs):
+        if self.child is None:
+            ok = ctx.row_valid
+        else:
+            ok = inputs[0].validity & ctx.row_valid
+        seg = _drop_invalid(ctx.seg_ids, ok, ctx.capacity)
+        c = _seg_sum(ok.astype(jnp.int64), seg, ctx.capacity)
+        return (ColumnVector(T.INT64, c, jnp.ones(ctx.capacity, bool)),)
+
+    def merge(self, ctx, partials):
+        (p,) = partials
+        ok = p.validity & ctx.row_valid
+        seg = _drop_invalid(ctx.seg_ids, ok, ctx.capacity)
+        c = _seg_sum(jnp.where(ok, p.data, 0), seg, ctx.capacity)
+        return (ColumnVector(T.INT64, c, jnp.ones(ctx.capacity, bool)),)
+
+    def evaluate(self, partials, schema):
+        return partials[0]
+
+
+def _minmax_numeric(v: ColumnVector, ctx: AggContext, is_min: bool):
+    """Direct segment min/max with Spark NaN semantics (NaN is the largest
+    value).  No bit-encode: 64-bit bitcasts don't lower on TPU.
+
+    floats: max — NaN wins whenever present (map NaN -> +inf and track);
+            min — NaN loses unless the whole group is NaN.
+    """
+    cap = ctx.capacity
+    ok = v.validity & ctx.row_valid
+    seg = _drop_invalid(ctx.seg_ids, ok, cap)
+    cnt = _seg_sum(ok.astype(jnp.int64), seg, cap)
+    has = cnt > 0
+    if v.dtype.is_floating:
+        nan = jnp.isnan(v.data) & ok
+        non_nan = ok & ~nan
+        seg_nn = _drop_invalid(ctx.seg_ids, non_nan, cap)
+        n_non_nan = _seg_sum(non_nan.astype(jnp.int64), seg_nn, cap)
+        any_nan = _seg_sum(nan.astype(jnp.int64), seg, cap) > 0
+        fill = jnp.inf if is_min else -jnp.inf
+        masked = jnp.where(non_nan, v.data, fill)
+        red = _seg_min(masked, seg_nn, cap) if is_min else \
+            _seg_max(masked, seg_nn, cap)
+        if is_min:
+            # all-NaN group -> NaN
+            red = jnp.where(has & (n_non_nan == 0), jnp.nan, red)
+        else:
+            # any NaN -> NaN is the max
+            red = jnp.where(any_nan, jnp.nan, red)
+        return red.astype(v.dtype.storage_dtype), has
+    lo = _INT_MIN[v.dtype.id]
+    hi = _INT_MAX[v.dtype.id]
+    fill = hi if is_min else lo
+    masked = jnp.where(ok, v.data.astype(jnp.int64), fill)
+    red = _seg_min(masked, seg, cap) if is_min else \
+        _seg_max(masked, seg, cap)
+    return red.astype(v.dtype.storage_dtype), has
+
+
+@dataclasses.dataclass
+class _MinMax(AggregateFunction):
+    child: Expression
+
+    @property
+    def _is_min(self) -> bool:
+        raise NotImplementedError
+
+    def result_type(self, schema):
+        return self.child.data_type(schema)
+
+    def intermediate_types(self, schema):
+        return (self.child.data_type(schema),)
+
+    def update(self, ctx, inputs):
+        (v,) = inputs
+        if v.dtype.is_string:
+            return self._update_string(ctx, v)
+        red, has = _minmax_numeric(v, ctx, self._is_min)
+        return (ColumnVector(v.dtype, red, has),)
+
+    def merge(self, ctx, partials):
+        return self.update(ctx, partials)
+
+    def evaluate(self, partials, schema):
+        return partials[0]
+
+    def _update_string(self, ctx, v: ColumnVector):
+        """Strings: argmin/argmax by byte-lexicographic rank.  Rank rows
+        with a per-segment sorted pass: reuse encode keys to lexsort and
+        take the first row per segment."""
+        from spark_rapids_tpu.ops.sort_encode import encode_key_column
+        cap = ctx.capacity
+        ok = v.validity & ctx.row_valid
+        # lexsort by (segment, value) -> first row of each segment wins
+        keys = encode_key_column(v, ascending=self._is_min,
+                                 nulls_first=False)
+        seg_key = _drop_invalid(ctx.seg_ids, ok, cap)
+        order = jnp.lexsort(tuple(reversed([seg_key] + keys)))
+        seg_sorted = jnp.take(seg_key, order)
+        isfirst = jnp.concatenate(
+            [jnp.ones(1, bool), seg_sorted[1:] != seg_sorted[:-1]])
+        isfirst = isfirst & (seg_sorted < cap)
+        # scatter winner row index to its segment slot
+        win_per_seg = _seg_min(
+            jnp.where(isfirst, order, jnp.iinfo(jnp.int64).max),
+            jnp.where(isfirst, seg_sorted, cap), cap)
+        has = _seg_sum(ok.astype(jnp.int64),
+                       _drop_invalid(ctx.seg_ids, ok, cap), cap) > 0
+        idx = jnp.where(has, win_per_seg, 0).astype(jnp.int32)
+        out = v.gather(idx, has)
+        return (out,)
+
+
+class Min(_MinMax):
+    _is_min = True
+
+
+class Max(_MinMax):
+    _is_min = False
+
+
+@dataclasses.dataclass
+class Average(AggregateFunction):
+    """Spark avg -> double; intermediates are (sum: double, count: long)."""
+    child: Expression
+
+    def result_type(self, schema):
+        return T.FLOAT64
+
+    def intermediate_types(self, schema):
+        return (T.FLOAT64, T.INT64)
+
+    num_intermediates = 2
+
+    def result_from_intermediates(self, inter):
+        return T.FLOAT64
+
+    def update(self, ctx, inputs):
+        (v,) = inputs
+        ok = v.validity & ctx.row_valid
+        seg = _drop_invalid(ctx.seg_ids, ok, ctx.capacity)
+        s = _seg_sum(jnp.where(ok, v.data.astype(jnp.float64), 0.0),
+                     seg, ctx.capacity)
+        c = _seg_sum(ok.astype(jnp.int64), seg, ctx.capacity)
+        always = jnp.ones(ctx.capacity, bool)
+        return (ColumnVector(T.FLOAT64, s, always),
+                ColumnVector(T.INT64, c, always))
+
+    def merge(self, ctx, partials):
+        s_p, c_p = partials
+        ok = ctx.row_valid
+        seg = _drop_invalid(ctx.seg_ids, ok, ctx.capacity)
+        s = _seg_sum(jnp.where(ok, s_p.data, 0.0), seg, ctx.capacity)
+        c = _seg_sum(jnp.where(ok, c_p.data, 0), seg, ctx.capacity)
+        always = jnp.ones(ctx.capacity, bool)
+        return (ColumnVector(T.FLOAT64, s, always),
+                ColumnVector(T.INT64, c, always))
+
+    def evaluate(self, partials, schema):
+        s, c = partials
+        nonzero = c.data > 0
+        avg = s.data / jnp.where(nonzero, c.data, 1).astype(jnp.float64)
+        return ColumnVector(T.FLOAT64, avg, nonzero)
+
+
+@dataclasses.dataclass
+class _FirstLast(AggregateFunction):
+    child: Expression
+    ignore_nulls: bool = False
+
+    @property
+    def _is_first(self) -> bool:
+        raise NotImplementedError
+
+    def result_type(self, schema):
+        return self.child.data_type(schema)
+
+    def intermediate_types(self, schema):
+        return (self.child.data_type(schema),)
+
+    def update(self, ctx, inputs):
+        (v,) = inputs
+        cap = ctx.capacity
+        ok = ctx.row_valid & (v.validity if self.ignore_nulls
+                              else jnp.ones(cap, bool))
+        seg = _drop_invalid(ctx.seg_ids, ok, cap)
+        rows = jnp.arange(cap, dtype=jnp.int64)
+        if self._is_first:
+            pick = _seg_min(jnp.where(ok, rows, jnp.iinfo(jnp.int64).max),
+                            seg, cap)
+        else:
+            pick = _seg_max(jnp.where(ok, rows, -1), seg, cap)
+        has = _seg_sum(ok.astype(jnp.int64), seg, cap) > 0
+        idx = jnp.where(has, pick, 0).astype(jnp.int32)
+        return (v.gather(idx, has),)
+
+    def merge(self, ctx, partials):
+        return self.update(ctx, partials)
+
+    def evaluate(self, partials, schema):
+        return partials[0]
+
+
+class First(_FirstLast):
+    _is_first = True
+
+
+class Last(_FirstLast):
+    _is_first = False
+
+
+def Avg(e: Expression) -> Average:
+    return Average(e)
+
+
+def CountStar() -> Count:
+    return Count(None)

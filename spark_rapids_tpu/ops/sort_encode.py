@@ -1,0 +1,126 @@
+"""Sortable key encoding + multi-key argsort (TPU groupby/sort substrate).
+
+The reference leans on cuDF's `Table.orderBy` / groupby radix machinery;
+on TPU the idiomatic equivalent is: encode every key column into one or
+more totally-ordered integer arrays, then `jnp.lexsort` — XLA lowers this
+to its sort HLO, which is efficient on VPU.
+
+Encodings (all yield uint64/int16 keys whose integer order == SQL order):
+  - signed ints/dates/timestamps: bias by the sign bit.
+  - floats: IEEE754 total-order trick; NaN encodes above +inf which is
+    exactly Spark's "NaN is largest" ordering, and -0.0 < 0.0.
+  - bools: 0/1.
+  - strings: one int16 key per byte position, +1 biased so "beyond end of
+    string" (0) sorts before any real byte — prefix < longer string.
+  - nulls: a separate 0/1 rank key ahead of the value keys.
+  - invalid rows (padding beyond num_rows): forced to sort last via the
+    most-significant key.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import ColumnVector
+
+_SIGN64 = jnp.uint64(1 << 63)
+
+
+def _encode_int(data) -> jnp.ndarray:
+    """signed int/bool -> uint64 whose unsigned order matches value order."""
+    if data.dtype == jnp.bool_:
+        return data.astype(jnp.uint64)
+    return data.astype(jnp.int64).astype(jnp.uint64) ^ _SIGN64
+
+
+def _float_keys(data, ascending: bool) -> list[jnp.ndarray]:
+    """Floats sort as [is_nan, value] key pairs instead of an IEEE bit
+    encode: 64-bit bitcast_convert is unimplemented in the TPU X64-rewrite
+    pass, and XLA's sort HLO orders plain floats natively.  NaN gets its
+    own most-significant key (Spark: NaN is largest); NaN payloads don't
+    affect SQL ordering so collapsing them to one flag is exact."""
+    nan = jnp.isnan(data)
+    val = jnp.where(nan, jnp.zeros_like(data), data)
+    if ascending:
+        return [nan.astype(jnp.uint8), val]
+    return [(~nan).astype(jnp.uint8), -val]
+
+
+def encode_key_column(col: ColumnVector, ascending: bool = True,
+                      nulls_first: bool = True) -> list[jnp.ndarray]:
+    """Returns lexsort keys for this column in MOST-significant-first
+    order: [null_rank, value_key...]."""
+    keys: list[jnp.ndarray] = []
+    null_rank = jnp.where(col.validity,
+                          jnp.uint8(1 if nulls_first else 0),
+                          jnp.uint8(0 if nulls_first else 1))
+    keys.append(null_rank)
+    if col.dtype.is_string:
+        cc = col.char_cap
+        pos = jnp.arange(cc)[None, :]
+        b = jnp.where(pos < col.lengths[:, None],
+                      col.data.astype(jnp.int16) + 1, 0)
+        if not ascending:
+            b = jnp.int16(256) - b
+        for j in range(cc):
+            keys.append(b[:, j])
+    elif col.dtype.is_floating:
+        keys.extend(_float_keys(col.data, ascending))
+    else:
+        k = _encode_int(col.data)
+        if not ascending:
+            k = ~k
+        keys.append(k)
+    return keys
+
+
+def multi_key_argsort(key_cols: list[tuple[ColumnVector, bool, bool]],
+                      row_mask: jnp.ndarray) -> jnp.ndarray:
+    """Stable argsort by multiple (column, ascending, nulls_first) keys;
+    padded rows sort last.  Returns the permutation."""
+    keys_msf: list[jnp.ndarray] = [(~row_mask).astype(jnp.uint8)]
+    for col, asc, nf in key_cols:
+        keys_msf.extend(encode_key_column(col, asc, nf))
+    # lexsort: LAST key is primary -> feed least-significant first
+    return jnp.lexsort(tuple(reversed(keys_msf)))
+
+
+def segment_boundaries(key_cols: list[ColumnVector],
+                       perm: jnp.ndarray,
+                       row_mask: jnp.ndarray) -> jnp.ndarray:
+    """After sorting by perm, True where a new group starts (valid rows
+    only).  Equal keys = equal (value, null-flag) pairs; two nulls are
+    grouped together (SQL GROUP BY semantics)."""
+    cap = perm.shape[0]
+    sorted_mask = jnp.take(row_mask, perm)
+    diff = jnp.zeros(cap, bool)
+    for col in key_cols:
+        v = jnp.take(col.validity, perm)
+        v_prev = jnp.roll(v, 1)
+        if col.dtype.is_string:
+            d = jnp.take(col.data, perm, axis=0)
+            ln = jnp.take(col.lengths, perm)
+            d_prev = jnp.roll(d, 1, axis=0)
+            ln_prev = jnp.roll(ln, 1)
+            pos = jnp.arange(col.char_cap)[None, :]
+            in_a = pos < ln[:, None]
+            in_b = pos < ln_prev[:, None]
+            byte_neq = jnp.where(in_a | in_b,
+                                 jnp.where(in_a & in_b,
+                                           d != d_prev, True),
+                                 False).any(axis=1)
+            val_neq = byte_neq | (ln != ln_prev)
+        else:
+            d = jnp.take(col.data, perm)
+            d_prev = jnp.roll(d, 1)
+            if col.dtype.is_floating:
+                # group NaNs together
+                both_nan = jnp.isnan(d) & jnp.isnan(d_prev)
+                val_neq = (d != d_prev) & ~both_nan
+            else:
+                val_neq = d != d_prev
+        neq = (v != v_prev) | (v & v_prev & val_neq)
+        diff = diff | neq
+    first = jnp.arange(cap) == 0
+    return sorted_mask & (diff | first)

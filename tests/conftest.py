@@ -13,6 +13,9 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 
 import jax  # noqa: E402
 
+# some pytest plugins import jax before this conftest runs, freezing the
+# platform choice from the outer env — force it again via config
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

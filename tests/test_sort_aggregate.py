@@ -1,0 +1,253 @@
+"""Sort + aggregation parity tests against pandas (golden-rule harness per
+SURVEY.md §4: same computation on CPU reference and TPU engine, diffed)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.aggregate import AggMode, HashAggregateExec
+from spark_rapids_tpu.exec.basic import (CoalescePartitionsExec,
+    LocalBatchSource)
+from spark_rapids_tpu.exec.coalesce import CoalesceBatchesExec
+from spark_rapids_tpu.exec.base import TargetSize
+from spark_rapids_tpu.exec.limit import GlobalLimitExec, LocalLimitExec
+from spark_rapids_tpu.exec.sort import (
+    SortExec, SortOrder, SortedTopNExec, asc, desc)
+from spark_rapids_tpu.exprs.aggregates import (
+    Average, Count, CountStar, First, Last, Max, Min, Sum)
+from spark_rapids_tpu.exprs.base import col, lit
+
+
+def _sales_df(rng, n=200):
+    return pd.DataFrame({
+        "store": rng.choice(["north", "south", "east"], n),
+        "sku": rng.integers(0, 10, n).astype(np.int64),
+        "qty": rng.integers(1, 100, n).astype(np.int64),
+        "price": np.round(rng.uniform(0.5, 50.0, n), 2),
+    })
+
+
+def test_sort_single_key(rng):
+    df = pd.DataFrame({"x": rng.integers(-50, 50, 100).astype(np.int64)})
+    out = SortExec([asc(col("x"))],
+                   LocalBatchSource.from_pandas(df)).to_pandas()
+    assert out["x"].tolist() == sorted(df["x"].tolist())
+
+
+def test_sort_desc_with_nulls(rng):
+    vals = np.array([5, 1, 3, 0, 9], np.int64)
+    valid = np.array([True, False, True, True, False])
+    b = ColumnarBatch.from_numpy({"x": vals}, validity={"x": valid})
+    out = SortExec([desc(col("x"))], LocalBatchSource([[b]])).collect()
+    # valid values are {5, 3, 0}; rows 1 and 4 are null
+    # desc -> nulls last (Spark default)
+    assert out.column("x").to_pylist(5) == [5, 3, 0, None, None]
+    out2 = SortExec([SortOrder(col("x"), ascending=True)],
+                    LocalBatchSource([[b]])).collect()
+    # asc -> nulls first
+    assert out2.column("x").to_pylist(5) == [None, None, 0, 3, 5]
+
+
+def test_sort_float_nan_ordering():
+    b = ColumnarBatch.from_numpy(
+        {"x": np.array([1.0, np.nan, -np.inf, 0.0, np.inf])})
+    out = SortExec([asc(col("x"))], LocalBatchSource([[b]])).collect()
+    got = out.column("x").to_pylist(5)
+    assert got[0] == -np.inf and got[1] == 0.0 and got[2] == 1.0
+    assert got[3] == np.inf and np.isnan(got[4])  # NaN sorts largest
+
+
+def test_sort_two_keys_string_primary(rng):
+    df = pd.DataFrame({
+        "s": rng.choice(["bb", "a", "ccc", "ab"], 50),
+        "v": rng.integers(0, 100, 50).astype(np.int64)})
+    out = SortExec([asc(col("s")), desc(col("v"))],
+                   LocalBatchSource.from_pandas(df)).to_pandas()
+    expect = df.sort_values(["s", "v"], ascending=[True, False])
+    assert out["s"].tolist() == expect["s"].tolist()
+    assert out["v"].tolist() == expect["v"].tolist()
+
+
+def test_groupby_sum_count_parity(rng):
+    df = _sales_df(rng)
+    plan = HashAggregateExec(
+        [col("store")],
+        [Sum(col("qty")).alias("total_qty"),
+         Count(col("qty")).alias("n"),
+         CountStar().alias("rows")],
+        CoalescePartitionsExec(
+            1, LocalBatchSource.from_pandas(df, num_partitions=3)))
+    out = plan.to_pandas().sort_values("store").reset_index(drop=True)
+    exp = (df.groupby("store")
+           .agg(total_qty=("qty", "sum"), n=("qty", "count"),
+                rows=("qty", "size"))
+           .reset_index().sort_values("store").reset_index(drop=True))
+    assert out["store"].tolist() == exp["store"].tolist()
+    assert out["total_qty"].tolist() == exp["total_qty"].tolist()
+    assert out["n"].tolist() == exp["n"].tolist()
+    assert out["rows"].tolist() == exp["rows"].tolist()
+
+
+def test_groupby_min_max_avg_parity(rng):
+    df = _sales_df(rng)
+    plan = HashAggregateExec(
+        [col("store"), col("sku")],
+        [Min(col("price")).alias("mn"), Max(col("price")).alias("mx"),
+         Average(col("price")).alias("avg")],
+        CoalescePartitionsExec(
+            1, LocalBatchSource.from_pandas(df, num_partitions=4)))
+    out = plan.to_pandas().sort_values(["store", "sku"]).reset_index(
+        drop=True)
+    exp = (df.groupby(["store", "sku"])["price"]
+           .agg(mn="min", mx="max", avg="mean").reset_index()
+           .sort_values(["store", "sku"]).reset_index(drop=True))
+    assert out["store"].tolist() == exp["store"].tolist()
+    assert out["sku"].tolist() == exp["sku"].tolist()
+    np.testing.assert_allclose(out["mn"], exp["mn"])
+    np.testing.assert_allclose(out["mx"], exp["mx"])
+    np.testing.assert_allclose(out["avg"], exp["avg"], rtol=1e-12)
+
+
+def test_groupby_with_nulls_in_keys_and_values():
+    b = ColumnarBatch.from_numpy(
+        {"k": np.array([1, 1, 2, 2, 0], np.int64),
+         "v": np.array([10, 20, 30, 0, 50], np.int64)},
+        validity={"k": np.array([True, True, True, True, False]),
+                  "v": np.array([True, True, True, False, True])})
+    plan = HashAggregateExec(
+        [col("k")], [Sum(col("v")).alias("s"), Count(col("v")).alias("c")],
+        LocalBatchSource([[b]]))
+    out = plan.collect()
+    rows = {k: (s, c) for k, s, c in zip(
+        out.column("k").to_pylist(out.num_rows),
+        out.column("s").to_pylist(out.num_rows),
+        out.column("c").to_pylist(out.num_rows))}
+    # null key forms its own group (SQL GROUP BY)
+    assert rows[None] == (50, 1)
+    assert rows[1] == (30, 2)
+    assert rows[2] == (30, 1)  # null value ignored by sum/count
+
+
+def test_groupby_all_null_group_sum_is_null():
+    b = ColumnarBatch.from_numpy(
+        {"k": np.array([7, 7], np.int64),
+         "v": np.array([0, 0], np.int64)},
+        validity={"v": np.array([False, False])})
+    out = HashAggregateExec([col("k")], [Sum(col("v")).alias("s")],
+                            LocalBatchSource([[b]])).collect()
+    assert out.column("s").to_pylist(1) == [None]
+
+
+def test_groupby_string_min_max(rng):
+    df = pd.DataFrame({
+        "g": rng.choice(["x", "y"], 40),
+        "s": rng.choice(["apple", "pear", "fig", "kiwi", "zz"], 40)})
+    out = HashAggregateExec(
+        [col("g")], [Min(col("s")).alias("mn"), Max(col("s")).alias("mx")],
+        LocalBatchSource.from_pandas(df)).to_pandas()
+    out = out.sort_values("g").reset_index(drop=True)
+    exp = df.groupby("g")["s"].agg(mn="min", mx="max").reset_index()
+    assert out["mn"].tolist() == exp["mn"].tolist()
+    assert out["mx"].tolist() == exp["mx"].tolist()
+
+
+def test_reduction_no_keys(rng):
+    df = _sales_df(rng, 100)
+    out = HashAggregateExec(
+        [], [Sum(col("qty")).alias("s"), CountStar().alias("n"),
+             Min(col("price")).alias("mn")],
+        CoalescePartitionsExec(
+            1, LocalBatchSource.from_pandas(df, num_partitions=3))
+    ).to_pandas()
+    assert len(out) == 1
+    assert out["s"][0] == df["qty"].sum()
+    assert out["n"][0] == len(df)
+    np.testing.assert_allclose(out["mn"][0], df["price"].min())
+
+
+def test_reduction_empty_input():
+    src = LocalBatchSource(
+        [[]], schema=T.Schema.of(("v", T.INT64)))
+    out = HashAggregateExec(
+        [], [CountStar().alias("n"), Sum(col("v")).alias("s")], src
+    ).collect()
+    assert out.num_rows == 1
+    assert out.column("n").to_pylist(1) == [0]
+    assert out.column("s").to_pylist(1) == [None]
+
+
+def test_partial_final_split(rng):
+    """Two-phase aggregation as the distributed planner will wire it."""
+    df = _sales_df(rng)
+    partial = HashAggregateExec(
+        [col("store")], [Sum(col("qty")).alias("s"),
+                         Average(col("price")).alias("a")],
+        LocalBatchSource.from_pandas(df, num_partitions=4),
+        mode=AggMode.PARTIAL)
+    # the exchange-to-one-partition the distributed planner will insert
+    final = HashAggregateExec(
+        [col("store")], [Sum(col("qty")).alias("s"),
+                         Average(col("price")).alias("a")],
+        CoalescePartitionsExec(1, partial), mode=AggMode.FINAL)
+    out = final.to_pandas().sort_values("store").reset_index(drop=True)
+    exp = (df.groupby("store").agg(s=("qty", "sum"), a=("price", "mean"))
+           .reset_index())
+    assert out["store"].tolist() == exp["store"].tolist()
+    assert out["s"].tolist() == exp["s"].tolist()
+    np.testing.assert_allclose(out["a"], exp["a"], rtol=1e-12)
+
+
+def test_first_last(rng):
+    b = ColumnarBatch.from_numpy(
+        {"k": np.array([1, 1, 1, 2], np.int64),
+         "v": np.array([0, 10, 20, 30], np.int64)},
+        validity={"v": np.array([False, True, True, True])})
+    out = HashAggregateExec(
+        [col("k")],
+        [First(col("v"), ignore_nulls=True).alias("f"),
+         Last(col("v")).alias("l")],
+        LocalBatchSource([[b]])).collect()
+    rows = {k: (f, l) for k, f, l in zip(
+        out.column("k").to_pylist(2), out.column("f").to_pylist(2),
+        out.column("l").to_pylist(2))}
+    assert rows[1] == (10, 20)
+    assert rows[2] == (30, 30)
+
+
+def test_coalesce_batches(rng):
+    df = pd.DataFrame({"x": np.arange(100, dtype=np.int64)})
+    src = LocalBatchSource.from_pandas(df, num_partitions=8)
+    plan = CoalesceBatchesExec(TargetSize(1 << 20), src)
+    batches = list(plan.execute_columnar())
+    assert sum(b.num_rows for b in batches) == 100
+    # 8 partitions stay separate (partition-local), each coalesced
+    assert len(batches) == 8
+
+
+def test_limits(rng):
+    df = pd.DataFrame({"x": np.arange(100, dtype=np.int64)})
+    src = LocalBatchSource.from_pandas(df, num_partitions=4)
+    local = LocalLimitExec(10, src)
+    total = sum(b.num_rows for it in local.execute_partitions()
+                for b in it)
+    assert total == 40  # 10 per partition
+    glob = GlobalLimitExec(10, src)
+    assert glob.collect().num_rows == 10
+
+
+def test_top_n(rng):
+    df = pd.DataFrame({"x": rng.permutation(1000).astype(np.int64)})
+    plan = SortedTopNExec(5, [desc(col("x"))],
+                          LocalBatchSource.from_pandas(df,
+                                                       num_partitions=4))
+    out = plan.collect()
+    assert out.column("x").to_pylist(5) == [999, 998, 997, 996, 995]
+
+
+def test_global_sort_across_partitions():
+    df = pd.DataFrame({"x": np.array([5, 1, 9, 3, 7, 2, 8, 0], np.int64)})
+    out = SortExec([asc(col("x"))],
+                   LocalBatchSource.from_pandas(df, num_partitions=2)
+                   ).to_pandas()
+    assert out["x"].tolist() == [0, 1, 2, 3, 5, 7, 8, 9]
