@@ -1,0 +1,78 @@
+"""Benchmark driver: TPC-H Q1 (pricing summary) on the TPU engine.
+
+Mirrors the reference bench harness shape (cold + hot runs,
+`TpcxbbLikeBench.scala:26-40`): 1 cold run (compile) + 3 hot runs, report
+the hot-run throughput.  `vs_baseline` is the speedup over single-thread
+pandas running the identical query on this host — the reference publishes
+charts, not numbers (BASELINE.md), so the CPU-on-same-host ratio is the
+honest stand-in for its GPU-vs-CPU-Spark comparisons.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import numpy as np
+
+ROWS = 1 << 22  # ~4.2M lineitem rows
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.models.tpch import (
+        build_q1_kernel, gen_lineitem, q1_reference_pandas)
+
+    rng = np.random.default_rng(42)
+    batch = gen_lineitem(rng, ROWS)
+    cap = batch.capacity
+    fn = jax.jit(build_q1_kernel(cap))
+    args = (
+        batch.column("l_returnflag").data,
+        batch.column("l_linestatus").data,
+        batch.column("l_quantity").data,
+        batch.column("l_extendedprice").data,
+        batch.column("l_discount").data,
+        batch.column("l_tax").data,
+        batch.column("l_shipdate").data,
+        jnp.int32(batch.num_rows),
+    )
+
+    # cold run (compile) + correctness check vs pandas
+    out = fn(*args)
+    jax.block_until_ready(out)
+    df = batch.to_pandas()
+    exp = q1_reference_pandas(df)
+    got_cnt = np.asarray(out[7])
+    exp_by_group = {(int(r["l_returnflag"]), int(r["l_linestatus"])):
+                    int(r["count_order"]) for _, r in exp.iterrows()}
+    for g in range(6):
+        flag, status = g // 2, g % 2
+        assert got_cnt[g] == exp_by_group.get((flag, status), 0), \
+            f"group {g}: {got_cnt[g]} != {exp_by_group.get((flag, status))}"
+
+    # hot runs
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    tpu_time = min(times)
+    rows_per_sec = ROWS / tpu_time
+
+    # pandas baseline (single-thread CPU, same query)
+    t0 = time.perf_counter()
+    q1_reference_pandas(df)
+    pandas_time = time.perf_counter() - t0
+
+    print(json.dumps({
+        "metric": "tpch_q1_rows_per_sec",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(pandas_time / tpu_time, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -25,7 +25,8 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
 from spark_rapids_tpu.columnar.vector import ColumnVector, bucket_capacity
 from spark_rapids_tpu.exec.base import (
-    TpuExec, UnaryExecBase, batch_signature, make_eval_context)
+    SchemaOnlyExec as _SchemaOnly, TpuExec, UnaryExecBase,
+    batch_signature, make_eval_context)
 from spark_rapids_tpu.exprs.aggregates import (
     AggAlias, AggContext, AggregateFunction)
 from spark_rapids_tpu.exprs.base import Expression, output_name
@@ -349,12 +350,4 @@ class GroupRef(Expression):
         return ctx.columns[self.ordinal]
 
 
-class _SchemaOnly(TpuExec):
-    """Placeholder child carrying just a schema (for internal merge nodes)."""
 
-    def __init__(self, schema: T.Schema):
-        super().__init__()
-        self._schema = schema
-
-    def output_schema(self):
-        return self._schema

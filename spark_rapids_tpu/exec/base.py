@@ -173,6 +173,18 @@ class TpuExec:
         return self.tree_string()
 
 
+class SchemaOnlyExec(TpuExec):
+    """Placeholder child carrying just a schema, for internal helper
+    execs (merge nodes, shared sorters)."""
+
+    def __init__(self, schema: T.Schema):
+        super().__init__()
+        self._schema = schema
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+
 class LeafExec(TpuExec):
     def execute_partitions(self):
         return [self.execute_columnar()]

@@ -1,0 +1,471 @@
+"""Join operators (reference shims `GpuHashJoin.scala:50,282`,
+`GpuShuffledHashJoinExec.scala`, `GpuBroadcastHashJoinExec.scala`,
+`GpuBroadcastNestedLoopJoinExec.scala`, `GpuCartesianProductExec.scala`).
+
+TPU equi-join core — exact, static-shape, collision-free:
+
+  1. concat build+probe rows; lexsort by join keys with a side flag as the
+     final tie-break (build rows first within each key group);
+  2. segment boundaries over the keys give key-groups; per group record the
+     build-row range [group_start, group_start + build_count);
+  3. each probe row's match count = its group's build count (0 if any key
+     is null — SQL equi-join semantics); a CSR expansion enumerates the
+     (probe, build) pairs.
+
+The expansion size is data-dependent: kernel A returns counts and the
+total syncs to host (one scalar), which picks the output capacity bucket
+for kernel B — the bucketed-compile discipline from SURVEY.md §7(a).
+
+Join types: inner, left/right outer, full outer, left semi, left anti,
+cross.  Residual (non-equi) conditions post-filter inner/cross joins, as
+the reference restricts.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.columnar.vector import ColumnVector, bucket_capacity
+from spark_rapids_tpu.exec.base import (
+    KernelCache, RequireSingleBatch, TpuExec, batch_signature,
+    make_eval_context)
+from spark_rapids_tpu.exprs.base import Expression
+from spark_rapids_tpu.ops.sort_encode import (
+    encode_key_column, segment_boundaries)
+from spark_rapids_tpu.utils import metrics as M
+
+
+class JoinType(enum.Enum):
+    INNER = "inner"
+    LEFT_OUTER = "left_outer"
+    RIGHT_OUTER = "right_outer"
+    FULL_OUTER = "full_outer"
+    LEFT_SEMI = "left_semi"
+    LEFT_ANTI = "left_anti"
+    CROSS = "cross"
+
+
+_PROBE_ONLY = (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI)
+
+
+class HashJoinExec(TpuExec):
+    """Shuffled hash join: build side concatenated to one batch, probe side
+    streamed (reference GpuShuffledHashJoinExec)."""
+
+    def __init__(self, join_type: JoinType,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 left: TpuExec, right: TpuExec,
+                 condition: Optional[Expression] = None):
+        super().__init__(left, right)
+        self.join_type = join_type
+        if condition is not None and join_type not in (
+                JoinType.INNER, JoinType.CROSS):
+            raise ValueError(
+                "residual join conditions only supported for inner joins "
+                "(same restriction as the reference GpuHashJoin)")
+        self.condition = condition
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        lschema, rschema = left.output_schema(), right.output_schema()
+        self._lschema, self._rschema = lschema, rschema
+        # probe = left, build = right, except RIGHT_OUTER which probes right
+        self._flip = join_type == JoinType.RIGHT_OUTER
+        if self._flip:
+            self._probe, self._build = right, left
+            self._probe_keys = [e.bind(rschema) for e in self.right_keys]
+            self._build_keys = [e.bind(lschema) for e in self.left_keys]
+        else:
+            self._probe, self._build = left, right
+            self._probe_keys = [e.bind(lschema) for e in self.left_keys]
+            self._build_keys = [e.bind(rschema) for e in self.right_keys]
+
+        if join_type in _PROBE_ONLY:
+            self._schema = lschema
+        else:
+            self._schema = T.Schema(tuple(lschema.fields) +
+                                    tuple(rschema.fields))
+        self._join_cache = KernelCache()
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def describe(self):
+        return (f"HashJoinExec({self.join_type.value}, "
+                f"keys={len(self.left_keys)})")
+
+    # -- kernel A: match counts ------------------------------------------
+    def _match_kernel(self, build: ColumnarBatch, probe: ColumnarBatch):
+        key = ("join-match", batch_signature(build),
+               batch_signature(probe))
+
+        def build_fn():
+            bcap, pcap = build.capacity, probe.capacity
+            cap = bcap + pcap
+            build_keys, probe_keys = self._build_keys, self._probe_keys
+
+            @jax.jit
+            def kernel(bcols, bnum, pcols, pnum):
+                bctx = make_eval_context(bcols, bcap, bnum)
+                pctx = make_eval_context(pcols, pcap, pnum)
+                bk = [e.eval(bctx) for e in build_keys]
+                pk = [e.eval(pctx) for e in probe_keys]
+                # combined key columns (build rows at [0, bcap))
+                comb = []
+                for b, p in zip(bk, pk):
+                    if b.dtype.is_string:
+                        from spark_rapids_tpu.columnar.vector import \
+                            _pad_chars
+                        cc = max(b.char_cap, p.char_cap)
+                        b, p = _pad_chars(b, cc), _pad_chars(p, cc)
+                        comb.append(ColumnVector(
+                            b.dtype,
+                            jnp.concatenate([b.data, p.data]),
+                            jnp.concatenate([b.validity, p.validity]),
+                            jnp.concatenate([b.lengths, p.lengths])))
+                    else:
+                        dt = b.dtype if b.dtype == p.dtype else \
+                            T.common_type(b.dtype, p.dtype)
+                        from spark_rapids_tpu.exprs.base import promote
+                        b, p = promote(b, dt), promote(p, dt)
+                        comb.append(ColumnVector(
+                            dt, jnp.concatenate([b.data, p.data]),
+                            jnp.concatenate([b.validity, p.validity])))
+                side = jnp.concatenate([jnp.zeros(bcap, jnp.uint8),
+                                        jnp.ones(pcap, jnp.uint8)])
+                row_mask = jnp.concatenate([bctx.row_mask, pctx.row_mask])
+                keys_msf = [(~row_mask).astype(jnp.uint8)]
+                for c in comb:
+                    keys_msf.extend(encode_key_column(c, True, True))
+                keys_msf.append(side)
+                perm = jnp.lexsort(tuple(reversed(keys_msf)))
+                bounds = segment_boundaries(comb, perm, row_mask)
+                gid = jnp.cumsum(bounds.astype(jnp.int32)) - 1
+                sorted_side = jnp.take(side, perm)
+                sorted_mask = jnp.take(row_mask, perm)
+                keys_ok = jnp.ones(cap, bool)
+                for c in comb:
+                    keys_ok = keys_ok & c.validity
+                sorted_ok = jnp.take(keys_ok, perm) & sorted_mask
+                gid_safe = jnp.where(sorted_mask, gid, cap)
+                is_build = (sorted_side == 0) & sorted_ok
+                is_probe = (sorted_side == 1) & sorted_ok
+                bcount = jax.ops.segment_sum(
+                    is_build.astype(jnp.int32),
+                    jnp.where(is_build, gid_safe, cap), num_segments=cap)
+                pcount = jax.ops.segment_sum(
+                    is_probe.astype(jnp.int32),
+                    jnp.where(is_probe, gid_safe, cap), num_segments=cap)
+                (gstart,) = jnp.nonzero(bounds, size=cap,
+                                        fill_value=cap - 1)
+                # per probe ORIGINAL row: count + start of its build range
+                sorted_pos = jnp.arange(cap)
+                probe_orig = jnp.where(sorted_side == 1,
+                                       jnp.take(perm, sorted_pos) - bcap, 0)
+                counts_p = jnp.zeros(pcap, jnp.int32)
+                start_p = jnp.zeros(pcap, jnp.int32)
+                cnt_for_row = jnp.where(is_probe,
+                                        jnp.take(bcount, gid_safe,
+                                                 mode="clip"), 0)
+                st_for_row = jnp.where(is_probe,
+                                       jnp.take(gstart, gid_safe,
+                                                mode="clip"), 0)
+                sel = sorted_side == 1
+                counts_p = counts_p.at[
+                    jnp.where(sel, probe_orig, pcap)].add(
+                    cnt_for_row.astype(jnp.int32), mode="drop")
+                start_p = start_p.at[
+                    jnp.where(sel, probe_orig, pcap)].add(
+                    st_for_row.astype(jnp.int32), mode="drop")
+                # build matched flags (original build rows)
+                bmatch_sorted = is_build & (jnp.take(pcount, gid_safe,
+                                                     mode="clip") > 0)
+                bmatched = jnp.zeros(bcap, bool)
+                borig = jnp.where(sorted_side == 0,
+                                  jnp.take(perm, sorted_pos), bcap)
+                bmatched = bmatched.at[borig].max(bmatch_sorted,
+                                                  mode="drop")
+                total_inner = counts_p.sum()
+                return counts_p, start_p, perm, bmatched, total_inner
+
+            return kernel
+
+        return self._join_cache.get_or_build(key, build_fn)
+
+    # -- kernel B: pair expansion ----------------------------------------
+    def _expand_kernel(self, build: ColumnarBatch, probe: ColumnarBatch,
+                       out_cap: int, outer_probe: bool):
+        key = ("join-expand", outer_probe, out_cap,
+               batch_signature(build), batch_signature(probe))
+
+        def build_fn():
+            bcap, pcap = build.capacity, probe.capacity
+            cap = bcap + pcap
+
+            @jax.jit
+            def kernel(bcols, pcols, counts_p, start_p, perm, pnum):
+                eff = counts_p
+                if outer_probe:
+                    probe_valid = jnp.arange(pcap) < pnum
+                    eff = jnp.where(probe_valid & (counts_p == 0), 1,
+                                    counts_p)
+                cum = jnp.cumsum(eff)
+                total = cum[-1]
+                k = jnp.arange(out_cap)
+                i = jnp.searchsorted(cum, k, side="right")
+                i = jnp.clip(i, 0, pcap - 1)
+                prev = jnp.where(i > 0, jnp.take(cum, i - 1, mode="clip"),
+                                 0)
+                off = k - prev
+                in_range = k < total
+                has_match = jnp.take(counts_p, i, mode="clip") > 0
+                sorted_bpos = jnp.take(start_p, i, mode="clip") + off
+                combined_row = jnp.take(perm, jnp.clip(sorted_bpos, 0,
+                                                       cap - 1))
+                build_row = jnp.clip(combined_row, 0, bcap - 1)
+                probe_sel = jnp.where(in_range, i, 0)
+                build_sel = jnp.where(in_range & has_match, build_row, 0)
+                pvalid = in_range
+                bvalid = in_range & has_match
+                pout = [c.gather(probe_sel, pvalid) for c in pcols]
+                bout = [c.gather(build_sel, bvalid) for c in bcols]
+                return pout, bout, total
+
+            return kernel
+
+        return self._join_cache.get_or_build(key, build_fn)
+
+    def _semi_kernel(self, probe: ColumnarBatch, anti: bool):
+        key = ("join-semi", anti, batch_signature(probe))
+
+        def build_fn():
+            pcap = probe.capacity
+
+            @jax.jit
+            def kernel(pcols, counts_p, pnum):
+                probe_valid = jnp.arange(pcap) < pnum
+                keep = probe_valid & ((counts_p == 0) if anti
+                                      else (counts_p > 0))
+                n = keep.sum().astype(jnp.int32)
+                (idx,) = jnp.nonzero(keep, size=pcap, fill_value=pcap - 1)
+                valid = jnp.arange(pcap) < n
+                return [c.gather(idx, valid) for c in pcols], n
+
+            return kernel
+
+        return self._join_cache.get_or_build(key, build_fn)
+
+    # -- execution --------------------------------------------------------
+    def children_coalesce_goal(self):
+        # build side needs a single batch
+        return [None, RequireSingleBatch()] if not self._flip else \
+            [RequireSingleBatch(), None]
+
+    def _build_batch(self) -> ColumnarBatch:
+        batches = [b for it in self._build.execute_partitions()
+                   for b in it if b.num_rows > 0]
+        if not batches:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+            return empty_batch(self._build.output_schema())
+        return concat_batches(batches)
+
+    def _assemble(self, pout, bout, n) -> ColumnarBatch:
+        """Order output columns as (left, right) regardless of probe side."""
+        if self._flip:
+            cols = list(bout) + list(pout)
+        else:
+            cols = list(pout) + list(bout)
+        return ColumnarBatch(self._schema, cols, n)
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        build = self._build_batch()
+        jt = self.join_type
+        outer_probe = jt in (JoinType.LEFT_OUTER, JoinType.RIGHT_OUTER,
+                             JoinType.FULL_OUTER)
+        emitted_any = False
+        bmatched_total = np.zeros(build.capacity, bool)
+        for it in self._probe.execute_partitions():
+            for pb in it:
+                if pb.num_rows == 0:
+                    continue
+                with self.metrics.timed(M.TOTAL_TIME):
+                    mk = self._match_kernel(build, pb)
+                    counts_p, start_p, perm, bmatched, total_inner = mk(
+                        build.columns, jnp.int32(build.num_rows),
+                        pb.columns, jnp.int32(pb.num_rows))
+                    if jt == JoinType.FULL_OUTER:
+                        bmatched_total |= np.asarray(bmatched)
+                    if jt in _PROBE_ONLY:
+                        sk = self._semi_kernel(pb, jt == JoinType.LEFT_ANTI)
+                        cols, n = sk(pb.columns, counts_p,
+                                     jnp.int32(pb.num_rows))
+                        out = ColumnarBatch(self._schema, list(cols), int(n))
+                    else:
+                        total = int(total_inner)
+                        if outer_probe:
+                            total = total + pb.num_rows  # upper bound
+                        out_cap = bucket_capacity(max(total, 1))
+                        ek = self._expand_kernel(build, pb, out_cap,
+                                                 outer_probe)
+                        pout, bout, tot = ek(build.columns, pb.columns,
+                                             counts_p, start_p, perm,
+                                             jnp.int32(pb.num_rows))
+                        out = self._assemble(pout, bout, int(tot))
+                        if self.condition is not None:
+                            out = self._apply_condition(out)
+                if out.num_rows > 0:
+                    emitted_any = True
+                    self.update_output_metrics(out)
+                    yield out
+        if jt == JoinType.FULL_OUTER:
+            un = self._unmatched_build(build, bmatched_total)
+            if un is not None and un.num_rows > 0:
+                self.update_output_metrics(un)
+                yield un
+        if not emitted_any and jt in _PROBE_ONLY:
+            return
+
+    def _apply_condition(self, batch: ColumnarBatch) -> ColumnarBatch:
+        from spark_rapids_tpu.exec.basic import FilterExec, LocalBatchSource
+        f = getattr(self, "_cond_filter", None)
+        if f is None:
+            src = LocalBatchSource([[]], schema=self._schema)
+            f = FilterExec(self.condition, src)
+            self._cond_filter = f
+        out = list(f.process_partition(iter([batch])))
+        return out[0]
+
+    def _unmatched_build(self, build: ColumnarBatch,
+                         matched: np.ndarray) -> Optional[ColumnarBatch]:
+        """FULL OUTER: build rows never matched, with null probe side."""
+        if build.num_rows == 0:
+            return None
+        unmatched = ~matched[: build.num_rows]
+        idx = np.nonzero(unmatched)[0]
+        if len(idx) == 0:
+            return None
+        cap = bucket_capacity(len(idx))
+        sel = jnp.asarray(np.pad(idx, (0, cap - len(idx))))
+        valid = jnp.arange(cap) < len(idx)
+        bout = [c.gather(sel, valid) for c in build.columns]
+        # null probe columns
+        from spark_rapids_tpu.columnar.batch import empty_batch
+        pschema = self._probe.output_schema()
+        nulls = []
+        for f in pschema.fields:
+            from spark_rapids_tpu.exprs.base import Literal
+            lv = Literal(None, f.dtype)
+            ctx = make_eval_context([], cap, jnp.int32(len(idx)))
+            nulls.append(lv.eval(ctx))
+        return self._assemble(nulls, bout, len(idx))
+
+    def execute_partitions(self):
+        return [self.execute_columnar()]
+
+
+class BroadcastHashJoinExec(HashJoinExec):
+    """Same join core; the build side comes from a BroadcastExchangeExec
+    so every probe partition reuses one broadcast batch (reference
+    GpuBroadcastHashJoinExec)."""
+
+    def _build_batch(self) -> ColumnarBatch:
+        from spark_rapids_tpu.shuffle.exchange import BroadcastExchangeExec
+        if isinstance(self._build, BroadcastExchangeExec):
+            return self._build.broadcast_batch()
+        return super()._build_batch()
+
+
+class NestedLoopJoinExec(TpuExec):
+    """Brute-force cross/conditioned join (reference
+    GpuBroadcastNestedLoopJoinExec / GpuCartesianProductExec — both
+    disabled by default there for OOM risk; here the pair expansion is
+    bucketed so memory stays bounded per batch pair)."""
+
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 condition: Optional[Expression] = None,
+                 join_type: JoinType = JoinType.CROSS):
+        super().__init__(left, right)
+        if join_type not in (JoinType.CROSS, JoinType.INNER):
+            raise ValueError("nested loop join supports cross/inner only")
+        self.condition = condition
+        self._schema = T.Schema(tuple(left.output_schema().fields) +
+                                tuple(right.output_schema().fields))
+        self._cache = KernelCache()
+
+    def output_schema(self):
+        return self._schema
+
+    def _pair_kernel(self, lb: ColumnarBatch, rb: ColumnarBatch):
+        key = ("nlj", batch_signature(lb), batch_signature(rb))
+
+        def build_fn():
+            lcap, rcap = lb.capacity, rb.capacity
+            out_cap = lcap * rcap
+
+            @jax.jit
+            def kernel(lcols, lnum, rcols, rnum):
+                k = jnp.arange(out_cap)
+                li = k // rcap
+                ri = k % rcap
+                valid = (li < lnum) & (ri < rnum)
+                lout = [c.gather(jnp.where(valid, li, 0), valid)
+                        for c in lcols]
+                rout = [c.gather(jnp.where(valid, ri, 0), valid)
+                        for c in rcols]
+                # compact valid pairs to the front
+                n = valid.sum().astype(jnp.int32)
+                (idx,) = jnp.nonzero(valid, size=out_cap,
+                                     fill_value=out_cap - 1)
+                ok = jnp.arange(out_cap) < n
+                lout = [c.gather(idx, ok) for c in lout]
+                rout = [c.gather(idx, ok) for c in rout]
+                return lout, rout, n
+
+            return kernel
+
+        return self._cache.get_or_build(key, build_fn)
+
+    def execute_columnar(self):
+        right_batches = [b for it in self.children[1].execute_partitions()
+                         for b in it if b.num_rows > 0]
+        for it in self.children[0].execute_partitions():
+            for lb in it:
+                if lb.num_rows == 0:
+                    continue
+                for rb in right_batches:
+                    with self.metrics.timed(M.TOTAL_TIME):
+                        kern = self._pair_kernel(lb, rb)
+                        lout, rout, n = kern(
+                            lb.columns, jnp.int32(lb.num_rows),
+                            rb.columns, jnp.int32(rb.num_rows))
+                        out = ColumnarBatch(self._schema,
+                                            list(lout) + list(rout), int(n))
+                        if self.condition is not None:
+                            out = self._apply_condition(out)
+                    if out.num_rows:
+                        self.update_output_metrics(out)
+                        yield out
+
+    def _apply_condition(self, batch):
+        from spark_rapids_tpu.exec.basic import FilterExec, LocalBatchSource
+        f = getattr(self, "_cond_filter", None)
+        if f is None:
+            src = LocalBatchSource([[]], schema=self._schema)
+            f = FilterExec(self.condition, src)
+            self._cond_filter = f
+        return list(f.process_partition(iter([batch])))[0]
+
+    def execute_partitions(self):
+        return [self.execute_columnar()]
+
+
+def CartesianProductExec(left: TpuExec, right: TpuExec,
+                         condition=None) -> NestedLoopJoinExec:
+    return NestedLoopJoinExec(left, right, condition, JoinType.CROSS)

@@ -128,7 +128,8 @@ class SortedTopNExec(UnaryExecBase):
         self.order = list(order)
         self._schema = child.output_schema()
         # one shared sorter so per-batch sort kernels hit ONE compile cache
-        self._sorter = SortExec(self.order, _SchemaChild(self._schema),
+        from spark_rapids_tpu.exec.base import SchemaOnlyExec
+        self._sorter = SortExec(self.order, SchemaOnlyExec(self._schema),
                                 global_sort=False)
 
     def output_schema(self):
@@ -157,13 +158,3 @@ class SortedTopNExec(UnaryExecBase):
     def execute_partitions(self):
         return [self.execute_columnar()]
 
-
-class _SchemaChild(TpuExec):
-    """Schema-only placeholder child for internal helper execs."""
-
-    def __init__(self, schema: T.Schema):
-        super().__init__()
-        self._schema = schema
-
-    def output_schema(self):
-        return self._schema

@@ -258,14 +258,25 @@ def _string_to_int(c: ColumnVector, dst: T.DataType) -> ColumnVector:
     in_digits = (pos >= start[:, None]) & (pos <= last[:, None])
     dig = chars - ord("0")
     digit_ok = (dig >= 0) & (dig <= 9)
-    valid_parse = (ndigits >= 1) & (ndigits <= 19) & \
-        (jnp.where(in_digits, digit_ok, True).all(axis=1))
-    # Horner accumulate left->right over static char width
-    acc = jnp.zeros(c.capacity, jnp.int64)
+    # significant digits (leading zeros allowed, like Long.parseLong)
+    sig = in_digits & (dig != 0)
+    first_sig = jnp.where(sig.any(axis=1), jnp.argmax(sig, axis=1), last + 1)
+    sig_digits = jnp.maximum(last - first_sig + 1, 0)
+    # Horner accumulate in uint64: 19 significant digits can't wrap
+    # (10^19 - 1 < 2^64), so overflow detection is an exact compare
+    acc = jnp.zeros(c.capacity, jnp.uint64)
     for k in range(cc):
         use = in_digits[:, k]
-        acc = jnp.where(use, acc * 10 + dig[:, k].astype(jnp.int64), acc)
-    val = jnp.where(neg, -acc, acc)
+        acc = jnp.where(use, acc * jnp.uint64(10)
+                        + dig[:, k].astype(jnp.uint64), acc)
+    limit = jnp.where(neg, jnp.uint64(2 ** 63), jnp.uint64(2 ** 63 - 1))
+    valid_parse = (ndigits >= 1) & (sig_digits <= 19) & (acc <= limit) & \
+        (jnp.where(in_digits, digit_ok, True).all(axis=1))
+    acc_i = acc.astype(jnp.int64)  # 2^63 wraps to INT64_MIN, handled below
+    val = jnp.where(neg,
+                    jnp.where(acc == jnp.uint64(2 ** 63),
+                              jnp.int64(-2 ** 63), -acc_i),
+                    acc_i)
     lo, hi = _INT_BOUNDS.get(dst.id, _INT_BOUNDS[T.TypeId.INT64])
     in_range = (val >= lo) & (val <= hi)
     validity = c.validity & valid_parse & in_range
@@ -297,6 +308,10 @@ def _string_to_date(c: ColumnVector) -> ColumnVector:
     dashes_ok = (chars[:, 4] == ord("-")) & (chars[:, 7] == ord("-"))
     y, m, d = num((0, 1, 2, 3)), num((5, 6)), num((8, 9))
     range_ok = (m >= 1) & (m <= 12) & (d >= 1) & (d <= 31)
-    validity = c.validity & ok_len & digits_ok & dashes_ok & range_ok
     days = DT.ymd_to_days(y, m, d)
+    # reject impossible dates (e.g. Feb 31): round-trip must reproduce
+    # the parsed fields exactly, otherwise ymd_to_days normalized them
+    ry, rm, rd = DT.days_to_ymd(days)
+    exact = (ry == y) & (rm == m) & (rd == d)
+    validity = c.validity & ok_len & digits_ok & dashes_ok & range_ok & exact
     return ColumnVector(T.DATE32, days, validity)

@@ -206,8 +206,5 @@ class Round(Expression):
         r = jnp.where(scaled >= 0, jnp.floor(scaled + 0.5),
                       jnp.ceil(scaled - 0.5))
         out = r / mul
-        if c.dtype.is_floating:
-            out = out.astype(c.dtype.storage_dtype)
-            return ColumnVector(c.dtype, out, c.validity)
         return ColumnVector(c.dtype, out.astype(c.dtype.storage_dtype),
                             c.validity)

@@ -1,0 +1,191 @@
+"""Spark-exact Murmur3 x86_32 row hashing, vectorized in JAX.
+
+The reference uses cuDF's murmur3 partition hashing
+(`GpuHashPartitioning.scala`), which matches Spark's
+`org.apache.spark.sql.catalyst.expressions.Murmur3Hash` (seed 42):
+
+  hash = 42
+  for each column:  hash = hash_col(value, seed=hash)   # null: unchanged
+
+  int/short/byte/bool/date -> hashInt(v)
+  long/timestamp           -> hashLong(v)   (two 32-bit words)
+  float  -> hashInt(floatToIntBits(f))   with -0.0 normalized to 0.0
+  double -> hashLong(doubleToLongBits(d)) with -0.0 normalized
+  string -> hashUnsafeBytes(utf8): 4-byte LE words, then per-byte tail
+            (bytes are SIGNED in the tail), fmix with total length
+
+All arithmetic is wrapping uint32.  Float bit patterns are recovered with
+32-bit bitcasts only (64-bit bitcast_convert does not lower on TPU): a
+double is split via frexp-based exact decomposition into hi/lo words.
+
+Known divergence: XLA flushes f64 subnormals to zero (FTZ), so subnormal
+doubles (|x| < 2.2e-308) hash as +/-0.0.  Spark/cuDF hash their exact bit
+patterns.  No realistic SQL workload is affected.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import ColumnVector
+
+C1 = jnp.uint32(0xCC9E2D51)
+C2 = jnp.uint32(0x1B873593)
+SPARK_SEED = 42
+
+
+def _rotl(x, r):
+    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+
+def _mix_k1(k1):
+    k1 = k1 * C1
+    k1 = _rotl(k1, 15)
+    return k1 * C2
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl(h1, 13)
+    return h1 * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+
+def _fmix(h1, length):
+    h1 = h1 ^ jnp.uint32(length)
+    h1 = h1 ^ (h1 >> jnp.uint32(16))
+    h1 = h1 * jnp.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> jnp.uint32(13))
+    h1 = h1 * jnp.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> jnp.uint32(16))
+    return h1
+
+
+def hash_int(v_u32, seed_u32):
+    return _fmix(_mix_h1(seed_u32, _mix_k1(v_u32)), 4)
+
+
+def hash_long(lo_u32, hi_u32, seed_u32):
+    h1 = _mix_h1(seed_u32, _mix_k1(lo_u32))
+    h1 = _mix_h1(h1, _mix_k1(hi_u32))
+    return _fmix(h1, 8)
+
+
+def _double_to_words(x):
+    """doubleToLongBits as (lo, hi) uint32 without 64-bit bitcast.
+
+    Exact IEEE754 reconstruction: frexp gives mantissa in [0.5, 1) and
+    exponent; the 52-bit mantissa field is recovered with two exact f64
+    multiplies (each fits 32 bits).  Specials (0, inf, nan, subnormal)
+    handled explicitly; NaN canonicalized like Java's doubleToLongBits."""
+    x = x.astype(jnp.float64)
+    neg = jnp.signbit(x)
+    ax = jnp.abs(x)
+    m, e = jnp.frexp(ax)                      # ax = m * 2^e, m in [0.5, 1)
+    biased = (e + 1022).astype(jnp.int64)     # IEEE exponent field
+    is_sub = biased <= 0                      # subnormal range
+    # normal: mantissa field = (m*2 - 1) * 2^52, split hi 20 / lo 32
+    frac = m * 2.0 - 1.0                      # [0, 1)
+    hi20 = jnp.floor(frac * (1 << 20))
+    rem = frac * (1 << 20) - hi20             # [0,1), 32 bits of precision
+    lo32 = jnp.floor(rem * 4294967296.0)
+    # subnormal: value = f * 2^-1074 exactly; f < 2^52
+    sub_scaled = ax * 4.49423283715579e307 * 4.0  # ax * 2^1024
+    # sub field = ax / 2^-1074 = ax * 2^1074 — do it in two exact steps
+    sub_f = ax * (2.0 ** 537)
+    sub_f = sub_f * (2.0 ** 537)
+    sub_hi = jnp.floor(sub_f / 4294967296.0)
+    sub_lo = sub_f - sub_hi * 4294967296.0
+    del sub_scaled
+    is_zero = ax == 0.0
+    is_inf = jnp.isinf(ax)
+    is_nan = jnp.isnan(x)
+    hi_field = jnp.where(is_sub, sub_hi, hi20 + biased.astype(
+        jnp.float64) * (1 << 20))
+    lo_field = jnp.where(is_sub, sub_lo, lo32)
+    hi_u = hi_field.astype(jnp.uint32)
+    lo_u = lo_field.astype(jnp.uint32)
+    hi_u = jnp.where(is_zero, jnp.uint32(0), hi_u)
+    lo_u = jnp.where(is_zero, jnp.uint32(0), lo_u)
+    hi_u = jnp.where(is_inf, jnp.uint32(0x7FF00000), hi_u)
+    lo_u = jnp.where(is_inf, jnp.uint32(0), lo_u)
+    sign = jnp.where(neg & ~is_nan, jnp.uint32(0x80000000), jnp.uint32(0))
+    hi_u = hi_u | sign
+    # Java canonical NaN: 0x7FF8000000000000
+    hi_u = jnp.where(is_nan, jnp.uint32(0x7FF80000), hi_u)
+    lo_u = jnp.where(is_nan, jnp.uint32(0), lo_u)
+    return lo_u, hi_u
+
+
+def hash_column(col: ColumnVector, seed_u32: jnp.ndarray) -> jnp.ndarray:
+    """Chain one column into the row hash; null rows keep the seed."""
+    dt = col.dtype
+    if dt.is_string:
+        h = _hash_string(col, seed_u32)
+    elif dt.id in (T.TypeId.BOOL,):
+        h = hash_int(col.data.astype(jnp.uint32), seed_u32)
+    elif dt.id in (T.TypeId.INT8, T.TypeId.INT16, T.TypeId.INT32,
+                   T.TypeId.DATE32):
+        h = hash_int(col.data.astype(jnp.int32).astype(jnp.uint32), seed_u32)
+    elif dt.id in (T.TypeId.INT64, T.TypeId.TIMESTAMP_US):
+        v = col.data.astype(jnp.int64)
+        lo = (v & 0xFFFFFFFF).astype(jnp.uint32)
+        hi = ((v >> 32) & 0xFFFFFFFF).astype(jnp.uint32)
+        h = hash_long(lo, hi, seed_u32)
+    elif dt.id == T.TypeId.FLOAT32:
+        f = col.data
+        f = jnp.where(f == 0.0, jnp.float32(0.0), f)  # -0f -> 0f
+        bits = lax.bitcast_convert_type(f.astype(jnp.float32), jnp.int32)
+        # Java canonical NaN float: 0x7FC00000
+        bits = jnp.where(jnp.isnan(f), jnp.int32(0x7FC00000), bits)
+        h = hash_int(bits.astype(jnp.uint32), seed_u32)
+    elif dt.id == T.TypeId.FLOAT64:
+        d = col.data
+        d = jnp.where(d == 0.0, 0.0, d)  # -0.0 -> 0.0
+        lo, hi = _double_to_words(d)
+        h = hash_long(lo, hi, seed_u32)
+    else:
+        raise TypeError(f"unhashable type {dt}")
+    return jnp.where(col.validity, h, seed_u32)
+
+
+def _hash_string(col: ColumnVector, seed_u32: jnp.ndarray) -> jnp.ndarray:
+    cc = col.char_cap
+    data = col.data.astype(jnp.uint32)        # [cap, cc]
+    lens = col.lengths
+    n_words = cc // 4
+    h1 = seed_u32
+    aligned = (lens // 4) * 4
+    for w in range(n_words):
+        base = w * 4
+        word = (data[:, base]
+                | (data[:, base + 1] << 8)
+                | (data[:, base + 2] << 16)
+                | (data[:, base + 3] << 24))
+        in_bounds = base + 4 <= aligned
+        h1 = jnp.where(in_bounds, _mix_h1(h1, _mix_k1(word)), h1)
+    # tail bytes: SIGNED byte value, each mixed separately
+    for b in range(cc):
+        sbyte = col.data[:, b].astype(jnp.int8).astype(jnp.int32)
+        in_tail = (b >= aligned) & (b < lens)
+        h1 = jnp.where(in_tail,
+                       _mix_h1(h1, _mix_k1(sbyte.astype(jnp.uint32))), h1)
+    return _fmix(h1, lens.astype(jnp.uint32))
+
+
+def murmur3_row_hash(cols: list[ColumnVector],
+                     seed: int = SPARK_SEED) -> jnp.ndarray:
+    """Spark Murmur3Hash(columns...) as int32."""
+    cap = cols[0].capacity
+    h = jnp.full(cap, seed, jnp.uint32)
+    for c in cols:
+        h = hash_column(c, h)
+    return h.astype(jnp.int32)
+
+
+def partition_ids(cols: list[ColumnVector], num_partitions: int
+                  ) -> jnp.ndarray:
+    """Spark HashPartitioning: pmod(murmur3(keys), n)."""
+    h = murmur3_row_hash(cols)
+    m = lax.rem(h, jnp.int32(num_partitions))
+    return jnp.where(m < 0, m + num_partitions, m)

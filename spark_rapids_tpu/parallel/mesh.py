@@ -1,0 +1,38 @@
+"""Device mesh management (the TPU analog of the reference's
+`GpuDeviceManager.scala` device discovery/binding, re-thought for SPMD).
+
+The reference binds ONE GPU per executor process and time-shares it across
+tasks.  On TPU the idiomatic scaling unit is a `jax.sharding.Mesh` over
+all chips: a single SPMD program owns every device, and "executors" become
+mesh axis slices.  We expose one canonical data axis for partition
+parallelism; multi-host meshes come from jax.distributed initialization
+outside (DCN x ICI topology), which `make_mesh` honors by using the global
+device list.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(num_devices: Optional[int] = None,
+              axis_name: str = DATA_AXIS) -> Mesh:
+    devs = jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def data_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
+    """Leading-axis sharding: element i of the stacked batch lives on
+    device i of the data axis."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

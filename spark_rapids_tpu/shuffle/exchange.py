@@ -1,0 +1,132 @@
+"""Shuffle exchange (reference `GpuShuffleExchangeExec.scala` +
+`ShuffledBatchRDD.scala`).
+
+The local-mode exchange: every upstream partition's batches are split with
+the bound partitioner (device-side murmur3 + stable reorder + slice), and
+each downstream partition concatenates its slices.  This is the analog of
+the reference's default path (GPU partition -> serializer -> Spark netty
+shuffle -> deserialize); the accelerated multi-chip path lives in
+`parallel/collective_exchange.py` (ICI all-to-all under shard_map), and
+`shuffle/transport.py` defines the pluggable cross-host transport SPI.
+
+Also here: BroadcastExchangeExec (reference GpuBroadcastExchangeExec) —
+collects the build side once and hands the same batch to every consumer.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExecBase
+from spark_rapids_tpu.shuffle.partitioning import (
+    RangePartitioning, TpuPartitioning)
+from spark_rapids_tpu.utils import metrics as M
+
+
+class ShuffleExchangeExec(UnaryExecBase):
+    def __init__(self, partitioning: TpuPartitioning, child: TpuExec):
+        super().__init__(child)
+        self._schema = child.output_schema()
+        self.partitioning = partitioning.bind(self._schema)
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def describe(self):
+        return (f"ShuffleExchangeExec({type(self.partitioning).__name__}, "
+                f"n={self.partitioning.num_partitions})")
+
+    def _materialize(self) -> list[list[ColumnarBatch]]:
+        """Run the map side: split every input batch; bucket by target."""
+        part = self.partitioning
+        if isinstance(part, RangePartitioning) and part.bounds is None:
+            part.bounds = self._sample_bounds(part)
+        n = part.num_partitions
+        buckets: list[list[ColumnarBatch]] = [[] for _ in range(n)]
+        for it in self.child.execute_partitions():
+            for batch in it:
+                if batch.num_rows == 0:
+                    continue
+                with self.metrics.timed(M.TOTAL_TIME):
+                    slices = part.partition_batch(batch)
+                for p, s in enumerate(slices):
+                    if s is not None and s.num_rows > 0:
+                        buckets[p].append(s)
+                        self.metrics.add("dataSize", s.device_size_bytes())
+        return buckets
+
+    def _sample_bounds(self, part: RangePartitioning):
+        """Driver-side reservoir sampling for range bounds (reference
+        GpuRangePartitioner.sketch/SamplingUtils)."""
+        import numpy as np
+        samples = []
+        sample_rows = 0
+        target = 20 * part.num_partitions
+        for it in self.child.execute_partitions():
+            for batch in it:
+                if batch.num_rows == 0:
+                    continue
+                take = min(batch.num_rows, max(1, target //
+                                               max(1, part.num_partitions)))
+                idx = np.linspace(0, batch.num_rows - 1, take).astype(int)
+                keep = batch.slice(0, batch.num_rows)
+                samples.append(keep)
+                sample_rows += batch.num_rows
+                if sample_rows >= 4 * target:
+                    break
+        if not samples:
+            from spark_rapids_tpu.columnar.batch import empty_batch
+            return empty_batch(self._schema)
+        sample = concat_batches(samples)
+        return RangePartitioning.compute_bounds(
+            sample, part.order, part.num_partitions)
+
+    def execute_partitions(self):
+        buckets = self._materialize()
+
+        def reader(bs: list[ColumnarBatch]):
+            for b in bs:
+                self.metrics.add(M.NUM_OUTPUT_ROWS, b.num_rows)
+                self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+                yield b
+        return [reader(bs) for bs in buckets]
+
+    def execute_columnar(self):
+        for it in self.execute_partitions():
+            yield from it
+
+
+class BroadcastExchangeExec(UnaryExecBase):
+    """Collect the (small) build side once; every consumer gets the same
+    single batch (reference GpuBroadcastExchangeExec +
+    SerializeConcatHostBuffersDeserializeBatch semantics, minus the
+    torrent wire format)."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__(child)
+        self._schema = child.output_schema()
+        self._cached: Optional[ColumnarBatch] = None
+
+    def output_schema(self):
+        return self._schema
+
+    def broadcast_batch(self) -> ColumnarBatch:
+        if self._cached is None:
+            with self.metrics.timed("broadcastTime"):
+                batches = [b for it in self.child.execute_partitions()
+                           for b in it if b.num_rows > 0]
+                if batches:
+                    self._cached = concat_batches(batches)
+                else:
+                    from spark_rapids_tpu.columnar.batch import empty_batch
+                    self._cached = empty_batch(self._schema)
+                self.metrics.add("dataSize",
+                                 self._cached.device_size_bytes())
+        return self._cached
+
+    def execute_columnar(self):
+        yield self.broadcast_batch()
+
+    def execute_partitions(self):
+        return [self.execute_columnar()]

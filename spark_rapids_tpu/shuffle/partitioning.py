@@ -1,0 +1,255 @@
+"""TPU partitioners (reference `GpuHashPartitioning.scala`,
+`GpuRoundRobinPartitioning.scala`, `GpuSinglePartitioning.scala`,
+`GpuRangePartitioner.scala` + `GpuPartitioning.scala` contiguous split).
+
+Each partitioner computes per-row target partition ids on device, then
+`contiguous_split` stably reorders rows by partition and returns per-
+partition slices — the analog of cuDF's `Table.contiguousSplit` after a
+murmur3 partition kernel.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.vector import bucket_capacity
+from spark_rapids_tpu.exec.base import KernelCache, batch_signature, \
+    make_eval_context
+from spark_rapids_tpu.exprs.base import Expression
+from spark_rapids_tpu.ops.murmur3 import partition_ids
+from spark_rapids_tpu.ops.sort_encode import multi_key_argsort
+
+
+class TpuPartitioning:
+    num_partitions: int
+
+    def bind(self, schema: T.Schema) -> "TpuPartitioning":
+        return self
+
+    def partition_batch(self, batch: ColumnarBatch
+                        ) -> list[ColumnarBatch]:
+        """Split a batch into num_partitions batches (possibly empty)."""
+        raise NotImplementedError
+
+
+def _split_kernel_for(cache: KernelCache, batch: ColumnarBatch,
+                      pid_fn, num_partitions: int, extra_key=()):
+    """Shared: sort rows by partition id, count per partition."""
+    key = ("split", num_partitions, extra_key, batch_signature(batch))
+
+    def build():
+        cap = batch.capacity
+
+        @jax.jit
+        def kernel(columns, num_rows, salt):
+            ctx = make_eval_context(columns, cap, num_rows)
+            pids = pid_fn(ctx, salt)
+            pids = jnp.where(ctx.row_mask, pids, num_partitions)
+            # stable sort by pid: lexsort with row index implicit
+            order = jnp.argsort(pids, stable=True)
+            counts = jnp.bincount(
+                jnp.where(ctx.row_mask, pids, num_partitions),
+                length=num_partitions + 1)[:num_partitions]
+            valid = jnp.take(ctx.row_mask, order)
+            cols = [c.gather(order, valid) for c in columns]
+            return cols, counts
+
+        return kernel
+
+    return cache.get_or_build(key, build)
+
+
+def _slice_partitions(batch_cols, counts: np.ndarray, schema: T.Schema,
+                      total_cap: int) -> list[ColumnarBatch]:
+    """Host-side: cut the pid-sorted batch into per-partition batches."""
+    out = []
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    reordered = ColumnarBatch(schema, list(batch_cols), int(offsets[-1]))
+    for p in range(len(counts)):
+        n = int(counts[p])
+        if n == 0:
+            out.append(None)
+            continue
+        out.append(reordered.slice(int(offsets[p]), n))
+    return out
+
+
+@dataclasses.dataclass
+class HashPartitioning(TpuPartitioning):
+    """murmur3(keys) pmod n — bit-identical to Spark's HashPartitioning so
+    TPU and CPU stages can co-shuffle."""
+    exprs: Sequence[Expression]
+    num_partitions: int
+
+    def bind(self, schema):
+        b = HashPartitioning([e.bind(schema) for e in self.exprs],
+                             self.num_partitions)
+        b._cache = getattr(self, "_cache", KernelCache())
+        return b
+
+    def partition_batch(self, batch):
+        cache = getattr(self, "_cache", None)
+        if cache is None:
+            cache = self._cache = KernelCache()
+        bound = self.exprs
+        n = self.num_partitions
+
+        def pid_fn(ctx, salt):
+            keys = [e.eval(ctx) for e in bound]
+            return partition_ids(keys, n)
+
+        kern = _split_kernel_for(cache, batch, pid_fn, n, "hash")
+        cols, counts = kern(batch.columns, jnp.int32(batch.num_rows),
+                            jnp.int32(0))
+        return _slice_partitions(cols, np.asarray(counts), batch.schema,
+                                 batch.capacity)
+
+
+@dataclasses.dataclass
+class RoundRobinPartitioning(TpuPartitioning):
+    num_partitions: int
+
+    def bind(self, schema):
+        b = RoundRobinPartitioning(self.num_partitions)
+        b._cache = getattr(self, "_cache", KernelCache())
+        return b
+
+    def partition_batch(self, batch):
+        cache = getattr(self, "_cache", None)
+        if cache is None:
+            cache = self._cache = KernelCache()
+        n = self.num_partitions
+
+        def pid_fn(ctx, salt):
+            from jax import lax
+            return lax.rem(jnp.arange(ctx.capacity, dtype=jnp.int32) + salt,
+                           jnp.int32(n))
+
+        kern = _split_kernel_for(cache, batch, pid_fn, n, "rr")
+        salt = np.random.randint(0, n)  # start-partition randomization
+        cols, counts = kern(batch.columns, jnp.int32(batch.num_rows),
+                            jnp.int32(salt))
+        return _slice_partitions(cols, np.asarray(counts), batch.schema,
+                                 batch.capacity)
+
+
+@dataclasses.dataclass
+class SinglePartitioning(TpuPartitioning):
+    num_partitions: int = 1
+
+    def partition_batch(self, batch):
+        return [batch]
+
+
+@dataclasses.dataclass
+class RangePartitioning(TpuPartitioning):
+    """Driver-side reservoir-sampled bounds + per-row binary search
+    (reference GpuRangePartitioner/GpuRangePartitioning + SamplingUtils).
+
+    `bounds` are computed once from sampled child data via
+    `compute_bounds`; rows route to the first bound >= key.
+    """
+    order: Sequence  # list[SortOrder]
+    num_partitions: int
+    bounds: Optional[ColumnarBatch] = None  # (num_partitions-1) rows
+
+    def bind(self, schema):
+        from spark_rapids_tpu.exec.sort import SortOrder
+        b = RangePartitioning(
+            [SortOrder(o.expr.bind(schema), o.ascending, o.nulls_first)
+             for o in self.order],
+            self.num_partitions, self.bounds)
+        b._cache = getattr(self, "_cache", KernelCache())
+        return b
+
+    @staticmethod
+    def compute_bounds(sample: ColumnarBatch, order, num_partitions: int
+                       ) -> ColumnarBatch:
+        """Sort the sample and take evenly spaced split points."""
+        from spark_rapids_tpu.exec.basic import LocalBatchSource
+        from spark_rapids_tpu.exec.sort import SortExec
+        s = SortExec(order, LocalBatchSource([[sample]]))
+        srt = s.collect()
+        n = srt.num_rows
+        k = num_partitions - 1
+        if n == 0 or k <= 0:
+            return srt.slice(0, 0)
+        idx = [min(n - 1, max(0, int(round((i + 1) * n / num_partitions))))
+               for i in range(k)]
+        parts = [srt.slice(i, 1) for i in idx]
+        from spark_rapids_tpu.columnar.batch import concat_batches
+        return concat_batches(parts)
+
+    def partition_batch(self, batch):
+        assert self.bounds is not None, "compute_bounds first"
+        cache = getattr(self, "_cache", None)
+        if cache is None:
+            cache = self._cache = KernelCache()
+        n = self.num_partitions
+        order = self.order
+        # key columns of the bounds, aligned to batch capacity for compare
+        bounds = self.bounds
+        k = bounds.num_rows
+
+        def pid_fn(ctx, salt):
+            from spark_rapids_tpu.ops.sort_encode import encode_key_column
+            # composite comparison row-vs-bound via pairwise key compare:
+            # pid = number of bounds strictly less-or-equal... we compute
+            # rank by comparing against each bound (k is small: <= nparts)
+            keys = [o.expr.eval(ctx) for o in order]
+            pid = jnp.zeros(ctx.capacity, jnp.int32)
+            for bi in range(k):
+                le = _row_less_than_bound(keys, bounds, bi, order)
+                # row > bound_bi -> belongs at least to partition bi+1
+                pid = jnp.where(le, pid, jnp.int32(bi + 1))
+            return pid
+
+        kern = _split_kernel_for(cache, batch, pid_fn, n,
+                                 ("range", k, id(self.bounds)))
+        cols, counts = kern(batch.columns, jnp.int32(batch.num_rows),
+                            jnp.int32(0))
+        return _slice_partitions(cols, np.asarray(counts), batch.schema,
+                                 batch.capacity)
+
+
+def _row_less_than_bound(keys, bounds: ColumnarBatch, bi: int, order
+                         ) -> jnp.ndarray:
+    """row <= bound_bi under the sort order (null ordering included)."""
+    from spark_rapids_tpu.exprs.predicates import _compare
+    cap = keys[0].capacity
+    lt_all = jnp.zeros(cap, bool)
+    eq_all = jnp.ones(cap, bool)
+    for key_col, o, bcol in zip(keys, order, bounds.columns):
+        bv = bcol.slice_row_broadcast(bi, cap) if hasattr(
+            bcol, "slice_row_broadcast") else None
+        if bv is None:
+            bv = _broadcast_row(bcol, bi, cap)
+        lt, eq = _compare(key_col, bv)
+        if not o.ascending:
+            lt = ~(lt | eq)
+        # null handling: null vs value ordering by nulls_first
+        knull = ~key_col.validity
+        bnull = ~bv.validity
+        nf = o.resolved_nulls_first
+        lt = jnp.where(knull & ~bnull, nf, lt)
+        lt = jnp.where(~knull & bnull, not nf, lt)
+        eqv = jnp.where(knull | bnull, knull & bnull, eq)
+        lt_all = lt_all | (eq_all & lt)
+        eq_all = eq_all & eqv
+    return lt_all | eq_all
+
+
+def _broadcast_row(col, row: int, cap: int):
+    from spark_rapids_tpu.columnar.vector import ColumnVector
+    data = jnp.broadcast_to(col.data[row:row + 1], (cap,) +
+                            col.data.shape[1:])
+    validity = jnp.broadcast_to(col.validity[row:row + 1], (cap,))
+    lengths = None if col.lengths is None else jnp.broadcast_to(
+        col.lengths[row:row + 1], (cap,))
+    return ColumnVector(col.dtype, data, validity, lengths)

@@ -217,3 +217,26 @@ def test_cast_date_string_roundtrip():
         LocalBatchSource([[b]])).collect()
     assert out.column("d").to_pylist(4) == [
         "2020-02-29", "1969-12-31", None, None]
+
+
+def test_cast_string_to_int_overflow_is_null():
+    from spark_rapids_tpu import types as T
+    b = ColumnarBatch.from_numpy(
+        {"s": np.array(["9223372036854775807", "9223372036854775808",
+                        "-9223372036854775808", "-9223372036854775809",
+                        "9999999999999999999", "00000000000000000042"],
+                       dtype=object)})
+    out = ProjectExec([col("s").cast(T.INT64).alias("v")],
+                      LocalBatchSource([[b]])).collect()
+    assert out.column("v").to_pylist(6) == [
+        2**63 - 1, None, -2**63, None, None, 42]
+
+
+def test_cast_string_to_date_impossible_dates_null():
+    from spark_rapids_tpu import types as T
+    b = ColumnarBatch.from_numpy(
+        {"s": np.array(["2021-02-31", "2021-04-31", "2020-02-29",
+                        "2021-02-29"], dtype=object)})
+    out = ProjectExec([col("s").cast(T.DATE32).cast(T.STRING).alias("d")],
+                      LocalBatchSource([[b]])).collect()
+    assert out.column("d").to_pylist(4) == [None, None, "2020-02-29", None]

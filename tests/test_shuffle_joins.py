@@ -1,0 +1,363 @@
+"""Partitioning, exchange, and join tests.
+
+Murmur3 is validated against a pure-Python implementation of Spark's
+Murmur3Hash spec (hashInt/hashLong/hashUnsafeBytes, seed 42).  Joins are
+validated against pandas merges.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.exec.basic import LocalBatchSource, ProjectExec
+from spark_rapids_tpu.exec.joins import (
+    CartesianProductExec, HashJoinExec, JoinType, NestedLoopJoinExec)
+from spark_rapids_tpu.exec.sort import asc
+from spark_rapids_tpu.exprs.base import col, lit
+from spark_rapids_tpu.shuffle.exchange import (
+    BroadcastExchangeExec, ShuffleExchangeExec)
+from spark_rapids_tpu.shuffle.partitioning import (
+    HashPartitioning, RangePartitioning, RoundRobinPartitioning,
+    SinglePartitioning)
+
+
+# --- pure-python Spark Murmur3 reference -----------------------------------
+def _m(x):
+    return x & 0xFFFFFFFF
+
+
+def _rotl(x, r):
+    return _m((x << r) | (x >> (32 - r)))
+
+
+def _mix_k1(k1):
+    k1 = _m(k1 * 0xCC9E2D51)
+    k1 = _rotl(k1, 15)
+    return _m(k1 * 0x1B873593)
+
+
+def _mix_h1(h1, k1):
+    h1 = h1 ^ _mix_k1(k1) if False else h1 ^ k1
+    h1 = _rotl(h1, 13)
+    return _m(h1 * 5 + 0xE6546B64)
+
+
+def _fmix(h1, length):
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = _m(h1 * 0x85EBCA6B)
+    h1 ^= h1 >> 13
+    h1 = _m(h1 * 0xC2B2AE35)
+    h1 ^= h1 >> 16
+    return h1
+
+
+def py_hash_int(v, seed):
+    return _fmix(_mix_h1(seed, _mix_k1(_m(v))), 4)
+
+
+def py_hash_long(v, seed):
+    lo = _m(v)
+    hi = _m(v >> 32)
+    h1 = _mix_h1(seed, _mix_k1(lo))
+    h1 = _mix_h1(h1, _mix_k1(hi))
+    return _fmix(h1, 8)
+
+
+def py_hash_bytes(bs: bytes, seed):
+    h1 = seed
+    aligned = len(bs) - len(bs) % 4
+    for i in range(0, aligned, 4):
+        word = int.from_bytes(bs[i:i + 4], "little")
+        h1 = _mix_h1(h1, _mix_k1(word))
+    for i in range(aligned, len(bs)):
+        b = bs[i]
+        sb = b - 256 if b >= 128 else b  # signed byte
+        h1 = _mix_h1(h1, _mix_k1(_m(sb)))
+    return _fmix(h1, len(bs))
+
+
+def _i32(u):
+    return u - (1 << 32) if u >= (1 << 31) else u
+
+
+def test_murmur3_int_parity():
+    from spark_rapids_tpu.ops.murmur3 import murmur3_row_hash
+    vals = np.array([0, 1, -1, 42, 2**31 - 1, -(2**31)], np.int32)
+    b = ColumnarBatch.from_numpy({"x": vals})
+    got = np.asarray(murmur3_row_hash([b.column("x")]))[:6]
+    exp = [_i32(py_hash_int(int(v), 42)) for v in vals]
+    assert got.tolist() == exp
+
+
+def test_murmur3_long_parity():
+    from spark_rapids_tpu.ops.murmur3 import murmur3_row_hash
+    vals = np.array([0, 1, -1, 2**62, -(2**62), 123456789012345],
+                    np.int64)
+    b = ColumnarBatch.from_numpy({"x": vals})
+    got = np.asarray(murmur3_row_hash([b.column("x")]))[:6]
+    exp = [_i32(py_hash_long(int(v) & 0xFFFFFFFFFFFFFFFF, 42))
+           for v in vals]
+    assert got.tolist() == exp
+
+
+def test_murmur3_string_parity():
+    from spark_rapids_tpu.ops.murmur3 import murmur3_row_hash
+    vals = np.array(["", "a", "ab", "abc", "abcd", "abcde",
+                     "hello world", "héllo…"], dtype=object)
+    b = ColumnarBatch.from_numpy({"x": vals})
+    got = np.asarray(murmur3_row_hash([b.column("x")]))[:len(vals)]
+    exp = [_i32(py_hash_bytes(v.encode("utf-8"), 42)) for v in vals]
+    assert got.tolist() == exp
+
+
+def test_murmur3_double_parity():
+    import struct
+    from spark_rapids_tpu.ops.murmur3 import murmur3_row_hash
+    # subnormals excluded: XLA FTZ flushes them (documented divergence)
+    vals = np.array([0.0, 1.0, -1.5, 3.141592653589793, 1e300, -1e-300,
+                     np.inf, -np.inf, np.nan])
+    b = ColumnarBatch.from_numpy({"x": vals})
+    got = np.asarray(murmur3_row_hash([b.column("x")]))[:len(vals)]
+    exp = []
+    for v in vals:
+        if np.isnan(v):
+            bits = 0x7FF8000000000000
+        else:
+            vv = 0.0 if v == 0.0 else v
+            bits = struct.unpack("<Q", struct.pack("<d", vv))[0]
+        exp.append(_i32(py_hash_long(bits, 42)))
+    assert got.tolist() == exp
+
+
+def test_murmur3_multi_column_chain_and_nulls():
+    from spark_rapids_tpu.ops.murmur3 import murmur3_row_hash
+    b = ColumnarBatch.from_numpy(
+        {"a": np.array([1, 2], np.int32),
+         "s": np.array(["x", "y"], dtype=object)},
+        validity={"a": np.array([True, False])})
+    got = np.asarray(murmur3_row_hash([b.column("a"), b.column("s")]))[:2]
+    # row 0: chain a then s; row 1: a is null -> seed passes through
+    e0 = py_hash_bytes(b"x", py_hash_int(1, 42))
+    e1 = py_hash_bytes(b"y", 42)
+    assert got.tolist() == [_i32(e0), _i32(e1)]
+
+
+# --- partitioning / exchange ------------------------------------------------
+def test_hash_partition_roundtrip(rng):
+    df = pd.DataFrame({"k": rng.integers(0, 1000, 500).astype(np.int64),
+                       "v": rng.normal(size=500)})
+    src = LocalBatchSource.from_pandas(df, num_partitions=3)
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], 4), src)
+    parts = ex.execute_partitions()
+    seen = []
+    for p, it in enumerate(parts):
+        for b in it:
+            ks = b.column("k").to_pylist(b.num_rows)
+            seen.extend(ks)
+            # co-partitioning invariant: same key -> same partition
+    assert sorted(seen) == sorted(df["k"].tolist())
+    # determinism: same key always lands in the same partition
+    ex2 = ShuffleExchangeExec(HashPartitioning([col("k")], 4),
+                              LocalBatchSource.from_pandas(df))
+    sets1 = [set() for _ in range(4)]
+    for p, it in enumerate(ex2.execute_partitions()):
+        for b in it:
+            sets1[p].update(b.column("k").to_pylist(b.num_rows))
+    for i in range(4):
+        for j in range(4):
+            if i != j:
+                assert not (sets1[i] & sets1[j])
+
+
+def test_round_robin_partition(rng):
+    df = pd.DataFrame({"v": np.arange(100, dtype=np.int64)})
+    ex = ShuffleExchangeExec(RoundRobinPartitioning(3),
+                             LocalBatchSource.from_pandas(df))
+    rows = 0
+    for it in ex.execute_partitions():
+        for b in it:
+            rows += b.num_rows
+    assert rows == 100
+
+
+def test_range_partition_ordered(rng):
+    df = pd.DataFrame({"k": rng.permutation(1000).astype(np.int64)})
+    ex = ShuffleExchangeExec(
+        RangePartitioning([asc(col("k"))], 4),
+        LocalBatchSource.from_pandas(df, num_partitions=2))
+    parts = ex.execute_partitions()
+    maxes = []
+    all_vals = []
+    for it in parts:
+        vals = []
+        for b in it:
+            vals.extend(b.column("k").to_pylist(b.num_rows))
+        if vals:
+            maxes.append((min(vals), max(vals)))
+            all_vals.extend(vals)
+    assert sorted(all_vals) == list(range(1000))
+    # ranges must not overlap
+    for (lo1, hi1), (lo2, hi2) in zip(maxes, maxes[1:]):
+        assert hi1 < lo2
+
+
+# --- joins ------------------------------------------------------------------
+def _join_dfs(rng):
+    left = pd.DataFrame({
+        "k": rng.integers(0, 20, 60).astype(np.int64),
+        "lv": np.arange(60, dtype=np.int64)})
+    right = pd.DataFrame({
+        "k2": rng.integers(0, 20, 40).astype(np.int64),
+        "rv": np.arange(100, 140, dtype=np.int64)})
+    return left, right
+
+
+def _run_join(jt, left, right, rng=None, **kw):
+    plan = HashJoinExec(jt, [col("k")], [col("k2")],
+                        LocalBatchSource.from_pandas(left,
+                                                     num_partitions=2),
+                        LocalBatchSource.from_pandas(right,
+                                                     num_partitions=2),
+                        **kw)
+    return plan.to_pandas()
+
+
+def test_inner_join_parity(rng):
+    left, right = _join_dfs(rng)
+    got = _run_join(JoinType.INNER, left, right)
+    exp = left.merge(right, left_on="k", right_on="k2")
+    key = lambda d: sorted(map(tuple, d[["k", "lv", "k2", "rv"]].values))
+    assert key(got) == key(exp)
+
+
+def test_left_outer_join_parity(rng):
+    left, right = _join_dfs(rng)
+    got = _run_join(JoinType.LEFT_OUTER, left, right)
+    exp = left.merge(right, left_on="k", right_on="k2", how="left")
+    assert len(got) == len(exp)
+    gm = got[got["rv"].notna()]
+    em = exp[exp["rv"].notna()]
+    key = lambda d: sorted(map(tuple, d[["k", "lv", "rv"]].astype(
+        np.int64).values))
+    assert key(gm) == key(em)
+    # unmatched
+    assert sorted(got[got["rv"].isna()]["lv"]) == \
+        sorted(exp[exp["rv"].isna()]["lv"])
+
+
+def test_right_outer_join_parity(rng):
+    left, right = _join_dfs(rng)
+    # restrict key ranges so both sides have unmatched rows
+    right = right.assign(k2=right["k2"] + 10)
+    got = _run_join(JoinType.RIGHT_OUTER, left, right)
+    exp = left.merge(right, left_on="k", right_on="k2", how="right")
+    assert len(got) == len(exp)
+    assert sorted(got[got["lv"].isna()]["rv"]) == \
+        sorted(exp[exp["lv"].isna()]["rv"])
+
+
+def test_full_outer_join_parity(rng):
+    left, right = _join_dfs(rng)
+    right = right.assign(k2=right["k2"] + 10)
+    got = _run_join(JoinType.FULL_OUTER, left, right)
+    exp = left.merge(right, left_on="k", right_on="k2", how="outer")
+    assert len(got) == len(exp)
+    assert sorted(got[got["rv"].isna()]["lv"]) == \
+        sorted(exp[exp["rv"].isna()]["lv"])
+    assert sorted(got[got["lv"].isna()]["rv"]) == \
+        sorted(exp[exp["lv"].isna()]["rv"])
+
+
+def test_semi_anti_join(rng):
+    left, right = _join_dfs(rng)
+    semi = _run_join(JoinType.LEFT_SEMI, left, right)
+    anti = _run_join(JoinType.LEFT_ANTI, left, right)
+    rkeys = set(right["k2"])
+    exp_semi = left[left["k"].isin(rkeys)]
+    exp_anti = left[~left["k"].isin(rkeys)]
+    assert sorted(semi["lv"]) == sorted(exp_semi["lv"])
+    assert sorted(anti["lv"]) == sorted(exp_anti["lv"])
+    assert len(semi) + len(anti) == len(left)
+
+
+def test_join_null_keys_never_match():
+    lb = ColumnarBatch.from_numpy(
+        {"k": np.array([1, 2, 3], np.int64),
+         "lv": np.array([10, 20, 30], np.int64)},
+        validity={"k": np.array([True, False, True])})
+    rb = ColumnarBatch.from_numpy(
+        {"k2": np.array([1, 2], np.int64),
+         "rv": np.array([100, 200], np.int64)},
+        validity={"k2": np.array([True, False])})
+    plan = HashJoinExec(JoinType.INNER, [col("k")], [col("k2")],
+                        LocalBatchSource([[lb]]), LocalBatchSource([[rb]]))
+    out = plan.collect()
+    assert out.num_rows == 1
+    assert out.column("lv").to_pylist(1) == [10]
+    # left outer: null-keyed left rows appear with null right side
+    plan2 = HashJoinExec(JoinType.LEFT_OUTER, [col("k")], [col("k2")],
+                         LocalBatchSource([[lb]]), LocalBatchSource([[rb]]))
+    out2 = plan2.collect()
+    assert out2.num_rows == 3
+
+
+def test_join_duplicate_keys_expand(rng):
+    left = pd.DataFrame({"k": np.array([1, 1, 2], np.int64),
+                         "lv": np.array([0, 1, 2], np.int64)})
+    right = pd.DataFrame({"k2": np.array([1, 1, 1, 2], np.int64),
+                          "rv": np.array([5, 6, 7, 8], np.int64)})
+    got = _run_join(JoinType.INNER, left, right)
+    assert len(got) == 7  # 2*3 + 1*1
+
+
+def test_inner_join_with_condition(rng):
+    left, right = _join_dfs(rng)
+    got = HashJoinExec(
+        JoinType.INNER, [col("k")], [col("k2")],
+        LocalBatchSource.from_pandas(left),
+        LocalBatchSource.from_pandas(right),
+        condition=col("lv") > col("rv") - lit(110)).to_pandas()
+    exp = left.merge(right, left_on="k", right_on="k2")
+    exp = exp[exp["lv"] > exp["rv"] - 110]
+    assert len(got) == len(exp)
+
+
+def test_broadcast_hash_join(rng):
+    left, right = _join_dfs(rng)
+    from spark_rapids_tpu.exec.joins import BroadcastHashJoinExec
+    bc = BroadcastExchangeExec(LocalBatchSource.from_pandas(right))
+    plan = BroadcastHashJoinExec(
+        JoinType.INNER, [col("k")], [col("k2")],
+        LocalBatchSource.from_pandas(left, num_partitions=3), bc)
+    got = plan.to_pandas()
+    exp = left.merge(right, left_on="k", right_on="k2")
+    assert len(got) == len(exp)
+
+
+def test_cartesian_product():
+    a = LocalBatchSource.from_pandas(
+        pd.DataFrame({"x": np.array([1, 2, 3], np.int64)}))
+    b = LocalBatchSource.from_pandas(
+        pd.DataFrame({"y": np.array([10, 20], np.int64)}))
+    out = CartesianProductExec(a, b).to_pandas()
+    assert len(out) == 6
+    assert sorted(map(tuple, out.values)) == sorted(
+        (x, y) for x in [1, 2, 3] for y in [10, 20])
+
+
+def test_shuffled_join_pipeline(rng):
+    """exchange -> join, the config-3 shape (TPC-H q3-like)."""
+    left, right = _join_dfs(rng)
+    lsrc = ShuffleExchangeExec(
+        HashPartitioning([col("k")], 4),
+        LocalBatchSource.from_pandas(left, num_partitions=2))
+    rsrc = ShuffleExchangeExec(
+        HashPartitioning([col("k2")], 4),
+        LocalBatchSource.from_pandas(right, num_partitions=2))
+    plan = HashJoinExec(JoinType.INNER, [col("k")], [col("k2")],
+                        lsrc, rsrc)
+    got = plan.to_pandas()
+    exp = left.merge(right, left_on="k", right_on="k2")
+    assert len(got) == len(exp)
