@@ -1,0 +1,330 @@
+"""Datetime expressions (reference `datetimeExpressions.scala` 560 LoC +
+`DateUtils.scala`).
+
+All timestamp math is UTC-only, the same guard the reference enforces
+(`GpuOverrides.scala:397-409` rejects non-UTC JVM timezones).  Civil-date
+arithmetic comes from exprs/datetime_utils.py (vectorized Hinnant
+algorithms — pure int ops, fully fused by XLA).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import ColumnVector
+from spark_rapids_tpu.exprs import datetime_utils as DT
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, Expression, Literal, UnaryExpression)
+
+
+def _as_days(c: ColumnVector):
+    if c.dtype.id == T.TypeId.DATE32:
+        return c.data
+    if c.dtype.id == T.TypeId.TIMESTAMP_US:
+        return DT.micros_to_date_days(c.data)
+    raise TypeError(f"expected date/timestamp, got {c.dtype}")
+
+
+@dataclasses.dataclass(eq=False)
+class _DateField(UnaryExpression):
+    child: Expression
+
+    def data_type(self, schema):
+        return T.INT32
+
+    def do_columnar(self, c, ctx):
+        days = _as_days(c)
+        return ColumnVector(T.INT32,
+                            self.field(days).astype(jnp.int32), c.validity)
+
+
+class Year(_DateField):
+    def field(self, days):
+        y, _, _ = DT.days_to_ymd(days)
+        return y
+
+
+class Month(_DateField):
+    def field(self, days):
+        _, m, _ = DT.days_to_ymd(days)
+        return m
+
+
+class DayOfMonth(_DateField):
+    def field(self, days):
+        _, _, d = DT.days_to_ymd(days)
+        return d
+
+
+class DayOfWeek(_DateField):
+    def field(self, days):
+        return DT.day_of_week(days)
+
+
+class DayOfYear(_DateField):
+    def field(self, days):
+        return DT.day_of_year(days)
+
+
+class Quarter(_DateField):
+    def field(self, days):
+        return DT.quarter(days)
+
+
+class WeekOfYear(_DateField):
+    """ISO-8601 week number (Spark weekofyear)."""
+
+    def field(self, days):
+        doy = DT.day_of_year(days)
+        # ISO day-of-week: Mon=1..Sun=7 ; our day_of_week: Sun=1..Sat=7
+        dow_sun1 = DT.day_of_week(days)
+        iso_dow = jnp.where(dow_sun1 == 1, 7, dow_sun1 - 1)
+        w = (doy - iso_dow + 10) // 7
+        y, _, _ = DT.days_to_ymd(days)
+        # w == 0 -> last week of previous year
+        prev_dec31 = DT.ymd_to_days(y - 1, jnp.full_like(y, 12),
+                                    jnp.full_like(y, 31))
+        prev_w = ((DT.day_of_year(prev_dec31)
+                   - jnp.where(DT.day_of_week(prev_dec31) == 1, 7,
+                               DT.day_of_week(prev_dec31) - 1) + 10) // 7)
+        # w == 53 but Dec 28 rule says week 1 of next year
+        dec28 = DT.ymd_to_days(y, jnp.full_like(y, 12),
+                               jnp.full_like(y, 28))
+        max_w = ((DT.day_of_year(dec28)
+                  - jnp.where(DT.day_of_week(dec28) == 1, 7,
+                              DT.day_of_week(dec28) - 1) + 10) // 7)
+        out = jnp.where(w < 1, prev_w, jnp.where(w > max_w, 1, w))
+        return out
+
+
+class LastDay(_DateField):
+    def data_type(self, schema):
+        return T.DATE32
+
+    def do_columnar(self, c, ctx):
+        days = _as_days(c)
+        return ColumnVector(T.DATE32, DT.last_day_of_month(days),
+                            c.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class _TimeField(UnaryExpression):
+    child: Expression
+
+    def data_type(self, schema):
+        return T.INT32
+
+    def do_columnar(self, c, ctx):
+        assert c.dtype.id == T.TypeId.TIMESTAMP_US, \
+            f"expected timestamp, got {c.dtype}"
+        h, mnt, s, us = DT.micros_time_of_day(c.data)
+        return ColumnVector(T.INT32,
+                            self.pick(h, mnt, s, us).astype(jnp.int32),
+                            c.validity)
+
+
+class Hour(_TimeField):
+    def pick(self, h, mnt, s, us):
+        return h
+
+
+class Minute(_TimeField):
+    def pick(self, h, mnt, s, us):
+        return mnt
+
+
+class Second(_TimeField):
+    def pick(self, h, mnt, s, us):
+        return s
+
+
+@dataclasses.dataclass(eq=False)
+class DateAdd(BinaryExpression):
+    left: Expression   # date
+    right: Expression  # days to add (int)
+
+    def data_type(self, schema):
+        return T.DATE32
+
+    def do_columnar(self, l, r, ctx):
+        days = _as_days(l) + r.data.astype(jnp.int32)
+        return ColumnVector(T.DATE32, days.astype(jnp.int32),
+                            l.validity & r.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class DateSub(BinaryExpression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return T.DATE32
+
+    def do_columnar(self, l, r, ctx):
+        days = _as_days(l) - r.data.astype(jnp.int32)
+        return ColumnVector(T.DATE32, days.astype(jnp.int32),
+                            l.validity & r.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class DateDiff(BinaryExpression):
+    """datediff(end, start) in days."""
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return T.INT32
+
+    def do_columnar(self, l, r, ctx):
+        d = _as_days(l) - _as_days(r)
+        return ColumnVector(T.INT32, d.astype(jnp.int32),
+                            l.validity & r.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class AddMonths(BinaryExpression):
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return T.DATE32
+
+    def do_columnar(self, l, r, ctx):
+        y, m, d = DT.days_to_ymd(_as_days(l))
+        total = (y * 12 + (m - 1)) + r.data.astype(jnp.int64)
+        ny = total // 12
+        nm = total - ny * 12 + 1
+        # clamp day to last day of target month (Spark/Java semantics)
+        first = DT.ymd_to_days(ny, nm, jnp.ones_like(nm))
+        last = DT.last_day_of_month(first)
+        _, _, last_d = DT.days_to_ymd(last)
+        nd = jnp.minimum(d, last_d)
+        out = DT.ymd_to_days(ny, nm, nd)
+        return ColumnVector(T.DATE32, out, l.validity & r.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class MonthsBetween(BinaryExpression):
+    """months_between(end, start): Spark semantics — whole months when
+    both are the same day-of-month (and same time) or both the last day
+    of their month; otherwise months + (day+time difference)/31, rounded
+    to 8 decimals (roundOff=true default)."""
+    left: Expression
+    right: Expression
+
+    def data_type(self, schema):
+        return T.FLOAT64
+
+    @staticmethod
+    def _sec_of_day(c):
+        if c.dtype.id == T.TypeId.TIMESTAMP_US:
+            days = DT.micros_to_date_days(c.data)
+            tod = c.data - days.astype(jnp.int64) * DT.MICROS_PER_DAY
+            return tod.astype(jnp.float64) / DT.MICROS_PER_SECOND
+        return jnp.zeros(c.capacity, jnp.float64)
+
+    def do_columnar(self, l, r, ctx):
+        y1, m1, d1 = DT.days_to_ymd(_as_days(l))
+        y2, m2, d2 = DT.days_to_ymd(_as_days(r))
+        s1 = self._sec_of_day(l)
+        s2 = self._sec_of_day(r)
+        _, _, ld1 = DT.days_to_ymd(DT.last_day_of_month(_as_days(l)))
+        _, _, ld2 = DT.days_to_ymd(DT.last_day_of_month(_as_days(r)))
+        both_last = (d1 == ld1) & (d2 == ld2)
+        same_point = (d1 == d2) & (s1 == s2)
+        months = ((y1 - y2) * 12 + (m1 - m2)).astype(jnp.float64)
+        frac = ((d1 - d2).astype(jnp.float64)
+                + (s1 - s2) / 86400.0) / 31.0
+        out = jnp.where(both_last | same_point, months, months + frac)
+        out = jnp.round(out * 1e8) / 1e8  # roundOff=true
+        return ColumnVector(T.FLOAT64, out, l.validity & r.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class UnixTimestamp(UnaryExpression):
+    """unix_timestamp(ts): seconds since epoch (UTC)."""
+    child: Expression
+
+    def data_type(self, schema):
+        return T.INT64
+
+    def do_columnar(self, c, ctx):
+        if c.dtype.id == T.TypeId.TIMESTAMP_US:
+            secs = c.data // DT.MICROS_PER_SECOND
+        elif c.dtype.id == T.TypeId.DATE32:
+            secs = c.data.astype(jnp.int64) * 86400
+        else:
+            raise TypeError(
+                "unix_timestamp on strings requires a format parse; only "
+                "date/timestamp inputs are device-native")
+        return ColumnVector(T.INT64, secs.astype(jnp.int64), c.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class FromUnixTime(UnaryExpression):
+    """from_unixtime(secs) -> timestamp (the reference emits a formatted
+    string; we expose the timestamp — cast to STRING for the text form)."""
+    child: Expression
+
+    def data_type(self, schema):
+        return T.TIMESTAMP_US
+
+    def do_columnar(self, c, ctx):
+        us = c.data.astype(jnp.int64) * DT.MICROS_PER_SECOND
+        return ColumnVector(T.TIMESTAMP_US, us, c.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class ToDate(UnaryExpression):
+    child: Expression
+
+    def data_type(self, schema):
+        return T.DATE32
+
+    def do_columnar(self, c, ctx):
+        if c.dtype.id == T.TypeId.DATE32:
+            return c
+        if c.dtype.id == T.TypeId.TIMESTAMP_US:
+            return ColumnVector(T.DATE32, DT.micros_to_date_days(c.data),
+                                c.validity)
+        from spark_rapids_tpu.exprs.cast import _string_to_date
+        return _string_to_date(c)
+
+
+@dataclasses.dataclass(eq=False)
+class TruncDate(Expression):
+    """trunc(date, fmt) for fmt in year/month/week."""
+    child: Expression
+    fmt: Expression
+
+    def data_type(self, schema):
+        return T.DATE32
+
+    def children(self):
+        return (self.child, self.fmt)
+
+    def with_children(self, kids):
+        return TruncDate(*kids)
+
+    def eval(self, ctx):
+        if not isinstance(self.fmt, Literal):
+            raise TypeError("trunc requires a literal format")
+        c = self.child.eval(ctx)
+        days = _as_days(c)
+        f = str(self.fmt.value).lower()
+        y, m, d = DT.days_to_ymd(days)
+        if f in ("year", "yyyy", "yy"):
+            out = DT.ymd_to_days(y, jnp.ones_like(m), jnp.ones_like(d))
+        elif f in ("month", "mon", "mm"):
+            out = DT.ymd_to_days(y, m, jnp.ones_like(d))
+        elif f == "week":
+            # Monday of the current week
+            dow_sun1 = DT.day_of_week(days)
+            iso = jnp.where(dow_sun1 == 1, 7, dow_sun1 - 1)
+            out = (days.astype(jnp.int64) - (iso - 1)).astype(jnp.int32)
+        else:
+            raise ValueError(f"unsupported trunc format {f!r}")
+        return ColumnVector(T.DATE32, out, c.validity)

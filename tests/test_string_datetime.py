@@ -1,0 +1,252 @@
+"""String + datetime expression parity tests vs Python/pandas golden."""
+import datetime as pydt
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.basic import LocalBatchSource, ProjectExec
+from spark_rapids_tpu.exprs import string_fns as S
+from spark_rapids_tpu.exprs import datetime_exprs as D
+from spark_rapids_tpu.exprs.base import col, lit
+
+
+def _sb(vals):
+    return LocalBatchSource([[ColumnarBatch.from_numpy(
+        {"s": np.array(vals, dtype=object)})]])
+
+
+def _proj1(expr, src):
+    out = ProjectExec([expr.alias("r")], src).collect()
+    return out.column("r").to_pylist(out.num_rows)
+
+
+def test_length_utf8_chars():
+    got = _proj1(S.Length(col("s")),
+                 _sb(["", "abc", "héllo", "日本語", None]))
+    assert got == [0, 3, 5, 3, None]
+
+
+def test_upper_lower_initcap():
+    src = _sb(["Hello World", "ALL CAPS", "mixedCase"])
+    assert _proj1(S.Upper(col("s")), src) == [
+        "HELLO WORLD", "ALL CAPS", "MIXEDCASE"]
+    assert _proj1(S.Lower(col("s")), src) == [
+        "hello world", "all caps", "mixedcase"]
+    assert _proj1(S.InitCap(col("s")), src) == [
+        "Hello World", "All Caps", "Mixedcase"]
+
+
+def test_substring():
+    src = _sb(["hello", "h", "", "héllo"])
+    assert _proj1(S.Substring(col("s"), lit(2), lit(3)), src) == \
+        ["ell", "", "", "éll"]
+    assert _proj1(S.Substring(col("s"), lit(-3), lit(2)), src) == \
+        ["ll", "", "", "ll"]
+    assert _proj1(S.Substring(col("s"), lit(1)), src) == \
+        ["hello", "h", "", "héllo"]
+    assert _proj1(S.Substring(col("s"), lit(0), lit(2)), src) == \
+        ["he", "h", "", "hé"]
+
+
+def test_trim_variants():
+    src = _sb(["  hi  ", "hi", "   ", ""])
+    assert _proj1(S.StringTrim(col("s")), src) == ["hi", "hi", "", ""]
+    assert _proj1(S.StringTrimLeft(col("s")), src) == \
+        ["hi  ", "hi", "", ""]
+    assert _proj1(S.StringTrimRight(col("s")), src) == \
+        ["  hi", "hi", "", ""]
+
+
+def test_concat():
+    b = ColumnarBatch.from_numpy({
+        "a": np.array(["foo", "", None], dtype=object),
+        "b": np.array(["bar", "x", "y"], dtype=object)})
+    src = LocalBatchSource([[b]])
+    got = _proj1(S.ConcatStrings((col("a"), lit("-"), col("b"))), src)
+    assert got == ["foo-bar", "-x", None]
+
+
+def test_startswith_endswith_contains():
+    src = _sb(["foobar", "barfoo", "foo", "fo", ""])
+    assert _proj1(S.StartsWith(col("s"), lit("foo")), src) == \
+        [True, False, True, False, False]
+    assert _proj1(S.EndsWith(col("s"), lit("foo")), src) == \
+        [False, True, True, False, False]
+    assert _proj1(S.Contains(col("s"), lit("foo")), src) == \
+        [True, True, True, False, False]
+    assert _proj1(S.Contains(col("s"), lit("")), src) == [True] * 5
+
+
+def test_like():
+    src = _sb(["hello", "help", "yelp", "hel", "hello!"])
+    assert _proj1(S.Like(col("s"), lit("hel%")), src) == \
+        [True, True, False, True, True]
+    assert _proj1(S.Like(col("s"), lit("%el_")), src) == \
+        [False, True, True, False, False]
+    assert _proj1(S.Like(col("s"), lit("hello")), src) == \
+        [True, False, False, False, False]
+    assert _proj1(S.Like(col("s"), lit("%l%o%")), src) == \
+        [True, False, False, False, True]
+
+
+def test_locate():
+    src = _sb(["hello", "lolo", "", "xxlo"])
+    assert _proj1(S.StringLocate(lit("lo"), col("s")), src) == \
+        [4, 1, 0, 3]
+    assert _proj1(S.StringLocate(lit("lo"), col("s"), lit(2)), src) == \
+        [4, 3, 0, 3]
+
+
+def test_replace():
+    src = _sb(["aaa", "abcabc", "", "xyz"])
+    assert _proj1(S.StringReplace(col("s"), lit("a"), lit("bb")), src) == \
+        ["bbbbbb", "bbbcbbbc", "", "xyz"]
+    assert _proj1(S.StringReplace(col("s"), lit("abc"), lit("")), src) == \
+        ["aaa", "", "", "xyz"]
+    # overlapping: greedy left-to-right
+    src2 = _sb(["aaaa"])
+    assert _proj1(S.StringReplace(col("s"), lit("aa"), lit("b")), src2) == \
+        ["bb"]
+
+
+def test_pad():
+    src = _sb(["hi", "longer", ""])
+    assert _proj1(S.LPad(col("s"), lit(5), lit("*")), src) == \
+        ["***hi", "longe", "*****"]
+    assert _proj1(S.RPad(col("s"), lit(5), lit("ab")), src) == \
+        ["hiaba", "longe", "ababa"]
+
+
+def test_rlike_literal_only():
+    src = _sb(["abc"])
+    assert _proj1(S.RLike(col("s"), lit("b")), src) == [True]
+    with pytest.raises(TypeError):
+        S.RLike(col("s"), lit("a.*b"))
+
+
+# --- datetime ---------------------------------------------------------------
+def _us(dt: pydt.datetime) -> int:
+    """Exact integer microseconds since epoch (no float round trip)."""
+    return (dt - pydt.datetime(1970, 1, 1)) // pydt.timedelta(microseconds=1)
+
+
+def _dates(date_strs):
+    days = np.array([
+        (pydt.date.fromisoformat(s) - pydt.date(1970, 1, 1)).days
+        for s in date_strs], np.int32)
+    b = ColumnarBatch.from_numpy({"d": days},
+                                 T.Schema.of(("d", T.DATE32)))
+    return LocalBatchSource([[b]])
+
+
+def test_date_fields():
+    src = _dates(["2020-02-29", "1999-12-31", "1970-01-01", "2024-07-04"])
+    assert _proj1(D.Year(col("d")), src) == [2020, 1999, 1970, 2024]
+    assert _proj1(D.Month(col("d")), src) == [2, 12, 1, 7]
+    assert _proj1(D.DayOfMonth(col("d")), src) == [29, 31, 1, 4]
+    # Spark dayofweek: 1=Sunday..7=Saturday
+    # 2020-02-29 Sat=7, 1999-12-31 Fri=6, 1970-01-01 Thu=5, 2024-07-04 Thu=5
+    assert _proj1(D.DayOfWeek(col("d")), src) == [7, 6, 5, 5]
+    assert _proj1(D.DayOfYear(col("d")), src) == [60, 365, 1, 186]
+    assert _proj1(D.Quarter(col("d")), src) == [1, 4, 1, 3]
+
+
+def test_week_of_year():
+    # ISO weeks: 2021-01-01 -> 53 (of 2020), 2021-01-04 -> 1,
+    # 2020-12-31 -> 53, 2016-01-03 (Sun) -> 53, 2015-12-28 -> 53
+    src = _dates(["2021-01-01", "2021-01-04", "2020-12-31", "2016-01-03"])
+    assert _proj1(D.WeekOfYear(col("d")), src) == [53, 1, 53, 53]
+
+
+def test_last_day_and_trunc():
+    src = _dates(["2020-02-15", "2021-02-15", "2024-12-31"])
+    got = _proj1(D.LastDay(col("d")), src)
+    exp = [(pydt.date(2020, 2, 29) - pydt.date(1970, 1, 1)).days,
+           (pydt.date(2021, 2, 28) - pydt.date(1970, 1, 1)).days,
+           (pydt.date(2024, 12, 31) - pydt.date(1970, 1, 1)).days]
+    assert got == exp
+    got2 = _proj1(D.TruncDate(col("d"), lit("month")), src)
+    exp2 = [(pydt.date(2020, 2, 1) - pydt.date(1970, 1, 1)).days,
+            (pydt.date(2021, 2, 1) - pydt.date(1970, 1, 1)).days,
+            (pydt.date(2024, 12, 1) - pydt.date(1970, 1, 1)).days]
+    assert got2 == exp2
+
+
+def test_date_arithmetic():
+    src = _dates(["2020-01-31", "2020-02-29"])
+    got = _proj1(D.AddMonths(col("d"), lit(1)), src)
+    exp = [(pydt.date(2020, 2, 29) - pydt.date(1970, 1, 1)).days,
+           (pydt.date(2020, 3, 29) - pydt.date(1970, 1, 1)).days]
+    assert got == exp
+    got2 = _proj1(D.DateAdd(col("d"), lit(30)), src)
+    exp2 = [(pydt.date(2020, 3, 1) - pydt.date(1970, 1, 1)).days,
+            (pydt.date(2020, 3, 30) - pydt.date(1970, 1, 1)).days]
+    assert got2 == exp2
+
+
+def test_timestamp_fields():
+    us = np.array([_us(pydt.datetime(2020, 6, 15, 13, 45, 30, 123456)),
+                   _us(pydt.datetime(1969, 12, 31, 23, 0, 1))], np.int64)
+    b = ColumnarBatch.from_numpy(
+        {"t": us}, T.Schema.of(("t", T.TIMESTAMP_US)))
+    src = LocalBatchSource([[b]])
+    assert _proj1(D.Hour(col("t")), src) == [13, 23]
+    assert _proj1(D.Minute(col("t")), src) == [45, 0]
+    assert _proj1(D.Second(col("t")), src) == [30, 1]
+    assert _proj1(D.Year(col("t")), src) == [2020, 1969]
+
+
+def test_timestamp_to_string_cast():
+    us = np.array([_us(pydt.datetime(2020, 6, 15, 13, 45, 30, 123456)),
+                   _us(pydt.datetime(2001, 1, 1))], np.int64)
+    b = ColumnarBatch.from_numpy(
+        {"t": us}, T.Schema.of(("t", T.TIMESTAMP_US)))
+    src = LocalBatchSource([[b]])
+    got = _proj1(col("t").cast(T.STRING), src)
+    assert got == ["2020-06-15 13:45:30.123456", "2001-01-01 00:00:00"]
+
+
+def test_months_between():
+    b = ColumnarBatch.from_numpy({
+        "a": np.array([(pydt.date(2020, 3, 31) - pydt.date(1970, 1, 1)
+                        ).days], np.int32),
+        "b": np.array([(pydt.date(2020, 1, 31) - pydt.date(1970, 1, 1)
+                        ).days], np.int32)},
+        T.Schema.of(("a", T.DATE32), ("b", T.DATE32)))
+    src = LocalBatchSource([[b]])
+    out = ProjectExec([D.MonthsBetween(col("a"), col("b")).alias("r")],
+                      src).collect()
+    assert out.column("r").to_pylist(1) == [2.0]  # both last days
+
+
+def test_like_utf8_chars_and_null_pattern():
+    src = _sb(["é", "héllo", "hxllo"])
+    assert _proj1(S.Like(col("s"), lit("_")), src) == [True, False, False]
+    assert _proj1(S.Like(col("s"), lit("h_llo")), src) == \
+        [False, True, True]
+    assert _proj1(S.Contains(col("s"), lit(None, T.STRING)), src) == \
+        [None, None, None]
+
+
+def test_pad_utf8_chars():
+    src = _sb(["日本", "abcdef"])
+    assert _proj1(S.LPad(col("s"), lit(4), lit("*")), src) == \
+        ["**日本", "abcd"]
+    assert _proj1(S.RPad(col("s"), lit(3), lit("日")), src) == \
+        ["日本日", "abc"]
+
+
+def test_months_between_timestamp_fraction():
+    a = np.array([_us(pydt.datetime(2020, 3, 15, 12, 0, 0))], np.int64)
+    b = np.array([_us(pydt.datetime(2020, 2, 15, 0, 0, 0))], np.int64)
+    batch = ColumnarBatch.from_numpy(
+        {"a": a, "b": b},
+        T.Schema.of(("a", T.TIMESTAMP_US), ("b", T.TIMESTAMP_US)))
+    src = LocalBatchSource([[batch]])
+    out = ProjectExec([D.MonthsBetween(col("a"), col("b")).alias("r")],
+                      src).collect()
+    got = out.column("r").to_pylist(1)[0]
+    assert abs(got - (1 + 0.5 / 31)) < 1e-8
