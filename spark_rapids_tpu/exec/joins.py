@@ -289,7 +289,6 @@ class HashJoinExec(TpuExec):
         jt = self.join_type
         outer_probe = jt in (JoinType.LEFT_OUTER, JoinType.RIGHT_OUTER,
                              JoinType.FULL_OUTER)
-        emitted_any = False
         bmatched_total = np.zeros(build.capacity, bool)
         for it in self._probe.execute_partitions():
             for pb in it:
@@ -321,7 +320,6 @@ class HashJoinExec(TpuExec):
                         if self.condition is not None:
                             out = self._apply_condition(out)
                 if out.num_rows > 0:
-                    emitted_any = True
                     self.update_output_metrics(out)
                     yield out
         if jt == JoinType.FULL_OUTER:
@@ -329,8 +327,6 @@ class HashJoinExec(TpuExec):
             if un is not None and un.num_rows > 0:
                 self.update_output_metrics(un)
                 yield un
-        if not emitted_any and jt in _PROBE_ONLY:
-            return
 
     def _apply_condition(self, batch: ColumnarBatch) -> ColumnarBatch:
         from spark_rapids_tpu.exec.basic import FilterExec, LocalBatchSource

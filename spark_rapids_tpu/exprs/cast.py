@@ -84,9 +84,13 @@ class Cast(Expression):
                     dst.storage_dtype), c.validity)
         if dst.id == T.TypeId.TIMESTAMP_US and src.is_numeric:
             if src.is_floating:
-                data = (c.data * DT.MICROS_PER_SECOND).astype(jnp.int64)
-            else:
-                data = c.data.astype(jnp.int64) * DT.MICROS_PER_SECOND
+                # Spark doubleToTimestamp: NaN/Infinity -> null
+                bad = jnp.isnan(c.data) | jnp.isinf(c.data)
+                safe = jnp.where(bad, 0.0, c.data)
+                data = (safe * DT.MICROS_PER_SECOND).astype(jnp.int64)
+                return ColumnVector(T.TIMESTAMP_US, data,
+                                    c.validity & ~bad)
+            data = c.data.astype(jnp.int64) * DT.MICROS_PER_SECOND
             return ColumnVector(T.TIMESTAMP_US, data, c.validity)
         # plain numeric widening/narrowing: wraps like Java (non-ANSI)
         return ColumnVector(dst, c.data.astype(dst.storage_dtype), c.validity)

@@ -1,0 +1,174 @@
+"""Misc expressions (reference `GpuMonotonicallyIncreasingID.scala`,
+`GpuSparkPartitionID.scala`, `GpuInputFileBlock.scala`,
+`GpuRandomExpressions.scala`, `NormalizeNaNAndZero.scala`,
+`constraintExpressions.scala`)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import ColumnVector
+from spark_rapids_tpu.exprs.base import (
+    EvalContext, Expression, UnaryExpression)
+
+
+@dataclasses.dataclass
+class TaskContextInfo:
+    """Per-partition execution context, set by the engine before a kernel
+    evaluates expressions that depend on task identity (the analog of
+    Spark's TaskContext + InputFileBlockHolder)."""
+    partition_id: int = 0
+    row_offset: int = 0          # rows emitted before this batch
+    input_file: str = ""
+    input_file_offset: int = 0
+    input_file_length: int = 0
+
+
+_ACTIVE_TASK = TaskContextInfo()
+
+
+def set_task_context(info: TaskContextInfo) -> None:
+    global _ACTIVE_TASK
+    _ACTIVE_TASK = info
+
+
+def get_task_context() -> TaskContextInfo:
+    return _ACTIVE_TASK
+
+
+@dataclasses.dataclass(eq=False)
+class MonotonicallyIncreasingID(Expression):
+    """(partition_id << 33) + row_index_within_partition, like Spark."""
+
+    def data_type(self, schema):
+        return T.INT64
+
+    def bind(self, schema):
+        return self
+
+    def eval(self, ctx: EvalContext):
+        tc = get_task_context()
+        base = (tc.partition_id << 33) + tc.row_offset
+        data = jnp.arange(ctx.capacity, dtype=jnp.int64) + base
+        return ColumnVector(T.INT64, data, ctx.row_mask)
+
+
+@dataclasses.dataclass(eq=False)
+class SparkPartitionID(Expression):
+    def data_type(self, schema):
+        return T.INT32
+
+    def bind(self, schema):
+        return self
+
+    def eval(self, ctx):
+        tc = get_task_context()
+        data = jnp.full(ctx.capacity, tc.partition_id, jnp.int32)
+        return ColumnVector(T.INT32, data, ctx.row_mask)
+
+
+@dataclasses.dataclass(eq=False)
+class InputFileName(Expression):
+    def data_type(self, schema):
+        return T.STRING
+
+    def bind(self, schema):
+        return self
+
+    def eval(self, ctx):
+        from spark_rapids_tpu.exprs.base import Literal
+        return Literal(get_task_context().input_file, T.STRING).eval(ctx)
+
+
+@dataclasses.dataclass(eq=False)
+class InputFileBlockStart(Expression):
+    def data_type(self, schema):
+        return T.INT64
+
+    def bind(self, schema):
+        return self
+
+    def eval(self, ctx):
+        v = get_task_context().input_file_offset
+        return ColumnVector(T.INT64, jnp.full(ctx.capacity, v, jnp.int64),
+                            ctx.row_mask)
+
+
+@dataclasses.dataclass(eq=False)
+class InputFileBlockLength(Expression):
+    def data_type(self, schema):
+        return T.INT64
+
+    def bind(self, schema):
+        return self
+
+    def eval(self, ctx):
+        v = get_task_context().input_file_length
+        return ColumnVector(T.INT64, jnp.full(ctx.capacity, v, jnp.int64),
+                            ctx.row_mask)
+
+
+@dataclasses.dataclass(eq=False)
+class Rand(Expression):
+    """rand(seed): uniform [0,1) via JAX's counter-based PRNG — unlike the
+    reference's per-task XORShift, results are reproducible across retries
+    because the key derives from (seed, partition, row offset), not
+    mutable task state."""
+    seed: int = 0
+
+    def data_type(self, schema):
+        return T.FLOAT64
+
+    def bind(self, schema):
+        return self
+
+    def eval(self, ctx):
+        tc = get_task_context()
+        key = jax.random.key(
+            (self.seed * 1_000_003 + tc.partition_id) & 0x7FFFFFFF)
+        key = jax.random.fold_in(key, tc.row_offset)
+        data = jax.random.uniform(key, (ctx.capacity,), jnp.float64)
+        return ColumnVector(T.FLOAT64, data, ctx.row_mask)
+
+
+@dataclasses.dataclass(eq=False)
+class NormalizeNaNAndZero(UnaryExpression):
+    """Canonicalize NaN payloads and -0.0 for grouping/join keys
+    (reference NormalizeFloatingNumbers)."""
+    child: Expression
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def do_columnar(self, c, ctx):
+        x = c.data
+        x = jnp.where(jnp.isnan(x), jnp.nan, x)
+        x = jnp.where(x == 0.0, 0.0, x)  # -0.0 == 0.0 -> +0.0
+        return ColumnVector(c.dtype, x, c.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class KnownFloatingPointNormalized(UnaryExpression):
+    """Marker wrapper (reference constraintExpressions.scala)."""
+    child: Expression
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def do_columnar(self, c, ctx):
+        return c
+
+
+@dataclasses.dataclass(eq=False)
+class KnownNotNull(UnaryExpression):
+    child: Expression
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def do_columnar(self, c, ctx):
+        return ColumnVector(c.dtype, c.data, ctx.row_mask, c.lengths)

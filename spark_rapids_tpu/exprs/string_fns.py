@@ -462,6 +462,8 @@ class StringLocate(Expression):
         first_byte = jnp.argmax(ok, axis=1)
         rows = jnp.arange(ctx.capacity)
         res = jnp.where(found, char_idx[rows, first_byte] + 1, 0)
+        # Spark: locate with start < 1 returns 0 unconditionally
+        res = jnp.where(min_char < 0, 0, res)
         return ColumnVector(T.INT32, res.astype(jnp.int32), validity)
 
 

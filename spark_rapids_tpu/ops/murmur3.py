@@ -89,14 +89,11 @@ def _double_to_words(x):
     hi20 = jnp.floor(frac * (1 << 20))
     rem = frac * (1 << 20) - hi20             # [0,1), 32 bits of precision
     lo32 = jnp.floor(rem * 4294967296.0)
-    # subnormal: value = f * 2^-1074 exactly; f < 2^52
-    sub_scaled = ax * 4.49423283715579e307 * 4.0  # ax * 2^1024
-    # sub field = ax / 2^-1074 = ax * 2^1074 — do it in two exact steps
+    # subnormal: field = ax * 2^1074 exactly, in two exact steps
     sub_f = ax * (2.0 ** 537)
     sub_f = sub_f * (2.0 ** 537)
     sub_hi = jnp.floor(sub_f / 4294967296.0)
     sub_lo = sub_f - sub_hi * 4294967296.0
-    del sub_scaled
     is_zero = ax == 0.0
     is_inf = jnp.isinf(ax)
     is_nan = jnp.isnan(x)
@@ -164,10 +161,13 @@ def _hash_string(col: ColumnVector, seed_u32: jnp.ndarray) -> jnp.ndarray:
                 | (data[:, base + 3] << 24))
         in_bounds = base + 4 <= aligned
         h1 = jnp.where(in_bounds, _mix_h1(h1, _mix_k1(word)), h1)
-    # tail bytes: SIGNED byte value, each mixed separately
-    for b in range(cc):
-        sbyte = col.data[:, b].astype(jnp.int8).astype(jnp.int32)
-        in_tail = (b >= aligned) & (b < lens)
+    # tail: at most 3 bytes (len % 4), each mixed as a SIGNED byte —
+    # gather them instead of scanning all cc positions
+    for t in range(3):
+        bpos = jnp.clip(aligned + t, 0, cc - 1)
+        byte = jnp.take_along_axis(col.data, bpos[:, None], axis=1)[:, 0]
+        sbyte = byte.astype(jnp.int8).astype(jnp.int32)
+        in_tail = aligned + t < lens
         h1 = jnp.where(in_tail,
                        _mix_h1(h1, _mix_k1(sbyte.astype(jnp.uint32))), h1)
     return _fmix(h1, lens.astype(jnp.uint32))

@@ -60,20 +60,30 @@ class ShuffleExchangeExec(UnaryExecBase):
         """Driver-side reservoir sampling for range bounds (reference
         GpuRangePartitioner.sketch/SamplingUtils)."""
         import numpy as np
+        import jax.numpy as jnp
+        from spark_rapids_tpu.columnar.vector import bucket_capacity
         samples = []
         sample_rows = 0
         target = 20 * part.num_partitions
+        done = False
         for it in self.child.execute_partitions():
+            if done:
+                break
             for batch in it:
                 if batch.num_rows == 0:
                     continue
-                take = min(batch.num_rows, max(1, target //
-                                               max(1, part.num_partitions)))
+                # evenly-spaced sample of each batch (the reference uses
+                # reservoir sampling; deterministic striding is equivalent
+                # for bound estimation and cheaper on device)
+                take = min(batch.num_rows, max(2, target))
                 idx = np.linspace(0, batch.num_rows - 1, take).astype(int)
-                keep = batch.slice(0, batch.num_rows)
-                samples.append(keep)
-                sample_rows += batch.num_rows
+                cap = bucket_capacity(take)
+                sel = jnp.asarray(np.pad(idx, (0, cap - take)))
+                valid = jnp.arange(cap) < take
+                samples.append(batch.gather(sel, valid, take))
+                sample_rows += take
                 if sample_rows >= 4 * target:
+                    done = True
                     break
         if not samples:
             from spark_rapids_tpu.columnar.batch import empty_batch

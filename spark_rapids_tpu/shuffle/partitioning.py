@@ -226,10 +226,7 @@ def _row_less_than_bound(keys, bounds: ColumnarBatch, bi: int, order
     lt_all = jnp.zeros(cap, bool)
     eq_all = jnp.ones(cap, bool)
     for key_col, o, bcol in zip(keys, order, bounds.columns):
-        bv = bcol.slice_row_broadcast(bi, cap) if hasattr(
-            bcol, "slice_row_broadcast") else None
-        if bv is None:
-            bv = _broadcast_row(bcol, bi, cap)
+        bv = _broadcast_row(bcol, bi, cap)
         lt, eq = _compare(key_col, bv)
         if not o.ascending:
             lt = ~(lt | eq)

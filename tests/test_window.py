@@ -1,0 +1,143 @@
+"""Window operator parity tests vs pandas."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.basic import LocalBatchSource
+from spark_rapids_tpu.exec.sort import asc, desc
+from spark_rapids_tpu.exec.window import (
+    DenseRank, Lag, Lead, Rank, RowNumber, WindowExec, WindowFrame,
+    WindowSpec, WinAvg, WinCount, WinMax, WinMin, WinSum)
+from spark_rapids_tpu.exprs.base import col
+
+
+def _df(rng, n=100):
+    return pd.DataFrame({
+        "g": rng.choice(["a", "b", "c"], n),
+        "o": rng.permutation(n).astype(np.int64),
+        "v": rng.integers(-50, 50, n).astype(np.int64),
+    })
+
+
+def _run(rng, fns, frame=WindowFrame(), n=100, order_desc=False):
+    df = _df(rng, n)
+    spec = WindowSpec([col("g")],
+                      [desc(col("o")) if order_desc else asc(col("o"))],
+                      frame)
+    plan = WindowExec(fns, spec,
+                      LocalBatchSource.from_pandas(df, num_partitions=1))
+    out = plan.to_pandas()
+    return df, out
+
+
+def test_row_number_rank(rng):
+    df, out = _run(rng, [RowNumber().alias("rn"), Rank().alias("rk"),
+                         DenseRank().alias("drk")])
+    out = out.sort_values(["g", "o"]).reset_index(drop=True)
+    exp = df.sort_values(["g", "o"]).reset_index(drop=True)
+    exp["rn"] = exp.groupby("g").cumcount() + 1
+    exp["rk"] = exp.groupby("g")["o"].rank(method="min").astype(int)
+    exp["drk"] = exp.groupby("g")["o"].rank(method="dense").astype(int)
+    assert out["rn"].tolist() == exp["rn"].tolist()
+    assert out["rk"].tolist() == exp["rk"].tolist()
+    assert out["drk"].tolist() == exp["drk"].tolist()
+
+
+def test_rank_with_ties():
+    b = ColumnarBatch.from_numpy({
+        "g": np.array(["x"] * 6, dtype=object),
+        "o": np.array([10, 10, 20, 20, 20, 30], np.int64)})
+    plan = WindowExec([Rank().alias("rk"), DenseRank().alias("drk")],
+                      WindowSpec([col("g")], [asc(col("o"))]),
+                      LocalBatchSource([[b]]))
+    out = plan.to_pandas()
+    assert out["rk"].tolist() == [1, 1, 3, 3, 3, 6]
+    assert out["drk"].tolist() == [1, 1, 2, 2, 2, 3]
+
+
+def test_running_sum(rng):
+    # default frame: UNBOUNDED PRECEDING .. CURRENT ROW
+    df, out = _run(rng, [WinSum(col("v")).alias("rs")])
+    out = out.sort_values(["g", "o"]).reset_index(drop=True)
+    exp = df.sort_values(["g", "o"]).reset_index(drop=True)
+    exp["rs"] = exp.groupby("g")["v"].cumsum()
+    assert out["rs"].tolist() == exp["rs"].tolist()
+
+
+def test_whole_partition_agg(rng):
+    frame = WindowFrame(is_rows=True, lower=None, upper=None)
+    df, out = _run(rng, [WinSum(col("v")).alias("t"),
+                         WinAvg(col("v")).alias("a"),
+                         WinCount(col("v")).alias("c")], frame)
+    exp_t = df.groupby("g")["v"].transform("sum")
+    exp_c = df.groupby("g")["v"].transform("count")
+    # out preserves input row order
+    assert out["t"].tolist() == exp_t.tolist()
+    assert out["c"].tolist() == exp_c.tolist()
+    np.testing.assert_allclose(
+        out["a"], df.groupby("g")["v"].transform("mean"))
+
+
+def test_sliding_rows_frame(rng):
+    frame = WindowFrame(is_rows=True, lower=-2, upper=0)
+    df, out = _run(rng, [WinSum(col("v")).alias("s3"),
+                         WinMin(col("v")).alias("mn"),
+                         WinMax(col("v")).alias("mx")], frame)
+    out = out.sort_values(["g", "o"]).reset_index(drop=True)
+    exp = df.sort_values(["g", "o"]).reset_index(drop=True)
+    g = exp.groupby("g")["v"]
+    assert out["s3"].tolist() == g.rolling(3, min_periods=1).sum() \
+        .reset_index(drop=True).astype(int).tolist()
+    assert out["mn"].tolist() == g.rolling(3, min_periods=1).min() \
+        .reset_index(drop=True).astype(int).tolist()
+    assert out["mx"].tolist() == g.rolling(3, min_periods=1).max() \
+        .reset_index(drop=True).astype(int).tolist()
+
+
+def test_lead_lag(rng):
+    df, out = _run(rng, [Lead(col("v")).alias("ld"),
+                         Lag(col("v"), 2).alias("lg")])
+    out = out.sort_values(["g", "o"]).reset_index(drop=True)
+    exp = df.sort_values(["g", "o"]).reset_index(drop=True)
+    exp_ld = exp.groupby("g")["v"].shift(-1)
+    exp_lg = exp.groupby("g")["v"].shift(2)
+    got_ld = out["ld"].tolist()
+    for g, e in zip(got_ld, exp_ld.tolist()):
+        assert (g is None and pd.isna(e)) or g == e
+    got_lg = out["lg"].tolist()
+    for g, e in zip(got_lg, exp_lg.tolist()):
+        assert (g is None and pd.isna(e)) or g == e
+
+
+def test_range_frame():
+    # range between 10 preceding and current row on integer order col
+    b = ColumnarBatch.from_numpy({
+        "g": np.array(["x"] * 5, dtype=object),
+        "o": np.array([0, 5, 12, 13, 30], np.int64),
+        "v": np.array([1, 2, 4, 8, 16], np.int64)})
+    from spark_rapids_tpu.exec.window import WindowSpec
+    frame = WindowFrame(is_rows=False, lower=-10, upper=0)
+    plan = WindowExec([WinSum(col("v")).alias("s")],
+                      WindowSpec([col("g")], [asc(col("o"))], frame),
+                      LocalBatchSource([[b]]))
+    out = plan.to_pandas()
+    # o=0: [o-10,0]={0}:1 ; o=5: {0,5}:3 ; o=12: {5,12}:6 ; o=13: {5,12,13}:14
+    # o=30: {30}:16
+    assert out["s"].tolist() == [1, 3, 6, 14, 16]
+
+
+def test_window_null_values(rng):
+    b = ColumnarBatch.from_numpy(
+        {"g": np.array(["x"] * 4, dtype=object),
+         "o": np.array([1, 2, 3, 4], np.int64),
+         "v": np.array([10, 0, 30, 0], np.int64)},
+        validity={"v": np.array([True, False, True, False])})
+    plan = WindowExec(
+        [WinSum(col("v")).alias("s"), WinCount(col("v")).alias("c")],
+        WindowSpec([col("g")], [asc(col("o"))]),
+        LocalBatchSource([[b]]))
+    out = plan.collect()
+    assert out.column("s").to_pylist(4) == [10, 10, 40, 40]
+    assert out.column("c").to_pylist(4) == [1, 1, 2, 2]
