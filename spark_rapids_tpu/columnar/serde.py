@@ -1,0 +1,116 @@
+"""Contiguous host serialization of ColumnarBatch.
+
+Role parallel of the reference's `JCudfSerialization` host stream format
+(`GpuColumnarBatchSerializer.scala:37-123`) and `MetaUtils.scala` TableMeta:
+one contiguous byte payload per batch plus a small metadata header, so a
+batch can (a) spill device->host->disk as a single blob and (b) travel the
+shuffle wire.  Rows are trimmed to `num_rows` on serialize and re-padded to
+the capacity bucket on deserialize — padding never hits the wire or disk.
+
+Layout: MAGIC | header_len:u32 | header(json utf8) | col payloads…
+Header: {num_rows, fields: [{name, dtype, char_cap?}], sizes: [...]}.
+Each column payload = data bytes (row-trimmed) + validity (packed bits).
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.vector import (
+    ColumnVector, _pad_to, bucket_capacity, bucket_char_cap)
+
+MAGIC = b"TPUB"
+
+
+def _pack_bits(mask: np.ndarray) -> bytes:
+    return np.packbits(mask.astype(np.uint8), bitorder="little").tobytes()
+
+
+def _unpack_bits(data: bytes, n: int) -> np.ndarray:
+    return np.unpackbits(np.frombuffer(data, np.uint8),
+                         bitorder="little")[:n].astype(bool)
+
+
+def serialize_batch(batch: ColumnarBatch) -> bytes:
+    n = batch.num_rows
+    fields_meta = []
+    payloads = []
+    for f, c in zip(batch.schema.fields, batch.columns):
+        data = np.asarray(c.data)[:n]
+        validity = np.asarray(c.validity)[:n]
+        meta = {"name": f.name, "dtype": f.dtype.id.value}
+        if f.dtype.is_string:
+            lens = np.asarray(c.lengths)[:n]
+            # trim char dimension to what the rows actually use
+            used = int(lens.max()) if n else 0
+            data = np.ascontiguousarray(data[:, :used])
+            meta["char_cap"] = used
+            payload = (data.tobytes() + lens.astype(np.int32).tobytes()
+                       + _pack_bits(validity))
+        else:
+            payload = (np.ascontiguousarray(data).tobytes()
+                       + _pack_bits(validity))
+        meta["size"] = len(payload)
+        fields_meta.append(meta)
+        payloads.append(payload)
+    header = json.dumps({"num_rows": n, "fields": fields_meta},
+                        separators=(",", ":")).encode()
+    out = bytearray()
+    out += MAGIC
+    out += len(header).to_bytes(4, "little")
+    out += header
+    for p in payloads:
+        out += p
+    return bytes(out)
+
+
+def peek_meta(blob: bytes) -> dict:
+    """Read just the header (the TableMeta analog) without materializing."""
+    assert blob[:4] == MAGIC, "bad magic"
+    hlen = int.from_bytes(blob[4:8], "little")
+    return json.loads(blob[8:8 + hlen].decode())
+
+
+def deserialize_batch(blob: bytes,
+                      capacity: Optional[int] = None) -> ColumnarBatch:
+    meta = peek_meta(blob)
+    hlen = int.from_bytes(blob[4:8], "little")
+    off = 8 + hlen
+    n = meta["num_rows"]
+    cap = capacity or bucket_capacity(n)
+    cols, fields = [], []
+    for fm in meta["fields"]:
+        dt = T.DataType(T.TypeId(fm["dtype"]))
+        payload = blob[off:off + fm["size"]]
+        off += fm["size"]
+        if dt.is_string:
+            used = fm["char_cap"]
+            dsz = n * used
+            raw = np.frombuffer(payload[:dsz], np.uint8).reshape(n, used)
+            lens = np.frombuffer(payload[dsz:dsz + 4 * n], np.int32)
+            validity = _unpack_bits(payload[dsz + 4 * n:], n)
+            cc = bucket_char_cap(used)
+            data = np.zeros((cap, cc), np.uint8)
+            data[:n, :used] = raw
+            col = ColumnVector(
+                dt, _dev(data), _dev(_pad_to(validity, cap)),
+                _dev(_pad_to(lens, cap)))
+        else:
+            storage = dt.storage_dtype
+            dsz = n * storage.itemsize
+            vals = np.frombuffer(payload[:dsz], storage)
+            validity = _unpack_bits(payload[dsz:], n)
+            col = ColumnVector(dt, _dev(_pad_to(vals, cap)),
+                               _dev(_pad_to(validity, cap)))
+        cols.append(col)
+        fields.append(T.Field(fm["name"], dt))
+    return ColumnarBatch(T.Schema(tuple(fields)), cols, n)
+
+
+def _dev(arr: np.ndarray):
+    import jax.numpy as jnp
+    return jnp.asarray(arr)

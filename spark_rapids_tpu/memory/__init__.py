@@ -1,0 +1,17 @@
+"""Device, memory & spill management (SURVEY.md §2.4).
+
+Exports the tiered-store stack: BufferCatalog + device/host/disk stores with
+native-backed (C++) allocator and spill-priority queue, the accounted HBM
+DeviceManager with preemptive-spill callback, and the task TpuSemaphore.
+"""
+from spark_rapids_tpu.memory.buffer import (  # noqa: F401
+    BufferId, DegenerateBuffer, SpillableBuffer, StorageTier, TableMeta,
+    degenerate_meta, meta_for_batch)
+from spark_rapids_tpu.memory.catalog import BufferCatalog  # noqa: F401
+from spark_rapids_tpu.memory.device_manager import (  # noqa: F401
+    DeviceManager, SpillCallback)
+from spark_rapids_tpu.memory.env import ResourceEnv  # noqa: F401
+from spark_rapids_tpu.memory.semaphore import (  # noqa: F401
+    TaskContext, TpuSemaphore)
+from spark_rapids_tpu.memory.stores import (  # noqa: F401
+    DeviceMemoryStore, DiskBlockManager, DiskStore, HostMemoryStore)

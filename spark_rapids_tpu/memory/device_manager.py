@@ -1,0 +1,164 @@
+"""Device manager: TPU discovery/binding + HBM budget accounting + the
+spill-on-pressure handler.
+
+Reference parallels: `GpuDeviceManager.scala` (device acquisition, RMM pool
+arithmetic alloc-fraction/max/reserve, pinned pool init, per-task device
+setup) and `DeviceMemoryEventHandler.scala` (RMM alloc-failure callback ->
+synchronous spill device->host->disk -> retry).
+
+TPU twist (SURVEY.md §7 hard part (c)): XLA/PJRT has no RMM-style
+alloc-failure hook, so the arena is *accounted*, not intercepted: stores
+report resident bytes, operators call `reserve(nbytes)` before materializing
+large outputs, and crossing the budget triggers a preemptive synchronous
+spill of the device store.  Real HBM totals come from the PJRT device when
+available; a conservative default otherwise.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from spark_rapids_tpu import config as C
+
+_DEFAULT_HBM = 16 * 1024**3  # v5p chip-class default when PJRT has no stats
+
+
+class SpillCallback:
+    """Alloc-pressure callback (DeviceMemoryEventHandler analog): spill the
+    device store until `needed` bytes fit, retrying a bounded number of
+    times; gives up when nothing is left to spill."""
+
+    MAX_RETRIES = 3
+
+    def __init__(self, device_store):
+        self.device_store = device_store
+        self.spill_count = 0
+        self.bytes_spilled = 0
+
+    def on_alloc_pressure(self, needed: int, budget: int,
+                          reserved: int) -> bool:
+        """Returns True if the allocation should be retried.  `reserved` is
+        outstanding reservations by in-flight operators — the spill target
+        must leave room for those commitments too, not just `needed`."""
+        for _ in range(self.MAX_RETRIES):
+            target = max(0, budget - needed - reserved)
+            freed = self.device_store.synchronous_spill(target)
+            self.spill_count += 1
+            self.bytes_spilled += freed
+            if (self.device_store.current_size + reserved + needed
+                    <= budget):
+                return True
+            if freed == 0:
+                return False  # store empty / everything pinned
+        return (self.device_store.current_size + reserved + needed
+                <= budget)
+
+
+class DeviceManager:
+    """Process singleton (one accelerator per executor, like the
+    reference's 1-GPU-per-executor model)."""
+
+    _instance: Optional["DeviceManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: Optional[C.RapidsConf] = None,
+                 hbm_total: Optional[int] = None):
+        conf = conf or C.get_active_conf()
+        self.conf = conf
+        self.device = self._pick_device()
+        total = hbm_total or self._query_hbm_total()
+        frac = conf[C.HBM_ALLOC_FRACTION]
+        reserve = conf[C.HBM_RESERVE]
+        # pool arithmetic mirrors GpuDeviceManager.scala:159-196
+        self.budget = max(0, int(total * frac) - reserve)
+        self.hbm_total = total
+        self._store_bytes = 0
+        self._reserved = 0
+        self._acct = threading.Lock()
+        self.spill_callback: Optional[SpillCallback] = None
+
+    # -- singleton lifecycle -------------------------------------------------
+    @classmethod
+    def initialize(cls, conf: Optional[C.RapidsConf] = None,
+                   hbm_total: Optional[int] = None) -> "DeviceManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls(conf, hbm_total)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "DeviceManager":
+        return cls.initialize()
+
+    @classmethod
+    def shutdown(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    # -- device ---------------------------------------------------------------
+    @staticmethod
+    def _pick_device():
+        import jax
+        devs = jax.devices()
+        for d in devs:
+            if d.platform == "tpu":
+                return d
+        return devs[0]
+
+    def _query_hbm_total(self) -> int:
+        try:
+            stats = self.device.memory_stats()
+            if stats and "bytes_limit" in stats:
+                return int(stats["bytes_limit"])
+        except Exception:
+            pass
+        return _DEFAULT_HBM
+
+    def resident_bytes(self) -> int:
+        try:
+            stats = self.device.memory_stats()
+            if stats and "bytes_in_use" in stats:
+                return int(stats["bytes_in_use"])
+        except Exception:
+            pass
+        with self._acct:
+            return self._store_bytes + self._reserved
+
+    # -- accounting ------------------------------------------------------------
+    def track_store_bytes(self, delta: int) -> None:
+        with self._acct:
+            self._store_bytes += delta
+
+    @property
+    def store_bytes(self) -> int:
+        with self._acct:
+            return self._store_bytes
+
+    def install_spill_handler(self, device_store) -> SpillCallback:
+        self.spill_callback = SpillCallback(device_store)
+        return self.spill_callback
+
+    def reserve(self, nbytes: int) -> bool:
+        """Pre-admission check before materializing `nbytes` on device.
+        Spills preemptively under pressure.  Returns False only when even
+        spilling everything cannot make room (caller may still proceed and
+        let XLA OOM — accounting is advisory, like RMM retries)."""
+        with self._acct:
+            projected = self._store_bytes + self._reserved + nbytes
+            if projected <= self.budget:
+                self._reserved += nbytes
+                return True
+            reserved = self._reserved
+        if self.spill_callback is not None:
+            ok = self.spill_callback.on_alloc_pressure(
+                nbytes, self.budget, reserved)
+            with self._acct:
+                self._reserved += nbytes
+            return ok
+        with self._acct:
+            self._reserved += nbytes
+        return False
+
+    def release_reservation(self, nbytes: int) -> None:
+        with self._acct:
+            self._reserved = max(0, self._reserved - nbytes)

@@ -1,0 +1,73 @@
+"""Executor-side resource environment: catalog + device->host->disk store
+chain + spill handler install (reference `GpuShuffleEnv.initStorage`
+`GpuShuffleEnv.scala:52-69`, which wires RapidsDeviceMemoryStore ->
+RapidsHostMemoryStore -> RapidsDiskStore and installs the RMM event
+handler).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.memory.catalog import BufferCatalog
+from spark_rapids_tpu.memory.device_manager import DeviceManager
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.memory.stores import (
+    DeviceMemoryStore, DiskBlockManager, DiskStore, HostMemoryStore)
+
+
+class ResourceEnv:
+    _instance: Optional["ResourceEnv"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: Optional[C.RapidsConf] = None,
+                 hbm_total: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
+        conf = conf or C.get_active_conf()
+        self.conf = conf
+        self.catalog = BufferCatalog()
+        self.device_manager = DeviceManager.initialize(conf, hbm_total)
+        self.device_store = DeviceMemoryStore(self.catalog,
+                                             self.device_manager)
+        self.host_store = HostMemoryStore(conf[C.HOST_SPILL_STORAGE],
+                                          self.catalog)
+        self.disk_store = DiskStore(DiskBlockManager(spill_dir), self.catalog)
+        self.device_store.set_spill_store(self.host_store)
+        self.host_store.set_spill_store(self.disk_store)
+        self.spill_callback = self.device_manager.install_spill_handler(
+            self.device_store)
+        self.semaphore = TpuSemaphore.initialize(
+            conf[C.CONCURRENT_TPU_TASKS])
+
+    @classmethod
+    def init(cls, conf: Optional[C.RapidsConf] = None,
+             hbm_total: Optional[int] = None,
+             spill_dir: Optional[str] = None) -> "ResourceEnv":
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance.close()
+            DeviceManager.shutdown()
+            cls._instance = cls(conf, hbm_total, spill_dir)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "ResourceEnv":
+        with cls._lock:
+            if cls._instance is None:
+                DeviceManager.shutdown()
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def shutdown(cls) -> None:
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance.close()
+                cls._instance = None
+            DeviceManager.shutdown()
+            TpuSemaphore.shutdown()
+
+    def close(self) -> None:
+        for store in (self.device_store, self.host_store, self.disk_store):
+            store.close()

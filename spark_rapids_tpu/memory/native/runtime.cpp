@@ -1,0 +1,199 @@
+// Native runtime primitives for the TPU columnar engine.
+//
+// The reference keeps its native code in external deps (cuDF/RMM); its
+// in-JVM memory bookkeeping lives in AddressSpaceAllocator.scala (first-fit
+// address-space allocator carving the pinned/host pool) and
+// HashedPriorityQueue.java (O(log n) priority queue with O(1) containment
+// for spill-priority tracking).  This library provides the same two
+// primitives as C++ with a C ABI, loaded from Python via ctypes
+// (spark_rapids_tpu/memory/native/__init__.py).
+//
+// Build: g++ -O2 -shared -fPIC -o _runtime.so runtime.cpp
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Address-space allocator: first-fit over [0, size) with block splitting and
+// free-neighbour coalescing (reference AddressSpaceAllocator.scala behavior).
+struct AsaBlock {
+  uint64_t size;
+  bool free;
+};
+
+struct Asa {
+  // offset -> block; ordered so neighbours coalesce in O(log n)
+  std::map<uint64_t, AsaBlock> blocks;
+  uint64_t total;
+  uint64_t allocated;
+  std::mutex mu;
+};
+
+void* asa_create(uint64_t size) {
+  Asa* a = new Asa();
+  a->total = size;
+  a->allocated = 0;
+  a->blocks[0] = AsaBlock{size, true};
+  return a;
+}
+
+void asa_destroy(void* h) { delete static_cast<Asa*>(h); }
+
+// Returns the offset of the allocation, or UINT64_MAX when it does not fit.
+uint64_t asa_allocate(void* h, uint64_t size) {
+  Asa* a = static_cast<Asa*>(h);
+  std::lock_guard<std::mutex> lk(a->mu);
+  if (size == 0) size = 1;
+  for (auto it = a->blocks.begin(); it != a->blocks.end(); ++it) {
+    if (!it->second.free || it->second.size < size) continue;
+    uint64_t off = it->first;
+    uint64_t remain = it->second.size - size;
+    it->second.size = size;
+    it->second.free = false;
+    if (remain > 0) a->blocks[off + size] = AsaBlock{remain, true};
+    a->allocated += size;
+    return off;
+  }
+  return UINT64_MAX;
+}
+
+// Frees the block at `offset`; returns its size, or UINT64_MAX if unknown.
+uint64_t asa_free(void* h, uint64_t offset) {
+  Asa* a = static_cast<Asa*>(h);
+  std::lock_guard<std::mutex> lk(a->mu);
+  auto it = a->blocks.find(offset);
+  if (it == a->blocks.end() || it->second.free) return UINT64_MAX;
+  uint64_t size = it->second.size;
+  it->second.free = true;
+  a->allocated -= size;
+  // coalesce with next
+  auto nx = std::next(it);
+  if (nx != a->blocks.end() && nx->second.free) {
+    it->second.size += nx->second.size;
+    a->blocks.erase(nx);
+  }
+  // coalesce with prev
+  if (it != a->blocks.begin()) {
+    auto pv = std::prev(it);
+    if (pv->second.free) {
+      pv->second.size += it->second.size;
+      a->blocks.erase(it);
+    }
+  }
+  return size;
+}
+
+uint64_t asa_allocated(void* h) {
+  Asa* a = static_cast<Asa*>(h);
+  std::lock_guard<std::mutex> lk(a->mu);
+  return a->allocated;
+}
+
+uint64_t asa_available(void* h) {
+  Asa* a = static_cast<Asa*>(h);
+  std::lock_guard<std::mutex> lk(a->mu);
+  return a->total - a->allocated;
+}
+
+// Largest free block — how big an allocation could currently succeed.
+uint64_t asa_largest_free(void* h) {
+  Asa* a = static_cast<Asa*>(h);
+  std::lock_guard<std::mutex> lk(a->mu);
+  uint64_t best = 0;
+  for (auto& kv : a->blocks)
+    if (kv.second.free && kv.second.size > best) best = kv.second.size;
+  return best;
+}
+
+// ---------------------------------------------------------------------------
+// Hashed priority queue keyed by int64 id with double priority; lowest
+// priority polls first (spill candidates).  FIFO tie-break via sequence
+// number, like the reference's insertion-ordered comparator behavior.
+struct Hpq {
+  // (priority, seq) -> id
+  std::map<std::pair<double, uint64_t>, int64_t> q;
+  std::unordered_map<int64_t, std::pair<double, uint64_t>> pos;
+  uint64_t seq = 0;
+  std::mutex mu;
+};
+
+void* hpq_create() { return new Hpq(); }
+void hpq_destroy(void* h) { delete static_cast<Hpq*>(h); }
+
+void hpq_offer(void* h, int64_t id, double priority) {
+  Hpq* p = static_cast<Hpq*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  auto it = p->pos.find(id);
+  if (it != p->pos.end()) p->q.erase(it->second);
+  auto key = std::make_pair(priority, p->seq++);
+  p->q[key] = id;
+  p->pos[id] = key;
+}
+
+// Pops the lowest-priority element; INT64_MIN when empty.
+int64_t hpq_poll(void* h) {
+  Hpq* p = static_cast<Hpq*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  if (p->q.empty()) return INT64_MIN;
+  auto it = p->q.begin();
+  int64_t id = it->second;
+  p->pos.erase(id);
+  p->q.erase(it);
+  return id;
+}
+
+int64_t hpq_peek(void* h) {
+  Hpq* p = static_cast<Hpq*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  if (p->q.empty()) return INT64_MIN;
+  return p->q.begin()->second;
+}
+
+// 1 if removed, 0 if absent.
+int hpq_remove(void* h, int64_t id) {
+  Hpq* p = static_cast<Hpq*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  auto it = p->pos.find(id);
+  if (it == p->pos.end()) return 0;
+  p->q.erase(it->second);
+  p->pos.erase(it);
+  return 1;
+}
+
+int hpq_contains(void* h, int64_t id) {
+  Hpq* p = static_cast<Hpq*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  return p->pos.count(id) ? 1 : 0;
+}
+
+void hpq_update_priority(void* h, int64_t id, double priority) {
+  hpq_remove(h, id);
+  hpq_offer(h, id, priority);
+}
+
+uint64_t hpq_size(void* h) {
+  Hpq* p = static_cast<Hpq*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  return p->q.size();
+}
+
+// ---------------------------------------------------------------------------
+// Pinned-staging arena: one big malloc'd host buffer the Python side reads /
+// writes through memoryviews (the PinnedMemoryPool analog — page-locked DMA
+// staging is a TPU-runtime concern; here we provide the pool carving +
+// stable addresses the stores need).
+void* arena_create(uint64_t size) { return std::malloc(size); }
+void arena_destroy(void* p) { std::free(p); }
+void arena_write(void* p, uint64_t off, const uint8_t* src, uint64_t n) {
+  std::memcpy(static_cast<uint8_t*>(p) + off, src, n);
+}
+void arena_read(void* p, uint64_t off, uint8_t* dst, uint64_t n) {
+  std::memcpy(dst, static_cast<uint8_t*>(p) + off, n);
+}
+
+}  // extern "C"

@@ -1,0 +1,132 @@
+"""TpuSemaphore: limits how many tasks hold the accelerator concurrently
+(reference `GpuSemaphore.scala:27-161`, conf
+`spark.rapids.sql.concurrentGpuTasks`).
+
+Tasks acquire before their first device use (e.g. after host-side scan
+buffering) and release when leaving the device (columnar->row, partition
+slicing to host).  Acquisition is per-task refcounted — nested operators in
+one task acquire once — with a task-completion hook that force-releases,
+like the reference's TaskContext listener.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+
+class TaskContext:
+    """Minimal task identity carrier (Spark TaskContext stand-in)."""
+
+    _local = threading.local()
+
+    def __init__(self, task_attempt_id: int):
+        self.task_attempt_id = task_attempt_id
+        self._completion_listeners = []
+
+    def on_task_completion(self, fn) -> None:
+        self._completion_listeners.append(fn)
+
+    def complete(self) -> None:
+        for fn in self._completion_listeners:
+            fn(self)
+        self._completion_listeners.clear()
+        if getattr(TaskContext._local, "ctx", None) is self:
+            TaskContext._local.ctx = None
+
+    @classmethod
+    def get(cls) -> Optional["TaskContext"]:
+        return getattr(cls._local, "ctx", None)
+
+    @classmethod
+    def set_current(cls, ctx: Optional["TaskContext"]) -> None:
+        cls._local.ctx = ctx
+
+    def __enter__(self):
+        TaskContext.set_current(self)
+        return self
+
+    def __exit__(self, *exc):
+        self.complete()
+
+
+class TpuSemaphore:
+    _instance: Optional["TpuSemaphore"] = None
+    _ilock = threading.Lock()
+
+    def __init__(self, max_concurrent: int):
+        assert max_concurrent > 0
+        self.max_concurrent = max_concurrent
+        self._sem = threading.Semaphore(max_concurrent)
+        self._refs: dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    # -- singleton (executor-lifetime) --------------------------------------
+    @classmethod
+    def initialize(cls, max_concurrent: int) -> "TpuSemaphore":
+        with cls._ilock:
+            cls._instance = cls(max_concurrent)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "TpuSemaphore":
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = cls(1)
+            return cls._instance
+
+    @classmethod
+    def shutdown(cls) -> None:
+        with cls._ilock:
+            cls._instance = None
+
+    # -----------------------------------------------------------------------
+    def acquire_if_necessary(self, ctx: Optional[TaskContext] = None) -> None:
+        ctx = ctx or TaskContext.get()
+        if ctx is None:
+            return  # non-task context (driver-side): no admission control
+        tid = ctx.task_attempt_id
+        with self._lock:
+            if self._refs.get(tid, 0) > 0:
+                self._refs[tid] += 1
+                return
+        self._sem.acquire()
+        with self._lock:
+            first = tid not in self._refs
+            self._refs[tid] = self._refs.get(tid, 0) + 1
+        if first:
+            ctx.on_task_completion(lambda c: self.release_all(c))
+
+    def release_if_necessary(self, ctx: Optional[TaskContext] = None) -> None:
+        ctx = ctx or TaskContext.get()
+        if ctx is None:
+            return
+        tid = ctx.task_attempt_id
+        with self._lock:
+            n = self._refs.get(tid, 0)
+            if n == 0:
+                return
+            if n > 1:
+                self._refs[tid] = n - 1
+                return
+            del self._refs[tid]
+        self._sem.release()
+
+    def release_all(self, ctx: TaskContext) -> None:
+        tid = ctx.task_attempt_id
+        with self._lock:
+            n = self._refs.pop(tid, 0)
+        if n > 0:
+            self._sem.release()
+
+    def holders(self) -> int:
+        with self._lock:
+            return len(self._refs)
+
+    @contextmanager
+    def held(self, ctx: Optional[TaskContext] = None):
+        self.acquire_if_necessary(ctx)
+        try:
+            yield
+        finally:
+            self.release_if_necessary(ctx)

@@ -1,0 +1,332 @@
+"""Tiered buffer stores: device (HBM) -> host (arena) -> disk.
+
+Reference parallels: `RapidsBufferStore.scala:39-341` (abstract store with
+spill-priority tracking + `setSpillStore` chaining + `synchronousSpill`),
+`RapidsDeviceMemoryStore.scala`, `RapidsHostMemoryStore.scala` (pool carved
+by AddressSpaceAllocator), `RapidsDiskStore.scala` (disk block manager
+files).
+
+TPU twist: the device tier holds live jax Arrays (HBM); spilling serializes
+the batch (columnar/serde.py) and pushes the blob down the chain.  Reading a
+spilled buffer re-uploads to HBM.  The spill-candidate order is kept in the
+native HashedPriorityQueue.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.serde import deserialize_batch, serialize_batch
+from spark_rapids_tpu.memory.buffer import (
+    BufferId, SpillableBuffer, StorageTier, TableMeta)
+from spark_rapids_tpu.memory.native import (
+    AddressSpaceAllocator, HashedPriorityQueue, HostArena)
+
+
+class BufferStore:
+    """Abstract tier: tracks buffers + spill candidates; chains to the next
+    tier via `set_spill_store` (reference RapidsBufferStore.setSpillStore)."""
+
+    tier: StorageTier
+
+    def __init__(self, catalog=None):
+        self.catalog = catalog
+        self._buffers: dict[BufferId, SpillableBuffer] = {}
+        self._handle_of: dict[int, BufferId] = {}
+        self._spill_queue = HashedPriorityQueue()
+        self._lock = threading.RLock()
+        self.spill_store: Optional["BufferStore"] = None
+        self.current_size = 0
+
+    def set_spill_store(self, store: "BufferStore") -> None:
+        self.spill_store = store
+
+    # -- registration --------------------------------------------------------
+    def _track(self, buf: SpillableBuffer) -> None:
+        with self._lock:
+            self._buffers[buf.id] = buf
+            buf.store = self
+            self.current_size += buf.size_bytes
+            h = id(buf)
+            self._handle_of[h] = buf.id
+            buf._spill_handle = h
+            if buf.is_spillable:
+                self._spill_queue.offer(h, buf.spill_priority)
+            if self.catalog is not None:
+                self.catalog.register(buf)
+
+    def remove(self, bid: BufferId) -> None:
+        with self._lock:
+            buf = self._buffers.pop(bid, None)
+            if buf is None:
+                return
+            self.current_size -= buf.size_bytes
+            h = getattr(buf, "_spill_handle", None)
+            if h is not None:
+                self._spill_queue.remove(h)
+                self._handle_of.pop(h, None)
+            self._on_remove(buf)
+            buf.free()
+            if self.catalog is not None:
+                self.catalog.unregister(bid)
+
+    def _on_remove(self, buf: SpillableBuffer) -> None:
+        """Tier-specific accounting, called under the store lock exactly
+        once per successful removal."""
+
+    def get(self, bid: BufferId) -> Optional[SpillableBuffer]:
+        with self._lock:
+            return self._buffers.get(bid)
+
+    def mark_acquired(self, buf: SpillableBuffer) -> None:
+        """Pinned buffers leave the spill queue."""
+        h = getattr(buf, "_spill_handle", None)
+        if h is not None:
+            self._spill_queue.remove(h)
+
+    def mark_released(self, buf: SpillableBuffer) -> None:
+        if buf.is_spillable:
+            h = getattr(buf, "_spill_handle", None)
+            if h is not None:
+                self._spill_queue.offer(h, buf.spill_priority)
+
+    def update_priority(self, buf: SpillableBuffer, priority: float) -> None:
+        buf.spill_priority = priority
+        h = getattr(buf, "_spill_handle", None)
+        if h is not None and h in self._spill_queue:
+            self._spill_queue.update_priority(h, priority)
+
+    # -- spilling ------------------------------------------------------------
+    def synchronous_spill(self, target_size: int) -> int:
+        """Spill lowest-priority buffers until `current_size <= target_size`.
+        Returns bytes freed (reference RapidsBufferStore.synchronousSpill)."""
+        freed = 0
+        while True:
+            with self._lock:
+                if self.current_size <= target_size:
+                    break
+                h = self._spill_queue.poll()
+                if h is None:
+                    break  # nothing spillable left
+                bid = self._handle_of.get(h)
+                buf = self._buffers.get(bid) if bid is not None else None
+                # claim atomically: a reader that pinned the buffer after
+                # it entered the spill queue wins, and the buffer stays
+                if buf is None or not buf.try_mark_spilling():
+                    continue
+            if self.spill_store is not None:
+                self.spill_store.copy_buffer(buf)
+            freed += buf.size_bytes
+            self.remove_from_tier_only(buf)
+        return freed
+
+    def remove_from_tier_only(self, buf: SpillableBuffer) -> None:
+        """Drop from this tier without unregistering from the catalog
+        (the buffer lives on in the spill store)."""
+        with self._lock:
+            if self._buffers.pop(buf.id, None) is not None:
+                self.current_size -= buf.size_bytes
+                self._on_remove(buf)
+            h = getattr(buf, "_spill_handle", None)
+            if h is not None:
+                self._handle_of.pop(h, None)
+            buf.free()
+
+    def copy_buffer(self, buf: SpillableBuffer) -> SpillableBuffer:
+        """Materialize `buf`'s payload at this tier (spill receive path)."""
+        raise NotImplementedError
+
+    @property
+    def spillable_size(self) -> int:
+        with self._lock:
+            return sum(b.size_bytes for b in self._buffers.values()
+                       if b.is_spillable)
+
+    def close(self) -> None:
+        with self._lock:
+            for bid in list(self._buffers):
+                self.remove(bid)
+
+
+# ---------------------------------------------------------------------------
+class DeviceBuffer(SpillableBuffer):
+    tier = StorageTier.DEVICE
+
+    def __init__(self, bid: BufferId, batch: ColumnarBatch, meta: TableMeta,
+                 spill_priority: float):
+        super().__init__(bid, meta, spill_priority)
+        self._batch = batch
+
+    def get_columnar_batch(self) -> ColumnarBatch:
+        return self._batch
+
+    def get_host_bytes(self) -> bytes:
+        return serialize_batch(self._batch)
+
+    def free(self) -> None:
+        super().free()
+        self._batch = None  # drop HBM references
+
+
+class DeviceMemoryStore(BufferStore):
+    """HBM tier (reference RapidsDeviceMemoryStore.addTable/addBuffer)."""
+
+    tier = StorageTier.DEVICE
+
+    def __init__(self, catalog=None, device_manager=None):
+        super().__init__(catalog)
+        self.device_manager = device_manager
+
+    def add_batch(self, bid: BufferId, batch: ColumnarBatch,
+                  spill_priority: float = 0.0) -> DeviceBuffer:
+        from spark_rapids_tpu.memory.buffer import meta_for_batch
+        meta = meta_for_batch(batch)
+        buf = DeviceBuffer(bid, batch, meta, spill_priority)
+        if self.device_manager is not None:
+            self.device_manager.track_store_bytes(meta.size_bytes)
+        self._track(buf)
+        return buf
+
+    def _on_remove(self, buf: SpillableBuffer) -> None:
+        if self.device_manager is not None:
+            self.device_manager.track_store_bytes(-buf.size_bytes)
+
+    def copy_buffer(self, buf: SpillableBuffer) -> SpillableBuffer:
+        batch = buf.get_columnar_batch()
+        return self.add_batch(buf.id, batch, buf.spill_priority)
+
+
+# ---------------------------------------------------------------------------
+class HostBuffer(SpillableBuffer):
+    tier = StorageTier.HOST
+
+    def __init__(self, bid: BufferId, store: "HostMemoryStore", offset: int,
+                 length: int, meta: TableMeta, spill_priority: float):
+        super().__init__(bid, meta, spill_priority)
+        self._host_store = store
+        self._offset = offset
+        self._length = length
+
+    def get_host_bytes(self) -> bytes:
+        return self._host_store.arena.read(self._offset, self._length)
+
+    def get_columnar_batch(self) -> ColumnarBatch:
+        return deserialize_batch(self.get_host_bytes())
+
+    def free(self) -> None:
+        super().free()
+        self._host_store.arena.allocator.free(self._offset)
+
+    @property
+    def size_bytes(self) -> int:
+        return self._length
+
+
+class HostMemoryStore(BufferStore):
+    """Host tier: fixed pool carved by the native first-fit allocator
+    (reference RapidsHostMemoryStore + AddressSpaceAllocator.scala).  When
+    the pool cannot fit a blob, it passes straight down to the spill store
+    (the reference's host-store behavior on allocation failure)."""
+
+    tier = StorageTier.HOST
+
+    def __init__(self, size: int, catalog=None):
+        super().__init__(catalog)
+        self.arena = HostArena(size)
+
+    def copy_buffer(self, buf: SpillableBuffer) -> SpillableBuffer:
+        blob = buf.get_host_bytes()
+        off = self.arena.allocator.allocate(len(blob))
+        if off is None:
+            # try to make room by spilling our own contents downward
+            if self.spill_store is not None:
+                self.synchronous_spill(
+                    max(0, self.current_size - len(blob)))
+                off = self.arena.allocator.allocate(len(blob))
+            if off is None:
+                if self.spill_store is None:
+                    raise MemoryError(
+                        f"host store full ({len(blob)} bytes needed)")
+                return self.spill_store.copy_buffer(buf)
+        self.arena.write(off, blob)
+        hb = HostBuffer(buf.id, self, off, len(blob), buf.meta,
+                        buf.spill_priority)
+        self._track(hb)
+        return hb
+
+
+# ---------------------------------------------------------------------------
+class DiskBlockManager:
+    """Maps buffer ids to spill files in a managed temp dir (reference
+    RapidsDiskBlockManager over Spark's disk block manager)."""
+
+    def __init__(self, root: Optional[str] = None):
+        import tempfile
+        self.root = root or tempfile.mkdtemp(prefix="tpu-spill-")
+        os.makedirs(self.root, exist_ok=True)
+
+    def path_for(self, bid: BufferId) -> str:
+        return os.path.join(
+            self.root,
+            f"t{bid.table_id}_s{bid.shuffle_id}_m{bid.map_id}"
+            f"_p{bid.partition}.bin")
+
+    def cleanup(self) -> None:
+        import shutil
+        shutil.rmtree(self.root, ignore_errors=True)
+
+
+class DiskBuffer(SpillableBuffer):
+    tier = StorageTier.DISK
+
+    def __init__(self, bid: BufferId, path: str, length: int, meta: TableMeta,
+                 spill_priority: float):
+        super().__init__(bid, meta, spill_priority)
+        self._path = path
+        self._length = length
+
+    def get_host_bytes(self) -> bytes:
+        with open(self._path, "rb") as f:
+            return f.read()
+
+    def get_columnar_batch(self) -> ColumnarBatch:
+        return deserialize_batch(self.get_host_bytes())
+
+    def free(self) -> None:
+        super().free()
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass
+
+    @property
+    def size_bytes(self) -> int:
+        return self._length
+
+    @property
+    def is_spillable(self) -> bool:
+        return False  # bottom tier
+
+
+class DiskStore(BufferStore):
+    tier = StorageTier.DISK
+
+    def __init__(self, block_manager: Optional[DiskBlockManager] = None,
+                 catalog=None):
+        super().__init__(catalog)
+        self.block_manager = block_manager or DiskBlockManager()
+
+    def copy_buffer(self, buf: SpillableBuffer) -> SpillableBuffer:
+        blob = buf.get_host_bytes()
+        path = self.block_manager.path_for(buf.id)
+        with open(path, "wb") as f:
+            f.write(blob)
+        db = DiskBuffer(buf.id, path, len(blob), buf.meta, buf.spill_priority)
+        self._track(db)
+        return db
+
+    def close(self) -> None:
+        super().close()
+        self.block_manager.cleanup()

@@ -1,0 +1,263 @@
+"""Memory subsystem tests (reference test parallels:
+RapidsDeviceMemoryStoreSuite, RapidsHostMemoryStoreSuite,
+RapidsDiskStoreSuite, RapidsBufferCatalogSuite, GpuSemaphoreSuite with a
+mock TaskContext — SURVEY.md §4 tier 1)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.serde import (
+    deserialize_batch, peek_meta, serialize_batch)
+from spark_rapids_tpu.memory import (
+    BufferCatalog, BufferId, DeviceManager, DeviceMemoryStore, DiskStore,
+    HostMemoryStore, ResourceEnv, TaskContext, TpuSemaphore)
+from spark_rapids_tpu.memory.native import (
+    AddressSpaceAllocator, HashedPriorityQueue, load_native)
+
+
+def make_batch(n=10, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_numpy({
+        "a": rng.integers(0, 100, n).astype(np.int64),
+        "b": rng.random(n),
+        "s": np.array([f"row{i}" if i % 3 else None for i in range(n)],
+                      dtype=object),
+    })
+
+
+# ---------------------------------------------------------------------------
+class TestNative:
+    def test_native_lib_loads(self):
+        assert load_native() is not None, "native runtime should compile"
+
+    def test_asa_alloc_free_coalesce(self):
+        a = AddressSpaceAllocator(1000)
+        offs = [a.allocate(100) for _ in range(10)]
+        assert offs == [i * 100 for i in range(10)]
+        assert a.allocate(1) is None
+        # free two adjacent blocks and reallocate across the boundary
+        a.free(offs[3])
+        a.free(offs[4])
+        assert a.allocate(200) == 300
+        assert a.allocated == 1000
+
+    def test_asa_free_unknown(self):
+        a = AddressSpaceAllocator(100)
+        assert a.free(7) is None
+
+    def test_hpq_order_and_update(self):
+        q = HashedPriorityQueue()
+        q.offer(1, 5.0)
+        q.offer(2, 1.0)
+        q.offer(3, 3.0)
+        assert len(q) == 3
+        assert 2 in q and 9 not in q
+        q.update_priority(2, 10.0)
+        assert q.poll() == 3
+        assert q.remove(1)
+        assert q.poll() == 2
+        assert q.poll() is None
+
+    def test_python_fallbacks_match(self, monkeypatch):
+        import spark_rapids_tpu.memory.native as nat
+        monkeypatch.setattr(nat, "_lib", None)
+        monkeypatch.setattr(nat, "load_native", lambda: None)
+        a = nat.AddressSpaceAllocator(1000)
+        assert a.allocate(400) == 0
+        assert a.allocate(400) == 400
+        assert a.allocate(400) is None
+        assert a.free(0) == 400
+        assert a.allocate(400) == 0
+        q = nat.HashedPriorityQueue()
+        q.offer(5, 2.0)
+        q.offer(6, 1.0)
+        assert q.poll() == 6
+        assert q.poll() == 5
+
+
+# ---------------------------------------------------------------------------
+class TestSerde:
+    def test_roundtrip(self):
+        b = make_batch(17)
+        blob = serialize_batch(b)
+        out = deserialize_batch(blob)
+        assert out.num_rows == 17
+        assert out.to_pylist() == b.to_pylist()
+
+    def test_peek_meta(self):
+        b = make_batch(5)
+        meta = peek_meta(serialize_batch(b))
+        assert meta["num_rows"] == 5
+        assert [f["name"] for f in meta["fields"]] == ["a", "b", "s"]
+
+    def test_empty_batch(self):
+        b = ColumnarBatch.from_numpy({"x": np.zeros(0, np.int64)})
+        out = deserialize_batch(serialize_batch(b))
+        assert out.num_rows == 0
+
+    def test_padding_not_serialized(self):
+        small = make_batch(3)
+        big = make_batch(3).with_capacity(1024)
+        assert len(serialize_batch(small)) == len(serialize_batch(big))
+
+
+# ---------------------------------------------------------------------------
+@pytest.fixture
+def env(tmp_path):
+    C.set_active_conf(C.RapidsConf({
+        C.HOST_SPILL_STORAGE.key: 1 << 20,
+        C.CONCURRENT_TPU_TASKS.key: 2,
+    }))
+    e = ResourceEnv.init(hbm_total=1 << 30, spill_dir=str(tmp_path))
+    yield e
+    ResourceEnv.shutdown()
+    C.set_active_conf(C.RapidsConf())
+
+
+class TestStores:
+    def test_catalog_acquire_release(self, env):
+        bid = BufferId(env.catalog.next_table_id())
+        env.device_store.add_batch(bid, make_batch(8))
+        with env.catalog.acquired(bid) as buf:
+            assert buf.refcount == 1
+            assert buf.get_columnar_batch().num_rows == 8
+        assert env.catalog.acquire_buffer(bid).refcount == 1
+
+    def test_spill_device_to_host(self, env):
+        bids = []
+        for i in range(4):
+            bid = BufferId(env.catalog.next_table_id())
+            env.device_store.add_batch(bid, make_batch(8, seed=i),
+                                       spill_priority=i)
+            bids.append(bid)
+        expect = {bid: env.catalog.acquire_buffer(bid).get_columnar_batch()
+                  .to_pylist() for bid in bids}
+        for bid in bids:
+            # release the acquire above
+            env.catalog.release_buffer(env.catalog._by_id[bid])
+        freed = env.device_store.synchronous_spill(0)
+        assert freed > 0
+        assert env.device_store.current_size == 0
+        # all buffers still resolvable through the catalog, now host tier
+        for bid in bids:
+            with env.catalog.acquired(bid) as buf:
+                assert buf.tier.name == "HOST"
+                assert buf.get_columnar_batch().to_pylist() == expect[bid]
+
+    def test_pinned_buffer_does_not_spill(self, env):
+        bid = BufferId(env.catalog.next_table_id())
+        env.device_store.add_batch(bid, make_batch(8))
+        buf = env.catalog.acquire_buffer(bid)
+        assert env.device_store.synchronous_spill(0) == 0
+        env.catalog.release_buffer(buf)
+        assert env.device_store.synchronous_spill(0) > 0
+
+    def test_spill_chain_to_disk(self, env):
+        # shrink host pool so blobs flow to disk
+        env.host_store.arena.allocator = type(
+            env.host_store.arena.allocator)(64)
+        env.host_store.arena.size = 64
+        bid = BufferId(env.catalog.next_table_id())
+        env.device_store.add_batch(bid, make_batch(32))
+        env.device_store.synchronous_spill(0)
+        with env.catalog.acquired(bid) as buf:
+            assert buf.tier.name == "DISK"
+            assert buf.get_columnar_batch().num_rows == 32
+
+    def test_spill_priority_order(self, env):
+        spilled = []
+        orig = env.host_store.copy_buffer
+
+        def spy(buf):
+            spilled.append(buf.id)
+            return orig(buf)
+        env.host_store.copy_buffer = spy
+        ids = []
+        for i, prio in enumerate([5.0, 1.0, 3.0]):
+            bid = BufferId(env.catalog.next_table_id())
+            env.device_store.add_batch(bid, make_batch(8, seed=i), prio)
+            ids.append(bid)
+        env.device_store.synchronous_spill(0)
+        assert spilled == [ids[1], ids[2], ids[0]]
+
+    def test_alloc_pressure_spills(self, env):
+        bid = BufferId(env.catalog.next_table_id())
+        env.device_store.add_batch(bid, make_batch(8))
+        dm = env.device_manager
+        # a reservation larger than budget triggers the spill callback
+        assert dm.reserve(dm.budget) is True
+        assert env.spill_callback.spill_count >= 1
+        assert env.device_store.current_size == 0
+        dm.release_reservation(dm.budget)
+
+    def test_degenerate_buffer(self, env):
+        from spark_rapids_tpu.memory import DegenerateBuffer, degenerate_meta
+        schema = T.Schema.of(("x", T.INT64))
+        bid = BufferId(env.catalog.next_table_id())
+        buf = DegenerateBuffer(bid, degenerate_meta(schema, 100))
+        env.catalog.register(buf)
+        got = env.catalog.acquire_buffer(bid)
+        assert got.get_columnar_batch().num_rows == 100
+        assert not got.is_spillable
+
+
+# ---------------------------------------------------------------------------
+class TestSemaphore:
+    def test_refcounted_reacquire(self):
+        sem = TpuSemaphore(1)
+        with TaskContext(1) as ctx:
+            sem.acquire_if_necessary(ctx)
+            sem.acquire_if_necessary(ctx)  # nested: no deadlock
+            assert sem.holders() == 1
+            sem.release_if_necessary(ctx)
+            assert sem.holders() == 1
+            sem.release_if_necessary(ctx)
+            assert sem.holders() == 0
+
+    def test_limits_concurrency(self):
+        sem = TpuSemaphore(1)
+        order = []
+
+        def task(tid, hold):
+            with TaskContext(tid) as ctx:
+                sem.acquire_if_necessary(ctx)
+                order.append(("in", tid))
+                time.sleep(hold)
+                order.append(("out", tid))
+                sem.release_if_necessary(ctx)
+
+        t1 = threading.Thread(target=task, args=(1, 0.15))
+        t2 = threading.Thread(target=task, args=(2, 0.0))
+        t1.start()
+        time.sleep(0.05)
+        t2.start()
+        t1.join(); t2.join()
+        assert order == [("in", 1), ("out", 1), ("in", 2), ("out", 2)]
+
+    def test_task_completion_releases(self):
+        sem = TpuSemaphore(1)
+        ctx = TaskContext(7)
+        TaskContext.set_current(ctx)
+        sem.acquire_if_necessary(ctx)
+        ctx.complete()  # task ends without explicit release
+        assert sem.holders() == 0
+        # a new task can acquire immediately
+        with TaskContext(8) as c2:
+            sem.acquire_if_necessary(c2)
+            assert sem.holders() == 1
+            sem.release_if_necessary(c2)
+
+
+class TestDeviceManager:
+    def test_budget_arithmetic(self):
+        DeviceManager.shutdown()
+        conf = C.RapidsConf({C.HBM_ALLOC_FRACTION.key: 0.5,
+                             C.HBM_RESERVE.key: 100})
+        dm = DeviceManager(conf, hbm_total=1000)
+        assert dm.budget == 400
+        DeviceManager.shutdown()
