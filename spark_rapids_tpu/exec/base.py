@@ -126,6 +126,13 @@ class TpuExec:
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         raise NotImplementedError
 
+    def output_partition_count(self) -> int:
+        """Planning-time partition count (outputPartitioning analog).
+        MUST NOT execute anything — planners consult this."""
+        if not self._children:
+            return 1
+        return self._children[0].output_partition_count()
+
     def execute_partitions(self) -> list[Iterator[ColumnarBatch]]:
         """Partitioned execution (RDD analog).  Default: operators that are
         partition-local map themselves over each child partition."""

@@ -139,6 +139,9 @@ class LocalBatchSource(LeafExec):
     def output_schema(self) -> T.Schema:
         return self._schema
 
+    def output_partition_count(self) -> int:
+        return max(1, len(self.partitions))
+
     def execute_columnar(self):
         for part in self.partitions:
             yield from part
@@ -172,6 +175,9 @@ class RangeExec(LeafExec):
         self.num_partitions = max(1, num_partitions)
         self.target_rows = target_rows
         self._schema = T.Schema.of((name, T.INT64, False))
+
+    def output_partition_count(self) -> int:
+        return self.num_partitions
 
     def output_schema(self) -> T.Schema:
         return self._schema
@@ -223,6 +229,9 @@ class UnionExec(TpuExec):
                 self.update_output_metrics(out)
                 yield out
 
+    def output_partition_count(self) -> int:
+        return sum(c.output_partition_count() for c in self.children)
+
     def execute_partitions(self):
         parts = []
         for c in self.children:
@@ -236,6 +245,9 @@ class CoalescePartitionsExec(UnaryExecBase):
     def __init__(self, num_partitions: int, child: TpuExec):
         super().__init__(child)
         self.num_partitions = max(1, num_partitions)
+
+    def output_partition_count(self) -> int:
+        return min(self.num_partitions, self.child.output_partition_count())
 
     def output_schema(self):
         return self.child.output_schema()

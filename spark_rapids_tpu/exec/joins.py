@@ -362,6 +362,9 @@ class HashJoinExec(TpuExec):
             nulls.append(lv.eval(ctx))
         return self._assemble(nulls, bout, len(idx))
 
+    def output_partition_count(self) -> int:
+        return 1
+
     def execute_partitions(self):
         return [self.execute_columnar()]
 
@@ -457,6 +460,9 @@ class NestedLoopJoinExec(TpuExec):
             f = FilterExec(self.condition, src)
             self._cond_filter = f
         return list(f.process_partition(iter([batch])))[0]
+
+    def output_partition_count(self) -> int:
+        return 1
 
     def execute_partitions(self):
         return [self.execute_columnar()]

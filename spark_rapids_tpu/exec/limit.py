@@ -62,6 +62,9 @@ class GlobalLimitExec(UnaryExecBase):
                 self.update_output_metrics(out)
                 yield out
 
+    def output_partition_count(self) -> int:
+        return 1
+
     def execute_partitions(self):
         return [self.execute_columnar()]
 

@@ -90,6 +90,11 @@ class SortExec(UnaryExecBase):
 
         return self.kernels.get_or_build(key, build)
 
+    def output_partition_count(self) -> int:
+        if not self.global_sort:
+            return self.child.output_partition_count()
+        return 1
+
     def execute_partitions(self):
         if not self.global_sort:
             return [self.process_partition(it)

@@ -1,0 +1,195 @@
+"""Meta-wrapper tree for plan tagging and conversion (reference
+`RapidsMeta.scala`: per-node tag state with `willNotWorkOnGpu` reasons,
+bottom-up `tagForGpu` recursion, `convertIfNeeded`, and whole-tree
+consistency passes like `fixUpExchangeOverhead`).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import Expression
+from spark_rapids_tpu.plan.nodes import CpuNode
+
+
+class BaseMeta:
+    def __init__(self, conf: C.RapidsConf, parent: Optional["BaseMeta"]):
+        self.conf = conf
+        self.parent = parent
+        self._reasons: set[str] = set()
+
+    def will_not_work_on_tpu(self, reason: str) -> None:
+        self._reasons.add(reason)
+
+    @property
+    def can_this_be_replaced(self) -> bool:
+        return not self._reasons
+
+    @property
+    def reasons(self) -> set[str]:
+        return self._reasons
+
+
+class ExprMeta(BaseMeta):
+    """Wraps one Expression node (reference BaseExprMeta)."""
+
+    def __init__(self, expr: Expression, conf: C.RapidsConf,
+                 parent: Optional[BaseMeta], rule):
+        super().__init__(conf, parent)
+        self.expr = expr
+        self.rule = rule
+        self.child_exprs = [
+            wrap_expr(c, conf, self) for c in expr.children()]
+
+    def tag_for_tpu(self) -> None:
+        for c in self.child_exprs:
+            c.tag_for_tpu()
+        name = type(self.expr).__name__
+        if self.rule is None:
+            self.will_not_work_on_tpu(
+                f"expression {name} has no TPU implementation")
+            return
+        if not self.conf.is_op_enabled("expression", name):
+            self.will_not_work_on_tpu(
+                f"expression {name} disabled by "
+                f"{C.op_enable_key('expression', name)}")
+        if self.rule.incompat and not self.conf[C.INCOMPATIBLE_OPS]:
+            self.will_not_work_on_tpu(
+                f"expression {name} is incompatible ({self.rule.incompat}); "
+                f"enable with {C.INCOMPATIBLE_OPS.key}")
+        if self.rule.tag_extra is not None:
+            self.rule.tag_extra(self)
+
+    @property
+    def can_expr_tree_be_replaced(self) -> bool:
+        return self.can_this_be_replaced and all(
+            c.can_expr_tree_be_replaced for c in self.child_exprs)
+
+    def all_reasons(self) -> set[str]:
+        out = set(self._reasons)
+        for c in self.child_exprs:
+            out |= c.all_reasons()
+        return out
+
+
+class PlanMeta(BaseMeta):
+    """Wraps one CpuNode (reference SparkPlanMeta)."""
+
+    def __init__(self, node: CpuNode, conf: C.RapidsConf,
+                 parent: Optional[BaseMeta], rule):
+        super().__init__(conf, parent)
+        self.node = node
+        self.rule = rule
+        self.child_plans = [wrap_plan(c, conf, self)
+                            for c in node.children]
+        exprs = rule.exprs_of(node) if rule is not None else []
+        self.child_exprs = [wrap_expr(e, conf, self) for e in exprs]
+
+    # -- tagging -------------------------------------------------------------
+    def tag_for_tpu(self) -> None:
+        for c in self.child_plans:
+            c.tag_for_tpu()
+        for e in self.child_exprs:
+            e.tag_for_tpu()
+        name = self.node.name()
+        if self.rule is None:
+            self.will_not_work_on_tpu(
+                f"exec {name} has no TPU implementation")
+            return
+        if not self.conf.is_op_enabled("exec", name):
+            self.will_not_work_on_tpu(
+                f"exec {name} disabled by {C.op_enable_key('exec', name)}")
+        bad = [e for e in self.child_exprs
+               if not e.can_expr_tree_be_replaced]
+        if bad:
+            reasons = set()
+            for e in bad:
+                reasons |= e.all_reasons()
+            self.will_not_work_on_tpu(
+                "unsupported expressions: " + "; ".join(sorted(reasons)))
+        self._tag_types()
+        if self.rule.tag_extra is not None:
+            self.rule.tag_extra(self)
+
+    def _tag_types(self) -> None:
+        """Type-matrix check (reference areAllSupportedTypes)."""
+        try:
+            schema = self.node.output_schema()
+        except Exception as e:  # schema resolution failure -> CPU
+            self.will_not_work_on_tpu(f"schema resolution failed: {e}")
+            return
+        for f in schema.fields:
+            if f.dtype not in T.ALL_TYPES:
+                self.will_not_work_on_tpu(
+                    f"unsupported type {f.dtype} for column {f.name}")
+
+    # -- conversion ----------------------------------------------------------
+    def convert_if_needed(self):
+        """Returns TpuExec when this node goes on the TPU, else a CpuNode
+        with converted children bridged through transitions
+        (reference convertIfNeeded RapidsMeta.scala:578-593)."""
+        from spark_rapids_tpu.plan.transitions import (
+            ColumnarToRowExec, RowToColumnarExec)
+        kids = [c.convert_if_needed() for c in self.child_plans]
+        from spark_rapids_tpu.exec.base import TpuExec
+        if self.can_this_be_replaced:
+            tpu_kids = [k if isinstance(k, TpuExec) else RowToColumnarExec(k)
+                        for k in kids]
+            return self.rule.convert(self, tpu_kids)
+        cpu_kids = [k if isinstance(k, CpuNode) else ColumnarToRowExec(k)
+                    for k in kids]
+        import copy
+        node = copy.copy(self.node)  # never mutate the caller's plan
+        node.children = cpu_kids
+        return node
+
+    # -- explain -------------------------------------------------------------
+    def explain(self, all_nodes: bool = False, indent: int = 0) -> str:
+        lines = []
+        pad = "  " * indent
+        if self.can_this_be_replaced:
+            if all_nodes:
+                lines.append(f"{pad}*{self.node.name()} will run on TPU")
+        else:
+            why = "; ".join(sorted(self._reasons))
+            lines.append(f"{pad}!{self.node.name()} cannot run on TPU "
+                         f"because {why}")
+        for c in self.child_plans:
+            s = c.explain(all_nodes, indent + 1)
+            if s:
+                lines.append(s)
+        return "\n".join(l for l in lines if l)
+
+
+def wrap_expr(expr: Expression, conf: C.RapidsConf,
+              parent: Optional[BaseMeta]) -> ExprMeta:
+    from spark_rapids_tpu.plan.overrides import expr_rule_for
+    return ExprMeta(expr, conf, parent, expr_rule_for(expr))
+
+
+def wrap_plan(node: CpuNode, conf: C.RapidsConf,
+              parent: Optional[BaseMeta] = None) -> PlanMeta:
+    from spark_rapids_tpu.plan.overrides import exec_rule_for
+    return PlanMeta(node, conf, parent, exec_rule_for(node))
+
+
+def fix_up_exchange_overhead(meta: PlanMeta) -> None:
+    """An exchange surrounded by CPU-only neighbors is pure overhead on the
+    TPU — keep it on CPU (reference RapidsMeta.fixUpExchangeOverhead
+    :496)."""
+    from spark_rapids_tpu.plan.nodes import (
+        CpuBroadcastExchange, CpuShuffleExchange)
+
+    def walk(m: PlanMeta, parent_on_tpu: Optional[bool]) -> None:
+        is_exchange = isinstance(
+            m.node, (CpuShuffleExchange, CpuBroadcastExchange))
+        if is_exchange and m.can_this_be_replaced:
+            child_ok = all(c.can_this_be_replaced for c in m.child_plans)
+            if not child_ok and parent_on_tpu is not True:
+                m.will_not_work_on_tpu(
+                    "columnar exchange without columnar neighbors")
+        for c in m.child_plans:
+            walk(c, m.can_this_be_replaced)
+
+    walk(meta, None)

@@ -1,0 +1,587 @@
+"""Device-neutral physical plan nodes with real CPU (pandas) execution.
+
+These play the role of Spark's own row-based physical operators: the input
+to the plan-rewrite pass (`plan/overrides.py`), and the engine a node runs
+on when it is tagged off the TPU.  Each node carries `Expression` trees —
+the shared AST both engines understand (TPU: jitted columnar kernels; CPU:
+`plan/cpu_eval.py` pandas interpreter).
+
+Execution model mirrors the TPU side: `execute() ->
+list[Iterator[pd.DataFrame]]` (partitions of row chunks).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.sort import SortOrder
+from spark_rapids_tpu.exec.joins import JoinType
+from spark_rapids_tpu.exprs.base import Expression, output_name
+from spark_rapids_tpu.plan.cpu_eval import cpu_eval, nullable_dtype
+
+
+class CpuNode:
+    """Base physical node.  `schema` is the output schema; `children` the
+    input nodes."""
+
+    def __init__(self, *children: "CpuNode"):
+        self.children = list(children)
+
+    @property
+    def child(self) -> "CpuNode":
+        return self.children[0]
+
+    def output_schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    def output_partition_count(self) -> int:
+        """Planning-time partition count; must not execute anything."""
+        if not self.children:
+            return 1
+        return self.children[0].output_partition_count()
+
+    def execute(self) -> list[Iterator[pd.DataFrame]]:
+        raise NotImplementedError
+
+    def collect(self) -> pd.DataFrame:
+        parts = [df for it in self.execute() for df in it]
+        schema = self.output_schema()
+        if not parts:
+            return empty_df(schema)
+        out = pd.concat(parts, ignore_index=True)
+        return out
+
+    def name(self) -> str:
+        return type(self).__name__
+
+    def describe(self) -> str:
+        return self.name()
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + self.describe()
+        for c in self.children:
+            s += "\n" + c.tree_string(indent + 1)
+        return s
+
+    def __repr__(self):
+        return self.tree_string()
+
+
+def empty_df(schema: T.Schema) -> pd.DataFrame:
+    return pd.DataFrame({
+        f.name: pd.Series([], dtype=nullable_dtype(f.dtype))
+        for f in schema.fields})
+
+
+def normalize_df(df: pd.DataFrame, schema: T.Schema) -> pd.DataFrame:
+    """Coerce columns to the schema's nullable dtypes."""
+    out = {}
+    for f in schema.fields:
+        s = df[f.name]
+        want = nullable_dtype(f.dtype)
+        if str(s.dtype) != want:
+            try:
+                s = s.astype(want)
+            except (TypeError, ValueError):
+                pass
+        out[f.name] = s
+    return pd.DataFrame(out)
+
+
+# ---------------------------------------------------------------------------
+class CpuSource(CpuNode):
+    """In-memory partitioned source (LocalBatchSource analog)."""
+
+    def __init__(self, partitions: list[pd.DataFrame], schema: T.Schema):
+        super().__init__()
+        self.partitions = partitions
+        self._schema = schema
+
+    @staticmethod
+    def from_pandas(df: pd.DataFrame, num_partitions: int = 1) -> "CpuSource":
+        schema = schema_of_df(df)
+        if num_partitions <= 1 or not len(df):
+            return CpuSource([df], schema)
+        bounds = np.linspace(0, len(df), num_partitions + 1).astype(int)
+        parts = [df.iloc[bounds[i]:bounds[i + 1]].reset_index(drop=True)
+                 for i in range(num_partitions)]
+        return CpuSource(parts, schema)
+
+    def output_schema(self):
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return max(1, len(self.partitions))
+
+    def execute(self):
+        return [iter([p]) for p in self.partitions]
+
+
+def schema_of_df(df: pd.DataFrame) -> T.Schema:
+    fields = []
+    for name in df.columns:
+        s = df[name]
+        kind = s.dtype.kind if hasattr(s.dtype, "kind") else "O"
+        sd = str(s.dtype)
+        mapping = {"Int8": T.INT8, "Int16": T.INT16, "Int32": T.INT32,
+                   "Int64": T.INT64, "Float32": T.FLOAT32,
+                   "Float64": T.FLOAT64, "boolean": T.BOOL}
+        if sd in mapping:
+            fields.append(T.Field(name, mapping[sd]))
+        elif kind == "M":
+            fields.append(T.Field(name, T.TIMESTAMP_US))
+        elif kind == "b":
+            fields.append(T.Field(name, T.BOOL))
+        elif kind == "i":
+            fields.append(T.Field(name, T.from_numpy_dtype(s.dtype)))
+        elif kind == "f":
+            fields.append(T.Field(name, T.from_numpy_dtype(s.dtype)))
+        else:
+            fields.append(T.Field(name, T.STRING))
+    return T.Schema(tuple(fields))
+
+
+class CpuRange(CpuNode):
+    def __init__(self, start: int, end: int, step: int = 1,
+                 num_partitions: int = 1):
+        super().__init__()
+        self.start, self.end, self.step = start, end, step
+        self.num_partitions = num_partitions
+        self._schema = T.Schema.of(("id", T.INT64, False))
+
+    def output_partition_count(self) -> int:
+        return self.num_partitions
+
+    def output_schema(self):
+        return self._schema
+
+    def execute(self):
+        vals = np.arange(self.start, self.end, self.step, np.int64)
+        bounds = np.linspace(0, len(vals),
+                             self.num_partitions + 1).astype(int)
+        return [iter([pd.DataFrame(
+            {"id": pd.array(vals[bounds[i]:bounds[i + 1]], "Int64")})])
+            for i in range(self.num_partitions)]
+
+
+class CpuProject(CpuNode):
+    def __init__(self, exprs: Sequence[Expression], child: CpuNode):
+        super().__init__(child)
+        self.exprs = list(exprs)
+        cs = child.output_schema()
+        self._schema = T.Schema(tuple(
+            T.Field(output_name(e, i), e.data_type(cs))
+            for i, e in enumerate(self.exprs)))
+
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"CpuProject({', '.join(map(repr, self.exprs))})"
+
+    def execute(self):
+        cs = self.child.output_schema()
+
+        def run(it):
+            for df in it:
+                out = {}
+                for i, e in enumerate(self.exprs):
+                    out[output_name(e, i)] = cpu_eval(e, df, cs)
+                yield pd.DataFrame(out, index=df.index)
+        return [run(it) for it in self.child.execute()]
+
+
+class CpuFilter(CpuNode):
+    def __init__(self, condition: Expression, child: CpuNode):
+        super().__init__(child)
+        self.condition = condition
+        self._schema = child.output_schema()
+
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"CpuFilter({self.condition!r})"
+
+    def execute(self):
+        cs = self._schema
+
+        def run(it):
+            for df in it:
+                mask = cpu_eval(self.condition, df, cs)
+                mask = mask.astype("boolean").fillna(False).astype(bool)
+                yield df[mask.to_numpy()].reset_index(drop=True)
+        return [run(it) for it in self.child.execute()]
+
+
+class CpuUnion(CpuNode):
+    def __init__(self, *children: CpuNode):
+        super().__init__(*children)
+        self._schema = children[0].output_schema()
+
+    def output_schema(self):
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return sum(c.output_partition_count() for c in self.children)
+
+    def execute(self):
+        return [it for c in self.children for it in c.execute()]
+
+
+class CpuLimit(CpuNode):
+    def __init__(self, n: int, child: CpuNode, global_limit: bool = True):
+        super().__init__(child)
+        self.n = n
+        self.global_limit = global_limit
+        self._schema = child.output_schema()
+
+    def output_schema(self):
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return 1 if self.global_limit else \
+            self.child.output_partition_count()
+
+    def describe(self):
+        return f"CpuLimit({self.n}, global={self.global_limit})"
+
+    def execute(self):
+        if self.global_limit:
+            def run():
+                remaining = self.n
+                for it in self.child.execute():
+                    for df in it:
+                        if remaining <= 0:
+                            return
+                        out = df.iloc[:remaining]
+                        remaining -= len(out)
+                        yield out
+            return [run()]
+
+        def run_local(it):
+            remaining = self.n
+            for df in it:
+                if remaining <= 0:
+                    return
+                out = df.iloc[:remaining]
+                remaining -= len(out)
+                yield out
+        return [run_local(it) for it in self.child.execute()]
+
+
+class CpuSort(CpuNode):
+    def __init__(self, order: Sequence[SortOrder], child: CpuNode,
+                 global_sort: bool = True):
+        super().__init__(child)
+        self.order = list(order)
+        self.global_sort = global_sort
+        self._schema = child.output_schema()
+
+    def output_schema(self):
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return 1 if self.global_sort else \
+            self.child.output_partition_count()
+
+    def describe(self):
+        return f"CpuSort(global={self.global_sort})"
+
+    def _sort_df(self, df: pd.DataFrame) -> pd.DataFrame:
+        cs = self._schema
+        tmp = df.copy()
+        # pandas applies one na_position to all keys; per-key null ordering
+        # is emulated with a null-rank companion key per sort column
+        aug_by, flat_asc = [], []
+        for i, o in enumerate(self.order):
+            kname, nullkey = f"__sk{i}", f"__sk{i}_n"
+            key = cpu_eval(o.expr, df, cs)
+            isna = key.isna()
+            rank = np.where(isna, 0 if o.resolved_nulls_first else 1,
+                            0 if not o.resolved_nulls_first else 1)
+            if not o.ascending:  # sort_values flips every column the same way
+                rank = -rank
+            tmp[kname] = key
+            tmp[nullkey] = rank
+            aug_by.extend([nullkey, kname])
+            flat_asc.extend([o.ascending, o.ascending])
+        tmp = tmp.sort_values(aug_by, ascending=flat_asc, kind="stable",
+                              na_position="last")
+        return tmp[list(df.columns)].reset_index(drop=True)
+
+    def execute(self):
+        if self.global_sort:
+            parts = [df for it in self.child.execute() for df in it]
+            if not parts:
+                return [iter([])]
+            merged = pd.concat(parts, ignore_index=True)
+            return [iter([self._sort_df(merged)])]
+
+        def run(it):
+            chunk = [df for df in it]
+            if not chunk:
+                return
+            yield self._sort_df(pd.concat(chunk, ignore_index=True))
+        return [run(it) for it in self.child.execute()]
+
+
+_AGG_PANDAS = {
+    "Sum": "sum", "Min": "min", "Max": "max", "Average": "mean",
+    "Count": "count", "First": "first", "Last": "last",
+}
+
+
+def _agg_op(func):
+    """pandas groupby op for an AggregateFunction, honoring First/Last
+    ignore_nulls=False (Spark default: take the raw first/last row even if
+    null — pandas 'first'/'last' skip NA)."""
+    fname = type(func).__name__
+    if fname in ("First", "Last") and not getattr(func, "ignore_nulls",
+                                                  False):
+        idx = 0 if fname == "First" else -1
+        return lambda s: s.iloc[idx] if len(s) else None
+    return _AGG_PANDAS[fname]
+
+
+class CpuAggregate(CpuNode):
+    """Hash aggregation over pandas groupby (complete mode; the CPU side
+    does not split partial/final — it only runs when a whole aggregate
+    subtree fell back)."""
+
+    def __init__(self, group_exprs: Sequence[Expression],
+                 aggregates: Sequence, child: CpuNode):
+        from spark_rapids_tpu.exprs.aggregates import AggAlias
+        super().__init__(child)
+        self.group_exprs = list(group_exprs)
+        self.aggregates = [a if isinstance(a, AggAlias)
+                           else AggAlias(a, f"agg{i}")
+                           for i, a in enumerate(aggregates)]
+        cs = child.output_schema()
+        fields = [T.Field(output_name(e, i), e.data_type(cs))
+                  for i, e in enumerate(self.group_exprs)]
+        for a in self.aggregates:
+            fields.append(T.Field(a.name, a.func.result_type(cs)))
+        self._schema = T.Schema(tuple(fields))
+
+    def output_schema(self):
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return 1
+
+    def describe(self):
+        return (f"CpuAggregate(keys={len(self.group_exprs)}, "
+                f"aggs={[a.name for a in self.aggregates]})")
+
+    def execute(self):
+        cs = self.child.output_schema()
+        parts = [df for it in self.child.execute() for df in it]
+        if parts:
+            df = pd.concat(parts, ignore_index=True)
+        else:
+            df = empty_df(cs)
+        key_names = [output_name(e, i)
+                     for i, e in enumerate(self.group_exprs)]
+        work = pd.DataFrame(index=df.index)
+        for kn, e in zip(key_names, self.group_exprs):
+            work[kn] = cpu_eval(e, df, cs)
+        for a in self.aggregates:
+            if a.func.child is None:  # Count(*)
+                work[a.name] = pd.Series(
+                    np.ones(len(df), np.int64), index=df.index)
+            else:
+                work[a.name] = cpu_eval(a.func.child, df, cs)
+        if not key_names:  # reduction
+            row = {a.name: _reduce(work[a.name], a.func)
+                   for a in self.aggregates}
+            out = pd.DataFrame([row])
+            return [iter([normalize_df(out, self._schema)])]
+        grouped = work.groupby(key_names, dropna=False, sort=False)
+        cols = {}
+        for a in self.aggregates:
+            cols[a.name] = grouped[a.name].agg(_agg_op(a.func))
+        out = pd.DataFrame(cols).reset_index()
+        return [iter([normalize_df(out, self._schema)])]
+
+
+def _reduce(s: pd.Series, func):
+    fname = type(func).__name__
+    if fname == "Count":
+        return int(s.notna().sum())
+    if fname in ("First", "Last") and not getattr(func, "ignore_nulls",
+                                                  False):
+        if not len(s):
+            return None
+        v = s.iloc[0 if fname == "First" else -1]
+        return None if v is pd.NA else v
+    s2 = s.dropna()
+    if not len(s2):
+        return None
+    return {"Sum": s2.sum, "Min": s2.min, "Max": s2.max,
+            "Average": s2.mean, "First": lambda: s2.iloc[0],
+            "Last": lambda: s2.iloc[-1]}[fname]()
+
+
+class CpuHashJoin(CpuNode):
+    def __init__(self, join_type: JoinType,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 left: CpuNode, right: CpuNode,
+                 condition: Optional[Expression] = None,
+                 broadcast: bool = False):
+        super().__init__(left, right)
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+        self.broadcast = broadcast
+        ls, rs = left.output_schema(), right.output_schema()
+        if join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            self._schema = ls
+        else:
+            self._schema = T.Schema(tuple(ls.fields) + tuple(rs.fields))
+
+    def output_schema(self):
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return 1
+
+    def describe(self):
+        return f"CpuHashJoin({self.join_type.value})"
+
+    def execute(self):
+        ls = self.children[0].output_schema()
+        rs = self.children[1].output_schema()
+        lparts = [df for it in self.children[0].execute() for df in it]
+        rparts = [df for it in self.children[1].execute() for df in it]
+        ldf = (pd.concat(lparts, ignore_index=True) if lparts
+               else empty_df(ls))
+        rdf = (pd.concat(rparts, ignore_index=True) if rparts
+               else empty_df(rs))
+        lk = pd.DataFrame({f"__k{i}": cpu_eval(e, ldf, ls)
+                           for i, e in enumerate(self.left_keys)})
+        rk = pd.DataFrame({f"__k{i}": cpu_eval(e, rdf, rs)
+                           for i, e in enumerate(self.right_keys)})
+        # Spark joins never match null keys
+        lvalid = ~lk.isna().any(axis=1)
+        rvalid = ~rk.isna().any(axis=1)
+        laug = pd.concat(
+            [ldf, lk, pd.Series(np.arange(len(ldf)), name="__lrow")],
+            axis=1)
+        raug = pd.concat(
+            [rdf.add_prefix("__r_"), rk,
+             pd.Series(np.arange(len(rdf)), name="__rrow")], axis=1)
+        keys = [f"__k{i}" for i in range(len(self.left_keys))]
+        jt = self.join_type
+        if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            matched = laug[lvalid].merge(raug[rvalid][keys].drop_duplicates(),
+                                         on=keys, how="inner")["__lrow"]
+            mask = np.zeros(len(ldf), bool)
+            mask[matched.to_numpy()] = True
+            if jt == JoinType.LEFT_ANTI:
+                mask = ~mask
+                out = ldf[mask]
+            else:
+                out = ldf[mask]
+            return [iter([out.reset_index(drop=True)])]
+        how = {JoinType.INNER: "inner", JoinType.LEFT_OUTER: "left",
+               JoinType.RIGHT_OUTER: "right",
+               JoinType.FULL_OUTER: "outer"}[jt]
+        if how == "inner":
+            merged = laug[lvalid].merge(raug[rvalid], on=keys, how="inner")
+        elif how == "left":
+            merged = laug.merge(raug[rvalid], on=keys, how="left")
+        elif how == "right":
+            merged = laug[lvalid].merge(raug, on=keys, how="right")
+        else:
+            # full outer: null keys never match (pandas would match NA==NA),
+            # so join only valid keys and append null-key rows unmatched
+            merged = laug[lvalid].merge(raug[rvalid], on=keys, how="outer")
+            merged = pd.concat(
+                [merged, laug[~lvalid], raug[~rvalid]], ignore_index=True)
+        if self.condition is not None:
+            comb = pd.concat([
+                merged[[c for c in ldf.columns]].reset_index(drop=True),
+                merged[[f"__r_{c}" for c in rdf.columns]]
+                .rename(columns=lambda c: c[4:]).reset_index(drop=True)],
+                axis=1)
+            m = cpu_eval(self.condition, comb, self._schema)
+            keep = m.astype("boolean").fillna(False).astype(bool).to_numpy()
+            merged = merged[keep]
+        out = pd.concat([
+            merged[[c for c in ldf.columns]].reset_index(drop=True),
+            merged[[f"__r_{c}" for c in rdf.columns]]
+            .rename(columns=lambda c: c[4:]).reset_index(drop=True)],
+            axis=1)
+        return [iter([normalize_df(out, self._schema)])]
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitioningSpec:
+    """Device-neutral partitioning description, converted to a TPU
+    partitioner by the overrides (reference `parts` rules
+    GpuOverrides.scala:1597)."""
+    kind: str  # hash | range | roundrobin | single
+    num_partitions: int
+    exprs: tuple = ()
+    order: tuple = ()
+
+
+class CpuShuffleExchange(CpuNode):
+    def __init__(self, spec: PartitioningSpec, child: CpuNode):
+        super().__init__(child)
+        self.spec = spec
+        self._schema = child.output_schema()
+
+    def output_schema(self):
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return self.spec.num_partitions
+
+    def describe(self):
+        return f"CpuShuffleExchange({self.spec.kind}, {self.spec.num_partitions})"
+
+    def execute(self):
+        cs = self._schema
+        parts = [df for it in self.child.execute() for df in it]
+        df = (pd.concat(parts, ignore_index=True) if parts
+              else empty_df(cs))
+        n = self.spec.num_partitions
+        if self.spec.kind == "single" or n == 1:
+            return [iter([df])]
+        if self.spec.kind == "hash":
+            keys = pd.DataFrame({
+                f"k{i}": cpu_eval(e, df, cs)
+                for i, e in enumerate(self.spec.exprs)})
+            codes = pd.util.hash_pandas_object(keys, index=False)
+            pid = (codes % n).to_numpy().astype(int)
+        elif self.spec.kind == "roundrobin":
+            pid = np.arange(len(df)) % n
+        else:  # range
+            tmp = CpuSort(list(self.spec.order), CpuSource([df], cs))
+            df = tmp.collect()
+            pid = (np.arange(len(df)) * n // max(1, len(df)))
+        return [iter([df[pid == p].reset_index(drop=True)])
+                for p in range(n)]
+
+
+class CpuBroadcastExchange(CpuNode):
+    def __init__(self, child: CpuNode):
+        super().__init__(child)
+        self._schema = child.output_schema()
+
+    def output_schema(self):
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return 1
+
+    def execute(self):
+        return [iter([self.child.collect()])]

@@ -1,0 +1,414 @@
+"""Replacement-rule registry + the plan-rewrite entry point (reference
+`GpuOverrides.scala`: `ReplacementRule` builders for expressions /
+partitionings / execs, `GpuOverrides.apply` pre-pass and the
+`GpuTransitionOverrides` post-pass).
+
+`accelerate(cpu_plan, conf)` is the full pipeline:
+  wrap -> tag (bottom-up) -> consistency fixups -> explain -> convert
+  -> transitions (R2C/C2R bridges, coalesce insertion, pair elimination).
+
+Conversion is *planning* too: aggregate rules expand to
+partial -> exchange -> final (the shape Spark's planner produces before
+the reference ever sees it), joins insert key exchanges or broadcast, and
+global sorts become range-exchange + per-partition sort.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Optional, Sequence
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.exec import basic as B
+from spark_rapids_tpu.exec.aggregate import AggMode, HashAggregateExec
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.exec.joins import (
+    BroadcastHashJoinExec, HashJoinExec, JoinType, NestedLoopJoinExec)
+from spark_rapids_tpu.exec.limit import GlobalLimitExec, LocalLimitExec
+from spark_rapids_tpu.exec.sort import SortExec
+from spark_rapids_tpu.exprs.base import Expression
+from spark_rapids_tpu.plan import nodes as N
+from spark_rapids_tpu.plan.meta import (
+    PlanMeta, fix_up_exchange_overhead, wrap_plan)
+from spark_rapids_tpu.shuffle.exchange import (
+    BroadcastExchangeExec, ShuffleExchangeExec)
+from spark_rapids_tpu.shuffle.partitioning import (
+    HashPartitioning, RangePartitioning, RoundRobinPartitioning,
+    SinglePartitioning)
+
+log = logging.getLogger("spark_rapids_tpu.plan")
+
+
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class ExprRule:
+    """Per-expression replacement rule (reference ReplacementRule).  Our
+    Expression AST is shared between engines, so `convert` is identity —
+    the rule carries tagging knowledge: docs, incompat notes, extra tag
+    hooks."""
+    name: str
+    desc: str
+    incompat: Optional[str] = None
+    tag_extra: Optional[Callable] = None
+
+
+@dataclasses.dataclass
+class ExecRule:
+    cpu_class: type
+    desc: str
+    convert: Callable[[PlanMeta, list[TpuExec]], TpuExec]
+    exprs_of: Callable[[N.CpuNode], Sequence[Expression]] = lambda n: ()
+    tag_extra: Optional[Callable] = None
+
+    @property
+    def name(self) -> str:
+        return self.cpu_class.__name__
+
+
+EXPR_RULES: dict[str, ExprRule] = {}
+EXEC_RULES: dict[type, ExecRule] = {}
+
+
+def expr(name: str, desc: str, incompat: Optional[str] = None,
+         tag_extra=None) -> None:
+    EXPR_RULES[name] = ExprRule(name, desc, incompat, tag_extra)
+
+
+def register_exec(cpu_class, desc, convert, exprs_of=lambda n: (),
+                  tag_extra=None) -> None:
+    EXEC_RULES[cpu_class] = ExecRule(cpu_class, desc, convert, exprs_of,
+                                     tag_extra)
+
+
+def expr_rule_for(e: Expression) -> Optional[ExprRule]:
+    return EXPR_RULES.get(type(e).__name__)
+
+
+def exec_rule_for(node: N.CpuNode) -> Optional[ExecRule]:
+    return EXEC_RULES.get(type(node))
+
+
+# ---------------------------------------------------------------------------
+# expression registry: every TPU expression class, with incompat markers
+# mirroring the reference's (GpuOverrides.scala commonExpressions :491)
+_SIMPLE_EXPRS = """
+AttributeReference BoundReference Literal Alias
+Add Subtract Multiply Divide IntegralDivide Remainder Pmod UnaryMinus
+UnaryPositive Abs
+EqualTo EqualNullSafe LessThan LessThanOrEqual GreaterThan
+GreaterThanOrEqual And Or Not IsNull IsNotNull IsNaN InSet
+BitwiseAnd BitwiseOr BitwiseXor BitwiseNot ShiftLeft ShiftRight
+ShiftRightUnsigned
+If CaseWhen Coalesce NullIf Nvl2 AtLeastNNonNulls NaNvl
+Cast
+Year Month DayOfMonth DayOfWeek DayOfYear Quarter WeekOfYear LastDay
+Hour Minute Second DateAdd DateSub DateDiff AddMonths MonthsBetween
+UnixTimestamp FromUnixTime ToDate TruncDate
+Sqrt Cbrt Exp Expm1 Log Log1p Log2 Log10 Rint Signum Ceil Floor Pow Round
+MonotonicallyIncreasingID SparkPartitionID
+NormalizeNaNAndZero KnownFloatingPointNormalized KnownNotNull
+Length Upper Lower InitCap Substring StringTrim StringTrimLeft
+StringTrimRight ConcatStrings Contains StartsWith EndsWith Like
+StringLocate StringReplace LPad RPad
+Sum Count Min Max First Last
+GroupRef
+""".split()
+for _name in _SIMPLE_EXPRS:
+    expr(_name, f"TPU implementation of {_name}")
+
+# transcendentals differ in ulp from JVM StrictMath (reference marks these
+# incompat the same way)
+for _name in ("Sin", "Cos", "Tan", "Asin", "Acos", "Atan", "Sinh", "Cosh",
+              "Tanh", "ToDegrees", "ToRadians"):
+    expr(_name, f"TPU implementation of {_name}",
+         incompat="floating point results differ in ulp from the JVM")
+
+expr("Rand", "per-row uniform random", incompat="TPU RNG stream differs "
+     "from JVM XORShiftRandom")
+
+
+expr("Average", "TPU average")
+
+# single source of truth with the CPU engine's aggregate table
+_SUPPORTED_AGGS = set(N._AGG_PANDAS)
+
+
+def _tag_aggregate(meta) -> None:
+    """Aggregate-function checks (reference GpuHashAggregateMeta tagging:
+    registry membership + float-order-variance gating via
+    spark.rapids.sql.variableFloatAgg.enabled)."""
+    node = meta.node
+    child_schema = node.child.output_schema()
+    for a in node.aggregates:
+        fname = type(a.func).__name__
+        if fname not in _SUPPORTED_AGGS:
+            meta.will_not_work_on_tpu(
+                f"aggregate function {fname} has no TPU implementation")
+            continue
+        if fname in ("Average", "Sum") and not meta.conf[
+                C.VARIABLE_FLOAT_AGG] and a.func.child is not None:
+            try:
+                dt = a.func.child.data_type(child_schema)
+            except Exception:
+                continue
+            if dt.is_floating:
+                meta.will_not_work_on_tpu(
+                    f"float {fname} varies with evaluation order; enable "
+                    f"with {C.VARIABLE_FLOAT_AGG.key}")
+
+
+# ---------------------------------------------------------------------------
+# exec converters
+def _conv_source(meta, kids) -> TpuExec:
+    node: N.CpuSource = meta.node
+    from spark_rapids_tpu.plan.transitions import batch_from_df
+    parts = [[batch_from_df(df, node.output_schema())] if len(df) else []
+             for df in node.partitions]
+    return B.LocalBatchSource(parts, node.output_schema())
+
+
+def _conv_range(meta, kids) -> TpuExec:
+    node: N.CpuRange = meta.node
+    return B.RangeExec(node.start, node.end, node.step,
+                       num_partitions=node.num_partitions)
+
+
+def _conv_project(meta, kids) -> TpuExec:
+    return B.ProjectExec(meta.node.exprs, kids[0])
+
+
+def _conv_filter(meta, kids) -> TpuExec:
+    return B.FilterExec(meta.node.condition, kids[0])
+
+
+def _conv_union(meta, kids) -> TpuExec:
+    return B.UnionExec(*kids)
+
+
+def _conv_limit(meta, kids) -> TpuExec:
+    node: N.CpuLimit = meta.node
+    if node.global_limit:
+        return GlobalLimitExec(node.n, LocalLimitExec(node.n, kids[0]))
+    return LocalLimitExec(node.n, kids[0])
+
+
+def _conv_sort(meta, kids) -> TpuExec:
+    node: N.CpuSort = meta.node
+    if not node.global_sort:
+        return SortExec(node.order, kids[0], global_sort=False)
+    nparts = _num_partitions_of(kids[0])
+    if nparts > 1:
+        # total order: range-exchange then per-partition sort (the shape
+        # Spark's planner + reference produce for global sorts)
+        ex = ShuffleExchangeExec(
+            RangePartitioning(node.order, nparts), kids[0])
+        return SortExec(node.order, ex, global_sort=True)
+    return SortExec(node.order, kids[0], global_sort=True)
+
+
+def _num_partitions_of(plan: TpuExec) -> int:
+    return plan.output_partition_count()
+
+
+def _conv_aggregate(meta, kids) -> TpuExec:
+    node: N.CpuAggregate = meta.node
+    child = kids[0]
+    nparts = _num_partitions_of(child)
+    if nparts <= 1:
+        return HashAggregateExec(node.group_exprs, node.aggregates, child,
+                                 AggMode.COMPLETE)
+    # distributed: partial -> key exchange -> final (Spark planner shape;
+    # reference GpuHashAggregateMeta handles each stage)
+    partial = HashAggregateExec(node.group_exprs, node.aggregates, child,
+                                AggMode.PARTIAL)
+    if node.group_exprs:
+        from spark_rapids_tpu.exprs.base import col
+        keys = [col(f.name) for f in
+                partial.output_schema().fields[:len(node.group_exprs)]]
+        ex = ShuffleExchangeExec(HashPartitioning(keys, nparts), partial)
+    else:
+        ex = ShuffleExchangeExec(SinglePartitioning(), partial)
+    return HashAggregateExec(
+        [_group_ref(i, partial.output_schema())
+         for i in range(len(node.group_exprs))],
+        node.aggregates, ex, AggMode.FINAL)
+
+
+def _group_ref(i, partial_schema):
+    from spark_rapids_tpu.exprs.base import col, Alias
+    f = partial_schema.fields[i]
+    return Alias(col(f.name), f.name)
+
+
+def _conv_hash_join(meta, kids) -> TpuExec:
+    node: N.CpuHashJoin = meta.node
+    left, right = kids
+    if node.broadcast:
+        bex = BroadcastExchangeExec(right)
+        return BroadcastHashJoinExec(node.join_type, node.left_keys,
+                                     node.right_keys, left, bex,
+                                     node.condition)
+    nparts = max(_num_partitions_of(left), _num_partitions_of(right))
+    if nparts > 1:
+        left = ShuffleExchangeExec(
+            HashPartitioning(node.left_keys, nparts), left)
+        right = ShuffleExchangeExec(
+            HashPartitioning(node.right_keys, nparts), right)
+    return HashJoinExec(node.join_type, node.left_keys, node.right_keys,
+                        left, right, node.condition)
+
+
+def _tag_join(meta) -> None:
+    node: N.CpuHashJoin = meta.node
+    supported = {JoinType.INNER, JoinType.LEFT_OUTER, JoinType.RIGHT_OUTER,
+                 JoinType.FULL_OUTER, JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
+                 JoinType.CROSS}
+    if node.join_type not in supported:
+        meta.will_not_work_on_tpu(
+            f"join type {node.join_type} not supported on TPU")
+    if node.condition is not None and node.join_type not in (
+            JoinType.INNER, JoinType.CROSS):
+        meta.will_not_work_on_tpu(
+            "residual join condition only supported for inner joins")
+
+
+_PART_OF_SPEC = {
+    "hash": lambda s: HashPartitioning(list(s.exprs), s.num_partitions),
+    "roundrobin": lambda s: RoundRobinPartitioning(s.num_partitions),
+    "single": lambda s: SinglePartitioning(),
+    "range": lambda s: RangePartitioning(list(s.order), s.num_partitions),
+}
+
+
+def _conv_shuffle(meta, kids) -> TpuExec:
+    node: N.CpuShuffleExchange = meta.node
+    return ShuffleExchangeExec(_PART_OF_SPEC[node.spec.kind](node.spec),
+                               kids[0])
+
+
+def _conv_broadcast(meta, kids) -> TpuExec:
+    return BroadcastExchangeExec(kids[0])
+
+
+register_exec(N.CpuSource, "in-memory source", _conv_source)
+register_exec(N.CpuRange, "range generation", _conv_range)
+register_exec(N.CpuProject, "projection", _conv_project,
+              exprs_of=lambda n: n.exprs)
+register_exec(N.CpuFilter, "filtering", _conv_filter,
+              exprs_of=lambda n: [n.condition])
+register_exec(N.CpuUnion, "union all", _conv_union)
+register_exec(N.CpuLimit, "row limit", _conv_limit)
+register_exec(N.CpuSort, "sorting", _conv_sort,
+              exprs_of=lambda n: [o.expr for o in n.order])
+register_exec(
+    N.CpuAggregate, "hash aggregation", _conv_aggregate,
+    exprs_of=lambda n: list(n.group_exprs) + [
+        a.func.child for a in n.aggregates if a.func.child is not None],
+    tag_extra=_tag_aggregate)
+register_exec(
+    N.CpuHashJoin, "hash join", _conv_hash_join,
+    exprs_of=lambda n: list(n.left_keys) + list(n.right_keys) +
+    ([n.condition] if n.condition is not None else []),
+    tag_extra=_tag_join)
+register_exec(N.CpuShuffleExchange, "shuffle exchange", _conv_shuffle,
+              exprs_of=lambda n: list(n.spec.exprs) +
+              [o.expr for o in n.spec.order])
+register_exec(N.CpuBroadcastExchange, "broadcast exchange", _conv_broadcast)
+
+
+# ---------------------------------------------------------------------------
+class ExecutionPlanCapture:
+    """Captures the most recent accelerated plan so tests can assert plan
+    shape / fallback (reference ExecutionPlanCaptureCallback
+    Plugin.scala:148-237)."""
+
+    last_plan = None
+    last_meta: Optional[PlanMeta] = None
+
+    @classmethod
+    def assert_did_fall_back(cls, op_name: str) -> None:
+        assert cls.last_plan is not None, "no plan captured"
+        found = _find_cpu_node(cls.last_plan, op_name)
+        assert found, (f"expected {op_name} to fall back to CPU:\n"
+                       f"{cls.last_plan}")
+
+    @classmethod
+    def assert_contains_tpu(cls, exec_name: str) -> None:
+        assert cls.last_plan is not None, "no plan captured"
+        assert _find_tpu_node(cls.last_plan, exec_name), (
+            f"expected {exec_name} on TPU:\n{cls.last_plan}")
+
+
+def _find_cpu_node(plan, name: str) -> bool:
+    from spark_rapids_tpu.plan.transitions import (
+        ColumnarToRowExec, RowToColumnarExec)
+    if isinstance(plan, TpuExec):
+        if isinstance(plan, RowToColumnarExec):
+            return _find_cpu_node(plan.cpu_child, name)
+        return any(_find_cpu_node(c, name) for c in plan.children)
+    if plan.name() == name:
+        return True
+    if isinstance(plan, ColumnarToRowExec):
+        return _find_cpu_node(plan.tpu_child, name)
+    return any(_find_cpu_node(c, name) for c in plan.children)
+
+
+def _find_tpu_node(plan, name: str) -> bool:
+    from spark_rapids_tpu.plan.transitions import (
+        ColumnarToRowExec, RowToColumnarExec)
+    if isinstance(plan, TpuExec):
+        if type(plan).__name__ == name:
+            return True
+        if isinstance(plan, RowToColumnarExec):
+            return _find_tpu_node(plan.cpu_child, name)
+        return any(_find_tpu_node(c, name) for c in plan.children)
+    if isinstance(plan, ColumnarToRowExec):
+        return _find_tpu_node(plan.tpu_child, name)
+    return any(_find_tpu_node(c, name) for c in plan.children)
+
+
+# ---------------------------------------------------------------------------
+def accelerate(cpu_plan: N.CpuNode,
+               conf: Optional[C.RapidsConf] = None):
+    """The full rewrite: returns a TpuExec (fully accelerated), or a
+    CpuNode tree with accelerated islands (partial), or the original plan
+    (sql disabled)."""
+    conf = conf or C.get_active_conf()
+    if not conf[C.SQL_ENABLED]:
+        return cpu_plan
+    meta = wrap_plan(cpu_plan, conf)
+    meta.tag_for_tpu()
+    fix_up_exchange_overhead(meta)
+    explain_mode = conf[C.EXPLAIN]
+    if explain_mode != "NONE":
+        text = meta.explain(all_nodes=(explain_mode == "ALL"))
+        if text:
+            log.warning("TPU plan overrides:\n%s", text)
+    plan = meta.convert_if_needed()
+    from spark_rapids_tpu.plan.transitions import (
+        _coalesce_cpu_islands, insert_coalesce, optimize_transitions,
+        _optimize_tpu)
+    from spark_rapids_tpu.exec.base import TargetSize
+    if isinstance(plan, TpuExec):
+        plan = _optimize_tpu(plan)
+        plan = insert_coalesce(plan, conf)
+    else:
+        plan = optimize_transitions(plan)
+        _coalesce_cpu_islands(plan, TargetSize(conf[C.BATCH_SIZE_BYTES]))
+    if conf[C.TEST_ENABLED]:
+        from spark_rapids_tpu.plan.transitions import assert_is_on_tpu
+        allowed = {s for s in
+                   str(conf[C.TEST_ALLOWED_NONGPU]).split(",") if s}
+        assert_is_on_tpu(plan, allowed)
+    ExecutionPlanCapture.last_plan = plan
+    ExecutionPlanCapture.last_meta = meta
+    return plan
+
+
+def collect(plan) -> "object":
+    """Run an accelerated (or partially accelerated) plan to a pandas
+    DataFrame — the driver-side collect."""
+    if isinstance(plan, TpuExec):
+        from spark_rapids_tpu.plan.transitions import df_from_batch
+        return df_from_batch(plan.collect())
+    return plan.collect()

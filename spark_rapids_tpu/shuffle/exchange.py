@@ -33,6 +33,9 @@ class ShuffleExchangeExec(UnaryExecBase):
     def output_schema(self) -> T.Schema:
         return self._schema
 
+    def output_partition_count(self) -> int:
+        return self.partitioning.num_partitions
+
     def describe(self):
         return (f"ShuffleExchangeExec({type(self.partitioning).__name__}, "
                 f"n={self.partitioning.num_partitions})")
@@ -120,6 +123,9 @@ class BroadcastExchangeExec(UnaryExecBase):
 
     def output_schema(self):
         return self._schema
+
+    def output_partition_count(self) -> int:
+        return 1
 
     def broadcast_batch(self) -> ColumnarBatch:
         if self._cached is None:

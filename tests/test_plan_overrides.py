@@ -1,0 +1,292 @@
+"""Plan-rewrite layer tests: tagging, conversion, fallback islands,
+transitions, explain — plus the CPU/TPU parity golden rule (reference
+SparkQueryCompareTestSuite + StringFallbackSuite, SURVEY.md §4)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.joins import JoinType
+from spark_rapids_tpu.exec.sort import SortOrder, asc, desc
+from spark_rapids_tpu.exprs.aggregates import Average, Count, Max, Min, Sum
+from spark_rapids_tpu.exprs.base import col, lit
+from spark_rapids_tpu.exprs.math_exprs import Sin
+from spark_rapids_tpu.plan import (
+    CpuAggregate, CpuFilter, CpuHashJoin, CpuLimit, CpuProject, CpuRange,
+    CpuShuffleExchange, CpuSort, CpuSource, CpuUnion, ExecutionPlanCapture,
+    PartitioningSpec, accelerate, collect)
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.plan.nodes import CpuNode
+
+
+def conf(**kv):
+    return C.RapidsConf({k.replace("__", "."): v for k, v in kv.items()})
+
+
+def _df():
+    return pd.DataFrame({
+        "a": np.arange(10, dtype=np.int64),
+        "b": np.array([1.5, 2.5, np.nan, 4.0, 5.0, -1.0, 0.0, 7.5, 8.0,
+                       9.25]),
+        "s": [None if i % 4 == 0 else f"s{i}" for i in range(10)],
+    })
+
+
+def compare(cpu_plan, c=None, sort_by=None):
+    """Golden rule: run the plan on CPU only, then accelerated, diff."""
+    expected = cpu_plan.collect()
+    plan = accelerate(cpu_plan, c or conf())
+    got = collect(plan)
+    if sort_by:
+        expected = expected.sort_values(sort_by, ignore_index=True)
+        got = got.sort_values(sort_by, ignore_index=True)
+    assert list(expected.columns) == list(got.columns)
+    for name in expected.columns:
+        e = expected[name]
+        g = got[name]
+        ena, gna = e.isna().to_numpy(), g.isna().to_numpy()
+        np.testing.assert_array_equal(ena, gna, err_msg=f"null mask {name}")
+        ev, gv = e[~ena].to_numpy(), g[~gna].to_numpy()
+        if e.dtype == object or g.dtype == object:
+            assert list(ev) == list(gv), f"column {name}"
+        else:
+            np.testing.assert_allclose(
+                np.asarray(ev, float), np.asarray(gv, float), rtol=1e-6,
+                err_msg=f"column {name}")
+    return plan
+
+
+# -- conversion & parity ----------------------------------------------------
+def test_project_filter_parity():
+    src = CpuSource.from_pandas(_df(), num_partitions=2)
+    plan = CpuProject([(col("a") * 2).alias("x"),
+                       (col("b") + 1).alias("y"), col("s")],
+                      CpuFilter(col("a") > 2, src))
+    out = compare(plan)
+    assert isinstance(out, TpuExec)
+
+
+def test_aggregate_distributed_parity():
+    src = CpuSource.from_pandas(_df(), num_partitions=3)
+    plan = CpuAggregate([(col("a") % 3).alias("k")],
+                        [Sum(col("a")).alias("sa"),
+                         Count(col("s")).alias("cs"),
+                         Min(col("b")).alias("mb"),
+                         Max(col("a")).alias("xa")], src)
+    tpu = compare(plan, sort_by=["k"])
+    # distributed conversion: partial -> exchange -> final
+    names = _tpu_names(tpu)
+    assert names.count("HashAggregateExec") == 2
+    assert "ShuffleExchangeExec" in names
+
+
+def test_reduction_parity():
+    src = CpuSource.from_pandas(_df(), num_partitions=2)
+    plan = CpuAggregate([], [Sum(col("a")).alias("s"),
+                             Count(None).alias("n")], src)
+    compare(plan)
+
+
+def test_sort_global_parity():
+    src = CpuSource.from_pandas(_df(), num_partitions=3)
+    plan = CpuSort([desc(col("b"))], src)
+    expected = plan.collect()
+    got = collect(accelerate(plan, conf()))
+    np.testing.assert_array_equal(
+        expected["a"].to_numpy(), got["a"].to_numpy())
+
+
+def test_join_parity():
+    left = CpuSource.from_pandas(_df(), num_partitions=2)
+    right = CpuSource.from_pandas(pd.DataFrame({
+        "k": np.array([0, 1, 2, 9, 9], np.int64),
+        "v": ["x", "y", "z", "w", "q"]}), num_partitions=2)
+    plan = CpuHashJoin(JoinType.INNER, [col("a")], [col("k")], left, right)
+    compare(plan, sort_by=["a", "v"])
+
+
+def test_limit_union_range_parity():
+    r = CpuRange(0, 100, 1, num_partitions=4)
+    plan = CpuLimit(10, CpuUnion(r, CpuRange(100, 120, 1)))
+    got = collect(accelerate(plan, conf()))
+    assert len(got) == 10
+
+
+def test_shuffle_exchange_parity():
+    src = CpuSource.from_pandas(_df(), num_partitions=2)
+    plan = CpuShuffleExchange(
+        PartitioningSpec("hash", 4, (col("a"),)), src)
+    expected = plan.collect().sort_values("a", ignore_index=True)
+    got = collect(accelerate(plan, conf())).sort_values(
+        "a", ignore_index=True)
+    np.testing.assert_array_equal(expected["a"].to_numpy(),
+                                  got["a"].to_numpy())
+
+
+# -- tagging / fallback -----------------------------------------------------
+def _tpu_names(plan, acc=None):
+    acc = [] if acc is None else acc
+    if isinstance(plan, TpuExec):
+        acc.append(type(plan).__name__)
+        for c in plan.children:
+            _tpu_names(c, acc)
+    return acc
+
+
+def test_disabled_exec_falls_back():
+    src = CpuSource.from_pandas(_df())
+    plan = CpuFilter(col("a") > 2, src)
+    c = conf(**{"spark.rapids.sql.exec.CpuFilter": False})
+    out = accelerate(plan, c)
+    assert isinstance(out, CpuNode)
+    ExecutionPlanCapture.assert_did_fall_back("CpuFilter")
+    # result still correct through the fallback island
+    got = collect(out)
+    assert got["a"].tolist() == list(range(3, 10))
+
+
+def test_disabled_expression_falls_back():
+    src = CpuSource.from_pandas(_df())
+    plan = CpuProject([(col("a") + 1).alias("x")], src)
+    c = conf(**{"spark.rapids.sql.expression.Add": False})
+    out = accelerate(plan, c)
+    ExecutionPlanCapture.assert_did_fall_back("CpuProject")
+    assert collect(out)["x"].tolist() == list(range(1, 11))
+
+
+def test_incompat_op_gated():
+    src = CpuSource.from_pandas(_df())
+    plan = CpuProject([Sin(col("b")).alias("x")], src)
+    out = accelerate(plan, conf())
+    ExecutionPlanCapture.assert_did_fall_back("CpuProject")
+    out2 = accelerate(plan, conf(**{C.INCOMPATIBLE_OPS.key: True}))
+    assert isinstance(out2, TpuExec)
+
+
+def test_float_average_gated():
+    src = CpuSource.from_pandas(_df())
+    plan = CpuAggregate([], [Average(col("b")).alias("m")], src)
+    accelerate(plan, conf())
+    ExecutionPlanCapture.assert_did_fall_back("CpuAggregate")
+    out = accelerate(plan, conf(**{C.VARIABLE_FLOAT_AGG.key: True}))
+    assert isinstance(out, TpuExec)
+
+
+def test_sql_disabled_returns_original():
+    src = CpuSource.from_pandas(_df())
+    plan = CpuFilter(col("a") > 2, src)
+    out = accelerate(plan, conf(**{C.SQL_ENABLED.key: False}))
+    assert out is plan
+
+
+def test_partial_fallback_sandwich():
+    """TPU -> CPU island -> TPU: transitions inserted both ways and results
+    stay correct."""
+    src = CpuSource.from_pandas(_df(), num_partitions=2)
+    inner = CpuProject([col("a"), (col("a") * 3).alias("t")], src)
+    mid = CpuFilter(col("t") > 6, inner)
+    outer = CpuProject([(col("t") + 1).alias("u")], mid)
+    c = conf(**{"spark.rapids.sql.exec.CpuFilter": False})
+    plan = accelerate(outer, c)
+    got = collect(plan).sort_values("u", ignore_index=True)
+    expected = outer.collect().sort_values("u", ignore_index=True)
+    assert got["u"].tolist() == expected["u"].tolist()
+    ExecutionPlanCapture.assert_did_fall_back("CpuFilter")
+    ExecutionPlanCapture.assert_contains_tpu("ProjectExec")
+
+
+def test_exchange_overhead_fixup():
+    """Exchange whose child and parent are CPU-only stays on CPU."""
+    src = CpuSource.from_pandas(_df())
+    inner = CpuProject([col("a"), Sin(col("b")).alias("x")], src)  # incompat
+    ex = CpuShuffleExchange(PartitioningSpec("roundrobin", 2), inner)
+    outer = CpuProject([Sin(col("x")).alias("y")], ex)  # incompat
+    accelerate(outer, conf())
+    ExecutionPlanCapture.assert_did_fall_back("CpuShuffleExchange")
+
+
+def test_test_mode_asserts():
+    src = CpuSource.from_pandas(_df())
+    plan = CpuProject([Sin(col("b")).alias("x")], src)
+    with pytest.raises(AssertionError, match="did not run on the TPU"):
+        accelerate(plan, conf(**{C.TEST_ENABLED.key: True}))
+
+
+def test_explain_output():
+    src = CpuSource.from_pandas(_df())
+    plan = CpuProject([Sin(col("b")).alias("x")], src)
+    c = conf(**{C.EXPLAIN.key: "NOT_ON_GPU"})
+    meta_plan = accelerate(plan, c)
+    text = ExecutionPlanCapture.last_meta.explain()
+    assert "cannot run on TPU" in text
+    assert "Sin" in text
+
+
+def test_coalesce_inserted_after_filter():
+    src = CpuSource.from_pandas(_df(), num_partitions=2)
+    plan = accelerate(CpuSort([asc(col("a"))],
+                              CpuFilter(col("a") > 0, src)), conf())
+    names = _tpu_names(plan)
+    assert "CoalesceBatchesExec" in names
+
+
+# -- review-regression cases ------------------------------------------------
+def test_incompat_fallback_actually_runs():
+    """A fallen-back expression with no pandas interpreter must still
+    execute (columnar-on-host generic path)."""
+    src = CpuSource.from_pandas(_df())
+    plan = CpuProject([Sin(col("b")).alias("x"), col("a")], src)
+    out = accelerate(plan, conf())
+    ExecutionPlanCapture.assert_did_fall_back("CpuProject")
+    got = collect(out)
+    valid = got["x"].notna()
+    np.testing.assert_allclose(
+        np.asarray(got["x"][valid], float),
+        np.sin(_df()["b"][valid.to_numpy()]), rtol=1e-12)
+
+
+def test_full_outer_join_null_keys():
+    left = CpuSource.from_pandas(pd.DataFrame({
+        "k": pd.array([1, None, 3], dtype="Int64"),
+        "a": pd.array([10, 20, 30], dtype="Int64")}))
+    right = CpuSource.from_pandas(pd.DataFrame({
+        "k2": pd.array([1, None], dtype="Int64"),
+        "b": pd.array([100, 200], dtype="Int64")}))
+    from spark_rapids_tpu.exec.joins import JoinType
+    plan = CpuHashJoin(JoinType.FULL_OUTER, [col("k")], [col("k2")],
+                       left, right)
+    out = plan.collect()
+    # null keys never match: 1 matched + 2 left-unmatched-ish + 1 right
+    assert len(out) == 4
+    matched = out[out["b"].notna() & out["a"].notna()]
+    assert matched["k"].tolist() == [1]
+
+
+def test_remainder_negative_dividend_parity():
+    df = pd.DataFrame({"a": np.array([-7, -1, 0, 1, 7], np.int64)})
+    src = CpuSource.from_pandas(df)
+    plan = CpuProject([(col("a") % 3).alias("m")], src)
+    compare(plan)  # CPU fmod (sign follows dividend) == TPU lax.rem
+
+
+def test_first_with_leading_null():
+    from spark_rapids_tpu.exprs.aggregates import First
+    df = pd.DataFrame({"g": pd.array([1, 1, 2], dtype="Int64"),
+                       "x": pd.array([None, 5, 7], dtype="Int64")})
+    src = CpuSource.from_pandas(df)
+    plan = CpuAggregate([col("g")], [First(col("x")).alias("f")], src)
+    out = plan.collect().sort_values("g", ignore_index=True)
+    # Spark First(ignoreNulls=false): group 1 -> NULL
+    assert out["f"][0] is pd.NA or pd.isna(out["f"][0])
+    assert out["f"][1] == 7
+
+
+def test_accelerate_does_not_mutate_input():
+    src = CpuSource.from_pandas(_df())
+    plan = CpuFilter(col("a") > 2, src)
+    c = conf(**{"spark.rapids.sql.exec.CpuFilter": False})
+    accelerate(plan, c)
+    assert plan.children == [src]  # original tree untouched
+    expected = plan.collect()
+    assert expected["a"].tolist() == list(range(3, 10))
