@@ -97,6 +97,36 @@ PINNED_POOL_SIZE = conf("spark.rapids.memory.pinnedPool.size", 0,
 HBM_DEBUG = conf("spark.rapids.memory.gpu.debug", "NONE",
                  "Arena allocation debug logging: NONE, STDOUT, STDERR.")
 
+# --- I/O formats (reference RapidsConf.scala format enables + Spark's
+# spark.sql.files.* split planning keys) --------------------------------------
+PARQUET_ENABLED = conf("spark.rapids.sql.format.parquet.enabled", True,
+                       "Enable parquet scan/write acceleration.")
+PARQUET_READ_ENABLED = conf("spark.rapids.sql.format.parquet.read.enabled",
+                            True, "Enable accelerated parquet reads.")
+PARQUET_WRITE_ENABLED = conf("spark.rapids.sql.format.parquet.write.enabled",
+                             True, "Enable accelerated parquet writes.")
+ORC_ENABLED = conf("spark.rapids.sql.format.orc.enabled", True,
+                   "Enable ORC scan/write acceleration.")
+ORC_READ_ENABLED = conf("spark.rapids.sql.format.orc.read.enabled", True,
+                        "Enable accelerated ORC reads.")
+ORC_WRITE_ENABLED = conf("spark.rapids.sql.format.orc.write.enabled", True,
+                         "Enable accelerated ORC writes.")
+CSV_ENABLED = conf("spark.rapids.sql.format.csv.enabled", True,
+                   "Enable CSV scan acceleration (reads only).")
+CSV_READ_ENABLED = conf("spark.rapids.sql.format.csv.read.enabled", True,
+                        "Enable accelerated CSV reads.")
+MULTITHREAD_READ_NUM_THREADS = conf(
+    "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads", 20,
+    "Host file-buffering threads per executor (small-file optimization).")
+MAX_PARTITION_BYTES = conf("spark.sql.files.maxPartitionBytes", 134217728,
+                           "Max bytes packed into one scan partition.")
+FILE_OPEN_COST = conf("spark.sql.files.openCostInBytes", 4194304,
+                      "Estimated cost in bytes of opening a file when "
+                      "packing splits into scan partitions.")
+MIN_PARTITION_NUM = conf("spark.sql.files.minPartitionNum", 8,
+                         "Suggested minimum scan partition count (Spark "
+                         "defaults this to the cluster parallelism).")
+
 # --- shuffle (reference :592-631) -------------------------------------------
 SHUFFLE_TRANSPORT_CLASS = conf(
     "spark.rapids.shuffle.transport.class",

@@ -1,0 +1,33 @@
+"""I/O layer: file scans (parquet/ORC/CSV) and writers (SURVEY.md §2.7).
+
+Public helpers build planner-facing scan/write nodes; `accelerate()`
+replaces them with the TPU execs.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from spark_rapids_tpu import types as T
+
+
+def read_parquet(path: str, schema: Optional[T.Schema] = None):
+    from spark_rapids_tpu.io.exec import CpuFileScan, ScanDescription
+    return CpuFileScan(ScanDescription(path, "parquet", schema))
+
+
+def read_orc(path: str, schema: Optional[T.Schema] = None):
+    from spark_rapids_tpu.io.exec import CpuFileScan, ScanDescription
+    return CpuFileScan(ScanDescription(path, "orc", schema))
+
+
+def read_csv(path: str, schema: T.Schema, options=None):
+    from spark_rapids_tpu.io.exec import CpuFileScan, ScanDescription
+    return CpuFileScan(ScanDescription(path, "csv", schema, options))
+
+
+def write(child, path: str, file_format: str,
+          partition_by: Sequence[str] = (), mode: str = "error",
+          options=None):
+    from spark_rapids_tpu.io.exec import CpuWriteFiles
+    return CpuWriteFiles(child, path, file_format, partition_by, mode,
+                         options)

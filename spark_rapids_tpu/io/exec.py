@@ -1,0 +1,290 @@
+"""Scan and write physical operators + their device-neutral plan nodes.
+
+Reference execs: `GpuFileSourceScanExec.scala` (v1 scan),
+`GpuBatchScanExec.scala` (v2 scan — same reader factories here),
+`GpuDataWritingCommandExec.scala` / `GpuInsertIntoHadoopFsRelationCommand`.
+
+The CpuFileScan / CpuWriteFiles nodes are the planner-facing inputs
+(Spark's FileSourceScanExec / InsertIntoHadoopFsRelationCommand analogs);
+override rules in plan/overrides.py convert them to the TPU execs, with
+per-format enable confs and CSV option guards deciding fallback.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, empty_batch
+from spark_rapids_tpu.exec.base import LeafExec, TpuExec, UnaryExecBase
+from spark_rapids_tpu.exprs.base import Expression
+from spark_rapids_tpu.io.csv import CsvFormat, CsvOptions
+from spark_rapids_tpu.io.orc import OrcFormat
+from spark_rapids_tpu.io.parquet import ParquetFormat
+from spark_rapids_tpu.io.scan import (
+    FilePartition, FormatReader, MultiFileCoalescingReader, discover_files,
+    plan_file_partitions)
+from spark_rapids_tpu.io.writer import WriteJob, WriteStats
+from spark_rapids_tpu.plan.nodes import CpuNode, normalize_df
+
+
+def make_format(file_format: str, schema: Optional[T.Schema] = None,
+                options=None) -> FormatReader:
+    if file_format == "parquet":
+        return ParquetFormat()
+    if file_format == "orc":
+        return OrcFormat()
+    if file_format == "csv":
+        assert schema is not None, "CSV requires an explicit schema"
+        return CsvFormat(schema, options or CsvOptions())
+    raise ValueError(f"unsupported scan format {file_format}")
+
+
+class ScanDescription:
+    """Planned scan shared by the CPU node and the TPU exec: files
+    discovered, splits packed, schemas resolved."""
+
+    def __init__(self, path: str, file_format: str,
+                 schema: Optional[T.Schema] = None, options=None,
+                 conf: Optional[C.RapidsConf] = None):
+        conf = conf or C.get_active_conf()
+        self.path = path
+        self.file_format = file_format
+        self.options = options
+        self.reader = make_format(file_format, schema, options)
+        files, self.part_schema = discover_files(path, self.reader.extension)
+        self.partitions = plan_file_partitions(
+            files, conf[C.MAX_PARTITION_BYTES], conf[C.FILE_OPEN_COST],
+            min_partitions=conf[C.MIN_PARTITION_NUM])
+        if schema is not None:
+            self.data_schema = schema
+        else:
+            if not files:
+                raise FileNotFoundError(f"no {file_format} files in {path}")
+            self.data_schema = self.reader.file_schema(files[0].path)
+        # partition columns never live in the data files
+        self.data_schema = T.Schema(tuple(
+            f for f in self.data_schema.fields
+            if f.name not in self.part_schema.names))
+        self.output_schema = T.Schema(
+            tuple(self.data_schema.fields) + tuple(self.part_schema.fields))
+
+
+class CpuFileScan(CpuNode):
+    """Planner-facing scan node; also the CPU fallback execution."""
+
+    def __init__(self, scan: ScanDescription):
+        super().__init__()
+        self.scan = scan
+        self.pushed_filter: Optional[Expression] = None
+
+    def name(self) -> str:
+        return f"CpuFileScan[{self.scan.file_format}]"
+
+    def describe(self) -> str:
+        return (f"CpuFileScan[{self.scan.file_format}]({self.scan.path}, "
+                f"{len(self.scan.partitions)} partitions)")
+
+    def output_schema(self) -> T.Schema:
+        return self.scan.output_schema
+
+    def output_partition_count(self) -> int:
+        return max(1, len(self.scan.partitions))
+
+    def execute(self) -> list[Iterator[pd.DataFrame]]:
+        return [self._read_partition(p) for p in self.scan.partitions]
+
+    def _read_partition(self, part: FilePartition
+                        ) -> Iterator[pd.DataFrame]:
+        scan = self.scan
+        for split in part.splits:
+            table = scan.reader.read_split(split, scan.data_schema,
+                                           self.pushed_filter)
+            if table is None or table.num_rows == 0:
+                continue
+            df = table.to_pandas()
+            # storage model: dates as int32 days, timestamps int64 micros
+            for f in scan.data_schema.fields:
+                if f.name not in df.columns:
+                    df[f.name] = pd.Series([pd.NA] * len(df))
+                elif f.dtype.id == T.TypeId.DATE32 and \
+                        df[f.name].dtype.kind == "O":
+                    df[f.name] = pd.array(
+                        [None if v is None else
+                         (v - __import__("datetime").date(1970, 1, 1)).days
+                         for v in df[f.name]], "Int32")
+            pvals = dict(split.partition_values)
+            for f in scan.part_schema.fields:
+                df[f.name] = pvals.get(f.name)
+            yield normalize_df(df[list(scan.output_schema.names)],
+                               scan.output_schema)
+
+
+class TpuFileSourceScanExec(LeafExec):
+    """Columnar scan exec (reference `GpuFileSourceScanExec.scala:58`).
+    One output partition per FilePartition; host buffering overlaps device
+    work via the shared thread pool."""
+
+    def __init__(self, scan: ScanDescription,
+                 pushed_filter: Optional[Expression] = None,
+                 conf: Optional[C.RapidsConf] = None):
+        super().__init__()
+        self.scan = scan
+        self.pushed_filter = pushed_filter
+        self.conf = conf or C.get_active_conf()
+
+    def output_schema(self) -> T.Schema:
+        return self.scan.output_schema
+
+    def output_partition_count(self) -> int:
+        return max(1, len(self.scan.partitions))
+
+    def describe(self) -> str:
+        pf = f", pushed={self.pushed_filter!r}" if self.pushed_filter else ""
+        return (f"TpuFileSourceScanExec[{self.scan.file_format}]"
+                f"({self.scan.path}{pf})")
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        for it in self.execute_partitions():
+            yield from it
+
+    def execute_partitions(self) -> list[Iterator[ColumnarBatch]]:
+        outs = []
+        for p in self.scan.partitions:
+            outs.append(self._partition_iter(p))
+        return outs or [iter(())]
+
+    def _partition_iter(self, part: FilePartition
+                        ) -> Iterator[ColumnarBatch]:
+        reader = MultiFileCoalescingReader(
+            self.scan.reader, part, self.scan.data_schema,
+            self.scan.part_schema, self.pushed_filter, self.conf,
+            metrics=self.metrics)
+        for batch in reader:
+            self.update_output_metrics(batch)
+            yield batch
+
+
+# ---------------------------------------------------------------------------
+_WRITE_SCHEMA = T.Schema.of(("num_files", T.INT64), ("num_rows", T.INT64),
+                            ("num_bytes", T.INT64))
+
+
+class CpuWriteFiles(CpuNode):
+    """InsertIntoHadoopFsRelationCommand analog; executes the write on
+    whichever engine the child landed on.  Output: one summary row."""
+
+    def __init__(self, child: CpuNode, path: str, file_format: str,
+                 partition_by: Sequence[str] = (), mode: str = "error",
+                 options=None):
+        super().__init__(child)
+        self.path = path
+        self.file_format = file_format
+        self.partition_by = list(partition_by)
+        self.mode = mode
+        self.options = options
+
+    def name(self) -> str:
+        return f"CpuWriteFiles[{self.file_format}]"
+
+    def output_schema(self) -> T.Schema:
+        return _WRITE_SCHEMA
+
+    def output_partition_count(self) -> int:
+        return 1
+
+    def execute(self) -> list[Iterator[pd.DataFrame]]:
+        schema = self.child.output_schema()
+        job = WriteJob(self.path, self.file_format, schema,
+                       self.partition_by, self.mode, self.options)
+        job.setup()
+        stats_list = []
+        try:
+            for task_id, it in enumerate(self.child.execute()):
+                writer = job.task_writer(task_id)
+                for df in it:
+                    writer.write(ColumnarBatch.from_numpy(
+                        _df_data(df, schema), schema,
+                        _df_validity(df, schema)))
+                stats_list.append(writer.commit())
+        except BaseException:
+            job.abort()
+            raise
+        total = job.commit(stats_list)
+        return [iter([_stats_df(total)])]
+
+
+def _df_data(df: pd.DataFrame, schema: T.Schema) -> dict:
+    data = {}
+    for f in schema.fields:
+        s = df[f.name]
+        if f.dtype.is_string:
+            data[f.name] = np.array(
+                [None if v is None or v is pd.NA else v for v in s],
+                dtype=object)
+        else:
+            arr = s.to_numpy(dtype=f.dtype.storage_dtype, na_value=0)
+            data[f.name] = arr
+    return data
+
+
+def _df_validity(df: pd.DataFrame, schema: T.Schema) -> dict:
+    return {f.name: ~df[f.name].isna().to_numpy()
+            for f in schema.fields}
+
+
+def _stats_df(stats: WriteStats) -> pd.DataFrame:
+    return pd.DataFrame({"num_files": pd.array([stats.num_files], "Int64"),
+                         "num_rows": pd.array([stats.num_rows], "Int64"),
+                         "num_bytes": pd.array([stats.num_bytes], "Int64")})
+
+
+class TpuWriteFilesExec(UnaryExecBase):
+    """Columnar write exec (reference `GpuDataWritingCommandExec.scala`).
+    Tasks stream child batches straight from HBM into the host encoder."""
+
+    def __init__(self, node: CpuWriteFiles, child: TpuExec):
+        super().__init__(child)
+        self.node = node
+
+    def output_schema(self) -> T.Schema:
+        return _WRITE_SCHEMA
+
+    def output_partition_count(self) -> int:
+        return 1
+
+    def describe(self) -> str:
+        return (f"TpuWriteFilesExec[{self.node.file_format}]"
+                f"({self.node.path})")
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        node = self.node
+        schema = self.child.output_schema()
+        job = WriteJob(node.path, node.file_format, schema,
+                       node.partition_by, node.mode, node.options)
+        job.setup()
+        stats_list = []
+        try:
+            for task_id, it in enumerate(self.child.execute_partitions()):
+                writer = job.task_writer(task_id)
+                with self.metrics.timed():
+                    for batch in it:
+                        writer.write(batch)
+                stats_list.append(writer.commit())
+        except BaseException:
+            job.abort()
+            raise
+        total = job.commit(stats_list)
+        out = ColumnarBatch.from_numpy(
+            {"num_files": np.array([total.num_files], np.int64),
+             "num_rows": np.array([total.num_rows], np.int64),
+             "num_bytes": np.array([total.num_bytes], np.int64)},
+            _WRITE_SCHEMA)
+        self.update_output_metrics(out)
+        yield out
+
+    def execute_partitions(self):
+        return [self.execute_columnar()]

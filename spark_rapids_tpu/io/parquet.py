@@ -1,0 +1,135 @@
+"""Parquet scan + write.
+
+Reference: `GpuParquetScan.scala` — footer parse, predicate-pushdown
+row-group filtering (`filterBlocks:228`), schema clipping, host re-assembly
+of the needed column chunks, then device decode; and
+`GpuParquetFileFormat.scala` for the write side.
+
+TPU design: pyarrow owns the host-side footer parse and column-chunk
+decode (the role parquet-mr + cuDF's parquet reader share in the
+reference).  Row-group pruning happens on footer statistics *before* any
+data pages are read, so a selective filter touches only the matching
+byte ranges; decoded Arrow tables upload to HBM as one padded batch.
+Chunk selection follows Spark's convention: a row group belongs to the
+split containing its byte midpoint, so concurrent splits of one file
+never double-read a row group.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.io import pushdown as PD
+from spark_rapids_tpu.io.scan import FileSplit, FormatReader
+
+
+def _rg_midpoint(rg) -> int:
+    """Midpoint of the row group's COMPRESSED byte range (parquet-mr's
+    split-assignment rule): rg.total_byte_size is uncompressed and can
+    point past EOF, which would assign the row group to no split."""
+    first_col = rg.column(0)
+    start = first_col.dictionary_page_offset
+    if start is None:
+        start = first_col.data_page_offset
+    total = sum(rg.column(i).total_compressed_size
+                for i in range(rg.num_columns))
+    return start + total // 2
+
+
+def _stats_of_row_group(rg, names: list[str]) -> dict[str, PD.ColumnStats]:
+    stats: dict[str, PD.ColumnStats] = {}
+    for i in range(rg.num_columns):
+        col = rg.column(i)
+        name = col.path_in_schema.split(".")[0]
+        if name not in names:
+            continue
+        s = col.statistics
+        if s is None:
+            stats[name] = PD.ColumnStats(num_values=rg.num_rows)
+            continue
+        stats[name] = PD.ColumnStats(
+            min=s.min if s.has_min_max else None,
+            max=s.max if s.has_min_max else None,
+            null_count=s.null_count if s.has_null_count else None,
+            num_values=rg.num_rows)
+    return stats
+
+
+class ParquetFormat(FormatReader):
+    extension = ".parquet"
+
+    def file_schema(self, path: str) -> T.Schema:
+        import pyarrow.parquet as pq
+        sch = pq.read_schema(path)
+        return T.Schema(tuple(
+            T.Field(f.name, T.from_arrow(f.type)) for f in sch))
+
+    def read_split(self, split: FileSplit, read_schema: T.Schema,
+                   filter_expr) -> Optional["object"]:
+        import pyarrow.parquet as pq
+        f = pq.ParquetFile(split.path)
+        md = f.metadata
+        names = [n for n in read_schema.names
+                 if n in set(md.schema.to_arrow_schema().names)]
+        keep: list[int] = []
+        for rg_idx in range(md.num_row_groups):
+            rg = md.row_group(rg_idx)
+            if rg.num_rows == 0:
+                continue
+            mid = _rg_midpoint(rg)
+            if not (split.start <= mid < split.start + split.length):
+                continue
+            if filter_expr is not None and PD.might_match(
+                    filter_expr, _stats_of_row_group(rg, names)) is False:
+                continue
+            keep.append(rg_idx)
+        if not keep:
+            return None
+        return f.read_row_groups(keep, columns=names or None,
+                                 use_threads=False)
+
+
+# ---------------------------------------------------------------------------
+# write side (reference GpuParquetFileFormat.scala / ColumnarOutputWriter)
+_PA_COMPRESSION = {"none": "NONE", "uncompressed": "NONE", "snappy": "SNAPPY",
+                   "gzip": "GZIP", "zstd": "ZSTD", "lz4": "LZ4"}
+
+
+@dataclasses.dataclass
+class ParquetWriterOptions:
+    compression: str = "snappy"
+
+
+class ParquetColumnarWriter:
+    """Streams batches into one parquet file (reference
+    `ColumnarOutputWriter.scala`: chunked device encode; here the encode is
+    Arrow's parquet writer over the downloaded batch)."""
+
+    def __init__(self, path: str, schema: T.Schema,
+                 options: Optional[ParquetWriterOptions] = None):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        self.path = path
+        self.schema = schema
+        opts = options or ParquetWriterOptions()
+        codec = _PA_COMPRESSION.get(opts.compression.lower())
+        if codec is None:
+            raise ValueError(
+                f"unsupported parquet compression {opts.compression}")
+        self._arrow_schema = pa.schema(
+            [pa.field(f.name, T.to_arrow(f.dtype)) for f in schema.fields])
+        self._writer = pq.ParquetWriter(path, self._arrow_schema,
+                                        compression=codec.lower())
+        self.rows_written = 0
+        self.bytes_written = 0
+
+    def write_batch(self, batch) -> None:
+        table = batch.to_arrow().cast(self._arrow_schema)
+        self._writer.write_table(table)
+        self.rows_written += batch.num_rows
+
+    def close(self) -> None:
+        import os
+        self._writer.close()
+        self.bytes_written = os.path.getsize(self.path)

@@ -1,0 +1,316 @@
+"""Common file-scan infrastructure.
+
+Mirrors the reference's scan plumbing (SURVEY.md §2.7):
+  - `FileSplit`/`plan_file_partitions`: Spark's FilePartition bin-packing
+    (maxSplitBytes formula) that `GpuFileSourceScanExec.scala` reuses.
+  - `discover_files`: hive-style partition-value discovery (key=value dirs),
+    the input Spark's catalog provides in the reference.
+  - `append_partition_values`: per-batch partition-value columns
+    (reference `ColumnarPartitionReaderWithPartitionValues`).
+  - `MultiFileCoalescingReader`: thread-pool host-side buffering so file
+    I/O overlaps device compute (reference `MultiFileThreadPoolFactory`,
+    `GpuParquetScan.scala:647-698` small-file optimization).
+
+TPU boundary discipline (reference `GpuParquetScan.scala:1102`): all host
+parsing/decoding runs *before* the task acquires the TPU semaphore; only
+the final host→HBM upload holds it.
+"""
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import dataclasses
+import os
+import threading
+from typing import Any, Iterator, Optional, Sequence
+
+import numpy as np
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.vector import ColumnVector
+
+
+@dataclasses.dataclass(frozen=True)
+class FileSplit:
+    """A byte range of one file plus its hive partition values."""
+    path: str
+    start: int
+    length: int
+    file_size: int
+    partition_values: tuple[tuple[str, Any], ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class FilePartition:
+    """One task's worth of splits (Spark FilePartition)."""
+    index: int
+    splits: tuple[FileSplit, ...]
+
+
+def plan_file_partitions(files: Sequence[FileSplit],
+                         max_partition_bytes: int,
+                         open_cost_bytes: int,
+                         min_partitions: int = 1) -> list[FilePartition]:
+    """Spark's split packing: split each file at maxSplitBytes, sort splits
+    descending, first-fit into partitions of maxSplitBytes (each split
+    costs its length + open cost)."""
+    total = sum(f.length for f in files) + open_cost_bytes * len(files)
+    bytes_per_core = max(1, total // max(1, min_partitions))
+    max_split = min(max_partition_bytes, max(open_cost_bytes,
+                                             bytes_per_core))
+    splits: list[FileSplit] = []
+    for f in files:
+        off = f.start
+        remaining = f.length
+        while remaining > 0:
+            size = min(max_split, remaining)
+            splits.append(dataclasses.replace(f, start=off, length=size))
+            off += size
+            remaining -= size
+    splits.sort(key=lambda s: s.length, reverse=True)
+    partitions: list[list[FileSplit]] = []
+    sizes: list[int] = []
+    cur: list[FileSplit] = []
+    cur_size = 0
+    for s in splits:
+        # Spark's rule: close on length overflow, but account the open
+        # cost in the accumulated size (FilePartition.getFilePartitions)
+        if cur and cur_size + s.length > max_split:
+            partitions.append(cur)
+            sizes.append(cur_size)
+            cur, cur_size = [], 0
+        cur.append(s)
+        cur_size += s.length + open_cost_bytes
+    if cur:
+        partitions.append(cur)
+    if not partitions:
+        partitions = [[]]
+    return [FilePartition(i, tuple(p)) for i, p in enumerate(partitions)]
+
+
+# ---------------------------------------------------------------------------
+# hive-style partition discovery
+def discover_files(path: str, extension: Optional[str] = None
+                   ) -> tuple[list[FileSplit], T.Schema]:
+    """Walk `path`; parse key=value directory components into partition
+    values.  Returns (files, partition_schema).  Partition value types are
+    inferred (int64 else string), matching Spark's default inference."""
+    files: list[tuple[str, int, tuple[tuple[str, str], ...]]] = []
+    if os.path.isfile(path):
+        files.append((path, os.path.getsize(path), ()))
+    else:
+        for root, dirs, names in os.walk(path):
+            dirs[:] = sorted(d for d in dirs if not d.startswith(("_", ".")))
+            rel = os.path.relpath(root, path)
+            pvals = []
+            if rel != ".":
+                for comp in rel.split(os.sep):
+                    if "=" in comp:
+                        k, v = comp.split("=", 1)
+                        pvals.append((k, v))
+            for name in sorted(names):
+                if name.startswith(("_", ".")):
+                    continue
+                if extension and not name.endswith(extension):
+                    continue
+                full = os.path.join(root, name)
+                files.append((full, os.path.getsize(full), tuple(pvals)))
+    part_names: list[str] = []
+    for _, _, pvals in files:
+        for k, _ in pvals:
+            if k not in part_names:
+                part_names.append(k)
+    part_fields = []
+    typed_files = []
+    inferred: dict[str, T.DataType] = {}
+    for k in part_names:
+        vals = [dict(pv).get(k) for _, _, pv in files]
+        inferred[k] = _infer_partition_type([v for v in vals if v is not None])
+        part_fields.append(T.Field(k, inferred[k]))
+    for fpath, fsize, pvals in files:
+        d = dict(pvals)
+        typed = tuple((k, _convert_partition_value(d.get(k), inferred[k]))
+                      for k in part_names)
+        typed_files.append(FileSplit(fpath, 0, fsize, fsize, typed))
+    return typed_files, T.Schema(tuple(part_fields))
+
+
+def _infer_partition_type(raw: list[str]) -> T.DataType:
+    try:
+        for v in raw:
+            int(v)
+        return T.INT64
+    except (TypeError, ValueError):
+        return T.STRING
+
+
+def _convert_partition_value(raw: Optional[str], dt: T.DataType):
+    if raw is None or raw == "__HIVE_DEFAULT_PARTITION__":
+        return None
+    if dt == T.INT64:
+        return int(raw)
+    return raw
+
+
+def append_partition_values(batch: ColumnarBatch,
+                            part_schema: T.Schema,
+                            values: tuple[tuple[str, Any], ...]
+                            ) -> ColumnarBatch:
+    """Widen a data batch with broadcast partition-value columns."""
+    if not len(part_schema):
+        return batch
+    vals = dict(values)
+    cols = list(batch.columns)
+    fields = list(batch.schema.fields)
+    for f in part_schema.fields:
+        cols.append(ColumnVector.from_scalar(
+            vals.get(f.name), f.dtype, batch.capacity, batch.num_rows))
+        fields.append(f)
+    return ColumnarBatch(T.Schema(tuple(fields)), cols, batch.num_rows)
+
+
+# ---------------------------------------------------------------------------
+class FormatReader:
+    """Per-format host decode: split -> pyarrow Table (or None when the
+    split prunes to nothing).  Implementations must be thread-safe; they
+    run on the buffering pool."""
+
+    #: file extension used by partition discovery
+    extension: Optional[str] = None
+
+    def read_split(self, split: FileSplit, read_schema: T.Schema,
+                   filter_expr) -> Optional["object"]:
+        raise NotImplementedError
+
+    def file_schema(self, path: str) -> T.Schema:
+        raise NotImplementedError
+
+
+_POOL_LOCK = threading.Lock()
+_POOLS: dict[int, concurrent.futures.ThreadPoolExecutor] = {}
+
+
+def _buffering_pool(num_threads: int):
+    """Shared host-read pool (reference MultiFileThreadPoolFactory:647 —
+    one pool per executor, sized by conf).  Pools are keyed by size and
+    never shut down while readers may hold them (distinct sizes are rare:
+    one per configured numThreads value)."""
+    with _POOL_LOCK:
+        pool = _POOLS.get(num_threads)
+        if pool is None:
+            pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=num_threads,
+                thread_name_prefix="tpu-file-buffer")
+            _POOLS[num_threads] = pool
+        return pool
+
+
+class MultiFileCoalescingReader:
+    """Reads a partition's splits on the buffering pool, coalescing the
+    decoded host tables into device batches capped by the reader batch
+    limits.  The semaphore is taken only around host→HBM upload."""
+
+    def __init__(self, reader: FormatReader, partition: FilePartition,
+                 read_schema: T.Schema, part_schema: T.Schema,
+                 filter_expr, conf: C.RapidsConf, metrics=None):
+        self.reader = reader
+        self.partition = partition
+        self.read_schema = read_schema
+        self.part_schema = part_schema
+        self.filter_expr = filter_expr
+        self.conf = conf
+        self.metrics = metrics
+
+    def __iter__(self) -> Iterator[ColumnarBatch]:
+        import time
+        num_threads = self.conf[C.MULTITHREAD_READ_NUM_THREADS]
+        max_rows = self.conf[C.MAX_READER_BATCH_ROWS]
+        max_bytes = self.conf[C.MAX_READER_BATCH_BYTES]
+        pool = _buffering_pool(num_threads)
+        t0 = time.monotonic()
+        # bounded in-flight window: decoded host tables are consumed in
+        # split order, so only ~2x the pool's width is buffered at once
+        # (the reference throttles with a bounded buffer pool likewise)
+        window = max(2, num_threads * 2)
+        splits = list(self.partition.splits)
+        futures: collections.deque = collections.deque()
+        next_submit = 0
+
+        def _top_up():
+            nonlocal next_submit
+            while next_submit < len(splits) and len(futures) < window:
+                futures.append(pool.submit(
+                    self.reader.read_split, splits[next_submit],
+                    self.read_schema, self.filter_expr))
+                next_submit += 1
+
+        _top_up()
+        # accumulate host tables per partition-value group; flush when the
+        # next table would exceed the reader batch limits
+        pending: list = []
+        pending_rows = 0
+        pending_bytes = 0
+        pending_pvals: Optional[tuple] = None
+        for split in splits:
+            fut = futures.popleft()
+            table = fut.result()
+            _top_up()
+            if table is None or table.num_rows == 0:
+                continue
+            if (pending and
+                    (pending_pvals != split.partition_values or
+                     pending_rows + table.num_rows > max_rows or
+                     pending_bytes + table.nbytes > max_bytes)):
+                yield self._upload(pending, pending_pvals, t0)
+                t0 = time.monotonic()
+                pending, pending_rows, pending_bytes = [], 0, 0
+            pending.append(table)
+            pending_pvals = split.partition_values
+            pending_rows += table.num_rows
+            pending_bytes += table.nbytes
+        if pending:
+            yield self._upload(pending, pending_pvals, t0)
+
+    def _upload(self, tables: list, pvals, t0) -> ColumnarBatch:
+        import time
+
+        import pyarrow as pa
+
+        from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+        from spark_rapids_tpu.utils import metrics as M
+        table = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+        buffer_time = time.monotonic() - t0
+        TpuSemaphore.get().acquire_if_necessary()
+        t1 = time.monotonic()
+        batch = ColumnarBatch.from_arrow(table)
+        batch = _conform(batch, self.read_schema)
+        batch = append_partition_values(batch, self.part_schema, pvals or ())
+        if self.metrics is not None:
+            self.metrics.add(M.BUFFER_TIME, buffer_time)
+            self.metrics.add(M.DECODE_TIME, time.monotonic() - t1)
+        return batch
+
+
+def _conform(batch: ColumnarBatch, schema: T.Schema) -> ColumnarBatch:
+    """Schema evolution (reference `evolveSchemaIfNeededAndClose`
+    `GpuParquetScan.scala:529`): reorder to the read schema, add missing
+    columns as null, cast widened types."""
+    cols = []
+    for f in schema.fields:
+        try:
+            idx = batch.schema.index(f.name)
+        except KeyError:
+            cols.append(ColumnVector.from_scalar(
+                None, f.dtype, batch.capacity, batch.num_rows))
+            continue
+        c = batch.columns[idx]
+        if c.dtype != f.dtype:
+            from spark_rapids_tpu.exec.base import make_eval_context
+            from spark_rapids_tpu.exprs.base import BoundReference
+            from spark_rapids_tpu.exprs.cast import Cast
+            ctx = make_eval_context([c], batch.capacity, batch.num_rows)
+            c = Cast(BoundReference(0, c.dtype), f.dtype).eval(ctx)
+        cols.append(c)
+    return ColumnarBatch(schema, cols, batch.num_rows)

@@ -339,12 +339,15 @@ _AGG_PANDAS = {
 def _agg_op(func):
     """pandas groupby op for an AggregateFunction, honoring First/Last
     ignore_nulls=False (Spark default: take the raw first/last row even if
-    null — pandas 'first'/'last' skip NA)."""
+    null — pandas 'first'/'last' skip NA) and Spark's SUM-of-all-null =
+    NULL (pandas default min_count=0 would give 0)."""
     fname = type(func).__name__
     if fname in ("First", "Last") and not getattr(func, "ignore_nulls",
                                                   False):
         idx = 0 if fname == "First" else -1
         return lambda s: s.iloc[idx] if len(s) else None
+    if fname == "Sum":
+        return lambda s: s.sum(min_count=1)
     return _AGG_PANDAS[fname]
 
 
@@ -490,36 +493,59 @@ class CpuHashJoin(CpuNode):
             else:
                 out = ldf[mask]
             return [iter([out.reset_index(drop=True)])]
-        how = {JoinType.INNER: "inner", JoinType.LEFT_OUTER: "left",
-               JoinType.RIGHT_OUTER: "right",
-               JoinType.FULL_OUTER: "outer"}[jt]
-        if how == "inner":
-            merged = laug[lvalid].merge(raug[rvalid], on=keys, how="inner")
-        elif how == "left":
-            merged = laug.merge(raug[rvalid], on=keys, how="left")
-        elif how == "right":
-            merged = laug[lvalid].merge(raug, on=keys, how="right")
+        if self.condition is not None and jt in (
+                JoinType.LEFT_OUTER, JoinType.RIGHT_OUTER,
+                JoinType.FULL_OUTER):
+            # Spark applies the residual condition DURING matching: rows
+            # whose every match fails the condition are still emitted as
+            # unmatched (null-padded), never dropped
+            inner = laug[lvalid].merge(raug[rvalid], on=keys, how="inner")
+            inner = inner[self._condition_mask(inner, ldf, rdf)]
+            parts = [inner]
+            if jt in (JoinType.LEFT_OUTER, JoinType.FULL_OUTER):
+                matched = set(inner["__lrow"])
+                parts.append(laug[~laug["__lrow"].isin(matched)])
+            if jt in (JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+                matched = set(inner["__rrow"])
+                parts.append(raug[~raug["__rrow"].isin(matched)])
+            merged = pd.concat(parts, ignore_index=True)
         else:
-            # full outer: null keys never match (pandas would match NA==NA),
-            # so join only valid keys and append null-key rows unmatched
-            merged = laug[lvalid].merge(raug[rvalid], on=keys, how="outer")
-            merged = pd.concat(
-                [merged, laug[~lvalid], raug[~rvalid]], ignore_index=True)
-        if self.condition is not None:
-            comb = pd.concat([
-                merged[[c for c in ldf.columns]].reset_index(drop=True),
-                merged[[f"__r_{c}" for c in rdf.columns]]
-                .rename(columns=lambda c: c[4:]).reset_index(drop=True)],
-                axis=1)
-            m = cpu_eval(self.condition, comb, self._schema)
-            keep = m.astype("boolean").fillna(False).astype(bool).to_numpy()
-            merged = merged[keep]
+            how = {JoinType.INNER: "inner", JoinType.LEFT_OUTER: "left",
+                   JoinType.RIGHT_OUTER: "right",
+                   JoinType.FULL_OUTER: "outer"}[jt]
+            if how == "inner":
+                merged = laug[lvalid].merge(raug[rvalid], on=keys,
+                                            how="inner")
+            elif how == "left":
+                merged = laug.merge(raug[rvalid], on=keys, how="left")
+            elif how == "right":
+                merged = laug[lvalid].merge(raug, on=keys, how="right")
+            else:
+                # full outer: null keys never match (pandas would match
+                # NA==NA), so join valid keys, append null-key rows unmatched
+                merged = laug[lvalid].merge(raug[rvalid], on=keys,
+                                            how="outer")
+                merged = pd.concat(
+                    [merged, laug[~lvalid], raug[~rvalid]],
+                    ignore_index=True)
+            if self.condition is not None:
+                merged = merged[self._condition_mask(merged, ldf, rdf)]
         out = pd.concat([
             merged[[c for c in ldf.columns]].reset_index(drop=True),
             merged[[f"__r_{c}" for c in rdf.columns]]
             .rename(columns=lambda c: c[4:]).reset_index(drop=True)],
             axis=1)
         return [iter([normalize_df(out, self._schema)])]
+
+    def _condition_mask(self, merged: pd.DataFrame, ldf: pd.DataFrame,
+                        rdf: pd.DataFrame) -> np.ndarray:
+        comb = pd.concat([
+            merged[[c for c in ldf.columns]].reset_index(drop=True),
+            merged[[f"__r_{c}" for c in rdf.columns]]
+            .rename(columns=lambda c: c[4:]).reset_index(drop=True)],
+            axis=1)
+        m = cpu_eval(self.condition, comb, self._schema)
+        return m.astype("boolean").fillna(False).astype(bool).to_numpy()
 
 
 @dataclasses.dataclass(frozen=True)

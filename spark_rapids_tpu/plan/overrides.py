@@ -85,6 +85,7 @@ def expr_rule_for(e: Expression) -> Optional[ExprRule]:
 
 
 def exec_rule_for(node: N.CpuNode) -> Optional[ExecRule]:
+    _ensure_io_rules()
     return EXEC_RULES.get(type(node))
 
 
@@ -178,6 +179,13 @@ def _conv_project(meta, kids) -> TpuExec:
 
 
 def _conv_filter(meta, kids) -> TpuExec:
+    # filter-over-scan: push the predicate into the scan for row-group
+    # pruning (Spark pushed-filters shape); the FilterExec stays for
+    # exactness (stats pruning is conservative, not exact)
+    from spark_rapids_tpu.io.exec import TpuFileSourceScanExec
+    if isinstance(kids[0], TpuFileSourceScanExec) and \
+            kids[0].pushed_filter is None:
+        kids[0].pushed_filter = meta.node.condition
     return B.FilterExec(meta.node.condition, kids[0])
 
 
@@ -314,6 +322,73 @@ register_exec(N.CpuShuffleExchange, "shuffle exchange", _conv_shuffle,
               exprs_of=lambda n: list(n.spec.exprs) +
               [o.expr for o in n.spec.order])
 register_exec(N.CpuBroadcastExchange, "broadcast exchange", _conv_broadcast)
+
+
+# --- I/O (reference GpuOverrides scan rules + GpuReadXFileFormat checks) ----
+_FORMAT_ENABLES = {
+    "parquet": (C.PARQUET_ENABLED, C.PARQUET_READ_ENABLED,
+                C.PARQUET_WRITE_ENABLED),
+    "orc": (C.ORC_ENABLED, C.ORC_READ_ENABLED, C.ORC_WRITE_ENABLED),
+    "csv": (C.CSV_ENABLED, C.CSV_READ_ENABLED, None),
+}
+
+
+def _tag_file_scan(meta) -> None:
+    node = meta.node
+    fmt = node.scan.file_format
+    fmt_conf, read_conf, _ = _FORMAT_ENABLES[fmt]
+    if not meta.conf[fmt_conf]:
+        meta.will_not_work_on_tpu(
+            f"{fmt} acceleration disabled by {fmt_conf.key}")
+    elif not meta.conf[read_conf]:
+        meta.will_not_work_on_tpu(
+            f"{fmt} reads disabled by {read_conf.key}")
+    if fmt == "csv":
+        for reason in node.scan.reader.options.tag_unsupported():
+            meta.will_not_work_on_tpu(f"CSV: {reason}")
+
+
+def _conv_file_scan(meta, kids) -> TpuExec:
+    from spark_rapids_tpu.io.exec import TpuFileSourceScanExec
+    return TpuFileSourceScanExec(meta.node.scan, meta.node.pushed_filter,
+                                 meta.conf)
+
+
+def _tag_write_files(meta) -> None:
+    node = meta.node
+    if node.file_format not in ("parquet", "orc"):
+        meta.will_not_work_on_tpu(
+            f"{node.file_format} writes have no TPU implementation")
+        return
+    fmt_conf, _, write_conf = _FORMAT_ENABLES[node.file_format]
+    if not meta.conf[fmt_conf]:
+        meta.will_not_work_on_tpu(
+            f"{node.file_format} acceleration disabled by {fmt_conf.key}")
+    elif not meta.conf[write_conf]:
+        meta.will_not_work_on_tpu(
+            f"{node.file_format} writes disabled by {write_conf.key}")
+
+
+def _conv_write_files(meta, kids) -> TpuExec:
+    from spark_rapids_tpu.io.exec import TpuWriteFilesExec
+    return TpuWriteFilesExec(meta.node, kids[0])
+
+
+_io_rules_registered = False
+
+
+def _ensure_io_rules() -> None:
+    """Lazy registration: io.exec imports plan.nodes, so importing it at
+    module load would be circular through plan/__init__."""
+    global _io_rules_registered
+    if _io_rules_registered:
+        return
+    _io_rules_registered = True
+    from spark_rapids_tpu.io.exec import CpuFileScan, CpuWriteFiles
+    register_exec(CpuFileScan, "columnar file scan", _conv_file_scan,
+                  tag_extra=_tag_file_scan)
+    register_exec(CpuWriteFiles, "columnar file write", _conv_write_files,
+                  tag_extra=_tag_write_files)
 
 
 # ---------------------------------------------------------------------------

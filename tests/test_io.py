@@ -1,0 +1,321 @@
+"""I/O layer tests (reference: parquet/orc/csv read+write integration
+tests, SURVEY.md §4 tier 3; unit tests of split planning and pushdown)."""
+import datetime
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import io as tio
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import col, lit
+from spark_rapids_tpu.io import pushdown as PD
+from spark_rapids_tpu.io.csv import CsvOptions
+from spark_rapids_tpu.io.exec import ScanDescription, TpuFileSourceScanExec
+from spark_rapids_tpu.io.scan import (
+    FileSplit, discover_files, plan_file_partitions)
+from spark_rapids_tpu.io.writer import write_batches
+from spark_rapids_tpu.plan import (
+    CpuFilter, CpuProject, ExecutionPlanCapture, accelerate, collect)
+
+def conf(**kv):
+    return C.RapidsConf({k.replace("__", "."): v for k, v in kv.items()})
+
+
+def compare(cpu_plan, c=None, sort_by=None):
+    """Golden rule: run the plan on CPU only, then accelerated, diff."""
+    expected = cpu_plan.collect()
+    plan = accelerate(cpu_plan, c or conf())
+    got = collect(plan)
+    if sort_by:
+        expected = expected.sort_values(sort_by, ignore_index=True)
+        got = got.sort_values(sort_by, ignore_index=True)
+    assert list(expected.columns) == list(got.columns)
+    for name in expected.columns:
+        e, g = expected[name], got[name]
+        ena, gna = e.isna().to_numpy(), g.isna().to_numpy()
+        np.testing.assert_array_equal(ena, gna, err_msg=f"null mask {name}")
+        ev, gv = e[~ena].to_numpy(), g[~gna].to_numpy()
+        if e.dtype == object or g.dtype == object:
+            assert list(ev) == list(gv), f"column {name}"
+        else:
+            np.testing.assert_allclose(
+                np.asarray(ev, float), np.asarray(gv, float), rtol=1e-6,
+                err_msg=f"column {name}")
+    return plan
+
+
+def _sample_df(n=100, seed=7):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "i": np.arange(n, dtype=np.int64),
+        "f": rng.normal(size=n),
+        "s": [None if i % 11 == 0 else f"row{i}" for i in range(n)],
+        "d": [datetime.date(2020, 1, 1) + datetime.timedelta(days=int(i))
+              for i in range(n)],
+    })
+
+
+@pytest.fixture
+def pq_path(tmp_path):
+    df = _sample_df()
+    p = tmp_path / "data.parquet"
+    pq.write_table(pa.Table.from_pandas(df), p, row_group_size=20)
+    return str(p)
+
+
+# -- split planning ---------------------------------------------------------
+def test_plan_file_partitions_packs_and_splits():
+    files = [FileSplit(f"/f{i}", 0, 100 * 2 ** 20, 100 * 2 ** 20)
+             for i in range(4)]
+    parts = plan_file_partitions(files, 128 * 2 ** 20, 4 * 2 ** 20)
+    total = sum(s.length for p in parts for s in p.splits)
+    assert total == 4 * 100 * 2 ** 20
+    for p in parts:
+        assert sum(s.length + 4 * 2 ** 20 for s in p.splits) <= 128 * 2 ** 20
+
+    big = [FileSplit("/big", 0, 300 * 2 ** 20, 300 * 2 ** 20)]
+    parts = plan_file_partitions(big, 128 * 2 ** 20, 4 * 2 ** 20)
+    assert len(parts) >= 3  # file was split
+    covered = sorted((s.start, s.length) for p in parts for s in p.splits)
+    end = 0
+    for start, length in covered:
+        assert start == end
+        end = start + length
+    assert end == 300 * 2 ** 20
+
+
+def test_discover_hive_partitions(tmp_path):
+    for year, n in ((2020, 3), (2021, 4)):
+        d = tmp_path / f"year={year}"
+        d.mkdir()
+        pq.write_table(pa.Table.from_pandas(
+            pd.DataFrame({"x": np.arange(n, dtype=np.int64)})),
+            d / "part-0.parquet")
+    files, part_schema = discover_files(str(tmp_path), ".parquet")
+    assert len(files) == 2
+    assert part_schema.names == ("year",)
+    assert part_schema.field("year").dtype == T.INT64
+    assert dict(files[0].partition_values)["year"] == 2020
+
+
+# -- pushdown ---------------------------------------------------------------
+def test_pushdown_range_pruning():
+    stats = {"a": PD.ColumnStats(min=10, max=20, null_count=0,
+                                 num_values=100)}
+    assert PD.might_match(col("a") > 25, stats) is False
+    assert PD.might_match(col("a") > 15, stats) is True
+    assert PD.might_match(col("a") < 10, stats) is False
+    assert PD.might_match(col("a") <= 10, stats) is True
+    assert PD.might_match(col("a").eq(5), stats) is False
+    assert PD.might_match(lit(25) > col("a"), stats) is True
+    assert PD.might_match(lit(5) > col("a"), stats) is False
+    # and/or composition
+    assert PD.might_match((col("a") > 25) & (col("a") < 30), stats) is False
+    assert PD.might_match((col("a") > 25) | (col("a") < 12), stats) is True
+
+
+def test_pushdown_nulls_and_unknown():
+    stats = {"a": PD.ColumnStats(min=1, max=2, null_count=100,
+                                 num_values=100)}
+    from spark_rapids_tpu.exprs.predicates import IsNotNull, IsNull
+    assert PD.might_match(IsNotNull(col("a")), stats) is False
+    assert PD.might_match(IsNull(col("a")), stats) is True
+    assert PD.might_match(col("a") > 0, stats) is False  # all null
+    # unknown column stays
+    assert PD.might_match(col("zz") > 0, stats) is True
+
+
+# -- parquet ----------------------------------------------------------------
+def test_parquet_scan_parity(pq_path):
+    scan = tio.read_parquet(pq_path)
+    plan = compare(scan)
+    assert isinstance(plan, TpuFileSourceScanExec)
+
+
+def test_parquet_filter_pushdown_prunes_row_groups(pq_path):
+    c = conf()
+    scan = ScanDescription(pq_path, "parquet", conf=c)
+    exec_ = TpuFileSourceScanExec(scan, pushed_filter=(col("i") >= 90), conf=c)
+    rows = sum(b.num_rows for b in exec_.execute_columnar())
+    # only the last row group (rows 80..99) survives the stats filter
+    assert rows == 20
+
+
+def test_parquet_filter_query_parity(pq_path):
+    plan = CpuFilter((col("i") >= lit(25)) & (col("i") < lit(35)),
+                     tio.read_parquet(pq_path))
+    compare(plan)
+    tpu_plan = ExecutionPlanCapture.last_plan
+    scans = _find_scans(tpu_plan)
+    assert scans and scans[0].pushed_filter is not None
+
+
+def _find_scans(plan):
+    out = []
+    if isinstance(plan, TpuFileSourceScanExec):
+        out.append(plan)
+    for c in getattr(plan, "children", []):
+        out.extend(_find_scans(c))
+    return out
+
+
+def test_parquet_partitioned_dataset(tmp_path):
+    for year in (2020, 2021):
+        d = tmp_path / f"year={year}"
+        d.mkdir()
+        pq.write_table(pa.Table.from_pandas(pd.DataFrame({
+            "x": np.arange(5, dtype=np.int64) + year})), d / "p.parquet")
+    scan = tio.read_parquet(str(tmp_path))
+    assert scan.output_schema().names == ("x", "year")
+    compare(scan, sort_by=["year", "x"])
+
+
+def test_parquet_schema_evolution(tmp_path):
+    # file lacks column "extra"; read schema requests it -> nulls
+    pq.write_table(pa.Table.from_pandas(
+        pd.DataFrame({"x": np.arange(4, dtype=np.int64)})),
+        tmp_path / "f.parquet")
+    want = T.Schema.of(("x", T.INT64), ("extra", T.FLOAT64))
+    scan = tio.read_parquet(str(tmp_path / "f.parquet"), want)
+    df = collect(accelerate(scan, conf()))
+    assert df["extra"].isna().all()
+    assert list(df["x"]) == [0, 1, 2, 3]
+
+
+def test_parquet_fallback_when_disabled(pq_path):
+    c = conf().set(C.PARQUET_ENABLED.key, False)
+    plan = accelerate(tio.read_parquet(pq_path), c)
+    from spark_rapids_tpu.exec.base import TpuExec
+    assert not isinstance(plan, TpuExec)  # scan stayed on CPU
+    got = collect(plan)
+    assert len(got) == 100
+
+
+# -- orc --------------------------------------------------------------------
+def test_orc_scan_parity(tmp_path):
+    from pyarrow import orc
+    df = _sample_df(60)
+    p = tmp_path / "data.orc"
+    orc.write_table(pa.Table.from_pandas(df), str(p))
+    compare(tio.read_orc(str(p)))
+
+
+# -- csv --------------------------------------------------------------------
+def test_csv_scan_parity(tmp_path):
+    p = tmp_path / "data.csv"
+    with open(p, "w") as f:
+        f.write("i,f,s\n")
+        for i in range(50):
+            s = "" if i % 7 == 0 else f"v{i}"
+            f.write(f"{i},{i * 0.5},{s}\n")
+    schema = T.Schema.of(("i", T.INT64), ("f", T.FLOAT64), ("s", T.STRING))
+    scan = tio.read_csv(str(p), schema, CsvOptions(header=True))
+    plan = compare(scan)
+    assert isinstance(plan, TpuFileSourceScanExec)
+
+
+def test_csv_unsupported_options_fall_back(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("a;b\n1;2\n")
+    schema = T.Schema.of(("a", T.INT64), ("b", T.INT64))
+    scan = tio.read_csv(str(p), schema, CsvOptions(sep=";;"))
+    plan = accelerate(scan, conf())
+    from spark_rapids_tpu.exec.base import TpuExec
+    assert not isinstance(plan, TpuExec)
+
+
+def test_csv_split_line_boundaries(tmp_path):
+    # force multiple splits over one file; rows must not be lost/duplicated
+    p = tmp_path / "big.csv"
+    with open(p, "w") as f:
+        for i in range(2000):
+            f.write(f"{i},{'x' * (i % 37)}\n")
+    schema = T.Schema.of(("i", T.INT64), ("s", T.STRING))
+    c = conf().set(C.MAX_PARTITION_BYTES.key, 4096).set(
+        C.FILE_OPEN_COST.key, 0)
+    C.set_active_conf(c)
+    try:
+        scan = ScanDescription(str(p), "csv", schema, CsvOptions(), conf=c)
+        assert len(scan.partitions) > 1
+        exec_ = TpuFileSourceScanExec(scan, conf=c)
+        got = sorted(
+            v for b in exec_.execute_columnar()
+            for v in b.column("i").to_pylist(b.num_rows))
+        assert got == list(range(2000))
+    finally:
+        C.set_active_conf(C.RapidsConf())
+
+
+# -- write path -------------------------------------------------------------
+def test_parquet_write_roundtrip(tmp_path):
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    df = _sample_df(40)
+    out = str(tmp_path / "out")
+    batch = ColumnarBatch.from_pandas(df)
+    stats = write_batches(iter([batch]), out, "parquet", batch.schema)
+    assert stats.num_files == 1 and stats.num_rows == 40
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    back = collect(accelerate(tio.read_parquet(out), conf()))
+    assert len(back) == 40
+    assert list(back["i"]) == list(range(40))
+
+
+def test_write_exec_plan_parity(tmp_path):
+    df = _sample_df(30)
+    from spark_rapids_tpu.plan import CpuSource
+    out = str(tmp_path / "o1")
+    node = tio.write(CpuSource.from_pandas(df, num_partitions=2), out,
+                     "parquet")
+    plan = accelerate(node, conf())
+    from spark_rapids_tpu.io.exec import TpuWriteFilesExec
+    assert isinstance(plan, TpuWriteFilesExec)
+    res = collect(plan)
+    assert int(res["num_rows"][0]) == 30
+    back = collect(accelerate(tio.read_parquet(out), conf()))
+    assert sorted(back["i"]) == list(range(30))
+
+
+def test_dynamic_partition_write(tmp_path):
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    df = pd.DataFrame({
+        "k": ["a", "b", "a", None, "b", "a"],
+        "v": np.arange(6, dtype=np.int64)})
+    out = str(tmp_path / "parted")
+    batch = ColumnarBatch.from_pandas(df)
+    stats = write_batches(iter([batch]), out, "parquet", batch.schema,
+                          partition_by=["k"])
+    assert os.path.isdir(os.path.join(out, "k=a"))
+    assert os.path.isdir(os.path.join(out, "k=b"))
+    assert os.path.isdir(os.path.join(out, "k=__HIVE_DEFAULT_PARTITION__"))
+    assert stats.num_rows == 6
+    back = collect(accelerate(tio.read_parquet(out), conf()))
+    assert sorted(back["v"]) == list(range(6))
+
+
+def test_orc_write_roundtrip(tmp_path):
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    df = _sample_df(25)
+    out = str(tmp_path / "orcout")
+    batch = ColumnarBatch.from_pandas(df)
+    stats = write_batches(iter([batch]), out, "orc", batch.schema)
+    assert stats.num_rows == 25
+    back = collect(accelerate(tio.read_orc(out), conf()))
+    assert len(back) == 25
+
+
+def test_write_mode_error_and_overwrite(tmp_path):
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    df = pd.DataFrame({"x": np.arange(3, dtype=np.int64)})
+    out = str(tmp_path / "m")
+    b = ColumnarBatch.from_pandas(df)
+    write_batches(iter([b]), out, "parquet", b.schema)
+    with pytest.raises(FileExistsError):
+        write_batches(iter([b]), out, "parquet", b.schema)
+    write_batches(iter([b]), out, "parquet", b.schema, mode="overwrite")
+    back = collect(accelerate(tio.read_parquet(out), conf()))
+    assert len(back) == 3

@@ -290,3 +290,47 @@ def test_accelerate_does_not_mutate_input():
     assert plan.children == [src]  # original tree untouched
     expected = plan.collect()
     assert expected["a"].tolist() == list(range(3, 10))
+
+
+def test_cpu_grouped_sum_all_null_group_is_null():
+    df = pd.DataFrame({"g": pd.array([1, 1, 2], dtype="Int64"),
+                       "x": pd.array([None, None, 5], dtype="Int64")})
+    plan = CpuAggregate([col("g")], [Sum(col("x")).alias("s")],
+                        CpuSource.from_pandas(df))
+    out = plan.collect().sort_values("g", ignore_index=True)
+    # Spark: SUM over an all-null group is NULL, not 0
+    assert pd.isna(out["s"][0])
+    assert out["s"][1] == 5
+
+
+def test_cpu_left_outer_join_residual_condition_keeps_unmatched():
+    left = CpuSource.from_pandas(pd.DataFrame({
+        "k": pd.array([1, 2, 3], dtype="Int64"),
+        "lv": pd.array([10, 20, 30], dtype="Int64")}))
+    right = CpuSource.from_pandas(pd.DataFrame({
+        "k2": pd.array([1, 2], dtype="Int64"),
+        "rv": pd.array([100, 5], dtype="Int64")}))
+    plan = CpuHashJoin(JoinType.LEFT_OUTER, [col("k")], [col("k2")],
+                       left, right, condition=col("rv") > col("lv"))
+    out = plan.collect().sort_values("k", ignore_index=True)
+    # every left row survives; k=2 match fails the condition -> null right,
+    # k=3 has no match -> null right
+    assert out["k"].tolist() == [1, 2, 3]
+    assert out["rv"][0] == 100
+    assert pd.isna(out["rv"][1]) and pd.isna(out["rv"][2])
+
+
+def test_cpu_full_outer_join_residual_condition():
+    left = CpuSource.from_pandas(pd.DataFrame({
+        "k": pd.array([1, 2], dtype="Int64"),
+        "lv": pd.array([10, 20], dtype="Int64")}))
+    right = CpuSource.from_pandas(pd.DataFrame({
+        "k2": pd.array([2, 9], dtype="Int64"),
+        "rv": pd.array([5, 99], dtype="Int64")}))
+    plan = CpuHashJoin(JoinType.FULL_OUTER, [col("k")], [col("k2")],
+                       left, right, condition=col("rv") > col("lv"))
+    out = plan.collect()
+    # condition fails the k=2 match: both sides re-emitted unmatched
+    assert len(out) == 4
+    assert sorted(out["k"].dropna().tolist()) == [1, 2]
+    assert sorted(out["rv"].dropna().tolist()) == [5, 99]
