@@ -128,6 +128,10 @@ MIN_PARTITION_NUM = conf("spark.sql.files.minPartitionNum", 8,
                          "defaults this to the cluster parallelism).")
 
 # --- shuffle (reference :592-631) -------------------------------------------
+RAPIDS_SHUFFLE_ENABLED = conf(
+    "spark.rapids.shuffle.enabled", False,
+    "Route exchanges through the accelerated shuffle manager (spillable "
+    "catalog + ICI/DCN transport) instead of the in-process exchange.")
 SHUFFLE_TRANSPORT_CLASS = conf(
     "spark.rapids.shuffle.transport.class",
     "spark_rapids_tpu.shuffle.ici_transport.IciShuffleTransport",

@@ -47,6 +47,8 @@ class ScanDescription:
     """Planned scan shared by the CPU node and the TPU exec: files
     discovered, splits packed, schemas resolved."""
 
+    _EXTENSIONS = {"parquet": ".parquet", "orc": ".orc", "csv": ".csv"}
+
     def __init__(self, path: str, file_format: str,
                  schema: Optional[T.Schema] = None, options=None,
                  conf: Optional[C.RapidsConf] = None):
@@ -54,21 +56,26 @@ class ScanDescription:
         self.path = path
         self.file_format = file_format
         self.options = options
-        self.reader = make_format(file_format, schema, options)
-        files, self.part_schema = discover_files(path, self.reader.extension)
-        self.partitions = plan_file_partitions(
-            files, conf[C.MAX_PARTITION_BYTES], conf[C.FILE_OPEN_COST],
-            min_partitions=conf[C.MIN_PARTITION_NUM])
+        files, self.part_schema = discover_files(
+            path, self._EXTENSIONS[file_format])
+        # partition columns never live in the data files — strip them
+        # BEFORE building the reader (the CSV parser needs the exact
+        # per-file column list)
         if schema is not None:
-            self.data_schema = schema
+            self.data_schema = T.Schema(tuple(
+                f for f in schema.fields
+                if f.name not in self.part_schema.names))
         else:
             if not files:
                 raise FileNotFoundError(f"no {file_format} files in {path}")
-            self.data_schema = self.reader.file_schema(files[0].path)
-        # partition columns never live in the data files
-        self.data_schema = T.Schema(tuple(
-            f for f in self.data_schema.fields
-            if f.name not in self.part_schema.names))
+            probe = make_format(file_format, None, options)
+            self.data_schema = T.Schema(tuple(
+                f for f in probe.file_schema(files[0].path).fields
+                if f.name not in self.part_schema.names))
+        self.reader = make_format(file_format, self.data_schema, options)
+        self.partitions = plan_file_partitions(
+            files, conf[C.MAX_PARTITION_BYTES], conf[C.FILE_OPEN_COST],
+            min_partitions=conf[C.MIN_PARTITION_NUM])
         self.output_schema = T.Schema(
             tuple(self.data_schema.fields) + tuple(self.part_schema.fields))
 

@@ -66,6 +66,9 @@ class WriteJob:
         self.partition_by = list(partition_by)
         self.mode = mode
         self.options = options
+        # validate the format BEFORE setup() can destroy existing output
+        self._writer_cls, self._writer_opts, self._ext = _writer_factory(
+            file_format, options)
         self.job_id = uuid.uuid4().hex[:12]
         self.staging = os.path.join(output_path, "_temporary", self.job_id)
 
@@ -82,12 +85,10 @@ class WriteJob:
     def task_writer(self, task_id: int) -> "DataWriter":
         data_schema = T.Schema(tuple(
             f for f in self.schema.fields if f.name not in self.partition_by))
-        cls, opts, ext = _writer_factory(self.file_format, self.options)
-        if self.partition_by:
-            return DynamicPartitionDataWriter(
-                self, task_id, data_schema, cls, opts, ext)
-        return SingleDirectoryDataWriter(
-            self, task_id, data_schema, cls, opts, ext)
+        cls = (DynamicPartitionDataWriter if self.partition_by
+               else SingleDirectoryDataWriter)
+        return cls(self, task_id, data_schema, self._writer_cls,
+                   self._writer_opts, self._ext)
 
     def commit(self, task_stats: Sequence[WriteStats]) -> WriteStats:
         """Move committed task output from staging to the final dir."""
@@ -190,41 +191,53 @@ class DynamicPartitionDataWriter(DataWriter):
     def write(self, batch: ColumnarBatch) -> None:
         if batch.num_rows == 0:
             return
-        part_cols = [n for n in self.job.partition_by]
-        # host-side partition keys (partition columns are small); slice the
-        # device batch per distinct run
-        key_arrays = []
-        for name in part_cols:
-            vals, validity = batch.column(name).to_numpy(batch.num_rows)
-            key_arrays.append([
-                None if not validity[i] else
-                (vals[i] if isinstance(vals[i], str) else vals[i].item()
-                 if hasattr(vals[i], "item") else vals[i])
-                for i in range(batch.num_rows)])
-        keys = list(zip(*key_arrays))
-        order = np.array(sorted(range(len(keys)),
-                                key=lambda i: tuple(
-                                    (k is None, k) for k in keys[i])),
-                         dtype=np.int64)
-        runs: list[tuple[tuple, list[int]]] = []
-        for i in order:
-            k = keys[i]
-            if runs and runs[-1][0] == k:
-                runs[-1][1].append(i)
+        n = batch.num_rows
+        # vectorized host-side key sort: np.lexsort over (null-rank, value)
+        # per partition column, most-significant column last in the key
+        # list (lexsort convention); runs of equal keys are found with one
+        # adjacent-compare pass
+        cols = []  # (values, validity) in partition_by order
+        sort_keys = []
+        for name in self.job.partition_by:
+            vals, validity = batch.column(name).to_numpy(n)
+            if vals.dtype == object:
+                sortable = np.array(
+                    ["" if v is None else str(v) for v in vals])
             else:
-                runs.append((k, [i]))
+                sortable = vals
+            cols.append((vals, validity))
+            sort_keys.append((sortable, ~validity))
+        lex = []
+        for sortable, null_rank in reversed(sort_keys):
+            lex.append(sortable)
+            lex.append(null_rank)  # more significant than the value
+        order = np.lexsort(lex)
+        changed = np.zeros(n, bool)
+        changed[0] = True
+        for sortable, null_rank in sort_keys:
+            sv, nr = sortable[order], null_rank[order]
+            changed[1:] |= (sv[1:] != sv[:-1]) | (nr[1:] != nr[:-1])
+        starts = np.flatnonzero(changed)
+        ends = np.append(starts[1:], n)
         import jax.numpy as jnp
 
         from spark_rapids_tpu.columnar.vector import bucket_capacity
-        for key, rows in runs:
+        for s, e in zip(starts, ends):
+            first = order[s]
+            key = tuple(
+                None if not validity[first] else
+                (vals[first] if isinstance(vals[first], str)
+                 else vals[first].item() if hasattr(vals[first], "item")
+                 else vals[first])
+                for vals, validity in cols)
             if key != self._current_key:
                 self._roll(key)
-            n = len(rows)
-            cap = bucket_capacity(n)
+            rows = order[s:e]
+            cap = bucket_capacity(len(rows))
             idx = np.zeros(cap, np.int64)
-            idx[:n] = rows
-            valid = jnp.arange(cap) < n
-            sub = batch.gather(jnp.asarray(idx), valid, n)
+            idx[: len(rows)] = rows
+            valid = jnp.arange(cap) < len(rows)
+            sub = batch.gather(jnp.asarray(idx), valid, len(rows))
             self._writer.write_batch(sub.select(self.data_schema.names))
 
     def _roll(self, key: tuple) -> None:

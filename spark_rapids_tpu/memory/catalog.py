@@ -66,6 +66,10 @@ class BufferCatalog:
         finally:
             self.release_buffer(buf)
 
+    def ids(self) -> list[BufferId]:
+        with self._lock:
+            return list(self._by_id)
+
     def is_registered(self, bid: BufferId) -> bool:
         with self._lock:
             return bid in self._by_id

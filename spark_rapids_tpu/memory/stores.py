@@ -237,7 +237,22 @@ class HostMemoryStore(BufferStore):
         self.arena = HostArena(size)
 
     def copy_buffer(self, buf: SpillableBuffer) -> SpillableBuffer:
-        blob = buf.get_host_bytes()
+        return self._add(buf.id, buf.get_host_bytes, buf.meta,
+                         buf.spill_priority,
+                         lambda: self.spill_store.copy_buffer(buf))
+
+    def add_blob(self, bid: BufferId, blob: bytes, meta: TableMeta,
+                 spill_priority: float = 0.0) -> SpillableBuffer:
+        """Store an already-serialized batch (shuffle receive path —
+        reference ShuffleReceivedBufferCatalog adds to the host tier)."""
+        return self._add(
+            bid, lambda: blob, meta, spill_priority,
+            lambda: self.spill_store.add_blob(bid, blob, meta,
+                                              spill_priority))
+
+    def _add(self, bid: BufferId, get_blob, meta: TableMeta,
+             spill_priority: float, fall_through) -> SpillableBuffer:
+        blob = get_blob()
         off = self.arena.allocator.allocate(len(blob))
         if off is None:
             # try to make room by spilling our own contents downward
@@ -249,10 +264,9 @@ class HostMemoryStore(BufferStore):
                 if self.spill_store is None:
                     raise MemoryError(
                         f"host store full ({len(blob)} bytes needed)")
-                return self.spill_store.copy_buffer(buf)
+                return fall_through()
         self.arena.write(off, blob)
-        hb = HostBuffer(buf.id, self, off, len(blob), buf.meta,
-                        buf.spill_priority)
+        hb = HostBuffer(bid, self, off, len(blob), meta, spill_priority)
         self._track(hb)
         return hb
 
@@ -319,11 +333,15 @@ class DiskStore(BufferStore):
         self.block_manager = block_manager or DiskBlockManager()
 
     def copy_buffer(self, buf: SpillableBuffer) -> SpillableBuffer:
-        blob = buf.get_host_bytes()
-        path = self.block_manager.path_for(buf.id)
+        return self.add_blob(buf.id, buf.get_host_bytes(), buf.meta,
+                             buf.spill_priority)
+
+    def add_blob(self, bid: BufferId, blob: bytes, meta: TableMeta,
+                 spill_priority: float = 0.0) -> SpillableBuffer:
+        path = self.block_manager.path_for(bid)
         with open(path, "wb") as f:
             f.write(blob)
-        db = DiskBuffer(buf.id, path, len(blob), buf.meta, buf.spill_priority)
+        db = DiskBuffer(bid, path, len(blob), meta, spill_priority)
         self._track(db)
         return db
 

@@ -1,0 +1,232 @@
+"""Shuffle client/server protocol state machines (transport-agnostic).
+
+Reference: `RapidsShuffleClient.scala` (metadata request/response,
+transfer-request issuance, `BufferReceiveState` chunk assembly, retry) and
+`RapidsShuffleServer.scala` (`handleMetadataRequest:284`,
+`BufferSendState:380` — acquire from any tier, stage through send bounce
+buffers, throttled).  These classes hold no sockets: the Connection /
+request-handler SPI injects the wire, so protocol behavior is unit-tested
+with mocked transports exactly like the reference's `tests/.../shuffle`
+suites (SURVEY.md §4 tier 2).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional, Sequence
+
+from spark_rapids_tpu.memory.buffer import BufferId
+from spark_rapids_tpu.shuffle.catalog import (
+    ShuffleBufferCatalog, ShuffleReceivedBufferCatalog)
+from spark_rapids_tpu.shuffle.transport import (
+    BlockIdMsg, Connection, InflightLimiter, MsgKind, ShuffleTransport,
+    TableMetaMsg, Transaction, TransactionStatus, meta_request,
+    parse_meta_response)
+
+log = logging.getLogger("spark_rapids_tpu.shuffle")
+
+
+class FetchFailedError(Exception):
+    """Maps to Spark's FetchFailedException semantics: the scheduler
+    regenerates the map outputs (reference RapidsShuffleIterator error
+    path)."""
+
+    def __init__(self, address: str, block: Optional[BlockIdMsg],
+                 message: str):
+        super().__init__(f"fetch failed from {address} ({block}): {message}")
+        self.address = address
+        self.block = block
+
+
+class ShuffleReceiveHandler:
+    """Callback surface the iterator implements (reference
+    RapidsShuffleFetchHandler): batchReceived / transferError."""
+
+    def start(self, expected_batches: int) -> None:
+        ...
+
+    def batch_received(self, bid: BufferId) -> None:
+        ...
+
+    def transfer_error(self, message: str) -> None:
+        ...
+
+
+class BufferReceiveState:
+    """Assembles DATA chunks into whole serialized batches, releasing the
+    inflight budget as each buffer lands in the host store (reference
+    BufferReceiveState RapidsShuffleClient.scala:108)."""
+
+    def __init__(self, metas: Sequence[TableMetaMsg],
+                 received_catalog: ShuffleReceivedBufferCatalog,
+                 host_store, task_attempt_id: int,
+                 limiter: InflightLimiter,
+                 handler: ShuffleReceiveHandler):
+        self.metas = {m.table_id: m for m in metas}
+        self.received_catalog = received_catalog
+        self.host_store = host_store
+        self.task_attempt_id = task_attempt_id
+        self.limiter = limiter
+        self.handler = handler
+        self.completed: set[int] = set()
+        self._chunks: dict[int, list[bytes]] = {}
+        self._lock = threading.Lock()
+
+    def on_chunk(self, table_id: int, seq: int, chunk: bytes,
+                 is_last: bool) -> None:
+        with self._lock:
+            parts = self._chunks.setdefault(table_id, [])
+            assert seq == len(parts), (
+                f"out-of-order chunk {seq} for table {table_id}")
+            parts.append(chunk)
+            if not is_last:
+                return
+            blob = b"".join(self._chunks.pop(table_id))
+            self.completed.add(table_id)
+        meta_msg = self.metas[table_id]
+        bid = BufferId(self.received_catalog.new_buffer_id().table_id,
+                       meta_msg.shuffle_id, meta_msg.map_id,
+                       meta_msg.partition)
+        self.host_store.add_blob(bid, blob, meta_msg.table_meta())
+        self.received_catalog.add_received(self.task_attempt_id, bid)
+        self.limiter.release(meta_msg.size_bytes)  # mirrors the acquire
+        self.handler.batch_received(bid)
+
+    def drop_partial(self, table_id: int) -> None:
+        with self._lock:
+            self._chunks.pop(table_id, None)
+
+
+class ShuffleClient:
+    """Per-peer fetch driver (reference RapidsShuffleClient).  Two-phase:
+    metadata round-trip, then transfer with bounded inflight bytes and
+    bounded retries on transient transport errors (FetchRetry:406)."""
+
+    MAX_RETRIES = 3
+
+    def __init__(self, connection: Connection, transport: ShuffleTransport,
+                 received_catalog: ShuffleReceivedBufferCatalog,
+                 host_store, address: str = "peer"):
+        self.connection = connection
+        self.transport = transport
+        self.received_catalog = received_catalog
+        self.host_store = host_store
+        self.address = address
+
+    def fetch_blocks(self, blocks: Sequence[BlockIdMsg],
+                     task_attempt_id: int,
+                     handler: ShuffleReceiveHandler) -> list[TableMetaMsg]:
+        kind, payload = self.connection.request(meta_request(blocks))
+        if kind != MsgKind.METADATA_RESPONSE:
+            raise FetchFailedError(self.address, blocks[0] if blocks else
+                                   None, f"unexpected response {kind}")
+        metas = parse_meta_response(payload)
+        real = [m for m in metas if not m.is_degenerate]
+        degenerate = [m for m in metas if m.is_degenerate]
+        handler.start(len(metas))
+        # degenerate (rows-only) batches need no data phase
+        for m in degenerate:
+            bid = BufferId(self.received_catalog.new_buffer_id().table_id,
+                           m.shuffle_id, m.map_id, m.partition)
+            self.host_store.add_blob(bid, b"", m.table_meta())
+            self.received_catalog.add_received(task_attempt_id, bid)
+            handler.batch_received(bid)
+        if not real:
+            return metas
+        state = BufferReceiveState(real, self.received_catalog,
+                                   self.host_store, task_attempt_id,
+                                   self.transport.receive_limiter, handler)
+        pending = list(real)
+        attempt = 0
+        while pending:
+            batch_ids = []
+            budget_taken = []
+            for m in pending:
+                if not self.transport.receive_limiter.acquire(
+                        m.size_bytes, timeout=None if not batch_ids
+                        else 0.0):
+                    break  # send what we have; rest in the next round
+                batch_ids.append(m.table_id)
+                budget_taken.append(m)
+            txn = self.connection.fetch(batch_ids, state.on_chunk)
+            if txn.status != TransactionStatus.SUCCESS:
+                # return the budget of buffers that did not complete
+                for m in budget_taken:
+                    if m.table_id not in state.completed:
+                        state.drop_partial(m.table_id)
+                        self.transport.receive_limiter.release(m.size_bytes)
+                pending = [m for m in pending
+                           if m.table_id not in state.completed]
+                attempt += 1
+                if attempt > self.MAX_RETRIES:
+                    handler.transfer_error(txn.error or "transfer failed")
+                    raise FetchFailedError(
+                        self.address, None,
+                        f"transfer failed after {attempt} attempts: "
+                        f"{txn.error}")
+                log.warning("shuffle fetch retry %d from %s: %s", attempt,
+                            self.address, txn.error)
+                continue
+            pending = [m for m in pending
+                       if m.table_id not in state.completed]
+        return metas
+
+
+class ShuffleServer:
+    """Serves metadata + data for locally-stored shuffle buffers
+    (reference RapidsShuffleServer).  `BufferSendState` slices each
+    serialized buffer into bounce-buffer-sized chunks; buffers are
+    acquired from whatever tier they live in (device or spilled)."""
+
+    def __init__(self, shuffle_catalog: ShuffleBufferCatalog,
+                 transport: ShuffleTransport):
+        self.shuffle_catalog = shuffle_catalog
+        self.transport = transport
+
+    def handle_metadata_request(self, blocks: Sequence[BlockIdMsg]
+                                ) -> list[TableMetaMsg]:
+        out = []
+        for b in blocks:
+            bids = self.shuffle_catalog.blocks_for_partition(
+                b.shuffle_id, b.partition, map_ids=[b.map_id])
+            for bid in bids:
+                out.append(TableMetaMsg.of(
+                    bid, self.shuffle_catalog.meta_for(bid)))
+        return out
+
+    def acquire_buffer_bytes(self, table_id: int) -> bytes:
+        """Serialize a catalog buffer for the wire, whichever tier holds
+        it (reference BufferSendState acquires from catalog :380)."""
+        catalog = self.shuffle_catalog.catalog
+        bid = self.shuffle_catalog.lookup_table(table_id)
+        with catalog.acquired(bid) as buf:
+            return buf.get_host_bytes()
+
+    def send_state(self, table_ids: Sequence[int],
+                   emit: Callable[[int, int, bytes, bool], None]
+                   ) -> Transaction:
+        """Stream requested buffers as chunks through the send bounce
+        pool: acquire a bounce buffer, fill, emit, release — so at most
+        `count` chunks are in flight server-side."""
+        total = 0
+        bb = self.transport.send_bounce
+        try:
+            for tid in table_ids:
+                blob = self.acquire_buffer_bytes(tid)
+                n = len(blob)
+                nchunks = max(1, -(-n // bb.buffer_size))
+                for i in range(nchunks):
+                    stage = bb.acquire()
+                    try:
+                        chunk = blob[i * bb.buffer_size:
+                                     (i + 1) * bb.buffer_size]
+                        stage[: len(chunk)] = chunk
+                        emit(tid, i, bytes(stage[: len(chunk)]),
+                             i == nchunks - 1)
+                        total += len(chunk)
+                    finally:
+                        bb.release(stage)
+        except Exception as e:  # noqa: BLE001 — surface as transaction
+            return Transaction(TransactionStatus.ERROR, str(e), total)
+        return Transaction(TransactionStatus.SUCCESS,
+                           bytes_transferred=total)

@@ -14,6 +14,7 @@ collects the build side once and hands the same batch to every consumer.
 """
 from __future__ import annotations
 
+import threading
 from typing import Iterator, Optional
 
 from spark_rapids_tpu import types as T
@@ -96,6 +97,9 @@ class ShuffleExchangeExec(UnaryExecBase):
             sample, part.order, part.num_partitions)
 
     def execute_partitions(self):
+        from spark_rapids_tpu import config as C
+        if C.get_active_conf()[C.RAPIDS_SHUFFLE_ENABLED]:
+            return self._execute_via_manager()
         buckets = self._materialize()
 
         def reader(bs: list[ColumnarBatch]):
@@ -104,6 +108,61 @@ class ShuffleExchangeExec(UnaryExecBase):
                 self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
                 yield b
         return [reader(bs) for bs in buckets]
+
+    _SHUFFLE_IDS = iter(range(1, 1 << 31))
+
+    def _execute_via_manager(self):
+        """Accelerated path: map outputs land in the spillable shuffle
+        catalog; reducers pull through the caching reader (reference
+        RapidsShuffleManager write/read, SURVEY.md §3.4)."""
+        from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+        mgr = (TpuShuffleManager.get("local")
+               or TpuShuffleManager("local"))
+        shuffle_id = next(ShuffleExchangeExec._SHUFFLE_IDS)
+        mgr.register_shuffle(shuffle_id)
+        part = self.partitioning
+        if isinstance(part, RangePartitioning) and part.bounds is None:
+            part.bounds = self._sample_bounds(part)
+        n = part.num_partitions
+        for map_id, it in enumerate(self.child.execute_partitions()):
+            writer = mgr.get_writer(shuffle_id, map_id)
+            try:
+                for batch in it:
+                    if batch.num_rows == 0:
+                        continue
+                    with self.metrics.timed(M.TOTAL_TIME):
+                        slices = part.partition_batch(batch)
+                    for p, s in enumerate(slices):
+                        if s is not None and s.num_rows > 0:
+                            writer.write_partition(p, s)
+                            self.metrics.add("dataSize",
+                                             s.device_size_bytes())
+            except BaseException:
+                writer.abort()
+                raise
+            writer.commit(n)
+
+        # free the shuffle's spillable buffers + map-output entries once
+        # every partition reader is exhausted (or closed early)
+        remaining = [n]
+        lock = threading.Lock()
+
+        def _done():
+            with lock:
+                remaining[0] -= 1
+                last = remaining[0] == 0
+            if last:
+                mgr.unregister_shuffle(shuffle_id)
+
+        def reader(p: int):
+            try:
+                for b in mgr.get_reader(shuffle_id, p):
+                    self.metrics.add(M.NUM_OUTPUT_ROWS, b.num_rows)
+                    self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+                    yield b
+            finally:
+                _done()
+        return [reader(p) for p in range(n)]
 
     def execute_columnar(self):
         for it in self.execute_partitions():

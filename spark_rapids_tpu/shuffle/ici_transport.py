@@ -1,0 +1,236 @@
+"""ICI/DCN shuffle transport (the default `ShuffleTransport` impl; conf
+`spark.rapids.shuffle.transport.class`).
+
+Reference parallel: `shuffle-plugin/.../ucx/UCXShuffleTransport.scala` +
+`UCX.scala` — UCX tag-matching with a TCP management handshake and a
+dedicated progress thread.  TPU redesign, two lanes:
+
+  * **ICI lane (intra-slice)**: executors on one pod slice share the XLA
+    runtime, so batch exchange is the SPMD all-to-all in
+    `parallel/collective_exchange.py` — it never goes through this SPI.
+    Within a host (and in local mode / tests) peers are reached by direct
+    loopback: the "connection" invokes the peer server's handlers
+    in-process, zero-copy of the control plane.
+  * **DCN lane (cross-host)**: a TCP data-plane socket per peer pair, with
+    length-prefixed control frames and bounce-buffer-sized DATA frames —
+    the role UCX tag messages play in the reference.  Each server runs an
+    accept loop + per-connection handler threads (the progress-thread
+    analog).
+
+Peer addressing: `loop://<executor_id>` for in-process peers,
+`tcp://host:port` for remote ones — the address travels in MapStatus like
+the reference's UCX port in `BlockManagerId.topologyInfo`
+(`RapidsShuffleInternalManager.scala:170-186`).
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Optional, Sequence
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.shuffle.transport import (
+    Connection, MsgKind, ShuffleTransport, Transaction, TransactionStatus,
+    decode_frame, encode_data, meta_response, transfer_request)
+
+_LOOP_REGISTRY_LOCK = threading.Lock()
+_LOOP_REGISTRY: dict[str, "object"] = {}  # executor_id -> request handler
+
+
+class LoopbackConnection(Connection):
+    """In-process peer: drives the server state machine directly."""
+
+    def __init__(self, handler, transport: ShuffleTransport):
+        self.server = handler
+        self.transport = transport
+
+    def request(self, frame: bytes):
+        kind, payload = decode_frame(frame[4:])
+        if kind == MsgKind.METADATA_REQUEST:
+            from spark_rapids_tpu.shuffle.transport import BlockIdMsg
+            blocks = [BlockIdMsg(*b) for b in payload["blocks"]]
+            metas = self.server.handle_metadata_request(blocks)
+            resp = meta_response(metas)
+            return decode_frame(resp[4:])
+        raise ValueError(f"unexpected request {kind}")
+
+    def fetch(self, table_ids: Sequence[int],
+              on_chunk: Callable[[int, int, bytes, bool], None]
+              ) -> Transaction:
+        return self.server.send_state(table_ids, on_chunk)
+
+
+class TcpServer:
+    """Accept loop + per-connection handler threads (the reference's UCX
+    progress thread / management-port pair collapsed into one socket)."""
+
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0):
+        self.server = server
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.address = f"tcp://{host}:{self._sock.getsockname()[1]}"
+        self._closing = False
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="tpu-shuffle-server",
+                                        daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        from spark_rapids_tpu.shuffle.transport import BlockIdMsg
+        try:
+            while True:
+                frame = _recv_frame(conn)
+                if frame is None:
+                    return
+                kind, payload = decode_frame(frame)
+                if kind == MsgKind.METADATA_REQUEST:
+                    blocks = [BlockIdMsg(*b) for b in payload["blocks"]]
+                    metas = self.server.handle_metadata_request(blocks)
+                    _send_all(conn, meta_response(metas))
+                elif kind == MsgKind.TRANSFER_REQUEST:
+                    def emit(tid, seq, chunk, is_last):
+                        _send_all(conn, encode_data(
+                            tid, (seq << 1) | int(is_last), chunk))
+                    txn = self.server.send_state(payload["table_ids"], emit)
+                    _send_all(conn, _txn_frame(txn))
+                else:
+                    return
+        except OSError:
+            return
+        finally:
+            conn.close()
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _txn_frame(txn: Transaction) -> bytes:
+    from spark_rapids_tpu.shuffle.transport import encode_control
+    return encode_control(MsgKind.TRANSFER_RESPONSE, {
+        "status": txn.status.value, "error": txn.error,
+        "bytes": txn.bytes_transferred})
+
+
+def _send_all(conn: socket.socket, data: bytes) -> None:
+    conn.sendall(data)
+
+
+def _recv_frame(conn: socket.socket) -> Optional[bytes]:
+    hdr = _recv_exact(conn, 4)
+    if hdr is None:
+        return None
+    (length,) = struct.unpack("<I", hdr)
+    return _recv_exact(conn, length)
+
+
+def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+class TcpConnection(Connection):
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._lock = threading.Lock()  # one outstanding exchange per conn
+
+    def request(self, frame: bytes):
+        with self._lock:
+            _send_all(self._sock, frame)
+            resp = _recv_frame(self._sock)
+            if resp is None:
+                raise ConnectionError("peer closed during request")
+            return decode_frame(resp)
+
+    def fetch(self, table_ids: Sequence[int],
+              on_chunk: Callable[[int, int, bytes, bool], None]
+              ) -> Transaction:
+        with self._lock:
+            try:
+                _send_all(self._sock, transfer_request(table_ids))
+                while True:
+                    frame = _recv_frame(self._sock)
+                    if frame is None:
+                        return Transaction(TransactionStatus.ERROR,
+                                           "peer closed during transfer")
+                    kind, payload = decode_frame(frame)
+                    if kind == MsgKind.DATA:
+                        tid, packed, chunk = payload
+                        on_chunk(tid, packed >> 1, chunk, bool(packed & 1))
+                    elif kind == MsgKind.TRANSFER_RESPONSE:
+                        return Transaction(
+                            TransactionStatus(payload["status"]),
+                            payload.get("error"), payload.get("bytes", 0))
+                    else:
+                        return Transaction(TransactionStatus.ERROR,
+                                           f"unexpected frame {kind}")
+            except OSError as e:
+                return Transaction(TransactionStatus.ERROR, str(e))
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class IciShuffleTransport(ShuffleTransport):
+    """Default transport: loopback for same-process peers, TCP for DCN."""
+
+    def __init__(self, conf: C.RapidsConf):
+        super().__init__(conf)
+        self._servers: list[TcpServer] = []
+        self._executor_ids: list[str] = []
+
+    def make_server(self, executor_id: str, request_handler):
+        with _LOOP_REGISTRY_LOCK:
+            _LOOP_REGISTRY[executor_id] = request_handler
+        self._executor_ids.append(executor_id)
+        tcp = TcpServer(request_handler)
+        self._servers.append(tcp)
+        # peers prefer loopback when they share the process
+        return type("ServerHandle", (), {
+            "loop_address": f"loop://{executor_id}",
+            "tcp_address": tcp.address})()
+
+    def make_client(self, peer_address: str) -> Connection:
+        if peer_address.startswith("loop://"):
+            eid = peer_address[len("loop://"):]
+            with _LOOP_REGISTRY_LOCK:
+                handler = _LOOP_REGISTRY.get(eid)
+            if handler is None:
+                raise ConnectionError(f"no loopback peer {eid}")
+            return LoopbackConnection(handler, self)
+        if peer_address.startswith("tcp://"):
+            host, port = peer_address[len("tcp://"):].rsplit(":", 1)
+            return TcpConnection(host, int(port))
+        raise ValueError(f"bad peer address {peer_address}")
+
+    def shutdown(self) -> None:
+        for s in self._servers:
+            s.close()
+        self._servers.clear()
+        with _LOOP_REGISTRY_LOCK:
+            for eid in self._executor_ids:
+                _LOOP_REGISTRY.pop(eid, None)
+        self._executor_ids.clear()

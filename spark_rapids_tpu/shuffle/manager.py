@@ -1,0 +1,285 @@
+"""Accelerated shuffle manager: caching writer/reader over the spillable
+catalog + transport.
+
+Reference: `RapidsShuffleInternalManager.scala` — `RapidsCachingWriter`
+(map output stays in the device store, spillable; MapStatus advertises the
+transport address), `RapidsCachingReader` (local partitions read straight
+from the catalog; remote ones fetched via the transport), and
+`RapidsShuffleIterator` (fetch orchestration, semaphore on materialize,
+timeout -> FetchFailed).
+
+The driver-side MapOutputRegistry plays Spark's MapOutputTracker: map
+task -> (executor, per-partition sizes).  Executor environments register
+here so local mode and tests can run many "executors" in one process —
+multi-executor behavior without a cluster, like the reference's
+mocked-transport suites.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Sequence
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.memory.buffer import (
+    BufferId, DegenerateBuffer, degenerate_meta)
+from spark_rapids_tpu.memory.env import ResourceEnv
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.memory.spill_priorities import (
+    OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY)
+from spark_rapids_tpu.shuffle.catalog import (
+    ShuffleBufferCatalog, ShuffleReceivedBufferCatalog)
+from spark_rapids_tpu.shuffle.client_server import (
+    FetchFailedError, ShuffleClient, ShuffleReceiveHandler, ShuffleServer)
+from spark_rapids_tpu.shuffle.transport import BlockIdMsg, make_transport
+
+
+class MapStatus:
+    """Map-task completion record (reference MapStatus with the transport
+    address in BlockManagerId.topologyInfo)."""
+
+    def __init__(self, executor_id: str, address: str,
+                 partition_sizes: list[int]):
+        self.executor_id = executor_id
+        self.address = address
+        self.partition_sizes = partition_sizes
+
+
+class MapOutputRegistry:
+    """Driver-side map output tracker (process-global)."""
+
+    _lock = threading.Lock()
+    _outputs: dict[int, dict[int, MapStatus]] = {}
+
+    @classmethod
+    def register(cls, shuffle_id: int, map_id: int,
+                 status: MapStatus) -> None:
+        with cls._lock:
+            cls._outputs.setdefault(shuffle_id, {})[map_id] = status
+
+    @classmethod
+    def outputs_for(cls, shuffle_id: int) -> dict[int, MapStatus]:
+        with cls._lock:
+            return dict(cls._outputs.get(shuffle_id, {}))
+
+    @classmethod
+    def unregister_shuffle(cls, shuffle_id: int) -> None:
+        with cls._lock:
+            cls._outputs.pop(shuffle_id, None)
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._outputs.clear()
+
+
+class TpuShuffleManager:
+    """Executor-side shuffle environment (reference GpuShuffleEnv +
+    RapidsShuffleInternalManagerBase)."""
+
+    _registry_lock = threading.Lock()
+    _managers: dict[str, "TpuShuffleManager"] = {}
+
+    def __init__(self, executor_id: str,
+                 env: Optional[ResourceEnv] = None,
+                 conf: Optional[C.RapidsConf] = None):
+        self.executor_id = executor_id
+        self.conf = conf or C.get_active_conf()
+        self.env = env or ResourceEnv.get()
+        self.shuffle_catalog = ShuffleBufferCatalog(self.env.catalog)
+        self.received_catalog = ShuffleReceivedBufferCatalog(
+            self.env.catalog)
+        self.transport = make_transport(self.conf)
+        self.server = ShuffleServer(self.shuffle_catalog, self.transport)
+        handle = self.transport.make_server(executor_id, self.server)
+        self.loop_address = handle.loop_address
+        self.tcp_address = handle.tcp_address
+        with TpuShuffleManager._registry_lock:
+            TpuShuffleManager._managers[executor_id] = self
+
+    # -- lifecycle -----------------------------------------------------------
+    @classmethod
+    def get(cls, executor_id: str) -> Optional["TpuShuffleManager"]:
+        with cls._registry_lock:
+            return cls._managers.get(executor_id)
+
+    def close(self) -> None:
+        self.transport.shutdown()
+        with TpuShuffleManager._registry_lock:
+            TpuShuffleManager._managers.pop(self.executor_id, None)
+
+    def register_shuffle(self, shuffle_id: int) -> None:
+        self.shuffle_catalog.register_shuffle(shuffle_id)
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        self.shuffle_catalog.unregister_shuffle(shuffle_id)
+        MapOutputRegistry.unregister_shuffle(shuffle_id)
+
+    # -- write side ----------------------------------------------------------
+    def get_writer(self, shuffle_id: int, map_id: int
+                   ) -> "CachingShuffleWriter":
+        return CachingShuffleWriter(self, shuffle_id, map_id)
+
+    # -- read side -----------------------------------------------------------
+    def get_reader(self, shuffle_id: int, partition: int,
+                   task_attempt_id: int = 0,
+                   timeout: float = 30.0) -> Iterator[ColumnarBatch]:
+        return CachingShuffleReader(
+            self, shuffle_id, partition, task_attempt_id, timeout).read()
+
+
+class CachingShuffleWriter:
+    """Stores each partition's batch in the device store via the shuffle
+    catalog; degenerate (rows-only) batches store metadata alone
+    (reference RapidsCachingWriter.write :74-191)."""
+
+    def __init__(self, manager: TpuShuffleManager, shuffle_id: int,
+                 map_id: int):
+        self.manager = manager
+        self.shuffle_id = shuffle_id
+        self.map_id = map_id
+        self._sizes: dict[int, int] = {}
+
+    def write_partition(self, partition: int, batch: ColumnarBatch) -> None:
+        cat = self.manager.shuffle_catalog
+        bid = cat.next_shuffle_buffer_id(self.shuffle_id, self.map_id,
+                                         partition)
+        if batch.num_columns == 0:
+            buf = DegenerateBuffer(
+                bid, degenerate_meta(batch.schema, batch.num_rows))
+            cat.catalog.register(buf)
+            self._sizes[partition] = 0
+            return
+        buf = self.manager.env.device_store.add_batch(
+            bid, batch, OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY)
+        self._sizes[partition] = self._sizes.get(partition, 0) + \
+            buf.size_bytes
+
+    def commit(self, num_partitions: int) -> MapStatus:
+        status = MapStatus(
+            self.manager.executor_id, self.manager.loop_address,
+            [self._sizes.get(p, 0) for p in range(num_partitions)])
+        MapOutputRegistry.register(self.shuffle_id, self.map_id, status)
+        return status
+
+    def abort(self) -> None:
+        """Failed-task cleanup (reference :159-167)."""
+        self.manager.shuffle_catalog.remove_task_buffers(
+            self.shuffle_id, self.map_id)
+
+
+class _IteratorHandler(ShuffleReceiveHandler):
+    def __init__(self, q: "queue.Queue"):
+        self.q = q
+        self.expected = 0
+
+    def start(self, expected_batches: int) -> None:
+        self.expected = expected_batches
+
+    def batch_received(self, bid: BufferId) -> None:
+        self.q.put(("batch", bid))
+
+    def transfer_error(self, message: str) -> None:
+        self.q.put(("error", message))
+
+
+class CachingShuffleReader:
+    """Partitions the fetch list into local (catalog) and remote
+    (transport) blocks (reference RapidsCachingReader.read:61-100);
+    remote fetches run on a fetch thread while the task consumes."""
+
+    def __init__(self, manager: TpuShuffleManager, shuffle_id: int,
+                 partition: int, task_attempt_id: int, timeout: float):
+        self.manager = manager
+        self.shuffle_id = shuffle_id
+        self.partition = partition
+        self.task_attempt_id = task_attempt_id
+        self.timeout = timeout
+
+    def read(self) -> Iterator[ColumnarBatch]:
+        outputs = MapOutputRegistry.outputs_for(self.shuffle_id)
+        local_bids: list[BufferId] = []
+        remote: dict[str, list[BlockIdMsg]] = {}
+        for map_id, status in sorted(outputs.items()):
+            if status.partition_sizes[self.partition] == 0 and \
+                    not self._has_degenerate(status, map_id):
+                continue
+            if status.executor_id == self.manager.executor_id:
+                local_bids.extend(
+                    self.manager.shuffle_catalog.blocks_for_partition(
+                        self.shuffle_id, self.partition, [map_id]))
+            else:
+                remote.setdefault(status.address, []).append(
+                    BlockIdMsg(self.shuffle_id, map_id, self.partition))
+        # local blocks: straight catalog reads with the semaphore held
+        sem = TpuSemaphore.get()
+        for bid in local_bids:
+            with self.manager.env.catalog.acquired(bid) as buf:
+                sem.acquire_if_necessary()
+                yield buf.get_columnar_batch()
+        # remote: issue fetches per peer, consume as they land
+        yield from self._fetch_remote(remote, sem)
+
+    def _has_degenerate(self, status: MapStatus, map_id: int) -> bool:
+        # degenerate batches report size 0 but still must be fetched for
+        # their row counts; local catalog lookup answers cheaply
+        if status.executor_id != self.manager.executor_id:
+            return True  # conservatively ask the peer
+        return bool(self.manager.shuffle_catalog.blocks_for_partition(
+            self.shuffle_id, self.partition, [map_id]))
+
+    def _fetch_remote(self, remote: dict[str, list[BlockIdMsg]],
+                      sem) -> Iterator[ColumnarBatch]:
+        if not remote:
+            return
+        q: "queue.Queue" = queue.Queue()
+        handler = _IteratorHandler(q)
+        errors: list[BaseException] = []
+        done = threading.Event()
+
+        def fetch_all():
+            try:
+                for address, blocks in remote.items():
+                    conn = self.manager.transport.make_client(address)
+                    client = ShuffleClient(
+                        conn, self.manager.transport,
+                        self.manager.received_catalog,
+                        self.manager.env.host_store, address)
+                    client.fetch_blocks(blocks, self.task_attempt_id,
+                                        handler)
+                    conn.close()
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                q.put(("fatal", str(e)))
+            finally:
+                done.set()
+                q.put(("done", None))
+
+        t = threading.Thread(target=fetch_all, daemon=True,
+                             name="tpu-shuffle-fetch")
+        t.start()
+        received = 0
+        finished = False
+        while True:
+            try:
+                kind, payload = q.get(timeout=self.timeout)
+            except queue.Empty:
+                raise FetchFailedError(
+                    "remote", None,
+                    f"shuffle fetch timed out after {self.timeout}s") \
+                    from None
+            if kind == "batch":
+                received += 1
+                with self.manager.env.catalog.acquired(payload) as buf:
+                    sem.acquire_if_necessary()
+                    yield buf.get_columnar_batch()
+            elif kind == "error":
+                raise FetchFailedError("remote", None, payload)
+            elif kind == "fatal":
+                raise errors[0] if errors else FetchFailedError(
+                    "remote", None, payload)
+            elif kind == "done":
+                finished = True
+            if finished and q.empty() and done.is_set():
+                break

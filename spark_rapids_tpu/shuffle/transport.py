@@ -1,0 +1,250 @@
+"""Shuffle transport SPI + control protocol.
+
+Reference: `RapidsShuffleTransport.scala:38-659` — the pluggable transport
+trait (`makeClient`/`makeServer`, bounce-buffer pools, inflight-bytes
+throttle, `Transaction` lifecycle) and the FlatBuffers control messages
+(`ShuffleMetadataRequest/Response.fbs`, `ShuffleTransferRequest.fbs`).
+The reference loads the implementation reflectively by class name
+(`spark.rapids.shuffle.transport.class`); `make_transport` does the same.
+
+TPU redesign notes: UCX tag-matching RDMA becomes two lanes —
+intra-slice exchanges ride XLA collectives (parallel/collective_exchange),
+while this SPI carries the DCN/cross-host lane and local-mode loopback:
+a two-phase pull (metadata then data) of serialized batches staged through
+fixed-size bounce buffers, exactly the reference's protocol shape.
+
+Wire format (length-prefixed frames):
+  control frame: u32 len | u8 kind | json payload
+  data frame:    u32 len | u8 DATA | u64 table_id | u32 seq | bytes
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import importlib
+import json
+import struct
+import threading
+from typing import Callable, Optional, Sequence
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.memory.buffer import BufferId, TableMeta
+
+
+class MsgKind(enum.IntEnum):
+    METADATA_REQUEST = 1
+    METADATA_RESPONSE = 2
+    TRANSFER_REQUEST = 3
+    TRANSFER_RESPONSE = 4
+    DATA = 5
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockIdMsg:
+    """One shuffle block coordinate (shuffle_id, map_id, partition)."""
+    shuffle_id: int
+    map_id: int
+    partition: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TableMetaMsg:
+    """Wire TableMeta (reference ShuffleCommon.fbs TableMeta)."""
+    table_id: int
+    shuffle_id: int
+    map_id: int
+    partition: int
+    num_rows: int
+    size_bytes: int
+    schema_fields: tuple  # ((name, dtype_value, nullable), ...)
+
+    @staticmethod
+    def of(bid: BufferId, meta: TableMeta) -> "TableMetaMsg":
+        return TableMetaMsg(
+            bid.table_id, bid.shuffle_id, bid.map_id, bid.partition,
+            meta.num_rows, meta.size_bytes,
+            tuple((f.name, f.dtype.id.value, f.nullable)
+                  for f in meta.schema.fields))
+
+    def buffer_id(self) -> BufferId:
+        return BufferId(self.table_id, self.shuffle_id, self.map_id,
+                        self.partition)
+
+    def table_meta(self) -> TableMeta:
+        schema = T.Schema(tuple(
+            T.Field(n, T.DataType(T.TypeId(d)), nl)
+            for n, d, nl in self.schema_fields))
+        return TableMeta(schema, self.num_rows, self.size_bytes)
+
+    @property
+    def is_degenerate(self) -> bool:
+        return self.size_bytes == 0
+
+
+# -- frame encode/decode ------------------------------------------------------
+def encode_control(kind: MsgKind, payload: dict) -> bytes:
+    body = json.dumps(payload, separators=(",", ":")).encode()
+    return struct.pack("<IB", len(body) + 1, int(kind)) + body
+
+
+def encode_data(table_id: int, seq: int, chunk: bytes) -> bytes:
+    return struct.pack("<IBQI", len(chunk) + 13, int(MsgKind.DATA),
+                       table_id, seq) + chunk
+
+
+def decode_frame(frame: bytes) -> tuple[MsgKind, object]:
+    kind = MsgKind(frame[0])
+    if kind == MsgKind.DATA:
+        table_id, seq = struct.unpack_from("<QI", frame, 1)
+        return kind, (table_id, seq, frame[13:])
+    return kind, json.loads(frame[1:].decode())
+
+
+def meta_request(blocks: Sequence[BlockIdMsg]) -> bytes:
+    return encode_control(MsgKind.METADATA_REQUEST, {
+        "blocks": [[b.shuffle_id, b.map_id, b.partition] for b in blocks]})
+
+
+def meta_response(metas: Sequence[TableMetaMsg]) -> bytes:
+    return encode_control(MsgKind.METADATA_RESPONSE, {
+        "tables": [[m.table_id, m.shuffle_id, m.map_id, m.partition,
+                    m.num_rows, m.size_bytes, list(map(list,
+                                                       m.schema_fields))]
+                   for m in metas]})
+
+
+def parse_meta_response(payload: dict) -> list[TableMetaMsg]:
+    return [TableMetaMsg(t[0], t[1], t[2], t[3], t[4], t[5],
+                         tuple(tuple(f) for f in t[6]))
+            for t in payload["tables"]]
+
+
+def transfer_request(table_ids: Sequence[int]) -> bytes:
+    return encode_control(MsgKind.TRANSFER_REQUEST,
+                          {"table_ids": list(table_ids)})
+
+
+# ---------------------------------------------------------------------------
+class BounceBufferManager:
+    """Fixed pool of staging buffers (reference
+    BounceBufferManager.scala:55-128: slices one registered buffer into N
+    fixed bounce buffers with blocking acquire)."""
+
+    def __init__(self, buffer_size: int, count: int):
+        self.buffer_size = buffer_size
+        self._free = [bytearray(buffer_size) for _ in range(count)]
+        self._cv = threading.Condition()
+
+    def acquire(self, blocking: bool = True,
+                timeout: Optional[float] = None) -> Optional[bytearray]:
+        with self._cv:
+            while not self._free:
+                if not blocking:
+                    return None
+                if not self._cv.wait(timeout):
+                    return None
+            return self._free.pop()
+
+    def release(self, buf: bytearray) -> None:
+        with self._cv:
+            self._free.append(buf)
+            self._cv.notify()
+
+    @property
+    def free_count(self) -> int:
+        with self._cv:
+            return len(self._free)
+
+
+class InflightLimiter:
+    """Byte-budget throttle for outstanding receives (reference
+    maxReceiveInflightBytes, RapidsShuffleClient.scala:108)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._used = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, nbytes: int, timeout: Optional[float] = None) -> bool:
+        nbytes = min(nbytes, self.max_bytes)
+        with self._cv:
+            while self._used + nbytes > self.max_bytes:
+                if not self._cv.wait(timeout):
+                    return False
+            self._used += nbytes
+            return True
+
+    def release(self, nbytes: int) -> None:
+        nbytes = min(nbytes, self.max_bytes)
+        with self._cv:
+            self._used -= nbytes
+            self._cv.notify_all()
+
+
+# ---------------------------------------------------------------------------
+class TransactionStatus(enum.Enum):
+    SUCCESS = "success"
+    ERROR = "error"
+    CANCELLED = "cancelled"
+
+
+@dataclasses.dataclass
+class Transaction:
+    """Completed-exchange record (reference Transaction trait :311-380)."""
+    status: TransactionStatus
+    error: Optional[str] = None
+    bytes_transferred: int = 0
+
+
+class Connection:
+    """Client-side connection to one peer executor.
+
+    `request` performs a control round-trip; `fetch` streams the DATA
+    frames of the requested tables to `on_chunk(table_id, seq, bytes,
+    is_last)` — the bounce-buffer receive path."""
+
+    def request(self, frame: bytes) -> tuple[MsgKind, object]:
+        raise NotImplementedError
+
+    def fetch(self, table_ids: Sequence[int],
+              on_chunk: Callable[[int, int, bytes, bool], None]
+              ) -> Transaction:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class ShuffleTransport:
+    """Transport SPI (reference RapidsShuffleTransport trait)."""
+
+    def __init__(self, conf: C.RapidsConf):
+        self.conf = conf
+        self.receive_limiter = InflightLimiter(
+            conf[C.SHUFFLE_MAX_RECV_INFLIGHT])
+        self.send_bounce = BounceBufferManager(
+            conf[C.SHUFFLE_BOUNCE_BUFFER_SIZE],
+            conf[C.SHUFFLE_BOUNCE_BUFFER_COUNT])
+
+    def make_server(self, executor_id: str, request_handler) -> "object":
+        """Start serving this executor's shuffle data.  `request_handler`
+        exposes handle_metadata_request(blocks)->[TableMetaMsg] and
+        acquire_buffer_bytes(table_id)->bytes."""
+        raise NotImplementedError
+
+    def make_client(self, peer_address: str) -> Connection:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+def make_transport(conf: Optional[C.RapidsConf] = None) -> ShuffleTransport:
+    """Reflective load by conf class name (reference
+    RapidsShuffleTransport.makeTransport, RapidsConf.scala:592)."""
+    conf = conf or C.get_active_conf()
+    path = conf[C.SHUFFLE_TRANSPORT_CLASS]
+    mod_name, cls_name = path.rsplit(".", 1)
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    return cls(conf)

@@ -319,3 +319,33 @@ def test_write_mode_error_and_overwrite(tmp_path):
     write_batches(iter([b]), out, "parquet", b.schema, mode="overwrite")
     back = collect(accelerate(tio.read_parquet(out), conf()))
     assert len(back) == 3
+
+
+def test_csv_partitioned_dataset(tmp_path):
+    # partition column in the user schema but not in the files
+    for year in (2020, 2021):
+        d = tmp_path / f"year={year}"
+        d.mkdir()
+        with open(d / "p.csv", "w") as f:
+            for i in range(4):
+                f.write(f"{i},{year}-v{i}\n")
+    schema = T.Schema.of(("i", T.INT64), ("s", T.STRING),
+                         ("year", T.INT64))
+    scan = tio.read_csv(str(tmp_path), schema, CsvOptions())
+    assert scan.output_schema().names == ("i", "s", "year")
+    df = collect(accelerate(scan, conf()))
+    assert len(df) == 8
+    assert sorted(df["year"].unique()) == [2020, 2021]
+
+
+def test_write_unsupported_format_does_not_destroy_output(tmp_path):
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    out = str(tmp_path / "keep")
+    df = pd.DataFrame({"x": np.arange(3, dtype=np.int64)})
+    b = ColumnarBatch.from_pandas(df)
+    write_batches(iter([b]), out, "parquet", b.schema)
+    with pytest.raises(ValueError, match="unsupported write format"):
+        write_batches(iter([b]), out, "csv", b.schema, mode="overwrite")
+    # the existing parquet output survived the failed overwrite
+    back = collect(accelerate(tio.read_parquet(out), conf()))
+    assert len(back) == 3

@@ -1,0 +1,354 @@
+"""Accelerated shuffle tests (reference `tests/.../shuffle` suites,
+SURVEY.md §4 tier 2: client/server protocol state machines exercised
+single-process with mocked transports — multi-node behavior without a
+cluster — plus caching writer/reader over the spillable catalog and the
+TCP DCN lane on localhost)."""
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, empty_batch
+from spark_rapids_tpu.memory.buffer import BufferId
+from spark_rapids_tpu.memory.env import ResourceEnv
+from spark_rapids_tpu.memory.semaphore import TaskContext
+from spark_rapids_tpu.shuffle.catalog import (
+    ShuffleBufferCatalog, ShuffleReceivedBufferCatalog)
+from spark_rapids_tpu.shuffle.client_server import (
+    FetchFailedError, ShuffleClient, ShuffleReceiveHandler, ShuffleServer)
+from spark_rapids_tpu.shuffle.ici_transport import IciShuffleTransport
+from spark_rapids_tpu.shuffle.manager import (
+    MapOutputRegistry, TpuShuffleManager)
+from spark_rapids_tpu.shuffle.transport import (
+    BlockIdMsg, BounceBufferManager, Connection, InflightLimiter,
+    Transaction, TransactionStatus, make_transport)
+
+
+@pytest.fixture(autouse=True)
+def clean_world():
+    MapOutputRegistry.clear()
+    yield
+    MapOutputRegistry.clear()
+    for eid in list(TpuShuffleManager._managers):
+        TpuShuffleManager._managers[eid].close()
+    ResourceEnv.shutdown()
+
+
+def _conf(**kv):
+    c = C.RapidsConf({k.replace("__", "."): v for k, v in kv.items()})
+    C.set_active_conf(c)
+    return c
+
+
+def _batch(lo, n, part=0):
+    return ColumnarBatch.from_numpy({
+        "k": np.arange(lo, lo + n, dtype=np.int64),
+        "s": np.array([f"v{i}" for i in range(lo, lo + n)], object)})
+
+
+def _mgr(eid="exec-0", conf=None):
+    conf = conf or _conf()
+    env = ResourceEnv.init(conf)
+    return TpuShuffleManager(eid, env, conf), env
+
+
+# -- transport primitives ----------------------------------------------------
+def test_bounce_buffer_manager_blocking():
+    bb = BounceBufferManager(64, 2)
+    a = bb.acquire()
+    b = bb.acquire()
+    assert bb.acquire(blocking=False) is None
+    bb.release(a)
+    c = bb.acquire()
+    assert c is not None
+    assert bb.free_count == 0
+    bb.release(b)
+    bb.release(c)
+    assert bb.free_count == 2
+
+
+def test_inflight_limiter_throttles():
+    lim = InflightLimiter(100)
+    assert lim.acquire(60)
+    assert not lim.acquire(60, timeout=0.01)
+    lim.release(60)
+    assert lim.acquire(100)
+    lim.release(100)
+    # oversized requests clamp to the max instead of deadlocking
+    assert lim.acquire(10_000)
+    lim.release(10_000)
+
+
+# -- writer/catalog ----------------------------------------------------------
+def test_caching_writer_stores_spillable_and_cleans_up():
+    mgr, env = _mgr()
+    mgr.register_shuffle(1)
+    w = mgr.get_writer(1, map_id=0)
+    w.write_partition(0, _batch(0, 10))
+    w.write_partition(1, _batch(10, 5))
+    status = w.commit(2)
+    assert status.partition_sizes[0] > 0
+    assert len(env.catalog) == 2
+    # spill the shuffle output to host, then read it back via the reader
+    spilled = env.device_store.synchronous_spill(0)
+    assert spilled > 0
+    got = list(mgr.get_reader(1, 0))
+    assert sum(b.num_rows for b in got) == 10
+    mgr.unregister_shuffle(1)
+    assert len(env.catalog) == 0
+
+
+def test_caching_writer_abort_removes_task_output():
+    mgr, env = _mgr()
+    mgr.register_shuffle(3)
+    w = mgr.get_writer(3, 0)
+    w.write_partition(0, _batch(0, 4))
+    w.abort()
+    assert len(env.catalog) == 0
+    w2 = mgr.get_writer(3, 1)
+    w2.write_partition(0, _batch(0, 6))
+    w2.commit(1)
+    assert sum(b.num_rows for b in mgr.get_reader(3, 0)) == 6
+
+
+def test_degenerate_batch_roundtrip():
+    mgr, env = _mgr()
+    mgr.register_shuffle(4)
+    w = mgr.get_writer(4, 0)
+    schema = T.Schema(())
+    w.write_partition(0, ColumnarBatch(schema, [], 123))
+    w.commit(1)
+    got = list(mgr.get_reader(4, 0))
+    assert len(got) == 1 and got[0].num_rows == 123
+
+
+# -- protocol state machines with mocked transport (tier 2) ------------------
+class _Recorder(ShuffleReceiveHandler):
+    def __init__(self):
+        self.received = []
+        self.errors = []
+        self.expected = None
+
+    def start(self, n):
+        self.expected = n
+
+    def batch_received(self, bid):
+        self.received.append(bid)
+
+    def transfer_error(self, msg):
+        self.errors.append(msg)
+
+
+class _FlakyConnection(Connection):
+    """Mock wire: first `fail_times` fetches die mid-stream after one
+    chunk (reference RapidsShuffleClientSuite error paths)."""
+
+    def __init__(self, server, fail_times=0):
+        self.server = server
+        self.fail_times = fail_times
+        self.fetch_calls = 0
+
+    def request(self, frame):
+        from spark_rapids_tpu.shuffle.transport import (
+            decode_frame, meta_response)
+        kind, payload = decode_frame(frame[4:])
+        blocks = [BlockIdMsg(*b) for b in payload["blocks"]]
+        return decode_frame(
+            meta_response(self.server.handle_metadata_request(blocks))[4:])
+
+    def fetch(self, table_ids, on_chunk):
+        self.fetch_calls += 1
+        if self.fetch_calls <= self.fail_times:
+            emitted = 0
+
+            def flaky_emit(tid, seq, chunk, is_last):
+                nonlocal emitted
+                if emitted >= 1:
+                    raise OSError("simulated link failure")
+                emitted += 1
+                on_chunk(tid, seq, chunk, is_last and emitted > 0)
+            try:
+                return self.server.send_state(table_ids, flaky_emit)
+            except OSError:
+                return Transaction(TransactionStatus.ERROR, "link down")
+        return self.server.send_state(table_ids, on_chunk)
+
+
+def _two_exec_setup(conf=None):
+    conf = conf or _conf()
+    env = ResourceEnv.init(conf)
+    server_cat = ShuffleBufferCatalog(env.catalog)
+    server_cat.register_shuffle(9)
+    transport = IciShuffleTransport(conf)
+    server = ShuffleServer(server_cat, transport)
+    # populate two blocks
+    bid0 = server_cat.next_shuffle_buffer_id(9, 0, 0)
+    env.device_store.add_batch(bid0, _batch(0, 50))
+    bid1 = server_cat.next_shuffle_buffer_id(9, 1, 0)
+    env.device_store.add_batch(bid1, _batch(50, 30))
+    recv_cat = ShuffleReceivedBufferCatalog(env.catalog)
+    return env, transport, server, recv_cat
+
+
+def test_client_fetch_with_mocked_transport():
+    env, transport, server, recv_cat = _two_exec_setup()
+    conn = _FlakyConnection(server)
+    client = ShuffleClient(conn, transport, recv_cat, env.host_store)
+    rec = _Recorder()
+    metas = client.fetch_blocks(
+        [BlockIdMsg(9, 0, 0), BlockIdMsg(9, 1, 0)], 7, rec)
+    assert len(metas) == 2 and rec.expected == 2
+    assert len(rec.received) == 2
+    rows = 0
+    for bid in rec.received:
+        with env.catalog.acquired(bid) as buf:
+            rows += buf.get_columnar_batch().num_rows
+    assert rows == 80
+    recv_cat.release_task(7)
+    # received buffers freed with the task
+    assert all(not env.catalog.is_registered(b) for b in rec.received)
+
+
+def test_client_retries_flaky_link_then_succeeds():
+    # small bounce buffers -> multi-chunk transfers, so the mid-stream
+    # failure leaves a PARTIAL buffer that must be dropped and re-fetched
+    conf = _conf(**{"spark.rapids.shuffle.bounceBuffers.size": 128})
+    env, transport, server, recv_cat = _two_exec_setup(conf)
+    conn = _FlakyConnection(server, fail_times=2)
+    client = ShuffleClient(conn, transport, recv_cat, env.host_store)
+    rec = _Recorder()
+    client.fetch_blocks([BlockIdMsg(9, 0, 0), BlockIdMsg(9, 1, 0)], 1, rec)
+    assert len(rec.received) == 2
+    assert conn.fetch_calls >= 3
+    # inflight budget fully returned after completion
+    assert transport.receive_limiter._used == 0
+
+
+def test_client_gives_up_after_max_retries():
+    conf = _conf(**{"spark.rapids.shuffle.bounceBuffers.size": 128})
+    env, transport, server, recv_cat = _two_exec_setup(conf)
+    conn = _FlakyConnection(server, fail_times=99)
+    client = ShuffleClient(conn, transport, recv_cat, env.host_store)
+    rec = _Recorder()
+    with pytest.raises(FetchFailedError):
+        client.fetch_blocks([BlockIdMsg(9, 0, 0)], 1, rec)
+    assert rec.errors
+    assert transport.receive_limiter._used == 0
+
+
+def test_chunked_transfer_respects_bounce_buffer_size():
+    conf = _conf(**{"spark.rapids.shuffle.bounceBuffers.size": 256})
+    env, transport, server, recv_cat = _two_exec_setup(conf)
+    chunks = []
+
+    def spy(tid, seq, chunk, is_last):
+        chunks.append((tid, seq, len(chunk), is_last))
+
+    blob = server.acquire_buffer_bytes(
+        server.shuffle_catalog.lookup_table(
+            server.handle_metadata_request(
+                [BlockIdMsg(9, 0, 0)])[0].table_id).table_id)
+    txn = server.send_state(
+        [server.handle_metadata_request(
+            [BlockIdMsg(9, 0, 0)])[0].table_id], spy)
+    assert txn.status == TransactionStatus.SUCCESS
+    assert all(size <= 256 for _, _, size, _ in chunks)
+    assert sum(size for _, _, size, _ in chunks) == len(blob)
+    assert chunks[-1][3] is True
+
+
+# -- end-to-end across "executors" (loopback + TCP) --------------------------
+def test_two_executor_shuffle_loopback():
+    conf = _conf()
+    env = ResourceEnv.init(conf)
+    m0 = TpuShuffleManager("exec-0", env, conf)
+    m1 = TpuShuffleManager("exec-1", env, conf)
+    for m in (m0, m1):
+        m.register_shuffle(11)
+    w0 = m0.get_writer(11, 0)
+    w0.write_partition(0, _batch(0, 20))
+    w0.write_partition(1, _batch(20, 20))
+    w0.commit(2)
+    w1 = m1.get_writer(11, 1)
+    w1.write_partition(0, _batch(100, 10))
+    w1.commit(2)
+    with TaskContext(1):
+        got0 = list(m1.get_reader(11, 0, task_attempt_id=1))
+    rows0 = sum(b.num_rows for b in got0)
+    assert rows0 == 30  # 20 remote (exec-0) + 10 local
+    with TaskContext(2):
+        got1 = list(m0.get_reader(11, 1, task_attempt_id=2))
+    assert sum(b.num_rows for b in got1) == 20
+
+
+def test_two_executor_shuffle_tcp():
+    conf = _conf()
+    env = ResourceEnv.init(conf)
+    m0 = TpuShuffleManager("exec-a", env, conf)
+    m1 = TpuShuffleManager("exec-b", env, conf)
+    for m in (m0, m1):
+        m.register_shuffle(12)
+    w = m0.get_writer(12, 0)
+    w.write_partition(0, _batch(0, 64))
+    status = w.commit(1)
+    # force the DCN lane: advertise the TCP address instead of loopback
+    status.address = m0.tcp_address
+    MapOutputRegistry.register(12, 0, status)
+    got = list(m1.get_reader(12, 0))
+    assert sum(b.num_rows for b in got) == 64
+    vals = sorted(v for b in got for v in b.column("k").to_pylist(b.num_rows))
+    assert vals == list(range(64))
+
+
+def test_shuffle_reads_spilled_tiers_via_transport():
+    conf = _conf()
+    env = ResourceEnv.init(conf)
+    m0 = TpuShuffleManager("exec-x", env, conf)
+    m1 = TpuShuffleManager("exec-y", env, conf)
+    for m in (m0, m1):
+        m.register_shuffle(13)
+    w = m0.get_writer(13, 0)
+    w.write_partition(0, _batch(0, 40))
+    status = w.commit(1)
+    status.address = m0.tcp_address
+    MapOutputRegistry.register(13, 0, status)
+    # spill map output device -> host -> disk before the fetch
+    env.device_store.synchronous_spill(0)
+    env.host_store.synchronous_spill(0)
+    got = list(m1.get_reader(13, 0))
+    assert sum(b.num_rows for b in got) == 40
+
+
+# -- exchange exec integration ----------------------------------------------
+def test_exchange_via_shuffle_manager_parity():
+    from spark_rapids_tpu.exec.basic import LocalBatchSource
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+    conf = _conf(**{"spark.rapids.shuffle.enabled": True})
+    ResourceEnv.init(conf)
+    df = pd.DataFrame({"k": np.arange(57, dtype=np.int64) % 7,
+                       "v": np.arange(57, dtype=np.int64)})
+    src = LocalBatchSource.from_pandas(df, num_partitions=3)
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], 4), src)
+    parts = [list(it) for it in ex.execute_partitions()]
+    assert len(parts) == 4
+    all_rows = sorted(v for bs in parts for b in bs
+                      for v in b.column("v").to_pylist(b.num_rows))
+    assert all_rows == list(range(57))
+    # same key never lands in two partitions
+    key_home = {}
+    for p, bs in enumerate(parts):
+        for b in bs:
+            for k in b.column("k").to_pylist(b.num_rows):
+                assert key_home.setdefault(k, p) == p
+
+
+def test_transport_loaded_reflectively():
+    conf = _conf()
+    t = make_transport(conf)
+    assert isinstance(t, IciShuffleTransport)
+    t.shutdown()
