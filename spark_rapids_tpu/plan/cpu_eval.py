@@ -388,7 +388,34 @@ _DISPATCH = {
     "Year": _datefield("year"),
     "Month": _datefield("month"),
     "DayOfMonth": _datefield("day"),
+    "PythonUDF": None,  # replaced below (forward ref)
 }
+
+
+def _python_udf(e, df, schema):
+    """Row-apply of an uncompiled UDF (the reference keeps the original
+    ScalaUDF for Spark to run; our CPU engine runs the Python original).
+    Nulls pass through as None like Spark python UDFs."""
+    args = [_ev(a, df, schema) for a in e.args]
+    out = []
+    for i in range(len(df)):
+        vals = [None if a.iloc[i] is pd.NA or
+                (isinstance(a.iloc[i], float) and pd.isna(a.iloc[i]))
+                else a.iloc[i] for a in args]
+        if any(v is None for v in vals):
+            # None reached the UDF: null-safe bodies handle it; others
+            # raise, which maps to null (matching compiled propagation)
+            try:
+                out.append(e.fn(*vals))
+            except (TypeError, AttributeError):
+                out.append(None)
+        else:
+            out.append(e.fn(*vals))  # real UDF bugs surface
+    s = pd.Series(out, index=df.index, dtype=object)
+    return s.astype(nullable_dtype(e.return_type))
+
+
+_DISPATCH["PythonUDF"] = _python_udf
 
 
 def cpu_supported(expr: E.Expression) -> bool:

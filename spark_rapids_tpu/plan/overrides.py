@@ -389,6 +389,32 @@ def _ensure_io_rules() -> None:
                   tag_extra=_tag_file_scan)
     register_exec(CpuWriteFiles, "columnar file write", _conv_write_files,
                   tag_extra=_tag_write_files)
+    _register_pyudf_rules()
+
+
+def _tag_pandas_exec(meta) -> None:
+    # disabled by default (reference GpuOverrides.scala:1821-1845): the
+    # per-exec enable key must be set explicitly
+    name = meta.node.name()
+    if not meta.conf.is_op_enabled("exec", name, default=False):
+        meta.will_not_work_on_tpu(
+            f"{name} is disabled by default; enable with "
+            f"{C.op_enable_key('exec', name)}")
+
+
+def _register_pyudf_rules() -> None:
+    from spark_rapids_tpu.pyudf.exec import (
+        ArrowEvalPythonExec, CpuArrowEvalPython, CpuMapInPandas,
+        MapInPandasExec)
+    register_exec(
+        CpuArrowEvalPython, "vectorized python UDF evaluation",
+        lambda meta, kids: ArrowEvalPythonExec(meta.node.udfs, kids[0]),
+        exprs_of=lambda n: [a for u in n.udfs for a in u.args],
+        tag_extra=_tag_pandas_exec)
+    register_exec(
+        CpuMapInPandas, "mapInPandas",
+        lambda meta, kids: MapInPandasExec(meta.node, kids[0]),
+        tag_extra=_tag_pandas_exec)
 
 
 # ---------------------------------------------------------------------------
@@ -451,6 +477,9 @@ def accelerate(cpu_plan: N.CpuNode,
     conf = conf or C.get_active_conf()
     if not conf[C.SQL_ENABLED]:
         return cpu_plan
+    if conf[C.UDF_COMPILER_ENABLED]:
+        from spark_rapids_tpu.udf import rewrite_udfs
+        cpu_plan = rewrite_udfs(cpu_plan)
     meta = wrap_plan(cpu_plan, conf)
     meta.tag_for_tpu()
     fix_up_exchange_overhead(meta)

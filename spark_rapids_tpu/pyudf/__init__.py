@@ -1,0 +1,21 @@
+"""Python/Pandas UDF execution (SURVEY.md §2.12).
+
+Reference: `org/apache/spark/sql/rapids/execution/python/` —
+`GpuArrowEvalPythonExec` ships batches to a python worker over Arrow IPC
+and reads results back as batches, `GpuMapInPandas` maps whole frames,
+`PythonWorkerSemaphore` caps concurrent workers
+(`python/PythonWorkerSemaphore.scala:17-40`).
+
+TPU shape: the engine is already host-driven Python, so the "worker" is
+in-process — the Arrow IPC hop collapses to a zero-copy
+`ColumnarBatch.to_arrow()` view.  The exec contract is identical: batches
+leave HBM only at this operator, the UDF sees pandas objects, results are
+re-uploaded under the task semaphore, and the worker semaphore still caps
+concurrency (vectorized UDFs can be memory-hungry).  These execs are
+disabled by default like the reference (GpuOverrides.scala:1821-1845).
+"""
+from spark_rapids_tpu.pyudf.exec import (  # noqa: F401
+    ArrowEvalPythonExec, CpuArrowEvalPython, CpuMapInPandas,
+    MapInPandasExec, pandas_udf)
+from spark_rapids_tpu.pyudf.semaphore import (  # noqa: F401
+    PythonWorkerSemaphore)

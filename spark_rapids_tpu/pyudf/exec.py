@@ -1,0 +1,175 @@
+"""Pandas UDF execs (reference `GpuArrowEvalPythonExec.scala`,
+`GpuMapInPandasExec.scala`).
+
+`ArrowEvalPythonExec` evaluates vectorized (Series -> Series) UDFs: the
+batch leaves HBM once, the UDF runs under the worker semaphore, and the
+appended result columns re-upload under the task semaphore — the exact
+device-boundary discipline of the reference (batches -> Arrow -> worker ->
+batches).  `MapInPandasExec` maps whole DataFrames to DataFrames with a
+declared output schema.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterator, Sequence
+
+import pandas as pd
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExecBase
+from spark_rapids_tpu.exprs.base import Expression
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.plan.nodes import CpuNode, normalize_df
+from spark_rapids_tpu.pyudf.semaphore import PythonWorkerSemaphore
+
+
+def pandas_udf(return_type: T.DataType):
+    """Vectorized UDF decorator: fn receives pandas Series (Spark's
+    pandas_udf scalar flavor)."""
+
+    def wrap(fn: Callable):
+        fn.return_type = return_type
+        fn.is_pandas_udf = True
+        return fn
+    return wrap
+
+
+@dataclasses.dataclass
+class PandasUdfSpec:
+    name: str
+    fn: Callable
+    return_type: T.DataType
+    args: tuple  # Expression args
+
+
+def _eval_udfs(df: pd.DataFrame, udfs: Sequence[PandasUdfSpec],
+               input_schema: T.Schema) -> pd.DataFrame:
+    from spark_rapids_tpu.plan.cpu_eval import cpu_eval, nullable_dtype
+    out = df.copy()
+    sem = PythonWorkerSemaphore.get()
+    for u in udfs:
+        args = [cpu_eval(a, df, input_schema) for a in u.args]
+        with sem.held():
+            res = u.fn(*args)
+        if not isinstance(res, pd.Series):
+            res = pd.Series(res, index=df.index)
+        out[u.name] = res.astype(nullable_dtype(u.return_type))
+    return out
+
+
+def _output_schema(child_schema: T.Schema,
+                   udfs: Sequence[PandasUdfSpec]) -> T.Schema:
+    return T.Schema(tuple(child_schema.fields) + tuple(
+        T.Field(u.name, u.return_type) for u in udfs))
+
+
+class CpuArrowEvalPython(CpuNode):
+    """Planner-facing node (Spark's ArrowEvalPythonExec analog): appends
+    one column per UDF to the child output."""
+
+    def __init__(self, udfs: Sequence[PandasUdfSpec], child: CpuNode):
+        super().__init__(child)
+        self.udfs = list(udfs)
+        self._schema = _output_schema(child.output_schema(), self.udfs)
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return f"CpuArrowEvalPython({[u.name for u in self.udfs]})"
+
+    def execute(self):
+        cs = self.child.output_schema()
+
+        def run(it):
+            for df in it:
+                yield normalize_df(_eval_udfs(df, self.udfs, cs),
+                                   self._schema)
+        return [run(it) for it in self.child.execute()]
+
+
+class ArrowEvalPythonExec(UnaryExecBase):
+    """Columnar exec: one HBM->host->HBM round trip per batch, worker
+    semaphore around the UDF, task semaphore around the re-upload
+    (reference GpuArrowEvalPythonExec.doExecuteColumnar :376)."""
+
+    def __init__(self, udfs: Sequence[PandasUdfSpec], child: TpuExec):
+        super().__init__(child)
+        self.udfs = list(udfs)
+        self._schema = _output_schema(child.output_schema(), self.udfs)
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return f"ArrowEvalPythonExec({[u.name for u in self.udfs]})"
+
+    def process_partition(self, batches: Iterator[ColumnarBatch]
+                          ) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.plan.transitions import (
+            batch_from_df, df_from_batch)
+        cs = self.child.output_schema()
+        for batch in batches:
+            df = df_from_batch(batch)
+            with self.metrics.timed():
+                out = _eval_udfs(df, self.udfs, cs)
+            TpuSemaphore.get().acquire_if_necessary()
+            nb = batch_from_df(normalize_df(out, self._schema),
+                               self._schema)
+            self.update_output_metrics(nb)
+            yield nb
+
+
+class CpuMapInPandas(CpuNode):
+    """mapInPandas: fn maps an iterator of DataFrames to an iterator of
+    DataFrames with a declared schema."""
+
+    def __init__(self, fn: Callable, schema: T.Schema, child: CpuNode):
+        super().__init__(child)
+        self.fn = fn
+        self._schema = schema
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def describe(self) -> str:
+        return f"CpuMapInPandas({getattr(self.fn, '__name__', 'fn')})"
+
+    def execute(self):
+        def run(it):
+            sem = PythonWorkerSemaphore.get()
+            with sem.held():
+                for out in self.fn(iter(it)):
+                    yield normalize_df(out, self._schema)
+        return [run(it) for it in self.child.execute()]
+
+
+class MapInPandasExec(UnaryExecBase):
+    def __init__(self, node: CpuMapInPandas, child: TpuExec):
+        super().__init__(child)
+        self.node = node
+
+    def output_schema(self) -> T.Schema:
+        return self.node.output_schema()
+
+    def describe(self) -> str:
+        return f"MapInPandasExec({getattr(self.node.fn, '__name__', 'fn')})"
+
+    def process_partition(self, batches: Iterator[ColumnarBatch]
+                          ) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.plan.transitions import (
+            batch_from_df, df_from_batch)
+        schema = self.node.output_schema()
+
+        def host_frames():
+            for b in batches:
+                yield df_from_batch(b)
+        sem = PythonWorkerSemaphore.get()
+        with sem.held():
+            for out in self.node.fn(host_frames()):
+                out = normalize_df(out, schema)
+                TpuSemaphore.get().acquire_if_necessary()
+                nb = batch_from_df(out, schema)
+                self.update_output_metrics(nb)
+                yield nb

@@ -1,0 +1,54 @@
+"""PythonWorkerSemaphore: caps concurrent python UDF evaluations per
+executor (reference `python/PythonWorkerSemaphore.scala:17-40`, conf
+`spark.rapids.python.concurrentPythonWorkers`; 0 = unlimited)."""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Optional
+
+
+class PythonWorkerSemaphore:
+    _instance: Optional["PythonWorkerSemaphore"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, max_workers: int):
+        self.max_workers = max_workers
+        self._sem = (threading.Semaphore(max_workers)
+                     if max_workers > 0 else None)
+        self.active = 0
+        self._alock = threading.Lock()
+
+    @classmethod
+    def initialize(cls, max_workers: int) -> "PythonWorkerSemaphore":
+        with cls._lock:
+            cls._instance = cls(max_workers)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "PythonWorkerSemaphore":
+        with cls._lock:
+            if cls._instance is None:
+                from spark_rapids_tpu import config as C
+                cls._instance = cls(
+                    C.get_active_conf()[C.PYTHON_CONCURRENT_WORKERS])
+            return cls._instance
+
+    @classmethod
+    def shutdown(cls) -> None:
+        with cls._lock:
+            cls._instance = None
+
+    @contextmanager
+    def held(self):
+        if self._sem is not None:
+            self._sem.acquire()
+        with self._alock:
+            self.active += 1
+        try:
+            yield
+        finally:
+            with self._alock:
+                self.active -= 1
+            if self._sem is not None:
+                self._sem.release()

@@ -124,11 +124,15 @@ class ShuffleClient:
         real = [m for m in metas if not m.is_degenerate]
         degenerate = [m for m in metas if m.is_degenerate]
         handler.start(len(metas))
-        # degenerate (rows-only) batches need no data phase
+        # degenerate (rows-only) batches need no data phase; they become
+        # metadata-only buffers on the receive side too (a serialized b""
+        # blob would fail deserialization)
+        from spark_rapids_tpu.memory.buffer import DegenerateBuffer
         for m in degenerate:
             bid = BufferId(self.received_catalog.new_buffer_id().table_id,
                            m.shuffle_id, m.map_id, m.partition)
-            self.host_store.add_blob(bid, b"", m.table_meta())
+            self.received_catalog.catalog.register(
+                DegenerateBuffer(bid, m.table_meta()))
             self.received_catalog.add_received(task_attempt_id, bid)
             handler.batch_received(bid)
         if not real:
@@ -205,27 +209,22 @@ class ShuffleServer:
     def send_state(self, table_ids: Sequence[int],
                    emit: Callable[[int, int, bytes, bool], None]
                    ) -> Transaction:
-        """Stream requested buffers as chunks through the send bounce
-        pool: acquire a bounce buffer, fill, emit, release — so at most
-        `count` chunks are in flight server-side."""
+        """Stream requested buffers as bounce-buffer-sized chunks.  With a
+        synchronous `emit` the chunks are zero-copy slices; the send
+        bounce pool (reference BufferSendState) only sizes the chunks —
+        an async transport would stage through `transport.send_bounce`
+        to bound its in-flight copies."""
         total = 0
-        bb = self.transport.send_bounce
+        chunk_size = self.transport.send_bounce.buffer_size
         try:
             for tid in table_ids:
                 blob = self.acquire_buffer_bytes(tid)
                 n = len(blob)
-                nchunks = max(1, -(-n // bb.buffer_size))
+                nchunks = max(1, -(-n // chunk_size))
                 for i in range(nchunks):
-                    stage = bb.acquire()
-                    try:
-                        chunk = blob[i * bb.buffer_size:
-                                     (i + 1) * bb.buffer_size]
-                        stage[: len(chunk)] = chunk
-                        emit(tid, i, bytes(stage[: len(chunk)]),
-                             i == nchunks - 1)
-                        total += len(chunk)
-                    finally:
-                        bb.release(stage)
+                    chunk = blob[i * chunk_size: (i + 1) * chunk_size]
+                    emit(tid, i, chunk, i == nchunks - 1)
+                    total += len(chunk)
         except Exception as e:  # noqa: BLE001 — surface as transaction
             return Transaction(TransactionStatus.ERROR, str(e), total)
         return Transaction(TransactionStatus.SUCCESS,

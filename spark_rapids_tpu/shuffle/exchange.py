@@ -124,23 +124,29 @@ class ShuffleExchangeExec(UnaryExecBase):
         if isinstance(part, RangePartitioning) and part.bounds is None:
             part.bounds = self._sample_bounds(part)
         n = part.num_partitions
-        for map_id, it in enumerate(self.child.execute_partitions()):
-            writer = mgr.get_writer(shuffle_id, map_id)
-            try:
-                for batch in it:
-                    if batch.num_rows == 0:
-                        continue
-                    with self.metrics.timed(M.TOTAL_TIME):
-                        slices = part.partition_batch(batch)
-                    for p, s in enumerate(slices):
-                        if s is not None and s.num_rows > 0:
-                            writer.write_partition(p, s)
-                            self.metrics.add("dataSize",
-                                             s.device_size_bytes())
-            except BaseException:
-                writer.abort()
-                raise
-            writer.commit(n)
+        try:
+            for map_id, it in enumerate(self.child.execute_partitions()):
+                writer = mgr.get_writer(shuffle_id, map_id)
+                try:
+                    for batch in it:
+                        if batch.num_rows == 0:
+                            continue
+                        with self.metrics.timed(M.TOTAL_TIME):
+                            slices = part.partition_batch(batch)
+                        for p, s in enumerate(slices):
+                            if s is not None and s.num_rows > 0:
+                                writer.write_partition(p, s)
+                                self.metrics.add("dataSize",
+                                                 s.device_size_bytes())
+                except BaseException:
+                    writer.abort()
+                    raise
+                writer.commit(n)
+        except BaseException:
+            # failed map stage: free completed tasks' buffers too — no
+            # reader will ever run _done()
+            mgr.unregister_shuffle(shuffle_id)
+            raise
 
         # free the shuffle's spillable buffers + map-output entries once
         # every partition reader is exhausted (or closed early)

@@ -16,6 +16,7 @@ mocked-transport suites.
 """
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 from typing import Iterator, Optional, Sequence
@@ -122,9 +123,15 @@ class TpuShuffleManager:
         return CachingShuffleWriter(self, shuffle_id, map_id)
 
     # -- read side -----------------------------------------------------------
+    _attempt_ids = itertools.count(1)
+
     def get_reader(self, shuffle_id: int, partition: int,
-                   task_attempt_id: int = 0,
+                   task_attempt_id: Optional[int] = None,
                    timeout: float = 30.0) -> Iterator[ColumnarBatch]:
+        if task_attempt_id is None:
+            # unique per reader so per-task receive cleanup cannot free a
+            # concurrent reader's buffers
+            task_attempt_id = next(TpuShuffleManager._attempt_ids)
         return CachingShuffleReader(
             self, shuffle_id, partition, task_attempt_id, timeout).read()
 
@@ -212,14 +219,20 @@ class CachingShuffleReader:
             else:
                 remote.setdefault(status.address, []).append(
                     BlockIdMsg(self.shuffle_id, map_id, self.partition))
-        # local blocks: straight catalog reads with the semaphore held
-        sem = TpuSemaphore.get()
-        for bid in local_bids:
-            with self.manager.env.catalog.acquired(bid) as buf:
-                sem.acquire_if_necessary()
-                yield buf.get_columnar_batch()
-        # remote: issue fetches per peer, consume as they land
-        yield from self._fetch_remote(remote, sem)
+        try:
+            # local blocks: straight catalog reads with the semaphore held
+            sem = TpuSemaphore.get()
+            for bid in local_bids:
+                with self.manager.env.catalog.acquired(bid) as buf:
+                    sem.acquire_if_necessary()
+                    yield buf.get_columnar_batch()
+            # remote: issue fetches per peer, consume as they land
+            yield from self._fetch_remote(remote, sem)
+        finally:
+            # received buffers live only for this task (reference
+            # ShuffleReceivedBufferCatalog per-task cleanup)
+            self.manager.received_catalog.release_task(
+                self.task_attempt_id)
 
     def _has_degenerate(self, status: MapStatus, map_id: int) -> bool:
         # degenerate batches report size 0 but still must be fetched for

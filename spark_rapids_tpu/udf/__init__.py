@@ -1,0 +1,131 @@
+"""User-defined functions: the `tpu_udf` decorator, the `PythonUDF`
+expression, and the plan-rewrite pass that compiles UDF bytecode into
+native expressions.
+
+Reference: `udf-compiler/` (SURVEY.md §2.11) — a logical-plan resolution
+rule finds `ScalaUDF`, attempts bytecode->Catalyst compilation, and falls
+back silently to the original UDF on any unsupported construct
+(`udf-compiler/.../Plugin.scala:28-94`).  Identical contract here:
+`rewrite_udfs` runs at the head of `accelerate()` (gated by
+`spark.rapids.sql.udfCompiler.enabled`); a `PythonUDF` that does not
+compile stays in the plan, has no TPU rule, and therefore falls back to
+the CPU engine, which row-applies the original function.
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import Expression, _lit
+from spark_rapids_tpu.exprs.cast import Cast
+from spark_rapids_tpu.udf.compiler import compile_udf
+
+
+@dataclasses.dataclass(eq=False)
+class PythonUDF(Expression):
+    """Uncompiled user function over child expressions.  No TPU rule is
+    registered for it, so an uncompiled UDF forces CPU fallback (the
+    reference keeps the original ScalaUDF the same way)."""
+    fn: Callable
+    return_type: T.DataType
+    args: tuple
+
+    def data_type(self, schema) -> T.DataType:
+        return self.return_type
+
+    def children(self) -> Sequence[Expression]:
+        return self.args
+
+    def with_children(self, kids):
+        return PythonUDF(self.fn, self.return_type, tuple(kids))
+
+    def eval(self, ctx):
+        raise RuntimeError(
+            "PythonUDF must be compiled or run on the CPU engine")
+
+    def __repr__(self):
+        name = getattr(self.fn, "__name__", "udf")
+        return f"{name}({', '.join(map(repr, self.args))})"
+
+
+def tpu_udf(return_type: T.DataType):
+    """Decorator: `@tpu_udf(T.INT64)` makes `fn(col("a"), ...)` build a
+    PythonUDF expression (Spark's `udf(...)` analog)."""
+
+    def wrap(fn: Callable):
+        def build(*args) -> PythonUDF:
+            return PythonUDF(fn, return_type,
+                             tuple(_lit(a) for a in args))
+        build.fn = fn
+        build.return_type = return_type
+        build.__name__ = getattr(fn, "__name__", "udf")
+        return build
+    return wrap
+
+
+def compile_expression(e: Expression) -> Expression:
+    """Recursively replace compilable PythonUDFs.  The compiled body is
+    cast to the declared return type so plan schemas match the fallback
+    path exactly."""
+    e = e.map_children(compile_expression)
+    if isinstance(e, PythonUDF):
+        compiled = compile_udf(e.fn, list(e.args))
+        if compiled is not None:
+            return Cast(compiled, e.return_type)
+    return e
+
+
+def rewrite_udfs(node):
+    """Plan-wide UDF compilation pass (reference LogicalPlanRules.apply).
+    Returns a new tree; the input is never mutated."""
+    from spark_rapids_tpu.plan import nodes as N
+    new_children = [rewrite_udfs(c) for c in node.children]
+    changed = any(nc is not oc for nc, oc in zip(new_children,
+                                                 node.children))
+    rewrites = {}
+    if isinstance(node, N.CpuProject):
+        new = [compile_expression(x) for x in node.exprs]
+        if any(a is not b for a, b in zip(new, node.exprs)):
+            rewrites["exprs"] = new
+    elif isinstance(node, N.CpuFilter):
+        ne = compile_expression(node.condition)
+        if ne is not node.condition:
+            rewrites["condition"] = ne
+    elif isinstance(node, N.CpuAggregate):
+        ng = [compile_expression(x) for x in node.group_exprs]
+        if any(a is not b for a, b in zip(ng, node.group_exprs)):
+            rewrites["group_exprs"] = ng
+        from spark_rapids_tpu.exprs.aggregates import AggAlias
+        na = []
+        agg_changed = False
+        for a in node.aggregates:
+            if a.func.child is not None:
+                nc = compile_expression(a.func.child)
+                if nc is not a.func.child:
+                    f = copy.copy(a.func)
+                    f.child = nc
+                    a = AggAlias(f, a.name)
+                    agg_changed = True
+            na.append(a)
+        if agg_changed:
+            rewrites["aggregates"] = na
+    elif isinstance(node, N.CpuHashJoin):
+        nl = [compile_expression(x) for x in node.left_keys]
+        nr = [compile_expression(x) for x in node.right_keys]
+        if any(a is not b for a, b in zip(
+                nl + nr, node.left_keys + node.right_keys)):
+            rewrites["left_keys"] = nl
+            rewrites["right_keys"] = nr
+        if node.condition is not None:
+            ncond = compile_expression(node.condition)
+            if ncond is not node.condition:
+                rewrites["condition"] = ncond
+    if not changed and not rewrites:
+        return node
+    out = copy.copy(node)
+    out.children = new_children
+    for k, v in rewrites.items():
+        setattr(out, k, v)
+    return out

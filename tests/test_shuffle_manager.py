@@ -352,3 +352,66 @@ def test_transport_loaded_reflectively():
     t = make_transport(conf)
     assert isinstance(t, IciShuffleTransport)
     t.shutdown()
+
+
+def test_degenerate_batch_remote_fetch():
+    conf = _conf()
+    env = ResourceEnv.init(conf)
+    m0 = TpuShuffleManager("deg-a", env, conf)
+    m1 = TpuShuffleManager("deg-b", env, conf)
+    for m in (m0, m1):
+        m.register_shuffle(20)
+    w = m0.get_writer(20, 0)
+    w.write_partition(0, ColumnarBatch(T.Schema(()), [], 77))
+    status = w.commit(1)
+    status.address = m0.tcp_address  # force the remote path
+    MapOutputRegistry.register(20, 0, status)
+    got = list(m1.get_reader(20, 0))
+    assert len(got) == 1 and got[0].num_rows == 77
+
+
+def test_received_buffers_freed_after_read():
+    conf = _conf()
+    env = ResourceEnv.init(conf)
+    m0 = TpuShuffleManager("rel-a", env, conf)
+    m1 = TpuShuffleManager("rel-b", env, conf)
+    for m in (m0, m1):
+        m.register_shuffle(21)
+    w = m0.get_writer(21, 0)
+    w.write_partition(0, _batch(0, 20))
+    status = w.commit(1)
+    status.address = m0.tcp_address
+    MapOutputRegistry.register(21, 0, status)
+    before = len(env.catalog)
+    got = list(m1.get_reader(21, 0))
+    assert sum(b.num_rows for b in got) == 20
+    # the fetched copy was freed with the reader; only the map output
+    # remains registered
+    assert len(env.catalog) == before
+    m0.unregister_shuffle(21)
+    assert len(env.catalog) == 0
+
+
+def test_failed_map_stage_cleans_catalog():
+    from spark_rapids_tpu.exec.basic import LocalBatchSource
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+    conf = _conf(**{"spark.rapids.shuffle.enabled": True})
+    env = ResourceEnv.init(conf)
+
+    class Exploding(LocalBatchSource):
+        def execute_partitions(self):
+            def ok():
+                yield _batch(0, 8)
+
+            def boom():
+                raise RuntimeError("map task failed")
+                yield  # pragma: no cover
+            return [ok(), boom()]
+
+    src = Exploding([[_batch(0, 8)], []])
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], 2), src)
+    with pytest.raises(RuntimeError):
+        ex.execute_partitions()
+    assert len(env.catalog) == 0  # completed task 0's buffers freed too
