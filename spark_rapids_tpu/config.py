@@ -159,6 +159,24 @@ UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled", True,
 METRICS_LEVEL = conf("spark.rapids.sql.metrics.level", "MODERATE",
                      "Operator metric detail: ESSENTIAL, MODERATE, DEBUG.")
 
+# --- adaptive query execution ----------------------------------------------
+# Spark-owned keys the plugin reads (reference: AQE is driven by Spark's
+# spark.sql.adaptive.* confs; the plugin supplies GpuCustomShuffleReaderExec
+# and the query-stage prep rule, GpuOverrides.scala:1807-1881).
+ADAPTIVE_ENABLED = conf(
+    "spark.sql.adaptive.enabled", False,
+    "Re-plan at query-stage boundaries from runtime shuffle statistics.")
+COALESCE_PARTITIONS_ENABLED = conf(
+    "spark.sql.adaptive.coalescePartitions.enabled", True,
+    "Merge adjacent small reduce partitions after a shuffle stage.")
+ADVISORY_PARTITION_SIZE = conf(
+    "spark.sql.adaptive.advisoryPartitionSizeInBytes", 64 * 1024 * 1024,
+    "Target post-shuffle partition size for AQE partition coalescing.")
+AUTO_BROADCAST_THRESHOLD = conf(
+    "spark.sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024,
+    "Max build-side bytes for the AQE shuffled-hash-join to "
+    "broadcast-join demotion (-1 disables).")
+
 
 def op_enable_key(kind: str, name: str) -> str:
     """Auto-derived per-operator enable key

@@ -1,0 +1,272 @@
+"""Adaptive query execution (reference `GpuQueryStagePrepOverrides`
+`GpuOverrides.scala:1873-1881`, `GpuCustomShuffleReaderExec.scala`, and the
+AQE hooks in `RapidsMeta.scala:121-137` / `GpuTransitionOverrides.scala:51-94`).
+
+Spark's AQE executes a plan one shuffle "query stage" at a time, then
+re-plans the rest using the runtime statistics of materialized stages.
+The two optimizations the reference participates in:
+
+* **partition coalescing** — merge adjacent small reduce partitions so the
+  downstream runs fewer, fatter tasks (Spark's `CustomShuffleReaderExec`
+  wrapping `CoalescedPartitionSpec`s; the plugin supplies the columnar
+  `GpuCustomShuffleReaderExec`).
+* **dynamic join demotion** — a shuffled hash join whose build side turns
+  out to be under `spark.sql.autoBroadcastJoinThreshold` becomes a
+  broadcast hash join.
+
+The TPU engine drives the same loop itself (it is both "Spark" and the
+plugin here): `adaptive_execute` walks the physical plan bottom-up,
+materializes every `ShuffleExchangeExec` into a `ShuffleQueryStageExec`
+(map outputs land in device-resident buckets, spillable through the
+shuffle catalog path), reads its per-partition sizes, and rewrites the
+not-yet-executed remainder of the plan.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Iterator, Optional
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import LeafExec, TpuExec
+from spark_rapids_tpu.exec.joins import BroadcastHashJoinExec, HashJoinExec
+from spark_rapids_tpu.shuffle.exchange import (BroadcastExchangeExec,
+                                               ShuffleExchangeExec)
+from spark_rapids_tpu.utils import metrics as M
+
+log = logging.getLogger(__name__)
+
+
+def query_stage_prep(cpu_plan, conf: Optional[C.RapidsConf] = None):
+    """AQE preparation rule (reference `GpuQueryStagePrepOverrides`
+    `GpuOverrides.scala:1873-1881`, which runs tagging before AQE splits
+    the plan and stores the verdict in a `TreeNodeTag` on each node,
+    `RapidsMeta.scala:121-137`): tag the whole plan once and pin each
+    node's verdict onto the node itself (`_tpu_tag`), so stage-local
+    re-plans see consistent whole-plan decisions.  Returns the plan
+    unchanged."""
+    from spark_rapids_tpu.plan.meta import wrap_plan
+    conf = conf or C.get_active_conf()
+    if not conf[C.SQL_ENABLED]:
+        return cpu_plan
+    meta = wrap_plan(cpu_plan, conf)
+    meta.tag_for_tpu()
+    _pin_tags(meta)
+    return cpu_plan
+
+
+def _pin_tags(meta) -> None:
+    meta.node._tpu_tag = (meta.can_this_be_replaced,
+                          frozenset(meta.reasons))
+    for c in meta.child_plans:
+        _pin_tags(c)
+
+
+class ShuffleQueryStageExec(LeafExec):
+    """A materialized shuffle stage: runs the wrapped exchange's map side
+    exactly once, holds the reduce-side buckets, and exposes the runtime
+    statistics AQE re-plans from (Spark's `ShuffleQueryStageExec` +
+    `MapOutputStatistics`)."""
+
+    def __init__(self, exchange: ShuffleExchangeExec):
+        super().__init__()
+        self.exchange = exchange
+        self._schema = exchange.output_schema()
+        self._buckets: Optional[list[list[ColumnarBatch]]] = None
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def materialize(self) -> "ShuffleQueryStageExec":
+        if self._buckets is None:
+            self._buckets = [list(it)
+                             for it in self.exchange.execute_partitions()]
+        return self
+
+    @property
+    def buckets(self) -> list[list[ColumnarBatch]]:
+        # lazily re-materialize: release_stage_buffers drops buckets after
+        # a collect, and a re-executed plan simply re-runs the exchange
+        # (the same recompute semantics the non-adaptive path has)
+        if self._buckets is None:
+            self.materialize()
+        return self._buckets
+
+    def partition_sizes(self) -> list[int]:
+        return [sum(b.device_size_bytes() for b in p)
+                for p in self.buckets]
+
+    def total_bytes(self) -> int:
+        return sum(self.partition_sizes())
+
+    def output_partition_count(self) -> int:
+        return self.exchange.output_partition_count()
+
+    def execute_partitions(self):
+        return [iter(list(p)) for p in self.buckets]
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        for p in self.buckets:
+            yield from p
+
+    def describe(self):
+        n = "?" if self._buckets is None else len(self._buckets)
+        return f"ShuffleQueryStageExec(n={n})"
+
+
+class CustomShuffleReaderExec(LeafExec):
+    """Columnar AQE shuffle reader (reference
+    `GpuCustomShuffleReaderExec.scala`): reads a materialized stage
+    through partition specs — here coalesced `(start, end)` ranges of
+    adjacent reduce partitions."""
+
+    def __init__(self, stage: ShuffleQueryStageExec,
+                 specs: list[tuple[int, int]]):
+        super().__init__()
+        self.stage = stage
+        self.specs = specs
+        self._schema = stage.output_schema()
+        self.metrics.add("numPartitions", len(specs))
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return max(1, len(self.specs))
+
+    def _read_spec(self, start: int, end: int) -> Iterator[ColumnarBatch]:
+        for p in range(start, end):
+            for b in self.stage.buckets[p]:
+                self.metrics.add(M.NUM_OUTPUT_ROWS, b.num_rows)
+                self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+                yield b
+
+    def execute_partitions(self):
+        return [self._read_spec(s, e) for s, e in self.specs]
+
+    def execute_columnar(self) -> Iterator[ColumnarBatch]:
+        for it in self.execute_partitions():
+            yield from it
+
+    def describe(self):
+        return (f"CustomShuffleReaderExec({len(self.specs)} specs over "
+                f"{self.stage.output_partition_count()} partitions)")
+
+
+def coalesce_partition_specs(sizes: list[int], target: int
+                             ) -> list[tuple[int, int]]:
+    """Greedy adjacent merge (Spark's `ShufflePartitionsUtil`): pack
+    neighboring reduce partitions until adding the next would cross the
+    advisory size.  Always yields at least one spec."""
+    if not sizes:
+        return [(0, 0)]
+    specs: list[tuple[int, int]] = []
+    start, acc = 0, 0
+    for i, sz in enumerate(sizes):
+        if i > start and acc + sz > target:
+            specs.append((start, i))
+            start, acc = i, 0
+        acc += sz
+    specs.append((start, len(sizes)))
+    return specs
+
+
+# ---------------------------------------------------------------------------
+def adaptive_execute(plan: TpuExec,
+                     conf: Optional[C.RapidsConf] = None) -> TpuExec:
+    """Stage-at-a-time re-planning over a TPU physical plan.  Returns an
+    equivalent plan in which every shuffle exchange has been materialized
+    into a query stage, small reduce partitions are coalesced, and
+    small-build shuffled joins are demoted to broadcast joins."""
+    conf = conf or C.get_active_conf()
+    if not conf[C.ADAPTIVE_ENABLED]:
+        return plan
+    return _adapt(plan, conf)
+
+
+def _adapt(node: TpuExec, conf: C.RapidsConf) -> TpuExec:
+    if isinstance(node, ShuffleExchangeExec):
+        return _materialize_stage(node, conf)
+    if isinstance(node, HashJoinExec):
+        # joins cache probe/build aliases at construction — they must be
+        # rebound whenever children are swapped, so all join flavors go
+        # through _adapt_join
+        return _adapt_join(node, conf)
+    for i, c in enumerate(node.children):
+        node.children[i] = _adapt(c, conf)
+    return node
+
+
+def _materialize_stage(exchange: ShuffleExchangeExec,
+                       conf: C.RapidsConf) -> TpuExec:
+    exchange.children[0] = _adapt(exchange.child, conf)
+    stage = ShuffleQueryStageExec(exchange).materialize()
+    if not conf[C.COALESCE_PARTITIONS_ENABLED]:
+        return stage
+    sizes = stage.partition_sizes()
+    specs = coalesce_partition_specs(sizes, conf[C.ADVISORY_PARTITION_SIZE])
+    if len(specs) == len(sizes):
+        return stage
+    log.info("AQE coalesced %d shuffle partitions into %d",
+             len(sizes), len(specs))
+    return CustomShuffleReaderExec(stage, specs)
+
+
+def _stage_bytes(node: TpuExec) -> Optional[int]:
+    """Runtime size of an already-materialized subtree, if it is one."""
+    if isinstance(node, ShuffleQueryStageExec):
+        return node.total_bytes()
+    if isinstance(node, CustomShuffleReaderExec):
+        return node.stage.total_bytes()
+    return None
+
+
+def _adapt_join(join: HashJoinExec, conf: C.RapidsConf) -> TpuExec:
+    from spark_rapids_tpu.exec.joins import JoinType
+    left = _adapt(join.children[0], conf)
+    right = _adapt(join.children[1], conf)
+    threshold = conf[C.AUTO_BROADCAST_THRESHOLD]
+    # build side: right, except RIGHT_OUTER probes right and builds left
+    # (HashJoinExec._flip); FULL OUTER tracks build-side match bits across
+    # the whole build table so it broadcasts fine in local mode too, but
+    # Spark never broadcasts FULL OUTER — keep that behavior.
+    build_is_left = join.join_type == JoinType.RIGHT_OUTER
+    build = left if build_is_left else right
+    size = _stage_bytes(build)
+    if (not isinstance(join, BroadcastHashJoinExec)
+            and threshold is not None and int(threshold) >= 0
+            and join.join_type != JoinType.FULL_OUTER
+            and size is not None and size <= int(threshold)):
+        bcast = BroadcastExchangeExec(build)
+        new_left = bcast if build_is_left else left
+        new_right = right if build_is_left else bcast
+        log.info("AQE demoted %s to broadcast join (build side %d bytes)",
+                 join.describe(), size)
+        return BroadcastHashJoinExec(
+            join.join_type, join.left_keys, join.right_keys,
+            new_left, new_right, condition=join.condition)
+    join.children[0], join.children[1] = left, right
+    # rebind probe/build aliases to the adapted children
+    if join._flip:
+        join._probe, join._build = join.children[1], join.children[0]
+    else:
+        join._probe, join._build = join.children[0], join.children[1]
+    return join
+
+
+def release_stage_buffers(plan: TpuExec) -> None:
+    """Drop every materialized stage's reduce buckets after the plan has
+    been drained, so the captured plan does not pin the whole query's
+    shuffle output in device memory (the reference frees shuffle buffers
+    when the last reader finishes, GpuShuffleExchangeExec reader _done)."""
+    if isinstance(plan, ShuffleQueryStageExec):
+        plan._buckets = None
+        # stages nested below this stage's exchange hold buckets too
+        release_stage_buffers(plan.exchange)
+        return
+    if isinstance(plan, CustomShuffleReaderExec):
+        release_stage_buffers(plan.stage)
+        return
+    for c in plan.children:
+        release_stage_buffers(c)

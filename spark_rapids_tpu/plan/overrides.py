@@ -509,10 +509,24 @@ def accelerate(cpu_plan: N.CpuNode,
     return plan
 
 
-def collect(plan) -> "object":
+def collect(plan, conf: Optional[C.RapidsConf] = None) -> "object":
     """Run an accelerated (or partially accelerated) plan to a pandas
-    DataFrame — the driver-side collect."""
+    DataFrame — the driver-side collect.  With spark.sql.adaptive.enabled,
+    fully-TPU plans are executed stage-at-a-time with runtime re-planning
+    (plan/aqe.py)."""
+    conf = conf or C.get_active_conf()
     if isinstance(plan, TpuExec):
         from spark_rapids_tpu.plan.transitions import df_from_batch
+        if conf[C.ADAPTIVE_ENABLED]:
+            from spark_rapids_tpu.plan.aqe import (adaptive_execute,
+                                                   release_stage_buffers)
+            plan = adaptive_execute(plan, conf)
+            ExecutionPlanCapture.last_plan = plan
+            try:
+                return df_from_batch(plan.collect())
+            finally:
+                # the captured plan must not pin the query's entire
+                # shuffle output in device memory
+                release_stage_buffers(plan)
         return df_from_batch(plan.collect())
     return plan.collect()

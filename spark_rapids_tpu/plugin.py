@@ -1,0 +1,255 @@
+"""Plugin entry & lifecycle (reference `SQLPlugin.scala:28`,
+`Plugin.scala:50-237`).
+
+The reference splits into three hooks that Spark's PluginContainer drives:
+
+* `SQLPlugin` — the `spark.plugins=...` SPI entry returning a driver plugin
+  and an executor plugin (`SQLPlugin.scala:28`).
+* `RapidsDriverPlugin.init` — fixes up session configs (injects the SQL
+  extension, validates the serializer) and returns the `spark.rapids.*`
+  conf map that Spark broadcasts to every executor
+  (`Plugin.scala:68-112`).
+* `RapidsExecutorPlugin.init` — device + memory-pool + semaphore bring-up;
+  a failure kills the executor process so the cluster manager replaces it
+  (`Plugin.scala:117-146`).
+
+Here the same lifecycle drives the TPU engine: the driver plugin owns conf
+fix-up and propagation, the executor plugin owns `ResourceEnv` (TPU
+binding, HBM arena accounting, device->host->disk spill chain, task
+semaphore).  `activate()` is the local-mode convenience that plays both
+roles in-process, the way tests and single-host runs use it.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Optional
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.memory.env import ResourceEnv
+
+log = logging.getLogger(__name__)
+
+_SQL_EXTENSION = "spark_rapids_tpu.plugin.SQLExecPlugin"
+_KRYO_REGISTRATOR = "spark_rapids_tpu.plugin.TpuKryoRegistrator"
+
+
+class ExecutorInitError(RuntimeError):
+    """Raised when executor-side bring-up fails.  The reference calls
+    `System.exit(1)` (`Plugin.scala:132-139`) so Spark replaces the
+    executor; embedders of this engine should treat this exception as
+    process-fatal the same way."""
+
+
+def fixup_configs(spark_conf: dict) -> dict:
+    """Driver-side conf surgery (reference `RapidsPluginUtils.fixupConfigs`
+    `Plugin.scala:68-100`): inject the SQL extension that installs the
+    columnar override rules, and make the serializer registrator-aware so
+    broadcast batches round-trip."""
+    out = dict(spark_conf)
+    exts = [e for e in str(out.get("spark.sql.extensions", "")).split(",")
+            if e]
+    if _SQL_EXTENSION not in exts:
+        exts.append(_SQL_EXTENSION)
+    out["spark.sql.extensions"] = ",".join(exts)
+
+    serializer = out.get("spark.serializer", "")
+    if "KryoSerializer" in serializer:
+        regs = [r for r in
+                str(out.get("spark.kryo.registrator", "")).split(",") if r]
+        if _KRYO_REGISTRATOR not in regs:
+            regs.append(_KRYO_REGISTRATOR)
+        out["spark.kryo.registrator"] = ",".join(regs)
+    elif serializer and "JavaSerializer" not in serializer:
+        raise ValueError(
+            f"spark.serializer={serializer} is not supported "
+            "(reference Plugin.scala:90-98: only the Java and Kryo "
+            "serializers are)")
+    return out
+
+
+def _rapids_conf_map(spark_conf: dict) -> dict:
+    """The subset the driver ships to executors (`Plugin.scala:107-111`
+    filters to `spark.rapids.*`)."""
+    return {k: v for k, v in spark_conf.items()
+            if k.startswith("spark.rapids.")}
+
+
+class DriverPlugin:
+    """Reference `RapidsDriverPlugin` (`Plugin.scala:106-112`)."""
+
+    def __init__(self):
+        self.conf: Optional[C.RapidsConf] = None
+
+    def init(self, spark_conf: dict) -> dict:
+        fixed = fixup_configs(spark_conf)
+        spark_conf.clear()
+        spark_conf.update(fixed)
+        self.conf = C.RapidsConf(dict(spark_conf))
+        return _rapids_conf_map(spark_conf)
+
+
+class ExecutorPlugin:
+    """Reference `RapidsExecutorPlugin` (`Plugin.scala:117-146`)."""
+
+    def __init__(self):
+        self.env: Optional[ResourceEnv] = None
+
+    def init(self, extra_conf: dict,
+             hbm_total: Optional[int] = None,
+             spill_dir: Optional[str] = None) -> None:
+        try:
+            conf = C.RapidsConf(dict(extra_conf))
+            self.env = ResourceEnv.init(conf, hbm_total=hbm_total,
+                                        spill_dir=spill_dir)
+            TpuKryoRegistrator.register_all()
+            # only a successfully validated conf becomes process-active
+            C.set_active_conf(conf)
+        except Exception as e:  # noqa: BLE001 - init failure is fatal
+            log.error("Exception in the executor plugin: %s", e)
+            raise ExecutorInitError(str(e)) from e
+
+    def shutdown(self) -> None:
+        if self.env is not None:
+            ResourceEnv.shutdown()
+            self.env = None
+
+
+class SQLPlugin:
+    """`spark.plugins` SPI entry (reference `SQLPlugin.scala:28`)."""
+
+    def driver_plugin(self) -> DriverPlugin:
+        return DriverPlugin()
+
+    def executor_plugin(self) -> ExecutorPlugin:
+        return ExecutorPlugin()
+
+
+class SQLExecPlugin:
+    """Session-extension hook (reference `Plugin.scala:50-57`): installs
+    the columnar override rules (pre = plan rewrite, post = transitions)
+    and the AQE query-stage prep rule."""
+
+    @staticmethod
+    def apply(extensions: "SparkSessionExtensions") -> None:
+        extensions.inject_columnar(lambda conf: _ColumnarOverrideRules(conf))
+        extensions.inject_query_stage_prep_rule(
+            lambda conf: _query_stage_prep(conf))
+
+
+class SparkSessionExtensions:
+    """Minimal extension registry mirroring Spark's
+    `SparkSessionExtensions` surface the plugin touches."""
+
+    def __init__(self):
+        self.columnar_rules: list[Callable] = []
+        self.query_stage_prep_rules: list[Callable] = []
+
+    def inject_columnar(self, builder: Callable) -> None:
+        self.columnar_rules.append(builder)
+
+    def inject_query_stage_prep_rule(self, builder: Callable) -> None:
+        self.query_stage_prep_rules.append(builder)
+
+
+class _ColumnarOverrideRules:
+    """pre/post columnar transition rules (`Plugin.scala:38-45`)."""
+
+    def __init__(self, conf: C.RapidsConf):
+        self.conf = conf
+
+    def pre_columnar_transitions(self, plan):
+        from spark_rapids_tpu.plan.overrides import accelerate
+        return accelerate(plan, self.conf)
+
+    def post_columnar_transitions(self, plan):
+        return plan  # accelerate() already runs the transition pass
+
+
+def _query_stage_prep(conf: C.RapidsConf):
+    from spark_rapids_tpu.plan.aqe import query_stage_prep
+    return lambda plan: query_stage_prep(plan, conf)
+
+
+class TpuKryoRegistrator:
+    """Serializer registry for broadcast/shuffle payload classes
+    (reference `GpuKryoRegistrator.scala:34`, which registers
+    `SerializeConcatHostBuffersDeserializeBatch` and friends with Kryo).
+    Here: class -> (serialize, deserialize) over the engine's host-buffer
+    wire format (`columnar/serde.py`)."""
+
+    _registry: dict[type, tuple[Callable, Callable]] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def register(cls, klass: type, ser: Callable, deser: Callable) -> None:
+        with cls._lock:
+            cls._registry[klass] = (ser, deser)
+
+    @classmethod
+    def lookup(cls, klass: type) -> Optional[tuple[Callable, Callable]]:
+        for base in klass.__mro__:
+            hit = cls._registry.get(base)
+            if hit is not None:
+                return hit
+        return None
+
+    @classmethod
+    def serialize(cls, obj: Any) -> bytes:
+        hit = cls.lookup(type(obj))
+        if hit is None:
+            raise TypeError(f"no serializer registered for {type(obj)}")
+        return hit[0](obj)
+
+    @classmethod
+    def deserialize(cls, klass: type, blob: bytes) -> Any:
+        hit = cls.lookup(klass)
+        if hit is None:
+            raise TypeError(f"no serializer registered for {klass}")
+        return hit[1](blob)
+
+    @classmethod
+    def register_all(cls) -> None:
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.columnar.serde import (deserialize_batch,
+                                                     serialize_batch)
+        cls.register(ColumnarBatch, serialize_batch,
+                     lambda blob: deserialize_batch(blob))
+
+
+# ---------------------------------------------------------------------------
+_ACTIVE: dict = {}
+_ACTIVE_LOCK = threading.Lock()
+
+
+def activate(settings: Optional[dict] = None,
+             hbm_total: Optional[int] = None,
+             spill_dir: Optional[str] = None) -> C.RapidsConf:
+    """Local-mode bring-up: run the driver plugin's conf fix-up and the
+    executor plugin's resource init in this process (driver and executor
+    are the same process in Spark local mode), install the session
+    extension, and make the resulting conf active."""
+    with _ACTIVE_LOCK:
+        spark_conf = dict(settings or {})
+        driver = DriverPlugin()
+        driver.init(spark_conf)  # fixes up spark_conf in place
+        executor = ExecutorPlugin()
+        # local mode: driver and executor share the process, so the
+        # executor sees the full fixed-up conf (a cluster would ship only
+        # the spark.rapids.* map and merge it into executor-side confs)
+        executor.init(spark_conf, hbm_total=hbm_total,
+                      spill_dir=spill_dir)
+        extensions = SparkSessionExtensions()
+        SQLExecPlugin.apply(extensions)
+        _ACTIVE.update(driver=driver, executor=executor,
+                       extensions=extensions)
+        return C.get_active_conf()
+
+
+def deactivate() -> None:
+    with _ACTIVE_LOCK:
+        executor = _ACTIVE.pop("executor", None)
+        if executor is not None:
+            executor.shutdown()
+        _ACTIVE.clear()
+        C.set_active_conf(C.RapidsConf())

@@ -139,9 +139,14 @@ class CpuMapInPandas(CpuNode):
     def execute(self):
         def run(it):
             sem = PythonWorkerSemaphore.get()
-            with sem.held():
-                for out in self.fn(iter(it)):
-                    yield normalize_df(out, self._schema)
+            gen = self.fn(iter(it))
+            while True:
+                with sem.held():
+                    try:
+                        out = next(gen)
+                    except StopIteration:
+                        break
+                yield normalize_df(out, self._schema)
         return [run(it) for it in self.child.execute()]
 
 
@@ -166,10 +171,17 @@ class MapInPandasExec(UnaryExecBase):
             for b in batches:
                 yield df_from_batch(b)
         sem = PythonWorkerSemaphore.get()
-        with sem.held():
-            for out in self.node.fn(host_frames()):
-                out = normalize_df(out, schema)
-                TpuSemaphore.get().acquire_if_necessary()
-                nb = batch_from_df(out, schema)
-                self.update_output_metrics(nb)
-                yield nb
+        gen = self.node.fn(host_frames())
+        # hold the worker slot only while python code runs (each next()),
+        # not across downstream device work on the yielded batch
+        while True:
+            with sem.held():
+                try:
+                    out = next(gen)
+                except StopIteration:
+                    break
+            out = normalize_df(out, schema)
+            TpuSemaphore.get().acquire_if_necessary()
+            nb = batch_from_df(out, schema)
+            self.update_output_metrics(nb)
+            yield nb

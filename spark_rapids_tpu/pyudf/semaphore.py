@@ -18,6 +18,9 @@ class PythonWorkerSemaphore:
                      if max_workers > 0 else None)
         self.active = 0
         self._alock = threading.Lock()
+        # per-thread hold depth: stacked python-UDF operators on one task
+        # thread share a single worker slot instead of self-deadlocking
+        self._tls = threading.local()
 
     @classmethod
     def initialize(cls, max_workers: int) -> "PythonWorkerSemaphore":
@@ -41,14 +44,20 @@ class PythonWorkerSemaphore:
 
     @contextmanager
     def held(self):
-        if self._sem is not None:
+        depth = getattr(self._tls, "depth", 0)
+        outermost = depth == 0
+        self._tls.depth = depth + 1
+        if outermost and self._sem is not None:
             self._sem.acquire()
-        with self._alock:
-            self.active += 1
+        if outermost:
+            with self._alock:
+                self.active += 1
         try:
             yield
         finally:
-            with self._alock:
-                self.active -= 1
-            if self._sem is not None:
-                self._sem.release()
+            self._tls.depth -= 1
+            if outermost:
+                with self._alock:
+                    self.active -= 1
+                if self._sem is not None:
+                    self._sem.release()

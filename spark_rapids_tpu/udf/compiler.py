@@ -198,7 +198,11 @@ def compile_udf(fn: Callable, arg_exprs: Sequence[Expression]
                    for i, e in enumerate(arg_exprs)}
         interp = _Interpreter(cfg, fn.__globals__, freevars)
         return interp.eval_block(cfg.entry, locals_, [], depth=0)
-    except (UdfCompileError, KeyError, IndexError, AttributeError):
+    except (UdfCompileError, KeyError, IndexError, AttributeError,
+            TypeError, ValueError):
+        # TypeError/ValueError cover arity or operand-kind mismatches
+        # inside expression builders — fall back like any other
+        # unsupported construct
         return None
 
 
@@ -355,11 +359,27 @@ class _Interpreter:
             raise UdfCompileError("no fall-through block")
         return nxt
 
+    def _check_not_shadowed(self, name: str) -> None:
+        """Global-call dispatch is by name; if the UDF's module rebinds
+        that name (`def round(x): ...`, `math = something`), compiling it
+        as the builtin would silently change results — fall back
+        instead."""
+        import builtins
+        base = name.split(".", 1)[0]
+        if base not in self.globals:
+            return
+        bound = self.globals[base]
+        expected = math if base == "math" else getattr(builtins, base, None)
+        if bound is not expected:
+            raise UdfCompileError(f"global {base} is shadowed in the "
+                                  "UDF's module")
+
     def _call(self, target, args) -> Expression:
         if not isinstance(target, _Marker):
             raise UdfCompileError(f"call of {target!r}")
         if target.kind == "global":
             name = target.payload
+            self._check_not_shadowed(name)
             builder = _GLOBAL_CALLS.get(name)
             if builder is None:
                 raise UdfCompileError(f"unsupported function {name}")

@@ -333,3 +333,25 @@ def test_cpu_udf_real_bugs_surface():
     bad = PythonUDF(lambda x: x.upper(), T.STRING, (col("a"),))
     with pytest.raises(AttributeError):
         cpu_eval(bad, df, schema)
+
+
+def test_fallback_on_arity_mismatch():
+    # min() with 3 args has no 3-ary builder; must fall back, not raise
+    def f(a, b, c):
+        return min(a, b, c)
+    assert compile_udf(f, [col("a"), col("b"), col("a")]) is None
+
+
+def test_fallback_on_shadowed_builtin():
+    # a module-level rebind of a supported name must not compile as the
+    # builtin (silent wrong results); it falls back to the CPU UDF
+    import types
+    mod = types.ModuleType("shadow_mod")
+    exec("def round(x):\n    return x * 1000\n"
+         "def f(v):\n    return round(v)", mod.__dict__)
+    assert compile_udf(mod.f, [col("a")]) is None
+
+
+def test_module_level_math_still_compiles():
+    e = compile_udf(lambda x: math.floor(x), [col("b")])
+    assert e is not None
