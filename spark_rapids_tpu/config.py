@@ -75,6 +75,9 @@ TEST_ALLOWED_NONGPU = conf("spark.rapids.sql.test.allowedNonGpu", "",
 EXPORT_COLUMNAR_RDD = conf("spark.rapids.sql.exportColumnarRdd", False,
                            "Expose the final columnar output for ML "
                            "integration (ColumnarRdd).")
+SPARK_VERSION = conf("spark.rapids.tpu.sparkVersion", "3.0.1",
+                     "Spark version the session emulates; selects the "
+                     "shim set (reference ShimLoader.scala:26-61).")
 
 # --- batch sizing / memory (reference :271-360) -----------------------------
 BATCH_SIZE_BYTES = conf("spark.rapids.sql.batchSizeBytes", 2147483136,

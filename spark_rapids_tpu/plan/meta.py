@@ -111,6 +111,15 @@ class PlanMeta(BaseMeta):
         self._tag_types()
         if self.rule.tag_extra is not None:
             self.rule.tag_extra(self)
+        pinned = getattr(self.node, "_tpu_tag", None)
+        if pinned is not None and not pinned[0] \
+                and self.can_this_be_replaced:
+            # AQE query-stage prep pinned this node off the TPU with
+            # whole-plan context a stage-local re-tag cannot see
+            # (reference TreeNodeTag propagation RapidsMeta.scala:121-137)
+            reasons = pinned[1] or {"pinned off TPU by query-stage prep"}
+            for r in reasons:
+                self.will_not_work_on_tpu(r)
 
     def _tag_types(self) -> None:
         """Type-matrix check (reference areAllSupportedTypes)."""
@@ -129,15 +138,17 @@ class PlanMeta(BaseMeta):
         """Returns TpuExec when this node goes on the TPU, else a CpuNode
         with converted children bridged through transitions
         (reference convertIfNeeded RapidsMeta.scala:578-593)."""
-        from spark_rapids_tpu.plan.transitions import (
-            ColumnarToRowExec, RowToColumnarExec)
+        from spark_rapids_tpu.plan.transitions import RowToColumnarExec
+        from spark_rapids_tpu.shims import current_shims
         kids = [c.convert_if_needed() for c in self.child_plans]
         from spark_rapids_tpu.exec.base import TpuExec
         if self.can_this_be_replaced:
             tpu_kids = [k if isinstance(k, TpuExec) else RowToColumnarExec(k)
                         for k in kids]
             return self.rule.convert(self, tpu_kids)
-        cpu_kids = [k if isinstance(k, CpuNode) else ColumnarToRowExec(k)
+        shims = current_shims(self.conf)
+        cpu_kids = [k if isinstance(k, CpuNode)
+                    else shims.columnar_to_row_transition(k)
                     for k in kids]
         import copy
         node = copy.copy(self.node)  # never mutate the caller's plan

@@ -346,6 +346,16 @@ def _tag_file_scan(meta) -> None:
     if fmt == "csv":
         for reason in node.scan.reader.options.tag_unsupported():
             meta.will_not_work_on_tpu(f"CSV: {reason}")
+    if fmt == "parquet":
+        # hybrid-calendar (julian/gregorian) rebase is CPU-only
+        # (reference GpuParquetScan.scala:1108-1115); the conf key is
+        # version-variant, so it routes through the shim layer
+        from spark_rapids_tpu.shims import current_shims
+        key = current_shims(meta.conf).parquet_rebase_read_key()
+        mode = str(meta.conf.get(key, "CORRECTED")).upper()
+        if mode in ("LEGACY", "TRUE"):
+            meta.will_not_work_on_tpu(
+                f"legacy datetime rebase requested via {key}")
 
 
 def _conv_file_scan(meta, kids) -> TpuExec:

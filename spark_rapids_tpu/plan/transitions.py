@@ -56,6 +56,16 @@ def batch_from_df(df: pd.DataFrame, schema: T.Schema) -> ColumnarBatch:
     return ColumnarBatch.from_numpy(data, schema, validity)
 
 
+def series_from_column(field: T.Field, vals, valid) -> pd.Series:
+    """One host column -> nullable pandas Series; shared by every
+    device-exit strategy so dtype semantics cannot drift between shims."""
+    if field.dtype.is_string:
+        return pd.Series(list(vals), dtype=object)
+    s = pd.Series(vals).astype(nullable_dtype(field.dtype))
+    s[~np.asarray(valid)] = pd.NA
+    return s
+
+
 def df_from_batch(batch: ColumnarBatch) -> pd.DataFrame:
     """Device batch -> host rows with nullable dtypes (storage model
     preserved: DATE32 stays int days, TIMESTAMP_US stays int micros), so
@@ -63,12 +73,7 @@ def df_from_batch(batch: ColumnarBatch) -> pd.DataFrame:
     out = {}
     for f, c in zip(batch.schema.fields, batch.columns):
         vals, valid = c.to_numpy(batch.num_rows)
-        if f.dtype.is_string:
-            out[f.name] = pd.Series(list(vals), dtype=object)
-        else:
-            s = pd.Series(vals).astype(nullable_dtype(f.dtype))
-            s[~valid] = pd.NA
-            out[f.name] = s
+        out[f.name] = series_from_column(f, vals, valid)
     return pd.DataFrame(out)
 
 
@@ -132,6 +137,34 @@ class ColumnarToRowExec(CpuNode):
                 df = df_from_batch(batch)
                 TpuSemaphore.get().release_if_necessary()
                 yield df
+        return [convert(it) for it in self.tpu_child.execute_partitions()]
+
+
+class AcceleratedColumnarToRowExec(ColumnarToRowExec):
+    """Spark 3.1.0's accelerated device-exit transition (reference
+    `SparkShims.getGpuColumnarToRowTransition`, spark310 shim): all
+    columns of a batch leave the device in ONE packed transfer
+    (`jax.device_get` of the whole pytree) instead of per-column syncs."""
+
+    def execute(self):
+        import jax
+
+        def convert(it):
+            for batch in it:
+                n = batch.num_rows
+                host = list(jax.device_get(
+                    [(c.data, c.validity) for c in batch.columns
+                     if not c.dtype.is_string]))
+                out = {}
+                for f, c in zip(batch.schema.fields, batch.columns):
+                    if f.dtype.is_string:
+                        vals, valid = c.to_numpy(n)
+                    else:
+                        data, validity = host.pop(0)
+                        vals, valid = data[:n], validity[:n]
+                    out[f.name] = series_from_column(f, vals, valid)
+                TpuSemaphore.get().release_if_necessary()
+                yield pd.DataFrame(out)
         return [convert(it) for it in self.tpu_child.execute_partitions()]
 
 

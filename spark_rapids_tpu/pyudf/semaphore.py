@@ -46,9 +46,11 @@ class PythonWorkerSemaphore:
     def held(self):
         depth = getattr(self._tls, "depth", 0)
         outermost = depth == 0
-        self._tls.depth = depth + 1
         if outermost and self._sem is not None:
+            # acquire before bumping the depth: a failed/interrupted
+            # acquire must not leave this thread marked as holding
             self._sem.acquire()
+        self._tls.depth = depth + 1
         if outermost:
             with self._alock:
                 self.active += 1

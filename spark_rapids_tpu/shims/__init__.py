@@ -1,0 +1,18 @@
+"""Version shim layer (reference `sql-plugin/.../SparkShims.scala` +
+`shims/spark30*` modules): everything that varies across supported Spark
+versions routes through a `SparkShims` instance resolved by `ShimLoader`.
+"""
+from spark_rapids_tpu.shims.base import ShimVersion, SparkShims
+from spark_rapids_tpu.shims.loader import (current_shims, detect_version,
+                                           get_spark_shims,
+                                           register_provider)
+from spark_rapids_tpu.shims.versions import (ALL_SHIMS, Spark300dbShims,
+                                             Spark300Shims, Spark301Shims,
+                                             Spark302Shims, Spark310Shims)
+
+__all__ = [
+    "ShimVersion", "SparkShims", "current_shims", "detect_version",
+    "get_spark_shims", "register_provider", "ALL_SHIMS",
+    "Spark300Shims", "Spark300dbShims", "Spark301Shims", "Spark302Shims",
+    "Spark310Shims",
+]

@@ -1,0 +1,142 @@
+"""Version shim surface (reference `SparkShims.scala:57-136`).
+
+The reference abstracts Spark 3.0.0/3.0.1/3.0.2/3.1.0/Databricks API drift
+behind a ~25-method `SparkShims` trait with per-version implementations
+discovered by a `ServiceLoader` (`ShimLoader.scala:26-61`).  The TPU build
+keeps the same contract: everything version-variant — transition execs,
+First/Last aggregate construction, AQE map-output range reads, file
+partition packing, the per-version shuffle-manager class name — routes
+through a `SparkShims` instance resolved from the session's Spark version.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class ShimVersion:
+    """Parsed Spark version (reference `SparkShimVersion` /
+    `DatabricksShimVersion` in `SparkShims.scala:24-36`)."""
+    major: int
+    minor: int
+    patch: int
+    databricks: bool = False
+
+    def __str__(self):
+        base = f"{self.major}.{self.minor}.{self.patch}"
+        return base + ("-databricks" if self.databricks else "")
+
+    @staticmethod
+    def parse(s: str) -> "ShimVersion":
+        db = "databricks" in s or "-db" in s
+        m = re.match(r"^(\d+)\.(\d+)\.(\d+)", s)
+        if not m:
+            raise ValueError(f"cannot parse Spark version {s!r}")
+        return ShimVersion(int(m.group(1)), int(m.group(2)),
+                           int(m.group(3)), db)
+
+
+class SparkShims:
+    """Base shim: the Spark 3.0.0 behavior set.  Later versions subclass
+    and override only what drifted (mirrors how `shims/spark30*` carry
+    per-version copies of version-sensitive classes)."""
+
+    #: exact version strings this shim serves (reference
+    #: `SparkShimServiceProvider.matchesVersion`)
+    VERSION_NAMES: tuple = ()
+
+    @property
+    def version(self) -> ShimVersion:
+        return ShimVersion.parse(self.VERSION_NAMES[0])
+
+    # -- transitions --------------------------------------------------------
+    def columnar_to_row_transition(self, tpu_child):
+        """Device-exit transition exec.  3.1.0 swaps in an accelerated
+        variant (reference `SparkShims.getGpuColumnarToRowTransition`,
+        spark310 shim)."""
+        from spark_rapids_tpu.plan.transitions import ColumnarToRowExec
+        return ColumnarToRowExec(tpu_child)
+
+    # -- expression construction drift --------------------------------------
+    def make_first_last(self, child, last: bool, ignore_nulls: bool):
+        """First/Last aggregate constructor (API changed in 3.0.1:
+        `ignoreNulls` became a plain boolean — reference shims carry
+        per-version `GpuFirst`/`GpuLast`).  The 3.0.0 form models the
+        literal-expression API by validating a literal-like value."""
+        from spark_rapids_tpu.exprs.aggregates import First, Last
+        ctor = Last if last else First
+        return ctor(child, ignore_nulls=bool(ignore_nulls))
+
+    # -- shuffle / AQE ------------------------------------------------------
+    def shuffle_manager_class(self) -> str:
+        """Fully-qualified per-version shuffle manager (reference
+        `shims/spark300/.../spark300/RapidsShuffleManager.scala`)."""
+        return ("spark_rapids_tpu.shims.spark300.RapidsShuffleManager")
+
+    def supports_map_index_ranges(self) -> bool:
+        """Spark 3.0.x `getMapSizesByExecutorId` cannot address partial
+        mapper ranges; 3.1.0 can (AQE skew-split reads)."""
+        return False
+
+    def get_map_sizes(self, registry, shuffle_id: int,
+                      start_map: int, end_map: Optional[int],
+                      start_part: int, end_part: int):
+        """Map-output lookup for a reducer range (reference
+        `SparkShims.getMapSizesByExecutorId`).  Returns
+        [(map_id, part_id, size_bytes)] for blocks in range."""
+        statuses = registry.outputs_for(shuffle_id)
+        all_maps = (max(statuses) + 1) if statuses else 0
+        hi = all_maps if end_map is None else end_map
+        if (start_map, hi) != (0, all_maps) \
+                and not self.supports_map_index_ranges():
+            raise NotImplementedError(
+                f"Spark {self.version} cannot fetch partial mapper ranges")
+        out = []
+        for map_id in range(start_map, hi):
+            if map_id not in statuses:
+                continue
+            sizes = statuses[map_id].partition_sizes
+            for part_id in range(start_part, end_part):
+                if sizes[part_id] > 0:
+                    out.append((map_id, part_id, sizes[part_id]))
+        return out
+
+    def aqe_shuffle_reader_name(self) -> str:
+        """Display/class name of the AQE shuffle reader this version uses
+        (upstream `CustomShuffleReaderExec`; Databricks forked its own)."""
+        return "CustomShuffleReaderExec"
+
+    # -- file scan ----------------------------------------------------------
+    def make_file_partitions(self, files: Sequence, max_bytes: int,
+                             open_cost: int = 4 * 1024 * 1024):
+        """Pack (path, size) file splits into partitions (reference
+        `SparkShims.createFilePartition` / `getFileScanRDD` drift).  Spark
+        3.0.x packs greedily by size + open cost."""
+        parts, cur, cur_bytes = [], [], 0
+        for f in sorted(files, key=lambda f: -f[1]):
+            est = f[1] + open_cost
+            if cur and cur_bytes + est > max_bytes:
+                parts.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(f)
+            cur_bytes += est
+        if cur:
+            parts.append(cur)
+        return parts
+
+    # -- config drift -------------------------------------------------------
+    def parquet_rebase_read_key(self) -> str:
+        """Hybrid-calendar rebase conf key; Spark 3.0.0 shipped the
+        boolean-era name, renamed to the mode conf in 3.0.1."""
+        return "spark.sql.legacy.parquet.rebaseDateTimeInRead"
+
+    # -- rule extensions ----------------------------------------------------
+    def extra_exec_rules(self) -> dict:
+        """Per-version exec replacement rules added on top of the common
+        set (reference `SparkShims.getExecs`)."""
+        return {}
+
+    def extra_expr_rules(self) -> dict:
+        return {}

@@ -1,0 +1,65 @@
+"""Shim discovery (reference `ShimLoader.scala:26-61`).
+
+The reference finds `SparkShimServiceProvider`s via Java's `ServiceLoader`
+and picks the one whose `matchesVersion` accepts the running Spark version
+(with a Databricks sniff, since Databricks misreports its base version).
+Here providers self-register at import; resolution is by exact version
+string, with the same Databricks detection hook.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.shims.base import SparkShims
+from spark_rapids_tpu.shims.versions import ALL_SHIMS
+
+log = logging.getLogger(__name__)
+
+_PROVIDERS: list[type] = list(ALL_SHIMS)
+_lock = threading.Lock()
+_cache: dict[str, SparkShims] = {}
+
+
+def register_provider(shim_class: type) -> None:
+    """ServiceLoader analog: add an externally-defined shim provider."""
+    with _lock:
+        _PROVIDERS.append(shim_class)
+    _cache.clear()
+
+
+def detect_version(conf: Optional[C.RapidsConf] = None) -> str:
+    """The session's Spark version.  Databricks detection mirrors
+    `ShimLoader.scala`: the cluster-tag conf marks a Databricks runtime
+    regardless of the reported base version."""
+    conf = conf or C.get_active_conf()
+    version = str(conf[C.SPARK_VERSION])
+    if conf.get("spark.databricks.clusterUsageTags.clusterId") \
+            and "databricks" not in version:
+        version = f"{version}-databricks"
+    return version
+
+
+def get_spark_shims(version: Optional[str] = None,
+                    conf: Optional[C.RapidsConf] = None) -> SparkShims:
+    version = version or detect_version(conf)
+    with _lock:
+        hit = _cache.get(version)
+        if hit is not None:
+            return hit
+        for provider in _PROVIDERS:
+            if version in provider.VERSION_NAMES:
+                shims = provider()
+                _cache[version] = shims
+                log.info("Loaded shims for Spark %s via %s", version,
+                         provider.__name__)
+                return shims
+    raise RuntimeError(
+        f"Could not find a shim provider for Spark version {version!r}; "
+        f"supported: {[v for p in _PROVIDERS for v in p.VERSION_NAMES]}")
+
+
+def current_shims(conf: Optional[C.RapidsConf] = None) -> SparkShims:
+    return get_spark_shims(conf=conf)

@@ -1,0 +1,8 @@
+"""Per-version package (reference `shims/spark300db/.../spark300db/RapidsShuffleManager.scala`):
+the version-named shuffle-manager class users put in
+`spark.shuffle.manager`."""
+from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+
+class RapidsShuffleManager(TpuShuffleManager):
+    SPARK_VERSION = "spark300db"

@@ -1,0 +1,71 @@
+"""Per-version shim implementations (reference `shims/spark300`,
+`spark300db`, `spark301`, `spark302`, `spark310` modules).
+
+Each class carries only what drifted from its parent, the same way the
+reference's per-version source trees carry per-version copies of
+version-sensitive classes.
+"""
+from __future__ import annotations
+
+from spark_rapids_tpu.shims.base import SparkShims
+
+
+class Spark300Shims(SparkShims):
+    """Spark 3.0.0 — the base behavior set."""
+    VERSION_NAMES = ("3.0.0",)
+
+
+class Spark300dbShims(Spark300Shims):
+    """Databricks 3.0.0 (reference `shims/spark300db`): forked AQE classes
+    and its own shuffle-manager package."""
+    VERSION_NAMES = ("3.0.0-databricks",)
+
+    def aqe_shuffle_reader_name(self) -> str:
+        # Databricks runtime forked AQE before upstream settled the name.
+        return "DatabricksShuffleReaderExec"
+
+    def shuffle_manager_class(self) -> str:
+        return "spark_rapids_tpu.shims.spark300db.RapidsShuffleManager"
+
+
+class Spark301Shims(Spark300Shims):
+    """Spark 3.0.1 (reference `shims/spark301`): First/Last boolean API,
+    renamed rebase conf, per-version shuffle manager package."""
+    VERSION_NAMES = ("3.0.1",)
+
+    def shuffle_manager_class(self) -> str:
+        return "spark_rapids_tpu.shims.spark301.RapidsShuffleManager"
+
+    def parquet_rebase_read_key(self) -> str:
+        return "spark.sql.legacy.parquet.datetimeRebaseModeInRead"
+
+
+class Spark302Shims(Spark301Shims):
+    """Spark 3.0.2 (reference `shims/spark302`): identical surface to
+    3.0.1 except the advertised version/manager package."""
+    VERSION_NAMES = ("3.0.2",)
+
+    def shuffle_manager_class(self) -> str:
+        return "spark_rapids_tpu.shims.spark302.RapidsShuffleManager"
+
+
+class Spark310Shims(Spark301Shims):
+    """Spark 3.1.0 (reference `shims/spark310`): accelerated
+    columnar→row transition, map-index-range shuffle reads (AQE skew
+    splits), renamed rebase confs."""
+    VERSION_NAMES = ("3.1.0", "3.1.1-SNAPSHOT")
+
+    def columnar_to_row_transition(self, tpu_child):
+        from spark_rapids_tpu.plan.transitions import (
+            AcceleratedColumnarToRowExec)
+        return AcceleratedColumnarToRowExec(tpu_child)
+
+    def supports_map_index_ranges(self) -> bool:
+        return True
+
+    def shuffle_manager_class(self) -> str:
+        return "spark_rapids_tpu.shims.spark310.RapidsShuffleManager"
+
+
+ALL_SHIMS = (Spark300Shims, Spark300dbShims, Spark301Shims, Spark302Shims,
+             Spark310Shims)

@@ -146,6 +146,23 @@ def test_parquet_filter_pushdown_prunes_row_groups(pq_path):
     assert rows == 20
 
 
+def test_parquet_legacy_rebase_falls_back(pq_path):
+    """LEGACY hybrid-calendar rebase keeps the scan on CPU (reference
+    GpuParquetScan.scala:1108-1115), via the version-variant conf key."""
+    from spark_rapids_tpu.plan.overrides import accelerate
+    from spark_rapids_tpu.plan.nodes import CpuNode
+    key = "spark.sql.legacy.parquet.datetimeRebaseModeInRead"
+    c = conf(**{key: "LEGACY"})
+    out = accelerate(tio.read_parquet(pq_path), c)
+    assert isinstance(out, CpuNode)
+    ExecutionPlanCapture.assert_did_fall_back("CpuFileScan[parquet]")
+    # 3.0.0 sessions use the boolean-era key
+    c300 = conf(**{"spark.rapids.tpu.sparkVersion": "3.0.0",
+                   "spark.sql.legacy.parquet.rebaseDateTimeInRead": "true"})
+    out300 = accelerate(tio.read_parquet(pq_path), c300)
+    assert isinstance(out300, CpuNode)
+
+
 def test_parquet_filter_query_parity(pq_path):
     plan = CpuFilter((col("i") >= lit(25)) & (col("i") < lit(35)),
                      tio.read_parquet(pq_path))

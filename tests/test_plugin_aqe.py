@@ -200,6 +200,20 @@ class TestAdaptiveExecution:
         assert plan._tpu_tag[0] is True
         assert src._tpu_tag[0] is True
 
+    def test_pinned_off_tpu_verdict_survives_retag(self):
+        """A node the whole-plan prep pass pinned off the TPU must stay
+        off it when a stage-local re-tag would otherwise accept it
+        (reference TreeNodeTag propagation RapidsMeta.scala:121-137)."""
+        from spark_rapids_tpu.plan.overrides import (ExecutionPlanCapture,
+                                                     accelerate)
+        df = pd.DataFrame({"a": pd.array([1, 2, 3], "Int64")})
+        src = N.CpuSource.from_pandas(df)
+        plan = N.CpuFilter(col("a") > 1, src)
+        plan._tpu_tag = (False, frozenset({"whole-plan consistency pin"}))
+        out = accelerate(plan, C.RapidsConf())
+        assert isinstance(out, N.CpuNode)
+        ExecutionPlanCapture.assert_did_fall_back("CpuFilter")
+
     def test_broadcast_join_probe_side_rebinding(self):
         """A BroadcastHashJoinExec whose PROBE child is an exchange must
         execute the adapted stage, not re-run the raw exchange through a

@@ -1,0 +1,185 @@
+"""Shim layer tests (reference `shims/` + `ShimLoader.scala`): version
+resolution, Databricks sniffing, per-version behavior drift, and the
+spark310 accelerated columnar→row transition parity."""
+import importlib
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import shims as S
+from spark_rapids_tpu.exec.basic import LocalBatchSource
+from spark_rapids_tpu.plan import CpuSource, accelerate, collect
+from spark_rapids_tpu.plan.transitions import (AcceleratedColumnarToRowExec,
+                                               ColumnarToRowExec)
+from spark_rapids_tpu.shuffle.manager import (MapOutputRegistry, MapStatus,
+                                              TpuShuffleManager)
+
+
+def conf(**kv):
+    return C.RapidsConf({k.replace("__", "."): v for k, v in kv.items()})
+
+
+# -- loader -----------------------------------------------------------------
+def test_loader_resolves_every_supported_version():
+    for provider in S.ALL_SHIMS:
+        for name in provider.VERSION_NAMES:
+            assert type(S.get_spark_shims(name)) is provider
+
+
+def test_loader_unknown_version_raises():
+    with pytest.raises(RuntimeError, match="3.2.0"):
+        S.get_spark_shims("3.2.0")
+
+
+def test_loader_caches_instances():
+    assert S.get_spark_shims("3.0.1") is S.get_spark_shims("3.0.1")
+
+
+def test_databricks_detection_from_cluster_tag():
+    c = conf(**{"spark.databricks.clusterUsageTags.clusterId": "0001-x",
+                "spark.rapids.tpu.sparkVersion": "3.0.0"})
+    assert S.detect_version(c) == "3.0.0-databricks"
+    assert isinstance(S.current_shims(c), S.Spark300dbShims)
+
+
+def test_default_version_is_301():
+    assert isinstance(S.current_shims(conf()), S.Spark301Shims)
+
+
+def test_shim_version_parse_and_order():
+    v = S.ShimVersion.parse("3.1.1-SNAPSHOT")
+    assert (v.major, v.minor, v.patch) == (3, 1, 1)
+    assert S.ShimVersion.parse("3.0.0") < S.ShimVersion.parse("3.1.0")
+    assert S.ShimVersion.parse("3.0.0-databricks").databricks
+
+
+def test_register_external_provider():
+    class CustomShims(S.Spark301Shims):
+        VERSION_NAMES = ("3.0.1-custom",)
+    S.register_provider(CustomShims)
+    assert isinstance(S.get_spark_shims("3.0.1-custom"), CustomShims)
+
+
+# -- per-version drift ------------------------------------------------------
+def test_shuffle_manager_classes_resolve_per_version():
+    for version, pkg in [("3.0.0", "spark300"), ("3.0.1", "spark301"),
+                         ("3.0.2", "spark302"), ("3.1.0", "spark310"),
+                         ("3.0.0-databricks", "spark300db")]:
+        path = S.get_spark_shims(version).shuffle_manager_class()
+        mod, cls_name = path.rsplit(".", 1)
+        assert pkg in mod
+        cls = getattr(importlib.import_module(mod), cls_name)
+        assert issubclass(cls, TpuShuffleManager)
+
+
+def test_aqe_reader_name_databricks_fork():
+    assert S.get_spark_shims("3.0.0").aqe_shuffle_reader_name() \
+        == "CustomShuffleReaderExec"
+    assert S.get_spark_shims("3.0.0-databricks").aqe_shuffle_reader_name() \
+        == "DatabricksShuffleReaderExec"
+
+
+def test_map_index_ranges_gate():
+    MapOutputRegistry.clear()
+    sid = 991
+    for map_id, sizes in enumerate([[10, 0, 5], [0, 7, 3]]):
+        MapOutputRegistry.register(
+            sid, map_id, MapStatus("e0", "local", sizes))
+    s310 = S.get_spark_shims("3.1.0")
+    got = s310.get_map_sizes(MapOutputRegistry, sid, 1, 2, 0, 3)
+    assert got == [(1, 1, 7), (1, 2, 3)]
+    # full range works everywhere
+    s300 = S.get_spark_shims("3.0.0")
+    full = s300.get_map_sizes(MapOutputRegistry, sid, 0, None, 0, 3)
+    assert (0, 0, 10) in full and (1, 1, 7) in full
+    with pytest.raises(NotImplementedError):
+        s300.get_map_sizes(MapOutputRegistry, sid, 1, 2, 0, 3)
+    MapOutputRegistry.clear()
+
+
+def test_file_partition_packing():
+    files = [("a", 10), ("b", 200), ("c", 30), ("d", 5)]
+    parts = S.get_spark_shims("3.0.1").make_file_partitions(
+        files, max_bytes=256, open_cost=8)
+    assert sorted(f for p in parts for f, _ in p) == ["a", "b", "c", "d"]
+    for p in parts:
+        assert sum(sz + 8 for _, sz in p) <= 256 or len(p) == 1
+
+
+def test_first_last_construction():
+    from spark_rapids_tpu.exprs.aggregates import First, Last
+    from spark_rapids_tpu.exprs.base import col
+    sh = S.get_spark_shims("3.0.0")
+    f = sh.make_first_last(col("a"), last=False, ignore_nulls=True)
+    l = sh.make_first_last(col("a"), last=True, ignore_nulls=False)
+    assert isinstance(f, First) and f.ignore_nulls
+    assert isinstance(l, Last) and not l.ignore_nulls
+
+
+# -- accelerated transition -------------------------------------------------
+def _df():
+    return pd.DataFrame({
+        "a": np.arange(20, dtype=np.int64),
+        "b": [float(i) if i % 3 else np.nan for i in range(20)],
+        "s": [None if i % 5 == 0 else f"v{i}" for i in range(20)],
+    })
+
+
+def test_transition_classes_per_version():
+    src = LocalBatchSource.from_pandas(_df())
+    assert type(S.get_spark_shims("3.0.1")
+                .columnar_to_row_transition(src)) is ColumnarToRowExec
+    assert type(S.get_spark_shims("3.1.0")
+                .columnar_to_row_transition(src)) \
+        is AcceleratedColumnarToRowExec
+
+
+def test_accelerated_transition_parity():
+    df = _df()
+    src = LocalBatchSource.from_pandas(df, num_partitions=2)
+    base = ColumnarToRowExec(src).collect()
+    fast = AcceleratedColumnarToRowExec(src).collect()
+    pd.testing.assert_frame_equal(base, fast)
+
+
+def _find_node(plan, cls):
+    found = []
+
+    def walk(n):
+        if isinstance(n, cls):
+            found.append(n)
+        kids = getattr(n, "children", [])
+        for k in kids:
+            walk(k)
+        tk = getattr(n, "tpu_child", None)
+        if tk is not None:
+            walk(tk)
+    walk(plan)
+    return found
+
+
+def test_accelerated_transition_in_plan_rewrite():
+    """With sparkVersion=3.1.0 a CPU-fallback boundary below a TPU
+    island gets the accelerated transition end-to-end."""
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.plan import CpuFilter, CpuProject
+    df = _df()
+    build = lambda: CpuFilter(
+        col("a") > 4, CpuProject([col("a"), col("b"), col("s")],
+                                 CpuSource.from_pandas(df)))
+    expected = build().collect()
+    c = conf(**{"spark.rapids.tpu.sparkVersion": "3.1.0",
+                "spark.rapids.sql.exec.CpuFilter": False})
+    out = accelerate(build(), c)
+    assert _find_node(out, AcceleratedColumnarToRowExec), \
+        "expected the spark310 accelerated transition in the plan"
+    got = collect(out, c)
+    assert list(got.columns) == list(expected.columns)
+    for name in expected.columns:
+        e, g = expected[name], got[name]
+        np.testing.assert_array_equal(e.isna().to_numpy(),
+                                      g.isna().to_numpy())
+        ev, gv = e[~e.isna()].tolist(), g[~g.isna()].tolist()
+        assert ev == gv, f"column {name}"
