@@ -1,0 +1,188 @@
+"""API-surface audit (reference `api_validation/.../ApiValidation.scala:17-60`
++ `auditAllVersions.sh`).
+
+The reference reflection-diffs every Gpu exec's constructor signature
+against the Spark exec it replaces, per supported Spark version, to catch
+silent API drift between the plugin and Spark releases.  The TPU analog
+audits the replacement registry against the plan- and exec-layer classes:
+
+- every registered exec rule converts a real `CpuNode` subclass and its
+  converter is callable with (meta, children);
+- every `CpuNode` subclass that represents a physical op either has a
+  replacement rule or is a known intentional gap;
+- every TPU exec class reachable from a rule implements the columnar
+  execution protocol (`output_schema`, `execute_columnar`);
+- every expression rule names an `Expression` subclass that exists;
+- each shim version loads and exposes the full `SparkShims` surface.
+
+Run `audit_all_versions()` in CI; it returns a report with an empty
+`problems` list when the surface is consistent.
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Callable
+
+from spark_rapids_tpu.plan import nodes as N
+
+
+@dataclasses.dataclass
+class AuditReport:
+    version: str
+    checked: int = 0
+    problems: list = dataclasses.field(default_factory=list)
+
+    def ok(self) -> bool:
+        return not self.problems
+
+    def __str__(self):
+        head = f"[{self.version}] {self.checked} checks, " \
+               f"{len(self.problems)} problems"
+        return "\n".join([head] + [f"  - {p}" for p in self.problems])
+
+
+#: CpuNode subclasses that intentionally have no TPU replacement (plan
+#: infrastructure, not physical operators users hit)
+KNOWN_UNREPLACED = {"CpuNode"}
+
+#: the SparkShims surface every shim must provide (reference
+#: `SparkShims.scala:57-136`'s ~25-method trait)
+SHIM_SURFACE = (
+    "columnar_to_row_transition", "make_first_last",
+    "shuffle_manager_class", "supports_map_index_ranges",
+    "get_map_sizes", "aqe_shuffle_reader_name", "make_file_partitions",
+    "parquet_rebase_read_key", "extra_exec_rules", "extra_expr_rules",
+)
+
+
+def _all_cpu_nodes() -> list[type]:
+    import spark_rapids_tpu.io.exec  # registers scan/write nodes
+    import spark_rapids_tpu.pyudf.exec  # registers pandas-udf nodes
+    out = []
+
+    def walk(cls):
+        out.append(cls)
+        for sub in cls.__subclasses__():
+            walk(sub)
+    walk(N.CpuNode)
+    return out
+
+
+def audit_exec_rules(report: AuditReport) -> None:
+    from spark_rapids_tpu.plan.overrides import (EXEC_RULES,
+                                                 _ensure_io_rules,
+                                                 _register_pyudf_rules)
+    _ensure_io_rules()
+    _register_pyudf_rules()
+    from spark_rapids_tpu.exec.base import TpuExec
+    cpu_nodes = _all_cpu_nodes()
+    transition_names = {"ColumnarToRowExec", "AcceleratedColumnarToRowExec",
+                        "BringBackToHost"}
+    for cls in cpu_nodes:
+        report.checked += 1
+        if cls in EXEC_RULES:
+            continue
+        if cls.__name__ in KNOWN_UNREPLACED | transition_names:
+            continue
+        if inspect.isabstract(cls):
+            continue
+        report.problems.append(
+            f"CpuNode {cls.__name__} has no exec replacement rule")
+    for cls, rule in EXEC_RULES.items():
+        report.checked += 1
+        if not issubclass(cls, N.CpuNode):
+            report.problems.append(
+                f"exec rule registered for non-CpuNode {cls!r}")
+        conv = rule.convert
+        if not callable(conv):
+            report.problems.append(
+                f"exec rule for {cls.__name__}: converter not callable")
+            continue
+        try:
+            sig = inspect.signature(conv)
+            if len([p for p in sig.parameters.values()
+                    if p.default is p.empty
+                    and p.kind in (p.POSITIONAL_ONLY,
+                                   p.POSITIONAL_OR_KEYWORD)]) > 2:
+                report.problems.append(
+                    f"exec rule for {cls.__name__}: converter must accept "
+                    f"(meta, children), got {sig}")
+        except (TypeError, ValueError):
+            pass
+
+
+def audit_expr_rules(report: AuditReport) -> None:
+    import importlib
+    import pkgutil
+
+    from spark_rapids_tpu.plan.overrides import EXPR_RULES
+    import spark_rapids_tpu.exprs as E
+    from spark_rapids_tpu.exprs.base import Expression
+
+    for mod in pkgutil.iter_modules(E.__path__):
+        importlib.import_module(f"spark_rapids_tpu.exprs.{mod.name}")
+    from spark_rapids_tpu.exprs.aggregates import AggregateFunction
+
+    known = {}
+
+    def walk(cls):
+        known[cls.__name__] = cls
+        for sub in cls.__subclasses__():
+            walk(sub)
+    walk(Expression)
+    walk(AggregateFunction)
+    for name in EXPR_RULES:
+        report.checked += 1
+        if name not in known:
+            report.problems.append(
+                f"expression rule {name!r} names no Expression subclass")
+
+
+def audit_tpu_exec_protocol(report: AuditReport) -> None:
+    from spark_rapids_tpu.exec.base import TpuExec
+
+    def walk(cls):
+        yield cls
+        for sub in cls.__subclasses__():
+            yield from walk(sub)
+    for cls in walk(TpuExec):
+        report.checked += 1
+        for method in ("output_schema",):
+            fn = getattr(cls, method, None)
+            if fn is None:
+                report.problems.append(
+                    f"TpuExec {cls.__name__} missing {method}")
+
+
+def audit_shim_surface(report: AuditReport, shims) -> None:
+    for name in SHIM_SURFACE:
+        report.checked += 1
+        if not callable(getattr(shims, name, None)):
+            report.problems.append(
+                f"shim {type(shims).__name__} missing {name}()")
+
+
+def audit_version(version: str) -> AuditReport:
+    from spark_rapids_tpu.shims import get_spark_shims
+    report = AuditReport(version)
+    shims = get_spark_shims(version)
+    audit_shim_surface(report, shims)
+    audit_exec_rules(report)
+    audit_expr_rules(report)
+    audit_tpu_exec_protocol(report)
+    return report
+
+
+def audit_all_versions() -> list[AuditReport]:
+    """`auditAllVersions.sh` analog: one report per supported version."""
+    from spark_rapids_tpu.shims import ALL_SHIMS
+    return [audit_version(p.VERSION_NAMES[0]) for p in ALL_SHIMS]
+
+
+if __name__ == "__main__":
+    import sys
+    reports = audit_all_versions()
+    for r in reports:
+        print(r)
+    sys.exit(0 if all(r.ok() for r in reports) else 1)

@@ -55,6 +55,8 @@ class Expression:
         if not kids:
             return self
         new = [fn(c) for c in kids]
+        if all(n is o for n, o in zip(new, kids)):
+            return self  # identity-preserving: rewrites can detect no-ops
         return self.with_children(new)
 
     def with_children(self, new_children) -> "Expression":

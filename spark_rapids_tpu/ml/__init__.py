@@ -1,0 +1,5 @@
+"""ML integration (reference `ColumnarRdd.scala` / `docs/ml-integration.md`):
+zero-copy hand-off of a query's columnar output to JAX ML code."""
+from spark_rapids_tpu.ml.columnar_rdd import ColumnarRdd
+
+__all__ = ["ColumnarRdd"]

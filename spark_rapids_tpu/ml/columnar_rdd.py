@@ -1,0 +1,78 @@
+"""ML integration: zero-copy columnar export (reference
+`ColumnarRdd.scala:41-46` + `InternalColumnarRddConverter.scala:470` +
+`GpuTransitionOverrides.detectAndTagFinalColumnarOutput`
+`GpuTransitionOverrides.scala:324-329`).
+
+The reference exposes the final columnar output of a query as an
+`RDD[ai.rapids.cudf.Table]` so XGBoost-on-GPU can consume HBM-resident
+data without a row round-trip.  The TPU analog hands the final
+`ColumnarBatch` stream — jax arrays already resident in HBM — straight to
+JAX ML code (flax/optax training loops), with no host materialization.
+
+Gated by `spark.rapids.sql.exportColumnarRdd` exactly like the reference.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.plan.nodes import CpuNode
+
+
+class ColumnarRdd:
+    """Driver-facing API (reference `ColumnarRdd.convert(df)`)."""
+
+    @staticmethod
+    def convert(plan, conf: Optional[C.RapidsConf] = None
+                ) -> list[Iterator[ColumnarBatch]]:
+        """Accelerate `plan` and return its partitions as iterators of
+        device-resident batches.  A fully-TPU plan exports zero-copy
+        (reference GpuColumnarBatch path); a plan with CPU islands is
+        converted partition-by-partition on the fly (reference
+        InternalColumnarRddConverter's row path)."""
+        conf = conf or C.get_active_conf()
+        if not conf[C.EXPORT_COLUMNAR_RDD]:
+            raise RuntimeError(
+                "columnar export requires "
+                f"{C.EXPORT_COLUMNAR_RDD.key}=true (reference "
+                "ColumnarRdd.scala:41-46)")
+        from spark_rapids_tpu.plan.overrides import accelerate
+        out = plan if isinstance(plan, (TpuExec,)) else accelerate(
+            plan, conf)
+        if isinstance(out, TpuExec):
+            return out.execute_partitions()
+        return _rows_to_batches(out)
+
+    @staticmethod
+    def collect_arrays(plan, conf: Optional[C.RapidsConf] = None
+                       ) -> dict[str, jnp.ndarray]:
+        """All partitions concatenated into one dict of column -> device
+        array, trimmed to the true row count — the hand-off shape a JAX
+        training loop wants (the XGBoost-DMatrix analog)."""
+        parts = ColumnarRdd.convert(plan, conf)
+        batches = [b for it in parts for b in it]
+        if not batches:
+            return {}
+        from spark_rapids_tpu.columnar.batch import concat_batches
+        merged = concat_batches(batches)
+        out = {}
+        for f, c in zip(merged.schema.fields, merged.columns):
+            if f.dtype.is_string:
+                continue  # string features are not trainable tensors
+            out[f.name] = c.data[:merged.num_rows]
+        return out
+
+
+def _rows_to_batches(cpu_plan: CpuNode) -> list[Iterator[ColumnarBatch]]:
+    from spark_rapids_tpu.plan.transitions import batch_from_df
+    schema = cpu_plan.output_schema()
+
+    def gen(it):
+        for df in it:
+            if len(df):
+                yield batch_from_df(df, schema)
+    return [gen(it) for it in cpu_plan.execute()]

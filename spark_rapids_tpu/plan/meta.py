@@ -111,12 +111,14 @@ class PlanMeta(BaseMeta):
         self._tag_types()
         if self.rule.tag_extra is not None:
             self.rule.tag_extra(self)
-        pinned = getattr(self.node, "_tpu_tag", None)
+        pinned = self.node.__dict__.pop("_tpu_tag", None)
         if pinned is not None and not pinned[0] \
                 and self.can_this_be_replaced:
             # AQE query-stage prep pinned this node off the TPU with
             # whole-plan context a stage-local re-tag cannot see
-            # (reference TreeNodeTag propagation RapidsMeta.scala:121-137)
+            # (reference TreeNodeTag propagation RapidsMeta.scala:121-137).
+            # Consumed exactly once: a pin from one planning session must
+            # not leak into a later accelerate() under a different conf.
             reasons = pinned[1] or {"pinned off TPU by query-stage prep"}
             for r in reasons:
                 self.will_not_work_on_tpu(r)

@@ -129,7 +129,7 @@ class ColumnarToRowExec(CpuNode):
         return self.tpu_child.output_partition_count()
 
     def describe(self):
-        return f"ColumnarToRowExec\n{self.tpu_child.tree_string(1)}"
+        return f"{self.name()}\n{self.tpu_child.tree_string(1)}"
 
     def execute(self):
         def convert(it):

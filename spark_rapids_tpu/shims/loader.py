@@ -24,21 +24,34 @@ _cache: dict[str, SparkShims] = {}
 
 
 def register_provider(shim_class: type) -> None:
-    """ServiceLoader analog: add an externally-defined shim provider."""
+    """ServiceLoader analog: add an externally-defined shim provider.
+    Prepended so an external provider can override a built-in version."""
     with _lock:
-        _PROVIDERS.append(shim_class)
-    _cache.clear()
+        _PROVIDERS.insert(0, shim_class)
+        _cache.clear()
+
+
+def _has_provider(version: str) -> bool:
+    with _lock:
+        return any(version in p.VERSION_NAMES for p in _PROVIDERS)
 
 
 def detect_version(conf: Optional[C.RapidsConf] = None) -> str:
     """The session's Spark version.  Databricks detection mirrors
     `ShimLoader.scala`: the cluster-tag conf marks a Databricks runtime
-    regardless of the reported base version."""
+    regardless of the reported base version — but only when a Databricks
+    shim for that base version exists, so an unexpected runtime degrades
+    to the upstream shim instead of failing every plan rewrite."""
     conf = conf or C.get_active_conf()
     version = str(conf[C.SPARK_VERSION])
     if conf.get("spark.databricks.clusterUsageTags.clusterId") \
             and "databricks" not in version:
-        version = f"{version}-databricks"
+        db = f"{version}-databricks"
+        if _has_provider(db):
+            return db
+        log.warning(
+            "Databricks runtime detected but no %s shim exists; "
+            "using the upstream %s shim", db, version)
     return version
 
 

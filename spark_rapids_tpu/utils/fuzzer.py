@@ -1,0 +1,86 @@
+"""Random schema/batch generation for fuzz tests (reference
+`tests/.../FuzzerUtils.scala`: random schemas + batches with nulls used by
+coalesce/partitioning suites, and `integration_tests/.../data_gen.py`'s
+composable per-type generators).
+"""
+from __future__ import annotations
+
+import string
+from typing import Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+
+#: types the fuzzer draws from — the v0 type matrix (SURVEY.md §2.6)
+FUZZ_TYPES = (T.BOOL, T.INT8, T.INT16, T.INT32, T.INT64, T.FLOAT32,
+              T.FLOAT64, T.DATE32, T.TIMESTAMP_US, T.STRING)
+
+
+def random_schema(rng: np.random.Generator, num_cols: Optional[int] = None,
+                  types: Sequence = FUZZ_TYPES) -> T.Schema:
+    n = num_cols or int(rng.integers(1, 7))
+    fields = tuple(
+        T.Field(f"c{i}", types[int(rng.integers(0, len(types)))])
+        for i in range(n))
+    return T.Schema(fields)
+
+
+def _random_values(rng: np.random.Generator, dtype: T.DataType, n: int
+                   ) -> np.ndarray:
+    if dtype == T.BOOL:
+        return rng.integers(0, 2, n).astype(bool)
+    if dtype in (T.INT8, T.INT16, T.INT32, T.INT64):
+        info = np.iinfo(dtype.storage_dtype)
+        # keep within int8 range so casts/concats across types stay exact
+        return rng.integers(max(info.min, -100), min(info.max, 100),
+                            n).astype(dtype.storage_dtype)
+    if dtype in (T.FLOAT32, T.FLOAT64):
+        vals = rng.normal(scale=100.0, size=n).astype(dtype.storage_dtype)
+        special = rng.random(n)
+        vals = np.where(special < 0.05, np.nan, vals)
+        vals = np.where((special >= 0.05) & (special < 0.08),
+                        np.inf, vals)
+        vals = np.where((special >= 0.08) & (special < 0.10),
+                        -np.inf, vals)
+        return vals.astype(dtype.storage_dtype)
+    if dtype == T.DATE32:
+        return rng.integers(-3650, 3650, n).astype(np.int32)
+    if dtype == T.TIMESTAMP_US:
+        return rng.integers(0, 4_000_000_000_000_000, n).astype(np.int64)
+    if dtype.is_string:
+        alphabet = string.ascii_letters + string.digits + " _-"
+        return np.array(
+            ["".join(rng.choice(list(alphabet),
+                                size=int(rng.integers(0, 12))))
+             for _ in range(n)], dtype=object)
+    raise ValueError(f"fuzzer cannot generate {dtype}")
+
+
+def random_batch(rng: np.random.Generator, schema: Optional[T.Schema] = None,
+                 num_rows: Optional[int] = None,
+                 null_fraction: float = 0.15) -> ColumnarBatch:
+    schema = schema or random_schema(rng)
+    n = int(rng.integers(0, 200)) if num_rows is None else num_rows
+    data, validity = {}, {}
+    for f in schema.fields:
+        data[f.name] = _random_values(rng, f.dtype, n)
+        valid = rng.random(n) >= null_fraction
+        if f.dtype.is_string:
+            vals = data[f.name]
+            vals[~valid] = None
+            data[f.name] = vals
+        validity[f.name] = valid
+    return ColumnarBatch.from_numpy(data, schema, validity)
+
+
+def random_batches(rng: np.random.Generator, schema: T.Schema,
+                   count: int, **kw) -> list[ColumnarBatch]:
+    return [random_batch(rng, schema, **kw) for _ in range(count)]
+
+
+def batch_to_reference_df(batch: ColumnarBatch) -> pd.DataFrame:
+    """Null-aware host view for result diffing."""
+    return batch.to_pandas()

@@ -213,6 +213,11 @@ class TestAdaptiveExecution:
         out = accelerate(plan, C.RapidsConf())
         assert isinstance(out, N.CpuNode)
         ExecutionPlanCapture.assert_did_fall_back("CpuFilter")
+        # the pin is consumed exactly once: a later accelerate() under a
+        # fresh conf must not inherit the stale verdict
+        from spark_rapids_tpu.exec.base import TpuExec
+        out2 = accelerate(plan, C.RapidsConf())
+        assert isinstance(out2, TpuExec)
 
     def test_broadcast_join_probe_side_rebinding(self):
         """A BroadcastHashJoinExec whose PROBE child is an exchange must

@@ -48,6 +48,15 @@ def test_default_version_is_301():
     assert isinstance(S.current_shims(conf()), S.Spark301Shims)
 
 
+def test_databricks_without_db_shim_degrades_to_upstream():
+    """A Databricks cluster tag on a base version with no -databricks
+    provider must not break plan rewrites."""
+    c = conf(**{"spark.databricks.clusterUsageTags.clusterId": "0001-x",
+                "spark.rapids.tpu.sparkVersion": "3.0.1"})
+    assert S.detect_version(c) == "3.0.1"
+    assert isinstance(S.current_shims(c), S.Spark301Shims)
+
+
 def test_shim_version_parse_and_order():
     v = S.ShimVersion.parse("3.1.1-SNAPSHOT")
     assert (v.major, v.minor, v.patch) == (3, 1, 1)
