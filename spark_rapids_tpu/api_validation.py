@@ -140,19 +140,28 @@ def audit_expr_rules(report: AuditReport) -> None:
 
 
 def audit_tpu_exec_protocol(report: AuditReport) -> None:
+    """Every instantiable (leaf) TpuExec must override the raising base
+    stubs of the columnar protocol — getattr alone always finds the
+    stubs, so the check compares against them explicitly."""
     from spark_rapids_tpu.exec.base import TpuExec
 
     def walk(cls):
         yield cls
         for sub in cls.__subclasses__():
             yield from walk(sub)
+    #: placeholders that carry schema only and never execute
+    exempt = {"SchemaOnlyExec"}
     for cls in walk(TpuExec):
+        if cls.__subclasses__() or cls is TpuExec \
+                or cls.__name__ in exempt:
+            continue  # abstract-ish intermediates are not audited
         report.checked += 1
-        for method in ("output_schema",):
+        for method in ("output_schema", "execute_columnar"):
+            base_stub = getattr(TpuExec, method, None)
             fn = getattr(cls, method, None)
-            if fn is None:
+            if fn is None or fn is base_stub:
                 report.problems.append(
-                    f"TpuExec {cls.__name__} missing {method}")
+                    f"TpuExec {cls.__name__} does not implement {method}")
 
 
 def audit_shim_surface(report: AuditReport, shims) -> None:

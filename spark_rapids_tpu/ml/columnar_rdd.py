@@ -59,11 +59,21 @@ class ColumnarRdd:
             return {}
         from spark_rapids_tpu.columnar.batch import concat_batches
         merged = concat_batches(batches)
+        n = merged.num_rows
         out = {}
         for f, c in zip(merged.schema.fields, merged.columns):
             if f.dtype.is_string:
                 continue  # string features are not trainable tensors
-            out[f.name] = c.data[:merged.num_rows]
+            data, valid = c.data[:n], c.validity[:n]
+            if f.dtype.id.name.startswith("FLOAT"):
+                # nulls surface as NaN, never as a fabricated fill value
+                data = jnp.where(valid, data, jnp.nan)
+            elif not bool(valid.all()):
+                raise ValueError(
+                    f"column {f.name} ({f.dtype}) contains nulls; "
+                    "integer/date tensors cannot represent them — filter "
+                    "or coalesce nulls in the query first")
+            out[f.name] = data
         return out
 
 

@@ -266,7 +266,7 @@ def _coalesce(e, df, schema):
 # -- cast -------------------------------------------------------------------
 def _cast(e, df, schema):
     v = _ev(e.child, df, schema)
-    dt = e.dtype
+    dt = e.to
     if dt.is_string:
         res = v.astype(object).map(
             lambda x: None if x is None or x is pd.NA else
@@ -303,9 +303,12 @@ def _strmap(fn):
 
 
 def _substring(e, df, schema):
-    v = _ev(e.str_expr, df, schema)
+    v = _ev(e.child, df, schema)
     pos = _ev(e.pos, df, schema)
-    ln = _ev(e.length, df, schema)
+    if e.length is None:
+        ln = pd.Series([2 ** 31 - 1] * len(df), index=df.index)
+    else:
+        ln = _ev(e.length, df, schema)
 
     def sub(x, p, l):
         if x is None or x is pd.NA or p is pd.NA or l is pd.NA:
@@ -318,8 +321,13 @@ def _substring(e, df, schema):
         elif p == 0:
             start = 0
         else:
-            start = max(0, len(x) + p)
-        return x[start:start + l]
+            # Spark: the window starts at len+p even when that is before
+            # the string, shrinking the result (substring('abc',-5,3)='a')
+            start = len(x) + p
+        end = start + l
+        if end <= 0:
+            return ""
+        return x[max(0, start):end]
     return pd.Series([sub(x, p, l) for x, p, l in zip(v, pos, ln)],
                      index=v.index, dtype=object)
 
@@ -333,6 +341,64 @@ def _concat(e, df, schema):
         return "".join(vals)
     return pd.Series([cat(vals) for vals in zip(*parts)],
                      index=parts[0].index, dtype=object)
+
+
+def _literal_pattern(e):
+    """Pattern exprs must be literals on BOTH engines (reference
+    restriction GpuOverrides.scala:343-393); a non-literal must raise, not
+    silently evaluate as a null pattern."""
+    from spark_rapids_tpu.exprs.base import Literal
+    if not isinstance(e.pattern, Literal):
+        raise TypeError(
+            f"{type(e).__name__} requires a literal pattern")
+    return e.pattern.value
+
+
+def _str_pred(test):
+    """Boolean string predicate with Spark null semantics (null input or
+    null pattern -> null)."""
+    def f(e, df, schema):
+        v = _ev(e.child, df, schema)
+        pat = _literal_pattern(e)
+        if pat is None:
+            return pd.Series([pd.NA] * len(df), index=df.index,
+                             dtype="boolean")
+        pat = str(pat)
+        out = v.map(lambda x: None if x is None or x is pd.NA
+                    else test(x, pat))
+        return out.astype("boolean")
+    return f
+
+
+def _like_to_regex(pat: str) -> str:
+    import re
+    out, i = [], 0
+    while i < len(pat):
+        ch = pat[i]
+        if ch == "\\" and i + 1 < len(pat):
+            out.append(re.escape(pat[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+def _like(e, df, schema):
+    import re
+    v = _ev(e.child, df, schema)
+    pat = _literal_pattern(e)
+    if pat is None:
+        return pd.Series([pd.NA] * len(df), index=df.index,
+                         dtype="boolean")
+    rx = re.compile(_like_to_regex(str(pat)), re.DOTALL)
+    return v.map(lambda x: None if x is None or x is pd.NA
+                 else rx.match(x) is not None).astype("boolean")
 
 
 # -- datetime (storage: int32 days / int64 micros) --------------------------
@@ -385,6 +451,10 @@ _DISPATCH = {
             "Int32"),
     "Substring": _substring,
     "ConcatStrings": _concat,
+    "Like": _like,
+    "Contains": _str_pred(lambda x, p: p in x),
+    "StartsWith": _str_pred(lambda x, p: x.startswith(p)),
+    "EndsWith": _str_pred(lambda x, p: x.endswith(p)),
     "Year": _datefield("year"),
     "Month": _datefield("month"),
     "DayOfMonth": _datefield("day"),

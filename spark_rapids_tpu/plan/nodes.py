@@ -483,15 +483,22 @@ class CpuHashJoin(CpuNode):
         keys = [f"__k{i}" for i in range(len(self.left_keys))]
         jt = self.join_type
         if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
-            matched = laug[lvalid].merge(raug[rvalid][keys].drop_duplicates(),
-                                         on=keys, how="inner")["__lrow"]
+            if self.condition is None:
+                matched = laug[lvalid].merge(
+                    raug[rvalid][keys].drop_duplicates(),
+                    on=keys, how="inner")["__lrow"]
+            else:
+                # EXISTS semantics: a left row matches if ANY key-equal
+                # right row also passes the residual condition
+                inner = laug[lvalid].merge(raug[rvalid], on=keys,
+                                           how="inner")
+                inner = inner[self._condition_mask(inner, ldf, rdf)]
+                matched = inner["__lrow"]
             mask = np.zeros(len(ldf), bool)
             mask[matched.to_numpy()] = True
             if jt == JoinType.LEFT_ANTI:
                 mask = ~mask
-                out = ldf[mask]
-            else:
-                out = ldf[mask]
+            out = ldf[mask]
             return [iter([out.reset_index(drop=True)])]
         if self.condition is not None and jt in (
                 JoinType.LEFT_OUTER, JoinType.RIGHT_OUTER,
@@ -544,7 +551,12 @@ class CpuHashJoin(CpuNode):
             merged[[f"__r_{c}" for c in rdf.columns]]
             .rename(columns=lambda c: c[4:]).reset_index(drop=True)],
             axis=1)
-        m = cpu_eval(self.condition, comb, self._schema)
+        # conditions see both sides even when the join's OUTPUT schema is
+        # left-only (semi/anti)
+        ls = self.children[0].output_schema()
+        rs = self.children[1].output_schema()
+        both = T.Schema(tuple(ls.fields) + tuple(rs.fields))
+        m = cpu_eval(self.condition, comb, both)
         return m.astype("boolean").fillna(False).astype(bool).to_numpy()
 
 

@@ -1,0 +1,66 @@
+"""TPC-H correctness suite (reference `TpchSparkSuite` golden rule: run
+each query on the CPU engine and the accelerated engine, diff results)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.models.tpch_bench import run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+from spark_rapids_tpu.models.tpch_queries import QUERIES
+
+SCALE = 3000
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return gen_tables(np.random.default_rng(11), SCALE)
+
+
+def _norm(df: pd.DataFrame) -> pd.DataFrame:
+    """Row-set normalization: sort by every column so tie-order inside
+    equal sort keys cannot fail the diff."""
+    out = df.copy()
+    for c in out.columns:
+        if out[c].dtype == object:
+            out[c] = out[c].astype(str)
+    out = out.sort_values(list(out.columns), ignore_index=True)
+    return out
+
+
+def _compare(expected: pd.DataFrame, got: pd.DataFrame, query: int):
+    assert list(expected.columns) == list(got.columns), \
+        f"q{query} columns {list(got.columns)}"
+    assert len(expected) == len(got), \
+        f"q{query} rows: cpu={len(expected)} tpu={len(got)}"
+    e, g = _norm(expected), _norm(got)
+    for name in e.columns:
+        ena = e[name].isna().to_numpy()
+        gna = g[name].isna().to_numpy()
+        np.testing.assert_array_equal(ena, gna,
+                                      err_msg=f"q{query} nulls {name}")
+        ev, gv = e[name][~ena], g[name][~gna]
+        try:
+            evf = np.asarray(ev, dtype=float)
+            gvf = np.asarray(gv, dtype=float)
+            np.testing.assert_allclose(evf, gvf, rtol=1e-5, atol=1e-6,
+                                       err_msg=f"q{query} col {name}")
+        except (ValueError, TypeError):
+            assert list(ev) == list(gv), f"q{query} col {name}"
+
+
+@pytest.mark.parametrize("query", sorted(QUERIES))
+def test_tpch_parity(tables, query):
+    expected = run_query(query, tables, engine="cpu")
+    assert len(expected) > 0, f"q{query} CPU result empty — data bug"
+    got = run_query(query, tables, engine="tpu")
+    _compare(expected, got, query)
+
+
+def test_q1_known_shape(tables):
+    out = run_query(1, tables, engine="tpu")
+    # 3 returnflags x 2 linestatuses
+    assert len(out) <= 6 and len(out) >= 4
+    assert list(out.columns)[:2] == ["l_returnflag", "l_linestatus"]
+    # sums positive
+    assert (out["sum_qty"].astype(float) > 0).all()
