@@ -78,6 +78,10 @@ EXPORT_COLUMNAR_RDD = conf("spark.rapids.sql.exportColumnarRdd", False,
 SPARK_VERSION = conf("spark.rapids.tpu.sparkVersion", "3.0.1",
                      "Spark version the session emulates; selects the "
                      "shim set (reference ShimLoader.scala:26-61).")
+PRUNE_COLUMNS = conf("spark.rapids.tpu.columnPruning.enabled", True,
+                     "Prune unreferenced columns at scan/source leaves "
+                     "before plan rewrite (the role Catalyst's "
+                     "ColumnPruning plays for the reference).")
 
 # --- batch sizing / memory (reference :271-360) -----------------------------
 BATCH_SIZE_BYTES = conf("spark.rapids.sql.batchSizeBytes", 2147483136,

@@ -114,6 +114,14 @@ class HashAggregateExec(UnaryExecBase):
         return (f"HashAggregateExec(mode={self.mode.value}, "
                 f"keys=[{keys}], aggs=[{aggs}])")
 
+    def cache_scope(self):
+        from spark_rapids_tpu.exprs.base import fingerprint
+        return (self.mode.name, fingerprint(self._bound_groups),
+                fingerprint(self._funcs),
+                fingerprint(getattr(self, "_bound_inputs", None)),
+                fingerprint(self._inter_types),
+                fingerprint(self._child_schema))
+
     # -- kernels ------------------------------------------------------------
     def _groupby_kernel(self, batch: ColumnarBatch, phase: str):
         """phase: 'update' (raw inputs) or 'merge' (intermediates)."""

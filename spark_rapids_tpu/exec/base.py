@@ -62,21 +62,67 @@ def batch_signature(batch: ColumnarBatch) -> tuple:
     return tuple(sig)
 
 
-class KernelCache:
-    """Caches jitted executables per (node-key, signature)."""
+#: process-global executable store (bounded LRU): compiled kernels outlive
+#: plan instances, so per-query plan rebuilds and AQE re-plans over the
+#: same expressions hit warm executables instead of re-tracing
+import collections
+import threading
 
-    def __init__(self):
-        self._cache: dict = {}
+_GLOBAL_KERNELS: "collections.OrderedDict" = collections.OrderedDict()
+_GLOBAL_KERNELS_LOCK = threading.Lock()
+# one workload's operator x batch-shape set is well under this; XLA CPU
+# clients have been observed to segfault with thousands of live loaded
+# executables, so the LRU stays conservatively small
+_GLOBAL_KERNELS_MAX = 512
+
+
+def clear_kernel_cache() -> None:
+    with _GLOBAL_KERNELS_LOCK:
+        _GLOBAL_KERNELS.clear()
+
+
+def kernel_cache_size() -> int:
+    return len(_GLOBAL_KERNELS)
+
+
+class KernelCache:
+    """Caches jitted executables per (scope, key, signature).
+
+    With a `scope` (a structural fingerprint of the exec's bound
+    expressions), entries live in the process-global LRU and are shared
+    across plan instances.  Without one, the cache is private to the exec
+    and dies with the plan (the pre-fingerprint behavior, still used by
+    execs whose kernels close over non-fingerprintable state)."""
+
+    def __init__(self, scope: tuple = None):
+        self._scope = scope
+        self._cache: dict = {} if scope is None else None
 
     def get_or_build(self, key: tuple, builder: Callable[[], Callable]):
-        fn = self._cache.get(key)
-        if fn is None:
-            fn = builder()
-            self._cache[key] = fn
+        if self._scope is None:
+            fn = self._cache.get(key)
+            if fn is None:
+                fn = builder()
+                self._cache[key] = fn
+            return fn
+        gk = (self._scope, key)
+        with _GLOBAL_KERNELS_LOCK:
+            fn = _GLOBAL_KERNELS.get(gk)
+            if fn is not None:
+                _GLOBAL_KERNELS.move_to_end(gk)
+                return fn
+        fn = builder()  # trace/compile outside the lock
+        with _GLOBAL_KERNELS_LOCK:
+            _GLOBAL_KERNELS[gk] = fn
+            while len(_GLOBAL_KERNELS) > _GLOBAL_KERNELS_MAX:
+                _GLOBAL_KERNELS.popitem(last=False)
         return fn
 
     def __len__(self):
-        return len(self._cache)
+        if self._scope is None:
+            return len(self._cache)
+        with _GLOBAL_KERNELS_LOCK:
+            return sum(1 for s, _ in _GLOBAL_KERNELS if s == self._scope)
 
 
 
@@ -99,9 +145,26 @@ class TpuExec:
         self._children = list(children)
         self.metrics = M.MetricSet()
         self.exec_id = next(_EXEC_IDS)
-        # per-instance cache: executables are freed with the plan instead of
-        # accumulating in a process-global map
-        self.kernels = KernelCache()
+
+    @property
+    def kernels(self) -> KernelCache:
+        """Compile cache, resolved lazily so `cache_scope()` can use
+        subclass state set after base __init__.  Scoped execs share the
+        bounded global store; unscoped ones keep a private cache."""
+        kc = self.__dict__.get("_kernel_cache")
+        if kc is None:
+            scope = self.cache_scope()
+            if scope is not None:
+                scope = (type(self).__name__,) + tuple(scope)
+            kc = KernelCache(scope)
+            self.__dict__["_kernel_cache"] = kc
+        return kc
+
+    def cache_scope(self):
+        """Structural fingerprint of everything this exec's kernels close
+        over (bound expressions, modes, output schema).  None -> private
+        cache (no cross-instance sharing)."""
+        return None
 
     @property
     def children(self) -> list["TpuExec"]:

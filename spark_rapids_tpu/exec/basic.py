@@ -43,6 +43,10 @@ class ProjectExec(UnaryExecBase):
     def output_schema(self) -> T.Schema:
         return self._schema
 
+    def cache_scope(self):
+        from spark_rapids_tpu.exprs.base import fingerprint
+        return (fingerprint(self._bound),)
+
     def describe(self):
         return f"ProjectExec({', '.join(map(repr, self.exprs))})"
 
@@ -89,6 +93,10 @@ class FilterExec(UnaryExecBase):
 
     def output_schema(self) -> T.Schema:
         return self._schema
+
+    def cache_scope(self):
+        from spark_rapids_tpu.exprs.base import fingerprint
+        return (fingerprint(self._bound),)
 
     def describe(self):
         return f"FilterExec({self.condition!r})"

@@ -54,6 +54,10 @@ class ExpandExec(UnaryExecBase):
     def describe(self):
         return f"ExpandExec({len(self.projections)} projections)"
 
+    def cache_scope(self):
+        from spark_rapids_tpu.exprs.base import fingerprint
+        return (fingerprint(self._bound), fingerprint(self._schema))
+
     def _kernel(self, batch: ColumnarBatch):
         key = ("expand", batch_signature(batch))
 

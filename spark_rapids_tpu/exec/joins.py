@@ -38,7 +38,7 @@ from spark_rapids_tpu.exec.base import (
     make_eval_context)
 from spark_rapids_tpu.exprs.base import Expression
 from spark_rapids_tpu.ops.sort_encode import (
-    encode_key_column, segment_boundaries)
+    encode_key_bits, packed_lexsort, segment_boundaries)
 from spark_rapids_tpu.utils import metrics as M
 
 
@@ -92,7 +92,12 @@ class HashJoinExec(TpuExec):
         else:
             self._schema = T.Schema(tuple(lschema.fields) +
                                     tuple(rschema.fields))
-        self._join_cache = KernelCache()
+        from spark_rapids_tpu.exprs.base import fingerprint
+        self._join_cache = KernelCache((
+            "HashJoinExec", join_type.name, self._flip,
+            fingerprint(self._probe_keys), fingerprint(self._build_keys),
+            fingerprint(condition), fingerprint(lschema),
+            fingerprint(rschema)))
 
     def output_schema(self) -> T.Schema:
         return self._schema
@@ -141,11 +146,11 @@ class HashJoinExec(TpuExec):
                 side = jnp.concatenate([jnp.zeros(bcap, jnp.uint8),
                                         jnp.ones(pcap, jnp.uint8)])
                 row_mask = jnp.concatenate([bctx.row_mask, pctx.row_mask])
-                keys_msf = [(~row_mask).astype(jnp.uint8)]
+                keys_msf = [((~row_mask).astype(jnp.uint8), 1)]
                 for c in comb:
-                    keys_msf.extend(encode_key_column(c, True, True))
-                keys_msf.append(side)
-                perm = jnp.lexsort(tuple(reversed(keys_msf)))
+                    keys_msf.extend(encode_key_bits(c, True, True))
+                keys_msf.append((side, 1))
+                perm = packed_lexsort(keys_msf)
                 bounds = segment_boundaries(comb, perm, row_mask)
                 gid = jnp.cumsum(bounds.astype(jnp.int32)) - 1
                 sorted_side = jnp.take(side, perm)
@@ -396,7 +401,10 @@ class NestedLoopJoinExec(TpuExec):
         self.condition = condition
         self._schema = T.Schema(tuple(left.output_schema().fields) +
                                 tuple(right.output_schema().fields))
-        self._cache = KernelCache()
+        from spark_rapids_tpu.exprs.base import fingerprint
+        self._cache = KernelCache((
+            "NestedLoopJoinExec", join_type.name, fingerprint(condition),
+            fingerprint(self._schema)))
 
     def output_schema(self):
         return self._schema

@@ -67,6 +67,12 @@ class SortExec(UnaryExecBase):
             for o in self.order)
         return f"SortExec({dirs}, global={self.global_sort})"
 
+    def cache_scope(self):
+        from spark_rapids_tpu.exprs.base import fingerprint
+        return (fingerprint(self._bound),
+                tuple((o.ascending, o.resolved_nulls_first)
+                      for o in self.order))
+
     def _kernel(self, batch: ColumnarBatch):
         key = ("sort", batch_signature(batch))
 

@@ -182,6 +182,12 @@ class WindowExec(UnaryExecBase):
         return (f"WindowExec([{', '.join(f.kind for f in self.fns)}], "
                 f"partitionBy={len(self.spec.partition_by)})")
 
+    def cache_scope(self):
+        from spark_rapids_tpu.exprs.base import fingerprint
+        return (fingerprint(self.spec), fingerprint(self._bound_parts),
+                fingerprint(self._bound_order),
+                fingerprint(self._bound_inputs), fingerprint(self.fns))
+
     # ------------------------------------------------------------------
     def _kernel(self, batch: ColumnarBatch):
         key = ("window", batch_signature(batch))

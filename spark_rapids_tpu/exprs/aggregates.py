@@ -249,14 +249,16 @@ class _MinMax(AggregateFunction):
         """Strings: argmin/argmax by byte-lexicographic rank.  Rank rows
         with a per-segment sorted pass: reuse encode keys to lexsort and
         take the first row per segment."""
-        from spark_rapids_tpu.ops.sort_encode import encode_key_column
+        from spark_rapids_tpu.ops.sort_encode import (encode_key_bits,
+                                                      packed_lexsort)
         cap = ctx.capacity
         ok = v.validity & ctx.row_valid
         # lexsort by (segment, value) -> first row of each segment wins
-        keys = encode_key_column(v, ascending=self._is_min,
-                                 nulls_first=False)
+        keys = encode_key_bits(v, ascending=self._is_min,
+                               nulls_first=False)
         seg_key = _drop_invalid(ctx.seg_ids, ok, cap)
-        order = jnp.lexsort(tuple(reversed([seg_key] + keys)))
+        # segment ids are < 2*cap, well inside 32 bits -> packable
+        order = packed_lexsort([(seg_key.astype(jnp.uint64), 32)] + keys)
         seg_sorted = jnp.take(seg_key, order)
         isfirst = jnp.concatenate(
             [jnp.ones(1, bool), seg_sorted[1:] != seg_sorted[:-1]])

@@ -62,6 +62,14 @@ class Expression:
     def with_children(self, new_children) -> "Expression":
         raise NotImplementedError(type(self))
 
+    def fingerprint(self) -> tuple:
+        """Structural identity of the (bound) tree — the compile-cache
+        scope: two expressions with equal fingerprints trace to the same
+        XLA computation, so rebuilt plans (AQE re-plans, per-query plan
+        trees over the same schema) reuse executables instead of
+        recompiling."""
+        return fingerprint(self)
+
     # sugar -----------------------------------------------------------------
     def __add__(self, o): return _binop("Add", self, _lit(o))
     def __sub__(self, o): return _binop("Subtract", self, _lit(o))
@@ -299,3 +307,37 @@ def promote(v: ColumnVector, dt: T.DataType) -> ColumnVector:
     if v.dtype == dt:
         return v
     return ColumnVector(dt, v.data.astype(dt.storage_dtype), v.validity)
+
+
+# ---------------------------------------------------------------------------
+def fingerprint(obj) -> tuple:
+    """Structural fingerprint of expression trees / dataclass specs, used
+    to scope the global kernel compile cache (exec/base.py KernelCache):
+    two plan nodes whose bound expressions fingerprint equal produce the
+    same traced computation for a given batch signature."""
+    import dataclasses as _dc
+    if obj is None:
+        return ("~",)
+    if isinstance(obj, (list, tuple)):
+        return ("seq",) + tuple(fingerprint(x) for x in obj)
+    if isinstance(obj, Expression) or _dc.is_dataclass(obj):
+        out = [type(obj).__name__]
+        if _dc.is_dataclass(obj):
+            for f in _dc.fields(obj):
+                out.append(fingerprint(getattr(obj, f.name)))
+        else:  # non-dataclass Expression: fall back to child recursion
+            out.append(tuple(fingerprint(c) for c in obj.children()))
+        return tuple(out)
+    if isinstance(obj, T.DataType):
+        return ("dt", str(obj))
+    if isinstance(obj, T.Schema):
+        return ("schema",) + tuple(
+            (f.name, str(f.dtype)) for f in obj.fields)
+    if isinstance(obj, (str, int, float, bool, bytes)):
+        return ("v", type(obj).__name__, obj)
+    import enum as _enum
+    if isinstance(obj, _enum.Enum):
+        return ("enum", type(obj).__name__, obj.name)
+    # arbitrary values (numpy scalars, arrays in literals): repr is stable
+    # within a process, which is the cache's lifetime
+    return ("r", repr(obj))

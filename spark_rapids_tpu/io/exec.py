@@ -79,6 +79,21 @@ class ScanDescription:
         self.output_schema = T.Schema(
             tuple(self.data_schema.fields) + tuple(self.part_schema.fields))
 
+    def pruned(self, names: set) -> "ScanDescription":
+        """Column-pruned copy (Catalyst schema-pruning analog): the reader
+        only decodes the requested columns' chunks/stripes."""
+        import copy
+        sd = copy.copy(self)
+        sd.data_schema = T.Schema(tuple(
+            f for f in self.data_schema.fields if f.name in names))
+        sd.part_schema = T.Schema(tuple(
+            f for f in self.part_schema.fields if f.name in names))
+        sd.reader = make_format(self.file_format, sd.data_schema,
+                                self.options)
+        sd.output_schema = T.Schema(
+            tuple(sd.data_schema.fields) + tuple(sd.part_schema.fields))
+        return sd
+
 
 class CpuFileScan(CpuNode):
     """Planner-facing scan node; also the CPU fallback execution."""

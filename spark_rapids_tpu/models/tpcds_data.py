@@ -1,0 +1,192 @@
+"""TPC-DS table subset + synthetic data (reference
+`integration_tests/.../tpcds/TpcdsLikeSpark.scala` table readers — the
+full 24-table catalog; we carry the 8 tables the classic star-join query
+set touches, generated in-memory).
+
+Dates use the TPC-DS surrogate-key convention (d_date_sk joins, d_year /
+d_moy predicates) — no calendar math needed in the queries themselves.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu import types as T
+
+SCHEMAS = {
+    "date_dim": T.Schema.of(
+        ("d_date_sk", T.INT64), ("d_year", T.INT32),
+        ("d_moy", T.INT32), ("d_dom", T.INT32),
+        ("d_day_name", T.STRING), ("d_qoy", T.INT32)),
+    "item": T.Schema.of(
+        ("i_item_sk", T.INT64), ("i_item_id", T.STRING),
+        ("i_brand_id", T.INT32), ("i_brand", T.STRING),
+        ("i_category_id", T.INT32), ("i_category", T.STRING),
+        ("i_manufact_id", T.INT32), ("i_manager_id", T.INT32),
+        ("i_current_price", T.FLOAT64)),
+    "store": T.Schema.of(
+        ("s_store_sk", T.INT64), ("s_store_id", T.STRING),
+        ("s_store_name", T.STRING), ("s_number_employees", T.INT32),
+        ("s_city", T.STRING), ("s_state", T.STRING)),
+    "customer": T.Schema.of(
+        ("c_customer_sk", T.INT64), ("c_customer_id", T.STRING),
+        ("c_first_name", T.STRING), ("c_last_name", T.STRING),
+        ("c_current_addr_sk", T.INT64)),
+    "customer_address": T.Schema.of(
+        ("ca_address_sk", T.INT64), ("ca_city", T.STRING),
+        ("ca_state", T.STRING), ("ca_country", T.STRING)),
+    "household_demographics": T.Schema.of(
+        ("hd_demo_sk", T.INT64), ("hd_dep_count", T.INT32),
+        ("hd_vehicle_count", T.INT32), ("hd_buy_potential", T.STRING)),
+    "promotion": T.Schema.of(
+        ("p_promo_sk", T.INT64), ("p_channel_email", T.STRING),
+        ("p_channel_event", T.STRING)),
+    "store_sales": T.Schema.of(
+        ("ss_sold_date_sk", T.INT64), ("ss_item_sk", T.INT64),
+        ("ss_customer_sk", T.INT64), ("ss_cdemo_sk", T.INT64),
+        ("ss_hdemo_sk", T.INT64), ("ss_addr_sk", T.INT64),
+        ("ss_store_sk", T.INT64), ("ss_promo_sk", T.INT64),
+        ("ss_ticket_number", T.INT64), ("ss_quantity", T.INT32),
+        ("ss_list_price", T.FLOAT64), ("ss_sales_price", T.FLOAT64),
+        ("ss_ext_sales_price", T.FLOAT64),
+        ("ss_ext_discount_amt", T.FLOAT64),
+        ("ss_ext_list_price", T.FLOAT64),
+        ("ss_coupon_amt", T.FLOAT64), ("ss_net_profit", T.FLOAT64),
+        ("ss_ext_wholesale_cost", T.FLOAT64)),
+}
+
+CATEGORIES = ["Books", "Electronics", "Home", "Jewelry", "Music",
+              "Shoes", "Sports", "Women"]
+STATES = ["CA", "GA", "IL", "NY", "TX", "WA"]
+CITIES = ["Midway", "Fairview", "Oak Grove", "Five Points", "Centerville"]
+DAY_NAMES = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+             "Friday", "Saturday"]
+BUY_POTENTIAL = [">10000", "5001-10000", "1001-5000", "501-1000",
+                 "0-500", "Unknown"]
+
+
+def _money(rng, lo, hi, n):
+    return np.round(rng.uniform(lo, hi, n), 2)
+
+
+def gen_tables(rng: np.random.Generator, scale: int = 10_000
+               ) -> dict[str, pd.DataFrame]:
+    """`scale` ~ store_sales rows; dimensions scale down dbgen-style."""
+    n_dates = 365 * 5  # 1998-2002
+    n_items = max(scale // 20, 50)
+    n_stores = max(scale // 2000, 4)
+    n_cust = max(scale // 10, 100)
+    n_addr = n_cust
+    n_hd = 60
+    n_promo = max(scale // 500, 10)
+
+    sk = np.arange(n_dates, dtype=np.int64)
+    date_dim = pd.DataFrame({
+        "d_date_sk": sk,
+        "d_year": (1998 + sk // 365).astype(np.int32),
+        "d_moy": ((sk % 365) // 31 + 1).clip(1, 12).astype(np.int32),
+        "d_dom": ((sk % 31) + 1).astype(np.int32),
+        "d_day_name": np.array(DAY_NAMES, dtype=object)[sk % 7],
+        "d_qoy": (((sk % 365) // 92) + 1).clip(1, 4).astype(np.int32),
+    })
+    item = pd.DataFrame({
+        "i_item_sk": np.arange(n_items, dtype=np.int64),
+        "i_item_id": np.array(
+            [f"AAAAAAAA{i:08d}" for i in range(n_items)], dtype=object),
+        "i_brand_id": rng.integers(1, 10, n_items).astype(np.int32),
+        "i_brand": np.array(
+            [f"brand#{rng.integers(1, 10)}" for _ in range(n_items)],
+            dtype=object),
+        "i_category_id": rng.integers(0, len(CATEGORIES),
+                                      n_items).astype(np.int32),
+        "i_category": np.array(CATEGORIES, dtype=object)[
+            rng.integers(0, len(CATEGORIES), n_items)],
+        "i_manufact_id": rng.integers(1, 100, n_items).astype(np.int32),
+        "i_manager_id": rng.integers(1, 40, n_items).astype(np.int32),
+        "i_current_price": _money(rng, 1.0, 100.0, n_items),
+    })
+    store = pd.DataFrame({
+        "s_store_sk": np.arange(n_stores, dtype=np.int64),
+        "s_store_id": np.array(
+            [f"AAAAAAAA{i:08d}" for i in range(n_stores)], dtype=object),
+        "s_store_name": np.array(
+            ["ese", "ought", "able", "pri", "bar", "anti"][:n_stores]
+            * (n_stores // 6 + 1), dtype=object)[:n_stores],
+        "s_number_employees": rng.integers(200, 301,
+                                           n_stores).astype(np.int32),
+        "s_city": np.array(CITIES, dtype=object)[
+            rng.integers(0, len(CITIES), n_stores)],
+        "s_state": np.array(STATES, dtype=object)[
+            rng.integers(0, len(STATES), n_stores)],
+    })
+    customer = pd.DataFrame({
+        "c_customer_sk": np.arange(n_cust, dtype=np.int64),
+        "c_customer_id": np.array(
+            [f"AAAAAAAA{i:08d}" for i in range(n_cust)], dtype=object),
+        "c_first_name": np.array(
+            [f"First{i % 97}" for i in range(n_cust)], dtype=object),
+        "c_last_name": np.array(
+            [f"Last{i % 89}" for i in range(n_cust)], dtype=object),
+        "c_current_addr_sk": rng.integers(0, n_addr,
+                                          n_cust).astype(np.int64),
+    })
+    customer_address = pd.DataFrame({
+        "ca_address_sk": np.arange(n_addr, dtype=np.int64),
+        "ca_city": np.array(CITIES, dtype=object)[
+            rng.integers(0, len(CITIES), n_addr)],
+        "ca_state": np.array(STATES, dtype=object)[
+            rng.integers(0, len(STATES), n_addr)],
+        "ca_country": np.array(["United States"] * n_addr, dtype=object),
+    })
+    household_demographics = pd.DataFrame({
+        "hd_demo_sk": np.arange(n_hd, dtype=np.int64),
+        "hd_dep_count": rng.integers(0, 10, n_hd).astype(np.int32),
+        "hd_vehicle_count": rng.integers(0, 5, n_hd).astype(np.int32),
+        "hd_buy_potential": np.array(BUY_POTENTIAL, dtype=object)[
+            rng.integers(0, len(BUY_POTENTIAL), n_hd)],
+    })
+    promotion = pd.DataFrame({
+        "p_promo_sk": np.arange(n_promo, dtype=np.int64),
+        "p_channel_email": np.array(["N", "Y"], dtype=object)[
+            (rng.random(n_promo) < 0.12).astype(int)],
+        "p_channel_event": np.array(["N", "Y"], dtype=object)[
+            (rng.random(n_promo) < 0.12).astype(int)],
+    })
+    n = scale
+    # a ticket (basket) belongs to exactly one customer, several items —
+    # the invariant q68/q73's per-ticket aggregates group on
+    tickets = rng.integers(0, max(n // 6, 1), n).astype(np.int64)
+    ticket_cust = ((tickets * 7919) % n_cust).astype(np.int64)
+    qty = rng.integers(1, 101, n).astype(np.int32)
+    list_price = _money(rng, 1.0, 200.0, n)
+    sales_price = np.round(list_price * rng.uniform(0.2, 1.0, n), 2)
+    store_sales = pd.DataFrame({
+        "ss_sold_date_sk": rng.integers(0, n_dates, n).astype(np.int64),
+        "ss_item_sk": rng.integers(0, n_items, n).astype(np.int64),
+        "ss_customer_sk": ticket_cust,
+        "ss_cdemo_sk": rng.integers(0, 1000, n).astype(np.int64),
+        "ss_hdemo_sk": rng.integers(0, n_hd, n).astype(np.int64),
+        "ss_addr_sk": rng.integers(0, n_addr, n).astype(np.int64),
+        "ss_store_sk": rng.integers(0, n_stores, n).astype(np.int64),
+        "ss_promo_sk": rng.integers(0, n_promo, n).astype(np.int64),
+        "ss_ticket_number": tickets,
+        "ss_quantity": qty,
+        "ss_list_price": list_price,
+        "ss_sales_price": sales_price,
+        "ss_ext_sales_price": np.round(sales_price * qty, 2),
+        "ss_ext_discount_amt": _money(rng, 0.0, 100.0, n),
+        "ss_ext_list_price": np.round(list_price * qty, 2),
+        "ss_coupon_amt": np.where(rng.random(n) < 0.2,
+                                  _money(rng, 0.0, 50.0, n), 0.0),
+        "ss_net_profit": _money(rng, -500.0, 500.0, n),
+        "ss_ext_wholesale_cost": _money(rng, 1.0, 100.0, n),
+    })
+    return {"date_dim": date_dim, "item": item, "store": store,
+            "customer": customer, "customer_address": customer_address,
+            "household_demographics": household_demographics,
+            "promotion": promotion, "store_sales": store_sales}
+
+
+def sources(tables: dict[str, pd.DataFrame], num_partitions: int = 1):
+    from spark_rapids_tpu.models.data_util import make_sources
+    return make_sources(tables, SCHEMAS, num_partitions)

@@ -1,0 +1,221 @@
+"""TPC-DS-like query subset (reference
+`integration_tests/.../tpcds/TpcdsLikeSpark.scala` — the classic
+star-join report set: q3, q7-shape, q19, q27-shape, q42, q52, q55, q68,
+q73, q96, q98-shape).  Same plan-tree style as tpch_queries."""
+from __future__ import annotations
+
+from spark_rapids_tpu.exec.joins import JoinType
+from spark_rapids_tpu.exec.sort import asc, desc
+from spark_rapids_tpu.exprs.aggregates import Average, Count, Sum
+from spark_rapids_tpu.exprs.base import col, lit
+from spark_rapids_tpu.exprs.predicates import InSet
+from spark_rapids_tpu.plan.nodes import (CpuAggregate, CpuFilter,
+                                         CpuHashJoin, CpuLimit, CpuProject,
+                                         CpuSort)
+
+J = JoinType
+
+
+def _join(left, right, lk, rk, jt=J.INNER, condition=None):
+    return CpuHashJoin(jt, [col(k) for k in lk], [col(k) for k in rk],
+                       left, right, condition=condition)
+
+
+def q3(t, run):
+    """Brand revenue by year for one manufacturer in December."""
+    dd = CpuFilter(col("d_moy") == lit(12), t["date_dim"])
+    it = CpuFilter(col("i_manufact_id") == lit(5), t["item"])
+    j = _join(_join(dd, t["store_sales"],
+                    ["d_date_sk"], ["ss_sold_date_sk"]),
+              it, ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("d_year"), col("i_brand_id"), col("i_brand")],
+        [Sum(col("ss_ext_sales_price")).alias("sum_agg")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("d_year")), desc(col("sum_agg")),
+         asc(col("i_brand_id"))], agg))
+
+
+def q19(t, run):
+    """Brand revenue for one month/year by manager."""
+    dd = CpuFilter((col("d_year") == lit(1999)) &
+                   (col("d_moy") == lit(11)), t["date_dim"])
+    it = CpuFilter(col("i_manager_id") == lit(8), t["item"])
+    j = _join(_join(dd, t["store_sales"],
+                    ["d_date_sk"], ["ss_sold_date_sk"]),
+              it, ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_brand_id"), col("i_brand"), col("i_manufact_id")],
+        [Sum(col("ss_ext_sales_price")).alias("ext_price")], j)
+    return CpuLimit(100, CpuSort(
+        [desc(col("ext_price")), asc(col("i_brand_id")),
+         asc(col("i_manufact_id"))], agg))
+
+
+def q42(t, run):
+    """Category revenue for one month/year."""
+    dd = CpuFilter((col("d_year") == lit(2000)) &
+                   (col("d_moy") == lit(11)), t["date_dim"])
+    j = _join(_join(dd, t["store_sales"],
+                    ["d_date_sk"], ["ss_sold_date_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("d_year"), col("i_category_id"), col("i_category")],
+        [Sum(col("ss_ext_sales_price")).alias("total")], j)
+    return CpuLimit(100, CpuSort(
+        [desc(col("total")), asc(col("d_year")),
+         asc(col("i_category_id"))], agg))
+
+
+def q52(t, run):
+    """Brand revenue, one month/year (q42 by brand)."""
+    dd = CpuFilter((col("d_year") == lit(2000)) &
+                   (col("d_moy") == lit(11)), t["date_dim"])
+    j = _join(_join(dd, t["store_sales"],
+                    ["d_date_sk"], ["ss_sold_date_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("d_year"), col("i_brand_id"), col("i_brand")],
+        [Sum(col("ss_ext_sales_price")).alias("ext_price")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("d_year")), desc(col("ext_price")),
+         asc(col("i_brand_id"))], agg))
+
+
+def q55(t, run):
+    """Brand revenue for one manager, month, year."""
+    dd = CpuFilter((col("d_year") == lit(2001)) &
+                   (col("d_moy") == lit(12)), t["date_dim"])
+    it = CpuFilter(col("i_manager_id") == lit(28), t["item"])
+    j = _join(_join(dd, t["store_sales"],
+                    ["d_date_sk"], ["ss_sold_date_sk"]),
+              it, ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_brand_id"), col("i_brand")],
+        [Sum(col("ss_ext_sales_price")).alias("ext_price")], j)
+    return CpuLimit(100, CpuSort(
+        [desc(col("ext_price")), asc(col("i_brand_id"))], agg))
+
+
+def q7_shape(t, run):
+    """Average metrics per item under promotion (q7 without cdemo)."""
+    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
+    promo = CpuFilter((col("p_channel_email") == lit("N")) |
+                      (col("p_channel_event") == lit("N")),
+                      t["promotion"])
+    j = _join(_join(_join(dd, t["store_sales"],
+                          ["d_date_sk"], ["ss_sold_date_sk"]),
+                    promo, ["ss_promo_sk"], ["p_promo_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id")],
+        [Average(col("ss_quantity")).alias("agg1"),
+         Average(col("ss_list_price")).alias("agg2"),
+         Average(col("ss_coupon_amt")).alias("agg3"),
+         Average(col("ss_sales_price")).alias("agg4")], j)
+    return CpuLimit(100, CpuSort([asc(col("i_item_id"))], agg))
+
+
+def q27_shape(t, run):
+    """State-level item averages (q27 without cdemo rollup)."""
+    dd = CpuFilter(col("d_year") == lit(2002), t["date_dim"])
+    st = CpuFilter(InSet(col("s_state"), ("TX", "CA", "WA", "NY")),
+                   t["store"])
+    j = _join(_join(_join(dd, t["store_sales"],
+                          ["d_date_sk"], ["ss_sold_date_sk"]),
+                    st, ["ss_store_sk"], ["s_store_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id"), col("s_state")],
+        [Average(col("ss_quantity")).alias("agg1"),
+         Average(col("ss_list_price")).alias("agg2"),
+         Average(col("ss_coupon_amt")).alias("agg3"),
+         Average(col("ss_sales_price")).alias("agg4")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_item_id")), asc(col("s_state"))], agg))
+
+
+def q68(t, run):
+    """Per-ticket totals for high-dependency households in two cities."""
+    dd = CpuFilter((col("d_year") == lit(1999)) &
+                   InSet(col("d_dom"), tuple(range(1, 3))),
+                   t["date_dim"])
+    hd = CpuFilter((col("hd_dep_count") == lit(4)) |
+                   (col("hd_vehicle_count") == lit(3)),
+                   t["household_demographics"])
+    st = CpuFilter(InSet(col("s_city"), ("Midway", "Fairview")),
+                   t["store"])
+    j = _join(_join(_join(_join(dd, t["store_sales"],
+                                ["d_date_sk"], ["ss_sold_date_sk"]),
+                          st, ["ss_store_sk"], ["s_store_sk"]),
+                    hd, ["ss_hdemo_sk"], ["hd_demo_sk"]),
+              t["customer_address"], ["ss_addr_sk"], ["ca_address_sk"])
+    per_ticket = CpuAggregate(
+        [col("ss_ticket_number"), col("ss_customer_sk"),
+         col("ca_city")],
+        [Sum(col("ss_ext_sales_price")).alias("extended_price"),
+         Sum(col("ss_ext_list_price")).alias("list_price"),
+         Sum(col("ss_ext_wholesale_cost")).alias("extended_tax")], j)
+    j2 = _join(per_ticket, t["customer"],
+               ["ss_customer_sk"], ["c_customer_sk"])
+    out = CpuProject(
+        [col("c_last_name"), col("c_first_name"), col("ca_city"),
+         col("ss_ticket_number"), col("extended_price"),
+         col("extended_tax"), col("list_price")], j2)
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_last_name")), asc(col("ss_ticket_number"))], out))
+
+
+def q73(t, run):
+    """Ticket counts per customer for mid-size baskets."""
+    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
+    hd = CpuFilter(col("hd_buy_potential") == lit(">10000"),
+                   t["household_demographics"])
+    j = _join(_join(dd, t["store_sales"],
+                    ["d_date_sk"], ["ss_sold_date_sk"]),
+              hd, ["ss_hdemo_sk"], ["hd_demo_sk"])
+    per_ticket = CpuAggregate(
+        [col("ss_ticket_number"), col("ss_customer_sk")],
+        [Count(None).alias("cnt")], j)
+    big = CpuFilter((col("cnt") >= lit(2)) & (col("cnt") <= lit(50)),
+                    per_ticket)
+    j2 = _join(big, t["customer"],
+               ["ss_customer_sk"], ["c_customer_sk"])
+    out = CpuProject(
+        [col("c_last_name"), col("c_first_name"),
+         col("ss_ticket_number"), col("cnt")], j2)
+    return CpuSort([desc(col("cnt")), asc(col("c_last_name")),
+                    asc(col("ss_ticket_number"))], out)
+
+
+def q96(t, run):
+    """Count of sales in a demographic/time slice."""
+    hd = CpuFilter(col("hd_dep_count") == lit(7),
+                   t["household_demographics"])
+    st = CpuFilter(col("s_store_name") == lit("ese"), t["store"])
+    j = _join(_join(t["store_sales"], hd,
+                    ["ss_hdemo_sk"], ["hd_demo_sk"]),
+              st, ["ss_store_sk"], ["s_store_sk"])
+    return CpuAggregate([], [Count(None).alias("cnt")], j)
+
+
+def q98_shape(t, run):
+    """Revenue by item within categories over one month."""
+    dd = CpuFilter((col("d_year") == lit(1999)) &
+                   (col("d_moy") == lit(2)), t["date_dim"])
+    it = CpuFilter(InSet(col("i_category"),
+                         ("Sports", "Books", "Home")), t["item"])
+    j = _join(_join(dd, t["store_sales"],
+                    ["d_date_sk"], ["ss_sold_date_sk"]),
+              it, ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id"), col("i_category"), col("i_current_price")],
+        [Sum(col("ss_ext_sales_price")).alias("itemrevenue")], j)
+    return CpuSort([asc(col("i_category")), asc(col("i_item_id"))], agg)
+
+
+QUERIES = {
+    "q3": q3, "q7": q7_shape, "q19": q19, "q27": q27_shape,
+    "q42": q42, "q52": q52, "q55": q55, "q68": q68, "q73": q73,
+    "q96": q96, "q98": q98_shape,
+}

@@ -26,6 +26,15 @@ def _cpu_runner():
     return lambda plan: plan.collect()
 
 
+#: bench conf mirrors how the reference runs its TPC suites: incompat /
+#: order-sensitive float aggregation enabled (results differ from CPU only
+#: in float rounding order)
+BENCH_CONF = {
+    "spark.rapids.sql.variableFloatAgg.enabled": True,
+    "spark.rapids.sql.incompatibleOps.enabled": True,
+}
+
+
 def run_query(n: int, tables, engine: str = "tpu",
               conf: Optional[C.RapidsConf] = None,
               num_partitions: int = 2):
@@ -33,7 +42,7 @@ def run_query(n: int, tables, engine: str = "tpu",
     if engine == "cpu":
         run = _cpu_runner()
         return QUERIES[n](t, run).collect()
-    conf = conf or C.RapidsConf()
+    conf = conf or C.RapidsConf(dict(BENCH_CONF))
     run = _tpu_runner(conf)
     return run(QUERIES[n](t, run))
 
@@ -59,8 +68,9 @@ def run_bench(queries: Sequence[int] = tuple(QUERIES),
             hot.append(time.perf_counter() - t0)
         results[n] = {"cold_s": min(cold) if cold else None,
                       "hot_s": min(hot) if hot else None}
-        print(f"q{n}: cold={results[n]['cold_s']:.3f}s "
-              f"hot={results[n]['hot_s']:.3f}s")
+        fmt = lambda v: "-" if v is None else f"{v:.3f}s"
+        print(f"q{n}: cold={fmt(results[n]['cold_s'])} "
+              f"hot={fmt(results[n]['hot_s'])}")
     return results
 
 

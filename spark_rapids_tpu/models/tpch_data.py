@@ -131,7 +131,7 @@ def _comment(rng, n, specials=()):
                    dtype=object)
     for phrase in specials:
         hit = rng.random(n) < 0.08
-        out[hit] = np.array([f"{a} {phrase} {b}"
+        out[hit] = np.array([f"{a} {phrase} {b} requests"
                              for a, b in zip(base[hit], mid[hit])],
                             dtype=object)
     return out
@@ -287,16 +287,5 @@ def gen_tables(rng: np.random.Generator, scale: int = 1000
 def sources(tables: dict[str, pd.DataFrame], num_partitions: int = 1):
     """Wrap generated tables as CpuSource plan leaves with the declared
     schemas (DATE32 columns stay int32 storage)."""
-    from spark_rapids_tpu.plan.nodes import CpuSource
-    out = {}
-    for name, df in tables.items():
-        schema = SCHEMAS[name]
-        if num_partitions <= 1 or len(df) < num_partitions:
-            parts = [df]
-        else:
-            bounds = np.linspace(0, len(df), num_partitions + 1).astype(
-                int)
-            parts = [df.iloc[bounds[i]:bounds[i + 1]].reset_index(
-                drop=True) for i in range(num_partitions)]
-        out[name] = CpuSource(parts, schema)
-    return out
+    from spark_rapids_tpu.models.data_util import make_sources
+    return make_sources(tables, SCHEMAS, num_partitions)

@@ -1,0 +1,123 @@
+"""TPCx-BB-like workload subset (reference
+`integration_tests/.../tpcxbb/TpcxbbLikeSpark.scala` + the
+`TpcxbbLikeBench` driver that produced the headline chart —
+README.md:12-19).  Clickstream + sales analytics shapes: co-browsed
+categories, per-item view counts before purchase, category sales share.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.joins import JoinType
+from spark_rapids_tpu.exec.sort import asc, desc
+from spark_rapids_tpu.exprs.aggregates import Count, Sum
+from spark_rapids_tpu.exprs.base import col, lit
+from spark_rapids_tpu.exprs.predicates import InSet
+from spark_rapids_tpu.models.tpcds_data import CATEGORIES
+from spark_rapids_tpu.plan.nodes import (CpuAggregate, CpuFilter,
+                                         CpuHashJoin, CpuLimit, CpuProject,
+                                         CpuSort)
+
+CLICKS_SCHEMA = T.Schema.of(
+    ("wcs_click_date_sk", T.INT64), ("wcs_user_sk", T.INT64),
+    ("wcs_item_sk", T.INT64), ("wcs_sales_sk", T.INT64))
+
+
+def gen_clickstream(rng: np.random.Generator, n: int, n_items: int,
+                    n_users: int, n_dates: int) -> pd.DataFrame:
+    bought = rng.random(n) < 0.1
+    return pd.DataFrame({
+        "wcs_click_date_sk": rng.integers(0, n_dates, n).astype(np.int64),
+        "wcs_user_sk": rng.integers(0, n_users, n).astype(np.int64),
+        "wcs_item_sk": rng.integers(0, n_items, n).astype(np.int64),
+        # -1 marks a view without purchase (nullable FK in the reference)
+        "wcs_sales_sk": np.where(bought, rng.integers(0, n, n), -1)
+        .astype(np.int64),
+    })
+
+
+def gen_tables(rng: np.random.Generator, scale: int = 10_000):
+    """TPC-DS tables + a clickstream sized 3x store_sales."""
+    from spark_rapids_tpu.models import tpcds_data
+    tables = tpcds_data.gen_tables(rng, scale)
+    n_items = len(tables["item"])
+    n_users = len(tables["customer"])
+    tables["web_clickstreams"] = gen_clickstream(
+        rng, scale * 3, n_items, n_users, 365 * 5)
+    return tables
+
+
+def sources(tables, num_partitions: int = 1):
+    from spark_rapids_tpu.models import tpcds_data
+    from spark_rapids_tpu.models.data_util import make_sources
+    clicks = {"web_clickstreams": tables["web_clickstreams"]}
+    rest = {k: v for k, v in tables.items()
+            if k != "web_clickstreams"}
+    out = tpcds_data.sources(rest, num_partitions)
+    out.update(make_sources(clicks, {"web_clickstreams": CLICKS_SCHEMA},
+                            num_partitions))
+    return out
+
+
+def q01_shape(t, run):
+    """Top viewed categories (q01: frequently browsed together shape)."""
+    j = CpuHashJoin(JoinType.INNER, [col("wcs_item_sk")],
+                    [col("i_item_sk")], t["web_clickstreams"], t["item"])
+    agg = CpuAggregate([col("i_category")],
+                       [Count(None).alias("views")], j)
+    return CpuSort([desc(col("views")), asc(col("i_category"))], agg)
+
+
+def q05_shape(t, run):
+    """Per-user views of a category vs purchases (logistic-features
+    shape of q05)."""
+    j = CpuHashJoin(JoinType.INNER, [col("wcs_item_sk")],
+                    [col("i_item_sk")], t["web_clickstreams"], t["item"])
+    flt = CpuFilter(InSet(col("i_category"),
+                          ("Books", "Electronics")), j)
+    agg = CpuAggregate(
+        [col("wcs_user_sk")],
+        [Count(None).alias("clicks"),
+         Sum(_purchased()).alias("purchases")], flt)
+    return CpuLimit(100, CpuSort(
+        [desc(col("clicks")), asc(col("wcs_user_sk"))], agg))
+
+
+def _purchased():
+    from spark_rapids_tpu.exprs.conditional import CaseWhen
+    return CaseWhen((((col("wcs_sales_sk") >= lit(0)), lit(1)),), lit(0))
+
+
+def q12_shape(t, run):
+    """Users who browsed then bought in a category window (semi join)."""
+    j = CpuHashJoin(JoinType.INNER, [col("wcs_item_sk")],
+                    [col("i_item_sk")], t["web_clickstreams"], t["item"])
+    viewed = CpuFilter(
+        InSet(col("i_category"), ("Home", "Music")) &
+        (col("wcs_sales_sk") < lit(0)), j)
+    buyers = CpuProject(
+        [col("ss_customer_sk").alias("buyer_sk")],
+        t["store_sales"])
+    out = CpuHashJoin(JoinType.LEFT_SEMI, [col("wcs_user_sk")],
+                      [col("buyer_sk")], viewed, buyers)
+    agg = CpuAggregate([col("wcs_user_sk")],
+                       [Count(None).alias("views")], out)
+    return CpuLimit(100, CpuSort(
+        [desc(col("views")), asc(col("wcs_user_sk"))], agg))
+
+
+def q15_shape(t, run):
+    """Category share of sales per store (q15 trend shape)."""
+    j = CpuHashJoin(JoinType.INNER, [col("ss_item_sk")],
+                    [col("i_item_sk")], t["store_sales"], t["item"])
+    agg = CpuAggregate(
+        [col("ss_store_sk"), col("i_category")],
+        [Sum(col("ss_ext_sales_price")).alias("sales")], j)
+    return CpuSort([asc(col("ss_store_sk")), desc(col("sales")),
+                    asc(col("i_category"))], agg)
+
+
+QUERIES = {"q01": q01_shape, "q05": q05_shape, "q12": q12_shape,
+           "q15": q15_shape}

@@ -47,16 +47,27 @@ def _float_keys(data, ascending: bool) -> list[jnp.ndarray]:
     return [(~nan).astype(jnp.uint8), -val]
 
 
-def encode_key_column(col: ColumnVector, ascending: bool = True,
-                      nulls_first: bool = True) -> list[jnp.ndarray]:
-    """Returns lexsort keys for this column in MOST-significant-first
-    order: [null_rank, value_key...]."""
-    keys: list[jnp.ndarray] = []
+def encode_key_bits(col: ColumnVector, ascending: bool = True,
+                    nulls_first: bool = True
+                    ) -> list[tuple[jnp.ndarray, int]]:
+    """Sort keys for one column, each with its bit width so
+    `packed_lexsort` can pack many keys into few uint64 sort words.
+    A width of None marks an unpackable key (float64 values) that must be
+    its own sort operand."""
+    keys: list = []
     null_rank = jnp.where(col.validity,
                           jnp.uint8(1 if nulls_first else 0),
                           jnp.uint8(0 if nulls_first else 1))
-    keys.append(null_rank)
-    if col.dtype.is_string:
+    keys.append((null_rank, 1))
+    dt = col.dtype
+
+    def width_int(x, bits, bias):
+        enc = (x.astype(jnp.int64) + bias).astype(jnp.uint64)
+        if not ascending:
+            enc = jnp.uint64((1 << bits) - 1) - enc
+        return (enc, bits)
+
+    if dt.is_string:
         cc = col.char_cap
         pos = jnp.arange(cc)[None, :]
         b = jnp.where(pos < col.lengths[:, None],
@@ -64,26 +75,89 @@ def encode_key_column(col: ColumnVector, ascending: bool = True,
         if not ascending:
             b = jnp.int16(256) - b
         for j in range(cc):
-            keys.append(b[:, j])
-    elif col.dtype.is_floating:
-        keys.extend(_float_keys(col.data, ascending))
-    else:
-        k = _encode_int(col.data)
+            keys.append((b[:, j].astype(jnp.uint64), 9))
+    elif dt.id == T.TypeId.FLOAT32:
+        nan = jnp.isnan(col.data)
+        keys.append(((nan if ascending else ~nan).astype(jnp.uint8), 1))
+        val = jnp.where(nan, jnp.zeros_like(col.data), col.data)
+        bits = lax.bitcast_convert_type(val, jnp.uint32)
+        sign = bits >> jnp.uint32(31)
+        # IEEE total-order: negative floats reverse, positives offset
+        enc = jnp.where(sign == 1, ~bits,
+                        bits | jnp.uint32(0x80000000)).astype(jnp.uint64)
         if not ascending:
-            k = ~k
-        keys.append(k)
+            enc = jnp.uint64((1 << 32) - 1) - enc
+        keys.append((enc, 32))
+    elif dt.is_floating:  # float64: 64-bit bitcast is unavailable on TPU
+        nan = jnp.isnan(col.data)
+        keys.append(((nan if ascending else ~nan).astype(jnp.uint8), 1))
+        val = jnp.where(nan, jnp.zeros_like(col.data), col.data)
+        keys.append((val if ascending else -val, None))
+    elif dt.id == T.TypeId.BOOL:
+        enc = col.data.astype(jnp.uint64)
+        if not ascending:
+            enc = jnp.uint64(1) - enc
+        keys.append((enc, 1))
+    elif dt.id == T.TypeId.INT8:
+        keys.append(width_int(col.data, 8, 128))
+    elif dt.id == T.TypeId.INT16:
+        keys.append(width_int(col.data, 16, 1 << 15))
+    elif dt.id in (T.TypeId.INT32, T.TypeId.DATE32):
+        keys.append(width_int(col.data, 32, 1 << 31))
+    else:  # int64 / timestamp
+        enc = col.data.astype(jnp.int64).astype(jnp.uint64) ^ _SIGN64
+        if not ascending:
+            enc = ~enc
+        keys.append((enc, 64))
     return keys
+
+
+def packed_lexsort(keys_msf: list[tuple[jnp.ndarray, int]]) -> jnp.ndarray:
+    """Stable multi-key argsort, most-significant key first.
+
+    XLA:TPU sort compile time grows steeply with operand count and row
+    count (a 10-operand variadic sort at 64K rows compiles for minutes),
+    so keys are greedily packed MSF->LSF into uint64 words and the sort
+    runs as a chain of cheap 1-key stable sorts from the least significant
+    word up — the classic LSD radix composition."""
+    cap = keys_msf[0][0].shape[0]
+    words: list = []
+    acc, used = None, 0
+
+    def flush():
+        nonlocal acc, used
+        if acc is not None:
+            words.append(acc)
+            acc, used = None, 0
+
+    for arr, bits in keys_msf:
+        if bits is None:
+            flush()
+            words.append(arr)
+            continue
+        a = arr.astype(jnp.uint64)
+        if acc is not None and used + bits <= 64:
+            acc = (acc << jnp.uint64(bits)) | a
+            used += bits
+        else:
+            flush()
+            acc, used = a, bits
+    flush()
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    for w in reversed(words):
+        kw = jnp.take(w, perm)
+        _, perm = lax.sort((kw, perm), num_keys=1, is_stable=True)
+    return perm
 
 
 def multi_key_argsort(key_cols: list[tuple[ColumnVector, bool, bool]],
                       row_mask: jnp.ndarray) -> jnp.ndarray:
     """Stable argsort by multiple (column, ascending, nulls_first) keys;
     padded rows sort last.  Returns the permutation."""
-    keys_msf: list[jnp.ndarray] = [(~row_mask).astype(jnp.uint8)]
+    keys_msf: list = [((~row_mask).astype(jnp.uint8), 1)]
     for col, asc, nf in key_cols:
-        keys_msf.extend(encode_key_column(col, asc, nf))
-    # lexsort: LAST key is primary -> feed least-significant first
-    return jnp.lexsort(tuple(reversed(keys_msf)))
+        keys_msf.extend(encode_key_bits(col, asc, nf))
+    return packed_lexsort(keys_msf)
 
 
 def segment_boundaries(key_cols: list[ColumnVector],

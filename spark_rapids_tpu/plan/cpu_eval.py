@@ -386,7 +386,7 @@ def _like_to_regex(pat: str) -> str:
         else:
             out.append(re.escape(ch))
         i += 1
-    return "^" + "".join(out) + "$"
+    return "^" + "".join(out) + r"\Z"  # $ would accept a trailing newline
 
 
 def _like(e, df, schema):

@@ -490,6 +490,9 @@ def accelerate(cpu_plan: N.CpuNode,
     if conf[C.UDF_COMPILER_ENABLED]:
         from spark_rapids_tpu.udf import rewrite_udfs
         cpu_plan = rewrite_udfs(cpu_plan)
+    if conf[C.PRUNE_COLUMNS]:
+        from spark_rapids_tpu.plan.pruning import prune_columns
+        cpu_plan = prune_columns(cpu_plan)
     meta = wrap_plan(cpu_plan, conf)
     meta.tag_for_tpu()
     fix_up_exchange_overhead(meta)

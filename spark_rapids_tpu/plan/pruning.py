@@ -1,0 +1,158 @@
+"""Column pruning (the role Catalyst's ColumnPruning + schema pruning play
+for the reference — it inherits pruned scans from Spark's optimizer; we
+plan from raw trees, so we run the pass ourselves before plan rewrite).
+
+Top-down required-column analysis, bottom-up rebuild: leaves narrow to the
+columns actually referenced above them — a parquet scan reads fewer column
+chunks, an in-memory source uploads fewer columns, and (the TPU-critical
+part) wide string columns never ride through sort/join/exchange kernels
+they don't participate in.
+
+Conservative by construction: an unrecognized node type keeps its subtree
+untouched (children get `None` = all columns).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec.joins import JoinType
+from spark_rapids_tpu.exprs.base import AttributeReference, Expression
+from spark_rapids_tpu.plan import nodes as N
+
+
+def expr_refs(obj) -> set:
+    """Column names referenced anywhere in an expression-bearing object
+    (expressions, aggregate aliases, sort orders, nested sequences)."""
+    out: set = set()
+
+    def walk(v):
+        if v is None:
+            return
+        if isinstance(v, AttributeReference):
+            out.add(v.name)
+            return
+        if isinstance(v, Expression):
+            for c in v.children():
+                walk(c)
+            if dataclasses.is_dataclass(v):
+                for f in dataclasses.fields(v):
+                    fv = getattr(v, f.name)
+                    if isinstance(fv, (Expression, list, tuple)):
+                        walk(fv)
+            return
+        if isinstance(v, (list, tuple)):
+            for x in v:
+                walk(x)
+            return
+        if dataclasses.is_dataclass(v):
+            for f in dataclasses.fields(v):
+                walk(getattr(v, f.name))
+    walk(obj)
+    return out
+
+
+def _narrow_schema(schema: T.Schema, names: set) -> T.Schema:
+    return T.Schema(tuple(f for f in schema.fields if f.name in names))
+
+
+def prune_columns(node: N.CpuNode, required: Optional[set] = None
+                  ) -> N.CpuNode:
+    """Returns an equivalent tree whose leaves produce only `required`
+    columns (None = all).  Never mutates the input.  Node-attached state
+    (AQE `_tpu_tag` pins) survives the rebuild."""
+    new = _prune(node, required)
+    if new is not node and "_tpu_tag" in node.__dict__:
+        # MOVE the pin (consume-once semantics): the pruned tree is what
+        # this planning session tags, and a pin must not survive on the
+        # original node into a later accelerate() under a different conf
+        new._tpu_tag = node.__dict__.pop("_tpu_tag")
+    return new
+
+
+def _prune(node: N.CpuNode, required: Optional[set]) -> N.CpuNode:
+    if isinstance(node, N.CpuSource):
+        schema = node.output_schema()
+        if required is None or required >= set(schema.names):
+            return node
+        keep = [f.name for f in schema.fields if f.name in required]
+        if not keep:  # count(*)-style: keep one narrow column for rows
+            keep = [schema.fields[0].name]
+        return N.CpuSource([p[keep] for p in node.partitions],
+                           _narrow_schema(schema, set(keep)))
+
+    if type(node).__name__ == "CpuFileScan":
+        schema = node.output_schema()
+        if required is None or required >= set(schema.names) \
+                or node.scan.file_format == "csv":
+            return node  # csv readers key off the full file column list
+        keep = set(required)
+        if not keep:
+            keep = {schema.fields[0].name}
+        from spark_rapids_tpu.io.exec import CpuFileScan
+        out = CpuFileScan(node.scan.pruned(keep))
+        out.pushed_filter = node.pushed_filter
+        return out
+
+    if isinstance(node, N.CpuProject):
+        child = prune_columns(node.child, expr_refs(node.exprs))
+        return N.CpuProject(node.exprs, child)
+
+    if isinstance(node, N.CpuFilter):
+        need = None if required is None else \
+            required | expr_refs(node.condition)
+        return N.CpuFilter(node.condition,
+                           prune_columns(node.child, need))
+
+    if isinstance(node, N.CpuAggregate):
+        need = expr_refs(node.group_exprs) | expr_refs(node.aggregates)
+        return N.CpuAggregate(node.group_exprs, node.aggregates,
+                              prune_columns(node.child, need))
+
+    if isinstance(node, N.CpuSort):
+        need = None if required is None else \
+            required | expr_refs(node.order)
+        return N.CpuSort(node.order, prune_columns(node.child, need),
+                         node.global_sort)
+
+    if isinstance(node, N.CpuLimit):
+        return N.CpuLimit(node.n, prune_columns(node.child, required),
+                          node.global_limit)
+
+    if isinstance(node, N.CpuUnion):
+        return N.CpuUnion(*[prune_columns(c, required)
+                            for c in node.children])
+
+    if isinstance(node, N.CpuShuffleExchange):
+        need = None if required is None else \
+            required | expr_refs(node.spec)
+        return N.CpuShuffleExchange(node.spec,
+                                    prune_columns(node.child, need))
+
+    if isinstance(node, N.CpuBroadcastExchange):
+        return N.CpuBroadcastExchange(
+            prune_columns(node.child, required))
+
+    if isinstance(node, N.CpuHashJoin):
+        lnames = set(node.children[0].output_schema().names)
+        rnames = set(node.children[1].output_schema().names)
+        cond = expr_refs(node.condition)
+        if required is None:
+            lreq = rreq = None
+        else:
+            above = set(required) | cond
+            lreq = (above & lnames) | expr_refs(node.left_keys)
+            rreq = (above & rnames) | expr_refs(node.right_keys)
+        if node.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            # right side exists only for the match: keys + condition
+            rreq = expr_refs(node.right_keys) | (cond & rnames)
+        left = prune_columns(node.children[0], lreq)
+        right = prune_columns(node.children[1], rreq)
+        return N.CpuHashJoin(node.join_type, node.left_keys,
+                             node.right_keys, left, right,
+                             condition=node.condition,
+                             broadcast=node.broadcast)
+
+    # unknown node (window, UDF execs, writers, range...): keep subtree
+    return node

@@ -41,26 +41,51 @@ class ShuffleExchangeExec(UnaryExecBase):
         return (f"ShuffleExchangeExec({type(self.partitioning).__name__}, "
                 f"n={self.partitioning.num_partitions})")
 
+    #: below this many input rows a range exchange degenerates to a
+    #: single partition: a one-partition local sort is already globally
+    #: ordered, and skipping bounds sampling + the split kernel saves
+    #: several device round trips (AQE-style small-input coalescing)
+    SMALL_RANGE_INPUT_ROWS = 1 << 15
+
+    def _range_inputs(self):
+        """Range partitioning needs two passes over the child (sample
+        bounds, then split), so its inputs are materialized once here.
+        Returns (inputs, small) — `small` means a one-partition exchange
+        suffices.  Hash/round-robin callers must NOT use this: they
+        stream batch-at-a-time so pre-split inputs are freed as they go."""
+        inputs = [b for it in self.child.execute_partitions()
+                  for b in it if b.num_rows > 0]
+        total = sum(b.num_rows for b in inputs)
+        n = self.partitioning.num_partitions
+        small = total <= self.SMALL_RANGE_INPUT_ROWS or n == 1
+        if not small and self.partitioning.bounds is None:
+            self.partitioning.bounds = self._sample_bounds(
+                self.partitioning, inputs)
+        return inputs, small
+
     def _materialize(self) -> list[list[ColumnarBatch]]:
         """Run the map side: split every input batch; bucket by target."""
         part = self.partitioning
-        if isinstance(part, RangePartitioning) and part.bounds is None:
-            part.bounds = self._sample_bounds(part)
         n = part.num_partitions
+        if isinstance(part, RangePartitioning):
+            inputs, small = self._range_inputs()
+            if small:
+                return [list(inputs)] + [[] for _ in range(n - 1)]
+            batch_iter = iter(inputs)
+        else:
+            batch_iter = (b for it in self.child.execute_partitions()
+                          for b in it if b.num_rows > 0)
         buckets: list[list[ColumnarBatch]] = [[] for _ in range(n)]
-        for it in self.child.execute_partitions():
-            for batch in it:
-                if batch.num_rows == 0:
-                    continue
-                with self.metrics.timed(M.TOTAL_TIME):
-                    slices = part.partition_batch(batch)
-                for p, s in enumerate(slices):
-                    if s is not None and s.num_rows > 0:
-                        buckets[p].append(s)
-                        self.metrics.add("dataSize", s.device_size_bytes())
+        for batch in batch_iter:
+            with self.metrics.timed(M.TOTAL_TIME):
+                slices = part.partition_batch(batch)
+            for p, s in enumerate(slices):
+                if s is not None and s.num_rows > 0:
+                    buckets[p].append(s)
+                    self.metrics.add("dataSize", s.device_size_bytes())
         return buckets
 
-    def _sample_bounds(self, part: RangePartitioning):
+    def _sample_bounds(self, part: RangePartitioning, inputs):
         """Driver-side reservoir sampling for range bounds (reference
         GpuRangePartitioner.sketch/SamplingUtils)."""
         import numpy as np
@@ -69,26 +94,19 @@ class ShuffleExchangeExec(UnaryExecBase):
         samples = []
         sample_rows = 0
         target = 20 * part.num_partitions
-        done = False
-        for it in self.child.execute_partitions():
-            if done:
+        for batch in inputs:
+            # evenly-spaced sample of each batch (the reference uses
+            # reservoir sampling; deterministic striding is equivalent
+            # for bound estimation and cheaper on device)
+            take = min(batch.num_rows, max(2, target))
+            idx = np.linspace(0, batch.num_rows - 1, take).astype(int)
+            cap = bucket_capacity(take)
+            sel = jnp.asarray(np.pad(idx, (0, cap - take)))
+            valid = jnp.arange(cap) < take
+            samples.append(batch.gather(sel, valid, take))
+            sample_rows += take
+            if sample_rows >= 4 * target:
                 break
-            for batch in it:
-                if batch.num_rows == 0:
-                    continue
-                # evenly-spaced sample of each batch (the reference uses
-                # reservoir sampling; deterministic striding is equivalent
-                # for bound estimation and cheaper on device)
-                take = min(batch.num_rows, max(2, target))
-                idx = np.linspace(0, batch.num_rows - 1, take).astype(int)
-                cap = bucket_capacity(take)
-                sel = jnp.asarray(np.pad(idx, (0, cap - take)))
-                valid = jnp.arange(cap) < take
-                samples.append(batch.gather(sel, valid, take))
-                sample_rows += take
-                if sample_rows >= 4 * target:
-                    done = True
-                    break
         if not samples:
             from spark_rapids_tpu.columnar.batch import empty_batch
             return empty_batch(self._schema)
@@ -122,10 +140,18 @@ class ShuffleExchangeExec(UnaryExecBase):
         mgr.register_shuffle(shuffle_id)
         part = self.partitioning
         if isinstance(part, RangePartitioning) and part.bounds is None:
-            part.bounds = self._sample_bounds(part)
+            # two passes needed: materialize per-map batches once so the
+            # bounds sample and the split see the same data
+            per_map = [[b for b in it if b.num_rows > 0]
+                       for it in self.child.execute_partitions()]
+            part.bounds = self._sample_bounds(
+                part, [b for bs in per_map for b in bs])
+            map_iters = [iter(bs) for bs in per_map]
+        else:
+            map_iters = self.child.execute_partitions()
         n = part.num_partitions
         try:
-            for map_id, it in enumerate(self.child.execute_partitions()):
+            for map_id, it in enumerate(map_iters):
                 writer = mgr.get_writer(shuffle_id, map_id)
                 try:
                     for batch in it:

@@ -40,16 +40,18 @@ class TpuPartitioning:
 
 def _split_kernel_for(cache: KernelCache, batch: ColumnarBatch,
                       pid_fn, num_partitions: int, extra_key=()):
-    """Shared: sort rows by partition id, count per partition."""
+    """Shared: sort rows by partition id, count per partition.  `pid_fn`
+    receives a traced `extra` pytree (e.g. range bounds) so data-dependent
+    parameters stay kernel ARGUMENTS — one compile serves any bounds."""
     key = ("split", num_partitions, extra_key, batch_signature(batch))
 
     def build():
         cap = batch.capacity
 
         @jax.jit
-        def kernel(columns, num_rows, salt):
+        def kernel(columns, num_rows, salt, extra):
             ctx = make_eval_context(columns, cap, num_rows)
-            pids = pid_fn(ctx, salt)
+            pids = pid_fn(ctx, salt, extra)
             pids = jnp.where(ctx.row_mask, pids, num_partitions)
             # stable sort by pid: lexsort with row index implicit
             order = jnp.argsort(pids, stable=True)
@@ -88,9 +90,11 @@ class HashPartitioning(TpuPartitioning):
     num_partitions: int
 
     def bind(self, schema):
-        b = HashPartitioning([e.bind(schema) for e in self.exprs],
-                             self.num_partitions)
-        b._cache = getattr(self, "_cache", KernelCache())
+        from spark_rapids_tpu.exprs.base import fingerprint
+        bound = [e.bind(schema) for e in self.exprs]
+        b = HashPartitioning(bound, self.num_partitions)
+        b._cache = KernelCache(("HashPartitioning", fingerprint(bound),
+                                self.num_partitions))
         return b
 
     def partition_batch(self, batch):
@@ -100,13 +104,13 @@ class HashPartitioning(TpuPartitioning):
         bound = self.exprs
         n = self.num_partitions
 
-        def pid_fn(ctx, salt):
+        def pid_fn(ctx, salt, extra):
             keys = [e.eval(ctx) for e in bound]
             return partition_ids(keys, n)
 
         kern = _split_kernel_for(cache, batch, pid_fn, n, "hash")
         cols, counts = kern(batch.columns, jnp.int32(batch.num_rows),
-                            jnp.int32(0))
+                            jnp.int32(0), ())
         return _slice_partitions(cols, np.asarray(counts), batch.schema,
                                  batch.capacity)
 
@@ -117,7 +121,8 @@ class RoundRobinPartitioning(TpuPartitioning):
 
     def bind(self, schema):
         b = RoundRobinPartitioning(self.num_partitions)
-        b._cache = getattr(self, "_cache", KernelCache())
+        b._cache = KernelCache(("RoundRobinPartitioning",
+                                self.num_partitions))
         return b
 
     def partition_batch(self, batch):
@@ -126,7 +131,7 @@ class RoundRobinPartitioning(TpuPartitioning):
             cache = self._cache = KernelCache()
         n = self.num_partitions
 
-        def pid_fn(ctx, salt):
+        def pid_fn(ctx, salt, extra):
             from jax import lax
             return lax.rem(jnp.arange(ctx.capacity, dtype=jnp.int32) + salt,
                            jnp.int32(n))
@@ -134,7 +139,7 @@ class RoundRobinPartitioning(TpuPartitioning):
         kern = _split_kernel_for(cache, batch, pid_fn, n, "rr")
         salt = np.random.randint(0, n)  # start-partition randomization
         cols, counts = kern(batch.columns, jnp.int32(batch.num_rows),
-                            jnp.int32(salt))
+                            jnp.int32(salt), ())
         return _slice_partitions(cols, np.asarray(counts), batch.schema,
                                  batch.capacity)
 
@@ -161,11 +166,14 @@ class RangePartitioning(TpuPartitioning):
 
     def bind(self, schema):
         from spark_rapids_tpu.exec.sort import SortOrder
-        b = RangePartitioning(
-            [SortOrder(o.expr.bind(schema), o.ascending, o.nulls_first)
-             for o in self.order],
-            self.num_partitions, self.bounds)
-        b._cache = getattr(self, "_cache", KernelCache())
+        from spark_rapids_tpu.exprs.base import fingerprint
+        bound = [SortOrder(o.expr.bind(schema), o.ascending,
+                           o.nulls_first) for o in self.order]
+        b = RangePartitioning(bound, self.num_partitions, self.bounds)
+        # bounds ride in as traced kernel args, so the executable is
+        # shareable across bounds values / plan instances
+        b._cache = KernelCache(("RangePartitioning", fingerprint(bound),
+                                self.num_partitions))
         return b
 
     @staticmethod
@@ -197,35 +205,39 @@ class RangePartitioning(TpuPartitioning):
         bounds = self.bounds
         k = bounds.num_rows
 
-        def pid_fn(ctx, salt):
-            from spark_rapids_tpu.ops.sort_encode import encode_key_column
+        def pid_fn(ctx, salt, extra):
             # composite comparison row-vs-bound via pairwise key compare:
-            # pid = number of bounds strictly less-or-equal... we compute
-            # rank by comparing against each bound (k is small: <= nparts)
+            # pid = number of bounds strictly less-or-equal (k small)
+            bcols = extra
             keys = [o.expr.eval(ctx) for o in order]
             pid = jnp.zeros(ctx.capacity, jnp.int32)
             for bi in range(k):
-                le = _row_less_than_bound(keys, bounds, bi, order)
+                le = _row_less_than_bound(keys, bcols, bi, order)
                 # row > bound_bi -> belongs at least to partition bi+1
                 pid = jnp.where(le, pid, jnp.int32(bi + 1))
             return pid
 
+        bounds_sig = tuple(
+            (str(c.dtype), c.capacity,
+             c.char_cap if c.dtype.is_string else 0)
+            for c in bounds.columns)
         kern = _split_kernel_for(cache, batch, pid_fn, n,
-                                 ("range", k, id(self.bounds)))
+                                 ("range", k, bounds_sig))
         cols, counts = kern(batch.columns, jnp.int32(batch.num_rows),
-                            jnp.int32(0))
+                            jnp.int32(0), tuple(bounds.columns))
         return _slice_partitions(cols, np.asarray(counts), batch.schema,
                                  batch.capacity)
 
 
-def _row_less_than_bound(keys, bounds: ColumnarBatch, bi: int, order
-                         ) -> jnp.ndarray:
-    """row <= bound_bi under the sort order (null ordering included)."""
+def _row_less_than_bound(keys, bounds, bi: int, order) -> jnp.ndarray:
+    """row <= bound_bi under the sort order (null ordering included).
+    `bounds` is a ColumnarBatch or a sequence of its key ColumnVectors."""
     from spark_rapids_tpu.exprs.predicates import _compare
+    bcols = bounds.columns if hasattr(bounds, "columns") else bounds
     cap = keys[0].capacity
     lt_all = jnp.zeros(cap, bool)
     eq_all = jnp.ones(cap, bool)
-    for key_col, o, bcol in zip(keys, order, bounds.columns):
+    for key_col, o, bcol in zip(keys, order, bcols):
         bv = _broadcast_row(bcol, bi, cap)
         lt, eq = _compare(key_col, bv)
         if not o.ascending:

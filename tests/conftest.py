@@ -25,3 +25,15 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_kernel_cache():
+    """The process-global executable cache is sized for one workload's
+    operator set; across the whole suite it would accumulate every
+    module's executables (XLA:CPU clients segfault with thousands of
+    live loaded executables).  Clearing per module keeps each module's
+    hot-run reuse while bounding the live set."""
+    yield
+    from spark_rapids_tpu.exec.base import clear_kernel_cache
+    clear_kernel_cache()

@@ -1,0 +1,36 @@
+"""Shared CPU-vs-TPU result comparison (the reference's
+`SparkQueryCompareTestSuite.compareResults` / `asserts.py::_assert_equal`
+golden-rule helper, used by every workload parity suite)."""
+import numpy as np
+import pandas as pd
+
+
+def norm_frame(df: pd.DataFrame) -> pd.DataFrame:
+    """Row-set normalization: stringify object columns and sort by every
+    column so tie-order inside equal sort keys cannot fail a diff."""
+    out = df.copy()
+    for c in out.columns:
+        if out[c].dtype == object:
+            out[c] = out[c].astype(str)
+    return out.sort_values(list(out.columns), ignore_index=True)
+
+
+def compare_frames(expected: pd.DataFrame, got: pd.DataFrame,
+                   label: str = "", rtol: float = 1e-5,
+                   atol: float = 1e-6) -> None:
+    assert list(expected.columns) == list(got.columns), \
+        f"{label} columns {list(got.columns)}"
+    assert len(expected) == len(got), \
+        f"{label} rows: expected={len(expected)} got={len(got)}"
+    e, g = norm_frame(expected), norm_frame(got)
+    for name in e.columns:
+        ena, gna = e[name].isna().to_numpy(), g[name].isna().to_numpy()
+        np.testing.assert_array_equal(
+            ena, gna, err_msg=f"{label} nulls {name}")
+        ev, gv = e[name][~ena], g[name][~gna]
+        try:
+            np.testing.assert_allclose(
+                np.asarray(ev, dtype=float), np.asarray(gv, dtype=float),
+                rtol=rtol, atol=atol, err_msg=f"{label} col {name}")
+        except (ValueError, TypeError):
+            assert list(ev) == list(gv), f"{label} col {name}"

@@ -415,3 +415,60 @@ def test_failed_map_stage_cleans_catalog():
     with pytest.raises(RuntimeError):
         ex.execute_partitions()
     assert len(env.catalog) == 0  # completed task 0's buffers freed too
+
+
+# -- range partitioning above the small-input shortcut ----------------------
+def test_range_exchange_large_input_parity(monkeypatch):
+    """Exercises the real range path (bounds sampling + traced-bounds
+    split kernel): the small-input bailout is disabled so the sampled
+    bounds and per-row binary search actually run."""
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.exec.sort import desc
+    from spark_rapids_tpu.plan import nodes as N
+    from spark_rapids_tpu.plan.overrides import accelerate, collect
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+
+    monkeypatch.setattr(ShuffleExchangeExec, "SMALL_RANGE_INPUT_ROWS", 0)
+    rng = np.random.default_rng(17)
+    df = pd.DataFrame({
+        "k": rng.integers(-1000, 1000, 5000).astype(np.int64),
+        "v": rng.normal(size=5000)})
+    plan = N.CpuSort([desc(col("k"))],
+                     N.CpuSource.from_pandas(df, num_partitions=4))
+    expected = plan.collect()
+    got = collect(accelerate(
+        N.CpuSort([desc(col("k"))],
+                  N.CpuSource.from_pandas(df, num_partitions=4)),
+        C.RapidsConf()))
+    np.testing.assert_array_equal(expected["k"].to_numpy(),
+                                  got["k"].to_numpy())
+
+
+def test_range_exchange_via_manager(monkeypatch):
+    """Manager path + range partitioning with unset bounds (regression:
+    _sample_bounds signature drift broke this combination)."""
+    import numpy as np
+    import pandas as pd
+
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.exec.sort import asc
+    from spark_rapids_tpu.plan import nodes as N
+    from spark_rapids_tpu.plan.overrides import accelerate, collect
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+
+    monkeypatch.setattr(ShuffleExchangeExec, "SMALL_RANGE_INPUT_ROWS", 0)
+    rng = np.random.default_rng(23)
+    df = pd.DataFrame({"k": rng.integers(0, 500, 2000).astype(np.int64)})
+    conf = C.RapidsConf({"spark.rapids.shuffle.enabled": True})
+    expected = N.CpuSort([asc(col("k"))],
+                         N.CpuSource.from_pandas(df, 3)).collect()
+    got = collect(accelerate(
+        N.CpuSort([asc(col("k"))], N.CpuSource.from_pandas(df, 3)),
+        conf), conf)
+    np.testing.assert_array_equal(expected["k"].to_numpy(),
+                                  got["k"].to_numpy())

@@ -17,36 +17,11 @@ def tables():
     return gen_tables(np.random.default_rng(11), SCALE)
 
 
-def _norm(df: pd.DataFrame) -> pd.DataFrame:
-    """Row-set normalization: sort by every column so tie-order inside
-    equal sort keys cannot fail the diff."""
-    out = df.copy()
-    for c in out.columns:
-        if out[c].dtype == object:
-            out[c] = out[c].astype(str)
-    out = out.sort_values(list(out.columns), ignore_index=True)
-    return out
+from parity import compare_frames
 
 
 def _compare(expected: pd.DataFrame, got: pd.DataFrame, query: int):
-    assert list(expected.columns) == list(got.columns), \
-        f"q{query} columns {list(got.columns)}"
-    assert len(expected) == len(got), \
-        f"q{query} rows: cpu={len(expected)} tpu={len(got)}"
-    e, g = _norm(expected), _norm(got)
-    for name in e.columns:
-        ena = e[name].isna().to_numpy()
-        gna = g[name].isna().to_numpy()
-        np.testing.assert_array_equal(ena, gna,
-                                      err_msg=f"q{query} nulls {name}")
-        ev, gv = e[name][~ena], g[name][~gna]
-        try:
-            evf = np.asarray(ev, dtype=float)
-            gvf = np.asarray(gv, dtype=float)
-            np.testing.assert_allclose(evf, gvf, rtol=1e-5, atol=1e-6,
-                                       err_msg=f"q{query} col {name}")
-        except (ValueError, TypeError):
-            assert list(ev) == list(gv), f"q{query} col {name}"
+    compare_frames(expected, got, f"q{query}")
 
 
 @pytest.mark.parametrize("query", sorted(QUERIES))

@@ -1,0 +1,97 @@
+"""TPC-DS-like / TPCx-BB-like / Mortgage workload parity tests
+(reference `TpcdsLikeSpark` + `TpcxbbLikeSpark` + `MortgageSparkSuite`
+golden rule: CPU vs accelerated diff)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.models import mortgage, tpcxbb
+from spark_rapids_tpu.models import tpcds_data, tpcds_queries
+from spark_rapids_tpu.plan.overrides import accelerate, collect
+
+
+def tpu_conf():
+    return C.RapidsConf({
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.sql.incompatibleOps.enabled": True,
+    })
+
+
+def run_cpu(build_plan, t):
+    return build_plan(t, lambda p: p.collect()).collect()
+
+
+def run_tpu(build_plan, t):
+    conf = tpu_conf()
+
+    def run(p):
+        return collect(accelerate(p, conf), conf)
+    return run(build_plan(t, run))
+
+
+from parity import compare_frames as compare
+
+
+# -- TPC-DS -----------------------------------------------------------------
+@pytest.fixture(scope="module")
+def ds_tables():
+    return tpcds_data.gen_tables(np.random.default_rng(3), 8000)
+
+
+@pytest.mark.parametrize("name", sorted(tpcds_queries.QUERIES))
+def test_tpcds_parity(ds_tables, name):
+    fn = tpcds_queries.QUERIES[name]
+    expected = run_cpu(fn, tpcds_data.sources(ds_tables, 2))
+    assert len(expected) > 0, f"{name}: CPU result empty — data bug"
+    got = run_tpu(fn, tpcds_data.sources(ds_tables, 2))
+    compare(expected, got, name)
+
+
+# -- TPCx-BB ----------------------------------------------------------------
+@pytest.fixture(scope="module")
+def xbb_tables():
+    return tpcxbb.gen_tables(np.random.default_rng(4), 4000)
+
+
+@pytest.mark.parametrize("name", sorted(tpcxbb.QUERIES))
+def test_tpcxbb_parity(xbb_tables, name):
+    fn = tpcxbb.QUERIES[name]
+    expected = run_cpu(fn, tpcxbb.sources(xbb_tables, 2))
+    assert len(expected) > 0, f"{name}: CPU result empty — data bug"
+    got = run_tpu(fn, tpcxbb.sources(xbb_tables, 2))
+    compare(expected, got, name)
+
+
+# -- Mortgage ---------------------------------------------------------------
+@pytest.fixture(scope="module")
+def mtg_tables():
+    return mortgage.gen_tables(np.random.default_rng(5), loans=300,
+                               months=12)
+
+
+def test_mortgage_etl_parity(mtg_tables):
+    expected = mortgage.etl_plan(
+        mortgage.sources(mtg_tables, 2)).collect()
+    assert len(expected) == 300
+    conf = tpu_conf()
+    got = collect(accelerate(
+        mortgage.etl_plan(mortgage.sources(mtg_tables, 2)), conf), conf)
+    compare(expected, got, "mortgage-etl")
+
+
+def test_mortgage_summary_parity(mtg_tables):
+    expected = mortgage.summary_plan(
+        mortgage.sources(mtg_tables, 2)).collect()
+    assert len(expected) > 0
+    conf = tpu_conf()
+    got = collect(accelerate(
+        mortgage.summary_plan(mortgage.sources(mtg_tables, 2)), conf),
+        conf)
+    compare(expected, got, "mortgage-summary")
+
+
+def test_mortgage_delinquency_feature_sanity(mtg_tables):
+    out = mortgage.etl_plan(mortgage.sources(mtg_tables)).collect()
+    assert set(out["delinquency_12"].unique()) <= {0, 1}
+    assert (out["reporting_months"] == 12).all()
