@@ -206,13 +206,24 @@ def _strings_from_host(values: np.ndarray, validity_padded: np.ndarray,
             else (v if isinstance(v, (bytes, bytearray)) else
                   (str(v).encode("utf-8") if v is not None else b"")))
            for v in values]
-    max_len = max((len(e) for e in enc), default=0)
+    n = len(enc)
+    lens = np.fromiter((len(e) for e in enc), np.int32, count=n)
+    max_len = int(lens.max()) if n else 0
     cc = bucket_char_cap(max_len)
     data = np.zeros((cap, cc), np.uint8)
+    if n and lens.any():
+        # one pass: scatter the concatenated bytes into the padded
+        # matrix at vectorized flat offsets (the per-row copy loop was
+        # the hot spot of every host->device string upload)
+        flat = np.frombuffer(b"".join(enc), np.uint8)
+        starts = np.zeros(n, np.int64)
+        np.cumsum(lens[:-1], out=starts[1:])
+        row = np.repeat(np.arange(n, dtype=np.int64), lens)
+        off = np.arange(len(flat), dtype=np.int64) - np.repeat(starts,
+                                                               lens)
+        data.reshape(-1)[row * cc + off] = flat
     lengths = np.zeros(cap, np.int32)
-    for i, e in enumerate(enc):
-        data[i, : len(e)] = np.frombuffer(e, np.uint8)
-        lengths[i] = len(e)
+    lengths[:n] = lens
     lengths = np.where(validity_padded, lengths, 0).astype(np.int32)
     return ColumnVector(T.STRING, jnp.asarray(data),
                         jnp.asarray(validity_padded), jnp.asarray(lengths))

@@ -338,6 +338,13 @@ def fingerprint(obj) -> tuple:
     import enum as _enum
     if isinstance(obj, _enum.Enum):
         return ("enum", type(obj).__name__, obj.name)
-    # arbitrary values (numpy scalars, arrays in literals): repr is stable
-    # within a process, which is the cache's lifetime
+    if isinstance(obj, np.ndarray) or hasattr(obj, "tobytes"):
+        # full content hash: repr() truncates arrays >1000 elements, which
+        # would let different array literals share a compiled kernel
+        import hashlib
+        arr = np.asarray(obj)
+        h = hashlib.sha1(arr.tobytes()).hexdigest()
+        return ("arr", str(arr.dtype), arr.shape, h)
+    # other scalar-ish values: repr is stable within a process, which is
+    # the cache's lifetime
     return ("r", repr(obj))

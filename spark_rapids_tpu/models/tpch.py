@@ -122,22 +122,24 @@ def build_q1_kernel(capacity: int):
         disc_price = extprice * (1.0 - disc)
         charge = disc_price * (1.0 + tax)
         # group id = flag * 2 + status, 6 groups (static!)
-        gid = jnp.where(keep, flag * 2 + status, 8)
-        import jax
-        seg = lambda v: jax.ops.segment_sum(
-            jnp.where(keep, v, 0), gid, num_segments=8)
-        cnt = jax.ops.segment_sum(keep.astype(jnp.int32), gid,
-                                  num_segments=8)
-        sums = {
-            "sum_qty": seg(qty),
-            "sum_base_price": seg(extprice),
-            "sum_disc_price": seg(disc_price),
-            "sum_charge": seg(charge),
-            "sum_disc": seg(disc),
-        }
+        gid = jnp.where(keep, flag * 2 + status, 7)
+        # grouped reduction as ONE one-hot matmul on the MXU: scatter
+        # (segment_sum) serializes on TPU, but (cap x 6) values^T @
+        # (cap x 8) one-hot is a single systolic-array pass — the
+        # elementwise prologue fuses into the matmul's operand reads
+        onehot = (gid[:, None] == jnp.arange(8)[None, :]).astype(
+            jnp.float32)
+        # jnp.where, not multiply-by-mask: NaN in a filtered-out row
+        # must not poison the sums (NaN * 0 == NaN)
+        vals = jnp.where(
+            keep[:, None],
+            jnp.stack([qty, extprice, disc_price, charge, disc,
+                       jnp.ones_like(qty)], axis=1),
+            jnp.float32(0))
+        table = vals.T @ onehot  # (6 metrics, 8 groups)
         g = jnp.arange(8)
-        return (g // 2, g % 2, sums["sum_qty"], sums["sum_base_price"],
-                sums["sum_disc_price"], sums["sum_charge"],
-                sums["sum_disc"], cnt)
+        cnt = table[5].astype(jnp.int32)
+        return (g // 2, g % 2, table[0], table[1], table[2], table[3],
+                table[4], cnt)
 
     return q1_step

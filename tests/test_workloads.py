@@ -12,10 +12,8 @@ from spark_rapids_tpu.plan.overrides import accelerate, collect
 
 
 def tpu_conf():
-    return C.RapidsConf({
-        "spark.rapids.sql.variableFloatAgg.enabled": True,
-        "spark.rapids.sql.incompatibleOps.enabled": True,
-    })
+    from spark_rapids_tpu.models.tpch_bench import BENCH_CONF
+    return C.RapidsConf(dict(BENCH_CONF))
 
 
 def run_cpu(build_plan, t):
