@@ -45,12 +45,21 @@ def main():
     df = batch.to_pandas()
     exp = q1_reference_pandas(df)
     got_cnt = np.asarray(out[7])
-    exp_by_group = {(int(r["l_returnflag"]), int(r["l_linestatus"])):
-                    int(r["count_order"]) for _, r in exp.iterrows()}
+    got_base = np.asarray(out[3], dtype=np.float64)
+    exp_rows = {(int(r["l_returnflag"]), int(r["l_linestatus"])): r
+                for _, r in exp.iterrows()}
     for g in range(6):
         flag, status = g // 2, g % 2
-        assert got_cnt[g] == exp_by_group.get((flag, status), 0), \
-            f"group {g}: {got_cnt[g]} != {exp_by_group.get((flag, status))}"
+        row = exp_rows.get((flag, status))
+        exp_cnt = int(row["count_order"]) if row is not None else 0
+        assert got_cnt[g] == exp_cnt, \
+            f"group {g}: count {got_cnt[g]} != {exp_cnt}"
+        if row is not None:
+            # sums too: a low-precision reduction must fail loudly
+            rel = abs(got_base[g] - row["sum_base_price"]) / max(
+                abs(row["sum_base_price"]), 1.0)
+            assert rel < 1e-4, \
+                f"group {g}: sum_base_price rel err {rel:.2e}"
 
     # hot runs
     times = []

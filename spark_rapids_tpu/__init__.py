@@ -11,9 +11,27 @@ Spark parity requires 64-bit longs/doubles, so x64 is enabled at import
 (the reference's cuDF kernels are 64-bit native; on TPU f64 is emulated --
 performance-sensitive pipelines should prefer f32/bf16 columns).
 """
+import os as _os
+
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA executable cache: sort-heavy kernels take 10-100s to
+# compile on TPU, but compiled artifacts round-trip the disk cache across
+# processes (verified through the axon tunnel), so cold starts are paid
+# once per machine.  Opt out with SPARK_RAPIDS_TPU_NO_COMPILE_CACHE=1 or
+# override the standard JAX_COMPILATION_CACHE_DIR.
+if not _os.environ.get("SPARK_RAPIDS_TPU_NO_COMPILE_CACHE"):
+    _cache_dir = _os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        _os.path.expanduser("~/.cache/spark_rapids_tpu/xla"))
+    try:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           2.0)
+    except Exception:  # older jax without the knobs: in-memory only
+        pass
 
 __version__ = "0.2.0"
 

@@ -78,6 +78,13 @@ EXPORT_COLUMNAR_RDD = conf("spark.rapids.sql.exportColumnarRdd", False,
 SPARK_VERSION = conf("spark.rapids.tpu.sparkVersion", "3.0.1",
                      "Spark version the session emulates; selects the "
                      "shim set (reference ShimLoader.scala:26-61).")
+MAX_BATCH_ROWS = conf("spark.rapids.tpu.batchMaxRows", 65536,
+                      "Row cap per device batch at upload/scan/coalesce "
+                      "boundaries.  Bounds the set of compiled kernel "
+                      "shapes: every operator compiles at a few bucketed "
+                      "capacities <= this and streams larger data as "
+                      "multiple batches (XLA:TPU sort compile time grows "
+                      "steeply with capacity).")
 PRUNE_COLUMNS = conf("spark.rapids.tpu.columnPruning.enabled", True,
                      "Prune unreferenced columns at scan/source leaves "
                      "before plan rewrite (the role Catalyst's "

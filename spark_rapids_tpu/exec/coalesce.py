@@ -18,8 +18,11 @@ from spark_rapids_tpu.utils import metrics as M
 def coalesce_iterator(batches: Iterator[ColumnarBatch],
                       goal: CoalesceGoal,
                       schema: T.Schema,
-                      metrics) -> Iterator[ColumnarBatch]:
-    """The AbstractGpuCoalesceIterator analog."""
+                      metrics,
+                      max_rows: int = None) -> Iterator[ColumnarBatch]:
+    """The AbstractGpuCoalesceIterator analog.  `max_rows` (resolved by
+    the caller at plan time — the draining thread may not carry the
+    session conf) caps emitted batch row counts for TargetSize goals."""
     if isinstance(goal, RequireSingleBatch):
         got = [b for b in batches if b.num_rows > 0]
         if not got:
@@ -33,19 +36,32 @@ def coalesce_iterator(batches: Iterator[ColumnarBatch],
         return
 
     target = goal.bytes if isinstance(goal, TargetSize) else 1 << 31
+    if max_rows is None:
+        from spark_rapids_tpu import config as C
+        max_rows = C.get_active_conf()[C.MAX_BATCH_ROWS]
     pending: list[ColumnarBatch] = []
     pending_bytes = 0
-    for b in batches:
+    pending_rows = 0
+    for big in batches:
         metrics.add(M.NUM_INPUT_BATCHES, 1)
-        metrics.add(M.NUM_INPUT_ROWS, b.num_rows)
-        if b.num_rows == 0:
+        metrics.add(M.NUM_INPUT_ROWS, big.num_rows)
+        if big.num_rows == 0:
             continue
-        est = _row_bytes(b) * b.num_rows
-        if pending and pending_bytes + est > target:
-            yield _emit(pending, metrics)
-            pending, pending_bytes = [], 0
-        pending.append(b)
-        pending_bytes += est
+        # row cap keeps capacities inside the bounded bucket set so
+        # downstream kernels reuse compiled shapes; oversized batches
+        # (row-expanding joins/expand) are sliced, not forwarded
+        pieces = ([big] if big.num_rows <= max_rows else
+                  [big.slice(lo, min(max_rows, big.num_rows - lo))
+                   for lo in range(0, big.num_rows, max_rows)])
+        for b in pieces:
+            est = _row_bytes(b) * b.num_rows
+            if pending and (pending_bytes + est > target or
+                            pending_rows + b.num_rows > max_rows):
+                yield _emit(pending, metrics)
+                pending, pending_bytes, pending_rows = [], 0, 0
+            pending.append(b)
+            pending_bytes += est
+            pending_rows += b.num_rows
     if pending:
         yield _emit(pending, metrics)
 
@@ -84,6 +100,9 @@ class CoalesceBatchesExec(UnaryExecBase):
     def __init__(self, goal: CoalesceGoal, child: TpuExec):
         super().__init__(child)
         self.goal = goal
+        from spark_rapids_tpu import config as C
+        # resolved at plan time: the draining thread may not carry conf
+        self._max_rows = C.get_active_conf()[C.MAX_BATCH_ROWS]
 
     def output_schema(self):
         return self.child.output_schema()
@@ -93,4 +112,5 @@ class CoalesceBatchesExec(UnaryExecBase):
 
     def process_partition(self, batches):
         return coalesce_iterator(batches, self.goal,
-                                 self.output_schema(), self.metrics)
+                                 self.output_schema(), self.metrics,
+                                 max_rows=self._max_rows)

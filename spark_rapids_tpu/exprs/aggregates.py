@@ -45,6 +45,24 @@ def _seg_sum(vals, seg, n):
     return jax.ops.segment_sum(vals, seg, num_segments=n)
 
 
+def _sorted_seg_sum(vals, seg, n):
+    """Segment sum for NON-DECREASING `seg` (the exec feeds rows sorted
+    by group key): cumsum + vectorized binary-search gathers instead of
+    a scatter, which serializes on TPU.  Invalid rows must already be
+    value-zeroed (they may share the last group's id).  Integer sums
+    stay exact even if the running cumsum wraps (two's-complement
+    wraparound cancels in the difference); float sums reorder additions,
+    which the variableFloatAgg gate already licenses."""
+    c = jnp.cumsum(vals)
+    idx = jnp.arange(n)
+    hi = jnp.searchsorted(seg, idx, side="right")
+    lo = jnp.searchsorted(seg, idx, side="left")
+    last = vals.shape[0] - 1
+    chi = jnp.where(hi > 0, jnp.take(c, jnp.clip(hi - 1, 0, last)), 0)
+    clo = jnp.where(lo > 0, jnp.take(c, jnp.clip(lo - 1, 0, last)), 0)
+    return chi - clo
+
+
 def _seg_min(vals, seg, n):
     return jax.ops.segment_min(vals, seg, num_segments=n)
 
@@ -132,17 +150,19 @@ class Sum(AggregateFunction):
         dt = _sum_type(v.dtype)
         acc = v.data.astype(dt.storage_dtype)
         ok = v.validity & ctx.row_valid
-        seg = _drop_invalid(ctx.seg_ids, ok, ctx.capacity)
-        s = _seg_sum(jnp.where(ok, acc, 0), seg, ctx.capacity)
-        cnt = _seg_sum(ok.astype(jnp.int64), seg, ctx.capacity)
+        s = _sorted_seg_sum(jnp.where(ok, acc, 0), ctx.seg_ids,
+                            ctx.capacity)
+        cnt = _sorted_seg_sum(ok.astype(jnp.int64), ctx.seg_ids,
+                              ctx.capacity)
         return (ColumnVector(dt, s, cnt > 0),)
 
     def merge(self, ctx, partials):
         (p,) = partials
         ok = p.validity & ctx.row_valid
-        seg = _drop_invalid(ctx.seg_ids, ok, ctx.capacity)
-        s = _seg_sum(jnp.where(ok, p.data, 0), seg, ctx.capacity)
-        cnt = _seg_sum(ok.astype(jnp.int64), seg, ctx.capacity)
+        s = _sorted_seg_sum(jnp.where(ok, p.data, 0), ctx.seg_ids,
+                            ctx.capacity)
+        cnt = _sorted_seg_sum(ok.astype(jnp.int64), ctx.seg_ids,
+                              ctx.capacity)
         return (ColumnVector(p.dtype, s, cnt > 0),)
 
     def evaluate(self, partials, schema):
@@ -165,15 +185,15 @@ class Count(AggregateFunction):
             ok = ctx.row_valid
         else:
             ok = inputs[0].validity & ctx.row_valid
-        seg = _drop_invalid(ctx.seg_ids, ok, ctx.capacity)
-        c = _seg_sum(ok.astype(jnp.int64), seg, ctx.capacity)
+        c = _sorted_seg_sum(ok.astype(jnp.int64), ctx.seg_ids,
+                            ctx.capacity)
         return (ColumnVector(T.INT64, c, jnp.ones(ctx.capacity, bool)),)
 
     def merge(self, ctx, partials):
         (p,) = partials
         ok = p.validity & ctx.row_valid
-        seg = _drop_invalid(ctx.seg_ids, ok, ctx.capacity)
-        c = _seg_sum(jnp.where(ok, p.data, 0), seg, ctx.capacity)
+        c = _sorted_seg_sum(jnp.where(ok, p.data, 0), ctx.seg_ids,
+                            ctx.capacity)
         return (ColumnVector(T.INT64, c, jnp.ones(ctx.capacity, bool)),)
 
     def evaluate(self, partials, schema):
@@ -301,10 +321,11 @@ class Average(AggregateFunction):
     def update(self, ctx, inputs):
         (v,) = inputs
         ok = v.validity & ctx.row_valid
-        seg = _drop_invalid(ctx.seg_ids, ok, ctx.capacity)
-        s = _seg_sum(jnp.where(ok, v.data.astype(jnp.float64), 0.0),
-                     seg, ctx.capacity)
-        c = _seg_sum(ok.astype(jnp.int64), seg, ctx.capacity)
+        s = _sorted_seg_sum(
+            jnp.where(ok, v.data.astype(jnp.float64), 0.0),
+            ctx.seg_ids, ctx.capacity)
+        c = _sorted_seg_sum(ok.astype(jnp.int64), ctx.seg_ids,
+                            ctx.capacity)
         always = jnp.ones(ctx.capacity, bool)
         return (ColumnVector(T.FLOAT64, s, always),
                 ColumnVector(T.INT64, c, always))
@@ -312,9 +333,10 @@ class Average(AggregateFunction):
     def merge(self, ctx, partials):
         s_p, c_p = partials
         ok = ctx.row_valid
-        seg = _drop_invalid(ctx.seg_ids, ok, ctx.capacity)
-        s = _seg_sum(jnp.where(ok, s_p.data, 0.0), seg, ctx.capacity)
-        c = _seg_sum(jnp.where(ok, c_p.data, 0), seg, ctx.capacity)
+        s = _sorted_seg_sum(jnp.where(ok, s_p.data, 0.0), ctx.seg_ids,
+                            ctx.capacity)
+        c = _sorted_seg_sum(jnp.where(ok, c_p.data, 0), ctx.seg_ids,
+                            ctx.capacity)
         always = jnp.ones(ctx.capacity, bool)
         return (ColumnVector(T.FLOAT64, s, always),
                 ColumnVector(T.INT64, c, always))

@@ -226,7 +226,8 @@ class MultiFileCoalescingReader:
     def __iter__(self) -> Iterator[ColumnarBatch]:
         import time
         num_threads = self.conf[C.MULTITHREAD_READ_NUM_THREADS]
-        max_rows = self.conf[C.MAX_READER_BATCH_ROWS]
+        max_rows = min(self.conf[C.MAX_READER_BATCH_ROWS],
+                       self.conf[C.MAX_BATCH_ROWS])
         max_bytes = self.conf[C.MAX_READER_BATCH_BYTES]
         pool = _buffering_pool(num_threads)
         t0 = time.monotonic()

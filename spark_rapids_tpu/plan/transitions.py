@@ -96,15 +96,21 @@ class RowToColumnarExec(LeafExec):
         return f"RowToColumnarExec\n{self.cpu_child.tree_string(1)}"
 
     def execute_partitions(self):
+        max_rows = C.get_active_conf()[C.MAX_BATCH_ROWS]
+
         def convert(it):
             for df in it:
                 if not len(df):
                     continue
-                with self.metrics.timed(M.TOTAL_TIME):
-                    TpuSemaphore.get().acquire_if_necessary()
-                    b = batch_from_df(df, self._schema)
-                    self.update_output_metrics(b)
-                yield b
+                # chunk BEFORE upload so device batch capacities stay in
+                # the bounded bucketed set (one compile serves them all)
+                for lo in range(0, len(df), max_rows):
+                    chunk = df.iloc[lo:lo + max_rows]
+                    with self.metrics.timed(M.TOTAL_TIME):
+                        TpuSemaphore.get().acquire_if_necessary()
+                        b = batch_from_df(chunk, self._schema)
+                        self.update_output_metrics(b)
+                    yield b
         return [convert(it) for it in self.cpu_child.execute()]
 
     def execute_columnar(self):
