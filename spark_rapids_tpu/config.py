@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from contextlib import contextmanager
 from typing import Any, Callable, Optional
 
 _REGISTRY: dict[str, "ConfEntry"] = {}
@@ -161,12 +162,29 @@ SHUFFLE_BOUNCE_BUFFER_COUNT = conf(
     "Number of staging buffers per transport direction.")
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.shuffle.compression.codec", "none",
-    "Codec for shuffle payloads: none, copy (testing), lz4-host.")
+    "Codec for serialized shuffle payloads on the transport wire: "
+    "none, copy (testing), lz4, zstd.")
 
 # --- python / udf -----------------------------------------------------------
 PYTHON_CONCURRENT_WORKERS = conf(
     "spark.rapids.python.concurrentPythonWorkers", 0,
     "Cap on concurrent accelerated python UDF workers (0 = unlimited).")
+PYTHON_DAEMON_ENABLED = conf(
+    "spark.rapids.python.daemon.enabled", False,
+    "Run vectorized python UDFs in out-of-process daemon workers "
+    "(Arrow IPC over pipes) instead of in-process — process isolation "
+    "at one host round-trip of cost (reference python/rapids/daemon.py).")
+PYTHON_ON_TPU = conf(
+    "spark.rapids.python.onTpu.enabled", False,
+    "Allow daemon UDF workers to initialize the TPU platform; off by "
+    "default because the chip is single-process and belongs to the "
+    "executor (reference RAPIDS_PYTHON_ENABLED gate, "
+    "python/rapids/worker.py:22-30).")
+PYTHON_MEM_LIMIT = conf(
+    "spark.rapids.python.memory.limitBytes", 0,
+    "Address-space rlimit per daemon UDF worker, 0 = unlimited (the "
+    "role of the reference's per-worker RMM pool size, "
+    "python/rapids/worker.py:34-50).")
 UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled", True,
                             "Compile Python UDF bytecode to expressions.")
 
@@ -251,6 +269,24 @@ def get_active_conf() -> RapidsConf:
 
 def set_active_conf(conf_: RapidsConf) -> None:
     _active.conf = conf_
+
+
+@contextmanager
+def session(conf_: Optional[RapidsConf]):
+    """Install `conf_` as the active conf for the duration (the
+    driver-side analog of Spark's session-scoped SQLConf: plan-time conf
+    decisions and run-time conf reads see the same values —
+    GpuOverrides.scala:1885 reads conf at plan time; our collect()
+    installs the plan's conf for execution)."""
+    if conf_ is None:
+        yield
+        return
+    prev = getattr(_active, "conf", None)
+    _active.conf = conf_
+    try:
+        yield
+    finally:
+        _active.conf = prev
 
 
 def help_text() -> str:

@@ -50,9 +50,11 @@ def coalesce_iterator(batches: Iterator[ColumnarBatch],
         # row cap keeps capacities inside the bounded bucket set so
         # downstream kernels reuse compiled shapes; oversized batches
         # (row-expanding joins/expand) are sliced, not forwarded
-        pieces = ([big] if big.num_rows <= max_rows else
-                  [big.slice(lo, min(max_rows, big.num_rows - lo))
-                   for lo in range(0, big.num_rows, max_rows)])
+        # lazy slicing: materializing every slice up front would hold a
+        # second full copy of an oversized batch on device at once
+        pieces = ((big,) if big.num_rows <= max_rows else
+                  (big.slice(lo, min(max_rows, big.num_rows - lo))
+                   for lo in range(0, big.num_rows, max_rows)))
         for b in pieces:
             est = _row_bytes(b) * b.num_rows
             if pending and (pending_bytes + est > target or
@@ -97,12 +99,16 @@ class CoalesceBatchesExec(UnaryExecBase):
     """Reference GpuCoalesceBatches exec node, inserted by the transition
     pass per each operator's childrenCoalesceGoal."""
 
-    def __init__(self, goal: CoalesceGoal, child: TpuExec):
+    def __init__(self, goal: CoalesceGoal, child: TpuExec,
+                 max_rows: "Optional[int]" = None):
         super().__init__(child)
         self.goal = goal
         from spark_rapids_tpu import config as C
-        # resolved at plan time: the draining thread may not carry conf
-        self._max_rows = C.get_active_conf()[C.MAX_BATCH_ROWS]
+        # the session conf's cap is passed by the transition pass;
+        # resolved at plan time because the draining thread may not
+        # carry the conf
+        self._max_rows = (max_rows if max_rows is not None
+                          else C.get_active_conf()[C.MAX_BATCH_ROWS])
 
     def output_schema(self):
         return self.child.output_schema()

@@ -51,8 +51,13 @@ def _sorted_seg_sum(vals, seg, n):
     a scatter, which serializes on TPU.  Invalid rows must already be
     value-zeroed (they may share the last group's id).  Integer sums
     stay exact even if the running cumsum wraps (two's-complement
-    wraparound cancels in the difference); float sums reorder additions,
-    which the variableFloatAgg gate already licenses."""
+    wraparound cancels in the difference).  Floats take the scatter
+    path: a global cumsum difference cancels catastrophically when group
+    magnitudes differ (a ~1e16 group steals every smaller group's
+    precision), which is beyond the reordering the variableFloatAgg gate
+    licenses."""
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        return _seg_sum(vals, seg, n)
     c = jnp.cumsum(vals)
     idx = jnp.arange(n)
     hi = jnp.searchsorted(seg, idx, side="right")

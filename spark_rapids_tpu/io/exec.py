@@ -34,6 +34,9 @@ from spark_rapids_tpu.plan.nodes import CpuNode, normalize_df
 def make_format(file_format: str, schema: Optional[T.Schema] = None,
                 options=None) -> FormatReader:
     if file_format == "parquet":
+        # the hybrid-calendar read mode is frozen from the session conf
+        # by FormatReader.resolve_session at execution time (reference
+        # GpuParquetScan.scala:225-226)
         return ParquetFormat()
     if file_format == "orc":
         return OrcFormat()

@@ -21,6 +21,7 @@ from typing import Optional
 
 from spark_rapids_tpu import types as T
 from spark_rapids_tpu.io import pushdown as PD
+from spark_rapids_tpu.io import rebase as RB
 from spark_rapids_tpu.io.scan import FileSplit, FormatReader
 
 
@@ -59,6 +60,30 @@ def _stats_of_row_group(rg, names: list[str]) -> dict[str, PD.ColumnStats]:
 class ParquetFormat(FormatReader):
     extension = ".parquet"
 
+    def __init__(self, rebase_mode: Optional[str] = None):
+        # None = resolve from the active session conf at read time (the
+        # conf collect() installs), via the shim-variant key
+        self._explicit_rebase_mode = (
+            None if rebase_mode is None else RB.normalize_mode(rebase_mode))
+
+    @property
+    def rebase_mode(self) -> str:
+        if self._explicit_rebase_mode is not None:
+            return self._explicit_rebase_mode
+        from spark_rapids_tpu import config as C
+        return self._mode_from_conf(C.get_active_conf())
+
+    @staticmethod
+    def _mode_from_conf(conf) -> str:
+        from spark_rapids_tpu.shims import current_shims
+        key = current_shims(conf).parquet_rebase_read_key()
+        return RB.normalize_mode(conf.get(key, "EXCEPTION"))
+
+    def resolve_session(self, conf) -> "ParquetFormat":
+        if self._explicit_rebase_mode is not None:
+            return self
+        return ParquetFormat(self._mode_from_conf(conf))
+
     def file_schema(self, path: str) -> T.Schema:
         import pyarrow.parquet as pq
         sch = pq.read_schema(path)
@@ -86,8 +111,10 @@ class ParquetFormat(FormatReader):
             keep.append(rg_idx)
         if not keep:
             return None
-        return f.read_row_groups(keep, columns=names or None,
-                                 use_threads=False)
+        table = f.read_row_groups(keep, columns=names or None,
+                                  use_threads=False)
+        return RB.apply_read_rebase(table, md.metadata, self.rebase_mode,
+                                    "Parquet")
 
 
 # ---------------------------------------------------------------------------
@@ -99,6 +126,14 @@ _PA_COMPRESSION = {"none": "NONE", "uncompressed": "NONE", "snappy": "SNAPPY",
 @dataclasses.dataclass
 class ParquetWriterOptions:
     compression: str = "snappy"
+    # None = resolve from the session conf via the shim-variant key
+    # (spark.sql.legacy.parquet.datetimeRebaseModeInWrite and friends)
+    rebase_mode: Optional[str] = None
+
+
+# the version stamp makes readers' corrected-mode detection recognize our
+# files (reference GpuParquetScan.scala:195-197; Spark stamps the same
+# keys); it follows the emulated session version
 
 
 class ParquetColumnarWriter:
@@ -117,15 +152,34 @@ class ParquetColumnarWriter:
         if codec is None:
             raise ValueError(
                 f"unsupported parquet compression {opts.compression}")
+        from spark_rapids_tpu import config as C
+        conf = C.get_active_conf()
+        mode = opts.rebase_mode
+        if mode is None:
+            from spark_rapids_tpu.shims import current_shims
+            key = current_shims(conf).parquet_rebase_write_key()
+            mode = conf.get(key, "EXCEPTION")
+        self.rebase_mode = RB.normalize_mode(mode)
+        if self.rebase_mode not in RB.READ_MODES:
+            raise ValueError(
+                f"unrecognized datetime rebase mode {mode}")
+        meta = {RB.SPARK_VERSION_METADATA_KEY:
+                str(conf[C.SPARK_VERSION]).encode("utf-8")}
+        if self.rebase_mode == "LEGACY":
+            meta[RB.SPARK_LEGACY_DATETIME_KEY] = b""
         self._arrow_schema = pa.schema(
             [pa.field(f.name, T.to_arrow(f.dtype)) for f in schema.fields])
-        self._writer = pq.ParquetWriter(path, self._arrow_schema,
-                                        compression=codec.lower())
+        self._writer = pq.ParquetWriter(
+            path, self._arrow_schema.with_metadata(meta),
+            compression=codec.lower())
         self.rows_written = 0
         self.bytes_written = 0
 
     def write_batch(self, batch) -> None:
+        RB.check_batch_write(batch, self.rebase_mode, "Parquet")
         table = batch.to_arrow().cast(self._arrow_schema)
+        if self.rebase_mode == "LEGACY":
+            table = RB.rebase_arrow_table_write(table)
         self._writer.write_table(table)
         self.rows_written += batch.num_rows
 

@@ -187,6 +187,12 @@ class FormatReader:
     def file_schema(self, path: str) -> T.Schema:
         raise NotImplementedError
 
+    def resolve_session(self, conf: C.RapidsConf) -> "FormatReader":
+        """Freeze conf-dependent reader state before dispatch to the
+        buffering pool (the active conf is thread-local and does not
+        reach pool threads).  Default: nothing to freeze."""
+        return self
+
 
 _POOL_LOCK = threading.Lock()
 _POOLS: dict[int, concurrent.futures.ThreadPoolExecutor] = {}
@@ -215,7 +221,7 @@ class MultiFileCoalescingReader:
     def __init__(self, reader: FormatReader, partition: FilePartition,
                  read_schema: T.Schema, part_schema: T.Schema,
                  filter_expr, conf: C.RapidsConf, metrics=None):
-        self.reader = reader
+        self.reader = reader.resolve_session(conf)
         self.partition = partition
         self.read_schema = read_schema
         self.part_schema = part_schema

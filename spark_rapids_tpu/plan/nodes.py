@@ -78,10 +78,20 @@ def empty_df(schema: T.Schema) -> pd.DataFrame:
 
 
 def normalize_df(df: pd.DataFrame, schema: T.Schema) -> pd.DataFrame:
-    """Coerce columns to the schema's nullable dtypes."""
+    """Coerce columns to the schema's nullable dtypes.  Date columns
+    arriving as python `datetime.date` objects convert to the engine's
+    int32 days-since-epoch storage."""
+    import datetime as _dt
     out = {}
     for f in schema.fields:
         s = df[f.name]
+        if f.dtype.id == T.TypeId.DATE32 and s.dtype == object:
+            epoch = _dt.date(1970, 1, 1)
+            s = pd.array(
+                [None if pd.isna(v) else (v - epoch).days for v in s],
+                "Int32")
+            out[f.name] = pd.Series(s, index=df.index)
+            continue
         want = nullable_dtype(f.dtype)
         if str(s.dtype) != want:
             try:
@@ -141,7 +151,16 @@ def schema_of_df(df: pd.DataFrame) -> T.Schema:
         elif kind == "f":
             fields.append(T.Field(name, T.from_numpy_dtype(s.dtype)))
         else:
-            fields.append(T.Field(name, T.STRING))
+            # Spark infers DateType from python date objects
+            import datetime as _dt
+            non_null = s.dropna()
+            if len(non_null) and all(
+                    isinstance(v, _dt.date)
+                    and not isinstance(v, _dt.datetime)
+                    for v in non_null):
+                fields.append(T.Field(name, T.DATE32))
+            else:
+                fields.append(T.Field(name, T.STRING))
     return T.Schema(tuple(fields))
 
 

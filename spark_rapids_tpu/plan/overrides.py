@@ -347,15 +347,21 @@ def _tag_file_scan(meta) -> None:
         for reason in node.scan.reader.options.tag_unsupported():
             meta.will_not_work_on_tpu(f"CSV: {reason}")
     if fmt == "parquet":
-        # hybrid-calendar (julian/gregorian) rebase is CPU-only
-        # (reference GpuParquetScan.scala:1108-1115); the conf key is
+        # hybrid-calendar (julian/gregorian) rebase is CPU-only: the CPU
+        # fallback engine performs the actual Julian rebase (io/rebase.py)
+        # while EXCEPTION/CORRECTED stay accelerated (reference
+        # GpuParquetScan.scala:151-158,1108-1115); the conf key is
         # version-variant, so it routes through the shim layer
+        from spark_rapids_tpu.io import rebase as RB
         from spark_rapids_tpu.shims import current_shims
         key = current_shims(meta.conf).parquet_rebase_read_key()
-        mode = str(meta.conf.get(key, "CORRECTED")).upper()
-        if mode in ("LEGACY", "TRUE"):
+        mode = RB.normalize_mode(meta.conf.get(key, "EXCEPTION"))
+        if mode == "LEGACY":
             meta.will_not_work_on_tpu(
                 f"legacy datetime rebase requested via {key}")
+        elif mode not in RB.READ_MODES:
+            meta.will_not_work_on_tpu(
+                f"{mode} is not a supported read rebase mode")
 
 
 def _conv_file_scan(meta, kids) -> TpuExec:
@@ -377,11 +383,40 @@ def _tag_write_files(meta) -> None:
     elif not meta.conf[write_conf]:
         meta.will_not_work_on_tpu(
             f"{node.file_format} writes disabled by {write_conf.key}")
+    if node.file_format == "parquet":
+        # LEGACY rebase writes stay on the CPU engine, which performs the
+        # Gregorian->Julian rebase (reference GpuParquetFileFormat.scala:83)
+        from spark_rapids_tpu.io import rebase as RB
+        from spark_rapids_tpu.shims import current_shims
+        key = current_shims(meta.conf).parquet_rebase_write_key()
+        mode = RB.normalize_mode(meta.conf.get(key, "EXCEPTION"))
+        if mode == "LEGACY":
+            meta.will_not_work_on_tpu(
+                "LEGACY rebase mode for dates and timestamps "
+                f"requested via {key}")
+        elif mode not in RB.READ_MODES:
+            meta.will_not_work_on_tpu(
+                f"{mode} is not a supported write rebase mode")
 
 
 def _conv_write_files(meta, kids) -> TpuExec:
+    import copy
     from spark_rapids_tpu.io.exec import TpuWriteFilesExec
-    return TpuWriteFilesExec(meta.node, kids[0])
+    node = meta.node
+    if node.file_format == "parquet":
+        # freeze the session's rebase mode into the writer options so
+        # execution doesn't depend on the active conf at run time
+        import dataclasses
+        from spark_rapids_tpu.io import rebase as RB
+        from spark_rapids_tpu.io.parquet import ParquetWriterOptions
+        from spark_rapids_tpu.shims import current_shims
+        opts = node.options or ParquetWriterOptions()
+        if opts.rebase_mode is None:
+            key = current_shims(meta.conf).parquet_rebase_write_key()
+            mode = RB.normalize_mode(meta.conf.get(key, "EXCEPTION"))
+            node = copy.copy(node)
+            node.options = dataclasses.replace(opts, rebase_mode=mode)
+    return TpuWriteFilesExec(node, kids[0])
 
 
 _io_rules_registered = False
@@ -414,8 +449,11 @@ def _tag_pandas_exec(meta) -> None:
 
 def _register_pyudf_rules() -> None:
     from spark_rapids_tpu.pyudf.exec import (
-        ArrowEvalPythonExec, CpuArrowEvalPython, CpuMapInPandas,
-        MapInPandasExec)
+        AggregateInPandasExec, ArrowEvalPythonExec, CpuAggregateInPandas,
+        CpuArrowEvalPython, CpuFlatMapCoGroupsInPandas,
+        CpuFlatMapGroupsInPandas, CpuMapInPandas, CpuWindowInPandas,
+        FlatMapCoGroupsInPandasExec, FlatMapGroupsInPandasExec,
+        MapInPandasExec, WindowInPandasExec)
     register_exec(
         CpuArrowEvalPython, "vectorized python UDF evaluation",
         lambda meta, kids: ArrowEvalPythonExec(meta.node.udfs, kids[0]),
@@ -424,6 +462,25 @@ def _register_pyudf_rules() -> None:
     register_exec(
         CpuMapInPandas, "mapInPandas",
         lambda meta, kids: MapInPandasExec(meta.node, kids[0]),
+        tag_extra=_tag_pandas_exec)
+    register_exec(
+        CpuFlatMapGroupsInPandas, "grouped applyInPandas",
+        lambda meta, kids: FlatMapGroupsInPandasExec(meta.node, kids[0]),
+        tag_extra=_tag_pandas_exec)
+    register_exec(
+        CpuAggregateInPandas, "grouped aggregate pandas UDF",
+        lambda meta, kids: AggregateInPandasExec(meta.node, kids[0]),
+        exprs_of=lambda n: [a for u in n.udfs for a in u.args],
+        tag_extra=_tag_pandas_exec)
+    register_exec(
+        CpuWindowInPandas, "window pandas UDF",
+        lambda meta, kids: WindowInPandasExec(meta.node, kids[0]),
+        exprs_of=lambda n: [a for u in n.udfs for a in u.args],
+        tag_extra=_tag_pandas_exec)
+    register_exec(
+        CpuFlatMapCoGroupsInPandas, "cogrouped applyInPandas",
+        lambda meta, kids: FlatMapCoGroupsInPandasExec(
+            meta.node, kids[0], kids[1]),
         tag_extra=_tag_pandas_exec)
 
 
@@ -511,7 +568,8 @@ def accelerate(cpu_plan: N.CpuNode,
         plan = insert_coalesce(plan, conf)
     else:
         plan = optimize_transitions(plan)
-        _coalesce_cpu_islands(plan, TargetSize(conf[C.BATCH_SIZE_BYTES]))
+        _coalesce_cpu_islands(plan, TargetSize(conf[C.BATCH_SIZE_BYTES]),
+                              conf[C.MAX_BATCH_ROWS])
     if conf[C.TEST_ENABLED]:
         from spark_rapids_tpu.plan.transitions import assert_is_on_tpu
         allowed = {s for s in
@@ -519,6 +577,14 @@ def accelerate(cpu_plan: N.CpuNode,
         assert_is_on_tpu(plan, allowed)
     ExecutionPlanCapture.last_plan = plan
     ExecutionPlanCapture.last_meta = meta
+    # carry the session conf to execution: collect() re-installs it so
+    # run-time conf reads agree with plan-time decisions.  Re-accelerating
+    # the SAME plan object under another conf re-stamps it (last wins) —
+    # the session-global conf model of the reference.
+    try:
+        plan._session_conf = conf
+    except AttributeError:
+        pass  # frozen/slots nodes keep their creation conf
     return plan
 
 
@@ -527,7 +593,13 @@ def collect(plan, conf: Optional[C.RapidsConf] = None) -> "object":
     DataFrame — the driver-side collect.  With spark.sql.adaptive.enabled,
     fully-TPU plans are executed stage-at-a-time with runtime re-planning
     (plan/aqe.py)."""
-    conf = conf or C.get_active_conf()
+    conf = conf or getattr(plan, "_session_conf", None) or \
+        C.get_active_conf()
+    with C.session(conf):
+        return _collect(plan, conf)
+
+
+def _collect(plan, conf: C.RapidsConf) -> "object":
     if isinstance(plan, TpuExec):
         from spark_rapids_tpu.plan.transitions import df_from_batch
         if conf[C.ADAPTIVE_ENABLED]:

@@ -24,7 +24,7 @@ from spark_rapids_tpu.exec.base import (
 from spark_rapids_tpu.exec.coalesce import CoalesceBatchesExec
 from spark_rapids_tpu.memory.semaphore import TpuSemaphore
 from spark_rapids_tpu.plan.cpu_eval import nullable_dtype
-from spark_rapids_tpu.plan.nodes import CpuNode
+from spark_rapids_tpu.plan.nodes import CpuNode, normalize_df
 from spark_rapids_tpu.utils import metrics as M
 
 
@@ -35,6 +35,9 @@ def batch_from_df(df: pd.DataFrame, schema: T.Schema) -> ColumnarBatch:
     data, validity = {}, {}
     for f in schema.fields:
         s = df[f.name]
+        if f.dtype.id == T.TypeId.DATE32 and s.dtype == object:
+            # python date objects -> int32 days storage
+            s = normalize_df(df[[f.name]], T.Schema((f,)))[f.name]
         mask = s.isna().to_numpy() if hasattr(s, "isna") else None
         if f.dtype.is_string:
             data[f.name] = np.array(
@@ -194,15 +197,16 @@ def insert_coalesce(plan: TpuExec, conf: C.RapidsConf) -> TpuExec:
     after batch-shrinking nodes (reference
     GpuTransitionOverrides.insertCoalesce :114-199)."""
     target = TargetSize(conf[C.BATCH_SIZE_BYTES])
-    _insert_coalesce_walk(plan, target)
+    _insert_coalesce_walk(plan, target, conf[C.MAX_BATCH_ROWS])
     return plan
 
 
-def _insert_coalesce_walk(node: TpuExec, target: TargetSize) -> None:
+def _insert_coalesce_walk(node: TpuExec, target: TargetSize,
+                          max_rows: Optional[int] = None) -> None:
     if isinstance(node, RowToColumnarExec):
         # descend through the CPU island: TPU subtrees inside it need
         # coalesce too
-        _coalesce_cpu_islands(node.cpu_child, target)
+        _coalesce_cpu_islands(node.cpu_child, target, max_rows)
         return
     goals = node.children_coalesce_goal()
     for i, child in enumerate(list(node.children)):
@@ -210,16 +214,17 @@ def _insert_coalesce_walk(node: TpuExec, target: TargetSize) -> None:
         if getattr(child, "coalesce_after", False):
             goal = max_goal(goal, target)
         if goal is not None and not isinstance(child, CoalesceBatchesExec):
-            node._children[i] = CoalesceBatchesExec(goal, child)
-        _insert_coalesce_walk(child, target)
+            node._children[i] = CoalesceBatchesExec(goal, child, max_rows)
+        _insert_coalesce_walk(child, target, max_rows)
 
 
-def _coalesce_cpu_islands(node: CpuNode, target: TargetSize) -> None:
+def _coalesce_cpu_islands(node: CpuNode, target: TargetSize,
+                          max_rows: Optional[int] = None) -> None:
     if isinstance(node, ColumnarToRowExec):
-        _insert_coalesce_walk(node.tpu_child, target)
+        _insert_coalesce_walk(node.tpu_child, target, max_rows)
         return
     for c in node.children:
-        _coalesce_cpu_islands(c, target)
+        _coalesce_cpu_islands(c, target, max_rows)
 
 
 def optimize_transitions(node: CpuNode) -> CpuNode:

@@ -15,7 +15,10 @@ concurrency (vectorized UDFs can be memory-hungry).  These execs are
 disabled by default like the reference (GpuOverrides.scala:1821-1845).
 """
 from spark_rapids_tpu.pyudf.exec import (  # noqa: F401
-    ArrowEvalPythonExec, CpuArrowEvalPython, CpuMapInPandas,
-    MapInPandasExec, pandas_udf)
+    AggregateInPandasExec, ArrowEvalPythonExec, CpuAggregateInPandas,
+    CpuArrowEvalPython, CpuFlatMapCoGroupsInPandas,
+    CpuFlatMapGroupsInPandas, CpuMapInPandas, CpuWindowInPandas,
+    FlatMapCoGroupsInPandasExec, FlatMapGroupsInPandasExec,
+    MapInPandasExec, WindowInPandasExec, pandas_udf)
 from spark_rapids_tpu.pyudf.semaphore import (  # noqa: F401
     PythonWorkerSemaphore)

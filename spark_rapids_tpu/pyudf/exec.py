@@ -45,9 +45,12 @@ class PandasUdfSpec:
 
 def _eval_udfs(df: pd.DataFrame, udfs: Sequence[PandasUdfSpec],
                input_schema: T.Schema) -> pd.DataFrame:
+    from spark_rapids_tpu import config as C
     from spark_rapids_tpu.plan.cpu_eval import cpu_eval, nullable_dtype
     out = df.copy()
     sem = PythonWorkerSemaphore.get()
+    if C.get_active_conf()[C.PYTHON_DAEMON_ENABLED]:
+        return _eval_udfs_daemon(df, udfs, input_schema, sem)
     for u in udfs:
         args = [cpu_eval(a, df, input_schema) for a in u.args]
         with sem.held():
@@ -55,6 +58,34 @@ def _eval_udfs(df: pd.DataFrame, udfs: Sequence[PandasUdfSpec],
         if not isinstance(res, pd.Series):
             res = pd.Series(res, index=df.index)
         out[u.name] = res.astype(nullable_dtype(u.return_type))
+    return out
+
+
+def _eval_udfs_daemon(df: pd.DataFrame, udfs: Sequence[PandasUdfSpec],
+                      input_schema: T.Schema, sem) -> pd.DataFrame:
+    """Evaluate all UDFs in one out-of-process worker round trip
+    (pyudf/daemon.py): the worker computes only the result columns; the
+    driver merges them (smaller pipe payloads than echoing the input)."""
+    from spark_rapids_tpu.plan.cpu_eval import cpu_eval, nullable_dtype
+    from spark_rapids_tpu.pyudf.daemon import PythonWorkerPool
+    specs = [(u.name, u.fn, tuple(u.args)) for u in udfs]
+
+    def worker_side(frame: pd.DataFrame) -> pd.DataFrame:
+        res = {}
+        for name, fn, args in specs:
+            vals = fn(*[cpu_eval(a, frame, input_schema) for a in args])
+            if not isinstance(vals, pd.Series):
+                vals = pd.Series(vals, index=frame.index)
+            res[name] = vals
+        return pd.DataFrame(res, index=frame.index)
+
+    pool = PythonWorkerPool.get()
+    with sem.held():
+        res = pool.run_udf(worker_side, df)
+    out = df.copy()
+    for u in udfs:
+        out[u.name] = pd.Series(res[u.name].values, index=df.index).astype(
+            nullable_dtype(u.return_type))
     return out
 
 
@@ -185,3 +216,356 @@ class MapInPandasExec(UnaryExecBase):
             nb = batch_from_df(out, schema)
             self.update_output_metrics(nb)
             yield nb
+
+
+# ---------------------------------------------------------------------------
+# Grouped variants (reference GpuFlatMapGroupsInPandasExec,
+# GpuAggregateInPandasExec, GpuWindowInPandasExec,
+# GpuFlatMapCoGroupsInPandasExec — all disabled by default,
+# GpuOverrides.scala:1821-1845).  Grouping collapses to one partition and
+# groups host-side, the same complete-mode simplification CpuAggregate
+# uses; Spark plans the key exchange that makes this correct, and these
+# operators are host round-trips by nature.
+
+def _group_frames(df: pd.DataFrame, keys: Sequence[str]):
+    """Deterministic (key-sorted) groups, null keys grouped together like
+    Spark; yields (key_tuple, group_df)."""
+    if not len(df):
+        return
+    grouped = df.groupby(list(keys), dropna=False, sort=True)
+    for key, g in grouped:
+        if not isinstance(key, tuple):
+            key = (key,)
+        yield key, g
+
+
+def _flat_map_groups(df: pd.DataFrame, keys: Sequence[str], fn,
+                     schema: T.Schema) -> pd.DataFrame:
+    from spark_rapids_tpu.plan.nodes import empty_df
+    sem = PythonWorkerSemaphore.get()
+    outs = []
+    for _, g in _group_frames(df, keys):
+        with sem.held():
+            res = fn(g.reset_index(drop=True))
+        outs.append(res)
+    if not outs:
+        return empty_df(schema)
+    return pd.concat(outs, ignore_index=True)
+
+
+def _aggregate_in_pandas(df: pd.DataFrame, keys: Sequence[str],
+                         udfs: Sequence[PandasUdfSpec],
+                         input_schema: T.Schema,
+                         out_schema: T.Schema) -> pd.DataFrame:
+    from spark_rapids_tpu.plan.cpu_eval import cpu_eval
+    from spark_rapids_tpu.plan.nodes import empty_df
+    sem = PythonWorkerSemaphore.get()
+    rows = []
+    for key, g in _group_frames(df, keys):
+        g = g.reset_index(drop=True)
+        row = dict(zip(keys, key))
+        for u in udfs:
+            args = [cpu_eval(a, g, input_schema) for a in u.args]
+            with sem.held():
+                row[u.name] = u.fn(*args)
+        rows.append(row)
+    if not rows:
+        return empty_df(out_schema)
+    return pd.DataFrame(rows)
+
+
+def _window_in_pandas(df: pd.DataFrame, part_keys: Sequence[str],
+                      udfs: Sequence[PandasUdfSpec],
+                      input_schema: T.Schema) -> pd.DataFrame:
+    """Unbounded-partition-frame window UDFs (the frame shape the
+    reference's GpuWindowInPandas supports): each UDF reduces the
+    partition to a scalar broadcast to every row of the partition."""
+    from spark_rapids_tpu.plan.cpu_eval import cpu_eval
+    sem = PythonWorkerSemaphore.get()
+    out = df.copy()
+    from spark_rapids_tpu.plan.cpu_eval import nullable_dtype
+    for u in udfs:
+        out[u.name] = pd.Series([None] * len(df), index=df.index,
+                                dtype=nullable_dtype(u.return_type))
+    for _, g in _group_frames(df, part_keys):
+        for u in udfs:
+            args = [cpu_eval(a, g.reset_index(drop=True), input_schema)
+                    for a in u.args]
+            with sem.held():
+                val = u.fn(*args)
+            out.loc[g.index, u.name] = val
+    return out
+
+
+def _cogroup_apply(ldf: pd.DataFrame, rdf: pd.DataFrame,
+                   lkeys: Sequence[str], rkeys: Sequence[str], fn,
+                   schema: T.Schema) -> pd.DataFrame:
+    """flatMapCoGroupsInPandas: fn(left_group, right_group) per distinct
+    key across BOTH sides (missing side -> empty frame)."""
+    from spark_rapids_tpu.plan.nodes import empty_df
+    sem = PythonWorkerSemaphore.get()
+
+    def _canon(key: tuple) -> tuple:
+        # null keys must pair across sides: NaN != NaN and None vs pd.NA
+        # would otherwise split one logical null group into two
+        return tuple(None if pd.isna(v) else v for v in key)
+
+    lgroups = {_canon(k): g.reset_index(drop=True)
+               for k, g in _group_frames(ldf, lkeys)}
+    rgroups = {_canon(k): g.reset_index(drop=True)
+               for k, g in _group_frames(rdf, rkeys)}
+    all_keys = sorted(set(lgroups) | set(rgroups),
+                      key=lambda t: tuple((v is None, v) for v in t))
+    outs = []
+    for k in all_keys:
+        lg = lgroups.get(k)
+        rg = rgroups.get(k)
+        if lg is None:
+            lg = ldf.iloc[0:0].reset_index(drop=True)
+        if rg is None:
+            rg = rdf.iloc[0:0].reset_index(drop=True)
+        with sem.held():
+            outs.append(fn(lg, rg))
+    if not outs:
+        return empty_df(schema)
+    return pd.concat(outs, ignore_index=True)
+
+
+class CpuFlatMapGroupsInPandas(CpuNode):
+    """groupby(keys).applyInPandas(fn, schema)."""
+
+    def __init__(self, keys: Sequence[str], fn: Callable,
+                 schema: T.Schema, child: CpuNode):
+        super().__init__(child)
+        self.keys = list(keys)
+        self.fn = fn
+        self._schema = schema
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return 1
+
+    def describe(self) -> str:
+        return f"CpuFlatMapGroupsInPandas(keys={self.keys})"
+
+    def execute(self):
+        parts = [df for it in self.child.execute() for df in it]
+        df = (pd.concat(parts, ignore_index=True) if parts else
+              _empty_of(self.child.output_schema()))
+        out = _flat_map_groups(df, self.keys, self.fn, self._schema)
+        return [iter([normalize_df(out, self._schema)])]
+
+
+class CpuAggregateInPandas(CpuNode):
+    """groupby(keys).agg(pandas_udf): one output row per group."""
+
+    def __init__(self, keys: Sequence[str],
+                 udfs: Sequence[PandasUdfSpec], child: CpuNode):
+        super().__init__(child)
+        self.keys = list(keys)
+        self.udfs = list(udfs)
+        cs = child.output_schema()
+        fields = [cs.field(k) for k in self.keys]
+        fields += [T.Field(u.name, u.return_type) for u in self.udfs]
+        self._schema = T.Schema(tuple(fields))
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return 1
+
+    def describe(self) -> str:
+        return (f"CpuAggregateInPandas(keys={self.keys}, "
+                f"udfs={[u.name for u in self.udfs]})")
+
+    def execute(self):
+        cs = self.child.output_schema()
+        parts = [df for it in self.child.execute() for df in it]
+        df = (pd.concat(parts, ignore_index=True) if parts else
+              _empty_of(cs))
+        out = _aggregate_in_pandas(df, self.keys, self.udfs, cs,
+                                   self._schema)
+        return [iter([normalize_df(out, self._schema)])]
+
+
+class CpuWindowInPandas(CpuNode):
+    """Window pandas UDFs over an unbounded partition frame: child
+    columns + one column per UDF."""
+
+    def __init__(self, part_keys: Sequence[str],
+                 udfs: Sequence[PandasUdfSpec], child: CpuNode):
+        super().__init__(child)
+        self.part_keys = list(part_keys)
+        self.udfs = list(udfs)
+        self._schema = _output_schema(child.output_schema(), self.udfs)
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return 1
+
+    def describe(self) -> str:
+        return f"CpuWindowInPandas(partitionBy={self.part_keys})"
+
+    def execute(self):
+        cs = self.child.output_schema()
+        parts = [df for it in self.child.execute() for df in it]
+        df = (pd.concat(parts, ignore_index=True) if parts else
+              _empty_of(cs))
+        out = _window_in_pandas(df, self.part_keys, self.udfs, cs)
+        return [iter([normalize_df(out, self._schema)])]
+
+
+class CpuFlatMapCoGroupsInPandas(CpuNode):
+    """cogroup(left, right).applyInPandas(fn, schema)."""
+
+    def __init__(self, left_keys: Sequence[str],
+                 right_keys: Sequence[str], fn: Callable,
+                 schema: T.Schema, left: CpuNode, right: CpuNode):
+        super().__init__(left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.fn = fn
+        self._schema = schema
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return 1
+
+    def describe(self) -> str:
+        return (f"CpuFlatMapCoGroupsInPandas({self.left_keys} | "
+                f"{self.right_keys})")
+
+    def execute(self):
+        lparts = [df for it in self.children[0].execute() for df in it]
+        rparts = [df for it in self.children[1].execute() for df in it]
+        ldf = (pd.concat(lparts, ignore_index=True) if lparts else
+               _empty_of(self.children[0].output_schema()))
+        rdf = (pd.concat(rparts, ignore_index=True) if rparts else
+               _empty_of(self.children[1].output_schema()))
+        out = _cogroup_apply(ldf, rdf, self.left_keys, self.right_keys,
+                             self.fn, self._schema)
+        return [iter([normalize_df(out, self._schema)])]
+
+
+def _empty_of(schema: T.Schema) -> pd.DataFrame:
+    from spark_rapids_tpu.plan.nodes import empty_df
+    return empty_df(schema)
+
+
+class _GatherAllPythonExec(TpuExec):
+    """Base for grouped python execs: collapses child partitions to one
+    host frame (the key exchange is planned upstream), applies a host
+    transform, re-uploads under the task semaphore."""
+
+    def output_partition_count(self) -> int:
+        return 1
+
+    def execute_partitions(self):
+        return [self.execute_columnar()]
+
+    def _gather(self, child: TpuExec) -> pd.DataFrame:
+        from spark_rapids_tpu.plan.transitions import df_from_batch
+        frames = []
+        for it in child.execute_partitions():
+            for b in it:
+                frames.append(df_from_batch(b))
+        if not frames:
+            return _empty_of(child.output_schema())
+        return pd.concat(frames, ignore_index=True)
+
+    def _emit(self, out: pd.DataFrame):
+        from spark_rapids_tpu.plan.transitions import batch_from_df
+        schema = self.output_schema()
+        TpuSemaphore.get().acquire_if_necessary()
+        nb = batch_from_df(normalize_df(out, schema), schema)
+        self.update_output_metrics(nb)
+        yield nb
+
+
+class FlatMapGroupsInPandasExec(_GatherAllPythonExec):
+    def __init__(self, node: CpuFlatMapGroupsInPandas, child: TpuExec):
+        super().__init__(child)
+        self.node = node
+
+    def output_schema(self) -> T.Schema:
+        return self.node.output_schema()
+
+    def describe(self) -> str:
+        return f"FlatMapGroupsInPandasExec(keys={self.node.keys})"
+
+    def execute_columnar(self):
+        df = self._gather(self.child)
+        with self.metrics.timed():
+            out = _flat_map_groups(df, self.node.keys, self.node.fn,
+                                   self.output_schema())
+        yield from self._emit(out)
+
+
+class AggregateInPandasExec(_GatherAllPythonExec):
+    def __init__(self, node: CpuAggregateInPandas, child: TpuExec):
+        super().__init__(child)
+        self.node = node
+
+    def output_schema(self) -> T.Schema:
+        return self.node.output_schema()
+
+    def describe(self) -> str:
+        return f"AggregateInPandasExec(keys={self.node.keys})"
+
+    def execute_columnar(self):
+        df = self._gather(self.child)
+        with self.metrics.timed():
+            out = _aggregate_in_pandas(
+                df, self.node.keys, self.node.udfs,
+                self.child.output_schema(), self.output_schema())
+        yield from self._emit(out)
+
+
+class WindowInPandasExec(_GatherAllPythonExec):
+    def __init__(self, node: CpuWindowInPandas, child: TpuExec):
+        super().__init__(child)
+        self.node = node
+
+    def output_schema(self) -> T.Schema:
+        return self.node.output_schema()
+
+    def describe(self) -> str:
+        return f"WindowInPandasExec(partitionBy={self.node.part_keys})"
+
+    def execute_columnar(self):
+        df = self._gather(self.child)
+        with self.metrics.timed():
+            out = _window_in_pandas(df, self.node.part_keys,
+                                    self.node.udfs,
+                                    self.child.output_schema())
+        yield from self._emit(out)
+
+
+class FlatMapCoGroupsInPandasExec(_GatherAllPythonExec):
+    def __init__(self, node: CpuFlatMapCoGroupsInPandas,
+                 left: TpuExec, right: TpuExec):
+        super().__init__(left, right)
+        self.node = node
+
+    def output_schema(self) -> T.Schema:
+        return self.node.output_schema()
+
+    def describe(self) -> str:
+        return (f"FlatMapCoGroupsInPandasExec({self.node.left_keys} | "
+                f"{self.node.right_keys})")
+
+    def execute_columnar(self):
+        ldf = self._gather(self.children[0])
+        rdf = self._gather(self.children[1])
+        with self.metrics.timed():
+            out = _cogroup_apply(ldf, rdf, self.node.left_keys,
+                                 self.node.right_keys, self.node.fn,
+                                 self.output_schema())
+        yield from self._emit(out)

@@ -1,0 +1,111 @@
+"""Out-of-process python UDF worker (reference `python/rapids/worker.py`:
+the forked pyspark worker that calls `initialize_gpu_mem()` from env
+vars before touching the device).
+
+TPU adaptation of the memory init: a TPU chip is single-process — a UDF
+worker that imported jax with the default platform would steal the chip
+from the executor.  So `initialize_tpu_env()` pins the worker to the CPU
+platform unless `RAPIDS_PYTHON_ON_TPU=true` (the analog of the
+reference's `RAPIDS_PYTHON_ENABLED` gate), and bounds worker host memory
+via `RAPIDS_PYTHON_MEM_LIMIT_BYTES` (rlimit — the role the RMM pool
+size plays in `worker.py:34-50`).
+
+Wire protocol over stdin/stdout (all little-endian):
+    request:  u32 fn_len | cloudpickled fn | u32 ipc_len | Arrow IPC
+              stream of the argument batch
+    response: u8 status (0=ok, 1=error) | u32 len | payload
+              ok: Arrow IPC stream of the result batch
+              error: utf-8 traceback
+    shutdown: u32 fn_len == 0
+"""
+from __future__ import annotations
+
+import io
+import os
+import struct
+import sys
+
+
+def initialize_tpu_env() -> None:
+    on_tpu = os.environ.get("RAPIDS_PYTHON_ON_TPU",
+                            "false").lower() == "true"
+    if not on_tpu:
+        # keep the single-process TPU chip with the executor.  The env
+        # var alone is not enough: TPU platform plugins can win default
+        # platform selection during `import jax`, so pin via jax.config
+        # too (same workaround as __graft_entry__.py).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    limit = int(os.environ.get("RAPIDS_PYTHON_MEM_LIMIT_BYTES", "0"))
+    if limit > 0:
+        try:
+            import resource
+            resource.setrlimit(resource.RLIMIT_AS, (limit, limit))
+        except (ImportError, ValueError, OSError):
+            pass  # best-effort, like the reference's optional pool init
+
+
+def _read_exact(stream, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = stream.read(n - len(buf))
+        if not chunk:
+            raise EOFError("worker stdin closed")
+        buf += chunk
+    return buf
+
+
+def _read_frame(stream) -> bytes:
+    (n,) = struct.unpack("<I", _read_exact(stream, 4))
+    return _read_exact(stream, n) if n else b""
+
+
+def _write_response(stream, status: int, payload: bytes) -> None:
+    stream.write(struct.pack("<BI", status, len(payload)))
+    stream.write(payload)
+    stream.flush()
+
+
+def _df_to_ipc(df) -> bytes:
+    import pyarrow as pa
+    table = pa.Table.from_pandas(df, preserve_index=False)
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def _ipc_to_df(blob: bytes):
+    import pyarrow as pa
+    with pa.ipc.open_stream(pa.BufferReader(blob)) as r:
+        return r.read_all().to_pandas()
+
+
+def main() -> int:
+    initialize_tpu_env()
+    import cloudpickle
+    stdin = sys.stdin.buffer
+    stdout = sys.stdout.buffer
+    # anything the UDF prints must not corrupt the protocol stream
+    sys.stdout = sys.stderr
+    while True:
+        try:
+            fn_blob = _read_frame(stdin)
+        except EOFError:
+            return 0
+        if not fn_blob:
+            return 0
+        try:
+            ipc = _read_frame(stdin)
+            fn = cloudpickle.loads(fn_blob)
+            out = fn(_ipc_to_df(ipc))
+            _write_response(stdout, 0, _df_to_ipc(out))
+        except BaseException:  # noqa: BLE001 — ship traceback to driver
+            import traceback
+            _write_response(stdout, 1,
+                            traceback.format_exc().encode("utf-8"))
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

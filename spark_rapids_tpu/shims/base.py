@@ -132,6 +132,9 @@ class SparkShims:
         boolean-era name, renamed to the mode conf in 3.0.1."""
         return "spark.sql.legacy.parquet.rebaseDateTimeInRead"
 
+    def parquet_rebase_write_key(self) -> str:
+        return "spark.sql.legacy.parquet.rebaseDateTimeInWrite"
+
     # -- rule extensions ----------------------------------------------------
     def extra_exec_rules(self) -> dict:
         """Per-version exec replacement rules added on top of the common

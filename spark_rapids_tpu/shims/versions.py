@@ -39,6 +39,9 @@ class Spark301Shims(Spark300Shims):
     def parquet_rebase_read_key(self) -> str:
         return "spark.sql.legacy.parquet.datetimeRebaseModeInRead"
 
+    def parquet_rebase_write_key(self) -> str:
+        return "spark.sql.legacy.parquet.datetimeRebaseModeInWrite"
+
 
 class Spark302Shims(Spark301Shims):
     """Spark 3.0.2 (reference `shims/spark302`): identical surface to

@@ -73,7 +73,8 @@ class BufferReceiveState:
         self._lock = threading.Lock()
 
     def on_chunk(self, table_id: int, seq: int, chunk: bytes,
-                 is_last: bool) -> None:
+                 is_last: bool, codec_id: int = -1,
+                 raw_len: int = 0) -> None:
         with self._lock:
             parts = self._chunks.setdefault(table_id, [])
             assert seq == len(parts), (
@@ -83,6 +84,11 @@ class BufferReceiveState:
                 return
             blob = b"".join(self._chunks.pop(table_id))
             self.completed.add(table_id)
+        if codec_id != -1:
+            # wire payload was codec-compressed by the server
+            # (reference GpuCompressedColumnVector decompress-on-receive)
+            from spark_rapids_tpu.shuffle.compression import get_codec
+            blob = get_codec(codec_id).decompress(blob, raw_len)
         meta_msg = self.metas[table_id]
         bid = BufferId(self.received_catalog.new_buffer_id().table_id,
                        meta_msg.shuffle_id, meta_msg.map_id,
@@ -183,9 +189,12 @@ class ShuffleServer:
     acquired from whatever tier they live in (device or spilled)."""
 
     def __init__(self, shuffle_catalog: ShuffleBufferCatalog,
-                 transport: ShuffleTransport):
+                 transport: ShuffleTransport, codec=None):
         self.shuffle_catalog = shuffle_catalog
         self.transport = transport
+        # payload codec for the wire (reference TableCompressionCodec;
+        # conf spark.rapids.shuffle.compression.codec)
+        self.codec = codec
 
     def handle_metadata_request(self, blocks: Sequence[BlockIdMsg]
                                 ) -> list[TableMetaMsg]:
@@ -207,23 +216,34 @@ class ShuffleServer:
             return buf.get_host_bytes()
 
     def send_state(self, table_ids: Sequence[int],
-                   emit: Callable[[int, int, bytes, bool], None]
-                   ) -> Transaction:
+                   emit: Callable[[int, int, bytes, bool], None],
+                   wire: bool = True) -> Transaction:
         """Stream requested buffers as bounce-buffer-sized chunks.  With a
         synchronous `emit` the chunks are zero-copy slices; the send
         bounce pool (reference BufferSendState) only sizes the chunks —
         an async transport would stage through `transport.send_bounce`
-        to bound its in-flight copies."""
+        to bound its in-flight copies.
+
+        `wire=False` (loopback fetches) skips the payload codec: the
+        bytes never leave the process, so compressing them would be pure
+        CPU waste."""
         total = 0
         chunk_size = self.transport.send_bounce.buffer_size
+        codec = self.codec if wire else None
         try:
             for tid in table_ids:
                 blob = self.acquire_buffer_bytes(tid)
+                raw_len = len(blob)
+                codec_id = -1
+                if codec is not None:
+                    blob = codec.compress(blob)
+                    codec_id = codec.codec_id
                 n = len(blob)
                 nchunks = max(1, -(-n // chunk_size))
                 for i in range(nchunks):
                     chunk = blob[i * chunk_size: (i + 1) * chunk_size]
-                    emit(tid, i, chunk, i == nchunks - 1)
+                    emit(tid, i, chunk, i == nchunks - 1, codec_id,
+                         raw_len)
                     total += len(chunk)
         except Exception as e:  # noqa: BLE001 — surface as transaction
             return Transaction(TransactionStatus.ERROR, str(e), total)

@@ -1,0 +1,132 @@
+"""Shuffle payload compression codecs (reference
+`TableCompressionCodec.scala:42-120`, `CopyCompressionCodec.scala`).
+
+The reference compresses contiguous GPU tables with a pluggable codec and
+carries codec descriptors in the FlatBuffers `BufferMeta`
+(`ShuffleCommon.fbs` CodecBufferDescriptor); at the v0.2 snapshot only the
+testing `copy` codec exists.
+
+TPU redesign: device-resident batches are typed XLA arrays, not byte
+buffers, and the TPU has no codec kernels — so compression applies to the
+*serialized host payload* on the wire (the DCN lane, where bandwidth is
+scarcest; the intra-slice ICI lane rides XLA collectives and never sees
+bytes).  The codec id + uncompressed size travel in every DATA frame (the
+role of the reference's CodecBufferDescriptor), and the receive side
+decompresses before the blob lands in the host store.  Real codecs are
+backed by Arrow's host codecs (lz4/zstd) — the role nvcomp would play in
+a later reference snapshot.  The reference's BatchedTableCompressor
+exists to amortize GPU codec kernel launches across small tables; host
+codecs have no launch cost, so this SPI deliberately compresses one
+payload at a time.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+# codec ids on the wire (reference format/CodecType.java: COPY = 0)
+CODEC_NONE = -1   # never on the wire; "no compression" sentinel
+CODEC_COPY = 0
+CODEC_LZ4 = 1
+CODEC_ZSTD = 2
+
+
+class TableCompressionCodec:
+    """SPI: compress/decompress one serialized table payload."""
+
+    #: short name used in conf + logging
+    name: str = "?"
+    #: wire id (CodecType analog)
+    codec_id: int = CODEC_NONE
+
+    def compress(self, blob: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, blob: bytes, uncompressed_size: int) -> bytes:
+        raise NotImplementedError
+
+
+class CopyCompressionCodec(TableCompressionCodec):
+    """Pass-through codec for protocol testing (reference
+    `CopyCompressionCodec.scala`: a device memcpy)."""
+
+    name = "copy"
+    codec_id = CODEC_COPY
+
+    def compress(self, blob: bytes) -> bytes:
+        return bytes(blob)
+
+    def decompress(self, blob: bytes, uncompressed_size: int) -> bytes:
+        if len(blob) != uncompressed_size:
+            raise ValueError(
+                f"copy codec size mismatch: {len(blob)} != "
+                f"{uncompressed_size}")
+        return bytes(blob)
+
+
+class _ArrowCodec(TableCompressionCodec):
+    """Host codec backed by pyarrow's buffer compression."""
+
+    _arrow_name: str = "?"
+
+    def __init__(self):
+        import pyarrow as pa
+        self._codec = pa.Codec(self._arrow_name)
+
+    def compress(self, blob: bytes) -> bytes:
+        return self._codec.compress(blob, asbytes=True)
+
+    def decompress(self, blob: bytes, uncompressed_size: int) -> bytes:
+        return self._codec.decompress(
+            blob, decompressed_size=uncompressed_size, asbytes=True)
+
+
+class Lz4CompressionCodec(_ArrowCodec):
+    name = "lz4"
+    codec_id = CODEC_LZ4
+    _arrow_name = "lz4"
+
+
+class ZstdCompressionCodec(_ArrowCodec):
+    name = "zstd"
+    codec_id = CODEC_ZSTD
+    _arrow_name = "zstd"
+
+
+_BY_NAME = {c.name: c for c in
+            (CopyCompressionCodec, Lz4CompressionCodec,
+             ZstdCompressionCodec)}
+# names an earlier conf doc advertised before the codecs existed
+_BY_NAME["lz4-host"] = Lz4CompressionCodec
+_BY_NAME["zstd-host"] = ZstdCompressionCodec
+_BY_ID = {c.codec_id: c for c in _BY_NAME.values()}
+_CACHE: dict[int, TableCompressionCodec] = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def get_codec(name_or_id) -> Optional[TableCompressionCodec]:
+    """Codec lookup with instance cache (reference
+    `TableCompressionCodec.getCodec`).  Accepts the conf short name or
+    the wire id; "none"/CODEC_NONE -> None (no compression)."""
+    if name_or_id in (None, "none", CODEC_NONE):
+        return None
+    if isinstance(name_or_id, str):
+        cls = _BY_NAME.get(name_or_id)
+        if cls is None:
+            raise ValueError(f"Unknown table codec: {name_or_id}")
+        key = cls.codec_id
+    else:
+        cls = _BY_ID.get(int(name_or_id))
+        if cls is None:
+            raise ValueError(f"Unknown codec ID: {name_or_id}")
+        key = cls.codec_id
+    with _CACHE_LOCK:
+        inst = _CACHE.get(key)
+        if inst is None:
+            inst = _CACHE[key] = cls()
+        return inst
+
+
+def codec_from_conf(conf) -> Optional[TableCompressionCodec]:
+    from spark_rapids_tpu import config as C
+    return get_codec(str(conf[C.SHUFFLE_COMPRESSION_CODEC]).lower())

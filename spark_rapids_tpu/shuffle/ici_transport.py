@@ -58,7 +58,8 @@ class LoopbackConnection(Connection):
     def fetch(self, table_ids: Sequence[int],
               on_chunk: Callable[[int, int, bytes, bool], None]
               ) -> Transaction:
-        return self.server.send_state(table_ids, on_chunk)
+        # in-process fetch: bytes never hit a wire, skip the codec
+        return self.server.send_state(table_ids, on_chunk, wire=False)
 
 
 class TcpServer:
@@ -100,9 +101,11 @@ class TcpServer:
                     metas = self.server.handle_metadata_request(blocks)
                     _send_all(conn, meta_response(metas))
                 elif kind == MsgKind.TRANSFER_REQUEST:
-                    def emit(tid, seq, chunk, is_last):
+                    def emit(tid, seq, chunk, is_last, codec_id=-1,
+                             raw_len=0):
                         _send_all(conn, encode_data(
-                            tid, (seq << 1) | int(is_last), chunk))
+                            tid, (seq << 1) | int(is_last), chunk,
+                            codec_id, raw_len))
                     txn = self.server.send_state(payload["table_ids"], emit)
                     _send_all(conn, _txn_frame(txn))
                 else:
@@ -175,8 +178,9 @@ class TcpConnection(Connection):
                                            "peer closed during transfer")
                     kind, payload = decode_frame(frame)
                     if kind == MsgKind.DATA:
-                        tid, packed, chunk = payload
-                        on_chunk(tid, packed >> 1, chunk, bool(packed & 1))
+                        tid, packed, chunk, codec_id, raw_len = payload
+                        on_chunk(tid, packed >> 1, chunk,
+                                 bool(packed & 1), codec_id, raw_len)
                     elif kind == MsgKind.TRANSFER_RESPONSE:
                         return Transaction(
                             TransactionStatus(payload["status"]),

@@ -92,7 +92,9 @@ class TpuShuffleManager:
         self.received_catalog = ShuffleReceivedBufferCatalog(
             self.env.catalog)
         self.transport = make_transport(self.conf)
-        self.server = ShuffleServer(self.shuffle_catalog, self.transport)
+        from spark_rapids_tpu.shuffle.compression import codec_from_conf
+        self.server = ShuffleServer(self.shuffle_catalog, self.transport,
+                                    codec=codec_from_conf(self.conf))
         handle = self.transport.make_server(executor_id, self.server)
         self.loop_address = handle.loop_address
         self.tcp_address = handle.tcp_address

@@ -88,16 +88,21 @@ def encode_control(kind: MsgKind, payload: dict) -> bytes:
     return struct.pack("<IB", len(body) + 1, int(kind)) + body
 
 
-def encode_data(table_id: int, seq: int, chunk: bytes) -> bytes:
-    return struct.pack("<IBQI", len(chunk) + 13, int(MsgKind.DATA),
-                       table_id, seq) + chunk
+def encode_data(table_id: int, seq: int, chunk: bytes,
+                codec_id: int = -1, raw_len: int = 0) -> bytes:
+    """DATA frame; codec_id/raw_len play the reference's
+    CodecBufferDescriptor role (ShuffleCommon.fbs): -1 = uncompressed,
+    else the payload is `codec_id`-compressed and inflates to raw_len."""
+    return struct.pack("<IBQIBQ", len(chunk) + 22, int(MsgKind.DATA),
+                       table_id, seq, codec_id + 1, raw_len) + chunk
 
 
 def decode_frame(frame: bytes) -> tuple[MsgKind, object]:
     kind = MsgKind(frame[0])
     if kind == MsgKind.DATA:
-        table_id, seq = struct.unpack_from("<QI", frame, 1)
-        return kind, (table_id, seq, frame[13:])
+        table_id, seq, codec_byte, raw_len = struct.unpack_from(
+            "<QIBQ", frame, 1)
+        return kind, (table_id, seq, frame[22:], codec_byte - 1, raw_len)
     return kind, json.loads(frame[1:].decode())
 
 
@@ -202,7 +207,8 @@ class Connection:
 
     `request` performs a control round-trip; `fetch` streams the DATA
     frames of the requested tables to `on_chunk(table_id, seq, bytes,
-    is_last)` — the bounce-buffer receive path."""
+    is_last, codec_id, raw_len)` — the bounce-buffer receive path
+    (codec_id -1 = uncompressed payload)."""
 
     def request(self, frame: bytes) -> tuple[MsgKind, object]:
         raise NotImplementedError

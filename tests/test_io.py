@@ -366,3 +366,132 @@ def test_write_unsupported_format_does_not_destroy_output(tmp_path):
     # the existing parquet output survived the failed overwrite
     back = collect(accelerate(tio.read_parquet(out), conf()))
     assert len(back) == 3
+
+
+# --- hybrid-calendar rebase (reference RebaseHelper.scala,
+# GpuParquetScan.scala:194-249, GpuParquetFileFormat.scala:216-228) -------
+def _legacy_day(y, m, d):
+    """Day number a Spark 2.x (hybrid-calendar) writer stores for a
+    pre-cutover date label."""
+    from spark_rapids_tpu.io import rebase as RB
+    return int(RB._jdn_from_ymd(np.int64(y), np.int64(m), np.int64(d),
+                                julian=True) - RB._EPOCH_JDN)
+
+
+def _write_legacy_file(path):
+    stored = _legacy_day(1200, 1, 1)
+    tbl = pa.table({
+        "d": pa.array([stored, -100, None], pa.int32()).cast(pa.date32()),
+        "x": pa.array([1, 2, 3], pa.int64())})
+    pq.write_table(tbl, str(path))
+    return stored
+
+
+def test_parquet_rebase_exception_read_raises(tmp_path):
+    """EXCEPTION read mode raises the Spark-3.0 upgrade error on legacy
+    files holding pre-1582 dates (RebaseHelper.newRebaseExceptionInRead)."""
+    from spark_rapids_tpu.io import rebase as RB
+    _write_legacy_file(tmp_path / "t.parquet")
+    scan = tio.read_parquet(str(tmp_path))
+    plan = accelerate(scan, conf())
+    with pytest.raises(RB.SparkUpgradeError, match="1582-10-15"):
+        collect(plan)
+
+
+def test_parquet_rebase_corrected_reads_verbatim(tmp_path):
+    stored = _write_legacy_file(tmp_path / "t.parquet")
+    key = "spark.sql.legacy.parquet.datetimeRebaseModeInRead"
+    c = conf(**{key: "CORRECTED"})
+    df = collect(accelerate(tio.read_parquet(str(tmp_path)), c))
+    assert int(df["d"].iloc[0]) == stored
+
+
+def test_parquet_rebase_legacy_cpu_engine_rebases(tmp_path):
+    """LEGACY read falls back to the CPU engine (existing test), and that
+    engine performs the actual Julian->Gregorian rebase like CPU Spark's
+    RebaseDateTime: the pre-cutover *label* is preserved."""
+    from spark_rapids_tpu.plan.nodes import CpuNode
+    _write_legacy_file(tmp_path / "t.parquet")
+    key = "spark.sql.legacy.parquet.datetimeRebaseModeInRead"
+    c = conf(**{key: "LEGACY"})
+    plan = accelerate(tio.read_parquet(str(tmp_path)), c)
+    assert isinstance(plan, CpuNode)
+    df = collect(plan)
+    want = (datetime.date(1200, 1, 1) - datetime.date(1970, 1, 1)).days
+    assert int(df["d"].iloc[0]) == want
+    assert int(df["d"].iloc[1]) == -100  # post-cutover rows untouched
+
+
+def test_parquet_rebase_unknown_mode_falls_back(tmp_path):
+    from spark_rapids_tpu.plan.nodes import CpuNode
+    _write_legacy_file(tmp_path / "t.parquet")
+    key = "spark.sql.legacy.parquet.datetimeRebaseModeInRead"
+    plan = accelerate(tio.read_parquet(str(tmp_path)),
+                      conf(**{key: "BOGUS"}))
+    assert isinstance(plan, CpuNode)
+
+
+def test_parquet_rebase_write_exception_and_legacy(tmp_path):
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.io import rebase as RB
+    from spark_rapids_tpu.io.parquet import (
+        ParquetColumnarWriter, ParquetWriterOptions)
+    schema = T.Schema.of(("d", T.DATE32), ("x", T.INT64))
+    gre_day = (datetime.date(1200, 1, 1) - datetime.date(1970, 1, 1)).days
+    batch = ColumnarBatch.from_numpy(
+        {"d": np.array([gre_day, 0], np.int32),
+         "x": np.array([7, 8], np.int64)}, schema)
+    # EXCEPTION (the Spark default) raises on pre-cutover values
+    w = ParquetColumnarWriter(str(tmp_path / "e.parquet"), schema,
+                              ParquetWriterOptions(rebase_mode="EXCEPTION"))
+    with pytest.raises(RB.SparkUpgradeError, match="1582-10-15"):
+        w.write_batch(batch)
+    # LEGACY writes the Julian encoding + the legacyDateTime marker, and
+    # a LEGACY read round-trips to the original labels
+    p = str(tmp_path / "l.parquet")
+    w2 = ParquetColumnarWriter(p, schema,
+                               ParquetWriterOptions(rebase_mode="LEGACY"))
+    w2.write_batch(batch)
+    w2.close()
+    md = pq.ParquetFile(p).metadata.metadata
+    assert RB.SPARK_LEGACY_DATETIME_KEY in md
+    assert pq.read_table(p).column("d").cast(pa.int32()).to_pylist()[0] == \
+        _legacy_day(1200, 1, 1)
+    from spark_rapids_tpu.io.parquet import ParquetFormat
+    t = ParquetFormat("LEGACY").read_split(
+        FileSplit(p, 0, os.path.getsize(p), ()), schema, None)
+    assert t.column("d").cast(pa.int32()).to_pylist() == [gre_day, 0]
+
+
+def test_parquet_rebase_corrected_files_skip_checks(tmp_path):
+    """Files stamped with a Spark >= 3.0.0 version key and no legacy
+    marker are proleptic already — EXCEPTION mode reads them fine
+    (GpuParquetScan.scala:199-210)."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.io.parquet import (
+        ParquetColumnarWriter, ParquetFormat, ParquetWriterOptions)
+    schema = T.Schema.of(("d", T.DATE32), ("x", T.INT64))
+    gre_day = (datetime.date(1200, 1, 1) - datetime.date(1970, 1, 1)).days
+    batch = ColumnarBatch.from_numpy(
+        {"d": np.array([gre_day, 0], np.int32),
+         "x": np.array([7, 8], np.int64)}, schema)
+    p = str(tmp_path / "c.parquet")
+    w = ParquetColumnarWriter(p, schema,
+                              ParquetWriterOptions(rebase_mode="CORRECTED"))
+    w.write_batch(batch)
+    w.close()
+    t = ParquetFormat("EXCEPTION").read_split(
+        FileSplit(p, 0, os.path.getsize(p), ()), schema, None)
+    assert t.column("d").cast(pa.int32()).to_pylist() == [gre_day, 0]
+
+
+def test_rebase_timestamp_micros_roundtrip():
+    from spark_rapids_tpu.io import rebase as RB
+    rng = np.random.default_rng(3)
+    micros = rng.integers(-130_000_000_000, -119_000_000_000,
+                          200).astype(np.int64) * 1_000_000
+    leg = RB.rebase_gregorian_to_julian_micros(micros)
+    back = RB.rebase_julian_to_gregorian_micros(leg)
+    np.testing.assert_array_equal(back, micros)
+    # intra-day component survives the rebase
+    assert ((leg % 86400000000) == (micros % 86400000000)).all()

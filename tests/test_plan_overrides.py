@@ -334,3 +334,41 @@ def test_cpu_full_outer_join_residual_condition():
     assert len(out) == 4
     assert sorted(out["k"].dropna().tolist()) == [1, 2]
     assert sorted(out["rv"].dropna().tolist()) == [5, 99]
+
+
+def test_session_conf_reaches_plan_and_runtime():
+    """The conf handed to accelerate() must drive both plan-time
+    construction (CoalesceBatchesExec max-rows cap) and run-time conf
+    reads (collect installs the plan's session conf), independent of the
+    thread-local active conf (reference: conf is read per-query at plan
+    time, GpuOverrides.scala:1885)."""
+    from spark_rapids_tpu.exec.coalesce import CoalesceBatchesExec
+
+    src = CpuSource.from_pandas(pd.DataFrame(
+        {"x": pd.array(np.arange(100), dtype="Int64")}), num_partitions=1)
+    c = C.RapidsConf({"spark.rapids.tpu.batchMaxRows": 32})
+    # project-over-filter: the filter's coalesce_after makes the
+    # transition pass insert a CoalesceBatchesExec between them
+    plan = accelerate(
+        CpuProject([(col("x") * lit(2)).alias("y")],
+                   CpuFilter(col("x") >= lit(0), src)), c)
+
+    def find(node):
+        if isinstance(node, CoalesceBatchesExec):
+            return node
+        kids = list(getattr(node, "children", ()))
+        for attr in ("tpu_child", "cpu_child"):
+            if getattr(node, attr, None) is not None:
+                kids.append(getattr(node, attr))
+        for ch in kids:
+            got = find(ch)
+            if got is not None:
+                return got
+        return None
+
+    coal = find(plan)
+    assert coal is not None, "expected a CoalesceBatchesExec after filter"
+    assert coal._max_rows == 32
+    df = collect(plan)
+    assert len(df) == 100
+    assert getattr(plan, "_session_conf", None) is c

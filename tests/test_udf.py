@@ -2,6 +2,7 @@
 `udf-compiler/.../OpcodeSuite.scala` per-construct compile+result checks,
 plus the pandas-UDF exec suites; SURVEY.md §2.11/§2.12)."""
 import math
+import threading
 
 import numpy as np
 import pandas as pd
@@ -355,3 +356,213 @@ def test_fallback_on_shadowed_builtin():
 def test_module_level_math_still_compiles():
     e = compile_udf(lambda x: math.floor(x), [col("b")])
     assert e is not None
+
+
+# -- grouped pandas UDF variants (reference GpuFlatMapGroupsInPandasExec,
+# GpuAggregateInPandasExec, GpuWindowInPandasExec,
+# GpuFlatMapCoGroupsInPandasExec) --------------------------------------------
+def _grouped_df():
+    return pd.DataFrame({
+        "k": pd.array([1, 2, 1, None, 2, 1], dtype="Int64"),
+        "v": pd.array([10.0, 20.0, 30.0, 40.0, None, 60.0],
+                      dtype="Float64"),
+    })
+
+
+def test_flat_map_groups_in_pandas_parity():
+    from spark_rapids_tpu.pyudf import CpuFlatMapGroupsInPandas
+
+    def summarize(g: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame({
+            "k": g["k"].iloc[:1],
+            "total": pd.array([g["v"].sum(skipna=True)],
+                              dtype="Float64")})
+
+    schema = T.Schema.of(("k", T.INT64), ("total", T.FLOAT64))
+    src = CpuSource.from_pandas(_grouped_df(), num_partitions=2)
+    plan = CpuFlatMapGroupsInPandas(["k"], summarize, schema, src)
+    c = conf(**{"spark.rapids.sql.exec.CpuFlatMapGroupsInPandas": True})
+    tpu_plan = _compare(plan, c)
+    from spark_rapids_tpu.exec.base import TpuExec
+    assert isinstance(tpu_plan, TpuExec)
+
+
+def test_aggregate_in_pandas_parity():
+    from spark_rapids_tpu.pyudf import CpuAggregateInPandas, pandas_udf
+    from spark_rapids_tpu.pyudf.exec import PandasUdfSpec
+
+    @pandas_udf(T.FLOAT64)
+    def vmean(x: pd.Series):
+        return float(x.mean()) if x.notna().any() else None
+
+    spec = PandasUdfSpec("mean_v", vmean, T.FLOAT64, (col("v"),))
+    src = CpuSource.from_pandas(_grouped_df(), num_partitions=2)
+    plan = CpuAggregateInPandas(["k"], [spec], src)
+    c = conf(**{"spark.rapids.sql.exec.CpuAggregateInPandas": True})
+    _compare(plan, c)
+    # group count: keys 1, 2 and the null group
+    out = collect(accelerate(plan, c))
+    assert len(out) == 3
+
+
+def test_window_in_pandas_parity():
+    from spark_rapids_tpu.pyudf import CpuWindowInPandas, pandas_udf
+    from spark_rapids_tpu.pyudf.exec import PandasUdfSpec
+
+    @pandas_udf(T.FLOAT64)
+    def vmax(x: pd.Series):
+        return float(x.max()) if x.notna().any() else None
+
+    spec = PandasUdfSpec("max_v", vmax, T.FLOAT64, (col("v"),))
+    src = CpuSource.from_pandas(_grouped_df(), num_partitions=2)
+    plan = CpuWindowInPandas(["k"], [spec], src)
+    c = conf(**{"spark.rapids.sql.exec.CpuWindowInPandas": True})
+    _compare(plan, c, check_like=True)
+    # window output keeps every input row
+    out = collect(accelerate(plan, c))
+    assert len(out) == 6
+    # rows of group k=1 all see the same per-group max
+    g1 = out[out["k"] == 1]["max_v"].tolist()
+    assert g1 == [60.0, 60.0, 60.0]
+
+
+def test_flat_map_cogroups_in_pandas_parity():
+    from spark_rapids_tpu.pyudf import CpuFlatMapCoGroupsInPandas
+
+    left = pd.DataFrame({
+        "k": pd.array([1, 2, 1], dtype="Int64"),
+        "lv": pd.array([1.0, 2.0, 3.0], dtype="Float64")})
+    right = pd.DataFrame({
+        "k2": pd.array([2, 3], dtype="Int64"),
+        "rv": pd.array([20.0, 30.0], dtype="Float64")})
+
+    def merge(lg: pd.DataFrame, rg: pd.DataFrame) -> pd.DataFrame:
+        k = lg["k"].iloc[0] if len(lg) else rg["k2"].iloc[0]
+        return pd.DataFrame({
+            "k": pd.array([k], dtype="Int64"),
+            "lsum": pd.array([lg["lv"].sum() if len(lg) else None],
+                             dtype="Float64"),
+            "rsum": pd.array([rg["rv"].sum() if len(rg) else None],
+                             dtype="Float64")})
+
+    schema = T.Schema.of(("k", T.INT64), ("lsum", T.FLOAT64),
+                         ("rsum", T.FLOAT64))
+    plan = CpuFlatMapCoGroupsInPandas(
+        ["k"], ["k2"], merge, schema,
+        CpuSource.from_pandas(left, num_partitions=2),
+        CpuSource.from_pandas(right))
+    c = conf(**{"spark.rapids.sql.exec.CpuFlatMapCoGroupsInPandas": True})
+    tpu_plan = _compare(plan, c)
+    from spark_rapids_tpu.exec.base import TpuExec
+    assert isinstance(tpu_plan, TpuExec)
+    out = collect(accelerate(plan, c))
+    assert sorted(out["k"].tolist()) == [1, 2, 3]  # union of both key sets
+
+
+def test_grouped_pandas_execs_disabled_by_default():
+    from spark_rapids_tpu.pyudf import (
+        CpuAggregateInPandas, CpuFlatMapGroupsInPandas, CpuWindowInPandas)
+    from spark_rapids_tpu.pyudf.exec import PandasUdfSpec
+    from spark_rapids_tpu.exec.base import TpuExec
+    spec = PandasUdfSpec("r", lambda s: 0.0, T.FLOAT64, (col("v"),))
+    schema = T.Schema.of(("k", T.INT64))
+    src = CpuSource.from_pandas(_grouped_df())
+    for plan in (
+            CpuFlatMapGroupsInPandas(["k"], lambda g: g[["k"]], schema,
+                                     src),
+            CpuAggregateInPandas(["k"], [spec], src),
+            CpuWindowInPandas(["k"], [spec], src)):
+        assert not isinstance(accelerate(plan, conf()), TpuExec)
+
+
+# -- out-of-process worker daemon (reference python/rapids/daemon.py) --------
+def test_worker_pool_roundtrip_and_reuse():
+    from spark_rapids_tpu.pyudf.daemon import PythonWorkerPool
+    pool = PythonWorkerPool(max_workers=1)
+    try:
+        df = pd.DataFrame({"x": pd.array([1, 2, 3], dtype="Int64")})
+        import os as _os
+        out1 = pool.run_udf(
+            lambda f: pd.DataFrame({"y": f["x"] * 2,
+                                    "pid": _os.getpid()}), df)
+        assert out1["y"].tolist() == [2, 4, 6]
+        # same worker process serves the second call
+        out2 = pool.run_udf(
+            lambda f: pd.DataFrame({"n": [len(f)],
+                                    "pid": [_os.getpid()]}), df)
+        assert out2["n"].tolist() == [3]
+        assert out1["pid"].iloc[0] == out2["pid"].iloc[0]
+    finally:
+        pool.close()
+
+
+def test_worker_pool_propagates_udf_errors_and_reuses_worker():
+    from spark_rapids_tpu.pyudf.daemon import (
+        PythonUdfError, PythonWorkerPool)
+
+    def boom(frame):
+        raise ValueError("udf exploded")
+
+    pool = PythonWorkerPool(max_workers=1)
+    try:
+        with pytest.raises(PythonUdfError, match="udf exploded"):
+            pool.run_udf(boom, pd.DataFrame({"x": [1]}))
+        # the healthy worker survived the UDF error and serves again —
+        # no respawn, no leaked slot (would deadlock with max_workers=1)
+        out = pool.run_udf(lambda f: pd.DataFrame({"n": [len(f)]}),
+                           pd.DataFrame({"x": [1, 2]}))
+        assert out["n"].tolist() == [2]
+    finally:
+        pool.close()
+
+
+def test_worker_pool_unpicklable_fn_does_not_leak_slot():
+    from spark_rapids_tpu.pyudf.daemon import PythonWorkerPool
+    pool = PythonWorkerPool(max_workers=1)
+    try:
+        with pytest.raises(Exception, match="[Pp]ickl"):
+            pool.run_udf(lambda f, s=threading.Lock(): f,
+                         pd.DataFrame({"x": [1]}))
+        out = pool.run_udf(lambda f: pd.DataFrame({"n": [len(f)]}),
+                           pd.DataFrame({"x": [1]}))
+        assert out["n"].tolist() == [1]
+    finally:
+        pool.close()
+
+
+def test_worker_pins_cpu_platform():
+    """Daemon workers must not steal the single-process TPU chip: the
+    worker env pins JAX to CPU unless spark.rapids.python.onTpu.enabled."""
+    from spark_rapids_tpu.pyudf.daemon import PythonWorkerPool
+
+    def probe(frame):
+        import jax
+        return pd.DataFrame({"platform": [jax.devices()[0].platform]})
+
+    pool = PythonWorkerPool(max_workers=1)
+    try:
+        out = pool.run_udf(probe, pd.DataFrame({"x": [0]}))
+        assert out["platform"].tolist() == ["cpu"]
+    finally:
+        pool.close()
+
+
+def test_arrow_eval_python_via_daemon_parity():
+    from spark_rapids_tpu.pyudf import CpuArrowEvalPython, pandas_udf
+    from spark_rapids_tpu.pyudf.daemon import PythonWorkerPool
+    from spark_rapids_tpu.pyudf.exec import PandasUdfSpec
+
+    @pandas_udf(T.FLOAT64)
+    def vscale(x: pd.Series) -> pd.Series:
+        return x.astype("Float64") * 2.5
+
+    spec = PandasUdfSpec("scaled", vscale, T.FLOAT64, (col("a"),))
+    src = CpuSource.from_pandas(_df(), num_partitions=2)
+    plan = CpuArrowEvalPython([spec], src)
+    c = conf(**{"spark.rapids.sql.exec.CpuArrowEvalPython": True,
+                "spark.rapids.python.daemon.enabled": True,
+                "spark.rapids.python.concurrentPythonWorkers": 1})
+    try:
+        _compare(plan, c)
+    finally:
+        PythonWorkerPool.reset()
