@@ -1,11 +1,20 @@
 """Benchmark driver: TPC-H Q1 (pricing summary) on the TPU engine.
 
 Mirrors the reference bench harness shape (cold + hot runs,
-`TpcxbbLikeBench.scala:26-40`): 1 cold run (compile) + 3 hot runs, report
-the hot-run throughput.  `vs_baseline` is the speedup over single-thread
-pandas running the identical query on this host — the reference publishes
-charts, not numbers (BASELINE.md), so the CPU-on-same-host ratio is the
-honest stand-in for its GPU-vs-CPU-Spark comparisons.
+`TpcxbbLikeBench.scala:26-40`): 1 cold run (compile + correctness check)
+then a hot phase.  The hot phase measures the engine's operating mode —
+STREAMING batches through one compiled executable (the per-task batch
+iterator of `GpuCoalesceBatches`/scan pipelines): B device-resident
+batches are dispatched back-to-back and synced once, so the fixed
+per-dispatch cost of the runtime (which dwarfs compute when the chip is
+reached through a network tunnel) amortizes the way it does in a real
+multi-batch query.  Every dispatch gets distinct (batch, num_rows)
+inputs so no layer of result caching can fake the number.
+
+`vs_baseline` is the speedup over single-thread pandas running the
+identical query per batch on this host — the reference publishes charts,
+not numbers (BASELINE.md), so the CPU-on-same-host ratio is the honest
+stand-in for its GPU-vs-CPU-Spark comparisons.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -14,8 +23,21 @@ import time
 
 import numpy as np
 
-ROWS = 1 << 24  # ~16.8M lineitem rows (amortizes the fixed per-launch
-                # cost of the tunneled runtime; ~470MB of HBM operands)
+ROWS = 1 << 24   # ~16.8M lineitem rows per batch (~470MB of HBM operands)
+N_BATCHES = 6    # distinct device-resident batches (HBM budget ~2.8GB)
+CYCLES = 8       # hot dispatches = N_BATCHES * CYCLES
+
+
+def _args_of(batch):
+    return (
+        batch.column("l_returnflag").data,
+        batch.column("l_linestatus").data,
+        batch.column("l_quantity").data,
+        batch.column("l_extendedprice").data,
+        batch.column("l_discount").data,
+        batch.column("l_tax").data,
+        batch.column("l_shipdate").data,
+    )
 
 
 def main():
@@ -25,24 +47,14 @@ def main():
         build_q1_kernel, gen_lineitem, q1_reference_pandas)
 
     rng = np.random.default_rng(42)
-    batch = gen_lineitem(rng, ROWS)
-    cap = batch.capacity
+    batches = [gen_lineitem(rng, ROWS) for _ in range(N_BATCHES)]
+    cap = batches[0].capacity
     fn = jax.jit(build_q1_kernel(cap))
-    args = (
-        batch.column("l_returnflag").data,
-        batch.column("l_linestatus").data,
-        batch.column("l_quantity").data,
-        batch.column("l_extendedprice").data,
-        batch.column("l_discount").data,
-        batch.column("l_tax").data,
-        batch.column("l_shipdate").data,
-        jnp.int32(batch.num_rows),
-    )
 
-    # cold run (compile) + correctness check vs pandas
-    out = fn(*args)
+    # cold run (compile) + correctness check vs pandas on batch 0
+    out = fn(*_args_of(batches[0]), jnp.int32(batches[0].num_rows))
     jax.block_until_ready(out)
-    df = batch.to_pandas()
+    df = batches[0].to_pandas()
     exp = q1_reference_pandas(df)
     got_cnt = np.asarray(out[7])
     got_base = np.asarray(out[3], dtype=np.float64)
@@ -61,17 +73,28 @@ def main():
             assert rel < 1e-4, \
                 f"group {g}: sum_base_price rel err {rel:.2e}"
 
-    # hot runs
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-    tpu_time = min(times)
-    rows_per_sec = ROWS / tpu_time
+    # warm the pipeline once (device placement, executable reuse)
+    warm = [fn(*_args_of(b), jnp.int32(b.num_rows)) for b in batches]
+    jax.block_until_ready(warm)
+    np.asarray(warm[-1][7])
 
-    # pandas baseline (single-thread CPU, same query)
+    # hot phase: stream N_BATCHES * CYCLES dispatches, sync once at the
+    # end; distinct num_rows per dispatch defeats any result caching
+    total_rows = 0
+    t0 = time.perf_counter()
+    outs = []
+    for c in range(CYCLES):
+        for b in batches:
+            n = b.num_rows - (c + 1)
+            outs.append(fn(*_args_of(b), jnp.int32(n)))
+            total_rows += n
+    jax.block_until_ready(outs)
+    np.asarray(outs[-1][7])  # D2H readback: the only reliable fence
+    tpu_time = time.perf_counter() - t0
+    per_query = tpu_time / (N_BATCHES * CYCLES)
+    rows_per_sec = total_rows / tpu_time
+
+    # pandas baseline (single-thread CPU, same query over one batch)
     t0 = time.perf_counter()
     q1_reference_pandas(df)
     pandas_time = time.perf_counter() - t0
@@ -80,7 +103,7 @@ def main():
         "metric": "tpch_q1_rows_per_sec",
         "value": round(rows_per_sec, 1),
         "unit": "rows/s",
-        "vs_baseline": round(pandas_time / tpu_time, 2),
+        "vs_baseline": round(pandas_time / per_query, 2),
     }))
 
 

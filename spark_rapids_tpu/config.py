@@ -191,6 +191,13 @@ UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled", True,
 METRICS_LEVEL = conf("spark.rapids.sql.metrics.level", "MODERATE",
                      "Operator metric detail: ESSENTIAL, MODERATE, DEBUG.")
 
+PALLAS_Q1_ENABLED = conf(
+    "spark.rapids.tpu.pallas.q1.enabled", False,
+    "Use the explicit Pallas kernel for the TPC-H Q1 fused "
+    "scan-filter-aggregate instead of the XLA einsum kernel (measured "
+    "slower on v5e — see ops/pallas_kernels.py; kept as the template "
+    "for non-fusable ops).")
+
 # --- adaptive query execution ----------------------------------------------
 # Spark-owned keys the plugin reads (reference: AQE is driven by Spark's
 # spark.sql.adaptive.* confs; the plugin supplies GpuCustomShuffleReaderExec

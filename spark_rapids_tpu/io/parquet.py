@@ -76,8 +76,7 @@ class ParquetFormat(FormatReader):
     @staticmethod
     def _mode_from_conf(conf) -> str:
         from spark_rapids_tpu.shims import current_shims
-        key = current_shims(conf).parquet_rebase_read_key()
-        return RB.normalize_mode(conf.get(key, "EXCEPTION"))
+        return current_shims(conf).parquet_rebase_read_mode(conf)
 
     def resolve_session(self, conf) -> "ParquetFormat":
         if self._explicit_rebase_mode is not None:
@@ -97,6 +96,13 @@ class ParquetFormat(FormatReader):
         md = f.metadata
         names = [n for n in read_schema.names
                  if n in set(md.schema.to_arrow_schema().names)]
+        if filter_expr is not None and \
+                self.rebase_mode == "LEGACY" and \
+                not RB.is_corrected_file(md.metadata, False):
+            # legacy files store Julian-hybrid day numbers: row-group
+            # stats cannot be compared against proleptic-Gregorian
+            # filter literals — skip pruning, keep exactness
+            filter_expr = None
         keep: list[int] = []
         for rg_idx in range(md.num_row_groups):
             rg = md.row_group(rg_idx)
@@ -157,8 +163,7 @@ class ParquetColumnarWriter:
         mode = opts.rebase_mode
         if mode is None:
             from spark_rapids_tpu.shims import current_shims
-            key = current_shims(conf).parquet_rebase_write_key()
-            mode = conf.get(key, "EXCEPTION")
+            mode = current_shims(conf).parquet_rebase_write_mode(conf)
         self.rebase_mode = RB.normalize_mode(mode)
         if self.rebase_mode not in RB.READ_MODES:
             raise ValueError(

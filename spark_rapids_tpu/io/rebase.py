@@ -140,6 +140,9 @@ def apply_read_rebase(table, kv_meta: Optional[dict], mode: str,
     when pre-cutover values are present.  Returns the (possibly rebased)
     table."""
     mode = normalize_mode(mode)
+    if mode not in READ_MODES:
+        raise ValueError(f"{mode} is not a supported datetime rebase "
+                         "mode (EXCEPTION, CORRECTED, LEGACY)")
     if mode == "CORRECTED":
         return table
     if is_corrected_file(kv_meta, corrected_mode_conf=False):

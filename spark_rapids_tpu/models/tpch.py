@@ -113,7 +113,15 @@ def build_q1_kernel(capacity: int):
          -> (flag6, status6, sums..., counts)
     Output is a fixed 8-slot group table (3 flags x 2 statuses padded to
     8), fully static shapes — the whole query is a single fused XLA
-    computation: the flagship single-chip forward step."""
+    computation: the flagship single-chip forward step.
+
+    With spark.rapids.tpu.pallas.q1.enabled the explicit Pallas kernel
+    (ops/pallas_kernels.py) is returned instead — same contract."""
+    from spark_rapids_tpu import config as C
+    if C.get_active_conf()[C.PALLAS_Q1_ENABLED]:
+        from spark_rapids_tpu.ops.pallas_kernels import (
+            build_q1_kernel_pallas)
+        return build_q1_kernel_pallas(capacity, Q1_CUTOFF_DAYS)
     cap = capacity
 
     def q1_step(flag, status, qty, extprice, disc, tax, shipdate,

@@ -151,16 +151,26 @@ def schema_of_df(df: pd.DataFrame) -> T.Schema:
         elif kind == "f":
             fields.append(T.Field(name, T.from_numpy_dtype(s.dtype)))
         else:
-            # Spark infers DateType from python date objects
+            # Spark infers DateType from python date objects.  Sampled
+            # check with early exit: genuine string columns bail on the
+            # first value instead of materializing dropna() of millions
+            # of rows
             import datetime as _dt
-            non_null = s.dropna()
-            if len(non_null) and all(
-                    isinstance(v, _dt.date)
-                    and not isinstance(v, _dt.datetime)
-                    for v in non_null):
-                fields.append(T.Field(name, T.DATE32))
-            else:
-                fields.append(T.Field(name, T.STRING))
+
+            def _all_dates(series, limit=1000):
+                seen = 0
+                for v in series:
+                    if pd.isna(v):
+                        continue
+                    if not (isinstance(v, _dt.date)
+                            and not isinstance(v, _dt.datetime)):
+                        return False
+                    seen += 1
+                    if seen >= limit:
+                        break
+                return seen > 0
+            fields.append(T.Field(
+                name, T.DATE32 if _all_dates(s) else T.STRING))
     return T.Schema(tuple(fields))
 
 

@@ -354,8 +354,9 @@ def _tag_file_scan(meta) -> None:
         # version-variant, so it routes through the shim layer
         from spark_rapids_tpu.io import rebase as RB
         from spark_rapids_tpu.shims import current_shims
-        key = current_shims(meta.conf).parquet_rebase_read_key()
-        mode = RB.normalize_mode(meta.conf.get(key, "EXCEPTION"))
+        shims = current_shims(meta.conf)
+        key = shims.parquet_rebase_read_key()
+        mode = shims.parquet_rebase_read_mode(meta.conf)
         if mode == "LEGACY":
             meta.will_not_work_on_tpu(
                 f"legacy datetime rebase requested via {key}")
@@ -388,8 +389,9 @@ def _tag_write_files(meta) -> None:
         # Gregorian->Julian rebase (reference GpuParquetFileFormat.scala:83)
         from spark_rapids_tpu.io import rebase as RB
         from spark_rapids_tpu.shims import current_shims
-        key = current_shims(meta.conf).parquet_rebase_write_key()
-        mode = RB.normalize_mode(meta.conf.get(key, "EXCEPTION"))
+        shims = current_shims(meta.conf)
+        key = shims.parquet_rebase_write_key()
+        mode = shims.parquet_rebase_write_mode(meta.conf)
         if mode == "LEGACY":
             meta.will_not_work_on_tpu(
                 "LEGACY rebase mode for dates and timestamps "
@@ -412,8 +414,8 @@ def _conv_write_files(meta, kids) -> TpuExec:
         from spark_rapids_tpu.shims import current_shims
         opts = node.options or ParquetWriterOptions()
         if opts.rebase_mode is None:
-            key = current_shims(meta.conf).parquet_rebase_write_key()
-            mode = RB.normalize_mode(meta.conf.get(key, "EXCEPTION"))
+            mode = current_shims(meta.conf).parquet_rebase_write_mode(
+                meta.conf)
             node = copy.copy(node)
             node.options = dataclasses.replace(opts, rebase_mode=mode)
     return TpuWriteFilesExec(node, kids[0])

@@ -87,6 +87,9 @@ class PythonWorkerPool:
         self._idle: "Queue[_Worker]" = Queue()
         self._slots = threading.Semaphore(self.max_workers)
         self._closed = False
+        # guards _closed vs the idle queue: a _checkin racing close()
+        # must not park a live worker in an already-drained queue
+        self._state_lock = threading.Lock()
         self._settings = (max_workers, tuple(sorted(
             (env_extra or {}).items())))
         self._env = dict(os.environ)
@@ -157,9 +160,11 @@ class PythonWorkerPool:
 
     def _checkin(self, w: _Worker, reusable: bool) -> None:
         try:
-            if reusable and w.alive() and not self._closed:
-                self._idle.put(w)
-            else:
+            with self._state_lock:
+                keep = reusable and w.alive() and not self._closed
+                if keep:
+                    self._idle.put(w)
+            if not keep:
                 w.close()
         finally:
             self._slots.release()
@@ -183,15 +188,20 @@ class PythonWorkerPool:
 
     def close(self) -> None:
         # checked-out workers are closed by their _checkin (which sees
-        # _closed); only the idle ones are drained here
-        self._closed = True
-        while True:
+        # _closed under the same lock); only the idle ones drain here
+        with self._state_lock:
+            self._closed = True
+            drained = []
+            while True:
+                try:
+                    drained.append(self._idle.get_nowait())
+                except Empty:
+                    break
+        for w in drained:
             try:
-                self._idle.get_nowait().close()
-            except Empty:
-                break
+                w.close()
             except Exception:  # noqa: BLE001
-                break
+                pass
 
 
 def _worker_env_from_conf(conf) -> dict:

@@ -47,10 +47,10 @@ def _eval_udfs(df: pd.DataFrame, udfs: Sequence[PandasUdfSpec],
                input_schema: T.Schema) -> pd.DataFrame:
     from spark_rapids_tpu import config as C
     from spark_rapids_tpu.plan.cpu_eval import cpu_eval, nullable_dtype
-    out = df.copy()
     sem = PythonWorkerSemaphore.get()
     if C.get_active_conf()[C.PYTHON_DAEMON_ENABLED]:
         return _eval_udfs_daemon(df, udfs, input_schema, sem)
+    out = df.copy()
     for u in udfs:
         args = [cpu_eval(a, df, input_schema) for a in u.args]
         with sem.held():
@@ -67,6 +67,7 @@ def _eval_udfs_daemon(df: pd.DataFrame, udfs: Sequence[PandasUdfSpec],
     (pyudf/daemon.py): the worker computes only the result columns; the
     driver merges them (smaller pipe payloads than echoing the input)."""
     from spark_rapids_tpu.plan.cpu_eval import cpu_eval, nullable_dtype
+    from spark_rapids_tpu.plan.pruning import expr_refs
     from spark_rapids_tpu.pyudf.daemon import PythonWorkerPool
     specs = [(u.name, u.fn, tuple(u.args)) for u in udfs]
 
@@ -79,9 +80,15 @@ def _eval_udfs_daemon(df: pd.DataFrame, udfs: Sequence[PandasUdfSpec],
             res[name] = vals
         return pd.DataFrame(res, index=frame.index)
 
+    # ship only the columns the UDF args reference — the pipe payload,
+    # not the batch width, should bound the round-trip cost
+    needed = set()
+    for u in udfs:
+        needed |= expr_refs(list(u.args))
+    shipped = df[[c for c in df.columns if c in needed]]
     pool = PythonWorkerPool.get()
     with sem.held():
-        res = pool.run_udf(worker_side, df)
+        res = pool.run_udf(worker_side, shipped)
     out = df.copy()
     for u in udfs:
         out[u.name] = pd.Series(res[u.name].values, index=df.index).astype(
@@ -351,11 +358,9 @@ class CpuFlatMapGroupsInPandas(CpuNode):
         return f"CpuFlatMapGroupsInPandas(keys={self.keys})"
 
     def execute(self):
-        parts = [df for it in self.child.execute() for df in it]
-        df = (pd.concat(parts, ignore_index=True) if parts else
-              _empty_of(self.child.output_schema()))
-        out = _flat_map_groups(df, self.keys, self.fn, self._schema)
-        return [iter([normalize_df(out, self._schema)])]
+        out = _flat_map_groups(_gather_cpu(self.child), self.keys,
+                               self.fn, self._schema)
+        return _single_partition(out, self._schema)
 
 
 class CpuAggregateInPandas(CpuNode):
@@ -382,13 +387,10 @@ class CpuAggregateInPandas(CpuNode):
                 f"udfs={[u.name for u in self.udfs]})")
 
     def execute(self):
-        cs = self.child.output_schema()
-        parts = [df for it in self.child.execute() for df in it]
-        df = (pd.concat(parts, ignore_index=True) if parts else
-              _empty_of(cs))
-        out = _aggregate_in_pandas(df, self.keys, self.udfs, cs,
+        out = _aggregate_in_pandas(_gather_cpu(self.child), self.keys,
+                                   self.udfs, self.child.output_schema(),
                                    self._schema)
-        return [iter([normalize_df(out, self._schema)])]
+        return _single_partition(out, self._schema)
 
 
 class CpuWindowInPandas(CpuNode):
@@ -412,12 +414,9 @@ class CpuWindowInPandas(CpuNode):
         return f"CpuWindowInPandas(partitionBy={self.part_keys})"
 
     def execute(self):
-        cs = self.child.output_schema()
-        parts = [df for it in self.child.execute() for df in it]
-        df = (pd.concat(parts, ignore_index=True) if parts else
-              _empty_of(cs))
-        out = _window_in_pandas(df, self.part_keys, self.udfs, cs)
-        return [iter([normalize_df(out, self._schema)])]
+        out = _window_in_pandas(_gather_cpu(self.child), self.part_keys,
+                                self.udfs, self.child.output_schema())
+        return _single_partition(out, self._schema)
 
 
 class CpuFlatMapCoGroupsInPandas(CpuNode):
@@ -443,20 +442,28 @@ class CpuFlatMapCoGroupsInPandas(CpuNode):
                 f"{self.right_keys})")
 
     def execute(self):
-        lparts = [df for it in self.children[0].execute() for df in it]
-        rparts = [df for it in self.children[1].execute() for df in it]
-        ldf = (pd.concat(lparts, ignore_index=True) if lparts else
-               _empty_of(self.children[0].output_schema()))
-        rdf = (pd.concat(rparts, ignore_index=True) if rparts else
-               _empty_of(self.children[1].output_schema()))
-        out = _cogroup_apply(ldf, rdf, self.left_keys, self.right_keys,
-                             self.fn, self._schema)
-        return [iter([normalize_df(out, self._schema)])]
+        out = _cogroup_apply(
+            _gather_cpu(self.children[0]), _gather_cpu(self.children[1]),
+            self.left_keys, self.right_keys, self.fn, self._schema)
+        return _single_partition(out, self._schema)
 
 
 def _empty_of(schema: T.Schema) -> pd.DataFrame:
     from spark_rapids_tpu.plan.nodes import empty_df
     return empty_df(schema)
+
+
+def _gather_cpu(node: CpuNode) -> pd.DataFrame:
+    """Concatenate every partition of a CPU child into one frame (the
+    grouped execs collapse to a single partition, like CpuAggregate)."""
+    parts = [df for it in node.execute() for df in it]
+    if not parts:
+        return _empty_of(node.output_schema())
+    return pd.concat(parts, ignore_index=True)
+
+
+def _single_partition(out: pd.DataFrame, schema: T.Schema) -> list:
+    return [iter([normalize_df(out, schema)])]
 
 
 class _GatherAllPythonExec(TpuExec):

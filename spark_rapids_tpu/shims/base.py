@@ -135,6 +135,23 @@ class SparkShims:
     def parquet_rebase_write_key(self) -> str:
         return "spark.sql.legacy.parquet.rebaseDateTimeInWrite"
 
+    def parquet_rebase_default(self) -> str:
+        """Default mode when the key is unset: 3.0.0's boolean keys
+        default to false (read/write verbatim = CORRECTED); 3.0.1+
+        mode keys default to EXCEPTION."""
+        return "CORRECTED"
+
+    def parquet_rebase_read_mode(self, conf) -> str:
+        from spark_rapids_tpu.io import rebase as RB
+        return RB.normalize_mode(conf.get(
+            self.parquet_rebase_read_key(), self.parquet_rebase_default()))
+
+    def parquet_rebase_write_mode(self, conf) -> str:
+        from spark_rapids_tpu.io import rebase as RB
+        return RB.normalize_mode(conf.get(
+            self.parquet_rebase_write_key(),
+            self.parquet_rebase_default()))
+
     # -- rule extensions ----------------------------------------------------
     def extra_exec_rules(self) -> dict:
         """Per-version exec replacement rules added on top of the common

@@ -42,6 +42,9 @@ class Spark301Shims(Spark300Shims):
     def parquet_rebase_write_key(self) -> str:
         return "spark.sql.legacy.parquet.datetimeRebaseModeInWrite"
 
+    def parquet_rebase_default(self) -> str:
+        return "EXCEPTION"
+
 
 class Spark302Shims(Spark301Shims):
     """Spark 3.0.2 (reference `shims/spark302`): identical surface to

@@ -58,11 +58,22 @@ def _len_of(start, end):
     return A.Subtract(end, start)
 
 
+def _variadic_minmax(le_builder):
+    def build(*args):
+        if len(args) < 2:
+            raise UdfCompileError("min/max need >= 2 args")
+        acc = args[0]
+        for nxt in args[1:]:
+            acc = CO.If(le_builder(acc, nxt), acc, nxt)
+        return acc
+    return build
+
+
 _GLOBAL_CALLS: dict[str, Callable[..., Expression]] = {
     "abs": lambda x: A.Abs(x),
     "len": lambda x: S.Length(x),
-    "min": lambda a, b: CO.If(P.LessThanOrEqual(a, b), a, b),
-    "max": lambda a, b: CO.If(P.GreaterThanOrEqual(a, b), a, b),
+    "min": _variadic_minmax(P.LessThanOrEqual),
+    "max": _variadic_minmax(P.GreaterThanOrEqual),
     "round": lambda x, nd=None: MX.Round(
         x, nd if nd is not None else Literal.of(0)),
     "float": lambda x: Cast(x, T.FLOAT64),
@@ -107,6 +118,14 @@ _METHOD_CALLS: dict[str, Callable[..., Expression]] = {
     "replace": lambda s, a, b: S.StringReplace(s, a, b),
     "find": lambda s, sub: A.Subtract(
         S.StringLocate(sub, s, Literal.of(1)), Literal.of(1)),
+    # python ljust/rjust never truncate; Spark's pads do — guard on
+    # length so per-row results match python exactly
+    "ljust": lambda s, n, pad=None: CO.If(
+        P.GreaterThanOrEqual(S.Length(s), n), s,
+        S.RPad(s, n, pad if pad is not None else Literal.of(" "))),
+    "rjust": lambda s, n, pad=None: CO.If(
+        P.GreaterThanOrEqual(S.Length(s), n), s,
+        S.LPad(s, n, pad if pad is not None else Literal.of(" "))),
 }
 
 # Python `%` is sign-follows-divisor: exactly Spark's Pmod, NOT
@@ -306,8 +325,32 @@ class _Interpreter:
                 stack.append(_fn_substring(
                     seq, start_e,
                     None if stop is None else _as_expr(stop)))
+            elif op == "CONTAINS_OP":
+                container = stack.pop()
+                item = stack.pop()
+                if isinstance(container, (tuple, list, set, frozenset)):
+                    # `x in (a, b, c)` over literal constants -> InSet
+                    vals = tuple(container)
+                    if not all(isinstance(v, (bool, int, float, str))
+                               for v in vals):
+                        raise UdfCompileError("non-literal IN set")
+                    e = P.InSet(_as_expr(item), vals)
+                elif isinstance(item, str):
+                    # `"lit" in s` -> Contains (literal pattern only,
+                    # like the reference's regexp-as-literal handling)
+                    e = S.Contains(_as_expr(container), Literal.of(item))
+                else:
+                    raise UdfCompileError("unsupported `in` operands")
+                stack.append(P.Not(e) if ins.arg == 1 else e)
             elif op == "UNARY_NEGATIVE":
                 stack.append(A.UnaryMinus(_as_expr(stack.pop())))
+            elif op == "UNARY_POSITIVE":
+                stack.append(_as_expr(stack.pop()))
+            elif op == "CALL_INTRINSIC_1":
+                if ins.argrepr == "INTRINSIC_UNARY_POSITIVE":
+                    stack.append(_as_expr(stack.pop()))
+                else:
+                    raise UdfCompileError(f"intrinsic {ins.argrepr}")
             elif op == "UNARY_NOT":
                 stack.append(P.Not(_as_expr(stack.pop())))
             elif op == "TO_BOOL":

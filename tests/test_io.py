@@ -495,3 +495,14 @@ def test_rebase_timestamp_micros_roundtrip():
     np.testing.assert_array_equal(back, micros)
     # intra-day component survives the rebase
     assert ((leg % 86400000000) == (micros % 86400000000)).all()
+
+
+def test_parquet_rebase_default_is_shim_versioned(tmp_path):
+    """Spark 3.0.0's boolean-era rebase keys default to false (read
+    verbatim = CORRECTED); 3.0.1+ mode keys default to EXCEPTION — the
+    shim layer owns the default (reference shims encode per-version
+    behavior drift)."""
+    stored = _write_legacy_file(tmp_path / "t.parquet")
+    c300 = conf(**{"spark.rapids.tpu.sparkVersion": "3.0.0"})
+    df = collect(accelerate(tio.read_parquet(str(tmp_path)), c300))
+    assert int(df["d"].iloc[0]) == stored  # verbatim, no raise

@@ -337,10 +337,15 @@ def test_cpu_udf_real_bugs_surface():
 
 
 def test_fallback_on_arity_mismatch():
-    # min() with 3 args has no 3-ary builder; must fall back, not raise
+    # variadic min/max compile for >=2 scalars; a 1-arg min (python
+    # would demand an iterable) must fall back, not raise
     def f(a, b, c):
         return min(a, b, c)
-    assert compile_udf(f, [col("a"), col("b"), col("a")]) is None
+    assert compile_udf(f, [col("a"), col("b"), col("a")]) is not None
+
+    def g(a):
+        return min(a)
+    assert compile_udf(g, [col("a")]) is None
 
 
 def test_fallback_on_shadowed_builtin():
@@ -566,3 +571,69 @@ def test_arrow_eval_python_via_daemon_parity():
         _compare(plan, c)
     finally:
         PythonWorkerPool.reset()
+
+
+# -- expanded opcode coverage (reference OpcodeSuite.scala style: compile
+# must succeed AND per-row results must match running the python) ----------
+def _compile_and_compare(fn, ret_type, cols_):
+    """Golden rule for the compiler: the compiled expression's results
+    must equal the raw python function applied row-by-row.  Null-free
+    inputs: a compiled expression null-PROPAGATES where raw python sees
+    None as a value (`None in (1,)` is False) — Spark's UDF null
+    semantics vs python's, same trade the reference makes for primitive
+    JVM lambdas."""
+    e = compile_udf(fn, [col(c) for c in cols_])
+    assert e is not None, "expected UDF to compile"
+    udf = tpu_udf(ret_type)(fn)
+    src = CpuSource.from_pandas(pd.DataFrame({
+        "a": pd.array([1, 5, 7, -3, 10], dtype="Int64"),
+        "b": pd.array([2.0, -1.5, 4.0, 9.25, 0.5], dtype="Float64"),
+        "s": pd.array(["Hi", "world", "or bit", "Ab", "zzz"],
+                      dtype=object),
+    }))
+    plan = CpuProject([udf(*[col(c) for c in cols_]).alias("r")], src)
+    tpu_plan = _compare(plan)
+    from spark_rapids_tpu.exec.base import TpuExec
+    assert isinstance(tpu_plan, TpuExec)
+
+
+def test_compile_in_tuple_literal():
+    _compile_and_compare(lambda x: x in (1, 5, 99), T.BOOL, ["a"])
+
+
+def test_compile_not_in_tuple_literal():
+    _compile_and_compare(lambda x: x not in (1, 5), T.BOOL, ["a"])
+
+
+def test_compile_substring_contains():
+    _compile_and_compare(lambda s: "or" in s, T.BOOL, ["s"])
+
+
+def test_compile_in_non_literal_set_falls_back():
+    assert compile_udf(lambda x, y: x in (y, 2), [col("a"), col("b")]) \
+        is None
+
+
+def test_compile_boolean_short_circuit():
+    _compile_and_compare(lambda x, y: x > 2 and y > 0, T.BOOL, ["a", "b"])
+    _compile_and_compare(lambda x, y: x > 7 or y < 0, T.BOOL, ["a", "b"])
+
+
+def test_compile_chained_comparison():
+    _compile_and_compare(lambda x: -2 < x < 6, T.BOOL, ["a"])
+
+
+def test_compile_variadic_min_max():
+    _compile_and_compare(lambda x, y: min(x, y, 3), T.FLOAT64, ["a", "b"])
+    _compile_and_compare(lambda x, y: max(x, y, 3), T.FLOAT64, ["a", "b"])
+
+
+def test_compile_ljust_rjust_match_python():
+    # python ljust/rjust never truncate — the long row "world" must
+    # come through unchanged
+    _compile_and_compare(lambda s: s.ljust(4, "_"), T.STRING, ["s"])
+    _compile_and_compare(lambda s: s.rjust(4, "*"), T.STRING, ["s"])
+
+
+def test_compile_unary_positive():
+    _compile_and_compare(lambda x: +x + 1, T.INT64, ["a"])
