@@ -101,7 +101,12 @@ class ParquetFormat(FormatReader):
                 not RB.is_corrected_file(md.metadata, False):
             # legacy files store Julian-hybrid day numbers: row-group
             # stats cannot be compared against proleptic-Gregorian
-            # filter literals — skip pruning, keep exactness
+            # filter literals — skip pruning, keep exactness.
+            # EXCEPTION mode keeps pruning on purpose: the rebase check
+            # runs over DECODED values only, exactly like the reference
+            # (GpuParquetScan decodes the post-pruning blocks and only
+            # then checks isDateTimeRebaseNeededRead), so a pruned
+            # row group never raises there either.
             filter_expr = None
         keep: list[int] = []
         for rg_idx in range(md.num_row_groups):

@@ -42,7 +42,8 @@ SCHEMAS = {
         ("p_promo_sk", T.INT64), ("p_channel_email", T.STRING),
         ("p_channel_event", T.STRING)),
     "store_sales": T.Schema.of(
-        ("ss_sold_date_sk", T.INT64), ("ss_item_sk", T.INT64),
+        ("ss_sold_date_sk", T.INT64), ("ss_sold_time_sk", T.INT64),
+        ("ss_item_sk", T.INT64),
         ("ss_customer_sk", T.INT64), ("ss_cdemo_sk", T.INT64),
         ("ss_hdemo_sk", T.INT64), ("ss_addr_sk", T.INT64),
         ("ss_store_sk", T.INT64), ("ss_promo_sk", T.INT64),
@@ -52,7 +53,64 @@ SCHEMAS = {
         ("ss_ext_discount_amt", T.FLOAT64),
         ("ss_ext_list_price", T.FLOAT64),
         ("ss_coupon_amt", T.FLOAT64), ("ss_net_profit", T.FLOAT64),
-        ("ss_ext_wholesale_cost", T.FLOAT64)),
+        ("ss_ext_wholesale_cost", T.FLOAT64),
+        ("ss_net_paid", T.FLOAT64)),
+    "time_dim": T.Schema.of(
+        ("t_time_sk", T.INT64), ("t_hour", T.INT32),
+        ("t_minute", T.INT32)),
+    "customer_demographics": T.Schema.of(
+        ("cd_demo_sk", T.INT64), ("cd_gender", T.STRING),
+        ("cd_marital_status", T.STRING),
+        ("cd_education_status", T.STRING), ("cd_dep_count", T.INT32)),
+    "warehouse": T.Schema.of(
+        ("w_warehouse_sk", T.INT64), ("w_warehouse_name", T.STRING),
+        ("w_state", T.STRING), ("w_warehouse_sq_ft", T.INT32)),
+    "catalog_sales": T.Schema.of(
+        ("cs_sold_date_sk", T.INT64), ("cs_sold_time_sk", T.INT64),
+        ("cs_ship_date_sk", T.INT64),
+        ("cs_bill_customer_sk", T.INT64), ("cs_bill_cdemo_sk", T.INT64),
+        ("cs_item_sk", T.INT64), ("cs_order_number", T.INT64),
+        ("cs_warehouse_sk", T.INT64), ("cs_promo_sk", T.INT64),
+        ("cs_quantity", T.INT32), ("cs_list_price", T.FLOAT64),
+        ("cs_sales_price", T.FLOAT64),
+        ("cs_ext_sales_price", T.FLOAT64),
+        ("cs_ext_discount_amt", T.FLOAT64),
+        ("cs_ext_list_price", T.FLOAT64),
+        ("cs_ext_ship_cost", T.FLOAT64), ("cs_net_profit", T.FLOAT64),
+        ("cs_net_paid", T.FLOAT64)),
+    "web_sales": T.Schema.of(
+        ("ws_sold_date_sk", T.INT64), ("ws_sold_time_sk", T.INT64),
+        ("ws_ship_date_sk", T.INT64),
+        ("ws_bill_customer_sk", T.INT64), ("ws_item_sk", T.INT64),
+        ("ws_order_number", T.INT64), ("ws_warehouse_sk", T.INT64),
+        ("ws_web_site_sk", T.INT64), ("ws_promo_sk", T.INT64),
+        ("ws_quantity", T.INT32), ("ws_list_price", T.FLOAT64),
+        ("ws_sales_price", T.FLOAT64),
+        ("ws_ext_sales_price", T.FLOAT64),
+        ("ws_ext_discount_amt", T.FLOAT64),
+        ("ws_ext_list_price", T.FLOAT64),
+        ("ws_ext_ship_cost", T.FLOAT64), ("ws_net_profit", T.FLOAT64),
+        ("ws_net_paid", T.FLOAT64)),
+    "store_returns": T.Schema.of(
+        ("sr_returned_date_sk", T.INT64), ("sr_item_sk", T.INT64),
+        ("sr_customer_sk", T.INT64), ("sr_ticket_number", T.INT64),
+        ("sr_store_sk", T.INT64), ("sr_return_quantity", T.INT32),
+        ("sr_return_amt", T.FLOAT64), ("sr_net_loss", T.FLOAT64)),
+    "catalog_returns": T.Schema.of(
+        ("cr_returned_date_sk", T.INT64), ("cr_item_sk", T.INT64),
+        ("cr_order_number", T.INT64),
+        ("cr_returning_customer_sk", T.INT64),
+        ("cr_return_quantity", T.INT32),
+        ("cr_return_amount", T.FLOAT64)),
+    "web_returns": T.Schema.of(
+        ("wr_returned_date_sk", T.INT64), ("wr_item_sk", T.INT64),
+        ("wr_order_number", T.INT64),
+        ("wr_returning_customer_sk", T.INT64),
+        ("wr_return_quantity", T.INT32), ("wr_return_amt", T.FLOAT64)),
+    "inventory": T.Schema.of(
+        ("inv_date_sk", T.INT64), ("inv_item_sk", T.INT64),
+        ("inv_warehouse_sk", T.INT64),
+        ("inv_quantity_on_hand", T.INT32)),
 }
 
 CATEGORIES = ["Books", "Electronics", "Home", "Jewelry", "Music",
@@ -152,6 +210,9 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "p_channel_event": np.array(["N", "Y"], dtype=object)[
             (rng.random(n_promo) < 0.12).astype(int)],
     })
+    n_times = 24 * 12  # 5-minute buckets
+    n_cdemo = 1000
+    n_wh = 5
     n = scale
     # a ticket (basket) belongs to exactly one customer, several items —
     # the invariant q68/q73's per-ticket aggregates group on
@@ -162,9 +223,10 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
     sales_price = np.round(list_price * rng.uniform(0.2, 1.0, n), 2)
     store_sales = pd.DataFrame({
         "ss_sold_date_sk": rng.integers(0, n_dates, n).astype(np.int64),
+        "ss_sold_time_sk": rng.integers(0, n_times, n).astype(np.int64),
         "ss_item_sk": rng.integers(0, n_items, n).astype(np.int64),
         "ss_customer_sk": ticket_cust,
-        "ss_cdemo_sk": rng.integers(0, 1000, n).astype(np.int64),
+        "ss_cdemo_sk": rng.integers(0, n_cdemo, n).astype(np.int64),
         "ss_hdemo_sk": rng.integers(0, n_hd, n).astype(np.int64),
         "ss_addr_sk": rng.integers(0, n_addr, n).astype(np.int64),
         "ss_store_sk": rng.integers(0, n_stores, n).astype(np.int64),
@@ -180,11 +242,172 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
                                   _money(rng, 0.0, 50.0, n), 0.0),
         "ss_net_profit": _money(rng, -500.0, 500.0, n),
         "ss_ext_wholesale_cost": _money(rng, 1.0, 100.0, n),
+        "ss_net_paid": np.round(sales_price * qty, 2),
     })
+
+    time_dim = pd.DataFrame({
+        "t_time_sk": np.arange(n_times, dtype=np.int64),
+        "t_hour": (np.arange(n_times) // 12).astype(np.int32),
+        "t_minute": ((np.arange(n_times) % 12) * 5).astype(np.int32),
+    })
+    customer_demographics = pd.DataFrame({
+        "cd_demo_sk": np.arange(n_cdemo, dtype=np.int64),
+        "cd_gender": np.array(["M", "F"], dtype=object)[
+            rng.integers(0, 2, n_cdemo)],
+        "cd_marital_status": np.array(["M", "S", "D", "W", "U"],
+                                      dtype=object)[
+            rng.integers(0, 5, n_cdemo)],
+        "cd_education_status": np.array(
+            ["Primary", "Secondary", "College", "2 yr Degree",
+             "4 yr Degree", "Advanced Degree", "Unknown"], dtype=object)[
+            rng.integers(0, 7, n_cdemo)],
+        "cd_dep_count": rng.integers(0, 7, n_cdemo).astype(np.int32),
+    })
+    warehouse = pd.DataFrame({
+        "w_warehouse_sk": np.arange(n_wh, dtype=np.int64),
+        "w_warehouse_name": np.array(
+            [f"Warehouse {i}" for i in range(n_wh)], dtype=object),
+        "w_state": np.array(STATES, dtype=object)[
+            np.arange(n_wh) % len(STATES)],
+        "w_warehouse_sq_ft": rng.integers(
+            50_000, 1_000_000, n_wh).astype(np.int32),
+    })
+
+
+    def _channel_sales(n_rows, order_div):
+        orders = rng.integers(0, max(n_rows // order_div, 1),
+                              n_rows).astype(np.int64)
+        cust = ((orders * 6271) % n_cust).astype(np.int64)
+        q = rng.integers(1, 101, n_rows).astype(np.int32)
+        lp = _money(rng, 1.0, 250.0, n_rows)
+        sp = np.round(lp * rng.uniform(0.2, 1.0, n_rows), 2)
+        sold = rng.integers(0, n_dates, n_rows).astype(np.int64)
+        return orders, cust, q, lp, sp, sold
+
+    nc = max(n // 2, 1)
+    c_orders, c_cust, c_qty, c_lp, c_sp, c_sold = _channel_sales(nc, 5)
+    # half the catalog rows repeat a store (customer, item) pair so
+    # cross-channel joins (q25/q29/q97 shapes) have real matches
+    take = rng.random(nc) < 0.5
+    src_idx = rng.integers(0, n, nc)
+    cs_cust = np.where(take, ticket_cust[src_idx], c_cust)
+    cs_item = np.where(
+        take, store_sales["ss_item_sk"].to_numpy()[src_idx],
+        rng.integers(0, n_items, nc)).astype(np.int64)
+    catalog_sales = pd.DataFrame({
+        "cs_sold_date_sk": c_sold,
+        "cs_sold_time_sk": rng.integers(0, n_times, nc).astype(np.int64),
+        # shipping lag 1..120 days (q62/q99-style bucketing)
+        "cs_ship_date_sk": np.minimum(
+            c_sold + rng.integers(1, 121, nc), n_dates - 1
+        ).astype(np.int64),
+        "cs_bill_customer_sk": cs_cust,
+        "cs_bill_cdemo_sk": rng.integers(0, n_cdemo, nc).astype(np.int64),
+        "cs_item_sk": cs_item,
+        "cs_order_number": c_orders,
+        "cs_warehouse_sk": rng.integers(0, n_wh, nc).astype(np.int64),
+        "cs_promo_sk": rng.integers(0, n_promo, nc).astype(np.int64),
+        "cs_quantity": c_qty,
+        "cs_list_price": c_lp,
+        "cs_sales_price": c_sp,
+        "cs_ext_sales_price": np.round(c_sp * c_qty, 2),
+        "cs_ext_discount_amt": _money(rng, 0.0, 100.0, nc),
+        "cs_ext_list_price": np.round(c_lp * c_qty, 2),
+        "cs_ext_ship_cost": _money(rng, 0.0, 40.0, nc),
+        "cs_net_profit": _money(rng, -500.0, 500.0, nc),
+        "cs_net_paid": np.round(c_sp * c_qty, 2),
+    })
+
+    nw = max(n // 3, 1)
+    w_orders, w_cust, w_qty, w_lp, w_sp, w_sold = _channel_sales(nw, 4)
+    web_sales = pd.DataFrame({
+        "ws_sold_date_sk": w_sold,
+        "ws_sold_time_sk": rng.integers(0, n_times, nw).astype(np.int64),
+        "ws_ship_date_sk": np.minimum(
+            w_sold + rng.integers(1, 121, nw), n_dates - 1
+        ).astype(np.int64),
+        "ws_bill_customer_sk": w_cust,
+        "ws_item_sk": rng.integers(0, n_items, nw).astype(np.int64),
+        "ws_order_number": w_orders,
+        "ws_warehouse_sk": rng.integers(0, n_wh, nw).astype(np.int64),
+        "ws_web_site_sk": rng.integers(0, 6, nw).astype(np.int64),
+        "ws_promo_sk": rng.integers(0, n_promo, nw).astype(np.int64),
+        "ws_quantity": w_qty,
+        "ws_list_price": w_lp,
+        "ws_sales_price": w_sp,
+        "ws_ext_sales_price": np.round(w_sp * w_qty, 2),
+        "ws_ext_discount_amt": _money(rng, 0.0, 100.0, nw),
+        "ws_ext_list_price": np.round(w_lp * w_qty, 2),
+        "ws_ext_ship_cost": _money(rng, 0.0, 40.0, nw),
+        "ws_net_profit": _money(rng, -500.0, 500.0, nw),
+        "ws_net_paid": np.round(w_sp * w_qty, 2),
+    })
+
+    # returns are samples of sales rows: join keys always match a sale
+    ridx = rng.choice(n, size=max(n // 10, 1), replace=False)
+    rq = np.minimum(rng.integers(1, 20, len(ridx)).astype(np.int32),
+                    qty[ridx])
+    store_returns = pd.DataFrame({
+        "sr_returned_date_sk": np.minimum(
+            store_sales["ss_sold_date_sk"].to_numpy()[ridx]
+            + rng.integers(1, 60, len(ridx)), n_dates - 1
+        ).astype(np.int64),
+        "sr_item_sk": store_sales["ss_item_sk"].to_numpy()[ridx],
+        "sr_customer_sk": store_sales["ss_customer_sk"].to_numpy()[ridx],
+        "sr_ticket_number":
+            store_sales["ss_ticket_number"].to_numpy()[ridx],
+        "sr_store_sk": store_sales["ss_store_sk"].to_numpy()[ridx],
+        "sr_return_quantity": rq,
+        "sr_return_amt": np.round(
+            store_sales["ss_sales_price"].to_numpy()[ridx] * rq, 2),
+        "sr_net_loss": _money(rng, 0.0, 200.0, len(ridx)),
+    })
+    cidx = rng.choice(nc, size=max(nc // 10, 1), replace=False)
+    crq = np.minimum(rng.integers(1, 20, len(cidx)).astype(np.int32),
+                     c_qty[cidx])
+    catalog_returns = pd.DataFrame({
+        "cr_returned_date_sk": np.minimum(
+            c_sold[cidx] + rng.integers(1, 60, len(cidx)), n_dates - 1
+        ).astype(np.int64),
+        "cr_item_sk": catalog_sales["cs_item_sk"].to_numpy()[cidx],
+        "cr_order_number": c_orders[cidx],
+        "cr_returning_customer_sk": cs_cust[cidx],
+        "cr_return_quantity": crq,
+        "cr_return_amount": np.round(c_sp[cidx] * crq, 2),
+    })
+    widx = rng.choice(nw, size=max(nw // 10, 1), replace=False)
+    wrq = np.minimum(rng.integers(1, 20, len(widx)).astype(np.int32),
+                     w_qty[widx])
+    web_returns = pd.DataFrame({
+        "wr_returned_date_sk": np.minimum(
+            w_sold[widx] + rng.integers(1, 60, len(widx)), n_dates - 1
+        ).astype(np.int64),
+        "wr_item_sk": web_sales["ws_item_sk"].to_numpy()[widx],
+        "wr_order_number": w_orders[widx],
+        "wr_returning_customer_sk": w_cust[widx],
+        "wr_return_quantity": wrq,
+        "wr_return_amt": np.round(w_sp[widx] * wrq, 2),
+    })
+
+    ni = max(n // 4, 1)
+    inventory = pd.DataFrame({
+        "inv_date_sk": rng.integers(0, n_dates, ni).astype(np.int64),
+        "inv_item_sk": rng.integers(0, n_items, ni).astype(np.int64),
+        "inv_warehouse_sk": rng.integers(0, n_wh, ni).astype(np.int64),
+        "inv_quantity_on_hand": rng.integers(
+            0, 1000, ni).astype(np.int32),
+    })
+
     return {"date_dim": date_dim, "item": item, "store": store,
             "customer": customer, "customer_address": customer_address,
             "household_demographics": household_demographics,
-            "promotion": promotion, "store_sales": store_sales}
+            "promotion": promotion, "store_sales": store_sales,
+            "time_dim": time_dim,
+            "customer_demographics": customer_demographics,
+            "warehouse": warehouse, "catalog_sales": catalog_sales,
+            "web_sales": web_sales, "store_returns": store_returns,
+            "catalog_returns": catalog_returns,
+            "web_returns": web_returns, "inventory": inventory}
 
 
 def sources(tables: dict[str, pd.DataFrame], num_partitions: int = 1):

@@ -1,17 +1,22 @@
-"""TPC-DS-like query subset (reference
-`integration_tests/.../tpcds/TpcdsLikeSpark.scala` — the classic
-star-join report set: q3, q7-shape, q19, q27-shape, q42, q52, q55, q68,
-q73, q96, q98-shape).  Same plan-tree style as tpch_queries."""
+"""TPC-DS-like query set (reference
+`integration_tests/.../tpcds/TpcdsLikeSpark.scala`).  Same plan-tree
+style as tpch_queries; queries marked "-shape" follow the reference
+query's operator shape over the engine's v0 type matrix (no decimals,
+reduced column sets).  Coverage spans the reference's main families:
+star-join reports, returns-vs-average correlated shapes, multi-channel
+unions, semi/anti-join existence tests, left-outer returns netting,
+shipping-lag bucketing, time-slot pivots, and ratio reports."""
 from __future__ import annotations
 
 from spark_rapids_tpu.exec.joins import JoinType
 from spark_rapids_tpu.exec.sort import asc, desc
-from spark_rapids_tpu.exprs.aggregates import Average, Count, Sum
+from spark_rapids_tpu.exprs.aggregates import Average, Count, Max, Min, Sum
 from spark_rapids_tpu.exprs.base import col, lit
-from spark_rapids_tpu.exprs.predicates import InSet
+from spark_rapids_tpu.exprs.conditional import Coalesce, If
+from spark_rapids_tpu.exprs.predicates import InSet, IsNotNull, IsNull
 from spark_rapids_tpu.plan.nodes import (CpuAggregate, CpuFilter,
                                          CpuHashJoin, CpuLimit, CpuProject,
-                                         CpuSort)
+                                         CpuSort, CpuUnion)
 
 J = JoinType
 
@@ -214,8 +219,556 @@ def q98_shape(t, run):
     return CpuSort([asc(col("i_category")), asc(col("i_item_id"))], agg)
 
 
+
+
+# ---------------------------------------------------------------------------
+# returns / correlated-average shapes
+def q1(t, run):
+    """Customers whose store-return total exceeds 1.2x their store's
+    average (reference q1's correlated subquery, decorrelated into an
+    aggregate-join)."""
+    ctr = CpuAggregate(
+        [col("sr_customer_sk"), col("sr_store_sk")],
+        [Sum(col("sr_return_amt")).alias("ctr_total")],
+        t["store_returns"])
+    avg_ctr = CpuAggregate(
+        [col("sr_store_sk")],
+        [Average(col("ctr_total")).alias("avg_ret")],
+        CpuProject([col("sr_store_sk"), col("ctr_total")], ctr))
+    big = CpuFilter(
+        col("ctr_total") > col("avg_ret") * lit(1.2),
+        _join(ctr, CpuProject(
+            [col("sr_store_sk").alias("st2"), col("avg_ret")], avg_ctr),
+            ["sr_store_sk"], ["st2"]))
+    st = CpuFilter(col("s_state") == lit("TX"), t["store"])
+    j = _join(_join(big, st, ["sr_store_sk"], ["s_store_sk"]),
+              t["customer"], ["sr_customer_sk"], ["c_customer_sk"])
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_customer_id"))],
+        CpuProject([col("c_customer_id")], j)))
+
+
+def q6_shape(t, run):
+    """States of customers buying items priced 1.2x above their
+    category average."""
+    avg_cat = CpuAggregate(
+        [col("i_category")],
+        [Average(col("i_current_price")).alias("avg_p")], t["item"])
+    pricey = CpuFilter(
+        col("i_current_price") > col("avg_p") * lit(1.2),
+        _join(t["item"], CpuProject(
+            [col("i_category").alias("cat2"), col("avg_p")], avg_cat),
+            ["i_category"], ["cat2"]))
+    dd = CpuFilter((col("d_year") == lit(2000)) &
+                   (col("d_moy") == lit(1)), t["date_dim"])
+    j = _join(_join(_join(_join(dd, t["store_sales"],
+                                ["d_date_sk"], ["ss_sold_date_sk"]),
+                          pricey, ["ss_item_sk"], ["i_item_sk"]),
+                    t["customer"],
+                    ["ss_customer_sk"], ["c_customer_sk"]),
+              t["customer_address"],
+              ["c_current_addr_sk"], ["ca_address_sk"])
+    agg = CpuAggregate([col("ca_state")],
+                       [Count(None).alias("cnt")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("cnt")), asc(col("ca_state"))],
+        CpuFilter(col("cnt") >= lit(3), agg)))
+
+
+def q65(t, run):
+    """Store items whose revenue is at most 10% of the store's average
+    item revenue."""
+    sa = CpuAggregate(
+        [col("ss_store_sk"), col("ss_item_sk")],
+        [Sum(col("ss_sales_price")).alias("revenue")], t["store_sales"])
+    sb = CpuAggregate(
+        [col("ss_store_sk")],
+        [Average(col("revenue")).alias("ave")],
+        CpuProject([col("ss_store_sk"), col("revenue")], sa))
+    low = CpuFilter(
+        col("revenue") <= col("ave") * lit(0.1),
+        _join(sa, CpuProject([col("ss_store_sk").alias("sk2"),
+                              col("ave")], sb),
+              ["ss_store_sk"], ["sk2"]))
+    j = _join(_join(low, t["store"], ["ss_store_sk"], ["s_store_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    return CpuLimit(100, CpuSort(
+        [asc(col("s_store_name")), asc(col("i_item_id"))],
+        CpuProject([col("s_store_name"), col("i_item_id"),
+                    col("revenue")], j)))
+
+
+# ---------------------------------------------------------------------------
+# catalog / web channel star joins
+def q15_shape(t, run):
+    """Catalog revenue by customer state for one quarter."""
+    dd = CpuFilter((col("d_year") == lit(2001)) &
+                   (col("d_qoy") == lit(2)), t["date_dim"])
+    j = _join(_join(_join(dd, t["catalog_sales"],
+                          ["d_date_sk"], ["cs_sold_date_sk"]),
+                    t["customer"],
+                    ["cs_bill_customer_sk"], ["c_customer_sk"]),
+              t["customer_address"],
+              ["c_current_addr_sk"], ["ca_address_sk"])
+    agg = CpuAggregate([col("ca_state")],
+                       [Sum(col("cs_sales_price")).alias("total")], j)
+    return CpuLimit(100, CpuSort([asc(col("ca_state"))], agg))
+
+
+def q26(t, run):
+    """Catalog item averages for one demographic slice (q7's catalog
+    twin)."""
+    cd = CpuFilter((col("cd_gender") == lit("M")) &
+                   (col("cd_marital_status") == lit("S")) &
+                   (col("cd_education_status") == lit("College")),
+                   t["customer_demographics"])
+    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
+    j = _join(_join(_join(dd, t["catalog_sales"],
+                          ["d_date_sk"], ["cs_sold_date_sk"]),
+                    cd, ["cs_bill_cdemo_sk"], ["cd_demo_sk"]),
+              t["item"], ["cs_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id")],
+        [Average(col("cs_quantity")).alias("agg1"),
+         Average(col("cs_list_price")).alias("agg2"),
+         Average(col("cs_sales_price")).alias("agg3")], j)
+    return CpuLimit(100, CpuSort([asc(col("i_item_id"))], agg))
+
+
+def q45_shape(t, run):
+    """Web revenue by customer state for one quarter."""
+    dd = CpuFilter((col("d_year") == lit(2001)) &
+                   (col("d_qoy") == lit(2)), t["date_dim"])
+    j = _join(_join(_join(dd, t["web_sales"],
+                          ["d_date_sk"], ["ws_sold_date_sk"]),
+                    t["customer"],
+                    ["ws_bill_customer_sk"], ["c_customer_sk"]),
+              t["customer_address"],
+              ["c_current_addr_sk"], ["ca_address_sk"])
+    agg = CpuAggregate([col("ca_state")],
+                       [Sum(col("ws_sales_price")).alias("total")], j)
+    return CpuLimit(100, CpuSort([asc(col("ca_state"))], agg))
+
+
+def q48_shape(t, run):
+    """Store quantity total across demographic/quantity-band slices."""
+    cd = CpuFilter(
+        ((col("cd_marital_status") == lit("M")) &
+         (col("cd_education_status") == lit("4 yr Degree"))) |
+        ((col("cd_marital_status") == lit("D")) &
+         (col("cd_education_status") == lit("2 yr Degree"))),
+        t["customer_demographics"])
+    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
+    sales = CpuFilter(
+        ((col("ss_quantity") >= lit(1)) &
+         (col("ss_quantity") <= lit(40))) |
+        ((col("ss_quantity") >= lit(61)) &
+         (col("ss_quantity") <= lit(100))), t["store_sales"])
+    j = _join(_join(_join(dd, sales,
+                          ["d_date_sk"], ["ss_sold_date_sk"]),
+                    cd, ["ss_cdemo_sk"], ["cd_demo_sk"]),
+              t["store"], ["ss_store_sk"], ["s_store_sk"])
+    return CpuAggregate([], [Sum(col("ss_quantity")).alias("total")], j)
+
+
+# ---------------------------------------------------------------------------
+# multi-channel unions
+def q33_shape(t, run):
+    """Manufacturer revenue across all three channels for one month
+    (reference q33/q56/q60 family)."""
+    dd = CpuFilter((col("d_year") == lit(1999)) &
+                   (col("d_moy") == lit(3)), t["date_dim"])
+    it = CpuFilter(col("i_category") == lit("Books"), t["item"])
+
+    def channel(sales, date_key, item_key, price):
+        j = _join(_join(dd, t[sales], ["d_date_sk"], [date_key]),
+                  it, [item_key], ["i_item_sk"])
+        return CpuProject(
+            [col("i_manufact_id"),
+             col(price).alias("total_sales")], j)
+
+    u = CpuUnion(channel("store_sales", "ss_sold_date_sk",
+                         "ss_item_sk", "ss_ext_sales_price"),
+                 channel("catalog_sales", "cs_sold_date_sk",
+                         "cs_item_sk", "cs_ext_sales_price"),
+                 channel("web_sales", "ws_sold_date_sk",
+                         "ws_item_sk", "ws_ext_sales_price"))
+    agg = CpuAggregate([col("i_manufact_id")],
+                       [Sum(col("total_sales")).alias("total_sales")], u)
+    return CpuLimit(100, CpuSort([desc(col("total_sales")),
+                                  asc(col("i_manufact_id"))], agg))
+
+
+def q28_shape(t, run):
+    """Six price-band averages over store_sales (reference q28's six
+    bucket subqueries, united instead of cross-joined)."""
+    bands = [(0, 5, 11), (6, 51, 57), (11, 91, 97),
+             (16, 131, 137), (21, 171, 177), (26, 100, 200)]
+    parts = []
+    for i, (qlo, plo, phi) in enumerate(bands):
+        f = CpuFilter(
+            (col("ss_quantity") >= lit(qlo)) &
+            (col("ss_quantity") <= lit(qlo + 4)) &
+            (col("ss_list_price") >= lit(float(plo))) &
+            (col("ss_list_price") <= lit(float(phi))),
+            t["store_sales"])
+        agg = CpuAggregate(
+            [], [Average(col("ss_list_price")).alias("avg_price"),
+                 Count(col("ss_list_price")).alias("cnt")], f)
+        parts.append(CpuProject(
+            [lit(i).alias("bucket"), col("avg_price"), col("cnt")], agg))
+    return CpuSort([asc(col("bucket"))], CpuUnion(*parts))
+
+
+# ---------------------------------------------------------------------------
+# existence tests (semi/anti joins)
+def q16_shape(t, run):
+    """Catalog orders in a date window with no returns: order count +
+    cost sums (reference q16's `not exists` as a LEFT_ANTI join;
+    distinct order count as a per-order pre-aggregate)."""
+    dd = CpuFilter((col("d_year") == lit(2000)) &
+                   (col("d_moy") <= lit(4)), t["date_dim"])
+    sales = _join(dd, t["catalog_sales"],
+                  ["d_date_sk"], ["cs_sold_date_sk"])
+    no_ret = CpuHashJoin(
+        J.LEFT_ANTI, [col("cs_order_number")], [col("cr_order_number")],
+        sales, t["catalog_returns"])
+    per_order = CpuAggregate(
+        [col("cs_order_number")],
+        [Sum(col("cs_ext_ship_cost")).alias("ship_cost"),
+         Sum(col("cs_net_profit")).alias("net_profit")], no_ret)
+    return CpuAggregate(
+        [], [Count(None).alias("order_count"),
+             Sum(col("ship_cost")).alias("total_shipping_cost"),
+             Sum(col("net_profit")).alias("total_net_profit")],
+        per_order)
+
+
+def q37_shape(t, run):
+    """Items in a price band with healthy inventory that sold through
+    catalog (reference q37: inventory + semi-join on catalog sales)."""
+    it = CpuFilter(
+        (col("i_current_price") >= lit(20.0)) &
+        (col("i_current_price") <= lit(50.0)), t["item"])
+    inv = CpuFilter(
+        (col("inv_quantity_on_hand") >= lit(100)) &
+        (col("inv_quantity_on_hand") <= lit(500)), t["inventory"])
+    stocked = _join(it, inv, ["i_item_sk"], ["inv_item_sk"])
+    sold = CpuHashJoin(
+        J.LEFT_SEMI, [col("i_item_sk")], [col("cs_item_sk")],
+        stocked, t["catalog_sales"])
+    agg = CpuAggregate(
+        [col("i_item_id"), col("i_current_price")],
+        [Count(None).alias("stock_rows")], sold)
+    return CpuLimit(100, CpuSort([asc(col("i_item_id"))], agg))
+
+
+def q97(t, run):
+    """Customer-item overlap between store and catalog channels
+    (reference q97: FULL OUTER join of deduplicated channel pairs)."""
+    ssci = CpuAggregate(
+        [col("ss_customer_sk"), col("ss_item_sk")],
+        [Count(None).alias("_s")], t["store_sales"])
+    csci = CpuAggregate(
+        [col("cs_bill_customer_sk"), col("cs_item_sk")],
+        [Count(None).alias("_c")], t["catalog_sales"])
+    j = CpuHashJoin(
+        J.FULL_OUTER,
+        [col("ss_customer_sk"), col("ss_item_sk")],
+        [col("cs_bill_customer_sk"), col("cs_item_sk")], ssci, csci)
+    return CpuAggregate(
+        [],
+        [Sum(If(IsNotNull(col("_s")) & IsNull(col("_c")),
+                lit(1), lit(0))).alias("store_only"),
+         Sum(If(IsNull(col("_s")) & IsNotNull(col("_c")),
+                lit(1), lit(0))).alias("catalog_only"),
+         Sum(If(IsNotNull(col("_s")) & IsNotNull(col("_c")),
+                lit(1), lit(0))).alias("store_and_catalog")], j)
+
+
+# ---------------------------------------------------------------------------
+# returns netting / outer joins
+def q93_shape(t, run):
+    """Actual net paid per customer: sold quantity minus returned
+    quantity (reference q93's LEFT OUTER store_returns netting)."""
+    j = CpuHashJoin(
+        J.LEFT_OUTER,
+        [col("ss_item_sk"), col("ss_ticket_number")],
+        [col("sr_item_sk"), col("sr_ticket_number")],
+        t["store_sales"], t["store_returns"])
+    paid = CpuProject(
+        [col("ss_customer_sk"),
+         If(IsNotNull(col("sr_return_quantity")),
+            (col("ss_quantity") - col("sr_return_quantity"))
+            * col("ss_sales_price"),
+            col("ss_quantity") * col("ss_sales_price")).alias("act_sales")],
+        j)
+    agg = CpuAggregate([col("ss_customer_sk")],
+                       [Sum(col("act_sales")).alias("sumsales")], paid)
+    return CpuLimit(100, CpuSort(
+        [desc(col("sumsales")), asc(col("ss_customer_sk"))], agg))
+
+
+def q40_shape(t, run):
+    """Catalog sales netted against returns by warehouse state, split
+    around a pivot date (reference q40's before/after CASE sums)."""
+    j = CpuHashJoin(
+        J.LEFT_OUTER,
+        [col("cs_order_number"), col("cs_item_sk")],
+        [col("cr_order_number"), col("cr_item_sk")],
+        t["catalog_sales"], t["catalog_returns"])
+    j = _join(_join(j, t["warehouse"],
+                    ["cs_warehouse_sk"], ["w_warehouse_sk"]),
+              CpuFilter(col("d_year") == lit(2000), t["date_dim"]),
+              ["cs_sold_date_sk"], ["d_date_sk"])
+    net = col("cs_sales_price") - Coalesce(
+        (col("cr_return_amount"), lit(0.0)))
+    agg = CpuAggregate(
+        [col("w_state")],
+        [Sum(If(col("d_moy") < lit(6), net, lit(0.0))).alias(
+            "sales_before"),
+         Sum(If(col("d_moy") >= lit(6), net, lit(0.0))).alias(
+            "sales_after")], j)
+    return CpuSort([asc(col("w_state"))], agg)
+
+
+def q25_shape(t, run):
+    """Items sold, returned, then re-bought on catalog (reference q25's
+    three-fact join), with profit rollups."""
+    ss = _join(CpuFilter(col("d_year") == lit(2000), t["date_dim"]),
+               t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"])
+    sr = CpuHashJoin(
+        J.INNER,
+        [col("ss_customer_sk"), col("ss_item_sk"),
+         col("ss_ticket_number")],
+        [col("sr_customer_sk"), col("sr_item_sk"),
+         col("sr_ticket_number")], ss, t["store_returns"])
+    cs = CpuHashJoin(
+        J.INNER,
+        [col("sr_customer_sk"), col("sr_item_sk")],
+        [col("cs_bill_customer_sk"), col("cs_item_sk")],
+        sr, t["catalog_sales"])
+    j = _join(_join(cs, t["store"], ["ss_store_sk"], ["s_store_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id"), col("s_store_id")],
+        [Sum(col("ss_net_profit")).alias("store_sales_profit"),
+         Sum(col("sr_net_loss")).alias("store_returns_loss"),
+         Sum(col("cs_net_profit")).alias("catalog_sales_profit")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_item_id")), asc(col("s_store_id"))], agg))
+
+
+# ---------------------------------------------------------------------------
+# shipping-lag bucketing
+def _lag_buckets(lag, prefix):
+    b = lambda c: Sum(If(c, lit(1), lit(0)))
+    return [
+        b(lag <= lit(30)).alias(f"{prefix}30_days"),
+        b((lag > lit(30)) & (lag <= lit(60))).alias(f"{prefix}60_days"),
+        b((lag > lit(60)) & (lag <= lit(90))).alias(f"{prefix}90_days"),
+        b(lag > lit(90)).alias(f"{prefix}more_days"),
+    ]
+
+
+def q62_shape(t, run):
+    """Web shipping-lag day buckets per warehouse (reference q62)."""
+    j = _join(t["web_sales"], t["warehouse"],
+              ["ws_warehouse_sk"], ["w_warehouse_sk"])
+    lag = col("ws_ship_date_sk") - col("ws_sold_date_sk")
+    agg = CpuAggregate([col("w_warehouse_name")],
+                       _lag_buckets(lag, ""), j)
+    return CpuSort([asc(col("w_warehouse_name"))], agg)
+
+
+def q99_shape(t, run):
+    """Catalog shipping-lag day buckets per warehouse (reference q99)."""
+    j = _join(t["catalog_sales"], t["warehouse"],
+              ["cs_warehouse_sk"], ["w_warehouse_sk"])
+    lag = col("cs_ship_date_sk") - col("cs_sold_date_sk")
+    agg = CpuAggregate([col("w_warehouse_name")],
+                       _lag_buckets(lag, ""), j)
+    return CpuSort([asc(col("w_warehouse_name"))], agg)
+
+
+def q50_shape(t, run):
+    """Store return-lag day buckets per store (reference q50)."""
+    j = CpuHashJoin(
+        J.INNER,
+        [col("ss_item_sk"), col("ss_ticket_number"),
+         col("ss_customer_sk")],
+        [col("sr_item_sk"), col("sr_ticket_number"),
+         col("sr_customer_sk")],
+        t["store_sales"], t["store_returns"])
+    j = _join(j, t["store"], ["ss_store_sk"], ["s_store_sk"])
+    lag = col("sr_returned_date_sk") - col("ss_sold_date_sk")
+    agg = CpuAggregate([col("s_store_name")],
+                       _lag_buckets(lag, ""), j)
+    return CpuSort([asc(col("s_store_name"))], agg)
+
+
+# ---------------------------------------------------------------------------
+# pivots, time slots, ratios
+def q43_shape(t, run):
+    """Day-of-week sales pivot per store (reference q43)."""
+    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
+    j = _join(_join(dd, t["store_sales"],
+                    ["d_date_sk"], ["ss_sold_date_sk"]),
+              t["store"], ["ss_store_sk"], ["s_store_sk"])
+    day = lambda name: Sum(If(col("d_day_name") == lit(name),
+                              col("ss_sales_price"), lit(0.0)))
+    agg = CpuAggregate(
+        [col("s_store_name"), col("s_store_id")],
+        [day("Sunday").alias("sun_sales"),
+         day("Monday").alias("mon_sales"),
+         day("Tuesday").alias("tue_sales"),
+         day("Wednesday").alias("wed_sales"),
+         day("Thursday").alias("thu_sales"),
+         day("Friday").alias("fri_sales"),
+         day("Saturday").alias("sat_sales")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("s_store_name")), asc(col("s_store_id"))], agg))
+
+
+def q88_shape(t, run):
+    """Counts of store sales in four afternoon time slots for one
+    demographic (reference q88's eight-way self-join, as one pivot)."""
+    hd = CpuFilter(col("hd_dep_count") == lit(3),
+                   t["household_demographics"])
+    j = _join(_join(t["store_sales"], hd,
+                    ["ss_hdemo_sk"], ["hd_demo_sk"]),
+              t["time_dim"], ["ss_sold_time_sk"], ["t_time_sk"])
+    slot = lambda h: Sum(If((col("t_hour") == lit(h)), lit(1), lit(0)))
+    return CpuAggregate(
+        [], [slot(12).alias("h12"), slot(13).alias("h13"),
+             slot(14).alias("h14"), slot(15).alias("h15")], j)
+
+
+def q90_shape(t, run):
+    """Web AM/PM order ratio (reference q90)."""
+    j = _join(t["web_sales"], t["time_dim"],
+              ["ws_sold_time_sk"], ["t_time_sk"])
+    counts = CpuAggregate(
+        [], [Sum(If((col("t_hour") >= lit(8)) & (col("t_hour") < lit(12)),
+                    lit(1), lit(0))).alias("amc"),
+             Sum(If((col("t_hour") >= lit(14)) &
+                    (col("t_hour") < lit(18)),
+                    lit(1), lit(0))).alias("pmc")], j)
+    return CpuProject(
+        [col("amc"), col("pmc"),
+         (col("amc") / col("pmc")).alias("am_pm_ratio")], counts)
+
+
+def q61_shape(t, run):
+    """Promotional vs total store revenue ratio for one month
+    (reference q61's two-aggregate cross join via a key literal)."""
+    dd = CpuFilter((col("d_year") == lit(1999)) &
+                   (col("d_moy") == lit(11)), t["date_dim"])
+    base = _join(dd, t["store_sales"],
+                 ["d_date_sk"], ["ss_sold_date_sk"])
+    promo_rows = _join(base, CpuFilter(
+        (col("p_channel_email") == lit("Y")) |
+        (col("p_channel_event") == lit("Y")), t["promotion"]),
+        ["ss_promo_sk"], ["p_promo_sk"])
+    promos = CpuProject(
+        [lit(1).alias("k1"),
+         col("promotions")],
+        CpuAggregate([], [Sum(col("ss_ext_sales_price")).alias(
+            "promotions")], promo_rows))
+    total = CpuProject(
+        [lit(1).alias("k2"), col("total")],
+        CpuAggregate([], [Sum(col("ss_ext_sales_price")).alias(
+            "total")], base))
+    j = _join(promos, total, ["k1"], ["k2"])
+    return CpuProject(
+        [col("promotions"), col("total"),
+         (col("promotions") / col("total") * lit(100.0)).alias(
+             "promo_pct")], j)
+
+
+def q79_shape(t, run):
+    """Per-ticket profile for large stores and high-dependency
+    households (reference q79's q68 sibling)."""
+    dd = CpuFilter(col("d_year") == lit(1999), t["date_dim"])
+    hd = CpuFilter((col("hd_dep_count") == lit(6)) |
+                   (col("hd_vehicle_count") > lit(2)),
+                   t["household_demographics"])
+    st = CpuFilter(col("s_number_employees") >= lit(200), t["store"])
+    j = _join(_join(_join(dd, t["store_sales"],
+                          ["d_date_sk"], ["ss_sold_date_sk"]),
+                    hd, ["ss_hdemo_sk"], ["hd_demo_sk"]),
+              st, ["ss_store_sk"], ["s_store_sk"])
+    per_ticket = CpuAggregate(
+        [col("ss_ticket_number"), col("ss_customer_sk"),
+         col("s_city")],
+        [Sum(col("ss_coupon_amt")).alias("amt"),
+         Sum(col("ss_net_profit")).alias("profit")], j)
+    j2 = _join(per_ticket, t["customer"],
+               ["ss_customer_sk"], ["c_customer_sk"])
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_last_name")), asc(col("c_first_name")),
+         asc(col("ss_ticket_number"))],
+        CpuProject([col("c_last_name"), col("c_first_name"),
+                    col("s_city"), col("ss_ticket_number"),
+                    col("amt"), col("profit")], j2)))
+
+
+def q46_shape(t, run):
+    """Per-ticket city/amount profile on weekend days (reference q46)."""
+    dd = CpuFilter(InSet(col("d_day_name"), ("Saturday", "Sunday")) &
+                   (col("d_year") == lit(1999)), t["date_dim"])
+    hd = CpuFilter((col("hd_dep_count") == lit(4)) |
+                   (col("hd_vehicle_count") == lit(3)),
+                   t["household_demographics"])
+    st = CpuFilter(InSet(col("s_city"), ("Midway", "Fairview")),
+                   t["store"])
+    j = _join(_join(_join(_join(dd, t["store_sales"],
+                                ["d_date_sk"], ["ss_sold_date_sk"]),
+                          hd, ["ss_hdemo_sk"], ["hd_demo_sk"]),
+                    st, ["ss_store_sk"], ["s_store_sk"]),
+              t["customer_address"], ["ss_addr_sk"], ["ca_address_sk"])
+    per_ticket = CpuAggregate(
+        [col("ss_ticket_number"), col("ss_customer_sk"),
+         col("ca_city")],
+        [Sum(col("ss_coupon_amt")).alias("amt"),
+         Sum(col("ss_net_profit")).alias("profit")], j)
+    j2 = _join(per_ticket, t["customer"],
+               ["ss_customer_sk"], ["c_customer_sk"])
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_last_name")), asc(col("ss_ticket_number"))],
+        CpuProject([col("c_last_name"), col("c_first_name"),
+                    col("ca_city"), col("ss_ticket_number"),
+                    col("amt"), col("profit")], j2)))
+
+
+def q92_shape(t, run):
+    """Web sales with discount above 1.3x the item's average discount
+    (reference q92's excess-discount correlated subquery)."""
+    avg_disc = CpuAggregate(
+        [col("ws_item_sk")],
+        [Average(col("ws_ext_discount_amt")).alias("avg_disc")],
+        t["web_sales"])
+    j = _join(t["web_sales"],
+              CpuProject([col("ws_item_sk").alias("isk2"),
+                          col("avg_disc")], avg_disc),
+              ["ws_item_sk"], ["isk2"])
+    excess = CpuFilter(
+        col("ws_ext_discount_amt") > col("avg_disc") * lit(1.3), j)
+    return CpuAggregate(
+        [], [Sum(col("ws_ext_discount_amt")).alias("excess_discount")],
+        excess)
+
+
+
 QUERIES = {
-    "q3": q3, "q7": q7_shape, "q19": q19, "q27": q27_shape,
-    "q42": q42, "q52": q52, "q55": q55, "q68": q68, "q73": q73,
-    "q96": q96, "q98": q98_shape,
+    "q1": q1, "q3": q3, "q6": q6_shape, "q7": q7_shape,
+    "q15": q15_shape, "q16": q16_shape, "q19": q19, "q25": q25_shape,
+    "q26": q26, "q27": q27_shape, "q28": q28_shape, "q33": q33_shape,
+    "q37": q37_shape, "q40": q40_shape, "q42": q42, "q43": q43_shape,
+    "q45": q45_shape, "q46": q46_shape, "q48": q48_shape,
+    "q50": q50_shape, "q52": q52, "q55": q55, "q61": q61_shape,
+    "q62": q62_shape, "q65": q65, "q68": q68, "q73": q73,
+    "q79": q79_shape, "q88": q88_shape, "q90": q90_shape,
+    "q92": q92_shape, "q93": q93_shape, "q96": q96, "q97": q97,
+    "q98": q98_shape, "q99": q99_shape,
 }

@@ -151,23 +151,25 @@ def schema_of_df(df: pd.DataFrame) -> T.Schema:
         elif kind == "f":
             fields.append(T.Field(name, T.from_numpy_dtype(s.dtype)))
         else:
-            # Spark infers DateType from python date objects.  Sampled
-            # check with early exit: genuine string columns bail on the
-            # first value instead of materializing dropna() of millions
-            # of rows
+            # Spark infers DateType from python date objects.  Early
+            # exit on the first non-date: genuine string columns bail
+            # on value one instead of materializing dropna() of
+            # millions of rows; all-date columns still scan fully so a
+            # late string can never be mistyped.
             import datetime as _dt
 
-            def _all_dates(series, limit=1000):
+            def _all_dates(series):
                 seen = 0
                 for v in series:
-                    if pd.isna(v):
-                        continue
+                    try:
+                        if pd.isna(v):
+                            continue
+                    except (TypeError, ValueError):
+                        return False  # array-like element: not a date
                     if not (isinstance(v, _dt.date)
                             and not isinstance(v, _dt.datetime)):
                         return False
                     seen += 1
-                    if seen >= limit:
-                        break
                 return seen > 0
             fields.append(T.Field(
                 name, T.DATE32 if _all_dates(s) else T.STRING))

@@ -11,6 +11,8 @@ declared output schema.
 from __future__ import annotations
 
 import dataclasses
+
+import numpy as np
 from typing import Callable, Iterator, Sequence
 
 import pandas as pd
@@ -85,7 +87,14 @@ def _eval_udfs_daemon(df: pd.DataFrame, udfs: Sequence[PandasUdfSpec],
     needed = set()
     for u in udfs:
         needed |= expr_refs(list(u.args))
-    shipped = df[[c for c in df.columns if c in needed]]
+    cols = [c for c in df.columns if c in needed]
+    if cols:
+        shipped = df[cols]
+    else:
+        # all-literal args: a 0-column frame loses its row count over
+        # Arrow IPC — ship a 1-byte row-count carrier instead
+        shipped = pd.DataFrame(
+            {"__rows__": np.zeros(len(df), np.int8)}, index=df.index)
     pool = PythonWorkerPool.get()
     with sem.held():
         res = pool.run_udf(worker_side, shipped)

@@ -637,3 +637,27 @@ def test_compile_ljust_rjust_match_python():
 
 def test_compile_unary_positive():
     _compile_and_compare(lambda x: +x + 1, T.INT64, ["a"])
+
+
+def test_daemon_udf_with_all_literal_args():
+    """A UDF whose args are all literals still gets the right row count
+    through the worker pipe (0-column frames lose rows over Arrow IPC)."""
+    from spark_rapids_tpu.pyudf import CpuArrowEvalPython, pandas_udf
+    from spark_rapids_tpu.pyudf.daemon import PythonWorkerPool
+    from spark_rapids_tpu.pyudf.exec import PandasUdfSpec
+
+    @pandas_udf(T.FLOAT64)
+    def const(x):
+        return x * 1.0
+
+    spec = PandasUdfSpec("c", const, T.FLOAT64, (lit(2.5),))
+    src = CpuSource.from_pandas(_df())
+    plan = CpuArrowEvalPython([spec], src)
+    c = conf(**{"spark.rapids.sql.exec.CpuArrowEvalPython": True,
+                "spark.rapids.python.daemon.enabled": True,
+                "spark.rapids.python.concurrentPythonWorkers": 1})
+    try:
+        out = collect(accelerate(plan, c))
+        assert out["c"].tolist() == [2.5] * 5
+    finally:
+        PythonWorkerPool.reset()
