@@ -79,6 +79,14 @@ def load_native():
         lib.arena_destroy.argtypes = [p]
         lib.arena_write.argtypes = [p, u64, ctypes.c_char_p, u64]
         lib.arena_read.argtypes = [p, u64, ctypes.c_char_p, u64]
+        lib.rt_crc32.restype = ctypes.c_uint32
+        lib.rt_crc32.argtypes = [ctypes.c_char_p, u64]
+        lib.spill_write.restype = i64
+        lib.spill_write.argtypes = [ctypes.c_char_p, ctypes.c_char_p, u64]
+        lib.spill_read_size.restype = i64
+        lib.spill_read_size.argtypes = [ctypes.c_char_p]
+        lib.spill_read.restype = i64
+        lib.spill_read.argtypes = [ctypes.c_char_p, ctypes.c_char_p, u64]
         _lib = lib
         return _lib
 
@@ -276,3 +284,72 @@ class HostArena:
                 self._lib.arena_destroy(self._buf)
             except Exception:
                 pass
+
+
+# ---------------------------------------------------------------------------
+class SpillCorruptionError(IOError):
+    """A CRC-framed spill file failed its integrity check."""
+
+
+_SPILL_ERRORS = {-1: "cannot open", -2: "truncated header",
+                 -3: "bad magic/version", -4: "payload size mismatch",
+                 -5: "checksum mismatch"}
+
+
+def spill_write(path: str, blob: bytes) -> None:
+    """Write a spill file with CRC framing + fsync (native fast path;
+    Python fallback writes the same format so files interoperate)."""
+    lib = load_native()
+    if lib is not None:
+        rc = lib.spill_write(path.encode(), blob, len(blob))
+        if rc != 0:
+            raise IOError(f"spill write failed ({rc}) for {path}")
+        return
+    import struct
+    import zlib
+    crc = zlib.crc32(blob) & 0xFFFFFFFF
+    with open(path, "wb") as f:
+        f.write(b"TPUS" + struct.pack("<IQI", 1, len(blob), crc) + blob)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def spill_read(path: str) -> bytes:
+    """Read + verify a CRC-framed spill file; raises
+    SpillCorruptionError on any integrity failure instead of handing
+    poisoned bytes to the engine."""
+    lib = load_native()
+    if lib is not None:
+        n = lib.spill_read_size(path.encode())
+        if n < 0:
+            raise SpillCorruptionError(
+                f"spill file {path}: "
+                f"{_SPILL_ERRORS.get(n, 'unreadable')}")
+        buf = ctypes.create_string_buffer(int(n))
+        rc = lib.spill_read(path.encode(), buf, int(n))
+        if rc < 0:
+            raise SpillCorruptionError(
+                f"spill file {path}: {_SPILL_ERRORS.get(rc, 'bad')}")
+        return buf.raw[:n]
+    import struct
+    import zlib
+    with open(path, "rb") as f:
+        hdr = f.read(20)
+        if len(hdr) != 20 or hdr[:4] != b"TPUS":
+            raise SpillCorruptionError(
+                f"spill file {path}: bad magic/version")
+        version, n, crc = struct.unpack("<IQI", hdr[4:])
+        if version != 1:
+            raise SpillCorruptionError(
+                f"spill file {path}: bad magic/version")
+        # a corrupted length field must not drive a huge allocation
+        if n != os.path.getsize(path) - 20:
+            raise SpillCorruptionError(
+                f"spill file {path}: payload size mismatch")
+        blob = f.read(n)
+    if len(blob) != n:
+        raise SpillCorruptionError(
+            f"spill file {path}: payload size mismatch")
+    if (zlib.crc32(blob) & 0xFFFFFFFF) != crc:
+        raise SpillCorruptionError(f"spill file {path}: checksum mismatch")
+    return blob

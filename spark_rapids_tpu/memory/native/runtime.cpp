@@ -197,3 +197,110 @@ void arena_read(void* p, uint64_t off, uint8_t* dst, uint64_t n) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Spill-file IO with integrity framing (the role the JVM's checksummed
+// shuffle/spill writers play; cuDF-side buffers get this from the
+// filesystem layer in the reference).  Format:
+//   magic "TPUS" | u32 version | u64 payload_len | u32 crc32 | payload
+// Written with fsync so a spilled buffer survives a crash of the
+// executor process; read verifies length + CRC and reports corruption
+// instead of handing poisoned bytes to the engine.
+#include <cstdio>
+#if defined(__unix__) || defined(__APPLE__)
+#include <unistd.h>
+#endif
+
+extern "C" {
+
+// C++11 magic-static init: thread-safe even when ctypes calls arrive
+// concurrently with the GIL released
+static const uint32_t* crc32_table_get() {
+  static const uint32_t* table = [] {
+    static uint32_t t[256];
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+    return t;
+  }();
+  return table;
+}
+
+uint32_t rt_crc32(const uint8_t* data, uint64_t n) {
+  const uint32_t* table = crc32_table_get();
+  uint32_t c = 0xFFFFFFFFu;
+  for (uint64_t i = 0; i < n; i++)
+    c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+static const char kSpillMagic[4] = {'T', 'P', 'U', 'S'};
+static const uint32_t kSpillVersion = 1;
+
+// returns 0 on success, negative errno-style codes on failure
+int64_t spill_write(const char* path, const uint8_t* data, uint64_t n) {
+  std::FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  uint32_t crc = rt_crc32(data, n);
+  bool ok = std::fwrite(kSpillMagic, 1, 4, f) == 4 &&
+            std::fwrite(&kSpillVersion, 4, 1, f) == 1 &&
+            std::fwrite(&n, 8, 1, f) == 1 &&
+            std::fwrite(&crc, 4, 1, f) == 1 &&
+            (n == 0 || std::fwrite(data, 1, n, f) == n);
+  if (ok) ok = std::fflush(f) == 0;
+#if defined(__unix__) || defined(__APPLE__)
+  if (ok) ok = fsync(fileno(f)) == 0;
+#endif
+  std::fclose(f);
+  return ok ? 0 : -2;
+}
+
+// returns payload length, or negative code: -1 open, -2 header,
+// -3 bad magic/version, -4 size mismatch, -5 crc mismatch
+int64_t spill_read_size(const char* path) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char magic[4];
+  uint32_t version, crc;
+  uint64_t n;
+  bool ok = std::fread(magic, 1, 4, f) == 4 &&
+            std::fread(&version, 4, 1, f) == 1 &&
+            std::fread(&n, 8, 1, f) == 1 &&
+            std::fread(&crc, 4, 1, f) == 1;
+  long hdr_end = ok ? std::ftell(f) : 0;
+  long file_end = 0;
+  if (ok && std::fseek(f, 0, SEEK_END) == 0) file_end = std::ftell(f);
+  std::fclose(f);
+  if (!ok) return -2;
+  if (std::memcmp(magic, kSpillMagic, 4) != 0 || version != kSpillVersion)
+    return -3;
+  // a corrupted length field must not escape as a huge allocation
+  if (file_end - hdr_end != static_cast<long>(n)) return -4;
+  return static_cast<int64_t>(n);
+}
+
+int64_t spill_read(const char* path, uint8_t* out, uint64_t out_len) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  char magic[4];
+  uint32_t version, crc;
+  uint64_t n;
+  bool ok = std::fread(magic, 1, 4, f) == 4 &&
+            std::fread(&version, 4, 1, f) == 1 &&
+            std::fread(&n, 8, 1, f) == 1 &&
+            std::fread(&crc, 4, 1, f) == 1;
+  if (!ok) { std::fclose(f); return -2; }
+  if (std::memcmp(magic, kSpillMagic, 4) != 0 ||
+      version != kSpillVersion) { std::fclose(f); return -3; }
+  if (n != out_len) { std::fclose(f); return -4; }
+  ok = (n == 0) || std::fread(out, 1, n, f) == n;
+  std::fclose(f);
+  if (!ok) return -4;
+  if (rt_crc32(out, n) != crc) return -5;
+  return static_cast<int64_t>(n);
+}
+
+}  // extern "C"

@@ -302,8 +302,10 @@ class DiskBuffer(SpillableBuffer):
         self._length = length
 
     def get_host_bytes(self) -> bytes:
-        with open(self._path, "rb") as f:
-            return f.read()
+        # CRC-verified read: corruption surfaces as SpillCorruptionError
+        # instead of a poisoned batch (memory/native spill framing)
+        from spark_rapids_tpu.memory.native import spill_read
+        return spill_read(self._path)
 
     def get_columnar_batch(self) -> ColumnarBatch:
         return deserialize_batch(self.get_host_bytes())
@@ -338,9 +340,11 @@ class DiskStore(BufferStore):
 
     def add_blob(self, bid: BufferId, blob: bytes, meta: TableMeta,
                  spill_priority: float = 0.0) -> SpillableBuffer:
+        from spark_rapids_tpu.memory.native import spill_write
         path = self.block_manager.path_for(bid)
-        with open(path, "wb") as f:
-            f.write(blob)
+        # CRC-framed + fsync'd (native runtime.cpp; the role the JVM's
+        # checksummed spill writers play in the reference stack)
+        spill_write(path, blob)
         db = DiskBuffer(bid, path, len(blob), meta, spill_priority)
         self._track(db)
         return db

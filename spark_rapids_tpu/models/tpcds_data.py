@@ -1,7 +1,8 @@
 """TPC-DS table subset + synthetic data (reference
 `integration_tests/.../tpcds/TpcdsLikeSpark.scala` table readers — the
-full 24-table catalog; we carry the 8 tables the classic star-join query
-set touches, generated in-memory).
+full 24-table catalog; we carry the 17 tables the 36-query suite
+touches — all three sales channels with their returns tables,
+inventory, and the core dimensions — generated in-memory).
 
 Dates use the TPC-DS surrogate-key convention (d_date_sk joins, d_year /
 d_moy predicates) — no calendar math needed in the queries themselves.

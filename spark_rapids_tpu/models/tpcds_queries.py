@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from spark_rapids_tpu.exec.joins import JoinType
 from spark_rapids_tpu.exec.sort import asc, desc
-from spark_rapids_tpu.exprs.aggregates import Average, Count, Max, Min, Sum
+from spark_rapids_tpu.exprs.aggregates import Average, Count, Sum
 from spark_rapids_tpu.exprs.base import col, lit
 from spark_rapids_tpu.exprs.conditional import Coalesce, If
 from spark_rapids_tpu.exprs.predicates import InSet, IsNotNull, IsNull
