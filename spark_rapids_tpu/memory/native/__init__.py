@@ -325,12 +325,13 @@ def spill_read(path: str) -> bytes:
             raise SpillCorruptionError(
                 f"spill file {path}: "
                 f"{_SPILL_ERRORS.get(n, 'unreadable')}")
-        buf = ctypes.create_string_buffer(int(n))
+        # create_string_buffer appends a NUL: size it exactly
+        buf = (ctypes.c_char * int(n))()
         rc = lib.spill_read(path.encode(), buf, int(n))
         if rc < 0:
             raise SpillCorruptionError(
                 f"spill file {path}: {_SPILL_ERRORS.get(rc, 'bad')}")
-        return buf.raw[:n]
+        return bytes(buf)
     import struct
     import zlib
     with open(path, "rb") as f:

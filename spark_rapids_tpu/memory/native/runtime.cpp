@@ -203,6 +203,9 @@ void arena_read(void* p, uint64_t off, uint8_t* dst, uint64_t n) {
 // shuffle/spill writers play; cuDF-side buffers get this from the
 // filesystem layer in the reference).  Format:
 //   magic "TPUS" | u32 version | u64 payload_len | u32 crc32 | payload
+// Header integers are host-endian; the engine's supported hosts (x86,
+// ARM) are little-endian, matching the Python fallback's "<IQI". A
+// big-endian port would need explicit LE writes here.
 // Written with fsync so a spilled buffer survives a crash of the
 // executor process; read verifies length + CRC and reports corruption
 // instead of handing poisoned bytes to the engine.

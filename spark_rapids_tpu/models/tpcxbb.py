@@ -14,6 +14,7 @@ from spark_rapids_tpu.exec.joins import JoinType
 from spark_rapids_tpu.exec.sort import asc, desc
 from spark_rapids_tpu.exprs.aggregates import Count, Sum
 from spark_rapids_tpu.exprs.base import col, lit
+from spark_rapids_tpu.exprs.conditional import Coalesce, If
 from spark_rapids_tpu.exprs.predicates import InSet
 from spark_rapids_tpu.models.tpcds_data import CATEGORIES
 from spark_rapids_tpu.plan.nodes import (CpuAggregate, CpuFilter,
@@ -119,5 +120,200 @@ def q15_shape(t, run):
                     asc(col("i_category"))], agg)
 
 
-QUERIES = {"q01": q01_shape, "q05": q05_shape, "q12": q12_shape,
-           "q15": q15_shape}
+def q06_shape(t, run):
+    """Customers whose second-half web spend grew vs the first half
+    (reference q06's period-over-period ratio)."""
+    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
+    j = CpuHashJoin(JoinType.INNER, [col("d_date_sk")],
+                    [col("ws_sold_date_sk")], dd, t["web_sales"])
+    agg = CpuAggregate(
+        [col("ws_bill_customer_sk")],
+        [Sum(If(col("d_moy") <= lit(6), col("ws_net_paid"),
+                lit(0.0))).alias("first_half"),
+         Sum(If(col("d_moy") > lit(6), col("ws_net_paid"),
+                lit(0.0))).alias("second_half")], j)
+    grew = CpuFilter((col("first_half") > lit(0.0)) &
+                     (col("second_half") > col("first_half")), agg)
+    return CpuLimit(100, CpuSort(
+        [desc(col("second_half")), asc(col("ws_bill_customer_sk"))],
+        grew))
+
+
+def q09_shape(t, run):
+    """Store quantity over demographic x price-band slices (reference
+    q09's OR'd slice sums)."""
+    cd = CpuFilter(
+        ((col("cd_marital_status") == lit("M")) &
+         (col("cd_education_status") == lit("4 yr Degree"))) |
+        ((col("cd_marital_status") == lit("S")) &
+         (col("cd_education_status") == lit("Secondary"))),
+        t["customer_demographics"])
+    sales = CpuFilter(
+        ((col("ss_sales_price") >= lit(50.0)) &
+         (col("ss_sales_price") <= lit(100.0))) |
+        ((col("ss_sales_price") >= lit(150.0)) &
+         (col("ss_sales_price") <= lit(200.0))), t["store_sales"])
+    j = CpuHashJoin(JoinType.INNER, [col("ss_cdemo_sk")],
+                    [col("cd_demo_sk")], sales, cd)
+    return CpuAggregate([], [Sum(col("ss_quantity")).alias("qty")], j)
+
+
+def q14_shape(t, run):
+    """Morning vs evening web order ratio (reference q14)."""
+    j = CpuHashJoin(JoinType.INNER, [col("ws_sold_time_sk")],
+                    [col("t_time_sk")], t["web_sales"], t["time_dim"])
+    counts = CpuAggregate(
+        [], [Sum(If((col("t_hour") >= lit(7)) & (col("t_hour") < lit(9)),
+                    lit(1), lit(0))).alias("am_cnt"),
+             Sum(If((col("t_hour") >= lit(19)) &
+                    (col("t_hour") < lit(21)),
+                    lit(1), lit(0))).alias("pm_cnt")], j)
+    return CpuProject(
+        [col("am_cnt"), col("pm_cnt"),
+         (col("am_cnt") / col("pm_cnt")).alias("am_pm_ratio")], counts)
+
+
+def q16_shape(t, run):
+    """Web sales netted against returns around a pivot date (reference
+    q16's before/after sums)."""
+    j = CpuHashJoin(
+        JoinType.LEFT_OUTER,
+        [col("ws_order_number"), col("ws_item_sk")],
+        [col("wr_order_number"), col("wr_item_sk")],
+        t["web_sales"], t["web_returns"])
+    j = CpuHashJoin(JoinType.INNER, [col("ws_sold_date_sk")],
+                    [col("d_date_sk")], j,
+                    CpuFilter(col("d_year") == lit(2001), t["date_dim"]))
+    net = col("ws_sales_price") - Coalesce(
+        (col("wr_return_amt"), lit(0.0)))
+    return CpuAggregate(
+        [], [Sum(If(col("d_moy") < lit(7), net, lit(0.0))).alias(
+            "before"),
+             Sum(If(col("d_moy") >= lit(7), net, lit(0.0))).alias(
+            "after")], j)
+
+
+def q17_shape(t, run):
+    """Promotional share of store revenue in one category/month
+    (reference q17's ratio of filtered to total sales)."""
+    dd = CpuFilter((col("d_year") == lit(2000)) &
+                   (col("d_moy") == lit(12)), t["date_dim"])
+    it = CpuFilter(InSet(col("i_category"), ("Books", "Music")),
+                   t["item"])
+    base = CpuHashJoin(
+        JoinType.INNER, [col("ss_item_sk")], [col("i_item_sk")],
+        CpuHashJoin(JoinType.INNER, [col("d_date_sk")],
+                    [col("ss_sold_date_sk")], dd, t["store_sales"]),
+        it)
+    promo = CpuHashJoin(
+        JoinType.INNER, [col("ss_promo_sk")], [col("p_promo_sk")],
+        base, CpuFilter((col("p_channel_email") == lit("Y")) |
+                        (col("p_channel_event") == lit("Y")),
+                        t["promotion"]))
+    p_sum = CpuProject(
+        [lit(1).alias("k1"), col("promotional")],
+        CpuAggregate([], [Sum(col("ss_ext_sales_price")).alias(
+            "promotional")], promo))
+    t_sum = CpuProject(
+        [lit(1).alias("k2"), col("total")],
+        CpuAggregate([], [Sum(col("ss_ext_sales_price")).alias(
+            "total")], base))
+    j = CpuHashJoin(JoinType.INNER, [col("k1")], [col("k2")],
+                    p_sum, t_sum)
+    return CpuProject(
+        [col("promotional"), col("total"),
+         (col("promotional") / col("total") * lit(100.0)).alias(
+             "promo_pct")], j)
+
+
+def q20_shape(t, run):
+    """Per-customer return-rate features for clustering (reference
+    q20's order/amount return ratios)."""
+    sales = CpuAggregate(
+        [col("ss_customer_sk")],
+        [Count(None).alias("orders"),
+         Sum(col("ss_net_paid")).alias("spend")], t["store_sales"])
+    rets = CpuAggregate(
+        [col("sr_customer_sk")],
+        [Count(None).alias("returns"),
+         Sum(col("sr_return_amt")).alias("returned")],
+        t["store_returns"])
+    j = CpuHashJoin(JoinType.LEFT_OUTER, [col("ss_customer_sk")],
+                    [col("sr_customer_sk")], sales, rets)
+    out = CpuProject(
+        [col("ss_customer_sk"),
+         (Coalesce((col("returns"), lit(0))) * lit(1.0)
+          / col("orders")).alias("return_order_ratio"),
+         (Coalesce((col("returned"), lit(0.0)))
+          / col("spend")).alias("return_amt_ratio")], j)
+    return CpuLimit(100, CpuSort(
+        [desc(col("return_amt_ratio")), asc(col("ss_customer_sk"))],
+        out))
+
+
+def q21_shape(t, run):
+    """Items a customer returned and then re-bought through the
+    catalog channel (reference q21's store->return->rebuy chain, with
+    catalog as the re-buy channel)."""
+    sr = CpuHashJoin(
+        JoinType.INNER,
+        [col("ss_item_sk"), col("ss_ticket_number")],
+        [col("sr_item_sk"), col("sr_ticket_number")],
+        t["store_sales"], t["store_returns"])
+    re_buy = CpuHashJoin(
+        JoinType.INNER,
+        [col("sr_customer_sk"), col("sr_item_sk")],
+        [col("cs_bill_customer_sk"), col("cs_item_sk")],
+        sr, t["catalog_sales"])
+    j = CpuHashJoin(JoinType.INNER, [col("sr_item_sk")],
+                    [col("i_item_sk")], re_buy, t["item"])
+    agg = CpuAggregate([col("i_item_id")],
+                       [Count(None).alias("rebuys")], j)
+    return CpuLimit(100, CpuSort(
+        [desc(col("rebuys")), asc(col("i_item_id"))], agg))
+
+
+def q22_shape(t, run):
+    """Inventory on hand before vs after a pivot date per warehouse
+    (reference q22's ratio-banded report)."""
+    j = CpuHashJoin(JoinType.INNER, [col("inv_date_sk")],
+                    [col("d_date_sk")], t["inventory"],
+                    CpuFilter(col("d_year") == lit(2000), t["date_dim"]))
+    agg = CpuAggregate(
+        [col("inv_warehouse_sk"), col("inv_item_sk")],
+        [Sum(If(col("d_moy") < lit(6), col("inv_quantity_on_hand"),
+                lit(0))).alias("inv_before"),
+         Sum(If(col("d_moy") >= lit(6), col("inv_quantity_on_hand"),
+                lit(0))).alias("inv_after")], j)
+    banded = CpuFilter(
+        (col("inv_before") > lit(0)) &
+        (col("inv_after") * lit(3) >= col("inv_before") * lit(2)) &
+        (col("inv_after") * lit(2) <= col("inv_before") * lit(3)), agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col("inv_warehouse_sk")), asc(col("inv_item_sk"))], banded))
+
+
+def q29_shape(t, run):
+    """Item pairs bought in the same catalog order (reference q29/q30
+    affinity self-join)."""
+    left = CpuProject(
+        [col("cs_order_number").alias("o1"),
+         col("cs_item_sk").alias("item_l")], t["catalog_sales"])
+    right = CpuProject(
+        [col("cs_order_number").alias("o2"),
+         col("cs_item_sk").alias("item_r")], t["catalog_sales"])
+    pairs = CpuFilter(
+        col("item_l") < col("item_r"),
+        CpuHashJoin(JoinType.INNER, [col("o1")], [col("o2")],
+                    left, right))
+    agg = CpuAggregate([col("item_l"), col("item_r")],
+                       [Count(None).alias("cnt")], pairs)
+    return CpuLimit(100, CpuSort(
+        [desc(col("cnt")), asc(col("item_l")), asc(col("item_r"))], agg))
+
+
+QUERIES = {"q01": q01_shape, "q05": q05_shape, "q06": q06_shape,
+           "q09": q09_shape, "q12": q12_shape, "q14": q14_shape,
+           "q15": q15_shape, "q16": q16_shape, "q17": q17_shape,
+           "q20": q20_shape, "q21": q21_shape, "q22": q22_shape,
+           "q29": q29_shape}
