@@ -325,7 +325,6 @@ def spill_read(path: str) -> bytes:
             raise SpillCorruptionError(
                 f"spill file {path}: "
                 f"{_SPILL_ERRORS.get(n, 'unreadable')}")
-        # create_string_buffer appends a NUL: size it exactly
         buf = (ctypes.c_char * int(n))()
         rc = lib.spill_read(path.encode(), buf, int(n))
         if rc < 0:

@@ -760,8 +760,264 @@ def q92_shape(t, run):
 
 
 
+
+
+def q2_shape(t, run):
+    """Week-day revenue share, store vs web channels united (reference
+    q2's cross-channel weekly comparison)."""
+    u = CpuUnion(
+        CpuProject([col("ss_sold_date_sk").alias("sold_date_sk"),
+                    col("ss_ext_sales_price").alias("price")],
+                   t["store_sales"]),
+        CpuProject([col("ws_sold_date_sk").alias("sold_date_sk"),
+                    col("ws_ext_sales_price").alias("price")],
+                   t["web_sales"]))
+    j = _join(u, t["date_dim"], ["sold_date_sk"], ["d_date_sk"])
+    day = lambda n: Sum(If(col("d_day_name") == lit(n), col("price"),
+                           lit(0.0)))
+    agg = CpuAggregate(
+        [col("d_year")],
+        [day("Sunday").alias("sun"), day("Monday").alias("mon"),
+         day("Friday").alias("fri"), day("Saturday").alias("sat")], j)
+    return CpuSort([asc(col("d_year"))], agg)
+
+
+def q13_shape(t, run):
+    """Store averages across demographic/price-band OR-slices
+    (reference q13)."""
+    cd = CpuFilter(
+        ((col("cd_marital_status") == lit("M")) &
+         (col("cd_education_status") == lit("Advanced Degree"))) |
+        ((col("cd_marital_status") == lit("S")) &
+         (col("cd_education_status") == lit("College"))),
+        t["customer_demographics"])
+    hd = CpuFilter(InSet(col("hd_dep_count"), (1, 3)),
+                   t["household_demographics"])
+    j = _join(_join(_join(
+        CpuFilter(col("d_year") == lit(2001), t["date_dim"]),
+        t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        cd, ["ss_cdemo_sk"], ["cd_demo_sk"]),
+        hd, ["ss_hdemo_sk"], ["hd_demo_sk"])
+    return CpuAggregate(
+        [], [Average(col("ss_quantity")).alias("avg_qty"),
+             Average(col("ss_ext_sales_price")).alias("avg_price"),
+             Average(col("ss_ext_wholesale_cost")).alias("avg_cost"),
+             Sum(col("ss_ext_wholesale_cost")).alias("sum_cost")], j)
+
+
+def q18_shape(t, run):
+    """Catalog purchase averages by customer state for one demographic
+    (reference q18 without the rollup)."""
+    cd = CpuFilter(col("cd_gender") == lit("F"),
+                   t["customer_demographics"])
+    j = _join(_join(_join(_join(
+        CpuFilter(col("d_year") == lit(2001), t["date_dim"]),
+        t["catalog_sales"], ["d_date_sk"], ["cs_sold_date_sk"]),
+        cd, ["cs_bill_cdemo_sk"], ["cd_demo_sk"]),
+        t["customer"], ["cs_bill_customer_sk"], ["c_customer_sk"]),
+        t["customer_address"], ["c_current_addr_sk"], ["ca_address_sk"])
+    agg = CpuAggregate(
+        [col("ca_state")],
+        [Average(col("cs_quantity")).alias("agg1"),
+         Average(col("cs_list_price")).alias("agg2"),
+         Average(col("cs_sales_price")).alias("agg3"),
+         Average(col("cs_net_profit")).alias("agg4")], j)
+    return CpuLimit(100, CpuSort([asc(col("ca_state"))], agg))
+
+
+def q21ds_shape(t, run):
+    """Inventory before/after a pivot date for a price band of items
+    (reference q21)."""
+    it = CpuFilter((col("i_current_price") >= lit(10.0)) &
+                   (col("i_current_price") <= lit(60.0)), t["item"])
+    j = _join(_join(_join(t["inventory"], it,
+                          ["inv_item_sk"], ["i_item_sk"]),
+                    t["warehouse"],
+                    ["inv_warehouse_sk"], ["w_warehouse_sk"]),
+              CpuFilter(col("d_year") == lit(2000), t["date_dim"]),
+              ["inv_date_sk"], ["d_date_sk"])
+    agg = CpuAggregate(
+        [col("w_warehouse_name"), col("i_item_id")],
+        [Sum(If(col("d_moy") < lit(6), col("inv_quantity_on_hand"),
+                lit(0))).alias("inv_before"),
+         Sum(If(col("d_moy") >= lit(6), col("inv_quantity_on_hand"),
+                lit(0))).alias("inv_after")], j)
+    ok = CpuFilter(
+        (col("inv_before") > lit(0)) &
+        (col("inv_after") * lit(10) >= col("inv_before") * lit(5)) &
+        (col("inv_after") * lit(2) <= col("inv_before") * lit(3)), agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col("w_warehouse_name")), asc(col("i_item_id"))], ok))
+
+
+def q32_shape(t, run):
+    """Catalog sales with discount above 1.3x the item's average
+    (reference q32, q92's catalog twin)."""
+    avg_disc = CpuAggregate(
+        [col("cs_item_sk")],
+        [Average(col("cs_ext_discount_amt")).alias("avg_disc")],
+        t["catalog_sales"])
+    j = _join(t["catalog_sales"],
+              CpuProject([col("cs_item_sk").alias("isk2"),
+                          col("avg_disc")], avg_disc),
+              ["cs_item_sk"], ["isk2"])
+    excess = CpuFilter(
+        col("cs_ext_discount_amt") > col("avg_disc") * lit(1.3), j)
+    return CpuAggregate(
+        [], [Sum(col("cs_ext_discount_amt")).alias("excess_discount")],
+        excess)
+
+
+def q34_shape(t, run):
+    """Mid-size-basket customers for given buy potentials (reference
+    q34, q73's sibling; its 15-20 basket band is widened to 3-20 for
+    the small-scale synthetic data)."""
+    hd = CpuFilter(InSet(col("hd_buy_potential"),
+                         (">10000", "5001-10000")),
+                   t["household_demographics"])
+    j = _join(_join(CpuFilter(col("d_year") == lit(2000),
+                              t["date_dim"]),
+                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+              hd, ["ss_hdemo_sk"], ["hd_demo_sk"])
+    per_ticket = CpuAggregate(
+        [col("ss_ticket_number"), col("ss_customer_sk")],
+        [Count(None).alias("cnt")], j)
+    band = CpuFilter((col("cnt") >= lit(3)) & (col("cnt") <= lit(20)),
+                     per_ticket)
+    j2 = _join(band, t["customer"],
+               ["ss_customer_sk"], ["c_customer_sk"])
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_last_name")), desc(col("cnt")),
+         asc(col("ss_ticket_number"))],
+        CpuProject([col("c_last_name"), col("c_first_name"),
+                    col("ss_ticket_number"), col("cnt")], j2)))
+
+
+def q36_shape(t, run):
+    """Gross margin ratio by item category (reference q36 without the
+    rollup/window rank)."""
+    j = _join(_join(CpuFilter(col("d_year") == lit(2001),
+                              t["date_dim"]),
+                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_category")],
+        [Sum(col("ss_net_profit")).alias("profit"),
+         Sum(col("ss_ext_sales_price")).alias("sales")], j)
+    return CpuSort(
+        [asc(col("i_category"))],
+        CpuProject([col("i_category"),
+                    (col("profit") / col("sales")).alias(
+                        "gross_margin")], agg))
+
+
+def q38_shape(t, run):
+    """Customers active in all three channels (reference q38's
+    intersect, as chained semi joins over deduplicated customers)."""
+    ss_c = CpuAggregate([col("ss_customer_sk")],
+                        [Count(None).alias("_a")], t["store_sales"])
+    in_web = CpuHashJoin(
+        J.LEFT_SEMI, [col("ss_customer_sk")],
+        [col("ws_bill_customer_sk")], ss_c, t["web_sales"])
+    in_all = CpuHashJoin(
+        J.LEFT_SEMI, [col("ss_customer_sk")],
+        [col("cs_bill_customer_sk")], in_web, t["catalog_sales"])
+    return CpuAggregate([], [Count(None).alias("num_customers")],
+                        in_all)
+
+
+def q60_shape(t, run):
+    """Per-item revenue across the three channels for one category and
+    month (reference q60, q33's by-item sibling)."""
+    dd = CpuFilter((col("d_year") == lit(1999)) &
+                   (col("d_moy") == lit(9)), t["date_dim"])
+    it = CpuFilter(col("i_category") == lit("Music"), t["item"])
+
+    def channel(sales, date_key, item_key, price):
+        j = _join(_join(dd, t[sales], ["d_date_sk"], [date_key]),
+                  it, [item_key], ["i_item_sk"])
+        return CpuProject(
+            [col("i_item_id"), col(price).alias("total_sales")], j)
+
+    u = CpuUnion(channel("store_sales", "ss_sold_date_sk",
+                         "ss_item_sk", "ss_ext_sales_price"),
+                 channel("catalog_sales", "cs_sold_date_sk",
+                         "cs_item_sk", "cs_ext_sales_price"),
+                 channel("web_sales", "ws_sold_date_sk",
+                         "ws_item_sk", "ws_ext_sales_price"))
+    agg = CpuAggregate([col("i_item_id")],
+                       [Sum(col("total_sales")).alias("total_sales")], u)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_item_id")), desc(col("total_sales"))], agg))
+
+
+def q69_shape(t, run):
+    """Demographics of store customers with no web or catalog activity
+    in a window (reference q69's exists/not-exists combination)."""
+    dd = CpuFilter((col("d_year") == lit(2001)) &
+                   (col("d_moy") <= lit(3)), t["date_dim"])
+    store_c = CpuAggregate(
+        [col("ss_customer_sk")], [Count(None).alias("_a")],
+        _join(dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]))
+    web_c = CpuProject(
+        [col("ws_bill_customer_sk")],
+        _join(dd, t["web_sales"], ["d_date_sk"], ["ws_sold_date_sk"]))
+    cat_c = CpuProject(
+        [col("cs_bill_customer_sk")],
+        _join(dd, t["catalog_sales"],
+              ["d_date_sk"], ["cs_sold_date_sk"]))
+    only_store = CpuHashJoin(
+        J.LEFT_ANTI, [col("ss_customer_sk")],
+        [col("cs_bill_customer_sk")],
+        CpuHashJoin(J.LEFT_ANTI, [col("ss_customer_sk")],
+                    [col("ws_bill_customer_sk")], store_c, web_c),
+        cat_c)
+    j = _join(_join(only_store, t["customer"],
+                    ["ss_customer_sk"], ["c_customer_sk"]),
+              t["customer_address"],
+              ["c_current_addr_sk"], ["ca_address_sk"])
+    agg = CpuAggregate([col("ca_state")],
+                       [Count(None).alias("cnt")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("ca_state"))], agg))
+
+
+def q87_shape(t, run):
+    """Store customers absent from the web channel (reference q87's
+    EXCEPT, as a LEFT_ANTI join over deduplicated customers)."""
+    ss_c = CpuAggregate([col("ss_customer_sk")],
+                        [Count(None).alias("_a")], t["store_sales"])
+    not_web = CpuHashJoin(
+        J.LEFT_ANTI, [col("ss_customer_sk")],
+        [col("ws_bill_customer_sk")], ss_c, t["web_sales"])
+    return CpuAggregate([], [Count(None).alias("num_customers")],
+                        not_web)
+
+
+def q41_shape(t, run):
+    """Distinct item ids in a price/category slice (reference q41's
+    item-only filter query)."""
+    it = CpuFilter(
+        (col("i_current_price") >= lit(30.0)) &
+        (col("i_current_price") <= lit(60.0)) &
+        InSet(col("i_category"), ("Women", "Shoes", "Jewelry")),
+        t["item"])
+    dedup = CpuAggregate([col("i_item_id")],
+                         [Count(None).alias("_c")], it)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_item_id"))],
+        CpuProject([col("i_item_id")], dedup)))
+
+
+
+
+
 QUERIES = {
-    "q1": q1, "q3": q3, "q6": q6_shape, "q7": q7_shape,
+    "q1": q1, "q2": q2_shape, "q3": q3, "q6": q6_shape, "q7": q7_shape,
+    "q13": q13_shape, "q18": q18_shape, "q21": q21ds_shape,
+    "q32": q32_shape, "q34": q34_shape, "q36": q36_shape,
+    "q38": q38_shape, "q41": q41_shape, "q60": q60_shape,
+    "q69": q69_shape, "q87": q87_shape,
     "q15": q15_shape, "q16": q16_shape, "q19": q19, "q25": q25_shape,
     "q26": q26, "q27": q27_shape, "q28": q28_shape, "q33": q33_shape,
     "q37": q37_shape, "q40": q40_shape, "q42": q42, "q43": q43_shape,
