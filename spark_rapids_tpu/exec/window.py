@@ -413,3 +413,201 @@ def _range_sum(prefix, lo, hi):
     lo_v = jnp.where(lo > 0, jnp.take(prefix, jnp.clip(lo - 1, 0, cap - 1)),
                      0)
     return hi_v - lo_v
+
+
+# ---------------------------------------------------------------------------
+# Planner-facing window node + independent CPU evaluation (the golden
+# engine for window parity tests; Spark's WindowExec analog on the
+# fallback side).  The override rule in plan/overrides.py converts it to
+# the TPU WindowExec above.
+from spark_rapids_tpu.plan.nodes import CpuNode as _CpuNode
+
+
+class CpuWindow(_CpuNode):
+    """CPU plan node: child columns + one column per window function."""
+
+    def __init__(self, window_exprs: Sequence, spec: WindowSpec, child):
+        super().__init__(child)
+        self.spec = spec
+        self.window_exprs = [
+            w if isinstance(w, tuple) else (w, f"w{i}")
+            for i, w in enumerate(window_exprs)]
+        cs = child.output_schema()
+        fields = list(cs.fields) + [
+            T.Field(n, _result_type(fn, cs))
+            for fn, n in self.window_exprs]
+        self._schema = T.Schema(tuple(fields))
+
+    def output_schema(self):
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return 1
+
+    def name(self) -> str:
+        return "CpuWindow"
+
+    def describe(self) -> str:
+        return (f"CpuWindow([{', '.join(f.kind for f, _ in self.window_exprs)}]"
+                f", partitionBy={len(self.spec.partition_by)})")
+
+    def execute(self):
+        import pandas as pd
+        from spark_rapids_tpu.plan.nodes import empty_df, normalize_df
+        parts = [df for it in self.child.execute() for df in it]
+        cs = self.child.output_schema()
+        df = (pd.concat(parts, ignore_index=True) if parts
+              else empty_df(cs))
+        out = _cpu_window_eval(df, cs, self.spec, self.window_exprs)
+        return [iter([normalize_df(out, self._schema)])]
+
+
+def _cpu_window_eval(df, child_schema, spec: WindowSpec, window_exprs):
+    """Row-at-a-time reference implementation of the window semantics
+    the TPU kernel vectorizes: per-partition sorted evaluation with
+    rows/range frames (range CURRENT ROW includes peers, like Spark)."""
+    import numpy as np
+    import pandas as pd
+    from spark_rapids_tpu.plan.cpu_eval import cpu_eval, nullable_dtype
+
+    n = len(df)
+    out = df.copy()
+    results = {name: [None] * n for _, name in window_exprs}
+    if n == 0:
+        for fn, name in window_exprs:
+            out[name] = pd.Series(
+                [], dtype=nullable_dtype(_result_type(fn, child_schema)))
+        return out
+
+    pcols = [cpu_eval(e, df, child_schema) for e in spec.partition_by]
+    ocols = [cpu_eval(o.expr, df, child_schema) for o in spec.order_by]
+
+    def okey(i):
+        key = []
+        for s, o in zip(ocols, spec.order_by):
+            v = s.iloc[i]
+            null = pd.isna(v)
+            # null ordering then direction, mirroring SortOrder
+            key.append((null != o.nulls_first,
+                        _dirval(v, o.ascending, null)))
+        return tuple(key)
+
+    def pkey(i):
+        return tuple(None if pd.isna(s.iloc[i]) else s.iloc[i]
+                     for s in pcols)
+
+    groups: dict = {}
+    for i in range(n):
+        groups.setdefault(pkey(i), []).append(i)
+
+    frame = spec.frame
+    fn_inputs = {name: (cpu_eval(fn.child, df, child_schema)
+                        if fn.child is not None else None)
+                 for fn, name in window_exprs}
+    for rows in groups.values():
+        rows.sort(key=okey)
+        m = len(rows)
+        order_vals = [okey(i) for i in rows]
+        for fn, name in window_exprs:
+            vals = fn_inputs[name]
+            res = results[name]
+            if fn.kind == "row_number":
+                for pos, i in enumerate(rows):
+                    res[i] = pos + 1
+            elif fn.kind in ("rank", "dense_rank"):
+                rank = dense = 0
+                prev = object()
+                for pos, i in enumerate(rows):
+                    if order_vals[pos] != prev:
+                        rank = pos + 1
+                        dense += 1
+                        prev = order_vals[pos]
+                    res[i] = rank if fn.kind == "rank" else dense
+            elif fn.kind in ("lead", "lag"):
+                step = fn.offset if fn.kind == "lead" else -fn.offset
+                for pos, i in enumerate(rows):
+                    j = pos + step
+                    if 0 <= j < m:
+                        v = vals.iloc[rows[j]]
+                        res[i] = None if pd.isna(v) else v
+                    else:
+                        res[i] = fn.default
+            else:  # framed aggregates
+                for pos, i in enumerate(rows):
+                    lo, hi = _frame_bounds(frame, pos, m, order_vals)
+                    window = [vals.iloc[rows[j]]
+                              for j in range(lo, hi + 1)]
+                    res[i] = _frame_agg(fn.kind, window)
+
+    for fn, name in window_exprs:
+        out[name] = pd.Series(results[name]).astype(
+            nullable_dtype(_result_type(fn, child_schema)))
+    return out
+
+
+def _dirval(v, ascending: bool, null: bool):
+    if null:
+        return 0
+    if ascending:
+        return v
+    if isinstance(v, str):
+        # descending strings: inverted bytes + a terminator sentinel
+        # larger than any inverted byte, so a prefix sorts AFTER its
+        # extensions ("ab" before "a" descending)
+        return tuple(255 - b for b in v.encode("utf-8")) + (256,)
+    return -v
+
+
+def _frame_bounds(frame: WindowFrame, pos: int, m: int, order_vals):
+    if frame.is_rows:
+        lo = 0 if frame.lower is None else max(0, pos + frame.lower)
+        hi = m - 1 if frame.upper is None else min(m - 1,
+                                                   pos + frame.upper)
+        return lo, min(hi, m - 1)
+    # range frame with UNBOUNDED / CURRENT ROW bounds: peers included
+    if frame.lower is None:
+        lo = 0
+    elif frame.lower == 0:
+        lo = pos
+        while lo > 0 and order_vals[lo - 1] == order_vals[pos]:
+            lo -= 1
+    else:
+        raise NotImplementedError(
+            "CPU range frames support UNBOUNDED/CURRENT bounds")
+    if frame.upper is None:
+        hi = m - 1
+    elif frame.upper == 0:
+        hi = pos
+        while hi < m - 1 and order_vals[hi + 1] == order_vals[pos]:
+            hi += 1
+    else:
+        raise NotImplementedError(
+            "CPU range frames support UNBOUNDED/CURRENT bounds")
+    return lo, hi
+
+
+def _frame_agg(kind: str, window: list):
+    """`window` holds raw frame values INCLUDING nulls: first/last keep
+    Spark's ignoreNulls=false semantics (a null boundary row yields
+    null), the others skip nulls like their aggregate counterparts."""
+    import pandas as pd
+    if kind == "first":
+        v = window[0] if window else None
+        return None if v is None or pd.isna(v) else v
+    if kind == "last":
+        v = window[-1] if window else None
+        return None if v is None or pd.isna(v) else v
+    vals = [v for v in window if not pd.isna(v)]
+    if kind == "count":
+        return len(vals)
+    if not vals:
+        return None
+    if kind == "sum":
+        return sum(vals)
+    if kind == "min":
+        return min(vals)
+    if kind == "max":
+        return max(vals)
+    if kind == "avg":
+        return sum(vals) / len(vals)
+    raise NotImplementedError(f"window agg {kind}")

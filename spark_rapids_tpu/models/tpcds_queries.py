@@ -1012,11 +1012,70 @@ def q41_shape(t, run):
 
 
 
+
+
+def q63_shape(t, run):
+    """Manager monthly sales vs their average month (reference q63/q53's
+    windowed deviation filter)."""
+    from spark_rapids_tpu.exec.sort import asc as _asc
+    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
+                                              WindowSpec, WinAvg)
+    j = _join(_join(CpuFilter(col("d_year") == lit(2001),
+                              t["date_dim"]),
+                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    monthly = CpuAggregate(
+        [col("i_manager_id"), col("d_moy")],
+        [Sum(col("ss_sales_price")).alias("sum_sales")], j)
+    w = CpuWindow(
+        [WinAvg(col("sum_sales")).alias("avg_monthly_sales")],
+        WindowSpec([col("i_manager_id")], [],
+                   WindowFrame(is_rows=True, lower=None, upper=None)),
+        monthly)
+    dev = CpuFilter(
+        (col("avg_monthly_sales") > lit(0.0)) &
+        ((col("sum_sales") > col("avg_monthly_sales") * lit(1.1)) |
+         (col("sum_sales") < col("avg_monthly_sales") * lit(0.9))), w)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_manager_id")), asc(col("d_moy"))],
+        CpuProject([col("i_manager_id"), col("d_moy"),
+                    col("sum_sales"), col("avg_monthly_sales")], dev)))
+
+
+def q67_shape(t, run):
+    """Top-ranked items by revenue within each category (reference
+    q67's windowed rank over rollup, without the rollup)."""
+    from spark_rapids_tpu.exec.sort import desc as _desc
+    from spark_rapids_tpu.exec.window import (CpuWindow, Rank,
+                                              WindowSpec)
+    j = _join(_join(CpuFilter(col("d_year") == lit(2000),
+                              t["date_dim"]),
+                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    by_item = CpuAggregate(
+        [col("i_category"), col("i_item_id")],
+        [Sum(col("ss_ext_sales_price")).alias("sales")], j)
+    ranked = CpuWindow(
+        [Rank().alias("rk")],
+        WindowSpec([col("i_category")], [_desc(col("sales"))]),
+        by_item)
+    top = CpuFilter(col("rk") <= lit(3), ranked)
+    return CpuSort(
+        [asc(col("i_category")), asc(col("rk")),
+         asc(col("i_item_id"))],
+        CpuProject([col("i_category"), col("i_item_id"),
+                    col("sales"), col("rk")], top))
+
+
+
+
+
 QUERIES = {
     "q1": q1, "q2": q2_shape, "q3": q3, "q6": q6_shape, "q7": q7_shape,
     "q13": q13_shape, "q18": q18_shape, "q21": q21ds_shape,
     "q32": q32_shape, "q34": q34_shape, "q36": q36_shape,
     "q38": q38_shape, "q41": q41_shape, "q60": q60_shape,
+    "q63": q63_shape, "q67": q67_shape,
     "q69": q69_shape, "q87": q87_shape,
     "q15": q15_shape, "q16": q16_shape, "q19": q19, "q25": q25_shape,
     "q26": q26, "q27": q27_shape, "q28": q28_shape, "q33": q33_shape,

@@ -437,6 +437,58 @@ def _ensure_io_rules() -> None:
     register_exec(CpuWriteFiles, "columnar file write", _conv_write_files,
                   tag_extra=_tag_write_files)
     _register_pyudf_rules()
+    _register_window_rule()
+
+
+def _register_window_rule() -> None:
+    from spark_rapids_tpu.exec.window import CpuWindow, WindowExec
+
+    def _conv_window(meta, kids):
+        # co-locate each window partition group (Spark plans a hash
+        # exchange on the partition keys below WindowExec)
+        child = kids[0]
+        nparts = _num_partitions_of(child)
+        if nparts > 1:
+            if meta.node.spec.partition_by:
+                child = ShuffleExchangeExec(
+                    HashPartitioning(list(meta.node.spec.partition_by),
+                                     nparts), child)
+            else:
+                child = ShuffleExchangeExec(SinglePartitioning(), child)
+        return WindowExec(meta.node.window_exprs, meta.node.spec, child)
+
+    def _tag_window(meta) -> None:
+        # reference GpuWindowExec tags unsupported frame shapes so they
+        # fall back instead of crashing at kernel build
+        node = meta.node
+        if not node.spec.frame.is_rows and len(node.spec.order_by) != 1:
+            meta.will_not_work_on_tpu(
+                "range frames need exactly one order key on the TPU")
+        child_schema = node.child.output_schema()
+        for fn, _ in node.window_exprs:
+            if fn.kind not in ("row_number", "rank", "dense_rank",
+                               "lead", "lag", "sum", "min", "max",
+                               "count", "avg", "first", "last"):
+                meta.will_not_work_on_tpu(
+                    f"window function {fn.kind} has no TPU "
+                    "implementation")
+            elif fn.kind in ("min", "max") and fn.child is not None:
+                try:
+                    dt = fn.child.data_type(child_schema)
+                except Exception:
+                    continue
+                if dt.is_string:
+                    meta.will_not_work_on_tpu(
+                        "string window min/max has no TPU kernel")
+
+    register_exec(
+        CpuWindow, "window aggregation", _conv_window,
+        exprs_of=lambda n: (
+            [fn.child for fn, _ in n.window_exprs
+             if fn.child is not None]
+            + list(n.spec.partition_by)
+            + [o.expr for o in n.spec.order_by]),
+        tag_extra=_tag_window)
 
 
 def _tag_pandas_exec(meta) -> None:

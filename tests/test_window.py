@@ -141,3 +141,145 @@ def test_window_null_values(rng):
     out = plan.collect()
     assert out.column("s").to_pylist(4) == [10, 10, 40, 40]
     assert out.column("c").to_pylist(4) == [1, 1, 2, 2]
+
+
+# -- planner-level window node (CpuWindow -> WindowExec) ---------------------
+def _wdf():
+    return pd.DataFrame({
+        "g": pd.array([1, 1, 2, 2, 2, 1, 3], dtype="Int64"),
+        "o": pd.array([3, 1, 5, 5, 2, 2, 9], dtype="Int64"),
+        "v": pd.array([10.0, 20.0, 30.0, None, 50.0, 60.0, 70.0],
+                      dtype="Float64"),
+    })
+
+
+def _window_compare(plan, c=None, sort_by=("g", "o")):
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.plan import accelerate, collect
+    conf = c or C.RapidsConf()
+    expected = plan.collect().sort_values(
+        list(sort_by), ignore_index=True)
+    got = collect(accelerate(plan, conf), conf).sort_values(
+        list(sort_by), ignore_index=True)
+    pd.testing.assert_frame_equal(expected, got, check_dtype=False,
+                                  rtol=1e-6)
+    from spark_rapids_tpu.plan.overrides import ExecutionPlanCapture
+    return ExecutionPlanCapture.last_plan
+
+
+def test_cpu_window_node_rank_parity():
+    from spark_rapids_tpu.exec.window import (CpuWindow, DenseRank, Rank,
+                                              RowNumber, WindowSpec)
+    from spark_rapids_tpu.exec.sort import asc
+    from spark_rapids_tpu.exec.base import TpuExec
+    from spark_rapids_tpu.plan.nodes import CpuSource
+    spec = WindowSpec([col("g")], [asc(col("o"))])
+    plan = CpuWindow(
+        [RowNumber().alias("rn"), Rank().alias("rk"),
+         DenseRank().alias("drk")], spec,
+        CpuSource.from_pandas(_wdf(), num_partitions=2))
+    tpu_plan = _window_compare(plan)
+    assert isinstance(tpu_plan, TpuExec)
+
+
+def test_cpu_window_node_running_and_partition_aggs():
+    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
+                                              WindowSpec, WinAvg,
+                                              WinCount, WinSum)
+    from spark_rapids_tpu.exec.sort import asc
+    from spark_rapids_tpu.plan.nodes import CpuSource
+    # running (default frame: unbounded preceding .. current row, range
+    # semantics include peers)
+    spec = WindowSpec([col("g")], [asc(col("o"))],
+                      WindowFrame(is_rows=False))
+    plan = CpuWindow([WinSum(col("v")).alias("rs"),
+                      WinCount(col("v")).alias("rc")], spec,
+                     CpuSource.from_pandas(_wdf()))
+    _window_compare(plan)
+    # whole-partition frame (rows-unbounded; range frames require an
+    # order key in the TPU kernel)
+    spec2 = WindowSpec([col("g")], [],
+                       WindowFrame(is_rows=True, lower=None, upper=None))
+    plan2 = CpuWindow([WinAvg(col("v")).alias("pa")], spec2,
+                      CpuSource.from_pandas(_wdf()))
+    _window_compare(plan2, sort_by=("g", "o", "v"))
+
+
+def test_cpu_window_node_lead_lag_and_rows_frame():
+    from spark_rapids_tpu.exec.window import (CpuWindow, Lag, Lead,
+                                              WindowFrame, WindowSpec,
+                                              WinMax)
+    from spark_rapids_tpu.exec.sort import asc
+    from spark_rapids_tpu.plan.nodes import CpuSource
+    spec = WindowSpec([col("g")], [asc(col("o"))],
+                      WindowFrame(is_rows=True, lower=-1, upper=1))
+    plan = CpuWindow(
+        [Lead(col("v")).alias("nxt"), Lag(col("v"), 1).alias("prv"),
+         WinMax(col("v")).alias("m3")], spec,
+        CpuSource.from_pandas(_wdf(), num_partitions=2))
+    _window_compare(plan)
+
+
+def test_cpu_window_unsupported_shapes_fall_back():
+    """Range frames with != 1 order key and string min/max must fall
+    back to the CPU engine, not crash at kernel build."""
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
+                                              WindowSpec, WinMax, WinSum)
+    from spark_rapids_tpu.plan import accelerate
+    from spark_rapids_tpu.plan.nodes import CpuNode, CpuSource
+    df = pd.DataFrame({
+        "g": pd.array([1, 1, 2], dtype="Int64"),
+        "s": pd.array(["b", "a", "c"], dtype=object),
+        "v": pd.array([1.0, 2.0, 3.0], dtype="Float64")})
+    # range frame without an order key
+    p1 = CpuWindow([WinSum(col("v")).alias("x")],
+                   WindowSpec([col("g")], [],
+                              WindowFrame(is_rows=False)),
+                   CpuSource.from_pandas(df))
+    assert isinstance(accelerate(p1, C.RapidsConf()), CpuNode)
+    out1 = p1.collect()
+    assert len(out1) == 3
+    # string max
+    p2 = CpuWindow([WinMax(col("s")).alias("mx")],
+                   WindowSpec([col("g")], [],
+                              WindowFrame(is_rows=True, lower=None,
+                                          upper=None)),
+                   CpuSource.from_pandas(df))
+    assert isinstance(accelerate(p2, C.RapidsConf()), CpuNode)
+    out2 = p2.collect()
+    assert sorted(out2["mx"].tolist()) == ["b", "b", "c"]
+
+
+def test_cpu_window_desc_string_order_and_null_first_value():
+    """Descending string order keys sort prefixes after extensions, and
+    first over a frame whose boundary row is null yields null (Spark
+    ignoreNulls=false)."""
+    from spark_rapids_tpu.exec.sort import desc as _desc
+    from spark_rapids_tpu.exec.window import (CpuWindow, RowNumber,
+                                              WindowFrame, WindowSpec,
+                                              WindowFunction)
+    from spark_rapids_tpu.plan.nodes import CpuSource
+    df = pd.DataFrame({
+        "g": pd.array([1, 1, 1], dtype="Int64"),
+        "s": pd.array(["a", "ab", "b"], dtype=object),
+        "v": pd.array([None, 5.0, 7.0], dtype="Float64")})
+    plan = CpuWindow(
+        [RowNumber().alias("rn")],
+        WindowSpec([col("g")], [_desc(col("s"))]),
+        CpuSource.from_pandas(df))
+    out = plan.collect().sort_values("s", ignore_index=True)
+    # desc: b(1), ab(2), a(3)
+    assert out[out["s"] == "b"]["rn"].iloc[0] == 1
+    assert out[out["s"] == "ab"]["rn"].iloc[0] == 2
+    assert out[out["s"] == "a"]["rn"].iloc[0] == 3
+    first = WindowFunction("first", col("v"))
+    plan2 = CpuWindow(
+        [first.alias("fv")],
+        WindowSpec([col("g")], [],
+                   WindowFrame(is_rows=True, lower=None, upper=None)),
+        CpuSource.from_pandas(df))
+    out2 = plan2.collect()
+    # the first row of the partition holds null v -> first is null
+    assert out2["fv"].isna().all() or out2["fv"].isna().any()
+    assert out2["fv"].isna().sum() == 3
