@@ -487,8 +487,9 @@ def _cpu_window_eval(df, child_schema, spec: WindowSpec, window_exprs):
         for s, o in zip(ocols, spec.order_by):
             v = s.iloc[i]
             null = pd.isna(v)
-            # null ordering then direction, mirroring SortOrder
-            key.append((null != o.nulls_first,
+            # null ordering then direction, mirroring SortOrder's
+            # resolved default (asc -> nulls first, desc -> nulls last)
+            key.append((null != o.resolved_nulls_first,
                         _dirval(v, o.ascending, null)))
         return tuple(key)
 

@@ -1070,11 +1070,91 @@ def q67_shape(t, run):
 
 
 
+
+
+def q47_shape(t, run):
+    """Brand monthly sales vs neighbors and the brand average
+    (reference q47/q57: stacked windows — lag/lead over time plus a
+    whole-partition average)."""
+    from spark_rapids_tpu.exec.sort import asc as _asc
+    from spark_rapids_tpu.exec.window import (CpuWindow, Lag, Lead,
+                                              WindowFrame, WindowSpec,
+                                              WinAvg)
+    j = _join(_join(CpuFilter(col("d_year") == lit(2000),
+                              t["date_dim"]),
+                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    monthly = CpuAggregate(
+        [col("i_brand"), col("d_moy")],
+        [Sum(col("ss_sales_price")).alias("sum_sales")], j)
+    with_neighbors = CpuWindow(
+        [Lag(col("sum_sales")).alias("psum"),
+         Lead(col("sum_sales")).alias("nsum")],
+        WindowSpec([col("i_brand")], [_asc(col("d_moy"))]),
+        monthly)
+    with_avg = CpuWindow(
+        [WinAvg(col("sum_sales")).alias("avg_monthly")],
+        WindowSpec([col("i_brand")], [],
+                   WindowFrame(is_rows=True, lower=None, upper=None)),
+        with_neighbors)
+    dev = CpuFilter(
+        (col("avg_monthly") > lit(0.0)) &
+        (col("sum_sales") > col("avg_monthly") * lit(1.5)), with_avg)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_brand")), asc(col("d_moy"))],
+        CpuProject([col("i_brand"), col("d_moy"), col("sum_sales"),
+                    col("psum"), col("nsum"), col("avg_monthly")], dev)))
+
+
+def q51_shape(t, run):
+    """Running cumulative revenue per item over months, web vs store,
+    reporting months where the web cumulative overtakes the store one
+    (reference q51's full-outer join of windowed cumulatives)."""
+    from spark_rapids_tpu.exec.sort import asc as _asc
+    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
+                                              WindowSpec, WinSum)
+
+    def cum(sales, date_key, item_key, price, prefix):
+        monthly = CpuAggregate(
+            [col(item_key), col("d_moy")],
+            [Sum(col(price)).alias(f"{prefix}_sales")],
+            _join(CpuFilter(col("d_year") == lit(2000), t["date_dim"]),
+                  t[sales], ["d_date_sk"], [date_key]))
+        w = CpuWindow(
+            [WinSum(col(f"{prefix}_sales")).alias(f"{prefix}_cum")],
+            WindowSpec([col(item_key)], [_asc(col("d_moy"))],
+                       WindowFrame(is_rows=True, lower=None, upper=0)),
+            monthly)
+        return CpuProject(
+            [col(item_key).alias(f"{prefix}_item"),
+             col("d_moy").alias(f"{prefix}_moy"),
+             col(f"{prefix}_cum")], w)
+
+    web = cum("web_sales", "ws_sold_date_sk", "ws_item_sk",
+              "ws_ext_sales_price", "web")
+    store = cum("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                "ss_ext_sales_price", "store")
+    j = CpuHashJoin(
+        J.FULL_OUTER, [col("web_item"), col("web_moy")],
+        [col("store_item"), col("store_moy")], web, store)
+    ahead = CpuFilter(
+        IsNotNull(col("web_cum")) & IsNotNull(col("store_cum")) &
+        (col("web_cum") > col("store_cum")), j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("web_item")), asc(col("web_moy"))],
+        CpuProject([col("web_item"), col("web_moy"), col("web_cum"),
+                    col("store_cum")], ahead)))
+
+
+
+
+
 QUERIES = {
     "q1": q1, "q2": q2_shape, "q3": q3, "q6": q6_shape, "q7": q7_shape,
     "q13": q13_shape, "q18": q18_shape, "q21": q21ds_shape,
     "q32": q32_shape, "q34": q34_shape, "q36": q36_shape,
     "q38": q38_shape, "q41": q41_shape, "q60": q60_shape,
+    "q47": q47_shape, "q51": q51_shape,
     "q63": q63_shape, "q67": q67_shape,
     "q69": q69_shape, "q87": q87_shape,
     "q15": q15_shape, "q16": q16_shape, "q19": q19, "q25": q25_shape,

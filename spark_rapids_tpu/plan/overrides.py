@@ -461,10 +461,24 @@ def _register_window_rule() -> None:
         # reference GpuWindowExec tags unsupported frame shapes so they
         # fall back instead of crashing at kernel build
         node = meta.node
-        if not node.spec.frame.is_rows and len(node.spec.order_by) != 1:
-            meta.will_not_work_on_tpu(
-                "range frames need exactly one order key on the TPU")
         child_schema = node.child.output_schema()
+        if not node.spec.frame.is_rows:
+            if len(node.spec.order_by) != 1:
+                meta.will_not_work_on_tpu(
+                    "range frames need exactly one order key on the TPU")
+            else:
+                # the kernel reads the order key as int64: reject
+                # float/string keys so they fall back instead of being
+                # silently truncated into peers
+                try:
+                    dt = node.spec.order_by[0].expr.data_type(
+                        child_schema)
+                except Exception:
+                    dt = None
+                if dt is not None and dt.storage_dtype.kind != "i":
+                    meta.will_not_work_on_tpu(
+                        f"range frame order key must be integral/"
+                        f"date/timestamp, got {dt}")
         for fn, _ in node.window_exprs:
             if fn.kind not in ("row_number", "rank", "dense_rank",
                                "lead", "lag", "sum", "min", "max",

@@ -157,10 +157,16 @@ def _window_compare(plan, c=None, sort_by=("g", "o")):
     from spark_rapids_tpu import config as C
     from spark_rapids_tpu.plan import accelerate, collect
     conf = c or C.RapidsConf()
-    expected = plan.collect().sort_values(
-        list(sort_by), ignore_index=True)
-    got = collect(accelerate(plan, conf), conf).sort_values(
-        list(sort_by), ignore_index=True)
+
+    def norm(df):
+        df = df.sort_values(list(sort_by), ignore_index=True)
+        for name in df.columns:
+            if df[name].dtype == object:
+                df[name] = df[name].where(df[name].notna(), None)
+        return df
+
+    expected = norm(plan.collect())
+    got = norm(collect(accelerate(plan, conf), conf))
     pd.testing.assert_frame_equal(expected, got, check_dtype=False,
                                   rtol=1e-6)
     from spark_rapids_tpu.plan.overrides import ExecutionPlanCapture
@@ -281,5 +287,50 @@ def test_cpu_window_desc_string_order_and_null_first_value():
         CpuSource.from_pandas(df))
     out2 = plan2.collect()
     # the first row of the partition holds null v -> first is null
-    assert out2["fv"].isna().all() or out2["fv"].isna().any()
+    # for every row of the partition
     assert out2["fv"].isna().sum() == 3
+
+
+def test_cpu_window_null_order_keys_match_tpu():
+    """Null order keys follow SortOrder's resolved default (asc ->
+    nulls first) in BOTH engines, including string keys."""
+    from spark_rapids_tpu.exec.window import (CpuWindow, RowNumber,
+                                              WindowSpec)
+    from spark_rapids_tpu.plan.nodes import CpuSource
+    df = pd.DataFrame({
+        "g": pd.array([1, 1, 1], dtype="Int64"),
+        "o": pd.array([None, -5, 5], dtype="Int64"),
+        "s": pd.array([None, "b", "a"], dtype=object)})
+    plan = CpuWindow([RowNumber().alias("rn")],
+                     WindowSpec([col("g")], [asc(col("o"))]),
+                     CpuSource.from_pandas(df))
+    _window_compare(plan, sort_by=("o",))
+    out = plan.collect()
+    assert out[out["o"].isna()]["rn"].iloc[0] == 1  # nulls first
+    plan2 = CpuWindow([RowNumber().alias("rn")],
+                      WindowSpec([col("g")], [asc(col("s"))]),
+                      CpuSource.from_pandas(df))
+    out2 = plan2.collect()  # string key with null: no crash
+    assert out2[out2["s"].isna()]["rn"].iloc[0] == 1
+
+
+def test_float_range_frame_falls_back():
+    """Range frames over a float order key fall back to CPU (the TPU
+    kernel reads the key as int64 and would merge 1.2/1.9 into peers)."""
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
+                                              WindowSpec, WinSum)
+    from spark_rapids_tpu.plan import accelerate, collect as _collect
+    from spark_rapids_tpu.plan.nodes import CpuNode, CpuSource
+    df = pd.DataFrame({
+        "g": pd.array([1, 1, 1], dtype="Int64"),
+        "o": pd.array([1.2, 1.9, 3.0], dtype="Float64"),
+        "v": pd.array([10.0, 20.0, 40.0], dtype="Float64")})
+    plan = CpuWindow([WinSum(col("v")).alias("rs")],
+                     WindowSpec([col("g")], [asc(col("o"))],
+                                WindowFrame(is_rows=False)),
+                     CpuSource.from_pandas(df))
+    acc = accelerate(plan, C.RapidsConf())
+    assert isinstance(acc, CpuNode)
+    out = _collect(acc).sort_values("o", ignore_index=True)
+    assert out["rs"].tolist() == [10.0, 30.0, 70.0]
