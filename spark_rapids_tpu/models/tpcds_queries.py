@@ -1149,12 +1149,245 @@ def q51_shape(t, run):
 
 
 
+
+
+def q44_shape(t, run):
+    """Best and worst items by average profit via two window ranks
+    (reference q44's asc/desc rank pair)."""
+    from spark_rapids_tpu.exec.sort import asc as _asc, desc as _desc
+    from spark_rapids_tpu.exec.window import CpuWindow, Rank, WindowSpec
+    by_item = CpuAggregate(
+        [col("ss_item_sk")],
+        [Average(col("ss_net_profit")).alias("avg_profit")],
+        t["store_sales"])
+    ranked = CpuWindow(
+        [Rank().alias("best_rk")],
+        WindowSpec([], [_desc(col("avg_profit"))]), by_item)
+    ranked = CpuWindow(
+        [Rank().alias("worst_rk")],
+        WindowSpec([], [_asc(col("avg_profit"))]), ranked)
+    top = CpuFilter((col("best_rk") <= lit(10)) |
+                    (col("worst_rk") <= lit(10)), ranked)
+    j = _join(top, t["item"], ["ss_item_sk"], ["i_item_sk"])
+    return CpuSort(
+        [asc(col("best_rk")), asc(col("worst_rk")),
+         asc(col("i_item_id"))],
+        CpuProject([col("i_item_id"), col("avg_profit"),
+                    col("best_rk"), col("worst_rk")], j))
+
+
+def q58_shape(t, run):
+    """Items whose revenue is roughly equal across all three channels
+    (reference q58's three-way join with ratio bands)."""
+    def chan(sales, item_key, price, name):
+        agg = CpuAggregate(
+            [col(item_key)], [Sum(col(price)).alias(name)], t[sales])
+        return CpuProject(
+            [col(item_key).alias(f"{name}_item"), col(name)], agg)
+
+    ss = chan("store_sales", "ss_item_sk", "ss_ext_sales_price",
+              "ss_rev")
+    cs = chan("catalog_sales", "cs_item_sk", "cs_ext_sales_price",
+              "cs_rev")
+    ws = chan("web_sales", "ws_item_sk", "ws_ext_sales_price", "ws_rev")
+    j = _join(_join(ss, cs, ["ss_rev_item"], ["cs_rev_item"]),
+              ws, ["ss_rev_item"], ["ws_rev_item"])
+    avg3 = (col("ss_rev") + col("cs_rev") + col("ws_rev")) / lit(3.0)
+    close = CpuFilter(
+        (col("ss_rev") >= avg3 * lit(0.6)) &
+        (col("ss_rev") <= avg3 * lit(1.4)) &
+        (col("cs_rev") >= avg3 * lit(0.6)) &
+        (col("cs_rev") <= avg3 * lit(1.4)) &
+        (col("ws_rev") >= avg3 * lit(0.6)) &
+        (col("ws_rev") <= avg3 * lit(1.4)), j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("ss_rev_item"))],
+        CpuProject([col("ss_rev_item"), col("ss_rev"), col("cs_rev"),
+                    col("ws_rev")], close)))
+
+
+def q59_shape(t, run):
+    """Week-day store revenue pivot compared year over year (reference
+    q59's self-join of weekly pivots)."""
+    def pivot(year, suffix):
+        j = _join(CpuFilter(col("d_year") == lit(year), t["date_dim"]),
+                  t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"])
+        day = lambda n: Sum(If(col("d_day_name") == lit(n),
+                               col("ss_sales_price"), lit(0.0)))
+        agg = CpuAggregate(
+            [col("ss_store_sk")],
+            [day("Sunday").alias(f"sun{suffix}"),
+             day("Wednesday").alias(f"wed{suffix}"),
+             day("Saturday").alias(f"sat{suffix}")], j)
+        return CpuProject(
+            [col("ss_store_sk").alias(f"store{suffix}"),
+             col(f"sun{suffix}"), col(f"wed{suffix}"),
+             col(f"sat{suffix}")], agg)
+
+    y1 = pivot(2000, "1")
+    y2 = pivot(2001, "2")
+    j = _join(y1, y2, ["store1"], ["store2"])
+    safe = CpuFilter((col("sun2") > lit(0.0)) &
+                     (col("wed2") > lit(0.0)), j)
+    return CpuSort(
+        [asc(col("store1"))],
+        CpuProject([col("store1"),
+                    (col("sun1") / col("sun2")).alias("sun_ratio"),
+                    (col("wed1") / col("wed2")).alias("wed_ratio")],
+                   safe))
+
+
+def q66_shape(t, run):
+    """Warehouse monthly revenue pivot, web + catalog united
+    (reference q66's 12-month If-sum pivot)."""
+    u = CpuUnion(
+        CpuProject([col("ws_warehouse_sk").alias("wh"),
+                    col("ws_sold_date_sk").alias("sold"),
+                    col("ws_ext_sales_price").alias("price")],
+                   t["web_sales"]),
+        CpuProject([col("cs_warehouse_sk").alias("wh"),
+                    col("cs_sold_date_sk").alias("sold"),
+                    col("cs_ext_sales_price").alias("price")],
+                   t["catalog_sales"]))
+    j = _join(_join(u, CpuFilter(col("d_year") == lit(2001),
+                                 t["date_dim"]),
+                    ["sold"], ["d_date_sk"]),
+              t["warehouse"], ["wh"], ["w_warehouse_sk"])
+    mo = lambda m: Sum(If(col("d_moy") == lit(m), col("price"),
+                          lit(0.0)))
+    agg = CpuAggregate(
+        [col("w_warehouse_name"), col("w_warehouse_sq_ft")],
+        [mo(m).alias(f"m{m:02d}_sales") for m in range(1, 13)], j)
+    return CpuSort([asc(col("w_warehouse_name"))], agg)
+
+
+def q70_shape(t, run):
+    """States ranked by store profit, top 5 (reference q70's windowed
+    state rank without the rollup)."""
+    from spark_rapids_tpu.exec.sort import desc as _desc
+    from spark_rapids_tpu.exec.window import CpuWindow, Rank, WindowSpec
+    j = _join(_join(CpuFilter(col("d_year") == lit(2000),
+                              t["date_dim"]),
+                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+              t["store"], ["ss_store_sk"], ["s_store_sk"])
+    by_state = CpuAggregate(
+        [col("s_state")],
+        [Sum(col("ss_net_profit")).alias("total_profit")], j)
+    ranked = CpuWindow([Rank().alias("rk")],
+                       WindowSpec([], [_desc(col("total_profit"))]),
+                       by_state)
+    return CpuSort(
+        [asc(col("rk")), asc(col("s_state"))],
+        CpuFilter(col("rk") <= lit(5), ranked))
+
+
+def q75_shape(t, run):
+    """Year-over-year quantity change per category across all channels
+    (reference q75's union + prior-year self-join)."""
+    def year_qty(year):
+        u = CpuUnion(
+            CpuProject([col("ss_sold_date_sk").alias("sold"),
+                        col("ss_item_sk").alias("it"),
+                        col("ss_quantity").alias("qty")],
+                       t["store_sales"]),
+            CpuProject([col("cs_sold_date_sk").alias("sold"),
+                        col("cs_item_sk").alias("it"),
+                        col("cs_quantity").alias("qty")],
+                       t["catalog_sales"]),
+            CpuProject([col("ws_sold_date_sk").alias("sold"),
+                        col("ws_item_sk").alias("it"),
+                        col("ws_quantity").alias("qty")],
+                       t["web_sales"]))
+        j = _join(_join(u, CpuFilter(col("d_year") == lit(year),
+                                     t["date_dim"]),
+                        ["sold"], ["d_date_sk"]),
+                  t["item"], ["it"], ["i_item_sk"])
+        return CpuAggregate([col("i_category_id")],
+                            [Sum(col("qty")).alias(f"qty_{year}")], j)
+
+    cur = year_qty(2001)
+    prev = CpuProject([col("i_category_id").alias("cat_prev"),
+                       col("qty_2000")], year_qty(2000))
+    j = _join(cur, prev, ["i_category_id"], ["cat_prev"])
+    decline = CpuFilter(
+        (col("qty_2000") > lit(0)) &
+        (col("qty_2001") * lit(10) < col("qty_2000") * lit(9)), j)
+    return CpuSort(
+        [asc(col("i_category_id"))],
+        CpuProject([col("i_category_id"), col("qty_2000"),
+                    col("qty_2001")], decline))
+
+
+def q77_shape(t, run):
+    """Profit and returns per channel, united into one report
+    (reference q77's channel union with loss netting)."""
+    def channel(name, sales_profit, returns_amt):
+        return CpuProject(
+            [lit(name).alias("channel"), col("profit"),
+             col("returns_amt")],
+            _join(sales_profit, returns_amt, ["k1"], ["k2"]))
+
+    def one_row(node, alias_, key):
+        return CpuProject(
+            [lit(1).alias(key), col(alias_)],
+            node)
+
+    ss = one_row(CpuAggregate(
+        [], [Sum(col("ss_net_profit")).alias("profit")],
+        t["store_sales"]), "profit", "k1")
+    sr = one_row(CpuAggregate(
+        [], [Sum(col("sr_return_amt")).alias("returns_amt")],
+        t["store_returns"]), "returns_amt", "k2")
+    cs = one_row(CpuAggregate(
+        [], [Sum(col("cs_net_profit")).alias("profit")],
+        t["catalog_sales"]), "profit", "k1")
+    cr = one_row(CpuAggregate(
+        [], [Sum(col("cr_return_amount")).alias("returns_amt")],
+        t["catalog_returns"]), "returns_amt", "k2")
+    ws = one_row(CpuAggregate(
+        [], [Sum(col("ws_net_profit")).alias("profit")],
+        t["web_sales"]), "profit", "k1")
+    wr = one_row(CpuAggregate(
+        [], [Sum(col("wr_return_amt")).alias("returns_amt")],
+        t["web_returns"]), "returns_amt", "k2")
+    u = CpuUnion(channel("store", ss, sr),
+                 channel("catalog", cs, cr),
+                 channel("web", ws, wr))
+    return CpuSort([asc(col("channel"))], u)
+
+
+def q80_shape(t, run):
+    """Per-store revenue net of returns with promo split (reference
+    q80's store-channel report)."""
+    j = CpuHashJoin(
+        J.LEFT_OUTER,
+        [col("ss_item_sk"), col("ss_ticket_number")],
+        [col("sr_item_sk"), col("sr_ticket_number")],
+        t["store_sales"], t["store_returns"])
+    j = _join(j, t["store"], ["ss_store_sk"], ["s_store_sk"])
+    net = col("ss_ext_sales_price") - Coalesce(
+        (col("sr_return_amt"), lit(0.0)))
+    agg = CpuAggregate(
+        [col("s_store_id")],
+        [Sum(net).alias("sales_net"),
+         Sum(Coalesce((col("sr_return_amt"), lit(0.0)))).alias(
+             "returns_amt"),
+         Sum(col("ss_net_profit")).alias("profit")], j)
+    return CpuSort([asc(col("s_store_id"))], agg)
+
+
+
+
+
 QUERIES = {
     "q1": q1, "q2": q2_shape, "q3": q3, "q6": q6_shape, "q7": q7_shape,
     "q13": q13_shape, "q18": q18_shape, "q21": q21ds_shape,
     "q32": q32_shape, "q34": q34_shape, "q36": q36_shape,
     "q38": q38_shape, "q41": q41_shape, "q60": q60_shape,
-    "q47": q47_shape, "q51": q51_shape,
+    "q44": q44_shape, "q47": q47_shape, "q51": q51_shape,
+    "q58": q58_shape, "q59": q59_shape, "q66": q66_shape,
+    "q70": q70_shape, "q75": q75_shape, "q77": q77_shape,
+    "q80": q80_shape,
     "q63": q63_shape, "q67": q67_shape,
     "q69": q69_shape, "q87": q87_shape,
     "q15": q15_shape, "q16": q16_shape, "q19": q19, "q25": q25_shape,

@@ -475,7 +475,7 @@ def _register_window_rule() -> None:
                         child_schema)
                 except Exception:
                     dt = None
-                if dt is not None and dt.storage_dtype.kind != "i":
+                if dt is not None and not dt.is_integral:
                     meta.will_not_work_on_tpu(
                         f"range frame order key must be integral/"
                         f"date/timestamp, got {dt}")
