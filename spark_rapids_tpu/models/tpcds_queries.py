@@ -1228,12 +1228,14 @@ def q59_shape(t, run):
     y2 = pivot(2001, "2")
     j = _join(y1, y2, ["store1"], ["store2"])
     safe = CpuFilter((col("sun2") > lit(0.0)) &
-                     (col("wed2") > lit(0.0)), j)
+                     (col("wed2") > lit(0.0)) &
+                     (col("sat2") > lit(0.0)), j)
     return CpuSort(
         [asc(col("store1"))],
         CpuProject([col("store1"),
                     (col("sun1") / col("sun2")).alias("sun_ratio"),
-                    (col("wed1") / col("wed2")).alias("wed_ratio")],
+                    (col("wed1") / col("wed2")).alias("wed_ratio"),
+                    (col("sat1") / col("sat2")).alias("sat_ratio")],
                    safe))
 
 
@@ -1379,8 +1381,224 @@ def q80_shape(t, run):
 
 
 
+
+
+def q8_shape(t, run):
+    """Store revenue limited to customer states with enough customers
+    (reference q8's zip-list filter, by state)."""
+    by_state = CpuAggregate(
+        [col("ca_state")], [Count(None).alias("n_cust")],
+        t["customer_address"])
+    big = CpuFilter(col("n_cust") >= lit(10), by_state)
+    j = _join(_join(_join(
+        CpuFilter(col("d_year") == lit(2000), t["date_dim"]),
+        t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        t["customer"], ["ss_customer_sk"], ["c_customer_sk"]),
+        t["customer_address"], ["c_current_addr_sk"], ["ca_address_sk"])
+    j = CpuHashJoin(J.LEFT_SEMI, [col("ca_state")], [col("ca_state")],
+                    j, CpuProject([col("ca_state")], big))
+    agg = CpuAggregate(
+        [col("ca_state")],
+        [Sum(col("ss_net_profit")).alias("net_profit")], j)
+    return CpuSort([asc(col("ca_state"))], agg)
+
+
+def q10_shape(t, run):
+    """Demographics of customers active in web or catalog (reference
+    q10's exists-any-channel, as a semi join over a union)."""
+    active = CpuUnion(
+        CpuProject([col("ws_bill_customer_sk").alias("cust")],
+                   t["web_sales"]),
+        CpuProject([col("cs_bill_customer_sk").alias("cust")],
+                   t["catalog_sales"]))
+    store = _join(t["store_sales"], t["customer_demographics"],
+                  ["ss_cdemo_sk"], ["cd_demo_sk"])
+    j = CpuHashJoin(J.LEFT_SEMI, [col("ss_customer_sk")], [col("cust")],
+                    store, active)
+    agg = CpuAggregate(
+        [col("cd_gender"), col("cd_marital_status"),
+         col("cd_education_status")],
+        [Count(None).alias("cnt")], j)
+    return CpuSort(
+        [asc(col("cd_gender")), asc(col("cd_marital_status")),
+         asc(col("cd_education_status"))], agg)
+
+
+def q23_shape(t, run):
+    """Catalog revenue from frequent store items bought by the best
+    store customers (reference q23's two semi-join subqueries)."""
+    freq_items = CpuFilter(
+        col("n_sold") >= lit(8),
+        CpuAggregate([col("ss_item_sk")],
+                     [Count(None).alias("n_sold")], t["store_sales"]))
+    spend = CpuAggregate(
+        [col("ss_customer_sk")],
+        [Sum(col("ss_net_paid")).alias("spend")], t["store_sales"])
+    avg_spend = CpuProject(
+        [lit(1).alias("k"), col("avg_spend")],
+        CpuAggregate([], [Average(col("spend")).alias("avg_spend")],
+                     CpuProject([col("spend")], spend)))
+    best = CpuFilter(
+        col("spend") > col("avg_spend") * lit(1.2),
+        _join(CpuProject([col("ss_customer_sk"), col("spend"),
+                          lit(1).alias("k2")], spend),
+              avg_spend, ["k2"], ["k"]))
+    cs = CpuHashJoin(
+        J.LEFT_SEMI, [col("cs_item_sk")], [col("ss_item_sk")],
+        t["catalog_sales"],
+        CpuProject([col("ss_item_sk")], freq_items))
+    cs = CpuHashJoin(
+        J.LEFT_SEMI, [col("cs_bill_customer_sk")],
+        [col("ss_customer_sk")], cs,
+        CpuProject([col("ss_customer_sk")], best))
+    return CpuAggregate(
+        [], [Sum(col("cs_ext_sales_price")).alias("sales")], cs)
+
+
+def q30_shape(t, run):
+    """Customers whose web-return total exceeds 1.2x their state's
+    average (reference q30, q1's web twin)."""
+    ctr = CpuAggregate(
+        [col("wr_returning_customer_sk")],
+        [Sum(col("wr_return_amt")).alias("ctr_total")],
+        t["web_returns"])
+    j = _join(_join(ctr, t["customer"],
+                    ["wr_returning_customer_sk"], ["c_customer_sk"]),
+              t["customer_address"],
+              ["c_current_addr_sk"], ["ca_address_sk"])
+    avg_state = CpuAggregate(
+        [col("ca_state")],
+        [Average(col("ctr_total")).alias("avg_ret")],
+        CpuProject([col("ca_state"), col("ctr_total")], j))
+    big = CpuFilter(
+        col("ctr_total") > col("avg_ret") * lit(1.2),
+        _join(j, CpuProject([col("ca_state").alias("st2"),
+                             col("avg_ret")], avg_state),
+              ["ca_state"], ["st2"]))
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_customer_id"))],
+        CpuProject([col("c_customer_id"), col("ca_state"),
+                    col("ctr_total")], big)))
+
+
+def q31_shape(t, run):
+    """States where web revenue grew faster than store revenue between
+    quarters (reference q31's growth-ratio comparison)."""
+    def qrev(sales, date_key, cust_key, price, qoy, name):
+        j = _join(_join(_join(
+            CpuFilter((col("d_year") == lit(2000)) &
+                      (col("d_qoy") == lit(qoy)), t["date_dim"]),
+            t[sales], ["d_date_sk"], [date_key]),
+            t["customer"], [cust_key], ["c_customer_sk"]),
+            t["customer_address"],
+            ["c_current_addr_sk"], ["ca_address_sk"])
+        agg = CpuAggregate([col("ca_state")],
+                           [Sum(col(price)).alias(name)], j)
+        return CpuProject(
+            [col("ca_state").alias(f"{name}_state"), col(name)], agg)
+
+    ss1 = qrev("store_sales", "ss_sold_date_sk", "ss_customer_sk",
+               "ss_ext_sales_price", 1, "ss1")
+    ss2 = qrev("store_sales", "ss_sold_date_sk", "ss_customer_sk",
+               "ss_ext_sales_price", 2, "ss2")
+    ws1 = qrev("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
+               "ws_ext_sales_price", 1, "ws1")
+    ws2 = qrev("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
+               "ws_ext_sales_price", 2, "ws2")
+    j = _join(_join(_join(ss1, ss2, ["ss1_state"], ["ss2_state"]),
+                    ws1, ["ss1_state"], ["ws1_state"]),
+              ws2, ["ss1_state"], ["ws2_state"])
+    grew = CpuFilter(
+        (col("ss1") > lit(0.0)) & (col("ws1") > lit(0.0)) &
+        (col("ws2") * col("ss1") > col("ss2") * col("ws1")), j)
+    return CpuSort(
+        [asc(col("ss1_state"))],
+        CpuProject([col("ss1_state"), col("ss1"), col("ss2"),
+                    col("ws1"), col("ws2")], grew))
+
+
+def q71_shape(t, run):
+    """Brand revenue by hour band across all channels for one month
+    (reference q71's time-of-day breakdown)."""
+    dd = CpuFilter((col("d_year") == lit(2000)) &
+                   (col("d_moy") == lit(12)), t["date_dim"])
+    u = CpuUnion(
+        CpuProject([col("ss_sold_date_sk").alias("sold"),
+                    col("ss_sold_time_sk").alias("tsk"),
+                    col("ss_item_sk").alias("it"),
+                    col("ss_ext_sales_price").alias("price")],
+                   t["store_sales"]),
+        CpuProject([col("cs_sold_date_sk").alias("sold"),
+                    col("cs_sold_time_sk").alias("tsk"),
+                    col("cs_item_sk").alias("it"),
+                    col("cs_ext_sales_price").alias("price")],
+                   t["catalog_sales"]),
+        CpuProject([col("ws_sold_date_sk").alias("sold"),
+                    col("ws_sold_time_sk").alias("tsk"),
+                    col("ws_item_sk").alias("it"),
+                    col("ws_ext_sales_price").alias("price")],
+                   t["web_sales"]))
+    j = _join(_join(_join(u, dd, ["sold"], ["d_date_sk"]),
+                    t["item"], ["it"], ["i_item_sk"]),
+              t["time_dim"], ["tsk"], ["t_time_sk"])
+    agg = CpuAggregate(
+        [col("i_brand_id")],
+        [Sum(If((col("t_hour") >= lit(8)) & (col("t_hour") < lit(12)),
+                col("price"), lit(0.0))).alias("morning"),
+         Sum(If((col("t_hour") >= lit(12)) & (col("t_hour") < lit(18)),
+                col("price"), lit(0.0))).alias("afternoon"),
+         Sum(If((col("t_hour") >= lit(18)),
+                col("price"), lit(0.0))).alias("evening")], j)
+    return CpuSort([asc(col("i_brand_id"))], agg)
+
+
+def q82_shape(t, run):
+    """Items in a price band with healthy inventory sold in stores
+    (reference q82, q37's store twin)."""
+    it = CpuFilter(
+        (col("i_current_price") >= lit(30.0)) &
+        (col("i_current_price") <= lit(70.0)), t["item"])
+    inv = CpuFilter(
+        (col("inv_quantity_on_hand") >= lit(100)) &
+        (col("inv_quantity_on_hand") <= lit(500)), t["inventory"])
+    stocked = _join(it, inv, ["i_item_sk"], ["inv_item_sk"])
+    sold = CpuHashJoin(
+        J.LEFT_SEMI, [col("i_item_sk")], [col("ss_item_sk")],
+        stocked, t["store_sales"])
+    agg = CpuAggregate(
+        [col("i_item_id"), col("i_current_price")],
+        [Count(None).alias("stock_rows")], sold)
+    return CpuLimit(100, CpuSort([asc(col("i_item_id"))], agg))
+
+
+def q94_shape(t, run):
+    """Web orders in a window with no returns: order count + cost sums
+    (reference q94, q16's web twin)."""
+    dd = CpuFilter((col("d_year") == lit(2001)) &
+                   (col("d_moy") <= lit(4)), t["date_dim"])
+    sales = _join(dd, t["web_sales"], ["d_date_sk"], ["ws_sold_date_sk"])
+    no_ret = CpuHashJoin(
+        J.LEFT_ANTI, [col("ws_order_number")], [col("wr_order_number")],
+        sales, t["web_returns"])
+    per_order = CpuAggregate(
+        [col("ws_order_number")],
+        [Sum(col("ws_ext_ship_cost")).alias("ship_cost"),
+         Sum(col("ws_net_profit")).alias("net_profit")], no_ret)
+    return CpuAggregate(
+        [], [Count(None).alias("order_count"),
+             Sum(col("ship_cost")).alias("total_shipping_cost"),
+             Sum(col("net_profit")).alias("total_net_profit")],
+        per_order)
+
+
+
+
+
 QUERIES = {
     "q1": q1, "q2": q2_shape, "q3": q3, "q6": q6_shape, "q7": q7_shape,
+    "q8": q8_shape, "q10": q10_shape, "q23": q23_shape,
+    "q30": q30_shape, "q31": q31_shape, "q71": q71_shape,
+    "q82": q82_shape, "q94": q94_shape,
     "q13": q13_shape, "q18": q18_shape, "q21": q21ds_shape,
     "q32": q32_shape, "q34": q34_shape, "q36": q36_shape,
     "q38": q38_shape, "q41": q41_shape, "q60": q60_shape,
