@@ -164,6 +164,15 @@ SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.shuffle.compression.codec", "none",
     "Codec for serialized shuffle payloads on the transport wire: "
     "none, copy (testing), lz4, zstd.")
+MESH_EXCHANGE_ENABLED = conf(
+    "spark.rapids.shuffle.meshExchange.enabled", True,
+    "Route hash shuffle exchanges through the device-mesh ICI all-to-all "
+    "collective when an active mesh is set "
+    "(spark_rapids_tpu.parallel.mesh.set_active_mesh) and the exchange "
+    "is mesh-routable (hash keys are plain columns, partition count == "
+    "mesh size). The TCP/manager lane remains the DCN fallback — the "
+    "reference's equivalent split is UCX-inside-the-shuffle-manager "
+    "(RapidsShuffleInternalManager.scala:199, UCXShuffleTransport.scala:47).")
 
 # --- python / udf -----------------------------------------------------------
 PYTHON_CONCURRENT_WORKERS = conf(

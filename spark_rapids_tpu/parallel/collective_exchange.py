@@ -63,14 +63,19 @@ def _local_split(cols, num_rows, key_idx, n_dev, cap):
 
 
 def exchange_local(local, num_rows, schema: T.Schema, key_idx,
-                   n_dev: int, cap: int, axis: str):
+                   n_dev: int, cap: int, axis: str, out_cap=None):
     """The per-device exchange body; call INSIDE shard_map so larger SPMD
     programs (scan->exchange->aggregate in one jit) can fuse around it.
 
     local: list of (data, validity, lengths|None) local column arrays.
+    `out_cap` sizes the compacted received batch; pass n_dev*cap for the
+    overflow-proof worst case (every device sends all its rows here) —
+    the default (cap) is only safe when the caller pre-padded capacity.
     Returns (list of exchanged (data, validity, lengths|None), total_rows).
     """
     from spark_rapids_tpu.columnar.vector import ColumnVector
+    if out_cap is None:
+        out_cap = cap
     cols = []
     for f, (data, validity, lengths) in zip(schema.fields, local):
         cols.append(ColumnVector(f.dtype, data, validity, lengths))
@@ -82,7 +87,7 @@ def exchange_local(local, num_rows, schema: T.Schema, key_idx,
     starts = jnp.concatenate([jnp.zeros(1, recv_counts.dtype),
                               jnp.cumsum(recv_counts)[:-1]])
     total = recv_counts.sum()
-    k = jnp.arange(cap)
+    k = jnp.arange(out_cap)
     src_block = jnp.searchsorted(jnp.cumsum(recv_counts), k, side="right")
     src_block = jnp.clip(src_block, 0, n_dev - 1)
     src_off = k - jnp.take(starts, src_block)
@@ -116,11 +121,15 @@ def exchange_local(local, num_rows, schema: T.Schema, key_idx,
 def build_all_to_all_exchange(mesh: Mesh, axis: str,
                               schema: T.Schema,
                               key_indices: Sequence[int],
-                              capacity: int):
+                              capacity: int, out_capacity=None):
     """Returns a jitted SPMD function:
         (stacked_cols_pytree, num_rows[n_dev]) ->
         (exchanged_cols, new_num_rows[n_dev])
     where stacked arrays have leading dim n_dev sharded over `axis`.
+
+    `out_capacity` (default: capacity) sizes the received batch; pass
+    n_dev*capacity for the overflow-proof worst case without having to
+    pre-pad the send side.
 
     Column pytree layout per field: data [n_dev, cap, ...],
     validity [n_dev, cap], lengths or None.
@@ -135,7 +144,8 @@ def build_all_to_all_exchange(mesh: Mesh, axis: str,
                  for a in arrs]
         num_rows = num_rows[0]
         out_local, total = exchange_local(
-            local, num_rows, schema, key_idx, n_dev, capacity, axis)
+            local, num_rows, schema, key_idx, n_dev, capacity, axis,
+            out_cap=out_capacity)
         out_arrs = [(d[None], v[None], None if l is None else l[None])
                     for d, v, l in out_local]
         return out_arrs, total.astype(jnp.int32)[None]

@@ -11,6 +11,7 @@ device list.
 """
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Optional, Sequence
 
 import jax
@@ -36,3 +37,34 @@ def data_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+# --- active-mesh registry -------------------------------------------------
+# The session-level switch that turns on the accelerated (ICI collective)
+# shuffle lane: when a mesh is active, ShuffleExchangeExec routes hash
+# exchanges through the mesh all-to-all instead of the local/manager lane —
+# the analog of the reference enabling its UCX transport inside the shuffle
+# manager (RapidsShuffleInternalManager.scala:199).
+
+_ACTIVE: Optional[tuple[Mesh, str]] = None
+
+
+def set_active_mesh(mesh: Optional[Mesh],
+                    axis_name: str = DATA_AXIS) -> None:
+    global _ACTIVE
+    _ACTIVE = None if mesh is None else (mesh, axis_name)
+
+
+def get_active_mesh() -> Optional[tuple[Mesh, str]]:
+    return _ACTIVE
+
+
+@contextmanager
+def active_mesh(mesh: Mesh, axis_name: str = DATA_AXIS):
+    global _ACTIVE
+    prev = _ACTIVE
+    set_active_mesh(mesh, axis_name)
+    try:
+        yield mesh
+    finally:
+        _ACTIVE = prev

@@ -1,9 +1,10 @@
 """Plan-rewrite layer (SURVEY.md §2.2): device-neutral CPU physical plan,
 meta/tagging tree, replacement-rule registry, and transition insertion."""
 from spark_rapids_tpu.plan.nodes import (  # noqa: F401
-    CpuAggregate, CpuBroadcastExchange, CpuFilter, CpuHashJoin, CpuLimit,
-    CpuNode, CpuProject, CpuRange, CpuShuffleExchange, CpuSort, CpuSource,
-    CpuUnion, PartitioningSpec)
+    CpuAggregate, CpuBroadcastExchange, CpuExpand, CpuFilter, CpuGenerate,
+    CpuHashJoin, CpuLimit, CpuNode, CpuProject, CpuRange,
+    CpuShuffleExchange, CpuSort, CpuSortMergeJoin, CpuSource, CpuUnion,
+    PartitioningSpec)
 from spark_rapids_tpu.plan.overrides import (  # noqa: F401
     ExecutionPlanCapture, accelerate, collect)
 from spark_rapids_tpu.plan.transitions import (  # noqa: F401

@@ -591,6 +591,121 @@ class CpuHashJoin(CpuNode):
         return m.astype("boolean").fillna(False).astype(bool).to_numpy()
 
 
+class CpuExpand(CpuNode):
+    """Expand planner node (Spark ExpandExec: grouping sets / rollup /
+    cube building block): every input row emits one output row per
+    projection list.  Reference exec rule region GpuOverrides.scala:1668
+    + GpuExpandExec.scala; TPU conversion: exec/expand.py ExpandExec."""
+
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 names: Sequence[str], child: CpuNode):
+        super().__init__(child)
+        self.projections = [list(p) for p in projections]
+        self.names = list(names)
+        cs = child.output_schema()
+        dts = [e.data_type(cs) for e in self.projections[0]]
+        for p in self.projections[1:]:
+            for i, e in enumerate(p):
+                dt = e.data_type(cs)
+                if dt != dts[i]:
+                    dts[i] = T.common_type(dts[i], dt)
+        self._schema = T.Schema(tuple(
+            T.Field(n, dt) for n, dt in zip(self.names, dts)))
+
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"CpuExpand({len(self.projections)} projections)"
+
+    def _expand_df(self, df: pd.DataFrame) -> pd.DataFrame:
+        cs = self.child.output_schema()
+        frames = []
+        for p_i, proj in enumerate(self.projections):
+            cols = {}
+            for n, e in zip(self.names, proj):
+                v = cpu_eval(e, df, cs)
+                cols[n] = (v.reset_index(drop=True)
+                           if isinstance(v, pd.Series) else v)
+            f = pd.DataFrame(cols, index=pd.RangeIndex(len(df)))
+            f["__row"] = np.arange(len(df))
+            f["__proj"] = p_i
+            frames.append(f)
+        out = pd.concat(frames, ignore_index=True).sort_values(
+            ["__row", "__proj"], kind="stable", ignore_index=True)
+        return normalize_df(out.drop(columns=["__row", "__proj"]),
+                            self._schema)
+
+    def execute(self):
+        def run(it):
+            for df in it:
+                yield self._expand_df(df)
+        return [run(it) for it in self.child.execute()]
+
+
+class CpuGenerate(CpuNode):
+    """Generate planner node (Spark GenerateExec with an inline-array
+    explode/posexplode generator — the shape the reference accelerates at
+    this snapshot, GpuGenerateExec.scala).  TPU conversion:
+    exec/expand.py GenerateExec."""
+
+    def __init__(self, element_exprs: Sequence[Expression], child: CpuNode,
+                 include_pos: bool = False, value_name: str = "col",
+                 retained: Optional[Sequence[str]] = None):
+        super().__init__(child)
+        self.element_exprs = list(element_exprs)
+        self.include_pos = include_pos
+        self.value_name = value_name
+        cs = child.output_schema()
+        self.retained = (list(retained) if retained is not None
+                         else list(cs.names))
+        dt = self.element_exprs[0].data_type(cs)
+        for e in self.element_exprs[1:]:
+            d2 = e.data_type(cs)
+            if d2 != dt:
+                dt = T.common_type(dt, d2)
+        fields = [cs.field(n) for n in self.retained]
+        if include_pos:
+            fields.append(T.Field("pos", T.INT32))
+        fields.append(T.Field(value_name, dt))
+        self._schema = T.Schema(tuple(fields))
+
+    def output_schema(self):
+        return self._schema
+
+    def describe(self):
+        return (f"CpuGenerate(explode[{len(self.element_exprs)}], "
+                f"pos={self.include_pos})")
+
+    def _as_expand(self) -> CpuExpand:
+        from spark_rapids_tpu.exprs.base import AttributeReference, Literal
+        projections = []
+        for p, e in enumerate(self.element_exprs):
+            proj = [AttributeReference(n) for n in self.retained]
+            if self.include_pos:
+                proj.append(Literal(p, T.INT32))
+            proj.append(e)
+            projections.append(proj)
+        return CpuExpand(projections, [f.name for f in self._schema.fields],
+                         self.child)
+
+    def execute(self):
+        return self._as_expand().execute()
+
+
+class CpuSortMergeJoin(CpuHashJoin):
+    """Sort-merge join planner node (Spark SortMergeJoinExec).  The CPU
+    golden engine evaluates it like a hash join: the produced row set is
+    identical and merge-order is not part of the result contract.  The
+    overrides replace it with a TPU shuffled hash join and strip the
+    now-redundant input sorts when
+    spark.rapids.sql.replaceSortMergeJoin.enabled is set (reference
+    shims/spark300/.../GpuSortMergeJoinExec.scala:28)."""
+
+    def describe(self):
+        return f"CpuSortMergeJoin({self.join_type.value})"
+
+
 @dataclasses.dataclass(frozen=True)
 class PartitioningSpec:
     """Device-neutral partitioning description, converted to a TPU

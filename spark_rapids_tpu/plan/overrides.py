@@ -218,6 +218,20 @@ def _num_partitions_of(plan: TpuExec) -> int:
     return plan.output_partition_count()
 
 
+def _exchange_partitions(nparts: int, conf: C.RapidsConf) -> int:
+    """Partition count for a planned hash exchange.  When the mesh ICI
+    exchange lane is active (conf + set_active_mesh), plan at the mesh
+    size so each device owns exactly one output partition and the
+    exchange routes through the all-to-all collective
+    (ShuffleExchangeExec._mesh_routable)."""
+    from spark_rapids_tpu.parallel import mesh as PM
+    active = PM.get_active_mesh()
+    if active is not None and conf[C.MESH_EXCHANGE_ENABLED]:
+        mesh, axis = active
+        return mesh.shape[axis]
+    return nparts
+
+
 def _conv_aggregate(meta, kids) -> TpuExec:
     node: N.CpuAggregate = meta.node
     child = kids[0]
@@ -233,7 +247,9 @@ def _conv_aggregate(meta, kids) -> TpuExec:
         from spark_rapids_tpu.exprs.base import col
         keys = [col(f.name) for f in
                 partial.output_schema().fields[:len(node.group_exprs)]]
-        ex = ShuffleExchangeExec(HashPartitioning(keys, nparts), partial)
+        ex = ShuffleExchangeExec(
+            HashPartitioning(keys, _exchange_partitions(nparts, meta.conf)),
+            partial)
     else:
         ex = ShuffleExchangeExec(SinglePartitioning(), partial)
     return HashAggregateExec(
@@ -258,6 +274,7 @@ def _conv_hash_join(meta, kids) -> TpuExec:
                                      node.condition)
     nparts = max(_num_partitions_of(left), _num_partitions_of(right))
     if nparts > 1:
+        nparts = _exchange_partitions(nparts, meta.conf)
         left = ShuffleExchangeExec(
             HashPartitioning(node.left_keys, nparts), left)
         right = ShuffleExchangeExec(
@@ -278,6 +295,36 @@ def _tag_join(meta) -> None:
             JoinType.INNER, JoinType.CROSS):
         meta.will_not_work_on_tpu(
             "residual join condition only supported for inner joins")
+
+
+def _strip_smj_sort(kid: TpuExec, keys) -> TpuExec:
+    """Drop a per-partition SortExec whose keys are covered by the join
+    keys — the sort only existed to feed the sort-merge join we are
+    replacing (reference GpuSortMergeJoinExec.scala:40-52 removes the
+    child GpuSortExecs it made redundant)."""
+    from spark_rapids_tpu.exprs.base import fingerprint
+    if not isinstance(kid, SortExec) or kid.global_sort:
+        return kid
+    sort_fps = {fingerprint(o.expr) for o in kid.order}
+    key_fps = {fingerprint(k) for k in keys}
+    if sort_fps <= key_fps:
+        return kid.child
+    return kid
+
+
+def _conv_sort_merge_join(meta, kids) -> TpuExec:
+    node: N.CpuSortMergeJoin = meta.node
+    kids = [_strip_smj_sort(kids[0], node.left_keys),
+            _strip_smj_sort(kids[1], node.right_keys)]
+    return _conv_hash_join(meta, kids)
+
+
+def _tag_sort_merge_join(meta) -> None:
+    _tag_join(meta)
+    if not meta.conf[C.REPLACE_SORT_MERGE_JOIN]:
+        meta.will_not_work_on_tpu(
+            "replacing SortMergeJoin disabled by "
+            f"{C.REPLACE_SORT_MERGE_JOIN.key}")
 
 
 _PART_OF_SPEC = {
@@ -318,6 +365,33 @@ register_exec(
     exprs_of=lambda n: list(n.left_keys) + list(n.right_keys) +
     ([n.condition] if n.condition is not None else []),
     tag_extra=_tag_join)
+def _conv_expand(meta, kids) -> TpuExec:
+    from spark_rapids_tpu.exec.expand import ExpandExec
+    node: N.CpuExpand = meta.node
+    return ExpandExec(node.projections, list(node.names), kids[0])
+
+
+def _conv_generate(meta, kids) -> TpuExec:
+    from spark_rapids_tpu.exec.expand import GenerateExec
+    node: N.CpuGenerate = meta.node
+    return GenerateExec(node.element_exprs, kids[0],
+                        include_pos=node.include_pos,
+                        value_name=node.value_name,
+                        retained=node.retained)
+
+
+register_exec(
+    N.CpuExpand, "expand (grouping sets/rollup/cube)", _conv_expand,
+    exprs_of=lambda n: [e for p in n.projections for e in p])
+register_exec(
+    N.CpuGenerate, "generate (inline-array explode)", _conv_generate,
+    exprs_of=lambda n: list(n.element_exprs))
+register_exec(
+    N.CpuSortMergeJoin, "sort-merge join (replaced with hash join)",
+    _conv_sort_merge_join,
+    exprs_of=lambda n: list(n.left_keys) + list(n.right_keys) +
+    ([n.condition] if n.condition is not None else []),
+    tag_extra=_tag_sort_merge_join)
 register_exec(N.CpuShuffleExchange, "shuffle exchange", _conv_shuffle,
               exprs_of=lambda n: list(n.spec.exprs) +
               [o.expr for o in n.spec.order])

@@ -149,10 +149,12 @@ def _prune(node: N.CpuNode, required: Optional[set]) -> N.CpuNode:
             rreq = expr_refs(node.right_keys) | (cond & rnames)
         left = prune_columns(node.children[0], lreq)
         right = prune_columns(node.children[1], rreq)
-        return N.CpuHashJoin(node.join_type, node.left_keys,
-                             node.right_keys, left, right,
-                             condition=node.condition,
-                             broadcast=node.broadcast)
+        # type(node), not CpuHashJoin: CpuSortMergeJoin must survive
+        # pruning so its replacement rule (not the hash-join rule) fires
+        return type(node)(node.join_type, node.left_keys,
+                          node.right_keys, left, right,
+                          condition=node.condition,
+                          broadcast=node.broadcast)
 
     # unknown node (window, UDF execs, writers, range...): keep subtree
     return node

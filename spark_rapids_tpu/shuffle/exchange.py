@@ -116,6 +116,9 @@ class ShuffleExchangeExec(UnaryExecBase):
 
     def execute_partitions(self):
         from spark_rapids_tpu import config as C
+        mesh_axis = self._mesh_routable()
+        if mesh_axis is not None:
+            return self._execute_via_mesh(*mesh_axis)
         if C.get_active_conf()[C.RAPIDS_SHUFFLE_ENABLED]:
             return self._execute_via_manager()
         buckets = self._materialize()
@@ -126,6 +129,88 @@ class ShuffleExchangeExec(UnaryExecBase):
                 self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
                 yield b
         return [reader(bs) for bs in buckets]
+
+    def _mesh_routable(self):
+        """The accelerated ICI lane applies when: the conf enables it, a
+        device mesh is active, the partitioning is murmur3 hash over plain
+        bound columns, and the partition count equals the mesh size (so
+        device d IS partition d).  Anything else falls back to the
+        local/manager lane — mirroring the reference, whose UCX data plane
+        only takes over when the rapids shuffle manager is installed
+        (RapidsShuffleInternalManager.scala:199)."""
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.exprs.base import BoundReference
+        from spark_rapids_tpu.parallel import mesh as PM
+        from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+        if not C.get_active_conf()[C.MESH_EXCHANGE_ENABLED]:
+            return None
+        active = PM.get_active_mesh()
+        if active is None:
+            return None
+        mesh, axis = active
+        part = self.partitioning
+        if not isinstance(part, HashPartitioning):
+            return None
+        if part.num_partitions != mesh.shape[axis]:
+            return None
+        if not all(isinstance(e, BoundReference) for e in part.exprs):
+            return None
+        return mesh, axis
+
+    #: test-facing counter (ExecutionPlanCapture discipline): number of
+    #: exchanges actually routed through the mesh collective lane
+    _MESH_EXCHANGES_RUN = 0
+
+    def _execute_via_mesh(self, mesh, axis):
+        """Accelerated path: one SPMD all-to-all over the mesh replaces
+        the per-batch split + bucket copy of the local lane.  Each mesh
+        device owns one output partition; received rows are compacted
+        device-side into a worst-case-sized (overflow-proof) batch."""
+        import numpy as np
+        from spark_rapids_tpu.columnar.batch import empty_batch
+        from spark_rapids_tpu.parallel.collective_exchange import (
+            build_all_to_all_exchange, stack_batches, unstack_batches)
+        n = self.partitioning.num_partitions
+        groups: list[list[ColumnarBatch]] = [[] for _ in range(n)]
+        for i, it in enumerate(self.child.execute_partitions()):
+            for b in it:
+                if b.num_rows > 0:
+                    groups[i % n].append(b)
+        locals_ = [concat_batches(g) if g else empty_batch(self._schema)
+                   for g in groups]
+        cap = max(b.capacity for b in locals_)
+        locals_ = [b if b.capacity == cap else b.with_capacity(cap)
+                   for b in locals_]
+        key_idx = tuple(e.ordinal for e in self.partitioning.exprs)
+        # process-global LRU (bounded + clearable): mesh identity enters
+        # the key as device ids, not the Mesh object, so dead meshes are
+        # not pinned beyond the cached executable's LRU lifetime
+        from spark_rapids_tpu.exec.base import KernelCache
+        cache = KernelCache((
+            "mesh_exchange", axis,
+            tuple(d.id for d in mesh.devices.flat),
+            tuple((f.name, str(f.dtype)) for f in self._schema.fields),
+            key_idx))
+        schema = self._schema
+        step = cache.get_or_build(
+            ("step", cap),
+            lambda: build_all_to_all_exchange(
+                mesh, axis, schema, key_idx, cap, out_capacity=n * cap))
+        ShuffleExchangeExec._MESH_EXCHANGES_RUN += 1
+        with self.metrics.timed(M.TOTAL_TIME):
+            arrs, num_rows = stack_batches(locals_, cap)
+            out_arrs, out_rows = step(arrs, num_rows)
+        out = unstack_batches(out_arrs, np.asarray(out_rows),
+                              self._schema)
+        for b in out:
+            self.metrics.add("dataSize", b.device_size_bytes())
+
+        def reader(b: ColumnarBatch):
+            if b.num_rows > 0:
+                self.metrics.add(M.NUM_OUTPUT_ROWS, b.num_rows)
+                self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+                yield b
+        return [reader(b) for b in out]
 
     _SHUFFLE_IDS = iter(range(1, 1 << 31))
 

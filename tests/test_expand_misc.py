@@ -72,3 +72,84 @@ def test_normalize_nan_zero():
     got = out.column("n").to_pylist(4)
     assert math.copysign(1, got[0]) == 1.0  # -0.0 -> +0.0
     assert got[1] == 0.0 and math.isnan(got[2]) and got[3] == 1.5
+
+
+# -- planner-level Expand/Generate (VERDICT r1 item #4) ---------------------
+def test_cpu_expand_rollup_through_accelerate():
+    """Rollup-shaped expand (grouping sets) planned via accelerate():
+    projections (a,b,gid=0),(a,null,1),(null,null,3) then aggregate —
+    the exact shape Spark lowers ROLLUP(a,b) to."""
+    import pandas as pd
+    from parity import compare_frames
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.exprs.aggregates import Sum
+    from spark_rapids_tpu.exprs.base import col, Literal
+    from spark_rapids_tpu.plan import (
+        CpuAggregate, CpuExpand, CpuSource, ExecutionPlanCapture,
+        accelerate, collect)
+    df = pd.DataFrame({
+        "a": np.array([1, 1, 2, 2, 2], np.int64),
+        "b": np.array([10, 20, 10, 10, 30], np.int64),
+        "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+    })
+    src = CpuSource.from_pandas(df, num_partitions=2)
+    expand = CpuExpand(
+        [[col("a"), col("b"), Literal(0, T.INT32), col("v")],
+         [col("a"), Literal(None, T.INT64), Literal(1, T.INT32), col("v")],
+         [Literal(None, T.INT64), Literal(None, T.INT64),
+          Literal(3, T.INT32), col("v")]],
+        ["a", "b", "gid", "v"], src)
+    plan = CpuAggregate([col("a"), col("b"), col("gid")],
+                        [Sum(col("v")).alias("sv")], expand)
+    expected = plan.collect()
+    got = collect(accelerate(plan, C.RapidsConf()))
+    assert len(expected) == 7  # 4 (a,b) groups + 2 a groups + 1 total
+    ExecutionPlanCapture.assert_contains_tpu("ExpandExec")
+    compare_frames(expected, got, "rollup")
+
+
+def test_cpu_generate_posexplode_through_accelerate():
+    import pandas as pd
+    from parity import compare_frames
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.plan import (
+        CpuGenerate, CpuSource, ExecutionPlanCapture, accelerate, collect)
+    df = pd.DataFrame({
+        "k": np.array([1, 2, 3], np.int64),
+        "x": np.array([1.5, 2.5, 3.5]),
+        "y": np.array([10.0, 20.0, 30.0]),
+    })
+    src = CpuSource.from_pandas(df, num_partitions=1)
+    plan = CpuGenerate([col("x"), col("y")], src, include_pos=True,
+                       value_name="val", retained=["k"])
+    expected = plan.collect()
+    got = collect(accelerate(plan, C.RapidsConf()))
+    assert len(expected) == 6
+    ExecutionPlanCapture.assert_contains_tpu("GenerateExec")
+    compare_frames(expected, got, "posexplode")
+
+
+def test_cpu_expand_fallback_on_unsupported_expr():
+    """An expand whose projection uses an unsupported expression falls
+    back to the CPU golden engine (plan-time tagging, not runtime
+    raise)."""
+    import pandas as pd
+    from parity import compare_frames
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.exprs.base import col, Literal
+    from spark_rapids_tpu.plan import (
+        CpuExpand, CpuSource, ExecutionPlanCapture, accelerate, collect)
+    df = pd.DataFrame({"a": np.array([1, 2], np.int64)})
+    src = CpuSource.from_pandas(df, num_partitions=1)
+
+    class _Mystery(type(col("a"))):  # unregistered expression type
+        pass
+    mystery = _Mystery("a")
+    plan = CpuExpand([[col("a")], [mystery]], ["a"], src)
+    expected = plan.collect()
+    got = collect(accelerate(plan, C.RapidsConf()))
+    ExecutionPlanCapture.assert_did_fall_back("CpuExpand")
+    compare_frames(expected, got, "expand-fallback")

@@ -1,0 +1,109 @@
+"""Planner-routed mesh (ICI all-to-all) shuffle tests.
+
+The accelerated exchange lane must be reachable from accelerate(), not
+just from unit harnesses: a TPC-H join+groupby query planned normally,
+with a mesh active, must route its hash exchanges through the collective
+and still match the CPU golden engine (VERDICT r1 item #2; reference
+analog: UCX-inside-the-shuffle-manager,
+RapidsShuffleInternalManager.scala:199)."""
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+
+from parity import compare_frames
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.basic import LocalBatchSource
+from spark_rapids_tpu.exprs.base import col
+from spark_rapids_tpu.parallel.mesh import active_mesh, make_mesh
+from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must force 8 cpu devices"
+    return make_mesh(8)
+
+
+def _source(rng, n_parts=4, rows=200):
+    schema = T.Schema.of(("k", T.INT64), ("v", T.FLOAT64),
+                         ("s", T.STRING))
+    parts = []
+    for p in range(n_parts):
+        parts.append([ColumnarBatch.from_numpy({
+            "k": rng.integers(0, 50, rows).astype(np.int64),
+            "v": rng.normal(size=rows),
+            "s": np.array([f"p{p}r{i}" for i in range(rows)],
+                          dtype=object),
+        }, schema)])
+    return LocalBatchSource(parts, schema=schema)
+
+
+def test_exchange_exec_mesh_vs_local_lane(mesh8, rng):
+    """The same ShuffleExchangeExec produces the same row-sets per
+    partition through the mesh collective as through the local lane."""
+    src = _source(rng)
+    local = ShuffleExchangeExec(
+        HashPartitioning([col("k")], 8), src)
+    local_parts = [pd.concat([b.to_pandas() for b in it],
+                             ignore_index=True)
+                   for it in local.execute_partitions()]
+
+    ShuffleExchangeExec._MESH_EXCHANGES_RUN = 0
+    with active_mesh(mesh8):
+        meshed = ShuffleExchangeExec(
+            HashPartitioning([col("k")], 8), _source(
+                np.random.default_rng(42)))
+        mesh_parts = [pd.concat([b.to_pandas() for b in it],
+                                ignore_index=True)
+                      for it in meshed.execute_partitions()]
+    assert ShuffleExchangeExec._MESH_EXCHANGES_RUN == 1
+    assert len(local_parts) == len(mesh_parts) == 8
+    for p, (lp, mp) in enumerate(zip(local_parts, mesh_parts)):
+        compare_frames(lp, mp, f"part{p}")
+
+
+def test_mesh_lane_declines_without_mesh(rng):
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], 8),
+                             _source(rng))
+    assert ex._mesh_routable() is None
+
+
+def test_mesh_lane_declines_on_partition_mismatch(mesh8, rng):
+    with active_mesh(mesh8):
+        ex = ShuffleExchangeExec(HashPartitioning([col("k")], 4),
+                                 _source(rng))
+        assert ex._mesh_routable() is None
+
+
+def test_mesh_lane_conf_off(mesh8, rng):
+    conf = C.RapidsConf({"spark.rapids.shuffle.meshExchange.enabled":
+                         False})
+    with C.session(conf), active_mesh(mesh8):
+        ex = ShuffleExchangeExec(HashPartitioning([col("k")], 8),
+                                 _source(rng))
+        assert ex._mesh_routable() is None
+
+
+@pytest.fixture(scope="module")
+def tpch_tables():
+    from spark_rapids_tpu.models.tpch_data import gen_tables
+    return gen_tables(np.random.default_rng(7), 3000)
+
+
+@pytest.mark.parametrize("query", [3, 5])
+def test_tpch_mesh_exchange_parity(tpch_tables, mesh8, query):
+    """End-to-end: q3/q5 planned via accelerate() with an active mesh
+    executes its hash exchanges over the 8-device mesh with parity vs
+    the CPU golden engine (the VERDICT r1 #2 done-criterion)."""
+    from spark_rapids_tpu.models.tpch_bench import run_query
+    expected = run_query(query, tpch_tables, engine="cpu")
+    ShuffleExchangeExec._MESH_EXCHANGES_RUN = 0
+    with active_mesh(mesh8):
+        got = run_query(query, tpch_tables, engine="tpu")
+    assert ShuffleExchangeExec._MESH_EXCHANGES_RUN > 0, \
+        "no exchange actually took the mesh collective lane"
+    compare_frames(expected, got, f"q{query}-mesh")

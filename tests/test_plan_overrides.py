@@ -372,3 +372,53 @@ def test_session_conf_reaches_plan_and_runtime():
     df = collect(plan)
     assert len(df) == 100
     assert getattr(plan, "_session_conf", None) is c
+
+
+# -- sort-merge join replacement (reference GpuSortMergeJoinExec.scala:28) --
+def _smj_plan(n_parts=2):
+    from spark_rapids_tpu.plan import CpuSortMergeJoin
+    left = CpuSort([asc(col("a"))],
+                   CpuSource.from_pandas(_df(), num_partitions=n_parts),
+                   global_sort=False)
+    right = CpuSort([asc(col("k"))],
+                    CpuSource.from_pandas(pd.DataFrame({
+                        "k": np.array([0, 1, 2, 9, 9], np.int64),
+                        "v": ["x", "y", "z", "w", "q"]}),
+                        num_partitions=n_parts),
+                    global_sort=False)
+    return CpuSortMergeJoin(JoinType.INNER, [col("a")], [col("k")],
+                            left, right)
+
+
+def test_sort_merge_join_replaced_with_hash_join():
+    tpu = compare(_smj_plan(), sort_by=["a", "v"])
+    names = _tpu_names(tpu)
+    assert "HashJoinExec" in names
+    # the SMJ input sorts are redundant for a hash join and are stripped
+    assert "SortExec" not in names
+
+
+def test_sort_merge_join_keeps_unrelated_sort():
+    """A sort whose keys are NOT covered by the join keys survives the
+    replacement (it wasn't inserted for the SMJ)."""
+    from spark_rapids_tpu.plan import CpuSortMergeJoin
+    left = CpuSort([asc(col("b"))],
+                   CpuSource.from_pandas(_df(), num_partitions=2),
+                   global_sort=False)
+    right = CpuSource.from_pandas(pd.DataFrame({
+        "k": np.array([0, 1, 2], np.int64),
+        "v": ["x", "y", "z"]}), num_partitions=2)
+    plan = CpuSortMergeJoin(JoinType.INNER, [col("a")], [col("k")],
+                            left, right)
+    tpu = compare(plan, sort_by=["a", "v"])
+    assert "SortExec" in _tpu_names(tpu)
+
+
+def test_sort_merge_join_conf_off_falls_back():
+    c = conf(spark__rapids__sql__replaceSortMergeJoin__enabled=False)
+    plan = _smj_plan()
+    expected = plan.collect()
+    got = collect(accelerate(plan, c))
+    ExecutionPlanCapture.assert_did_fall_back("CpuSortMergeJoin")
+    from parity import compare_frames
+    compare_frames(expected, got, "smj-conf-off")
