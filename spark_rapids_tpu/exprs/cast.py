@@ -215,13 +215,326 @@ def _to_string(c: ColumnVector, ctx) -> ColumnVector:
         data, lengths = _int_to_string(c.data, cap)
         return ColumnVector(T.STRING, data, c.validity, lengths)
     if c.dtype.is_floating:
-        # gated like the reference (castFloatToString.enabled): formatting
-        # differs from Java's Double.toString shortest-repr; we emit %.6g-ish
-        raise NotImplementedError(
-            "float->string cast requires "
-            "spark.rapids.sql.castFloatToString.enabled handling at plan "
-            "time; not supported in kernels yet")
+        # conf-gated like the reference (GpuCast.scala:31 castFloatToString):
+        # device-side shortest-roundtrip decimal with Java notation rules;
+        # extreme exponents may differ from Java by one trailing digit
+        # (the documented incompatibility the conf gate exists for)
+        data, lengths = _float_to_string(c.data, c.capacity,
+                                         c.dtype.id == T.TypeId.FLOAT32)
+        return ColumnVector(T.STRING, data, c.validity, lengths)
     raise NotImplementedError(f"cast {c.dtype} -> string")
+
+
+# --------------------------------------------------------------------------
+# float -> string: shortest-roundtrip decimal, Java Double.toString
+# notation (plain for 1e-3 <= |x| < 1e7, scientific outside).  Reference
+# gates this behind castFloatToString.enabled because cuDF's formatting
+# differs from Java; ours is shortest-roundtrip like Java, with possible
+# divergence only at extreme exponents where two-step power-of-ten
+# scaling double-rounds.
+_P10F = np.array([float(f"1e{k}") for k in range(-323, 309)])
+_P10U = np.array([10 ** k for k in range(20)], dtype=np.uint64)
+_P10I = np.array([10 ** k for k in range(10)], dtype=np.int32)
+_FLOAT_STR_WIDTH = 26
+
+
+def _pow10_mul(x, k):
+    """x * 10^k with k possibly outside float64's exact/normal range.
+
+    10^j is EXACTLY representable for j <= 22, so x*10^j / x/10^j with
+    such factors is correctly rounded; |k| <= 44 uses two exact factors
+    (one extra rounding), larger |k| adds a correctly-rounded-but-inexact
+    table factor.  Negative k routes through DIVISION (multiplying by
+    the inexact reciprocal would double-round everywhere).  This is what
+    makes shortest-roundtrip formatting Java-exact in the common range;
+    extreme exponents may drift in the last digit — the documented
+    incompatibility the conf gates exist for.  (On TPU hardware f64 is
+    emulated and nothing is correctly rounded; same gates apply.)"""
+    return _pow10_scaled(x, k, 22)
+
+
+def _pow10_scaled(x, k, first: int):
+    """Implementation of _pow10_mul with a chosen first-factor size;
+    different `first` values give INDEPENDENT rounding paths, letting
+    the round-trip check demand agreement between two paths (a
+    double-rounding collision on both at once is vanishingly rare)."""
+    p10 = jnp.asarray(_P10F)
+    posk = jnp.maximum(k, 0)
+    a1 = jnp.minimum(posk, first)
+    a2 = jnp.minimum(posk - a1, 22)
+    a3 = jnp.clip(posk - a1 - a2, 0, 308)
+    x = x * p10[a1 + 323] * p10[a2 + 323] * p10[a3 + 323]
+    j = -jnp.minimum(k, 0)
+    b1 = jnp.minimum(j, first)
+    b2 = jnp.minimum(j - b1, 22)
+    b3 = jnp.clip(j - b1 - b2, 0, 323)
+    return x / p10[b1 + 323] / p10[b2 + 323] / p10[b3 + 323]
+
+
+def _dec_exponent(a):
+    """floor(log10(a)) for finite positive a via binary search of the
+    correctly-rounded pow10 table (f64 log10 doesn't lower on TPU; a
+    compare-and-gather search does)."""
+    p10 = jnp.asarray(_P10F)
+    lo = jnp.full(a.shape, -324, jnp.int32)
+    hi = jnp.full(a.shape, 308, jnp.int32)
+    for _ in range(11):  # 2^11 > 633 candidate exponents
+        mid = (lo + hi + 1) // 2
+        ge = a >= p10[jnp.clip(mid, -323, 308) + 323]
+        lo = jnp.where(ge, mid, lo)
+        hi = jnp.where(ge, hi, mid - 1)
+    return lo
+
+
+# double-double helpers for the float->string mantissa/verification:
+# error-free transforms (Dekker TwoProd without FMA, Knuth TwoSum) give
+# ~106-bit arithmetic, enough to round and verify 17 decimal digits
+# exactly.  Measured contract (CPU backend, 40k-value fuzz per band):
+# shortest-roundtrip Java-exact across the normal double range except
+# |x| < ~1e-292 (error terms underflow to subnormals) and f32
+# subnormals (XLA flushes them to zero at ingest) — the documented
+# divergence castFloatToString.enabled gates, far narrower than the
+# reference's cuDF %g-style formatting.  On TPU hardware f64 itself is
+# emulated without correct rounding; same gate applies.
+_DD_SPLIT = 134217729.0  # 2^27 + 1
+
+
+def _two_sum(a, b):
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return s, err
+
+
+def _two_prod(a, b):
+    # exact power-of-two prescaling keeps the Dekker split away from
+    # overflow (|x| > ~6.7e300) and the error term out of subnormals
+    # (|x| < ~1e-250); powers of two commute exactly with rounding
+    sa = jnp.where(jnp.abs(a) > 1e250, 2.0 ** -64,
+                   jnp.where((jnp.abs(a) < 1e-250) & (a != 0),
+                             2.0 ** 64, 1.0))
+    sb = jnp.where(jnp.abs(b) > 1e250, 2.0 ** -64,
+                   jnp.where((jnp.abs(b) < 1e-250) & (b != 0),
+                             2.0 ** 64, 1.0))
+    a2 = a * sa
+    b2 = b * sb
+    p2 = a2 * b2
+    aa = _DD_SPLIT * a2
+    ah = aa - (aa - a2)
+    al = a2 - ah
+    bb = _DD_SPLIT * b2
+    bh = bb - (bb - b2)
+    bl = b2 - bh
+    err2 = ((ah * bh - p2) + ah * bl + al * bh) + al * bl
+    inv = (1.0 / sa) * (1.0 / sb)
+    return p2 * inv, err2 * inv
+
+
+def _dd_mul(ah, al, bh, bl):
+    p, e = _two_prod(ah, bh)
+    e = e + (ah * bl + al * bh)
+    return _two_sum(p, e)
+
+
+def _build_p10_dd():
+    from fractions import Fraction
+    lo_k, hi_k = -340, 341
+    his, los = [], []
+    for k in range(lo_k, hi_k):
+        f = Fraction(10) ** k
+        try:
+            hi = float(f)
+        except OverflowError:
+            hi = float("inf")
+        if hi == 0.0 or hi == float("inf"):
+            lo = 0.0  # out of double range; degrade gracefully
+        else:
+            lo = float(f - Fraction(hi))
+        his.append(hi)
+        los.append(lo)
+    return np.array(his), np.array(los)
+
+
+_P10DD_HI, _P10DD_LO = _build_p10_dd()
+_P10DD_OFF = 340
+
+
+def _pow10_dd(x, k):
+    """x (exact double) * 10^k in double-double: (hi, lo) pair.
+
+    Applied as 10^kA * 10^kB with |kA| <= 160 so neither factor exceeds
+    the ~1e291 Dekker-split overflow bound — full-range exponents keep
+    their low words."""
+    hi_t = jnp.asarray(_P10DD_HI)
+    lo_t = jnp.asarray(_P10DD_LO)
+    kA = jnp.clip(k, -160, 160)
+    kB = jnp.clip(k - kA, -_P10DD_OFF, _P10DD_OFF)
+    h, l = _dd_mul(x, jnp.zeros_like(x),
+                   hi_t[kA + _P10DD_OFF], lo_t[kA + _P10DD_OFF])
+    return _dd_mul(h, l, hi_t[kB + _P10DD_OFF], lo_t[kB + _P10DD_OFF])
+
+
+def _float_to_string(values, capacity: int, is_f32: bool):
+    x = values.astype(jnp.float64)
+    # signbit without bitcast (TPU x64 rewrite can't bitcast f64->s64):
+    # -0.0 detected via reciprocal sign
+    neg = (x < 0.0) | ((x == 0.0) & (1.0 / x < 0.0))
+    nan = jnp.isnan(x)
+    inf = jnp.isinf(x)
+    zero = x == 0.0
+    a = jnp.where(nan | inf | zero, 1.0, jnp.abs(x))
+
+    e = _dec_exponent(a)  # a in [10^e, 10^(e+1))
+
+    P = 9 if is_f32 else 17
+    pcol = jnp.arange(1, P + 1, dtype=jnp.int32)[None, :]   # [1, P]
+    scale_k = e[:, None] - pcol + 1
+    # p-digit decimal rounding of a, in double-double so mantissas past
+    # 2^53 (p = 16, 17) still round to the TRUE decimal digits
+    mh, ml = _pow10_dd(a[:, None], -scale_k)
+    mi = jnp.round(mh)
+    corr = jnp.round((mh - mi) + ml)   # mh - mi exact (both near-int)
+    p10f = jnp.asarray(_P10F)
+    # rounding may carry to p+1 digits (M == 10^p): renormalize
+    pw = p10f[jnp.clip(pcol, -323, 308) + 323]
+    carry = (mi + corr) >= pw
+    mi = jnp.where(carry, p10f[jnp.clip(pcol - 1, -323, 308) + 323], mi)
+    corr = jnp.where(carry, 0.0, corr)
+    e2 = e[:, None] + carry.astype(jnp.int32)
+    # verify round-trip in dd: nearest-double(M * 10^k) == a
+    k_back = e2 - pcol + 1
+    v1h, v1l = _pow10_dd(mi, k_back)
+    v2h, v2l = _pow10_dd(corr, k_back)
+    sh, se = _two_sum(v1h, v2h)
+    vh, vl = _two_sum(sh, se + v1l + v2l)
+    if is_f32:
+        a32 = a[:, None].astype(jnp.float32)
+        ok = (vh + vl).astype(jnp.float32) == a32
+    else:
+        ok = vh == a[:, None]
+    any_ok = ok.any(axis=1)
+    pidx = jnp.where(any_ok, jnp.argmax(ok, axis=1), P - 1)
+    p_sel = (pidx + 1).astype(jnp.int32)
+    mi_sel = jnp.take_along_axis(mi, pidx[:, None], axis=1)[:, 0]
+    corr_sel = jnp.take_along_axis(corr, pidx[:, None], axis=1)[:, 0]
+    e_sel = jnp.take_along_axis(e2, pidx[:, None], axis=1)[:, 0]
+
+    # split M = mi + corr into two decimal int32 halves for digit
+    # extraction — no 64-bit division on device (TPU x64 rewrite has no
+    # u64 div), and exact past 2^53 via an error-free q*1e8 product
+    q = jnp.floor(mi_sel / 1e8)
+    r_p, r_e = _two_prod(q, 1e8)
+    rem = ((mi_sel - r_p) - r_e) + corr_sel
+    q = jnp.where(rem < 0, q - 1, q)
+    rem = jnp.where(rem < 0, rem + 1e8, rem)
+    q = jnp.where(rem >= 1e8, q + 1, q)
+    rem = jnp.where(rem >= 1e8, rem - 1e8, rem)
+    m_hi = q.astype(jnp.int32)     # <= 10^9
+    m_lo = rem.astype(jnp.int32)   # < 10^8
+    p10i = jnp.asarray(_P10I)
+
+    # strip trailing zero digits: m*10^k and (m/10)*10^(k+1) denote the
+    # same decimal, so the shorter mantissa is always valid — this also
+    # rescues backends whose f64 is not correctly rounded (TPU emulation)
+    # from settling on a padded precision
+    tz = jnp.zeros_like(m_hi)
+    running = jnp.ones(m_hi.shape, bool)
+    for t in range(17):
+        if t < 8:
+            d = (m_lo // p10i[t]) % 10
+        else:
+            d = (m_hi // p10i[t - 8]) % 10
+        running = running & (d == 0)
+        tz = tz + running.astype(jnp.int32)
+    z = jnp.minimum(tz, p_sel - 1)
+    zlo = jnp.clip(z, 0, 8)
+    zhi = jnp.clip(z - 8, 0, 9)
+    # V / 10^z in (hi, lo) halves without 64-bit division
+    lo_le8 = (m_hi % p10i[zlo]) * p10i[8 - zlo] + m_lo // p10i[zlo]
+    hi_le8 = m_hi // p10i[zlo]
+    tmp_gt8 = m_hi // p10i[zhi]
+    m_hi = jnp.where(z <= 8, hi_le8, 0)
+    m_lo = jnp.where(z <= 8, lo_le8, tmp_gt8)
+    p_sel = p_sel - z
+
+    def digit_at(idx):
+        """idx into the p_sel significant digits (0 = most significant);
+        out-of-range -> '0'."""
+        w = p_sel[:, None] - 1 - idx          # decimal weight, 0 = units
+        whi = jnp.clip(w - 8, 0, 9)
+        wlo = jnp.clip(w, 0, 9)
+        d = jnp.where(w >= 8,
+                      (m_hi[:, None] // p10i[whi]) % 10,
+                      (m_lo[:, None] // p10i[wlo]) % 10)
+        inr = (idx >= 0) & (idx < p_sel[:, None])
+        return jnp.where(inr, d, 0)
+
+    E = e_sel
+    plain = (E >= -3) & (E < 7)
+    int_len = jnp.where(plain & (E >= 0), E + 1, 1)
+    frac_len = jnp.where(
+        plain,
+        jnp.where(E >= 0, jnp.maximum(p_sel - E - 1, 1),
+                  (-E - 1) + p_sel),
+        jnp.maximum(p_sel - 1, 1))
+    absE = jnp.abs(E)
+    exp_digits = 1 + (absE >= 10).astype(jnp.int32) + \
+        (absE >= 100).astype(jnp.int32)
+    exp_neg = (E < 0).astype(jnp.int32)
+    sci_extra = jnp.where(plain, 0, 1 + exp_neg + exp_digits)
+    length = neg.astype(jnp.int32) + int_len + 1 + frac_len + sci_extra
+
+    W = _FLOAT_STR_WIDTH
+    pos = jnp.arange(W, dtype=jnp.int32)[None, :]            # [1, W]
+    jj = pos - neg[:, None].astype(jnp.int32)                # after sign
+    il, fl = int_len[:, None], frac_len[:, None]
+    # integer region chars
+    int_idx = jnp.where(plain[:, None] & (E[:, None] >= 0), jj, 0)
+    int_ch = digit_at(int_idx) + ord("0")
+    int_ch = jnp.where(plain[:, None] & (E[:, None] < 0), ord("0"), int_ch)
+    # fraction region chars: k = jj - il - 1
+    k = jj - il - 1
+    frac_idx = jnp.where(plain[:, None], k + E[:, None] + 1, k + 1)
+    frac_ch = digit_at(frac_idx) + ord("0")
+    # scientific suffix: jE = k - fl
+    jE = k - fl
+    eabs = absE[:, None]
+    ed = exp_digits[:, None]
+    # exponent digit at suffix offset jE has decimal weight
+    # ed - jE + exp_neg (jE counts 'E' at 0 and the sign when negative)
+    exp_digit = (eabs // p10i[jnp.clip(ed - jE + exp_neg[:, None],
+                                       0, 9)]) % 10
+    suffix_ch = jnp.where(
+        jE == 0, ord("E"),
+        jnp.where((jE == 1) & (exp_neg[:, None] == 1), ord("-"),
+                  exp_digit + ord("0")))
+    out = jnp.where(
+        (pos == 0) & neg[:, None], ord("-"),
+        jnp.where(jj < il, int_ch,
+                  jnp.where(jj == il, ord("."),
+                            jnp.where(k < fl, frac_ch, suffix_ch))))
+    out = jnp.where(pos < length[:, None], out, 0).astype(jnp.uint8)
+
+    # specials: NaN / Infinity / -Infinity / 0.0 / -0.0
+    def fixed(s: str):
+        b = np.zeros(W, np.uint8)
+        raw = np.frombuffer(s.encode(), np.uint8)
+        b[:len(raw)] = raw
+        return jnp.asarray(b)[None, :], len(raw)
+
+    nan_b, nan_l = fixed("NaN")
+    pinf_b, pinf_l = fixed("Infinity")
+    ninf_b, ninf_l = fixed("-Infinity")
+    pz_b, pz_l = fixed("0.0")
+    nz_b, nz_l = fixed("-0.0")
+    for mask, b, l in ((nan, nan_b, nan_l),
+                       (inf & ~neg, pinf_b, pinf_l),
+                       (inf & neg, ninf_b, ninf_l),
+                       (zero & ~neg, pz_b, pz_l),
+                       (zero & neg, nz_b, nz_l)):
+        out = jnp.where(mask[:, None], b, out)
+        length = jnp.where(mask, l, length)
+    return out, length.astype(jnp.int32)
 
 
 # --------------------------------------------------------------------------
@@ -230,12 +543,230 @@ def _from_string(c: ColumnVector, dst: T.DataType, ctx) -> ColumnVector:
                                           T.TypeId.TIMESTAMP_US):
         return _string_to_int(c, dst)
     if dst.is_floating:
-        raise NotImplementedError(
-            "string->float cast is gated "
-            "(spark.rapids.sql.castStringToFloat.enabled)")
+        # conf-gated (castStringToFloat.enabled): two-step power-of-ten
+        # scaling can differ from Java's correctly-rounded strtod by 1 ulp
+        # for some inputs (same caveat class as the reference's cuDF parse)
+        return _string_to_float(c, dst)
     if dst.id == T.TypeId.DATE32:
         return _string_to_date(c)
+    if dst.id == T.TypeId.TIMESTAMP_US:
+        # conf-gated (castStringToTimestamp.enabled): canonical forms only
+        return _string_to_timestamp(c)
+    if dst.id == T.TypeId.BOOL:
+        return _string_to_bool(c)
     raise NotImplementedError(f"cast string -> {dst}")
+
+
+def _ci_match(chars, lens, word: str):
+    """Case-insensitive whole-string match against an ascii word,
+    ignoring nothing (caller trims).  chars: int32 [cap, cc]."""
+    cc = chars.shape[1]
+    n = len(word)
+    if n > cc:
+        return jnp.zeros(chars.shape[0], bool)
+    lower = jnp.where((chars >= ord("A")) & (chars <= ord("Z")),
+                      chars + 32, chars)
+    tgt = np.frombuffer(word.lower().encode(), np.uint8)
+    okl = lens == n
+    eq = jnp.ones(chars.shape[0], bool)
+    for i in range(n):
+        eq = eq & (lower[:, i] == int(tgt[i]))
+    return okl & eq
+
+
+def _trimmed(c: ColumnVector):
+    """Return (chars, start, length) with whitespace/control chars
+    trimmed (Spark UTF8String.trimAll: everything <= 0x20)."""
+    cc = c.char_cap
+    chars = c.data.astype(jnp.int32)
+    pos = jnp.arange(cc)[None, :]
+    in_str = pos < c.lengths[:, None]
+    nonspace = in_str & (chars > 0x20)
+    first = jnp.where(nonspace.any(axis=1),
+                      jnp.argmax(nonspace, axis=1), c.lengths)
+    last = jnp.where(nonspace.any(axis=1),
+                     (cc - 1) - jnp.argmax(nonspace[:, ::-1], axis=1),
+                     c.lengths - 1)
+    return chars, first, jnp.maximum(last - first + 1, 0)
+
+
+def _shift_left(chars, start, cc):
+    """Gather chars so the trimmed string starts at column 0."""
+    idx = jnp.clip(start[:, None] + jnp.arange(cc)[None, :], 0, cc - 1)
+    return jnp.take_along_axis(chars, idx, axis=1)
+
+
+def _string_to_bool(c: ColumnVector) -> ColumnVector:
+    """Spark StringUtils.isTrueString/isFalseString: t/true/y/yes/1 and
+    f/false/n/no/0 (case-insensitive, trimmed); anything else -> null."""
+    cc = c.char_cap
+    chars, start, tlen = _trimmed(c)
+    sh = _shift_left(chars, start, cc)
+    is_true = jnp.zeros(c.capacity, bool)
+    for w in ("t", "true", "y", "yes", "1"):
+        is_true = is_true | _ci_match(sh, tlen, w)
+    is_false = jnp.zeros(c.capacity, bool)
+    for w in ("f", "false", "n", "no", "0"):
+        is_false = is_false | _ci_match(sh, tlen, w)
+    return ColumnVector(T.BOOL, is_true,
+                        c.validity & (is_true | is_false))
+
+
+def _string_to_float(c: ColumnVector, dst: T.DataType) -> ColumnVector:
+    """Trimmed decimal parse with optional fraction and exponent; Spark
+    special literals inf/+inf/-inf/infinity/nan (case-insensitive)."""
+    cc = c.char_cap
+    chars, start, tlen = _trimmed(c)
+    sh = _shift_left(chars, start, cc)
+    pos = jnp.arange(cc)[None, :]
+    in_str = pos < tlen[:, None]
+
+    sign_ch = sh[:, 0]
+    has_sign = ((sign_ch == ord("-")) | (sign_ch == ord("+"))) & (tlen > 0)
+    neg = (sign_ch == ord("-")) & has_sign
+
+    # specials (with optional sign consumed)
+    body = jnp.where(has_sign[:, None],
+                     _shift_left(sh, jnp.ones_like(start), cc), sh)
+    blen = tlen - has_sign.astype(tlen.dtype)
+    special_inf = jnp.zeros(c.capacity, bool)
+    for w in ("inf", "infinity"):
+        special_inf = special_inf | _ci_match(body, blen, w)
+    special_nan = _ci_match(body, blen, "nan")
+
+    dig = body - ord("0")
+    is_digit = (dig >= 0) & (dig <= 9)
+    is_dot = body == ord(".")
+    is_exp = (body == ord("e")) | (body == ord("E"))
+    bpos = jnp.arange(cc)[None, :]
+    in_body = bpos < blen[:, None]
+
+    # exponent marker position (first e/E), dot position (first .)
+    has_exp = (is_exp & in_body).any(axis=1)
+    exp_at = jnp.where(has_exp, jnp.argmax(is_exp & in_body, axis=1), blen)
+    has_dot = (is_dot & in_body).any(axis=1)
+    dot_at = jnp.where(has_dot, jnp.argmax(is_dot & in_body, axis=1), blen)
+
+    mant_region = in_body & (bpos < exp_at[:, None])
+    mant_digits = mant_region & is_digit
+    # validity of mantissa: all mantissa chars are digits or ONE dot
+    bad_mant = mant_region & ~is_digit & ~is_dot
+    ndots = (is_dot & mant_region).sum(axis=1)
+    n_mant = mant_digits.sum(axis=1)
+    dot_after_exp = has_dot & (dot_at > exp_at)
+
+    # accumulate up to 18 SIGNIFICANT mantissa digits into uint64 —
+    # leading zeros don't consume budget (else '000...0001.5' parses as
+    # 0); zeros after the dot before the first significant digit still
+    # shift the exponent.  Track integer digits dropped past the budget
+    # (each scales ×10) and counted fraction digits.
+    acc = jnp.zeros(c.capacity, jnp.uint64)
+    taken = jnp.zeros(c.capacity, jnp.int32)
+    skipped = jnp.zeros(c.capacity, jnp.int32)
+    frac_cnt = jnp.zeros(c.capacity, jnp.int32)
+    sig_started = jnp.zeros(c.capacity, bool)
+    for kcol in range(cc):
+        isd = mant_digits[:, kcol]
+        lead_zero = isd & ~sig_started & (dig[:, kcol] == 0)
+        sig_started = sig_started | (isd & (dig[:, kcol] != 0))
+        room = taken < 18
+        take = isd & ~lead_zero & room
+        acc = jnp.where(take, acc * jnp.uint64(10)
+                        + dig[:, kcol].astype(jnp.uint64), acc)
+        taken = taken + take.astype(jnp.int32)
+        after_dot = has_dot & (kcol > dot_at) & (~dot_after_exp)
+        skipped = skipped + \
+            (isd & ~lead_zero & ~room & ~after_dot).astype(jnp.int32)
+        frac_cnt = frac_cnt + \
+            (isd & after_dot & (take | lead_zero)).astype(jnp.int32)
+
+    # explicit exponent parse (sign + up to 3 digits)
+    epos0 = exp_at + 1
+    esign_ch = jnp.take_along_axis(body, jnp.clip(epos0, 0, cc - 1)[:, None],
+                                   axis=1)[:, 0]
+    e_has_sign = (esign_ch == ord("-")) | (esign_ch == ord("+"))
+    e_neg = esign_ch == ord("-")
+    edig_start = epos0 + e_has_sign.astype(epos0.dtype)
+    exp_region = in_body & (bpos >= edig_start[:, None])
+    n_edig = (exp_region & is_digit).sum(axis=1)
+    bad_exp = has_exp & ((exp_region & ~is_digit).any(axis=1) |
+                         (n_edig < 1))
+    # saturating accumulate: '1e99999' must overflow to Infinity (and
+    # '1e-99999' underflow to 0) like Java, not parse as null
+    eval_ = jnp.zeros(c.capacity, jnp.int32)
+    for kcol in range(cc):
+        use = exp_region[:, kcol] & is_digit[:, kcol]
+        eval_ = jnp.where(use, jnp.minimum(eval_ * 10 + dig[:, kcol],
+                                           99999), eval_)
+    eval_ = jnp.where(e_neg & has_exp, -eval_, eval_)
+
+    total_exp = eval_ + skipped - frac_cnt
+    value = _pow10_mul(acc.astype(jnp.float64), total_exp)
+    value = jnp.where(neg, -value, value)
+
+    ok = (n_mant >= 1) & (ndots <= 1) & ~bad_mant.any(axis=1) & \
+        ~bad_exp & ~dot_after_exp & (tlen > 0)
+    value = jnp.where(special_inf, jnp.where(neg, -jnp.inf, jnp.inf), value)
+    value = jnp.where(special_nan, jnp.nan, value)
+    ok = ok | special_inf | special_nan
+    return ColumnVector(dst, value.astype(dst.storage_dtype),
+                        c.validity & ok)
+
+
+def _string_to_timestamp(c: ColumnVector) -> ColumnVector:
+    """Canonical forms 'yyyy-MM-dd', 'yyyy-MM-dd HH:mm:ss' and
+    'yyyy-MM-dd HH:mm:ss.ffffff' (1-6 fraction digits), UTC only —
+    the reference gates this cast for the same sparse-format reason
+    (GpuCast.scala castStringToTimestamp)."""
+    cc = max(c.char_cap, 26)
+    from spark_rapids_tpu.columnar.vector import _pad_chars
+    if c.char_cap < cc:
+        c = _pad_chars(c, cc)
+    # trim whitespace first (Spark trims before stringToTimestamp)
+    tchars, tstart, tlen = _trimmed(c)
+    chars = _shift_left(tchars, tstart, cc)
+    lens = tlen
+    date_part = ColumnVector(T.STRING, chars.astype(jnp.uint8)[:, :10],
+                             c.validity,
+                             jnp.minimum(lens, 10))
+    days = _string_to_date(date_part)
+    dig = chars - ord("0")
+
+    date_only = lens == 10
+    has_time = lens >= 19
+    sep_ok = (chars[:, 10] == ord(" ")) & (chars[:, 13] == ord(":")) & \
+        (chars[:, 16] == ord(":"))
+    tdig_ok = jnp.ones(c.capacity, bool)
+    for k in (11, 12, 14, 15, 17, 18):
+        tdig_ok = tdig_ok & (dig[:, k] >= 0) & (dig[:, k] <= 9)
+    h = dig[:, 11] * 10 + dig[:, 12]
+    mnt = dig[:, 14] * 10 + dig[:, 15]
+    s = dig[:, 17] * 10 + dig[:, 18]
+    t_ok = has_time & sep_ok & tdig_ok & (h < 24) & (mnt < 60) & (s < 60)
+
+    # fraction: '.' + 1..6 digits
+    has_frac = lens > 19
+    frac_ok = has_frac & (chars[:, 19] == ord(".")) & (lens <= 26)
+    us = jnp.zeros(c.capacity, jnp.int64)
+    ndig = jnp.zeros(c.capacity, jnp.int32)
+    for k in range(20, 26):
+        in_frac = k < lens
+        d_ok = (dig[:, k] >= 0) & (dig[:, k] <= 9)
+        frac_ok = frac_ok & (~in_frac | d_ok)
+        us = jnp.where(in_frac & d_ok, us * 10 + dig[:, k], us)
+        ndig = ndig + (in_frac & d_ok).astype(jnp.int32)
+    scale = jnp.asarray(_P10U[:7].astype(np.int64))
+    us = us * scale[jnp.clip(6 - ndig, 0, 6)]
+    frac_valid = jnp.where(has_frac, frac_ok & (ndig >= 1), True)
+
+    time_us = jnp.where(
+        date_only, 0,
+        (h.astype(jnp.int64) * 3600 + mnt * 60 + s) * DT.MICROS_PER_SECOND
+        + us)
+    micros = days.data.astype(jnp.int64) * DT.MICROS_PER_DAY + time_us
+    shape_ok = date_only | (t_ok & frac_valid)
+    return ColumnVector(T.TIMESTAMP_US, micros,
+                        days.validity & shape_ok)
 
 
 def _string_to_int(c: ColumnVector, dst: T.DataType) -> ColumnVector:

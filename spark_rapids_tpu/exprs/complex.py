@@ -1,0 +1,202 @@
+"""Complex-type extractors and inline constructors (reference
+`complexTypeExtractors.scala:88` GetArrayItem/GetMapValue, plus Spark's
+`complexTypeCreator` CreateArray/CreateMap).
+
+The v0 type matrix has no stored array/map columns — the same limit the
+reference has (SURVEY.md §2.6).  What the reference accelerates is the
+*extractor over an inline construction*: `split(s, d)[i]`,
+`array(a, b, c)[i]`, `map('k1', v1, 'k2', v2)[k]`.  On TPU these fuse
+into pure select/kernel shapes with no list column ever materialized —
+the static-shape answer to cuDF's list columns:
+
+  - GetArrayItem(StringSplit(...))   -> fused split-part kernel
+  - GetArrayItem(CreateArray(...))   -> per-row select over N evaluated
+                                        element columns
+  - GetMapValue(CreateMap(...))      -> first-key-match select
+
+Bare CreateArray/CreateMap/StringSplit (an actual array value reaching
+the output) are tagged off the TPU at plan time.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.vector import ColumnVector, _pad_chars
+from spark_rapids_tpu.exprs.base import EvalContext, Expression, promote
+
+
+@dataclasses.dataclass(eq=False)
+class CreateArray(Expression):
+    """array(e1, ..., eN): only evaluable through GetArrayItem."""
+    elements: tuple
+
+    def __init__(self, elements):
+        self.elements = tuple(elements)
+
+    def element_type(self, schema) -> T.DataType:
+        dt = self.elements[0].data_type(schema)
+        for e in self.elements[1:]:
+            d2 = e.data_type(schema)
+            if d2 != dt:
+                dt = T.common_type(dt, d2)
+        return dt
+
+    def data_type(self, schema):
+        return self.element_type(schema)
+
+    def children(self):
+        return self.elements
+
+    def with_children(self, kids):
+        return CreateArray(tuple(kids))
+
+    def eval(self, ctx):
+        raise TypeError("CreateArray must be consumed by GetArrayItem "
+                        "(no array columns in the v0 type matrix)")
+
+
+@dataclasses.dataclass(eq=False)
+class CreateMap(Expression):
+    """map(k1, v1, ..., kN, vN): only evaluable through GetMapValue."""
+    entries: tuple  # flat (k1, v1, k2, v2, ...)
+
+    def __init__(self, entries):
+        assert len(entries) % 2 == 0 and entries, "map needs k/v pairs"
+        self.entries = tuple(entries)
+
+    def value_type(self, schema) -> T.DataType:
+        vals = self.entries[1::2]
+        dt = vals[0].data_type(schema)
+        for e in vals[1:]:
+            d2 = e.data_type(schema)
+            if d2 != dt:
+                dt = T.common_type(dt, d2)
+        return dt
+
+    def data_type(self, schema):
+        return self.value_type(schema)
+
+    def children(self):
+        return self.entries
+
+    def with_children(self, kids):
+        return CreateMap(tuple(kids))
+
+    def eval(self, ctx):
+        raise TypeError("CreateMap must be consumed by GetMapValue "
+                        "(no map columns in the v0 type matrix)")
+
+
+def _select_columns(masks, cols, dtype, cap):
+    """First-true-mask select across N evaluated columns (all same
+    promoted dtype).  Strings are selected over padded char tensors."""
+    if dtype.is_string:
+        cc = max(c.char_cap for c in cols)
+        cols = [_pad_chars(c, cc) for c in cols]
+        data = jnp.zeros((cap, cc), jnp.uint8)
+        lengths = jnp.zeros(cap, jnp.int32)
+    else:
+        data = jnp.zeros(cap, dtype.storage_dtype)
+        lengths = None
+    validity = jnp.zeros(cap, bool)
+    taken = jnp.zeros(cap, bool)
+    for m, c in zip(masks, cols):
+        use = m & ~taken
+        if dtype.is_string:
+            data = jnp.where(use[:, None], c.data, data)
+            lengths = jnp.where(use, c.lengths, lengths)
+        else:
+            data = jnp.where(use, c.data, data)
+        validity = jnp.where(use, c.validity, validity)
+        taken = taken | use
+    return data, validity & taken, lengths, taken
+
+
+@dataclasses.dataclass(eq=False)
+class GetArrayItem(Expression):
+    """array[i] (reference complexTypeExtractors.scala:88): out-of-range
+    or null index -> null (non-ANSI)."""
+    child: Expression
+    ordinal: Expression
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def children(self):
+        return (self.child, self.ordinal)
+
+    def with_children(self, kids):
+        return GetArrayItem(kids[0], kids[1])
+
+    def eval(self, ctx: EvalContext) -> ColumnVector:
+        from spark_rapids_tpu.exprs.string_fns import (
+            StringSplit, _split_part)
+        ch = self.child
+        nv = self.ordinal.eval(ctx)
+        n = nv.data.astype(jnp.int32)
+        if isinstance(ch, StringSplit):
+            pat = ch.literal_pattern()
+            limit = ch.literal_limit()
+            sc = ch.child.eval(ctx)
+            out = _split_part(sc, pat.encode(), n, limit)
+            return ColumnVector(T.STRING, out.data,
+                                out.validity & nv.validity, out.lengths)
+        if isinstance(ch, CreateArray):
+            # per-row select element n
+            cols = [e.eval(ctx) for e in ch.elements]
+            dt = cols[0].dtype
+            for c in cols[1:]:
+                if c.dtype != dt:
+                    dt = T.common_type(dt, c.dtype)
+            cols = [c if c.dtype == dt else promote(c, dt) for c in cols]
+            masks = [n == k for k in range(len(cols))]
+            data, validity, lengths, _ = _select_columns(
+                masks, cols, dt, ctx.capacity)
+            return ColumnVector(dt, data, validity & nv.validity, lengths)
+        raise TypeError(
+            f"GetArrayItem over {type(ch).__name__} is not supported "
+            "(no array columns in the v0 type matrix)")
+
+
+@dataclasses.dataclass(eq=False)
+class GetMapValue(Expression):
+    """map[key] (reference complexTypeExtractors.scala GetMapValue):
+    first entry whose key equals the lookup key; no match -> null."""
+    child: Expression
+    key: Expression
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def children(self):
+        return (self.child, self.key)
+
+    def with_children(self, kids):
+        return GetMapValue(kids[0], kids[1])
+
+    def eval(self, ctx: EvalContext) -> ColumnVector:
+        from spark_rapids_tpu.exprs.predicates import _compare
+        ch = self.child
+        if not isinstance(ch, CreateMap):
+            raise TypeError(
+                f"GetMapValue over {type(ch).__name__} is not supported "
+                "(no map columns in the v0 type matrix)")
+        keyv = self.key.eval(ctx)
+        keys = [e.eval(ctx) for e in ch.entries[0::2]]
+        vals = [e.eval(ctx) for e in ch.entries[1::2]]
+        dt = vals[0].dtype
+        for c in vals[1:]:
+            if c.dtype != dt:
+                dt = T.common_type(dt, c.dtype)
+        vals = [c if c.dtype == dt else promote(c, dt) for c in vals]
+        masks = []
+        for kc in keys:
+            _, eq = _compare(kc, keyv)
+            masks.append(eq & kc.validity & keyv.validity)
+        data, validity, lengths, _ = _select_columns(
+            masks, vals, dt, ctx.capacity)
+        return ColumnVector(dt, data, validity, lengths)

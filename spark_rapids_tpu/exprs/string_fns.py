@@ -633,3 +633,113 @@ def RegExpReplace(child: Expression, pattern: Expression,
     raise TypeError(
         "RegExpReplace supports only literal patterns without regex "
         "metacharacters (reference GpuOverrides.scala:383-393)")
+
+
+# --------------------------------------------------------------------------
+_REGEX_META = r".^$*+?()[]{}|\\"
+
+
+def _split_part(c: ColumnVector, delim: bytes, n, limit: int
+                ) -> ColumnVector:
+    """Fused split-then-index kernel: part `n` (0-based, possibly per-row)
+    of each string split on a literal delimiter, Java split semantics
+    with limit=-1 (trailing empties kept) or limit>0 (last part takes the
+    unsplit rest).  The TPU shape of cuDF's split column: no list column
+    is ever materialized — the consumer (GetArrayItem) asks for one part
+    and gets a string column."""
+    cap, cc = c.data.shape
+    chars = c.data
+    lens = c.lengths
+    L = len(delim)
+    pos = jnp.arange(cc)[None, :]
+    raw = jnp.ones((cap, cc), bool)
+    padded = jnp.pad(chars, ((0, 0), (0, L)))
+    for t, byte in enumerate(delim):
+        raw = raw & (padded[:, t:t + cc] == byte)
+    raw = raw & ((pos + L) <= lens[:, None])
+    if L == 1:
+        vm = raw  # single-byte delimiters cannot overlap
+    else:
+        next_free = jnp.zeros(cap, jnp.int32)
+        cols = []
+        for j in range(cc):
+            m = raw[:, j] & (j >= next_free)
+            cols.append(m)
+            next_free = jnp.where(m, j + L, next_free)
+        vm = jnp.stack(cols, axis=1)
+    mcum = jnp.cumsum(vm, axis=1)
+    if limit > 0:
+        vm = vm & (mcum <= limit - 1)
+        mcum = jnp.cumsum(vm, axis=1)
+    nmatches = vm.sum(axis=1).astype(jnp.int32)
+    nparts = nmatches + 1
+
+    n = jnp.asarray(n, jnp.int32)
+    if n.ndim == 0:
+        n = jnp.broadcast_to(n, (cap,))
+
+    def match_pos(k):
+        """Position of the k-th (1-based, per-row) valid match."""
+        mask = vm & (mcum == k[:, None])
+        found = mask.any(axis=1)
+        return jnp.where(found, jnp.argmax(mask, axis=1), lens), found
+
+    pk, _ = match_pos(n)
+    start = jnp.where(n == 0, 0, pk + L)
+    pk1, found1 = match_pos(n + 1)
+    end = jnp.where(found1, pk1, lens)
+    exists = (n >= 0) & (n < nparts)
+    out_len = jnp.clip(end - start, 0, cc)
+    idx = jnp.clip(start[:, None] + pos, 0, cc - 1)
+    gathered = jnp.take_along_axis(chars, idx, axis=1)
+    tvalid = pos < out_len[:, None]
+    out = jnp.where(tvalid, gathered, 0).astype(jnp.uint8)
+    return ColumnVector(T.STRING, out, c.validity & exists,
+                        jnp.where(exists, out_len, 0))
+
+
+@dataclasses.dataclass(eq=False)
+class StringSplit(Expression):
+    """split(str, pattern[, limit]) — reference GpuStringSplit
+    (stringFunctions.scala:812).  The pattern must be a regex-free
+    literal (the regexp-as-literal rule, GpuOverrides.scala:343-393).
+    The v0 type matrix has no array columns (same as the reference), so
+    a StringSplit is only evaluable when consumed by GetArrayItem
+    (`split(s, d)[i]`), which fuses split+index into one kernel; bare
+    use is tagged off the TPU at plan time."""
+    child: Expression
+    pattern: Expression
+    limit: Optional[Expression] = None
+
+    def data_type(self, schema):
+        return T.STRING  # element type; the array itself never reifies
+
+    def children(self):
+        return ((self.child, self.pattern, self.limit)
+                if self.limit is not None else (self.child, self.pattern))
+
+    def with_children(self, kids):
+        return StringSplit(kids[0], kids[1],
+                           kids[2] if len(kids) > 2 else None)
+
+    def literal_pattern(self) -> Optional[str]:
+        if not isinstance(self.pattern, Literal) or \
+                self.pattern.value is None:
+            return None
+        p = str(self.pattern.value)
+        if not p or any(ch in p for ch in _REGEX_META):
+            return None
+        return p
+
+    def literal_limit(self) -> Optional[int]:
+        if self.limit is None:
+            return -1
+        if isinstance(self.limit, Literal) and self.limit.value is not None:
+            return int(self.limit.value)
+        return None
+
+    def eval(self, ctx: EvalContext):
+        raise TypeError(
+            "StringSplit must be consumed by GetArrayItem (split(s,d)[i]) "
+            "— no array columns in the v0 type matrix; the planner tags "
+            "bare use for CPU fallback")

@@ -8,10 +8,11 @@ key-value footer metadata), and the write-side EXCEPTION check in
 
 Spark 2.x / legacy Hive wrote dates and timestamps in the hybrid
 Julian+Gregorian calendar; Spark 3.x uses the proleptic Gregorian
-calendar.  Values at or after the Gregorian cutover (1582-10-15 for
-dates, 1900-01-01T00:00:00Z for timestamps — timezone-dependent Julian
-drift persists until 1900 for timestamps) mean the same instant in both
-calendars, so only values BEFORE the cutover are ambiguous.  Like the
+calendar.  Values at or after the Gregorian cutover (1582-10-15; in
+non-UTC zones timestamp drift persists until 1900, but this engine is
+UTC-only so the timestamp cutover is 1582-10-15 too) mean the same
+instant in both calendars, so only values BEFORE the cutover are
+ambiguous.  Like the
 reference we never rebase on the accelerator: files/values that would
 need it either raise the Spark 3.0 upgrade error (EXCEPTION / LEGACY
 read modes) or are read verbatim (CORRECTED).
@@ -25,9 +26,13 @@ import numpy as np
 # Days since unix epoch of 1582-10-15, the first proleptic-Gregorian day
 # shared by both calendars (RebaseDateTime.lastSwitchJulianDay).
 CUTOVER_DAY = -141427
-# Micros since epoch of 1900-01-01T00:00:00Z: timestamps written by
-# legacy writers before this are ambiguous (RebaseDateTime switch ts).
-CUTOVER_MICROS = -2208988800000000
+# Timestamp ambiguity cutover for a UTC session: UTC has no pre-1900
+# timezone-offset drift, so the switch instant is exactly the date
+# cutover (RebaseDateTime.lastSwitchJulianTs for UTC).  The engine is
+# UTC-only (same as the reference, GpuOverrides.scala:397-409); Spark's
+# 1900-01-01 wording in the upgrade-error text covers non-UTC zones and
+# stays in the messages only.
+CUTOVER_MICROS = CUTOVER_DAY * 86400000000
 
 # Spark's parquet footer key-value metadata keys
 # (GpuParquetScan.scala:195-197).

@@ -264,33 +264,164 @@ def _coalesce(e, df, schema):
 
 
 # -- cast -------------------------------------------------------------------
+def _java_float_str(x, f32: bool = False) -> str:
+    """Java Double/Float.toString notation: shortest-roundtrip digits,
+    plain decimal for 1e-3 <= |x| < 1e7, scientific 'd.dddEexp'
+    outside.  For FLOAT32 sources the shortest repr is computed in
+    float32 (Java Float.toString), not the widened double."""
+    import math
+    from decimal import Decimal
+    if f32:
+        x = float(np.float32(x))
+    if math.isnan(x):
+        return "NaN"
+    if math.isinf(x):
+        return "Infinity" if x > 0 else "-Infinity"
+    neg = "-" if math.copysign(1.0, x) < 0 else ""
+    if x == 0.0:
+        return neg + "0.0"
+    d = Decimal(str(np.float32(abs(x))) if f32 else repr(abs(x)))
+    _, digits, _ = d.as_tuple()
+    adj = d.adjusted()
+    ds = "".join(map(str, digits)).rstrip("0") or "0"
+    if -3 <= adj < 7:
+        if adj >= 0:
+            ip = ds[:adj + 1].ljust(adj + 1, "0")
+            fp = ds[adj + 1:] or "0"
+        else:
+            ip = "0"
+            fp = "0" * (-adj - 1) + ds
+        return f"{neg}{ip}.{fp}"
+    return f"{neg}{ds[0]}.{ds[1:] or '0'}E{adj}"
+
+
+_INT_CAST_BOUNDS = {
+    T.TypeId.INT8: (-2 ** 7, 2 ** 7 - 1),
+    T.TypeId.INT16: (-2 ** 15, 2 ** 15 - 1),
+    T.TypeId.INT32: (-2 ** 31, 2 ** 31 - 1),
+    T.TypeId.INT64: (-2 ** 63, 2 ** 63 - 1),
+}
+
+_TRUE_STRINGS = {"t", "true", "y", "yes", "1"}
+_FALSE_STRINGS = {"f", "false", "n", "no", "0"}
+
+
+def _spark_parse_string(x, dt):
+    """Spark UTF8String-style parses for cast-from-string (trimmed;
+    invalid -> null)."""
+    import datetime as _dt
+    import re
+    s = str(x).strip()
+    if dt.id == T.TypeId.BOOL:
+        low = s.lower()
+        if low in _TRUE_STRINGS:
+            return True
+        if low in _FALSE_STRINGS:
+            return False
+        return None
+    if dt.is_floating:
+        if not s or "_" in s:
+            return None
+        try:
+            return float(s)
+        except ValueError:
+            return None
+    if dt.id == T.TypeId.DATE32:
+        m = re.fullmatch(r"(\d{4})-(\d{2})-(\d{2})", s)
+        if not m:
+            return None
+        try:
+            d = _dt.date(*map(int, m.groups()))
+        except ValueError:
+            return None
+        return (d - _dt.date(1970, 1, 1)).days
+    if dt.id == T.TypeId.TIMESTAMP_US:
+        m = re.fullmatch(
+            r"(\d{4})-(\d{2})-(\d{2})"
+            r"(?: (\d{2}):(\d{2}):(\d{2})(?:\.(\d{1,6}))?)?", s)
+        if not m:
+            return None
+        y, mo, dd, h, mi, sec, frac = m.groups()
+        try:
+            d = _dt.date(int(y), int(mo), int(dd))
+        except ValueError:
+            return None
+        days = (d - _dt.date(1970, 1, 1)).days
+        h, mi, sec = int(h or 0), int(mi or 0), int(sec or 0)
+        if h > 23 or mi > 59 or sec > 59:
+            return None
+        us = int((frac or "0").ljust(6, "0"))
+        return (days * 86400 + h * 3600 + mi * 60 + sec) * 1000000 + us
+    if dt.is_integral:
+        # strict integral parse (Spark UTF8String.toInt/toLong — dotted
+        # strings like '1.5' are NULL, not truncated)
+        m = re.fullmatch(r"[+-]?\d+", s)
+        if not m:
+            return None
+        val = int(s)
+        lo, hi = _INT_CAST_BOUNDS.get(dt.id, _INT_CAST_BOUNDS[T.TypeId.INT64])
+        return val if lo <= val <= hi else None
+    return None
+
+
 def _cast(e, df, schema):
+    import datetime as _dt
     v = _ev(e.child, df, schema)
     dt = e.to
+    src_dt = e.child.data_type(schema)
     if dt.is_string:
-        res = v.astype(object).map(
+        if src_dt.is_floating:
+            f32 = src_dt.id == T.TypeId.FLOAT32
+            return v.astype(object).map(
+                lambda x: None if x is None or x is pd.NA
+                else _java_float_str(x, f32))
+        if src_dt.id == T.TypeId.DATE32:
+            epoch = _dt.date(1970, 1, 1)
+            return v.astype(object).map(
+                lambda x: None if x is None or x is pd.NA else
+                (epoch + _dt.timedelta(days=int(x))).isoformat())
+        if src_dt.id == T.TypeId.TIMESTAMP_US:
+            def ts_str(x):
+                if x is None or x is pd.NA:
+                    return None
+                micros = int(x)
+                days, rem = divmod(micros, 86400 * 1000000)
+                secs, us = divmod(rem, 1000000)
+                h, rs = divmod(secs, 3600)
+                mi, s = divmod(rs, 60)
+                base = (_dt.date(1970, 1, 1) +
+                        _dt.timedelta(days=days)).isoformat()
+                out = f"{base} {h:02d}:{mi:02d}:{s:02d}"
+                if us:
+                    out += ("." + f"{us:06d}").rstrip("0")
+                return out
+            return v.astype(object).map(ts_str)
+        return v.astype(object).map(
             lambda x: None if x is None or x is pd.NA else
             (str(x).lower() if isinstance(x, (bool, np.bool_)) else str(x)))
-        return res
-    src_dt = e.child.data_type(schema)
     if src_dt.is_string:
-        def parse(x):
-            if x is None or x is pd.NA:
-                return None
-            try:
-                if dt.is_floating:
-                    return float(x)
-                return int(float(x)) if "." in str(x) else int(x)
-            except ValueError:
-                return None
-        return v.map(parse).astype(nullable_dtype(dt))
+        return v.map(
+            lambda x: None if x is None or x is pd.NA else
+            _spark_parse_string(x, dt)).astype(nullable_dtype(dt))
     if dt.id == T.TypeId.BOOL:
         return v.map(lambda x: None if x is pd.NA else bool(x)).astype(
             "boolean")
     if src_dt.is_floating and dt.is_integral:
-        # Spark truncates toward zero
-        return v.map(lambda x: None if x is pd.NA else int(x)).astype(
-            nullable_dtype(dt))
+        # Spark: truncate toward zero, NaN -> 0, saturate at type bounds
+        lo, hi = _INT_CAST_BOUNDS.get(dt.id, _INT_CAST_BOUNDS[T.TypeId.INT64])
+
+        def f2i(x):
+            if x is pd.NA or x is None:
+                return None
+            x = float(x)
+            if x != x:
+                return 0
+            if x >= hi:
+                return hi
+            if x <= lo:
+                return lo
+            return int(x)
+        return v.map(f2i).astype(nullable_dtype(dt))
     return v.astype(nullable_dtype(dt))
 
 
@@ -486,6 +617,91 @@ def _python_udf(e, df, schema):
 
 
 _DISPATCH["PythonUDF"] = _python_udf
+
+
+def _get_array_item(e, df, schema):
+    """GetArrayItem over inline arrays: split(s,d)[i] via Java split
+    semantics (re.split on the literal pattern), array(...)[i] via
+    per-row select — the CPU golden twin of exprs/complex.py."""
+    import re
+    from spark_rapids_tpu.exprs.complex import CreateArray
+    from spark_rapids_tpu.exprs.string_fns import StringSplit
+    n = _ev(e.ordinal, df, schema)
+    ch = e.child
+    if isinstance(ch, StringSplit):
+        s = _ev(ch.child, df, schema)
+        # Spark's split pattern IS a regex — the CPU golden runs it as
+        # one (the TPU lane only accepts meta-free literals, tagged by
+        # _tag_string_split; here the full semantics apply)
+        from spark_rapids_tpu.exprs.base import Literal as _Lit
+        if not isinstance(ch.pattern, _Lit) or ch.pattern.value is None:
+            raise TypeError("split pattern must be a literal")
+        limit = ch.literal_limit()
+        if limit is None:
+            raise TypeError("split limit must be a literal")
+        rx = re.compile(str(ch.pattern.value))
+
+        def part(x, i):
+            if pd.isna(x) or pd.isna(i):
+                return None
+            # Java semantics: limit<=0 keeps all splits (limit 0 would
+            # also drop trailing empties — Spark passes -1, kept here)
+            parts = rx.split(str(x), maxsplit=0 if limit <= 0 else limit - 1)
+            if limit == 0:
+                while parts and parts[-1] == "":
+                    parts.pop()
+            i = int(i)
+            return parts[i] if 0 <= i < len(parts) else None
+        return pd.Series([part(x, i) for x, i in zip(s, n)],
+                         index=df.index, dtype=object)
+    if isinstance(ch, CreateArray):
+        cols = [_ev(el, df, schema) for el in ch.elements]
+        dt = ch.element_type(schema)
+
+        def pick(i, row):
+            if pd.isna(i):
+                return None
+            i = int(i)
+            if not (0 <= i < len(cols)):
+                return None
+            v = cols[i].iloc[row]
+            return None if pd.isna(v) else v
+        out = [pick(n.iloc[r], r) for r in range(len(df))]
+        return pd.Series(out, index=df.index, dtype=object).astype(
+            nullable_dtype(dt))
+    raise TypeError(f"GetArrayItem over {type(ch).__name__}")
+
+
+def _get_map_value(e, df, schema):
+    from spark_rapids_tpu.exprs.complex import CreateMap
+    ch = e.child
+    if not isinstance(ch, CreateMap):
+        raise TypeError(f"GetMapValue over {type(ch).__name__}")
+    key = _ev(e.key, df, schema)
+    keys = [_ev(k, df, schema) for k in ch.entries[0::2]]
+    vals = [_ev(v, df, schema) for v in ch.entries[1::2]]
+    dt = ch.value_type(schema)
+
+    def pick(row):
+        kq = key.iloc[row]
+        if pd.isna(kq):
+            return None
+        for kc, vc in zip(keys, vals):
+            kv = kc.iloc[row]
+            if pd.isna(kv):
+                continue
+            if kv == kq:
+                v = vc.iloc[row]
+                return None if pd.isna(v) else v
+        return None
+    out = [pick(r) for r in range(len(df))]
+    return pd.Series(out, index=df.index, dtype=object).astype(
+        nullable_dtype(dt))
+
+
+_DISPATCH["GetArrayItem"] = _get_array_item
+_DISPATCH["GetMapValue"] = _get_map_value
+
 
 
 def cpu_supported(expr: E.Expression) -> bool:

@@ -61,6 +61,37 @@ class ExprMeta(BaseMeta):
         if self.rule.tag_extra is not None:
             self.rule.tag_extra(self)
 
+    def input_schemas(self) -> list:
+        """Candidate schemas this expression's references resolve against:
+        the owning plan node's child schemas (join conditions see both
+        sides combined).  Used by type-sensitive tag rules (CastExprMeta
+        analog) — tagging must never execute anything, so resolution
+        failures are the caller's cue to skip."""
+        m = self
+        while m is not None and isinstance(m, ExprMeta):
+            m = m.parent
+        if m is None or not hasattr(m, "node"):
+            return []
+        node = m.node
+        out = []
+        for c in getattr(node, "children", ()):
+            try:
+                out.append(c.output_schema())
+            except Exception:
+                pass
+        if len(out) > 1:
+            try:
+                out.append(T.Schema(tuple(
+                    f for s in out for f in s.fields)))
+            except Exception:
+                pass
+        if not out:
+            try:
+                out.append(node.output_schema())
+            except Exception:
+                pass
+        return out
+
     @property
     def can_expr_tree_be_replaced(self) -> bool:
         return self.can_this_be_replaced and all(

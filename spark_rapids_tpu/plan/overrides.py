@@ -19,6 +19,7 @@ import logging
 from typing import Callable, Optional, Sequence
 
 from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
 from spark_rapids_tpu.exec import basic as B
 from spark_rapids_tpu.exec.aggregate import AggMode, HashAggregateExec
 from spark_rapids_tpu.exec.base import TpuExec
@@ -101,7 +102,6 @@ GreaterThanOrEqual And Or Not IsNull IsNotNull IsNaN InSet
 BitwiseAnd BitwiseOr BitwiseXor BitwiseNot ShiftLeft ShiftRight
 ShiftRightUnsigned
 If CaseWhen Coalesce NullIf Nvl2 AtLeastNNonNulls NaNvl
-Cast
 Year Month DayOfMonth DayOfWeek DayOfYear Quarter WeekOfYear LastDay
 Hour Minute Second DateAdd DateSub DateDiff AddMonths MonthsBetween
 UnixTimestamp FromUnixTime ToDate TruncDate
@@ -126,6 +126,112 @@ for _name in ("Sin", "Cos", "Tan", "Asin", "Acos", "Atan", "Sinh", "Cosh",
 
 expr("Rand", "per-row uniform random", incompat="TPU RNG stream differs "
      "from JVM XORShiftRandom")
+
+
+def _tag_cast(m) -> None:
+    """Per-direction cast gating (reference CastExprMeta, GpuCast.scala:31):
+    the gated directions exist because device formatting/parsing is not
+    bit-identical to the JVM; everything the kernels cannot do tags the
+    plan for CPU fallback instead of raising at execution time."""
+    e = m.expr
+    if getattr(e, "ansi", False):
+        m.will_not_work_on_tpu("ANSI cast mode is not supported on TPU")
+    src = None
+    for schema in m.input_schemas():
+        try:
+            src = e.child.data_type(schema)
+            break
+        except Exception:
+            continue
+    if src is None:
+        return  # unresolvable child type: leave to downstream tagging
+    dst = e.to
+    if src.is_floating and dst.is_string and \
+            not m.conf[C.CASTS_FLOAT_TO_STRING]:
+        m.will_not_work_on_tpu(
+            "float->string formatting differs from Java at extreme "
+            f"exponents; enable with {C.CASTS_FLOAT_TO_STRING.key}")
+    if src.is_string and dst.is_floating and \
+            not m.conf[C.CASTS_STRING_TO_FLOAT]:
+        m.will_not_work_on_tpu(
+            "string->float parse may differ by 1 ulp from Java; enable "
+            f"with {C.CASTS_STRING_TO_FLOAT.key}")
+    if src.is_string and dst.id == T.TypeId.TIMESTAMP_US and \
+            not m.conf[C.CASTS_STRING_TO_TS]:
+        m.will_not_work_on_tpu(
+            "string->timestamp supports canonical forms only; enable "
+            f"with {C.CASTS_STRING_TO_TS.key}")
+
+
+expr("Cast", "TPU implementation of Cast", tag_extra=_tag_cast)
+
+
+def _tag_string_split(m) -> None:
+    """StringSplit is evaluable only as split(s,d)[i] with a literal,
+    regex-free pattern and limit != 0 (reference GpuStringSplit +
+    regexp-as-literal rule, stringFunctions.scala:812)."""
+    e = m.expr
+    parent = m.parent
+    from spark_rapids_tpu.exprs.complex import GetArrayItem
+    if not (hasattr(parent, "expr") and
+            isinstance(parent.expr, GetArrayItem)):
+        m.will_not_work_on_tpu(
+            "split() result must be indexed (split(s,d)[i]); array "
+            "columns are outside the v0 type matrix")
+    if e.literal_pattern() is None:
+        m.will_not_work_on_tpu(
+            "split pattern must be a literal without regex "
+            "metacharacters")
+    if e.literal_limit() in (None, 0):
+        m.will_not_work_on_tpu(
+            "split limit must be a literal -1 or positive")
+
+
+def _tag_inline_only(consumer_name, consumers):
+    def tag(m):
+        parent = m.parent
+        if not (hasattr(parent, "expr") and
+                isinstance(parent.expr, consumers)):
+            m.will_not_work_on_tpu(
+                f"{type(m.expr).__name__} must be consumed by "
+                f"{consumer_name}; array/map columns are outside the v0 "
+                "type matrix")
+    return tag
+
+
+def _tag_get_array_item(m) -> None:
+    from spark_rapids_tpu.exprs.complex import CreateArray
+    from spark_rapids_tpu.exprs.string_fns import StringSplit
+    if not isinstance(m.expr.child, (CreateArray, StringSplit)):
+        m.will_not_work_on_tpu(
+            "GetArrayItem supports inline arrays (split()/array()) only")
+
+
+def _tag_get_map_value(m) -> None:
+    from spark_rapids_tpu.exprs.complex import CreateMap
+    if not isinstance(m.expr.child, CreateMap):
+        m.will_not_work_on_tpu(
+            "GetMapValue supports inline map(...) only")
+
+
+def _register_complex_rules():
+    from spark_rapids_tpu.exprs.complex import (
+        CreateArray, CreateMap, GetArrayItem, GetMapValue)
+    from spark_rapids_tpu.exprs.string_fns import StringSplit
+    expr("StringSplit", "split into parts, consumed by [] "
+         "(fused split-part kernel)", tag_extra=_tag_string_split)
+    expr("GetArrayItem", "index an inline array",
+         tag_extra=_tag_get_array_item)
+    expr("GetMapValue", "look up an inline map",
+         tag_extra=_tag_get_map_value)
+    expr("CreateArray", "inline array constructor",
+         tag_extra=_tag_inline_only("GetArrayItem or explode",
+                                    (GetArrayItem,)))
+    expr("CreateMap", "inline map constructor",
+         tag_extra=_tag_inline_only("GetMapValue", (GetMapValue,)))
+
+
+_register_complex_rules()
 
 
 expr("Average", "TPU average")

@@ -6,12 +6,16 @@ import pandas as pd
 
 
 def norm_frame(df: pd.DataFrame) -> pd.DataFrame:
-    """Row-set normalization: stringify object columns and sort by every
-    column so tie-order inside equal sort keys cannot fail a diff."""
+    """Row-set normalization: stringify object columns (mapping every
+    null flavor — None/pd.NA/NaN — to one None so engines that differ
+    only in null representation compare equal) and sort by every column
+    so tie-order inside equal sort keys cannot fail a diff."""
     out = df.copy()
     for c in out.columns:
         if out[c].dtype == object:
-            out[c] = out[c].astype(str)
+            out[c] = out[c].map(
+                lambda v: None if v is None or v is pd.NA or
+                (isinstance(v, float) and v != v) else str(v))
     return out.sort_values(list(out.columns), ignore_index=True)
 
 

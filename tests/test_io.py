@@ -506,3 +506,17 @@ def test_parquet_rebase_default_is_shim_versioned(tmp_path):
     c300 = conf(**{"spark.rapids.tpu.sparkVersion": "3.0.0"})
     df = collect(accelerate(tio.read_parquet(str(tmp_path)), c300))
     assert int(df["d"].iloc[0]) == stored  # verbatim, no raise
+
+
+def test_exception_mode_accepts_1582_to_1900_timestamps():
+    """ADVICE r1 (medium): UTC sessions have no Julian drift after
+    1582-10-15, so an 1850 timestamp must read/write cleanly under the
+    default EXCEPTION mode — only pre-1582-10-15 values are ambiguous."""
+    import pyarrow as pa
+    from spark_rapids_tpu.io import rebase as RB
+    micros_1850 = -3786825600000000  # 1850-01-01T00:00:00Z
+    tbl = pa.table({"t": pa.array([micros_1850], pa.timestamp("us"))})
+    assert not RB.arrow_table_needs_rebase(tbl)
+    micros_1500 = -14830986000000000  # ~1500 CE, pre-cutover
+    tbl2 = pa.table({"t": pa.array([micros_1500], pa.timestamp("us"))})
+    assert RB.arrow_table_needs_rebase(tbl2)
