@@ -1618,3 +1618,786 @@ QUERIES = {
     "q92": q92_shape, "q93": q93_shape, "q96": q96, "q97": q97,
     "q98": q98_shape, "q99": q99_shape,
 }
+
+
+# ---------------------------------------------------------------------------
+# round-2 growth toward the reference's 103 (TpcdsLikeSpark.scala:709+):
+# year-over-year ratio family (q4/q11/q74), ROLLUP grouping-sets through
+# CpuExpand (q5/q22/q86), channel unions (q56/q76), windowed deviation
+# reports (q53/q57/q89), returns chains (q17/q24/q29/q49/q78/q81/q83/q85),
+# inventory (q39/q72), existence/self-join shapes (q14/q35/q95).
+from spark_rapids_tpu import types as _T
+from spark_rapids_tpu.exprs.base import Literal as _Lit
+from spark_rapids_tpu.plan.nodes import CpuExpand as _CpuExpand
+
+
+def _rollup_expand(child, keys, passthrough):
+    """Spark ROLLUP(keys...) lowering: CpuExpand with one projection per
+    key prefix plus the grand total, carrying a grouping id — the exact
+    shape Spark's planner feeds ExpandExec (reference GpuExpandExec)."""
+    cs = child.output_schema()
+    n = len(keys)
+    projs = []
+    for level in range(n, -1, -1):
+        proj = [col(k) if i < level else _Lit(None, cs.field(k).dtype)
+                for i, k in enumerate(keys)]
+        proj.append(_Lit((1 << (n - level)) - 1, _T.INT32))
+        proj.extend(col(p) for p in passthrough)
+        projs.append(proj)
+    names = list(keys) + ["gid"] + list(passthrough)
+    return _CpuExpand(projs, names, child)
+
+
+def _yoy_growth(t, sales, date_key, cust_key, val, year1=1999):
+    """Per-customer totals for two consecutive years, joined: the
+    q4/q11/q74 year-over-year scaffold."""
+    def year_total(y, alias):
+        dd = CpuFilter(col("d_year") == lit(y), t["date_dim"])
+        j = _join(_join(dd, t[sales], ["d_date_sk"], [date_key]),
+                  t["customer"], [cust_key], ["c_customer_sk"])
+        return CpuAggregate([col("c_customer_id")],
+                            [Sum(col(val)).alias(alias)], j)
+    y1 = year_total(year1, "total1")
+    y2 = CpuProject([col("c_customer_id").alias("cid2"),
+                     col("total2")],
+                    year_total(year1 + 1, "total2"))
+    j = _join(CpuFilter(col("total1") > lit(0.0), y1), y2,
+              ["c_customer_id"], ["cid2"])
+    return CpuProject([col("c_customer_id"),
+                       (col("total2") / col("total1")).alias("growth")], j)
+
+
+def q4_shape(t, run):
+    """Customers whose catalog growth beats their store growth
+    (reference q4's 3-channel year-over-year self-joins, 2 channels in
+    the v0 shape)."""
+    ss = _yoy_growth(t, "store_sales", "ss_sold_date_sk",
+                     "ss_customer_sk", "ss_net_paid")
+    cs = CpuProject([col("c_customer_id").alias("ccid"),
+                     col("growth").alias("c_growth")],
+                    _yoy_growth(t, "catalog_sales", "cs_sold_date_sk",
+                                "cs_bill_customer_sk", "cs_net_paid"))
+    j = _join(ss, cs, ["c_customer_id"], ["ccid"])
+    keep = CpuFilter(col("c_growth") > col("growth"), j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_customer_id"))],
+        CpuProject([col("c_customer_id")], keep)))
+
+
+def q11_shape(t, run):
+    """Web growth beats store growth (reference q11)."""
+    ss = _yoy_growth(t, "store_sales", "ss_sold_date_sk",
+                     "ss_customer_sk", "ss_ext_list_price")
+    ws = CpuProject([col("c_customer_id").alias("wcid"),
+                     col("growth").alias("w_growth")],
+                    _yoy_growth(t, "web_sales", "ws_sold_date_sk",
+                                "ws_bill_customer_sk",
+                                "ws_ext_list_price"))
+    j = _join(ss, ws, ["c_customer_id"], ["wcid"])
+    keep = CpuFilter(col("w_growth") > col("growth"), j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_customer_id"))],
+        CpuProject([col("c_customer_id")], keep)))
+
+
+def q74_shape(t, run):
+    """q11's sibling over net_paid sums (reference q74)."""
+    ss = _yoy_growth(t, "store_sales", "ss_sold_date_sk",
+                     "ss_customer_sk", "ss_net_paid", year1=2000)
+    ws = CpuProject([col("c_customer_id").alias("wcid"),
+                     col("growth").alias("w_growth")],
+                    _yoy_growth(t, "web_sales", "ws_sold_date_sk",
+                                "ws_bill_customer_sk", "ws_net_paid",
+                                year1=2000))
+    j = _join(ss, ws, ["c_customer_id"], ["wcid"])
+    keep = CpuFilter(col("w_growth") > col("growth"), j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_customer_id"))],
+        CpuProject([col("c_customer_id")], keep)))
+
+
+def q5_shape(t, run):
+    """Per-channel sales/returns/profit report with ROLLUP(channel, id)
+    through CpuExpand (reference q5)."""
+    def channel(label, sales, skey, sval, sprofit, rets, rkey, rval):
+        s = CpuProject([lit(label).alias("channel"),
+                        col(skey).alias("id"),
+                        col(sval).alias("sales"),
+                        lit(0.0).alias("returns_amt"),
+                        col(sprofit).alias("profit")], t[sales])
+        r = CpuProject([lit(label).alias("channel"),
+                        col(rkey).alias("id"),
+                        lit(0.0).alias("sales"),
+                        col(rval).alias("returns_amt"),
+                        lit(0.0).alias("profit")], t[rets])
+        return CpuUnion(s, r)
+
+    u = CpuUnion(
+        channel("store channel", "store_sales", "ss_store_sk",
+                "ss_ext_sales_price", "ss_net_profit",
+                "store_returns", "sr_store_sk", "sr_return_amt"),
+        channel("catalog channel", "catalog_sales", "cs_item_sk",
+                "cs_ext_sales_price", "cs_net_profit",
+                "catalog_returns", "cr_item_sk", "cr_return_amount"),
+        channel("web channel", "web_sales", "ws_web_site_sk",
+                "ws_ext_sales_price", "ws_net_profit",
+                "web_returns", "wr_item_sk", "wr_return_amt"))
+    ex = _rollup_expand(u, ["channel", "id"],
+                        ["sales", "returns_amt", "profit"])
+    agg = CpuAggregate(
+        [col("channel"), col("id"), col("gid")],
+        [Sum(col("sales")).alias("sales"),
+         Sum(col("returns_amt")).alias("returns_amt"),
+         Sum(col("profit")).alias("profit")], ex)
+    return CpuLimit(100, CpuSort(
+        [asc(col("channel")), asc(col("id")), asc(col("gid"))], agg))
+
+
+def q22_rollup(t, run):
+    """Inventory average quantity on hand, ROLLUP(category, brand) — a
+    true grouping-sets plan through CpuExpand (reference q22)."""
+    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
+    j = _join(_join(dd, t["inventory"], ["d_date_sk"], ["inv_date_sk"]),
+              t["item"], ["inv_item_sk"], ["i_item_sk"])
+    ex = _rollup_expand(j, ["i_category", "i_brand"],
+                        ["inv_quantity_on_hand"])
+    agg = CpuAggregate(
+        [col("i_category"), col("i_brand"), col("gid")],
+        [Average(col("inv_quantity_on_hand")).alias("qoh")], ex)
+    return CpuLimit(100, CpuSort(
+        [asc(col("qoh")), asc(col("i_category")), asc(col("i_brand")),
+         asc(col("gid"))], agg))
+
+
+def q86_rollup(t, run):
+    """Web revenue ROLLUP(category, brand) report (reference q86 uses
+    category/class; the v0 item schema carries brand)."""
+    dd = CpuFilter(col("d_year") == lit(2001), t["date_dim"])
+    j = _join(_join(dd, t["web_sales"], ["d_date_sk"],
+                    ["ws_sold_date_sk"]),
+              t["item"], ["ws_item_sk"], ["i_item_sk"])
+    ex = _rollup_expand(j, ["i_category", "i_brand"], ["ws_net_paid"])
+    agg = CpuAggregate(
+        [col("i_category"), col("i_brand"), col("gid")],
+        [Sum(col("ws_net_paid")).alias("total_sum")], ex)
+    return CpuLimit(100, CpuSort(
+        [desc(col("total_sum")), asc(col("i_category")),
+         asc(col("i_brand")), asc(col("gid"))], agg))
+
+
+def q9_shape(t, run):
+    """Quantity-range bucket statistics as one reduction over
+    store_sales (reference q9's CASE WHEN scalar subqueries)."""
+    ss = t["store_sales"]
+    aggs = []
+    for i, (lo, hi) in enumerate(((1, 10), (11, 20), (21, 30),
+                                  (31, 40), (41, 50))):
+        inb = (col("ss_quantity") >= lit(lo)) & \
+            (col("ss_quantity") <= lit(hi))
+        aggs.append(Sum(If(inb, lit(1), lit(0))).alias(f"cnt_{i}"))
+        aggs.append(Sum(If(inb, col("ss_ext_discount_amt"),
+                           lit(0.0))).alias(f"disc_{i}"))
+    return CpuAggregate([], aggs, ss)
+
+
+def _cat_ratio(t, sales, date_key, item_key, price, year, moy):
+    """q12/q20/q98 scaffold: item revenue + windowed share of its
+    category's revenue."""
+    from spark_rapids_tpu.exec.sort import asc as _asc
+    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
+                                              WindowSpec, WinSum)
+    dd = CpuFilter((col("d_year") == lit(year)) &
+                   (col("d_moy") == lit(moy)), t["date_dim"])
+    it = CpuFilter(InSet(col("i_category"),
+                         ("Books", "Music", "Home")), t["item"])
+    j = _join(_join(dd, t[sales], ["d_date_sk"], [date_key]),
+              it, [item_key], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id"), col("i_category")],
+        [Sum(col(price)).alias("itemrevenue")], j)
+    w = CpuWindow(
+        [WinSum(col("itemrevenue")).alias("cat_rev")],
+        WindowSpec([col("i_category")], [],
+                   WindowFrame(is_rows=True, lower=None, upper=None)),
+        agg)
+    share = CpuProject(
+        [col("i_item_id"), col("i_category"), col("itemrevenue"),
+         (col("itemrevenue") * lit(100.0) / col("cat_rev"))
+         .alias("revenueratio")], w)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_category")), asc(col("i_item_id")),
+         asc(col("revenueratio"))], share))
+
+
+def q12_shape(t, run):
+    return _cat_ratio(t, "web_sales", "ws_sold_date_sk", "ws_item_sk",
+                      "ws_ext_sales_price", 1999, 2)
+
+
+def q20_shape(t, run):
+    return _cat_ratio(t, "catalog_sales", "cs_sold_date_sk",
+                      "cs_item_sk", "cs_ext_sales_price", 2000, 3)
+
+
+def q14_shape(t, run):
+    """Items selling in ALL three channels: chained semi joins, then a
+    brand revenue report (reference q14's cross-channel intersection)."""
+    it = t["item"]
+    in_ss = CpuHashJoin(J.LEFT_SEMI, [col("i_item_sk")],
+                        [col("ss_item_sk")], it, t["store_sales"])
+    in_cs = CpuHashJoin(J.LEFT_SEMI, [col("i_item_sk")],
+                        [col("cs_item_sk")], in_ss, t["catalog_sales"])
+    in_all = CpuHashJoin(J.LEFT_SEMI, [col("i_item_sk")],
+                         [col("ws_item_sk")], in_cs, t["web_sales"])
+    j = _join(in_all, t["store_sales"], ["i_item_sk"], ["ss_item_sk"])
+    agg = CpuAggregate(
+        [col("i_brand_id"), col("i_category_id")],
+        [Sum(col("ss_ext_sales_price")).alias("sales"),
+         Count(col("ss_ext_sales_price")).alias("number_sales")], j)
+    return CpuLimit(100, CpuSort(
+        [desc(col("sales")), asc(col("i_brand_id")),
+         asc(col("i_category_id"))], agg))
+
+
+def q17_shape(t, run):
+    """Store sale -> return -> catalog repurchase chain: per-item
+    quantity statistics (reference q17; stddev reduced to avg/min/max,
+    outside the v0 aggregate set like the reference's own gates)."""
+    from spark_rapids_tpu.exprs.aggregates import Max, Min
+    ssr = CpuHashJoin(
+        J.INNER, [col("ss_ticket_number"), col("ss_item_sk")],
+        [col("sr_ticket_number"), col("sr_item_sk")],
+        t["store_sales"], t["store_returns"])
+    chain = CpuHashJoin(
+        J.INNER, [col("sr_customer_sk"), col("sr_item_sk")],
+        [col("cs_bill_customer_sk"), col("cs_item_sk")],
+        ssr, t["catalog_sales"])
+    j = _join(chain, t["item"], ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id")],
+        [Count(col("ss_quantity")).alias("store_sales_cnt"),
+         Average(col("ss_quantity")).alias("store_sales_avg"),
+         Min(col("sr_return_quantity")).alias("ret_min"),
+         Max(col("cs_quantity")).alias("cat_max")], j)
+    return CpuLimit(100, CpuSort([asc(col("i_item_id"))], agg))
+
+
+def q29_shape(t, run):
+    """q17's quantity-sum sibling (reference q29)."""
+    ssr = CpuHashJoin(
+        J.INNER, [col("ss_ticket_number"), col("ss_item_sk")],
+        [col("sr_ticket_number"), col("sr_item_sk")],
+        t["store_sales"], t["store_returns"])
+    chain = CpuHashJoin(
+        J.INNER, [col("sr_customer_sk"), col("sr_item_sk")],
+        [col("cs_bill_customer_sk"), col("cs_item_sk")],
+        ssr, t["catalog_sales"])
+    j = _join(chain, t["item"], ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id"), col("i_brand")],
+        [Sum(col("ss_quantity")).alias("store_qty"),
+         Sum(col("sr_return_quantity")).alias("return_qty"),
+         Sum(col("cs_quantity")).alias("catalog_qty")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_item_id")), asc(col("i_brand"))], agg))
+
+
+def q24_shape(t, run):
+    """Returned-ticket net paid by customer/store/brand, kept when above
+    5% of the overall average (reference q24's HAVING-over-subquery via
+    an unpartitioned window average)."""
+    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
+                                              WindowSpec, WinAvg)
+    ssr = CpuHashJoin(
+        J.INNER, [col("ss_ticket_number"), col("ss_item_sk")],
+        [col("sr_ticket_number"), col("sr_item_sk")],
+        t["store_sales"], t["store_returns"])
+    j = _join(_join(_join(ssr, t["store"], ["ss_store_sk"],
+                          ["s_store_sk"]),
+                    t["item"], ["ss_item_sk"], ["i_item_sk"]),
+              t["customer"], ["ss_customer_sk"], ["c_customer_sk"])
+    agg = CpuAggregate(
+        [col("c_last_name"), col("s_store_name"), col("i_brand")],
+        [Sum(col("ss_net_paid")).alias("netpaid")], j)
+    w = CpuWindow(
+        [WinAvg(col("netpaid")).alias("avg_netpaid")],
+        WindowSpec([], [], WindowFrame(is_rows=True, lower=None,
+                                       upper=None)), agg)
+    keep = CpuFilter(col("netpaid") > col("avg_netpaid") * lit(0.05), w)
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_last_name")), asc(col("s_store_name")),
+         asc(col("i_brand"))],
+        CpuProject([col("c_last_name"), col("s_store_name"),
+                    col("i_brand"), col("netpaid")], keep)))
+
+
+def q35_shape(t, run):
+    """Customer-demographic profile of store customers who also bought
+    through catalog or web (reference q35's EXISTS shapes as semi
+    joins)."""
+    cust = CpuHashJoin(J.LEFT_SEMI, [col("c_customer_sk")],
+                       [col("ss_customer_sk")], t["customer"],
+                       t["store_sales"])
+    cs_side = CpuProject([col("cs_bill_customer_sk").alias("buyer")],
+                         t["catalog_sales"])
+    ws_side = CpuProject([col("ws_bill_customer_sk").alias("buyer")],
+                         t["web_sales"])
+    cust2 = CpuHashJoin(J.LEFT_SEMI, [col("c_customer_sk")],
+                        [col("buyer")], cust,
+                        CpuUnion(cs_side, ws_side))
+    j = _join(cust2, t["customer_address"], ["c_current_addr_sk"],
+              ["ca_address_sk"])
+    agg = CpuAggregate(
+        [col("ca_state")],
+        [Count(col("c_customer_sk")).alias("cnt")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("ca_state"))], agg))
+
+
+def q39_shape(t, run):
+    """Inventory monthly mean by warehouse/item, self-joined on the next
+    month (reference q39's consecutive-month covariance pairs; variance
+    reduced to avg like the reference's own gating of unsupported
+    aggs)."""
+    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
+    j = _join(_join(dd, t["inventory"], ["d_date_sk"], ["inv_date_sk"]),
+              t["warehouse"], ["inv_warehouse_sk"], ["w_warehouse_sk"])
+    monthly = CpuAggregate(
+        [col("w_warehouse_sk"), col("inv_item_sk"), col("d_moy")],
+        [Average(col("inv_quantity_on_hand")).alias("qoh")], j)
+    m1 = CpuProject([col("w_warehouse_sk"), col("inv_item_sk"),
+                     (col("d_moy") + lit(1)).alias("next_moy"),
+                     col("qoh").alias("qoh1")], monthly)
+    m2 = CpuProject([col("w_warehouse_sk").alias("w2"),
+                     col("inv_item_sk").alias("i2"),
+                     col("d_moy").alias("moy2"),
+                     col("qoh").alias("qoh2")], monthly)
+    pair = CpuHashJoin(
+        J.INNER, [col("w_warehouse_sk"), col("inv_item_sk"),
+                  col("next_moy")],
+        [col("w2"), col("i2"), col("moy2")], m1, m2)
+    return CpuLimit(100, CpuSort(
+        [asc(col("w_warehouse_sk")), asc(col("inv_item_sk")),
+         asc(col("next_moy"))],
+        CpuProject([col("w_warehouse_sk"), col("inv_item_sk"),
+                    col("next_moy"), col("qoh1"), col("qoh2")], pair)))
+
+
+def q49_shape(t, run):
+    """Per-channel return ratios with a rank window, worst offenders
+    first (reference q49's three ranked channel blocks)."""
+    from spark_rapids_tpu.exec.sort import desc as _desc
+    from spark_rapids_tpu.exec.window import (CpuWindow, Rank,
+                                              WindowSpec)
+
+    def channel(label, sales, skey_o, skey_i, qty, rets, rkey_o,
+                rkey_i, rqty):
+        j = CpuHashJoin(
+            J.INNER, [col(skey_o), col(skey_i)],
+            [col(rkey_o), col(rkey_i)], t[sales], t[rets])
+        agg = CpuAggregate(
+            [col(skey_i)],
+            [Sum(col(rqty)).alias("ret"), Sum(col(qty)).alias("sold")], j)
+        ratio = CpuProject(
+            [lit(label).alias("channel"), col(skey_i).alias("item"),
+             (col("ret") / col("sold")).alias("return_ratio")],
+            CpuFilter(col("sold") > lit(0), agg))
+        ranked = CpuWindow(
+            [Rank().alias("return_rank")],
+            WindowSpec([], [_desc(col("return_ratio"))]), ratio)
+        return CpuFilter(col("return_rank") <= lit(10), ranked)
+
+    u = CpuUnion(
+        channel("web", "web_sales", "ws_order_number", "ws_item_sk",
+                "ws_quantity", "web_returns", "wr_order_number",
+                "wr_item_sk", "wr_return_quantity"),
+        channel("catalog", "catalog_sales", "cs_order_number",
+                "cs_item_sk", "cs_quantity", "catalog_returns",
+                "cr_order_number", "cr_item_sk", "cr_return_quantity"),
+        channel("store", "store_sales", "ss_ticket_number",
+                "ss_item_sk", "ss_quantity", "store_returns",
+                "sr_ticket_number", "sr_item_sk", "sr_return_quantity"))
+    return CpuLimit(100, CpuSort(
+        [asc(col("channel")), asc(col("return_rank")),
+         asc(col("item"))], u))
+
+
+def q53_shape(t, run):
+    """Manufacturer quarterly revenue vs its own average (reference
+    q53/q63 family; q63 already covers the monthly variant)."""
+    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
+                                              WindowSpec, WinAvg)
+    j = _join(_join(CpuFilter(col("d_year") == lit(2001),
+                              t["date_dim"]),
+                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_manufact_id"), col("d_qoy")],
+        [Sum(col("ss_sales_price")).alias("sum_sales")], j)
+    w = CpuWindow(
+        [WinAvg(col("sum_sales")).alias("avg_quarterly")],
+        WindowSpec([col("i_manufact_id")], [],
+                   WindowFrame(is_rows=True, lower=None, upper=None)),
+        agg)
+    from spark_rapids_tpu.exprs.arithmetic import Abs as _Abs
+    keep = CpuFilter(
+        (col("avg_quarterly") > lit(0.0)) &
+        (_Abs(col("sum_sales") - col("avg_quarterly")) /
+         col("avg_quarterly") > lit(0.1)), w)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_manufact_id")), asc(col("d_qoy"))],
+        CpuProject([col("i_manufact_id"), col("d_qoy"),
+                    col("sum_sales"), col("avg_quarterly")], keep)))
+
+
+def _cast_i64(e):
+    from spark_rapids_tpu.exprs.cast import Cast
+    return Cast(e, _T.INT64)
+
+
+def q54_shape(t, run):
+    """Revenue buckets of customers who bought a target category through
+    catalog or web (reference q54's cohort + bucketed histogram)."""
+    it = CpuFilter(col("i_category") == lit("Books"), t["item"])
+    cs_b = CpuProject([col("cs_bill_customer_sk").alias("buyer")],
+                      _join(it, t["catalog_sales"], ["i_item_sk"],
+                            ["cs_item_sk"]))
+    ws_b = CpuProject([col("ws_bill_customer_sk").alias("buyer")],
+                      _join(it, t["web_sales"], ["i_item_sk"],
+                            ["ws_item_sk"]))
+    cohort = CpuHashJoin(J.LEFT_SEMI, [col("c_customer_sk")],
+                         [col("buyer")], t["customer"],
+                         CpuUnion(cs_b, ws_b))
+    rev = CpuAggregate(
+        [col("c_customer_sk")],
+        [Sum(col("ss_ext_sales_price")).alias("revenue")],
+        _join(cohort, t["store_sales"], ["c_customer_sk"],
+              ["ss_customer_sk"]))
+    bucket = CpuProject(
+        [_cast_i64(col("revenue") / lit(50.0)).alias("segment")], rev)
+    agg = CpuAggregate([col("segment")],
+                       [Count(col("segment")).alias("num_customers")],
+                       bucket)
+    return CpuLimit(100, CpuSort(
+        [asc(col("segment")), asc(col("num_customers"))], agg))
+
+
+def q56_shape(t, run):
+    """Per-item revenue across the three channels for address-filtered
+    sales (reference q56, the q33/q60 sibling keyed by item_id)."""
+    dd = CpuFilter((col("d_year") == lit(2001)) &
+                   (col("d_moy") == lit(2)), t["date_dim"])
+    it = CpuFilter(InSet(col("i_category"), ("Home", "Shoes")),
+                   t["item"])
+
+    def channel(sales, date_key, item_key, price):
+        j = _join(_join(dd, t[sales], ["d_date_sk"], [date_key]),
+                  it, [item_key], ["i_item_sk"])
+        return CpuProject(
+            [col("i_item_id"), col(price).alias("total_sales")], j)
+
+    u = CpuUnion(channel("store_sales", "ss_sold_date_sk",
+                         "ss_item_sk", "ss_ext_sales_price"),
+                 channel("catalog_sales", "cs_sold_date_sk",
+                         "cs_item_sk", "cs_ext_sales_price"),
+                 channel("web_sales", "ws_sold_date_sk",
+                         "ws_item_sk", "ws_ext_sales_price"))
+    agg = CpuAggregate([col("i_item_id")],
+                       [Sum(col("total_sales")).alias("total_sales")], u)
+    return CpuLimit(100, CpuSort(
+        [asc(col("total_sales")), asc(col("i_item_id"))], agg))
+
+
+def q57_shape(t, run):
+    """Catalog monthly brand revenue vs neighbors (reference q57 — the
+    catalog sibling of q47's stacked windows)."""
+    from spark_rapids_tpu.exec.sort import asc as _asc
+    from spark_rapids_tpu.exec.window import (CpuWindow, Lag, Lead,
+                                              WindowFrame, WindowSpec,
+                                              WinAvg)
+    j = _join(_join(CpuFilter(col("d_year") == lit(1999),
+                              t["date_dim"]),
+                    t["catalog_sales"], ["d_date_sk"],
+                    ["cs_sold_date_sk"]),
+              t["item"], ["cs_item_sk"], ["i_item_sk"])
+    monthly = CpuAggregate(
+        [col("i_brand"), col("d_moy")],
+        [Sum(col("cs_sales_price")).alias("sum_sales")], j)
+    w = CpuWindow(
+        [Lag(col("sum_sales")).alias("psum"),
+         Lead(col("sum_sales")).alias("nsum")],
+        WindowSpec([col("i_brand")], [_asc(col("d_moy"))]), monthly)
+    wavg = CpuWindow(
+        [WinAvg(col("sum_sales")).alias("avg_monthly")],
+        WindowSpec([col("i_brand")], [],
+                   WindowFrame(is_rows=True, lower=None, upper=None)), w)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_brand")), asc(col("d_moy"))],
+        CpuProject([col("i_brand"), col("d_moy"), col("sum_sales"),
+                    col("psum"), col("nsum"), col("avg_monthly")],
+                   wavg)))
+
+
+def q64_shape(t, run):
+    """Returned store purchases by city and brand (reference q64's
+    cross-sale pairs, reduced to the store arm over the v0 schema)."""
+    ssr = CpuHashJoin(
+        J.INNER, [col("ss_ticket_number"), col("ss_item_sk")],
+        [col("sr_ticket_number"), col("sr_item_sk")],
+        t["store_sales"], t["store_returns"])
+    j = _join(_join(_join(ssr, t["item"], ["ss_item_sk"],
+                          ["i_item_sk"]),
+                    t["customer"], ["ss_customer_sk"],
+                    ["c_customer_sk"]),
+              t["customer_address"], ["c_current_addr_sk"],
+              ["ca_address_sk"])
+    agg = CpuAggregate(
+        [col("ca_city"), col("i_brand")],
+        [Count(col("ss_ticket_number")).alias("cnt"),
+         Sum(col("ss_net_paid")).alias("paid"),
+         Sum(col("sr_return_amt")).alias("returned")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("ca_city")), asc(col("i_brand"))], agg))
+
+
+def q72_shape(t, run):
+    """Catalog orders vs on-hand inventory, promo split (reference q72's
+    inventory shortage join)."""
+    j = CpuHashJoin(J.INNER, [col("cs_item_sk")], [col("inv_item_sk")],
+                    t["catalog_sales"], t["inventory"],
+                    condition=col("inv_quantity_on_hand") <
+                    col("cs_quantity"))
+    p = CpuHashJoin(J.LEFT_OUTER, [col("cs_promo_sk")],
+                    [col("p_promo_sk")], j, t["promotion"])
+    flagged = CpuProject(
+        [col("cs_item_sk"),
+         If(IsNull(col("p_promo_sk")), lit(1), lit(0)).alias("no_promo"),
+         If(IsNotNull(col("p_promo_sk")), lit(1), lit(0)).alias("promo")],
+        p)
+    agg = CpuAggregate(
+        [col("cs_item_sk")],
+        [Sum(col("no_promo")).alias("no_promo"),
+         Sum(col("promo")).alias("promo"),
+         Count(col("cs_item_sk")).alias("total_cnt")], flagged)
+    return CpuLimit(100, CpuSort(
+        [desc(col("total_cnt")), asc(col("cs_item_sk"))], agg))
+
+
+def q76_shape(t, run):
+    """Channel/year/category sales counts over the union of all three
+    channels (reference q76's null-key audit, keyed by channel here)."""
+    def channel(label, sales, date_key, item_key, price):
+        j = _join(_join(t["date_dim"], t[sales], ["d_date_sk"],
+                        [date_key]),
+                  t["item"], [item_key], ["i_item_sk"])
+        return CpuProject(
+            [lit(label).alias("channel"), col("d_year"),
+             col("i_category"), col(price).alias("ext_sales_price")], j)
+
+    u = CpuUnion(
+        channel("store", "store_sales", "ss_sold_date_sk", "ss_item_sk",
+                "ss_ext_sales_price"),
+        channel("web", "web_sales", "ws_sold_date_sk", "ws_item_sk",
+                "ws_ext_sales_price"),
+        channel("catalog", "catalog_sales", "cs_sold_date_sk",
+                "cs_item_sk", "cs_ext_sales_price"))
+    agg = CpuAggregate(
+        [col("channel"), col("d_year"), col("i_category")],
+        [Count(col("ext_sales_price")).alias("sales_cnt"),
+         Sum(col("ext_sales_price")).alias("sales_amt")], u)
+    return CpuLimit(100, CpuSort(
+        [asc(col("channel")), asc(col("d_year")),
+         asc(col("i_category"))], agg))
+
+
+def q78_shape(t, run):
+    """Unreturned web sales per item/year vs store equivalents
+    (reference q78's returns-netting left outer + null filter)."""
+    def unreturned(sales, okey, ikey, dkey, qty, rets, rokey, rikey):
+        jo = CpuHashJoin(
+            J.LEFT_OUTER, [col(okey), col(ikey)],
+            [col(rokey), col(rikey)], t[sales], t[rets])
+        kept = CpuFilter(IsNull(col(rokey)), jo)
+        jd = _join(t["date_dim"], kept, ["d_date_sk"], [dkey])
+        return CpuAggregate(
+            [col("d_year"), col(ikey)],
+            [Sum(col(qty)).alias("qty")], jd)
+
+    ws = unreturned("web_sales", "ws_order_number", "ws_item_sk",
+                    "ws_sold_date_sk", "ws_quantity",
+                    "web_returns", "wr_order_number", "wr_item_sk")
+    ss = CpuProject(
+        [col("d_year").alias("ss_year"),
+         col("ss_item_sk").alias("s_item"),
+         col("qty").alias("ss_qty")],
+        unreturned("store_sales", "ss_ticket_number", "ss_item_sk",
+                   "ss_sold_date_sk", "ss_quantity",
+                   "store_returns", "sr_ticket_number", "sr_item_sk"))
+    j = CpuHashJoin(J.INNER, [col("d_year"), col("ws_item_sk")],
+                    [col("ss_year"), col("s_item")], ws, ss)
+    out = CpuProject(
+        [col("d_year"), col("ws_item_sk"), col("qty"), col("ss_qty"),
+         (col("qty") / col("ss_qty")).alias("ratio")], j)
+    return CpuLimit(100, CpuSort(
+        [desc(col("ratio")), asc(col("ws_item_sk")),
+         asc(col("d_year"))], out))
+
+
+def q81_shape(t, run):
+    """Catalog returners above 1.2x their state's average return amount
+    (reference q81's correlated HAVING via a per-state window)."""
+    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
+                                              WindowSpec, WinAvg)
+    j = _join(_join(t["catalog_returns"], t["customer"],
+                    ["cr_returning_customer_sk"], ["c_customer_sk"]),
+              t["customer_address"], ["c_current_addr_sk"],
+              ["ca_address_sk"])
+    per_cust = CpuAggregate(
+        [col("c_customer_id"), col("ca_state")],
+        [Sum(col("cr_return_amount")).alias("ctr_total_return")], j)
+    w = CpuWindow(
+        [WinAvg(col("ctr_total_return")).alias("state_avg")],
+        WindowSpec([col("ca_state")], [],
+                   WindowFrame(is_rows=True, lower=None, upper=None)),
+        per_cust)
+    keep = CpuFilter(
+        col("ctr_total_return") > col("state_avg") * lit(1.2), w)
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_customer_id"))],
+        CpuProject([col("c_customer_id"), col("ca_state"),
+                    col("ctr_total_return")], keep)))
+
+
+def q83_shape(t, run):
+    """Return quantities by item across the three return tables
+    (reference q83's three-way item join)."""
+    sr = CpuAggregate([col("sr_item_sk")],
+                      [Sum(col("sr_return_quantity")).alias("sr_qty")],
+                      t["store_returns"])
+    cr = CpuProject([col("cr_item_sk").alias("c_item"),
+                     col("cr_qty")],
+                    CpuAggregate(
+                        [col("cr_item_sk")],
+                        [Sum(col("cr_return_quantity")).alias("cr_qty")],
+                        t["catalog_returns"]))
+    wr = CpuProject([col("wr_item_sk").alias("w_item"),
+                     col("wr_qty")],
+                    CpuAggregate(
+                        [col("wr_item_sk")],
+                        [Sum(col("wr_return_quantity")).alias("wr_qty")],
+                        t["web_returns"]))
+    j = CpuHashJoin(J.INNER, [col("sr_item_sk")], [col("c_item")],
+                    sr, cr)
+    j = CpuHashJoin(J.INNER, [col("sr_item_sk")], [col("w_item")],
+                    j, wr)
+    out = CpuProject(
+        [col("sr_item_sk"), col("sr_qty"), col("cr_qty"), col("wr_qty"),
+         ((col("sr_qty") + col("cr_qty") + col("wr_qty")) / lit(3.0))
+         .alias("average")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("sr_item_sk"))], out))
+
+
+def q84_shape(t, run):
+    """Customer directory for one city, names concatenated (reference
+    q84's customer/address/demographics lookup)."""
+    from spark_rapids_tpu.exprs.string_fns import ConcatStrings
+    ca = CpuFilter(col("ca_city") == lit("Midway"),
+                   t["customer_address"])
+    j = _join(t["customer"], ca, ["c_current_addr_sk"],
+              ["ca_address_sk"])
+    out = CpuProject(
+        [col("c_customer_id").alias("customer_id"),
+         ConcatStrings((col("c_last_name"), lit(", "),
+                        col("c_first_name"))).alias("customername")], j)
+    return CpuLimit(100, CpuSort([asc(col("customer_id"))], out))
+
+
+def q85_shape(t, run):
+    """Catalog returns profiled by buyer demographics (reference q85's
+    reason-bucketed web returns, carried by the catalog arm where the
+    v0 schema has the demographics link)."""
+    j = CpuHashJoin(
+        J.INNER, [col("cs_order_number"), col("cs_item_sk")],
+        [col("cr_order_number"), col("cr_item_sk")],
+        t["catalog_sales"], t["catalog_returns"])
+    jd = _join(j, t["customer_demographics"], ["cs_bill_cdemo_sk"],
+               ["cd_demo_sk"])
+    agg = CpuAggregate(
+        [col("cd_marital_status"), col("cd_education_status")],
+        [Average(col("cs_quantity")).alias("avg_qty"),
+         Average(col("cr_return_quantity")).alias("avg_ret_qty"),
+         Count(col("cs_order_number")).alias("cnt")], jd)
+    return CpuLimit(100, CpuSort(
+        [asc(col("cd_marital_status")),
+         asc(col("cd_education_status"))], agg))
+
+
+def q89_shape(t, run):
+    """Monthly category/brand/store revenue vs the yearly average
+    (reference q89)."""
+    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
+                                              WindowSpec, WinAvg)
+    j = _join(_join(_join(CpuFilter(col("d_year") == lit(2000),
+                                    t["date_dim"]),
+                          t["store_sales"], ["d_date_sk"],
+                          ["ss_sold_date_sk"]),
+                    t["item"], ["ss_item_sk"], ["i_item_sk"]),
+              t["store"], ["ss_store_sk"], ["s_store_sk"])
+    monthly = CpuAggregate(
+        [col("i_category"), col("i_brand"), col("s_store_name"),
+         col("d_moy")],
+        [Sum(col("ss_sales_price")).alias("sum_sales")], j)
+    w = CpuWindow(
+        [WinAvg(col("sum_sales")).alias("avg_monthly_sales")],
+        WindowSpec([col("i_category"), col("i_brand"),
+                    col("s_store_name")], [],
+                   WindowFrame(is_rows=True, lower=None, upper=None)),
+        monthly)
+    keep = CpuFilter(
+        col("sum_sales") > col("avg_monthly_sales") * lit(1.1), w)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_category")), asc(col("i_brand")),
+         asc(col("s_store_name")), asc(col("d_moy"))],
+        CpuProject([col("i_category"), col("i_brand"),
+                    col("s_store_name"), col("d_moy"), col("sum_sales"),
+                    col("avg_monthly_sales")], keep)))
+
+
+def q95_shape(t, run):
+    """Web orders shipped from more than one warehouse that were also
+    returned (reference q95's double-EXISTS over ws self-join + wr)."""
+    ws2 = CpuProject([col("ws_order_number").alias("o2"),
+                      col("ws_warehouse_sk").alias("w2")],
+                     t["web_sales"])
+    multi = CpuHashJoin(
+        J.LEFT_SEMI, [col("ws_order_number")], [col("o2")],
+        t["web_sales"], ws2,
+        condition=col("ws_warehouse_sk") != col("w2"))
+    returned = CpuHashJoin(
+        J.LEFT_SEMI, [col("ws_order_number")], [col("wr_order_number")],
+        multi, t["web_returns"])
+    per_order = CpuAggregate(
+        [col("ws_order_number")],
+        [Sum(col("ws_ext_ship_cost")).alias("ship_cost"),
+         Sum(col("ws_net_profit")).alias("profit")], returned)
+    total = CpuAggregate(
+        [],
+        [Count(col("ws_order_number")).alias("order_count"),
+         Sum(col("ship_cost")).alias("total_shipping"),
+         Sum(col("profit")).alias("total_profit")], per_order)
+    return total
+
+
+QUERIES.update({
+    "q4": q4_shape, "q5": q5_shape, "q9": q9_shape, "q11": q11_shape,
+    "q12": q12_shape, "q14": q14_shape, "q17": q17_shape,
+    "q20": q20_shape, "q22": q22_rollup, "q24": q24_shape,
+    "q29": q29_shape, "q35": q35_shape, "q39": q39_shape,
+    "q49": q49_shape, "q53": q53_shape, "q54": q54_shape,
+    "q56": q56_shape, "q57": q57_shape, "q64": q64_shape,
+    "q72": q72_shape, "q74": q74_shape, "q76": q76_shape,
+    "q78": q78_shape, "q81": q81_shape, "q83": q83_shape,
+    "q84": q84_shape, "q85": q85_shape, "q86": q86_rollup,
+    "q89": q89_shape, "q95": q95_shape,
+})

@@ -217,6 +217,16 @@ class IciShuffleTransport(ShuffleTransport):
             "loop_address": f"loop://{executor_id}",
             "tcp_address": tcp.address})()
 
+    def can_reach(self, address: str) -> bool:
+        # loop:// resolves only inside the process that registered it;
+        # cross-process readers must fall back to the MapStatus's wire
+        # address
+        if address.startswith("loop://"):
+            eid = address[len("loop://"):]
+            with _LOOP_REGISTRY_LOCK:
+                return eid in _LOOP_REGISTRY
+        return True
+
     def make_client(self, peer_address: str) -> Connection:
         if peer_address.startswith("loop://"):
             eid = peer_address[len("loop://"):]

@@ -38,13 +38,25 @@ from spark_rapids_tpu.shuffle.transport import BlockIdMsg, make_transport
 
 class MapStatus:
     """Map-task completion record (reference MapStatus with the transport
-    address in BlockManagerId.topologyInfo)."""
+    address in BlockManagerId.topologyInfo).  Carries BOTH the loopback
+    and the wire (TCP) address: in-process readers take the loop lane,
+    readers in another process fall back to the wire — how the reference
+    serves local vs UCX-remote blocks from one MapStatus."""
 
     def __init__(self, executor_id: str, address: str,
-                 partition_sizes: list[int]):
+                 partition_sizes: list[int],
+                 tcp_address: str | None = None):
         self.executor_id = executor_id
         self.address = address
         self.partition_sizes = partition_sizes
+        self.tcp_address = tcp_address
+
+    def reachable_address(self, transport) -> str:
+        if transport.can_reach(self.address):
+            return self.address
+        if self.tcp_address:
+            return self.tcp_address
+        return self.address
 
 
 class MapOutputRegistry:
@@ -168,7 +180,8 @@ class CachingShuffleWriter:
     def commit(self, num_partitions: int) -> MapStatus:
         status = MapStatus(
             self.manager.executor_id, self.manager.loop_address,
-            [self._sizes.get(p, 0) for p in range(num_partitions)])
+            [self._sizes.get(p, 0) for p in range(num_partitions)],
+            tcp_address=self.manager.tcp_address)
         MapOutputRegistry.register(self.shuffle_id, self.map_id, status)
         return status
 
@@ -219,7 +232,8 @@ class CachingShuffleReader:
                     self.manager.shuffle_catalog.blocks_for_partition(
                         self.shuffle_id, self.partition, [map_id]))
             else:
-                remote.setdefault(status.address, []).append(
+                addr = status.reachable_address(self.manager.transport)
+                remote.setdefault(addr, []).append(
                     BlockIdMsg(self.shuffle_id, map_id, self.partition))
         try:
             # local blocks: straight catalog reads with the semaphore held

@@ -239,6 +239,11 @@ class ShuffleTransport:
         acquire_buffer_bytes(table_id)->bytes."""
         raise NotImplementedError
 
+    def can_reach(self, address: str) -> bool:
+        """Whether this transport instance can open `address` from THIS
+        process (loopback addresses are per-process)."""
+        return True
+
     def make_client(self, peer_address: str) -> Connection:
         raise NotImplementedError
 

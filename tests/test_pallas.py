@@ -84,3 +84,39 @@ def test_pallas_q1_sub_lane_capacity_pads():
                             capacity=64, cutoff=Q1_CUTOFF_DAYS,
                             interpret=True)
     assert int(np.asarray(table)[0, 5]) == 3  # count lands in group 0
+
+
+def test_pallas_q1_stacked_multibatch(rng):
+    """The stacked (device-side batch loop) form: B batches in one call
+    must equal running the single-batch kernel B times."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.models.tpch import (Q1_CUTOFF_DAYS,
+                                              build_q1_fused_kernel,
+                                              gen_lineitem)
+    B, rows = 4, 1024  # 1024-row batches: the mosaic-legal stacked shape
+    batches = [gen_lineitem(rng, rows) for _ in range(B)]
+    cap = batches[0].capacity
+
+    def args_of(b):
+        return (b.column("l_returnflag").data,
+                b.column("l_linestatus").data,
+                b.column("l_quantity").data,
+                b.column("l_extendedprice").data,
+                b.column("l_discount").data, b.column("l_tax").data,
+                b.column("l_shipdate").data)
+
+    stacked = [jnp.concatenate(a)
+               for a in zip(*(args_of(b) for b in batches))]
+    nums = jnp.asarray([b.num_rows for b in batches], jnp.int32)
+    step = build_q1_fused_kernel(cap * B, cap)
+    table = np.asarray(step(*stacked, nums))
+
+    from spark_rapids_tpu.models.tpch import build_q1_kernel
+    single = build_q1_kernel(cap)
+    exp = np.zeros((8, 6))
+    for b in batches:
+        out = single(*args_of(b), jnp.int32(b.num_rows))
+        for j in range(5):
+            exp[:, j] += np.asarray(out[2 + j])
+        exp[:, 5] += np.asarray(out[7])
+    np.testing.assert_allclose(table, exp, rtol=1e-6)
