@@ -25,8 +25,7 @@ from spark_rapids_tpu.io.csv import CsvFormat, CsvOptions
 from spark_rapids_tpu.io.orc import OrcFormat
 from spark_rapids_tpu.io.parquet import ParquetFormat
 from spark_rapids_tpu.io.scan import (
-    FilePartition, FormatReader, MultiFileCoalescingReader, discover_files,
-    plan_file_partitions)
+    FilePartition, FormatReader, MultiFileCoalescingReader, discover_files)
 from spark_rapids_tpu.io.writer import WriteJob, WriteStats
 from spark_rapids_tpu.plan.nodes import CpuNode, normalize_df
 
@@ -76,7 +75,12 @@ class ScanDescription:
                 f for f in probe.file_schema(files[0].path).fields
                 if f.name not in self.part_schema.names))
         self.reader = make_format(file_format, self.data_schema, options)
-        self.partitions = plan_file_partitions(
+        #: multi-file coalescing reader toggle (reference
+        #: supportsSmallFileOpt; flipped via
+        #: shims.copy_scan_with_small_file_opt)
+        self.small_file_opt = True
+        from spark_rapids_tpu.shims import current_shims
+        self.partitions = current_shims(conf).plan_file_partitions(
             files, conf[C.MAX_PARTITION_BYTES], conf[C.FILE_OPEN_COST],
             min_partitions=conf[C.MIN_PARTITION_NUM])
         self.output_schema = T.Schema(
@@ -184,13 +188,22 @@ class TpuFileSourceScanExec(LeafExec):
 
     def _partition_iter(self, part: FilePartition
                         ) -> Iterator[ColumnarBatch]:
-        reader = MultiFileCoalescingReader(
-            self.scan.reader, part, self.scan.data_schema,
-            self.scan.part_schema, self.pushed_filter, self.conf,
-            metrics=self.metrics)
-        for batch in reader:
-            self.update_output_metrics(batch)
-            yield batch
+        import dataclasses as _dc
+        if getattr(self.scan, "small_file_opt", True):
+            groups = [part]
+        else:
+            # coalescing disabled (reference
+            # copyFileSourceScanExec(supportsSmallFileOpt=false)): each
+            # split decodes through its own reader
+            groups = [_dc.replace(part, splits=(s,)) for s in part.splits]
+        for g in groups:
+            reader = MultiFileCoalescingReader(
+                self.scan.reader, g, self.scan.data_schema,
+                self.scan.part_schema, self.pushed_filter, self.conf,
+                metrics=self.metrics)
+            for batch in reader:
+                self.update_output_metrics(batch)
+                yield batch
 
 
 # ---------------------------------------------------------------------------

@@ -52,16 +52,21 @@ class FilePartition:
 def plan_file_partitions(files: Sequence[FileSplit],
                          max_partition_bytes: int,
                          open_cost_bytes: int,
-                         min_partitions: int = 1) -> list[FilePartition]:
+                         min_partitions: int = 1,
+                         split_files: bool = True) -> list[FilePartition]:
     """Spark's split packing: split each file at maxSplitBytes, sort splits
     descending, first-fit into partitions of maxSplitBytes (each split
-    costs its length + open cost)."""
+    costs its length + open cost).  `split_files=False` packs whole files
+    only (the Databricks getPartitionSplitFiles drift — shim-routed)."""
     total = sum(f.length for f in files) + open_cost_bytes * len(files)
     bytes_per_core = max(1, total // max(1, min_partitions))
     max_split = min(max_partition_bytes, max(open_cost_bytes,
                                              bytes_per_core))
     splits: list[FileSplit] = []
     for f in files:
+        if not split_files:
+            splits.append(f)
+            continue
         off = f.start
         remaining = f.length
         while remaining > 0:

@@ -273,15 +273,24 @@ int64_t spill_read_size(const char* path) {
             std::fread(&version, 4, 1, f) == 1 &&
             std::fread(&n, 8, 1, f) == 1 &&
             std::fread(&crc, 4, 1, f) == 1;
-  long hdr_end = ok ? std::ftell(f) : 0;
-  long file_end = 0;
-  if (ok && std::fseek(f, 0, SEEK_END) == 0) file_end = std::ftell(f);
+  // 64-bit tell: long is 32-bit on LLP64 (Windows), so >2GB spill
+  // files would misreport size through std::ftell (ADVICE r1)
+#if defined(_WIN32)
+  int64_t hdr_end = ok ? _ftelli64(f) : 0;
+  int64_t file_end = 0;
+  if (ok && _fseeki64(f, 0, SEEK_END) == 0) file_end = _ftelli64(f);
+#else
+  int64_t hdr_end = ok ? static_cast<int64_t>(ftello(f)) : 0;
+  int64_t file_end = 0;
+  if (ok && fseeko(f, 0, SEEK_END) == 0)
+    file_end = static_cast<int64_t>(ftello(f));
+#endif
   std::fclose(f);
   if (!ok) return -2;
   if (std::memcmp(magic, kSpillMagic, 4) != 0 || version != kSpillVersion)
     return -3;
   // a corrupted length field must not escape as a huge allocation
-  if (file_end - hdr_end != static_cast<long>(n)) return -4;
+  if (file_end - hdr_end != static_cast<int64_t>(n)) return -4;
   return static_cast<int64_t>(n);
 }
 

@@ -1,7 +1,8 @@
 """Plan-rewrite layer (SURVEY.md §2.2): device-neutral CPU physical plan,
 meta/tagging tree, replacement-rule registry, and transition insertion."""
 from spark_rapids_tpu.plan.nodes import (  # noqa: F401
-    CpuAggregate, CpuBroadcastExchange, CpuExpand, CpuFilter, CpuGenerate,
+    CpuAggregate, CpuBroadcastExchange, CpuCachedColumnar, CpuExpand,
+    CpuFilter, CpuGenerate,
     CpuHashJoin, CpuLimit, CpuNode, CpuProject, CpuRange,
     CpuShuffleExchange, CpuSort, CpuSortMergeJoin, CpuSource, CpuUnion,
     PartitioningSpec)

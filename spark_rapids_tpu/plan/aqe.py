@@ -204,6 +204,11 @@ def _materialize_stage(exchange: ShuffleExchangeExec,
     stage = ShuffleQueryStageExec(exchange).materialize()
     if not conf[C.COALESCE_PARTITIONS_ENABLED]:
         return stage
+    # Spark 3.1 ShuffleExchangeLike contract: a user-specified
+    # repartition pins its partition count (shim-set flag; 3.0 shims
+    # always allow coalescing)
+    if not getattr(exchange, "can_change_num_partitions", True):
+        return stage
     sizes = stage.partition_sizes()
     specs = coalesce_partition_specs(sizes, conf[C.ADVISORY_PARTITION_SIZE])
     if len(specs) == len(sizes):

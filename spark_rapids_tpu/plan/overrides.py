@@ -374,7 +374,8 @@ def _conv_hash_join(meta, kids) -> TpuExec:
     node: N.CpuHashJoin = meta.node
     left, right = kids
     if node.broadcast:
-        bex = BroadcastExchangeExec(right)
+        from spark_rapids_tpu.shims import current_shims
+        bex = current_shims(meta.conf).make_broadcast_exchange(right)
         return BroadcastHashJoinExec(node.join_type, node.left_keys,
                                      node.right_keys, left, bex,
                                      node.condition)
@@ -443,12 +444,17 @@ _PART_OF_SPEC = {
 
 def _conv_shuffle(meta, kids) -> TpuExec:
     node: N.CpuShuffleExchange = meta.node
-    return ShuffleExchangeExec(_PART_OF_SPEC[node.spec.kind](node.spec),
-                               kids[0])
+    from spark_rapids_tpu.shims import current_shims
+    # user-requested repartitions keep their partition count under 3.1's
+    # ShuffleExchangeLike contract (constructor drift routes via shims)
+    return current_shims(meta.conf).make_shuffle_exchange(
+        _PART_OF_SPEC[node.spec.kind](node.spec), kids[0],
+        can_change_num_partitions=not node.user_specified)
 
 
 def _conv_broadcast(meta, kids) -> TpuExec:
-    return BroadcastExchangeExec(kids[0])
+    from spark_rapids_tpu.shims import current_shims
+    return current_shims(meta.conf).make_broadcast_exchange(kids[0])
 
 
 register_exec(N.CpuSource, "in-memory source", _conv_source)
@@ -471,6 +477,11 @@ register_exec(
     exprs_of=lambda n: list(n.left_keys) + list(n.right_keys) +
     ([n.condition] if n.condition is not None else []),
     tag_extra=_tag_join)
+def _conv_cached_columnar(meta, kids) -> TpuExec:
+    from spark_rapids_tpu.plan.transitions import HostColumnarToDeviceExec
+    return HostColumnarToDeviceExec(meta.node)
+
+
 def _conv_expand(meta, kids) -> TpuExec:
     from spark_rapids_tpu.exec.expand import ExpandExec
     node: N.CpuExpand = meta.node
@@ -486,6 +497,9 @@ def _conv_generate(meta, kids) -> TpuExec:
                         retained=node.retained)
 
 
+register_exec(
+    N.CpuCachedColumnar, "host-columnar cache upload (HostColumnarToGpu)",
+    _conv_cached_columnar)
 register_exec(
     N.CpuExpand, "expand (grouping sets/rollup/cube)", _conv_expand,
     exprs_of=lambda n: [e for p in n.projections for e in p])

@@ -80,6 +80,48 @@ def df_from_batch(batch: ColumnarBatch) -> pd.DataFrame:
     return pd.DataFrame(out)
 
 
+class HostColumnarToDeviceExec(LeafExec):
+    """HOST-COLUMNAR source → device batches (reference
+    `HostColumnarToGpu.scala`, 273 LoC: cached/InMemoryTableScan data
+    enters the GPU plan without a row pivot).  Column buffers upload via
+    `ColumnarBatch.from_arrow`; oversized tables chunk by the batch-row
+    cap like the scan path."""
+
+    def __init__(self, cpu_source):
+        super().__init__()
+        self.cpu_source = cpu_source  # CpuCachedColumnar
+        self._schema = cpu_source.output_schema()
+
+    def output_schema(self) -> T.Schema:
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return self.cpu_source.output_partition_count()
+
+    def describe(self):
+        return (f"HostColumnarToDeviceExec("
+                f"{len(self.cpu_source.partitions)} cached partitions)")
+
+    def execute_partitions(self):
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+        max_rows = C.get_active_conf()[C.MAX_BATCH_ROWS]
+
+        def convert(table):
+            sem = TpuSemaphore.get()
+            for off in range(0, max(table.num_rows, 1), max_rows):
+                sl = table.slice(off, max_rows)
+                if sl.num_rows == 0:
+                    continue
+                sem.acquire_if_necessary()  # device admission boundary
+                with self.metrics.timed(M.TOTAL_TIME):
+                    b = ColumnarBatch.from_arrow(sl)
+                    self.update_output_metrics(b)
+                yield b
+        outs = [convert(t) for t in self.cpu_source.partitions]
+        return outs or [iter(())]
+
+
 class RowToColumnarExec(LeafExec):
     """Runs a CPU subtree and uploads its partitions to the device
     (reference GpuRowToColumnarExec; leaf from the TPU tree's viewpoint)."""

@@ -133,8 +133,15 @@ class SQLExecPlugin:
     @staticmethod
     def apply(extensions: "SparkSessionExtensions") -> None:
         extensions.inject_columnar(lambda conf: _ColumnarOverrideRules(conf))
-        extensions.inject_query_stage_prep_rule(
-            lambda conf: _query_stage_prep(conf))
+
+        def _prep_builder(conf):
+            # shim resolution DEFERRED to build time: apply() may run
+            # before the session conf is active on this thread, and the
+            # builder receives the real per-session conf
+            from spark_rapids_tpu.shims import current_shims
+            return current_shims(conf).make_query_stage_prep_rule(
+                conf, _query_stage_prep)
+        extensions.inject_query_stage_prep_rule(_prep_builder)
 
 
 class SparkSessionExtensions:

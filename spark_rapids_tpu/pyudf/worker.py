@@ -96,12 +96,20 @@ def main() -> int:
             return 0
         if not fn_blob:
             return 0
+        # the second frame is PROTOCOL, not UDF work: EOF/truncation
+        # mid-request means the stream is desynced — exit, don't report
+        # a UDF error and keep looping (ADVICE r1).  KeyboardInterrupt/
+        # SystemExit likewise terminate the worker instead of being
+        # swallowed as a UDF failure.
         try:
             ipc = _read_frame(stdin)
+        except EOFError:
+            return 1
+        try:
             fn = cloudpickle.loads(fn_blob)
             out = fn(_ipc_to_df(ipc))
             _write_response(stdout, 0, _df_to_ipc(out))
-        except BaseException:  # noqa: BLE001 — ship traceback to driver
+        except Exception:  # noqa: BLE001 — ship traceback to driver
             import traceback
             _write_response(stdout, 1,
                             traceback.format_exc().encode("utf-8"))

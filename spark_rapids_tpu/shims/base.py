@@ -152,6 +152,82 @@ class SparkShims:
             self.parquet_rebase_write_key(),
             self.parquet_rebase_default()))
 
+    # -- join construction drift --------------------------------------------
+    BUILD_LEFT = "left"
+    BUILD_RIGHT = "right"
+
+    def build_side_of(self, join_type, preferred: str = "right") -> str:
+        """Build-side resolution (reference `SparkShims.getBuildSide`:
+        BuildLeft/BuildRight MOVED packages in Spark 3.1, so engine code
+        must never import them directly — the shim owns the mapping).
+        Semi/anti joins always build the right side."""
+        from spark_rapids_tpu.exec.joins import JoinType as JT
+        if join_type in (JT.LEFT_SEMI, JT.LEFT_ANTI):
+            return self.BUILD_RIGHT
+        return preferred
+
+    def make_nested_loop_join(self, join_type, left, right, condition,
+                              target_size_bytes: int = 0):
+        """Nested-loop join constructor (reference
+        `getGpuBroadcastNestedLoopJoinShim`: the exec's constructor
+        signature drifts per version; targetSizeBytes threading changed)."""
+        from spark_rapids_tpu.exec.joins import NestedLoopJoinExec
+        j = NestedLoopJoinExec(left, right, condition, join_type)
+        j.target_size_bytes = target_size_bytes
+        return j
+
+    # -- exchange construction drift ----------------------------------------
+    def make_shuffle_exchange(self, partitioning, child,
+                              can_change_num_partitions: bool = True):
+        """Shuffle exchange constructor (reference
+        `getGpuShuffleExchangeExec`): Spark 3.0 has no
+        canChangeNumPartitions — AQE may always coalesce; 3.1's
+        ShuffleExchangeLike carries the flag (spark310 override)."""
+        from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+        ex = ShuffleExchangeExec(partitioning, child)
+        ex.can_change_num_partitions = True  # 3.0 semantics
+        return ex
+
+    def make_broadcast_exchange(self, child):
+        """Broadcast exchange constructor (reference
+        `getGpuBroadcastExchangeExec`; 3.1 wraps BroadcastExchangeLike)."""
+        from spark_rapids_tpu.shuffle.exchange import BroadcastExchangeExec
+        return BroadcastExchangeExec(child)
+
+    # -- AQE rule injection ---------------------------------------------------
+    def inject_query_stage_prep_rule(self, extensions, builder) -> None:
+        """AQE prep-rule injection (reference
+        `SparkShims.injectQueryStagePrepRule`: the upstream API appeared
+        in 3.0.1; Databricks' forked AQE registers under its own hook —
+        spark300db override)."""
+        extensions.inject_query_stage_prep_rule(builder)
+
+    def make_query_stage_prep_rule(self, conf, factory):
+        """Build the prep rule for THIS version (conf-resolved, so the
+        plugin can defer shim lookup into the builder; Databricks wraps
+        the rule under its forked name)."""
+        return factory(conf)
+
+    # -- file scan construction ----------------------------------------------
+    def plan_file_partitions(self, files, max_bytes: int, open_cost: int,
+                             min_partitions: int = 1):
+        """FilePartition planning (reference `createFilePartition` +
+        `getPartitionSplitFiles`: Databricks packs whole files only)."""
+        from spark_rapids_tpu.io.scan import plan_file_partitions
+        return plan_file_partitions(files, max_bytes, open_cost,
+                                    min_partitions=min_partitions)
+
+    def copy_scan_with_small_file_opt(self, scan_exec, enabled: bool):
+        """Rebuild a file scan exec with the multi-file (small-file
+        coalescing) reader toggled (reference
+        `copyFileSourceScanExec(supportsSmallFileOpt)`)."""
+        import copy as _copy
+        from spark_rapids_tpu.io.exec import TpuFileSourceScanExec
+        sd = _copy.copy(scan_exec.scan)
+        sd.small_file_opt = enabled
+        return TpuFileSourceScanExec(sd, scan_exec.pushed_filter,
+                                     scan_exec.conf)
+
     # -- rule extensions ----------------------------------------------------
     def extra_exec_rules(self) -> dict:
         """Per-version exec replacement rules added on top of the common

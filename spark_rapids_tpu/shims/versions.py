@@ -24,6 +24,32 @@ class Spark300dbShims(Spark300Shims):
         # Databricks runtime forked AQE before upstream settled the name.
         return "DatabricksShuffleReaderExec"
 
+    def inject_query_stage_prep_rule(self, extensions, builder) -> None:
+        # the Databricks fork registers prep rules under its own hook
+        # name; tag the builder so plan capture shows the forked path
+        def db_rule(conf):
+            rule = builder(conf)
+            return rule
+        db_rule.__name__ = "DatabricksQueryStagePrepRule"
+        extensions.inject_query_stage_prep_rule(db_rule)
+
+    def make_query_stage_prep_rule(self, conf, factory):
+        rule = factory(conf)
+
+        def db_rule(plan):
+            return rule(plan)
+        db_rule.__name__ = "DatabricksQueryStagePrepRule"
+        return db_rule
+
+    def plan_file_partitions(self, files, max_bytes, open_cost,
+                             min_partitions: int = 1):
+        # Databricks' getPartitionSplitFiles packs WHOLE files (no
+        # byte-range splitting)
+        from spark_rapids_tpu.io.scan import plan_file_partitions
+        return plan_file_partitions(files, max_bytes, open_cost,
+                                    min_partitions=min_partitions,
+                                    split_files=False)
+
     def shuffle_manager_class(self) -> str:
         return "spark_rapids_tpu.shims.spark300db.RapidsShuffleManager"
 
@@ -71,6 +97,14 @@ class Spark310Shims(Spark301Shims):
 
     def shuffle_manager_class(self) -> str:
         return "spark_rapids_tpu.shims.spark310.RapidsShuffleManager"
+
+    def make_shuffle_exchange(self, partitioning, child,
+                              can_change_num_partitions: bool = True):
+        # 3.1 ShuffleExchangeLike: AQE honors canChangeNumPartitions
+        # (repartition-by-user must keep its partition count)
+        ex = super().make_shuffle_exchange(partitioning, child)
+        ex.can_change_num_partitions = can_change_num_partitions
+        return ex
 
 
 ALL_SHIMS = (Spark300Shims, Spark300dbShims, Spark301Shims, Spark302Shims,

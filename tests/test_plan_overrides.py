@@ -422,3 +422,22 @@ def test_sort_merge_join_conf_off_falls_back():
     ExecutionPlanCapture.assert_did_fall_back("CpuSortMergeJoin")
     from parity import compare_frames
     compare_frames(expected, got, "smj-conf-off")
+
+
+# -- HostColumnarToGpu analog (reference HostColumnarToGpu.scala) -----------
+def test_cached_columnar_uploads_without_row_pivot():
+    """A host-columnar (arrow) cached source enters the TPU plan through
+    HostColumnarToDeviceExec and computes with parity."""
+    from spark_rapids_tpu.plan import CpuCachedColumnar
+    df = pd.DataFrame({
+        "a": np.arange(20, dtype=np.int64),
+        "b": np.linspace(0, 1, 20),
+        "s": [None if i % 5 == 0 else f"v{i}" for i in range(20)],
+    })
+    cached = CpuCachedColumnar.from_pandas(df, num_partitions=3)
+    plan = CpuProject([(col("a") * 10).alias("x"), col("b"), col("s")],
+                      CpuFilter(col("a") >= 4, cached))
+    tpu = compare(plan, sort_by=["x"])
+    names = _tpu_names(tpu)
+    assert "HostColumnarToDeviceExec" in names
+    assert "RowToColumnarExec" not in names

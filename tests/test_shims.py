@@ -192,3 +192,135 @@ def test_accelerated_transition_in_plan_rewrite():
                                       g.isna().to_numpy())
         ev, gv = e[~e.isna()].tolist(), g[~g.isna()].tolist()
         assert ev == gv, f"column {name}"
+
+
+# -- round-2 drift points (reference SparkShims.scala:57-136) ---------------
+def test_shuffle_exchange_constructor_drift():
+    """3.0 exchanges always allow AQE coalescing; 3.1's
+    ShuffleExchangeLike carries canChangeNumPartitions."""
+    from spark_rapids_tpu.exec.basic import LocalBatchSource
+    from spark_rapids_tpu.shims.versions import (Spark300Shims,
+                                                 Spark310Shims)
+    from spark_rapids_tpu.shuffle.partitioning import RoundRobinPartitioning
+    import pandas as pd
+    src = LocalBatchSource.from_pandas(pd.DataFrame({"a": [1, 2]}))
+    part = RoundRobinPartitioning(2)
+    ex300 = Spark300Shims().make_shuffle_exchange(
+        part, src, can_change_num_partitions=False)
+    assert ex300.can_change_num_partitions is True  # 3.0: no such flag
+    ex310 = Spark310Shims().make_shuffle_exchange(
+        part, src, can_change_num_partitions=False)
+    assert ex310.can_change_num_partitions is False
+
+
+def test_build_side_and_nested_loop_constructor():
+    from spark_rapids_tpu.exec.basic import LocalBatchSource
+    from spark_rapids_tpu.exec.joins import JoinType, NestedLoopJoinExec
+    from spark_rapids_tpu.shims.versions import ALL_SHIMS
+    import pandas as pd
+    l = LocalBatchSource.from_pandas(pd.DataFrame({"a": [1]}))
+    r = LocalBatchSource.from_pandas(pd.DataFrame({"b": [2]}))
+    for cls in ALL_SHIMS:
+        s = cls()
+        # the mapping is version-stable; the DRIFT the shim hides is
+        # where BuildSide lives (moved packages in 3.1)
+        assert s.build_side_of(JoinType.LEFT_SEMI, "left") == "right"
+        assert s.build_side_of(JoinType.INNER, "left") == "left"
+        j = s.make_nested_loop_join(JoinType.CROSS, l, r, None,
+                                    target_size_bytes=1024)
+        assert isinstance(j, NestedLoopJoinExec)
+        assert j.target_size_bytes == 1024
+
+
+def test_databricks_prep_rule_injection_drift():
+    """The built rule carries the Databricks fork's name only on the db
+    shim — resolved from the PER-SESSION conf at build time, matching
+    the plugin's deferred builder."""
+    from spark_rapids_tpu.shims.versions import (Spark300dbShims,
+                                                 Spark301Shims)
+    for shim, expect_db in ((Spark301Shims(), False),
+                            (Spark300dbShims(), True)):
+        rule = shim.make_query_stage_prep_rule(
+            C.RapidsConf(), lambda conf: (lambda plan: plan))
+        name = getattr(rule, "__name__", "")
+        assert (name == "DatabricksQueryStagePrepRule") == expect_db
+        assert rule("PLAN") == "PLAN"  # still delegates to the rule
+
+
+def test_databricks_file_partitions_pack_whole_files():
+    """getPartitionSplitFiles drift: Databricks packs whole files."""
+    from spark_rapids_tpu.io.scan import FileSplit
+    from spark_rapids_tpu.shims.versions import (Spark300dbShims,
+                                                 Spark301Shims)
+    files = [FileSplit(path=f"/f{i}", start=0, length=10_000_000,
+                       file_size=10_000_000) for i in range(3)]
+    upstream = Spark301Shims().plan_file_partitions(
+        files, max_bytes=4_000_000, open_cost=10_000, min_partitions=1)
+    db = Spark300dbShims().plan_file_partitions(
+        files, max_bytes=4_000_000, open_cost=10_000, min_partitions=1)
+    up_splits = [s for p in upstream for s in p.splits]
+    db_splits = [s for p in db for s in p.splits]
+    assert any(s.length < 10_000_000 for s in up_splits)  # ranges
+    assert all(s.length == 10_000_000 for s in db_splits)  # whole files
+
+
+def test_copy_scan_with_small_file_opt(tmp_path):
+    import pandas as pd
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.io.exec import ScanDescription, \
+        TpuFileSourceScanExec
+    from spark_rapids_tpu.shims import current_shims
+    pd.DataFrame({"a": [1, 2, 3]}).to_parquet(tmp_path / "x.parquet")
+    sd = ScanDescription(str(tmp_path), "parquet",
+                         conf=C.get_active_conf())
+    exec_ = TpuFileSourceScanExec(sd)
+    for enabled in (True, False):
+        copied = current_shims(C.get_active_conf()) \
+            .copy_scan_with_small_file_opt(exec_, enabled)
+        assert copied.scan.small_file_opt is enabled
+        assert copied.scan is not exec_.scan
+        out = copied.collect()
+        assert out.num_rows == 3
+    # behavior: with the opt off, each split reads through its OWN
+    # reader (no cross-file coalescing) — two files -> >= 2 batches
+    pd.DataFrame({"a": [4, 5]}).to_parquet(tmp_path / "y.parquet")
+    sd2 = ScanDescription(str(tmp_path), "parquet",
+                          conf=C.get_active_conf())
+    base2 = TpuFileSourceScanExec(sd2)
+    off = current_shims(C.get_active_conf()) \
+        .copy_scan_with_small_file_opt(base2, False)
+    batches = [b for it in off.execute_partitions() for b in it]
+    assert sum(b.num_rows for b in batches) == 5
+    assert len(batches) >= 2
+
+
+
+def test_aqe_respects_pinned_partition_count():
+    """3.1 contract end-to-end: a user repartition(N) planned under the
+    3.1 shim is NOT coalesced by AQE; under 3.0 shims it may be."""
+    from spark_rapids_tpu.plan import (CpuShuffleExchange, CpuSource,
+                                       PartitioningSpec, accelerate,
+                                       collect, ExecutionPlanCapture)
+    from spark_rapids_tpu.exprs.base import col
+    df = pd.DataFrame({"a": np.arange(64, dtype=np.int64)})
+    plan = CpuShuffleExchange(
+        PartitioningSpec("hash", 8, (col("a"),)),
+        CpuSource.from_pandas(df, num_partitions=2))
+    for ver, may_coalesce in (("3.0.1", True), ("3.1.0", False)):
+        conf = C.RapidsConf({
+            "spark.rapids.tpu.sparkVersion": ver,
+            "spark.sql.adaptive.enabled": True,
+            "spark.sql.adaptive.coalescePartitions.enabled": True})
+        out = collect(accelerate(plan, conf), conf)
+        assert sorted(out["a"]) == list(range(64))
+        final = ExecutionPlanCapture.last_plan
+        names = []
+
+        def walk(n):
+            names.append(type(n).__name__)
+            for c in getattr(n, "children", []):
+                walk(c)
+        walk(final)
+        coalesced = "CustomShuffleReaderExec" in names
+        if not may_coalesce:
+            assert not coalesced, f"{ver} must pin the partition count"
