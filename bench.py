@@ -18,6 +18,9 @@ Mirrors the reference bench harness shape (cold + hot runs,
   4. join_sort_q3    — milestone 3: shuffled hash join + sort, q3 shape.
   5. exchange_mgr    — milestone 4 (single-executor form): hash exchange
      routed through TpuShuffleManager's spillable catalog.
+  6. groupby_dict_kernel — the Pallas dictionary-encoded grouped-sum
+     kernel on milestone 2's shape (the sort-free path the planner will
+     adopt with dictionary detection; `mode: "kernel"`).
 
 Every hot dispatch gets distinct inputs (the axon tunnel memoizes
 identical calls, and `block_until_ready` does not reliably fence — a
@@ -380,6 +383,50 @@ def bench_exchange_manager():
     }
 
 
+def bench_groupby_dict_kernel():
+    """Milestone 2's shape through the Pallas dictionary grouped-sum
+    kernel (ops/pallas_kernels.grouped_sum_pallas): keys already ids in
+    [0, G) — the sort-free path; f32-accumulator (variableFloatAgg)
+    semantics."""
+    import jax
+    import pandas as pd
+    from spark_rapids_tpu.ops.pallas_kernels import grouped_sum_pallas
+
+    rows, n_keys = 1 << 22, 1 << 10
+    rng = np.random.default_rng(5)
+    keys = rng.integers(0, n_keys, rows).astype(np.int32)
+    v = rng.uniform(0, 100, rows).astype(np.float32)
+    w = rng.uniform(0, 10, rows).astype(np.float32)
+    kd, vd, wd = map(jax.device_put, (keys, v, w))
+    sums, counts = grouped_sum_pallas(kd, (vd, wd), rows,
+                                      n_groups=n_keys, capacity=rows)
+    sums, counts = np.asarray(sums), np.asarray(counts)
+    df = pd.DataFrame({"k": keys, "v": v.astype(float),
+                       "w": w.astype(float)})
+    t0 = time.perf_counter()
+    exp = df.groupby("k").agg(sv=("v", "sum"), sw=("w", "sum"),
+                              c=("v", "size"))
+    pandas_time = time.perf_counter() - t0
+    assert (counts == exp["c"].to_numpy()).all()
+    np.testing.assert_allclose(sums[:, 0], exp["sv"].to_numpy(),
+                               rtol=2e-3)
+    t0 = time.perf_counter()
+    outs = [grouped_sum_pallas(kd, (vd, wd), rows - i,
+                               n_groups=n_keys, capacity=rows)
+            for i in range(4)]
+    jax.block_until_ready(outs)
+    np.asarray(outs[-1][0])
+    best = (time.perf_counter() - t0) / 4
+    return {
+        "metric": "groupby_dict_kernel_rows_per_sec", "mode": "kernel",
+        "value": round(rows / best, 1), "unit": "rows/s",
+        "vs_baseline": round(pandas_time / best, 2),
+        "note": "dictionary-encoded keys (ids in [0,G)); the sort-free "
+                "Pallas path the planner adopts next via dictionary "
+                "detection; f32-accumulator (variableFloatAgg) semantics",
+    }
+
+
 def main():
     q1, pandas_time, batches = bench_q1_stream()
     print(json.dumps(q1), flush=True)
@@ -388,7 +435,7 @@ def main():
     print(json.dumps(fused), flush=True)
     subs.append(fused)
     del batches, fused
-    for fn in (bench_groupby,
+    for fn in (bench_groupby, bench_groupby_dict_kernel,
                bench_join_sort, bench_exchange_manager):
         m = fn()
         print(json.dumps(m), flush=True)

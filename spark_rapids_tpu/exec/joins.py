@@ -478,4 +478,7 @@ class NestedLoopJoinExec(TpuExec):
 
 def CartesianProductExec(left: TpuExec, right: TpuExec,
                          condition=None) -> NestedLoopJoinExec:
-    return NestedLoopJoinExec(left, right, condition, JoinType.CROSS)
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.shims import current_shims
+    return current_shims(C.get_active_conf()).make_nested_loop_join(
+        JoinType.CROSS, left, right, condition)

@@ -2401,3 +2401,132 @@ QUERIES.update({
     "q84": q84_shape, "q85": q85_shape, "q86": q86_rollup,
     "q89": q89_shape, "q95": q95_shape,
 })
+
+
+# a/b variants (the reference counts q14a/b, q23a/b, q24a/b, q39a/b as
+# separate queries — TpcdsLikeSpark.scala) + q91.
+def q14b_shape(t, run):
+    """Cross-channel items: this-year vs last-year sales comparison for
+    items sold in both store and catalog (reference q14b's
+    year-over-year arm; q14(a) covers the 3-channel intersection)."""
+    both = CpuHashJoin(J.LEFT_SEMI, [col("i_item_sk")],
+                       [col("cs_item_sk")],
+                       CpuHashJoin(J.LEFT_SEMI, [col("i_item_sk")],
+                                   [col("ss_item_sk")], t["item"],
+                                   t["store_sales"]),
+                       t["catalog_sales"])
+
+    def year_sales(y, alias):
+        dd = CpuFilter(col("d_year") == lit(y), t["date_dim"])
+        j = _join(_join(dd, t["store_sales"], ["d_date_sk"],
+                        ["ss_sold_date_sk"]),
+                  both, ["ss_item_sk"], ["i_item_sk"])
+        return CpuAggregate(
+            [col("i_brand_id")],
+            [Sum(col("ss_ext_sales_price")).alias(alias)], j)
+
+    this_y = year_sales(2000, "this_year")
+    last_y = CpuProject([col("i_brand_id").alias("b2"),
+                         col("last_year")],
+                        year_sales(1999, "last_year"))
+    j = CpuHashJoin(J.INNER, [col("i_brand_id")], [col("b2")],
+                    this_y, last_y)
+    return CpuLimit(100, CpuSort(
+        [desc(col("this_year")), asc(col("i_brand_id"))],
+        CpuProject([col("i_brand_id"), col("this_year"),
+                    col("last_year")], j)))
+
+
+def q23b_shape(t, run):
+    """Best store customers' catalog spend on frequently-sold items
+    (reference q23b; q23(a) covers the frequent-item monthly totals)."""
+    freq = CpuFilter(col("cnt") > lit(4), CpuAggregate(
+        [col("ss_item_sk")], [Count(None).alias("cnt")],
+        t["store_sales"]))
+    best = CpuFilter(col("spend") > lit(1000.0), CpuAggregate(
+        [col("ss_customer_sk")],
+        [Sum(col("ss_net_paid")).alias("spend")], t["store_sales"]))
+    cs = CpuHashJoin(J.LEFT_SEMI, [col("cs_item_sk")],
+                     [col("ss_item_sk")], t["catalog_sales"], freq)
+    cs = CpuHashJoin(J.LEFT_SEMI, [col("cs_bill_customer_sk")],
+                     [col("ss_customer_sk")], cs, best)
+    agg = CpuAggregate(
+        [col("cs_bill_customer_sk")],
+        [Sum(col("cs_sales_price")).alias("sales")], cs)
+    return CpuLimit(100, CpuSort(
+        [desc(col("sales")), asc(col("cs_bill_customer_sk"))], agg))
+
+
+def q24b_shape(t, run):
+    """q24's sibling keyed by category instead of brand (the reference
+    differs only in the color filter; the v0 item schema has no color)."""
+    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
+                                              WindowSpec, WinAvg)
+    ssr = CpuHashJoin(
+        J.INNER, [col("ss_ticket_number"), col("ss_item_sk")],
+        [col("sr_ticket_number"), col("sr_item_sk")],
+        t["store_sales"], t["store_returns"])
+    j = _join(_join(_join(ssr, t["store"], ["ss_store_sk"],
+                          ["s_store_sk"]),
+                    t["item"], ["ss_item_sk"], ["i_item_sk"]),
+              t["customer"], ["ss_customer_sk"], ["c_customer_sk"])
+    agg = CpuAggregate(
+        [col("c_last_name"), col("s_store_name"), col("i_category")],
+        [Sum(col("ss_net_paid")).alias("netpaid")], j)
+    w = CpuWindow(
+        [WinAvg(col("netpaid")).alias("avg_netpaid")],
+        WindowSpec([], [], WindowFrame(is_rows=True, lower=None,
+                                       upper=None)), agg)
+    keep = CpuFilter(col("netpaid") > col("avg_netpaid") * lit(0.05), w)
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_last_name")), asc(col("s_store_name")),
+         asc(col("i_category"))],
+        CpuProject([col("c_last_name"), col("s_store_name"),
+                    col("i_category"), col("netpaid")], keep)))
+
+
+def q39b_shape(t, run):
+    """q39's second arm: only pairs whose month-over-month quantity
+    swing is large (reference q39b tightens the covariance filter)."""
+    base = q39_shape(t, run)
+    # re-filter the paired report: keep rows with a >30% swing
+    from spark_rapids_tpu.exprs.arithmetic import Abs as _Abs
+    inner = base.child.child if isinstance(base, CpuLimit) else base
+    swing = CpuFilter(
+        (col("qoh1") > lit(0.0)) &
+        (_Abs(col("qoh2") - col("qoh1")) / col("qoh1") > lit(0.3)),
+        inner)
+    return CpuLimit(100, CpuSort(
+        [asc(col("w_warehouse_sk")), asc(col("inv_item_sk")),
+         asc(col("next_moy"))], swing))
+
+
+def q91_shape(t, run):
+    """Catalog returns profiled by buyer demographics and customer state
+    (reference q91 groups by call center — outside the v0 table set;
+    the demographic link rides the originating catalog sale's
+    cs_bill_cdemo_sk, the same path q85 uses)."""
+    ret = CpuHashJoin(
+        J.INNER, [col("cr_order_number"), col("cr_item_sk")],
+        [col("cs_order_number"), col("cs_item_sk")],
+        t["catalog_returns"], t["catalog_sales"])
+    j = _join(_join(_join(ret, t["customer"],
+                          ["cr_returning_customer_sk"],
+                          ["c_customer_sk"]),
+                    t["customer_address"], ["c_current_addr_sk"],
+                    ["ca_address_sk"]),
+              t["customer_demographics"],
+              ["cs_bill_cdemo_sk"], ["cd_demo_sk"])
+    agg = CpuAggregate(
+        [col("ca_state"), col("cd_marital_status")],
+        [Sum(col("cr_return_amount")).alias("returns_loss"),
+         Count(None).alias("cnt")], j)
+    return CpuLimit(100, CpuSort(
+        [desc(col("returns_loss")), asc(col("ca_state")),
+         asc(col("cd_marital_status"))], agg))
+
+
+QUERIES.update({
+    "q14b": q14b_shape, "q23b": q23b_shape, "q24b": q24b_shape,
+    "q39b": q39b_shape, "q91": q91_shape,
+})

@@ -121,6 +121,10 @@ class HostColumnarToDeviceExec(LeafExec):
         outs = [convert(t) for t in self.cpu_source.partitions]
         return outs or [iter(())]
 
+    def execute_columnar(self):
+        for it in self.execute_partitions():
+            yield from it
+
 
 class RowToColumnarExec(LeafExec):
     """Runs a CPU subtree and uploads its partitions to the device

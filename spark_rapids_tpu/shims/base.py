@@ -195,13 +195,6 @@ class SparkShims:
         return BroadcastExchangeExec(child)
 
     # -- AQE rule injection ---------------------------------------------------
-    def inject_query_stage_prep_rule(self, extensions, builder) -> None:
-        """AQE prep-rule injection (reference
-        `SparkShims.injectQueryStagePrepRule`: the upstream API appeared
-        in 3.0.1; Databricks' forked AQE registers under its own hook —
-        spark300db override)."""
-        extensions.inject_query_stage_prep_rule(builder)
-
     def make_query_stage_prep_rule(self, conf, factory):
         """Build the prep rule for THIS version (conf-resolved, so the
         plugin can defer shim lookup into the builder; Databricks wraps

@@ -24,15 +24,6 @@ class Spark300dbShims(Spark300Shims):
         # Databricks runtime forked AQE before upstream settled the name.
         return "DatabricksShuffleReaderExec"
 
-    def inject_query_stage_prep_rule(self, extensions, builder) -> None:
-        # the Databricks fork registers prep rules under its own hook
-        # name; tag the builder so plan capture shows the forked path
-        def db_rule(conf):
-            rule = builder(conf)
-            return rule
-        db_rule.__name__ = "DatabricksQueryStagePrepRule"
-        extensions.inject_query_stage_prep_rule(db_rule)
-
     def make_query_stage_prep_rule(self, conf, factory):
         rule = factory(conf)
 

@@ -120,3 +120,28 @@ def test_pallas_q1_stacked_multibatch(rng):
             exp[:, j] += np.asarray(out[2 + j])
         exp[:, 5] += np.asarray(out[7])
     np.testing.assert_allclose(table, exp, rtol=1e-6)
+
+
+def test_grouped_sum_dictionary_keys(rng):
+    """Dictionary-encoded grouped sum/count: single-pass Pallas kernel
+    vs pandas (f32-accumulator tolerance = variableFloatAgg
+    semantics)."""
+    import pandas as pd
+    from spark_rapids_tpu.ops.pallas_kernels import grouped_sum_pallas
+    N, G = 4096, 37
+    keys = rng.integers(0, G, N).astype(np.int32)
+    v = rng.uniform(0, 100, N).astype(np.float32)
+    w = rng.uniform(0, 10, N).astype(np.float32)
+    sums, counts = grouped_sum_pallas(
+        keys, (v, w), N - 5, n_groups=G, capacity=N, interpret=True)
+    sums, counts = np.asarray(sums), np.asarray(counts)
+    df = pd.DataFrame({"k": keys[:N - 5], "v": v[:N - 5].astype(float),
+                       "w": w[:N - 5].astype(float)})
+    exp = df.groupby("k").agg(sv=("v", "sum"), sw=("w", "sum"),
+                              c=("v", "size")).reindex(range(G),
+                                                       fill_value=0)
+    np.testing.assert_array_equal(counts, exp["c"].to_numpy())
+    np.testing.assert_allclose(sums[:, 0], exp["sv"].to_numpy(),
+                               rtol=2e-3, atol=1e-6)
+    np.testing.assert_allclose(sums[:, 1], exp["sw"].to_numpy(),
+                               rtol=2e-3, atol=1e-6)
