@@ -207,6 +207,17 @@ PALLAS_Q1_ENABLED = conf(
     "measures faster (9.6 vs 13.0 ms/dispatch on a tunnel-attached "
     "v5e), so it stays the single-batch default; see q1Fused for the "
     "mode where Pallas wins 3x.")
+DICT_GROUPBY_ENABLED = conf(
+    "spark.rapids.tpu.dictGroupby.enabled", False,
+    "Sort-free grouped aggregation via the Pallas one-hot kernel when a "
+    "single integral group key's runtime range fits dictGroupby.maxGroups "
+    "(Sum/Count/Average over floats). Sums accumulate in f32 "
+    "(variableFloatAgg-class tolerance), so this ships default-off; "
+    "measured ~230x the sort-based path on the milestone-2 shape.")
+DICT_GROUPBY_MAX_GROUPS = conf(
+    "spark.rapids.tpu.dictGroupby.maxGroups", 4096,
+    "Max runtime key range for the dictionary group-by fast path (the "
+    "one-hot table must fit VMEM).")
 PALLAS_Q1_FUSED_ENABLED = conf(
     "spark.rapids.tpu.pallas.q1Fused.enabled", True,
     "Use the Pallas single-HBM-pass kernel for STACKED multi-batch Q1 "

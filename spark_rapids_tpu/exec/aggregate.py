@@ -104,6 +104,10 @@ class HashAggregateExec(UnaryExecBase):
                 fields.append(
                     T.Field(a.name, a.func.result_type(child_schema)))
         self._schema = T.Schema(tuple(fields))
+        # static qualification for the dictionary fast path, computed
+        # once (None = never applicable for this exec)
+        self._dict_qual = self._dict_plan()
+        self._dict_range_misses = 0
 
     def output_schema(self) -> T.Schema:
         return self._schema
@@ -204,6 +208,164 @@ class HashAggregateExec(UnaryExecBase):
 
         return self.kernels.get_or_build(key, build)
 
+    # -- dictionary fast path (conf-gated) -----------------------------------
+    def _dict_plan(self):
+        """Static qualification for the sort-free dictionary path:
+        single integral key, Sum/Count/Average over float inputs.
+        Returns (plan, measures) or None."""
+        if self.mode == AggMode.FINAL or len(self._bound_groups) != 1:
+            return None
+        kdt = self._group_fields[0].dtype
+        if not kdt.is_integral:
+            return None
+        plan, measures = [], []
+        for f, bins in zip(self._funcs, self._bound_inputs):
+            name = type(f).__name__
+            if name == "Count":
+                if bins:
+                    plan.append(("count_expr", len(measures)))
+                    measures.append(("flag", bins[0]))
+                else:
+                    plan.append(("count_star", None))
+            elif name in ("Sum", "Average"):
+                dt = bins[0].data_type(self._child_schema)
+                if not dt.is_floating:
+                    return None
+                plan.append((name.lower(), len(measures)))
+                measures.append(("val", bins[0]))
+                measures.append(("flag", bins[0]))
+            else:
+                return None
+        return plan, measures
+
+    def _dict_groupby_batch(self, batch: ColumnarBatch):
+        """Sort-free grouped aggregation (reference: the role cuDF's hash
+        groupby plays vs its sort groupby): when the single integral
+        key's RUNTIME range fits the dictionary budget, route through
+        ops/pallas_kernels.grouped_sum_pallas — one HBM pass, no bitonic
+        sort.  Conf-gated (spark.rapids.tpu.dictGroupby.enabled,
+        default off: f32-accumulated sums carry variableFloatAgg-class
+        tolerance).  Returns the partial-layout batch or None (caller
+        falls back to the sort kernel)."""
+        from spark_rapids_tpu import config as C
+        conf = C.get_active_conf()
+        if not conf[C.DICT_GROUPBY_ENABLED] or self._dict_qual is None:
+            return None
+        if batch.capacity >= (1 << 24) or batch.capacity % 128:
+            return None  # f32 counts exact below 2^24; kernel needs
+            # lane-aligned capacities
+        if self._dict_range_misses >= 3:
+            # this exec's keys keep spanning past the budget: stop
+            # paying a probe round-trip per batch
+            return None
+
+        probe = self.kernels.get_or_build(
+            ("dict-probe", batch_signature(batch)),
+            lambda: jax.jit(self._build_dict_probe(batch.capacity)))
+        kmin, kmax = probe(batch.columns, jnp.int32(batch.num_rows))
+        kmin, kmax = int(kmin), int(kmax)
+        span = kmax - kmin + 1 if kmax >= kmin else 0
+        if span > int(conf[C.DICT_GROUPBY_MAX_GROUPS]):
+            self._dict_range_misses += 1
+            return None
+        self._dict_range_misses = 0
+        # bucket the padded range so compiles amortize across batches
+        g_pad = max(8, int(bucket_capacity(max(span, 1))))
+        prep = self.kernels.get_or_build(
+            ("dict-prep", g_pad, batch_signature(batch)),
+            lambda: jax.jit(self._build_dict_prep(batch.capacity, g_pad)))
+        slots, vals = prep(batch.columns, jnp.int32(batch.num_rows),
+                           jnp.int64(kmin))
+        from spark_rapids_tpu.ops.pallas_kernels import (_on_tpu,
+                                                         grouped_sum_pallas)
+        sums, counts = grouped_sum_pallas(
+            slots, tuple(vals), batch.num_rows, n_groups=g_pad + 1,
+            capacity=batch.capacity, interpret=not _on_tpu())
+        fin = self.kernels.get_or_build(
+            ("dict-final", g_pad),
+            lambda: jax.jit(self._build_dict_finalize(g_pad)))
+        cols, n = fin(sums, counts, jnp.int64(kmin))
+        return ColumnarBatch(self._partial_schema(), list(cols), int(n))
+
+    def _build_dict_probe(self, cap: int):
+        key_expr = self._bound_groups[0]
+
+        def probe(columns, num_rows):
+            ctx = make_eval_context(columns, cap, num_rows)
+            k = key_expr.eval(ctx)
+            ok = k.validity & ctx.row_mask
+            kd = k.data.astype(jnp.int64)
+            i64 = jnp.iinfo(jnp.int64)
+            kmin = jnp.min(jnp.where(ok, kd, i64.max))
+            kmax = jnp.max(jnp.where(ok, kd, i64.min))
+            return kmin, kmax
+        return probe
+
+    def _build_dict_prep(self, cap: int, g_pad: int):
+        key_expr = self._bound_groups[0]
+        measures = self._dict_qual[1]
+
+        def prep(columns, num_rows, kmin):
+            ctx = make_eval_context(columns, cap, num_rows)
+            k = key_expr.eval(ctx)
+            ok = k.validity & ctx.row_mask
+            slots = jnp.where(ok, k.data.astype(jnp.int64) - kmin,
+                              g_pad).astype(jnp.int32)
+            vals = []
+            for kind, e in measures:
+                v = e.eval(ctx)
+                good = v.validity & ctx.row_mask
+                if kind == "val":
+                    vals.append(jnp.where(
+                        good, v.data.astype(jnp.float32),
+                        jnp.float32(0)))
+                else:
+                    vals.append(good.astype(jnp.float32))
+            return slots, vals
+        return prep
+
+    def _build_dict_finalize(self, g_pad: int):
+        plan = self._dict_qual[0]
+        kdt = self._group_fields[0].dtype
+        out_cap = int(bucket_capacity(g_pad + 1))
+
+        def finalize(sums, counts, kmin):
+            # order: null group FIRST (multi_key_argsort places nulls
+            # first ascending), then dense ascending keys
+            order = jnp.concatenate([jnp.asarray([g_pad]),
+                                     jnp.arange(g_pad)])
+            cnt_o = jnp.take(counts, order)
+            sums_o = jnp.take(sums, order, axis=0)
+            occupied = cnt_o > 0
+            n_out = occupied.sum().astype(jnp.int32)
+            (nz,) = jnp.nonzero(occupied, size=out_cap, fill_value=0)
+            valid_out = jnp.arange(out_cap) < n_out
+            slot = jnp.take(order, nz)
+            key_data = (kmin + slot).astype(kdt.storage_dtype)
+            key_valid = valid_out & (slot != g_pad)
+            out = [ColumnVector(kdt, key_data, key_valid)]
+            cnt_nz = jnp.take(cnt_o, nz)
+            for kind, mi in plan:
+                if kind == "count_star":
+                    out.append(ColumnVector(
+                        T.INT64, cnt_nz.astype(jnp.int64), valid_out))
+                    continue
+                if kind == "count_expr":
+                    flags = jnp.take(sums_o[:, mi], nz)
+                    out.append(ColumnVector(
+                        T.INT64, jnp.round(flags).astype(jnp.int64),
+                        valid_out))
+                    continue
+                s = jnp.take(sums_o[:, mi], nz)
+                f = jnp.round(jnp.take(sums_o[:, mi + 1], nz)
+                              ).astype(jnp.int64)
+                some = (f > 0) & valid_out
+                out.append(ColumnVector(T.FLOAT64, s, some))
+                if kind == "average":
+                    out.append(ColumnVector(T.INT64, f, valid_out))
+            return out, n_out
+        return finalize
+
     # -- execution ----------------------------------------------------------
     def process_partition(self, batches) -> Iterator[ColumnarBatch]:
         if not self.group_exprs:
@@ -217,6 +379,10 @@ class HashAggregateExec(UnaryExecBase):
             if batch.num_rows == 0:
                 continue
             with self.metrics.timed(M.TOTAL_TIME):
+                fast = self._dict_groupby_batch(batch)
+                if fast is not None:
+                    partials.append(fast)
+                    continue
                 kern = self._groupby_kernel(batch, phase)
                 cols, n = kern(batch.columns, jnp.int32(batch.num_rows))
                 partials.append(

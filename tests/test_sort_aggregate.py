@@ -251,3 +251,87 @@ def test_global_sort_across_partitions():
                    LocalBatchSource.from_pandas(df, num_partitions=2)
                    ).to_pandas()
     assert out["x"].tolist() == [0, 1, 2, 3, 5, 7, 8, 9]
+
+
+# -- dictionary fast path (conf-gated sort-free group-by) -------------------
+from spark_rapids_tpu import config as C  # noqa: E402
+
+
+def _dict_conf():
+    return C.RapidsConf({
+        "spark.rapids.tpu.dictGroupby.enabled": True,
+        "spark.rapids.sql.variableFloatAgg.enabled": True})
+
+
+def test_dict_groupby_parity_with_sort_path():
+    """Same plan, conf on vs off: identical groups/counts, sums within
+    f32-accumulation tolerance; nulls in keys AND values covered."""
+    import pandas as pd
+    from spark_rapids_tpu.exprs.aggregates import Average, Count, Sum
+    from spark_rapids_tpu.plan import CpuAggregate, CpuSource, accelerate, collect
+    rng = np.random.default_rng(8)
+    n = 5000
+    df = pd.DataFrame({
+        "k": pd.array([None if i % 97 == 0 else int(rng.integers(10, 200))
+                       for i in range(n)], "Int64"),
+        "v": pd.array([None if i % 13 == 0 else float(rng.uniform(0, 50))
+                       for i in range(n)], "Float64"),
+    })
+    src = CpuSource.from_pandas(df, num_partitions=2)
+    plan = CpuAggregate([col("k")],
+                        [Sum(col("v")).alias("sv"),
+                         Count(col("v")).alias("cv"),
+                         Count(None).alias("c"),
+                         Average(col("v")).alias("av")], src)
+    base_conf = C.RapidsConf(
+        {"spark.rapids.sql.variableFloatAgg.enabled": True})
+    expected = collect(accelerate(plan, base_conf), base_conf)
+    got = collect(accelerate(plan, _dict_conf()), _dict_conf())
+    e = expected.sort_values("k", ignore_index=True, na_position="first")
+    g = got.sort_values("k", ignore_index=True, na_position="first")
+    assert len(e) == len(g)
+    np.testing.assert_array_equal(e["k"].isna(), g["k"].isna())
+    np.testing.assert_array_equal(e["c"].to_numpy(), g["c"].to_numpy())
+    np.testing.assert_array_equal(e["cv"].to_numpy(), g["cv"].to_numpy())
+    np.testing.assert_allclose(e["sv"].astype(float),
+                               g["sv"].astype(float), rtol=2e-3)
+    np.testing.assert_allclose(e["av"].astype(float),
+                               g["av"].astype(float), rtol=2e-3)
+
+
+def test_dict_groupby_falls_back_on_wide_range():
+    """Keys spanning more than maxGroups silently use the sort path."""
+    import pandas as pd
+    from spark_rapids_tpu.exprs.aggregates import Sum
+    from spark_rapids_tpu.plan import CpuAggregate, CpuSource, accelerate, collect
+    rng = np.random.default_rng(9)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 1 << 40, 800).astype(np.int64),
+        "v": rng.uniform(0, 1, 800)})
+    src = CpuSource.from_pandas(df)
+    plan = CpuAggregate([col("k")], [Sum(col("v")).alias("sv")], src)
+    got = collect(accelerate(plan, _dict_conf()), _dict_conf())
+    exp = df.groupby("k")["v"].sum()
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(
+        got.sort_values("k")["sv"].astype(float).to_numpy(),
+        exp.sort_index().to_numpy(), rtol=1e-6)
+
+
+def test_dict_groupby_falls_back_on_minmax():
+    """Min/Max aggregates (not expressible as one-hot sums) fall back."""
+    import pandas as pd
+    from spark_rapids_tpu.exprs.aggregates import Min, Sum
+    from spark_rapids_tpu.plan import CpuAggregate, CpuSource, accelerate, collect
+    rng = np.random.default_rng(10)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 50, 500).astype(np.int64),
+        "v": rng.uniform(0, 1, 500)})
+    src = CpuSource.from_pandas(df)
+    plan = CpuAggregate([col("k")], [Min(col("v")).alias("mv"),
+                                     Sum(col("v")).alias("sv")], src)
+    got = collect(accelerate(plan, _dict_conf()), _dict_conf())
+    exp = df.groupby("k").agg(mv=("v", "min"), sv=("v", "sum"))
+    np.testing.assert_allclose(
+        got.sort_values("k")["mv"].astype(float).to_numpy(),
+        exp["mv"].to_numpy(), rtol=1e-6)
