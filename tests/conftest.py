@@ -22,6 +22,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slowish: spawns subprocesses; slower than unit tier")
+    config.addinivalue_line(
+        "markers", "slow: scale-up workload tier (multi-batch + spill)")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
