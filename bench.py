@@ -178,6 +178,23 @@ def bench_q1_fused(pandas_time, batches):
     probe_bytes = sum(flat[i].nbytes for i in (2, 3, 4, 5))
     ceiling_gbps = probe_bytes / ((time.perf_counter() - t0) / 4) / 1e9
 
+    # the kernel docstring's 2060 Mrows/s claim is the EIGHT-batch
+    # stacked config; reproduce it alongside the 6-batch one by reusing
+    # two stream batches (same bytes, no extra multi-GB tunnel upload —
+    # per-cycle num_rows salts keep dispatches distinct)
+    flat8 = [jnp.concatenate([a, a[: 2 * Q1_ROWS]]) for a in flat]
+    step8 = build_q1_fused_kernel(Q1_ROWS * 8, Q1_ROWS)
+    nums8 = jnp.full((8,), Q1_ROWS, jnp.int32)
+    o8 = step8(*flat8, nums8)
+    jax.block_until_ready(o8)
+    t0 = time.perf_counter()
+    outs8 = [step8(*flat8, nums8 - (c + 1)) for c in range(FUSE_CYCLES)]
+    jax.block_until_ready(outs8)
+    np.asarray(outs8[-1])
+    t8 = (time.perf_counter() - t0) / FUSE_CYCLES
+    rows8 = 8 * Q1_ROWS / t8
+    del flat8, o8, outs8
+
     step = build_q1_fused_kernel(cap, Q1_ROWS)
 
     def fn(nums):
@@ -216,7 +233,20 @@ def bench_q1_fused(pandas_time, batches):
         "platform_ceiling_gbps": round(ceiling_gbps, 1),
         "ceiling_utilization": round(gbps / ceiling_gbps, 3),
         "nominal_hbm_utilization": round(gbps / V5E_HBM_GBPS, 3),
+        "stacked8_rows_per_sec": round(rows8, 1),
     }
+
+
+def _best_of(fn, n: int) -> float:
+    """min wall-clock of n runs — applied to BOTH engine and pandas
+    sides so the vs_baseline ratio is not at the mercy of one cold or
+    noisy measurement."""
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
 
 
 def _mk_source(dfs, schema=None):
@@ -252,20 +282,25 @@ def bench_groupby():
                      Count(col("v")).alias("c")], src)
     # 64K-row batches mean ~100 dispatches through a ~10ms tunnel —
     # dispatch-bound; the bench operating point uses big batches (the
-    # coalesce goal a real cluster would hit)
+    # coalesce goal a real cluster would hit).  The DEFAULT conf takes
+    # the planner-automatic dictGroupby fast path (fused window +
+    # Pallas one-hot grouped sum, f32 accumulation = the variableFloatAgg
+    # tolerance the conf opts into); the dict-off variant records the
+    # general sort-based path.
     conf = C.RapidsConf(
         {"spark.rapids.sql.variableFloatAgg.enabled": True,
          "spark.rapids.tpu.batchMaxRows": 1 << 22})
     plan = accelerate(cpu_plan, conf)
     got = collect(plan)  # cold + correctness (partial->exchange->final)
-    t0 = time.perf_counter()
     exp = full.groupby("k").agg(sv=("v", "sum"), sw=("w", "sum"),
                                 c=("v", "size")).reset_index()
-    pandas_time = time.perf_counter() - t0
+    pandas_time = _best_of(
+        lambda: full.groupby("k").agg(sv=("v", "sum"), sw=("w", "sum"),
+                                      c=("v", "size")).reset_index(), 3)
     got = got.sort_values("k", ignore_index=True)
     exp = exp.sort_values("k", ignore_index=True)
     assert len(got) == len(exp) and \
-        np.allclose(got["sv"].astype(float), exp["sv"], rtol=1e-5) and \
+        np.allclose(got["sv"].astype(float), exp["sv"], rtol=2e-3) and \
         (got["c"].astype(int).to_numpy() == exp["c"].to_numpy()).all()
 
     times = []
@@ -275,44 +310,50 @@ def bench_groupby():
         times.append(time.perf_counter() - t0)
     best = min(times)
 
-    # same plan with the dictionary fast path enabled (conf-gated
-    # engine path over the same exec/planner machinery)
-    dconf = C.RapidsConf(
+    # same plan with the fast path disabled: the general sort-based
+    # lane every non-dictionary-shaped aggregation takes
+    sconf = C.RapidsConf(
         {"spark.rapids.sql.variableFloatAgg.enabled": True,
          "spark.rapids.tpu.batchMaxRows": 1 << 22,
-         "spark.rapids.tpu.dictGroupby.enabled": True})
-    dplan = accelerate(cpu_plan, dconf)
-    dgot = collect(dplan, dconf)
-    dgot = dgot.sort_values("k", ignore_index=True)
-    assert len(dgot) == len(exp) and \
-        np.allclose(dgot["sv"].astype(float), exp["sv"], rtol=2e-3) and \
-        (dgot["c"].astype(int).to_numpy() == exp["c"].to_numpy()).all()
-    dtimes = []
+         "spark.rapids.tpu.dictGroupby.enabled": False})
+    splan = accelerate(cpu_plan, sconf)
+    sgot = collect(splan, sconf)
+    sgot = sgot.sort_values("k", ignore_index=True)
+    assert len(sgot) == len(exp) and \
+        np.allclose(sgot["sv"].astype(float), exp["sv"], rtol=1e-5) and \
+        (sgot["c"].astype(int).to_numpy() == exp["c"].to_numpy()).all()
+    stimes = []
     for _ in range(3):
         t0 = time.perf_counter()
-        collect(dplan, dconf)
-        dtimes.append(time.perf_counter() - t0)
-    dbest = min(dtimes)
+        collect(splan, sconf)
+        stimes.append(time.perf_counter() - t0)
+    sbest = min(stimes)
     return [{
         "metric": "groupby_sf1_rows_per_sec", "mode": "engine",
         "value": round(rows / best, 1), "unit": "rows/s",
         "vs_baseline": round(pandas_time / best, 2),
-        "note": "sort-bound: XLA:TPU sorts are bitonic; see the "
-                "dictGroupby variant below for the sort-free path",
+        "note": "DEFAULT conf: planner-automatic dictGroupby fused "
+                "window + Pallas one-hot grouped sum, zero intermediate "
+                "host syncs (lazy num_rows engine)",
     }, {
-        "metric": "groupby_sf1_dict_rows_per_sec", "mode": "engine",
-        "value": round(rows / dbest, 1), "unit": "rows/s",
-        "vs_baseline": round(pandas_time / dbest, 2),
-        "note": "same plan with spark.rapids.tpu.dictGroupby.enabled "
-                "(sort-free Pallas path inside HashAggregateExec; f32 "
-                "sums = variableFloatAgg semantics)",
+        "metric": "groupby_sf1_sort_rows_per_sec", "mode": "engine",
+        "value": round(rows / sbest, 1), "unit": "rows/s",
+        "vs_baseline": round(pandas_time / sbest, 2),
+        "note": "dictGroupby disabled: the general sort-based lane "
+                "(bitonic multi-key argsort)",
     }]
 
 
 def bench_join_sort():
-    """BASELINE milestone 3: hash join + global sort (TPC-H q3 shape)."""
+    """BASELINE milestone 3: hash join + global sort, the TPC-H q3 shape
+    faithfully: q3 ends `ORDER BY revenue DESC ... LIMIT 10`, so the
+    engine plan is join -> SortExec (full sort) -> GlobalLimit(10) and
+    only the top rows come home (the reference's benchmarked queries
+    also collect aggregated/limited outputs, never multi-GB row sets).
+    pandas runs the identical merge + full sort + head."""
     import pandas as pd
     from spark_rapids_tpu.exec.joins import HashJoinExec, JoinType
+    from spark_rapids_tpu.exec.limit import GlobalLimitExec
     from spark_rapids_tpu.exec.sort import SortExec, desc
     from spark_rapids_tpu.exprs.base import col
 
@@ -327,39 +368,70 @@ def bench_join_sort():
         "o_custkey": rng.integers(0, 99999, n_ord).astype(np.int64),
     })
     from spark_rapids_tpu import config as C
-    # sort kernels compile steeply with capacity: 1M-row batches balance
-    # compile time vs dispatch count
-    conf = C.RapidsConf({"spark.rapids.tpu.batchMaxRows": 1 << 20})
+    conf = C.RapidsConf({"spark.rapids.tpu.batchMaxRows": 1 << 22})
     lsrc, _ = _mk_source([li])
     osrc, _ = _mk_source([orders])
-    plan = SortExec(
+    plan = GlobalLimitExec(10, SortExec(
         [desc(col("l_revenue"))],
         HashJoinExec(JoinType.INNER, [col("l_orderkey")],
-                     [col("o_orderkey")], lsrc, osrc, None))
+                     [col("o_orderkey")], lsrc, osrc, None)))
     with C.session(conf):
-        out = plan.collect()
-    t0 = time.perf_counter()
-    exp = li.merge(orders, left_on="l_orderkey", right_on="o_orderkey",
-                   how="inner").sort_values("l_revenue", ascending=False)
-    pandas_time = time.perf_counter() - t0
-    assert out.num_rows == len(exp)
-    got_top = out.to_pandas()["l_revenue"].iloc[:8].astype(float).to_numpy()
-    np.testing.assert_allclose(
-        got_top, exp["l_revenue"].iloc[:8].to_numpy(), rtol=1e-6)
+        got = plan.collect().to_pandas()
 
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
+    def pandas_run():
+        return (li.merge(orders, left_on="l_orderkey",
+                         right_on="o_orderkey", how="inner")
+                .sort_values("l_revenue", ascending=False).head(10))
+    exp = pandas_run()
+    pandas_time = _best_of(pandas_run, 3)
+    assert len(got) == 10
+    np.testing.assert_allclose(
+        got["l_revenue"].astype(float).to_numpy(),
+        exp["l_revenue"].to_numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(
+        got["o_custkey"].astype(np.int64).to_numpy(),
+        exp["o_custkey"].to_numpy())
+
+    def engine_run():
+        # to_pandas forces the full async pipeline to the host — the
+        # engine is async-until-collect, so a bare collect() would only
+        # queue the work
         with C.session(conf):
-            plan.collect()
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-    return {
+            plan.collect().to_pandas()
+    best = _best_of(engine_run, 3)
+
+    # the plan Spark actually produces for ORDER BY + LIMIT is
+    # TakeOrderedAndProject; our planner lowers limit-over-sort to
+    # SortedTopNExec (top_k candidate pruning + exact candidate re-sort)
+    from spark_rapids_tpu.exec.sort import SortedTopNExec
+    tplan = SortedTopNExec(10, [desc(col("l_revenue"))],
+                           HashJoinExec(JoinType.INNER, [col("l_orderkey")],
+                                        [col("o_orderkey")], lsrc, osrc,
+                                        None))
+    with C.session(conf):
+        tgot = tplan.collect().to_pandas()
+    np.testing.assert_allclose(
+        tgot["l_revenue"].astype(float).to_numpy(),
+        exp["l_revenue"].to_numpy(), rtol=1e-6)
+
+    def topn_run():
+        with C.session(conf):
+            tplan.collect().to_pandas()
+    tbest = _best_of(topn_run, 3)
+    return [{
         "metric": "join_sort_q3_rows_per_sec", "mode": "engine",
         "value": round(n_li / best, 1), "unit": "rows/s",
         "vs_baseline": round(pandas_time / best, 2),
-        "note": "sort-bound like groupby_sf1; same next target",
-    }
+        "note": "direct-address dense join (one dispatch/probe batch) + "
+                "full bitonic sort with in-sort compaction of the "
+                "join's deferred selection + limit 10",
+    }, {
+        "metric": "join_topn_q3_rows_per_sec", "mode": "engine",
+        "value": round(n_li / tbest, 1), "unit": "rows/s",
+        "vs_baseline": round(pandas_time / tbest, 2),
+        "note": "same query through the planner's TakeOrderedAndProject "
+                "lowering (SortedTopNExec: lax.top_k candidate pruning)",
+    }]
 
 
 def bench_exchange_manager():
@@ -392,10 +464,11 @@ def bench_exchange_manager():
 
     total = run()  # cold
     assert total == rows
-    t0 = time.perf_counter()
-    parts = df.groupby(np.asarray(df["k"]) % n_parts, sort=False)
-    _ = [g for _, g in parts]
-    pandas_time = time.perf_counter() - t0
+
+    def pandas_run():
+        parts = df.groupby(np.asarray(df["k"]) % n_parts, sort=False)
+        return [g for _, g in parts]
+    pandas_time = _best_of(pandas_run, 3)
     times = []
     for _ in range(3):
         t0 = time.perf_counter()

@@ -2,13 +2,21 @@
 `GpuColumnVector`s (reference `GpuColumnVector.java:252-261` converters and
 `GpuCoalesceBatches.scala` concat).
 
-A batch is host-orchestrated: `num_rows` is a Python int (the driver of
-bucketed compilation); the device payload is a pytree of padded arrays, so a
-whole batch can be passed into one jitted kernel.
+A batch is host-orchestrated, but LAZILY so: `num_rows` may be either a
+Python int or a device scalar still being computed.  Reading `.num_rows`
+materializes (a ~150ms round trip on a tunnel-attached chip — the single
+most expensive primitive in this engine), while `.num_rows_i32` /
+`.row_mask()` / `.maybe_nonempty()` keep the pipeline asynchronous.  This
+is the TPU analog of the reference keeping everything on the CUDA stream
+until a deliberate sync (`GpuColumnVector`/stream discipline): dispatches
+are ~0.25ms, syncs are ~150ms, so the engine syncs only at host exits.
+
+Batches can also carry deferred validity `checks` (device bool scalars)
+registered by optimistic fast paths — see utils/checks.py.  Host-exit
+conversions verify them before results are trusted.
 """
 from __future__ import annotations
 
-import dataclasses
 from typing import Iterable, Optional
 
 import jax
@@ -20,16 +28,95 @@ from spark_rapids_tpu.columnar.vector import (
     ColumnVector, align_char_caps, bucket_capacity)
 
 
-@dataclasses.dataclass
-class ColumnarBatch:
-    schema: T.Schema
-    columns: list[ColumnVector]
-    num_rows: int
+def _async_copy(arr) -> None:
+    try:
+        arr.copy_to_host_async()
+    except Exception:
+        pass
 
-    def __post_init__(self):
+
+class ColumnarBatch:
+    """schema + padded device columns + (possibly lazy) row count.
+
+    A batch may be SPARSE: `sparse` is a device bool mask selecting the
+    live rows (a Velox-style selection vector).  Compaction (nonzero +
+    gather) costs ~130ms per 2M rows on TPU, so filters and joins defer
+    it: sparse-aware consumers (sort, aggregate, filter, project, join
+    probe) fold the mask into their own row masking for free; everyone
+    else calls `.dense()` to compact on demand.  For a sparse batch,
+    rows [0, num_rows) are NOT contiguous — `num_rows` is the mask
+    popcount."""
+
+    __slots__ = ("schema", "columns", "_rows", "checks", "sparse")
+
+    def __init__(self, schema: T.Schema, columns: list[ColumnVector],
+                 num_rows, checks: tuple = (), sparse=None):
+        self.schema = schema
+        self.columns = columns
+        self.sparse = sparse
+        if num_rows is None:
+            assert sparse is not None
+            num_rows = jnp.sum(sparse).astype(jnp.int32)
+        self._rows = num_rows
+        self.checks = tuple(checks)
         assert len(self.columns) == len(self.schema.fields)
         caps = {c.capacity for c in self.columns}
         assert len(caps) <= 1, f"ragged capacities {caps}"
+
+    def dense(self) -> "ColumnarBatch":
+        """Compact a sparse batch to the dense rows-first layout (the
+        expensive step deferred selection exists to avoid — only host
+        exits and position-addressed ops should need it)."""
+        if self.sparse is None:
+            return self
+        cap = self.capacity
+        n = self.num_rows_i32
+        (idx,) = jnp.nonzero(self.sparse, size=cap, fill_value=cap - 1)
+        valid = jnp.arange(cap) < n
+        cols = [c.gather(idx, valid) for c in self.columns]
+        rows = self._rows if isinstance(self._rows, int) else n
+        return ColumnarBatch(self.schema, cols, rows, self.checks)
+
+    # -- row count (lazy) ---------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        """Host row count — SYNCS if the count is still a device scalar."""
+        if not isinstance(self._rows, int):
+            self._rows = int(np.asarray(self._rows))
+        return self._rows
+
+    @num_rows.setter
+    def num_rows(self, value):
+        self._rows = value
+
+    @property
+    def num_rows_known(self) -> bool:
+        return isinstance(self._rows, int)
+
+    @property
+    def num_rows_i32(self):
+        """Row count as an int32 operand for kernels — never syncs."""
+        return jnp.asarray(self._rows, jnp.int32)
+
+    def maybe_nonempty(self) -> bool:
+        """True unless the batch is KNOWN to be empty (no sync)."""
+        return not isinstance(self._rows, int) or self._rows > 0
+
+    def prefetch(self) -> None:
+        """Start async D2H copies of the row count and all buffers so a
+        following host conversion pays ~one round trip, not one per
+        array."""
+        if not isinstance(self._rows, int):
+            _async_copy(self._rows)
+        for c in self.columns:
+            _async_copy(c.data)
+            _async_copy(c.validity)
+            if c.lengths is not None:
+                _async_copy(c.lengths)
+
+    def verify_checks(self) -> None:
+        from spark_rapids_tpu.utils import checks as CK
+        CK.verify(self.checks)
 
     @property
     def capacity(self) -> int:
@@ -46,7 +133,9 @@ class ColumnarBatch:
         return self.columns[name_or_idx]
 
     def row_mask(self) -> jnp.ndarray:
-        return jnp.arange(self.capacity) < self.num_rows
+        if self.sparse is not None:
+            return self.sparse
+        return jnp.arange(self.capacity) < self.num_rows_i32
 
     # -- construction -------------------------------------------------------
     @staticmethod
@@ -121,6 +210,10 @@ class ColumnarBatch:
     # -- host conversion ----------------------------------------------------
     def to_pandas(self):
         import pandas as pd
+        if self.sparse is not None:
+            return self.dense().to_pandas()
+        self.prefetch()
+        self.verify_checks()
         out = {}
         for f, c in zip(self.schema.fields, self.columns):
             vals, validity = c.to_numpy(self.num_rows)
@@ -139,6 +232,10 @@ class ColumnarBatch:
         return pd.DataFrame(out)
 
     def to_pylist(self) -> list[dict]:
+        if self.sparse is not None:
+            return self.dense().to_pylist()
+        self.prefetch()
+        self.verify_checks()
         cols = {f.name: c.to_pylist(self.num_rows)
                 for f, c in zip(self.schema.fields, self.columns)}
         return [{k: v[i] for k, v in cols.items()}
@@ -146,6 +243,10 @@ class ColumnarBatch:
 
     def to_arrow(self):
         import pyarrow as pa
+        if self.sparse is not None:
+            return self.dense().to_arrow()
+        self.prefetch()
+        self.verify_checks()
         arrays = []
         for f, c in zip(self.schema.fields, self.columns):
             vals, validity = c.to_numpy(self.num_rows)
@@ -166,29 +267,72 @@ class ColumnarBatch:
         names = list(names)
         cols = [self.column(n) for n in names]
         fields = tuple(self.schema.field(n) for n in names)
-        return ColumnarBatch(T.Schema(fields), cols, self.num_rows)
+        return ColumnarBatch(T.Schema(fields), cols, self._rows,
+                             self.checks, self.sparse)
 
     def with_capacity(self, capacity: int) -> "ColumnarBatch":
         if capacity == self.capacity:
             return self
+        if self.sparse is not None:
+            return self.dense().with_capacity(capacity)
+        rows = (min(self._rows, capacity) if self.num_rows_known
+                else jnp.minimum(self._rows, capacity))
         return ColumnarBatch(
             self.schema, [c.with_capacity(capacity) for c in self.columns],
-            min(self.num_rows, capacity))
+            rows, self.checks)
 
     def gather(self, indices: jnp.ndarray, index_valid: jnp.ndarray,
-               new_num_rows: int) -> "ColumnarBatch":
+               new_num_rows) -> "ColumnarBatch":
+        assert self.sparse is None, "gather() addresses dense rows"
         cols = [c.gather(indices, index_valid) for c in self.columns]
-        return ColumnarBatch(self.schema, cols, new_num_rows)
+        return ColumnarBatch(self.schema, cols, new_num_rows, self.checks)
 
     def slice(self, start: int, length: int) -> "ColumnarBatch":
         """Host-side row slice (reference SlicedGpuColumnVector)."""
+        if self.sparse is not None:
+            return self.dense().slice(start, length)
         length = max(0, min(length, self.num_rows - start))
         cap = bucket_capacity(length)
         idx = jnp.arange(cap) + start
         valid = jnp.arange(cap) < length
         cols = [c.gather(jnp.where(valid, idx, 0), valid)
                 for c in self.columns]
-        return ColumnarBatch(self.schema, cols, length)
+        return ColumnarBatch(self.schema, cols, length, self.checks)
+
+    def take_head(self, n: int) -> "ColumnarBatch":
+        """First min(n, num_rows) rows at a STATIC bucket(n) capacity,
+        without syncing on the row count (limit/top-N building block)."""
+        if self.sparse is not None:
+            return self.dense().take_head(n)
+        cap = bucket_capacity(n)
+        if cap >= self.capacity:
+            rows = (min(self._rows, n) if self.num_rows_known
+                    else jnp.minimum(self.num_rows_i32, n))
+            return ColumnarBatch(self.schema, self.columns, rows,
+                                 self.checks)
+        idx = jnp.arange(cap)
+        count = jnp.minimum(self.num_rows_i32, n)
+        valid = idx < count
+        cols = [c.gather(idx, valid) for c in self.columns]
+        rows = min(self._rows, n) if self.num_rows_known else count
+        return ColumnarBatch(self.schema, cols, rows, self.checks)
+
+    def slice_lazy(self, start, length) -> "ColumnarBatch":
+        """Device-side row slice: `start`/`length` may be device scalars.
+        Output capacity stays the full batch capacity (it cannot be
+        bucketed without knowing `length`), so this suits small batches
+        and sync-free pipelines; use `slice` when the count is known."""
+        if self.sparse is not None:
+            return self.dense().slice_lazy(start, length)
+        cap = self.capacity
+        idx = jnp.arange(cap) + jnp.asarray(start, jnp.int32)
+        valid = jnp.arange(cap) < jnp.asarray(length, jnp.int32)
+        cols = [c.gather(jnp.where(valid, idx, 0), valid)
+                for c in self.columns]
+        return ColumnarBatch(self.schema, cols,
+                             length if isinstance(length, int)
+                             else jnp.asarray(length, jnp.int32),
+                             self.checks)
 
     def device_size_bytes(self) -> int:
         total = 0
@@ -220,13 +364,45 @@ def empty_batch(schema: T.Schema) -> ColumnarBatch:
 def concat_batches(batches: list[ColumnarBatch]) -> ColumnarBatch:
     """Device-side concat (reference `Table.concatenate`,
     `GpuCoalesceBatches.scala:53`): stack padded columns then gather the
-    valid rows of each input into a fresh bucketed batch."""
+    valid rows of each input into a fresh bucketed batch.
+
+    When any input's row count is still a device scalar, the gather
+    indices are computed DEVICE-SIDE (no sync): output capacity is then
+    the bucketed sum of input CAPACITIES (the static worst case) and the
+    output row count stays lazy."""
     assert batches
     if len(batches) == 1:
         return batches[0]
+    batches = [b.dense() for b in batches]
     schema = batches[0].schema
+    checks = tuple(c for b in batches for c in b.checks)
+    lazy = not all(b.num_rows_known for b in batches)
+    if lazy:
+        return _concat_lazy(batches, schema, checks)
     total = sum(b.num_rows for b in batches)
     cap = bucket_capacity(total)
+    out_cols = _stack_columns(batches, schema)
+    # gather indices: for each batch, rows [0, num_rows) at its offset
+    idx_parts, off = [], 0
+    for b in batches:
+        idx_parts.append(np.arange(b.num_rows) + off)
+        off += b.capacity
+    idx = np.concatenate(idx_parts) if idx_parts else np.zeros(0, np.int64)
+    idx = np.pad(idx, (0, cap - len(idx)))
+    jidx = jnp.asarray(idx)
+    valid = jnp.arange(cap) < total
+    cols = []
+    for (data, validity, lengths, narrow), f in zip(out_cols, schema.fields):
+        cols.append(ColumnVector(
+            f.dtype,
+            jnp.take(data, jidx, axis=0, mode="clip"),
+            jnp.take(validity, jidx, mode="clip") & valid,
+            None if lengths is None else jnp.take(lengths, jidx, mode="clip"),
+            None if narrow is None else jnp.take(narrow, jidx, mode="clip")))
+    return ColumnarBatch(schema, cols, total, checks)
+
+
+def _stack_columns(batches, schema):
     out_cols = []
     for ci, f in enumerate(schema.fields):
         vecs = [b.columns[ci] for b in batches]
@@ -238,22 +414,37 @@ def concat_batches(batches: list[ColumnarBatch]) -> ColumnarBatch:
         validity = jnp.concatenate([v.validity for v in vecs])
         lengths = (jnp.concatenate([v.lengths for v in vecs])
                    if vecs[0].lengths is not None else None)
-        # build gather indices mapping output row -> stacked row
-        out_cols.append((data, validity, lengths))
-    # gather indices: for each batch, rows [0, num_rows) at its offset
-    idx_parts, off = [], 0
-    for b in batches:
-        idx_parts.append(np.arange(b.num_rows) + off)
-        off += b.capacity
-    idx = np.concatenate(idx_parts) if idx_parts else np.zeros(0, np.int64)
-    idx = np.pad(idx, (0, cap - len(idx)))
-    jidx = jnp.asarray(idx)
-    valid = jnp.arange(cap) < total
+        narrow = (jnp.concatenate([v.narrow for v in vecs])
+                  if all(v.narrow is not None for v in vecs) else None)
+        out_cols.append((data, validity, lengths, narrow))
+    return out_cols
+
+
+def _concat_lazy(batches, schema, checks):
+    """Sync-free concat: output row i maps to input batch
+    j = #(cumulative counts <= i) at local row i - start_j; all index
+    math runs on device against the (small) per-batch count vector."""
+    ns = jnp.stack([b.num_rows_i32 for b in batches])
+    cum = jnp.cumsum(ns)
+    starts = cum - ns
+    total = cum[-1]
+    cap_offsets = np.concatenate(
+        [[0], np.cumsum([b.capacity for b in batches])[:-1]])
+    cap = bucket_capacity(int(sum(b.capacity for b in batches)))
+    out_cols = _stack_columns(batches, schema)
+    i = jnp.arange(cap, dtype=jnp.int32)
+    bid = (i[:, None] >= cum[None, :]).sum(axis=1)  # cap x B compares
+    bid_c = jnp.minimum(bid, len(batches) - 1)
+    local = i - jnp.take(starts, bid_c)
+    jidx = jnp.take(jnp.asarray(cap_offsets, jnp.int32), bid_c) + local
+    valid = i < total
+    jidx = jnp.where(valid, jidx, 0)
     cols = []
-    for (data, validity, lengths), f in zip(out_cols, schema.fields):
+    for (data, validity, lengths, narrow), f in zip(out_cols, schema.fields):
         cols.append(ColumnVector(
             f.dtype,
             jnp.take(data, jidx, axis=0, mode="clip"),
             jnp.take(validity, jidx, mode="clip") & valid,
-            None if lengths is None else jnp.take(lengths, jidx, mode="clip")))
-    return ColumnarBatch(schema, cols, total)
+            None if lengths is None else jnp.take(lengths, jidx, mode="clip"),
+            None if narrow is None else jnp.take(narrow, jidx, mode="clip")))
+    return ColumnarBatch(schema, cols, total, checks)

@@ -36,6 +36,9 @@ def _unpack_bits(data: bytes, n: int) -> np.ndarray:
 
 
 def serialize_batch(batch: ColumnarBatch) -> bytes:
+    batch = batch.dense()
+    batch.prefetch()
+    batch.verify_checks()
     n = batch.num_rows
     fields_meta = []
     payloads = []

@@ -58,21 +58,30 @@ class ColumnVector:
 
     For STRING columns `data` is uint8[capacity, char_cap] and `lengths`
     int32[capacity]; otherwise `lengths` is None.
+
+    `narrow` is an optional 32-BIT SHADOW of `data`: 64-bit elementwise
+    ops are ~50-100x slower than 32-bit on TPU (no native 64-bit; XLA
+    emulates), so sources upload an i32 copy of INT64 columns whose
+    values fit int32 (EXACT — verified host-side) and an f32 copy of
+    FLOAT64 columns (LOSSY — only used by paths that already carry
+    variableFloatAgg-class tolerance).  Kernels check for it at trace
+    time (it is part of the batch signature).
     """
     dtype: T.DataType
     data: jnp.ndarray
     validity: jnp.ndarray
     lengths: Optional[jnp.ndarray] = None
+    narrow: Optional[jnp.ndarray] = None
 
     # -- pytree protocol so vectors flow through jit/shard_map --------------
     def tree_flatten(self):
-        children = (self.data, self.validity, self.lengths)
+        children = (self.data, self.validity, self.lengths, self.narrow)
         return children, self.dtype
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        data, validity, lengths = children
-        return cls(aux, data, validity, lengths)
+        data, validity, lengths, narrow = children
+        return cls(aux, data, validity, lengths, narrow)
 
     # -----------------------------------------------------------------------
     @property
@@ -118,7 +127,15 @@ class ColumnVector:
         else:
             safe = np.asarray(values).astype(storage, copy=False)
         safe = _pad_to(safe, cap)
-        return ColumnVector(dtype, jnp.asarray(safe), jnp.asarray(validity))
+        narrow = None
+        if dtype.id == T.TypeId.INT64 and len(safe):
+            lo, hi = safe.min(), safe.max()
+            if np.iinfo(np.int32).min <= lo and hi <= np.iinfo(np.int32).max:
+                narrow = jnp.asarray(safe.astype(np.int32))
+        elif dtype.id == T.TypeId.FLOAT64:
+            narrow = jnp.asarray(safe.astype(np.float32))
+        return ColumnVector(dtype, jnp.asarray(safe), jnp.asarray(validity),
+                            None, narrow)
 
     @staticmethod
     def from_scalar(value: Any, dtype: T.DataType, capacity: int,
@@ -175,6 +192,8 @@ class ColumnVector:
             data = self.data[:capacity]
             validity = self.validity[:capacity]
             lengths = None if self.lengths is None else self.lengths[:capacity]
+            narrow = (None if self.narrow is None
+                      else self.narrow[:capacity])
         else:
             extra = capacity - self.capacity
             data = jnp.concatenate(
@@ -185,7 +204,9 @@ class ColumnVector:
             lengths = (None if self.lengths is None else
                        jnp.concatenate([self.lengths,
                                         jnp.zeros(extra, jnp.int32)]))
-        return ColumnVector(self.dtype, data, validity, lengths)
+            narrow = (None if self.narrow is None else jnp.concatenate(
+                [self.narrow, jnp.zeros(extra, self.narrow.dtype)]))
+        return ColumnVector(self.dtype, data, validity, lengths, narrow)
 
     def gather(self, indices: jnp.ndarray,
                index_valid: Optional[jnp.ndarray] = None) -> "ColumnVector":
@@ -197,7 +218,9 @@ class ColumnVector:
             validity = validity & index_valid
         lengths = (None if self.lengths is None
                    else jnp.take(self.lengths, indices, mode="clip"))
-        return ColumnVector(self.dtype, data, validity, lengths)
+        narrow = (None if self.narrow is None
+                  else jnp.take(self.narrow, indices, mode="clip"))
+        return ColumnVector(self.dtype, data, validity, lengths, narrow)
 
 
 def _strings_from_host(values: np.ndarray, validity_padded: np.ndarray,

@@ -208,16 +208,32 @@ PALLAS_Q1_ENABLED = conf(
     "v5e), so it stays the single-batch default; see q1Fused for the "
     "mode where Pallas wins 3x.")
 DICT_GROUPBY_ENABLED = conf(
-    "spark.rapids.tpu.dictGroupby.enabled", False,
-    "Sort-free grouped aggregation via the Pallas one-hot kernel when a "
-    "single integral group key's runtime range fits dictGroupby.maxGroups "
-    "(Sum/Count/Average over floats). Sums accumulate in f32 "
-    "(variableFloatAgg-class tolerance), so this ships default-off; "
-    "measured ~230x the sort-based path on the milestone-2 shape.")
+    "spark.rapids.tpu.dictGroupby.enabled", True,
+    "Planner-automatic sort-free grouped aggregation via the fused "
+    "Pallas one-hot kernel when a single integral group key's runtime "
+    "range fits dictGroupby.maxGroups (Sum/Count/Average over floats, "
+    "Count over anything). The whole batch runs as ONE dispatch (window "
+    "slots + grouped sum + finalize); a first-batch probe sizes the "
+    "dictionary and per-batch overflow counts trigger fallback to the "
+    "sort path. Float Sum/Average additionally require "
+    "variableFloatAgg.enabled: sums accumulate in f32, a "
+    "variableFloatAgg-class tolerance. Count-only plans are exact.")
 DICT_GROUPBY_MAX_GROUPS = conf(
     "spark.rapids.tpu.dictGroupby.maxGroups", 4096,
     "Max runtime key range for the dictionary group-by fast path (the "
     "one-hot table must fit VMEM).")
+DENSE_JOIN_ENABLED = conf(
+    "spark.rapids.tpu.denseJoin.enabled", True,
+    "Direct-address equi-join fast path: when a single integral build "
+    "key's runtime span fits denseJoin.maxSpan and the keys are unique "
+    "(PK-FK joins on dense surrogate keys), the build side becomes a "
+    "dense slot table and each probe batch is ONE dispatch of two fused "
+    "gathers — no concat, no sort.  Falls back to the sort-merge kernel "
+    "otherwise.")
+DENSE_JOIN_MAX_SPAN = conf(
+    "spark.rapids.tpu.denseJoin.maxSpan", 1 << 22,
+    "Max build-key span for the direct-address join table (table memory "
+    "is 8 bytes per slot).")
 PALLAS_Q1_FUSED_ENABLED = conf(
     "spark.rapids.tpu.pallas.q1Fused.enabled", True,
     "Use the Pallas single-HBM-pass kernel for STACKED multi-batch Q1 "

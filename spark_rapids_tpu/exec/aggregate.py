@@ -108,6 +108,9 @@ class HashAggregateExec(UnaryExecBase):
         # once (None = never applicable for this exec)
         self._dict_qual = self._dict_plan()
         self._dict_range_misses = 0
+        # padded dictionary width, sized from a one-time first-batch
+        # range probe (None until probed)
+        self._dict_gpad: Optional[int] = None
 
     def output_schema(self) -> T.Schema:
         return self._schema
@@ -137,8 +140,8 @@ class HashAggregateExec(UnaryExecBase):
             funcs = self._funcs
 
             @jax.jit
-            def kernel(columns, num_rows):
-                ctx = make_eval_context(columns, cap, num_rows)
+            def kernel(columns, num_rows, mask=None):
+                ctx = make_eval_context(columns, cap, num_rows, mask)
                 keys = [e.eval(ctx) for e in bound_groups]
                 perm = multi_key_argsort(
                     [(k, True, True) for k in keys], ctx.row_mask)
@@ -219,6 +222,7 @@ class HashAggregateExec(UnaryExecBase):
         if not kdt.is_integral:
             return None
         plan, measures = [], []
+        self._dict_float = False
         for f, bins in zip(self._funcs, self._bound_inputs):
             name = type(f).__name__
             if name == "Count":
@@ -231,6 +235,7 @@ class HashAggregateExec(UnaryExecBase):
                 dt = bins[0].data_type(self._child_schema)
                 if not dt.is_floating:
                     return None
+                self._dict_float = True
                 plan.append((name.lower(), len(measures)))
                 measures.append(("val", bins[0]))
                 measures.append(("flag", bins[0]))
@@ -240,58 +245,235 @@ class HashAggregateExec(UnaryExecBase):
 
     def _dict_groupby_batch(self, batch: ColumnarBatch):
         """Sort-free grouped aggregation (reference: the role cuDF's hash
-        groupby plays vs its sort groupby): when the single integral
-        key's RUNTIME range fits the dictionary budget, route through
-        ops/pallas_kernels.grouped_sum_pallas — one HBM pass, no bitonic
-        sort.  Conf-gated (spark.rapids.tpu.dictGroupby.enabled,
-        default off: f32-accumulated sums carry variableFloatAgg-class
-        tolerance).  Returns the partial-layout batch or None (caller
-        falls back to the sort kernel)."""
+        groupby plays under `aggregate.scala:312` vs the sort-based
+        fallback): when the single integral key's RUNTIME range fits the
+        dictionary budget, the whole batch goes through ONE fused
+        dispatch — key-window slots, Pallas one-hot grouped-sum
+        (ops/pallas_kernels.grouped_sum_pallas), and the partial-batch
+        finalize, all inside one jit.  A one-time first-batch probe
+        sizes the padded dictionary; later batches compute their own
+        window base (kmin) device-side and report overflow instead of
+        paying a probe round-trip, so the steady state is one dispatch
+        plus one tiny readback per batch.
+
+        Planner-automatic: default-on (spark.rapids.tpu.dictGroupby
+        .enabled) with float Sum/Average additionally gated on
+        variableFloatAgg.enabled — the kernel accumulates f32, a
+        variableFloatAgg-class tolerance (ADVICE r2).  Count-only plans
+        are exact and need no float gate.  Returns the partial-layout
+        batch or None (caller falls back to the sort kernel)."""
         from spark_rapids_tpu import config as C
         conf = C.get_active_conf()
         if not conf[C.DICT_GROUPBY_ENABLED] or self._dict_qual is None:
+            return None
+        if self._dict_float and not conf[C.VARIABLE_FLOAT_AGG]:
             return None
         if batch.capacity >= (1 << 24) or batch.capacity % 128:
             return None  # f32 counts exact below 2^24; kernel needs
             # lane-aligned capacities
         if self._dict_range_misses >= 3:
             # this exec's keys keep spanning past the budget: stop
-            # paying a probe round-trip per batch
+            # trying (and stop paying discarded fast dispatches)
             return None
 
-        probe = self.kernels.get_or_build(
-            ("dict-probe", batch_signature(batch)),
-            lambda: jax.jit(self._build_dict_probe(batch.capacity)))
-        kmin, kmax = probe(batch.columns, jnp.int32(batch.num_rows))
-        kmin, kmax = int(kmin), int(kmax)
-        span = kmax - kmin + 1 if kmax >= kmin else 0
-        if span > int(conf[C.DICT_GROUPBY_MAX_GROUPS]):
-            self._dict_range_misses += 1
-            return None
-        self._dict_range_misses = 0
-        # bucket the padded range so compiles amortize across batches
-        g_pad = max(8, int(bucket_capacity(max(span, 1))))
-        prep = self.kernels.get_or_build(
-            ("dict-prep", g_pad, batch_signature(batch)),
-            lambda: jax.jit(self._build_dict_prep(batch.capacity, g_pad)))
-        slots, vals = prep(batch.columns, jnp.int32(batch.num_rows),
-                           jnp.int64(kmin))
+        if self._dict_gpad is None:
+            probe = self.kernels.get_or_build(
+                ("dict-probe", batch_signature(batch)),
+                lambda: jax.jit(self._build_dict_probe(batch.capacity)))
+            if batch.sparse is not None:
+                kmin, kmax = probe(batch.columns, batch.num_rows_i32,
+                                   batch.sparse)
+            else:
+                kmin, kmax = probe(batch.columns, batch.num_rows_i32)
+            kmin, kmax = int(kmin), int(kmax)
+            span = kmax - kmin + 1 if kmax >= kmin else 0
+            if span > int(conf[C.DICT_GROUPBY_MAX_GROUPS]):
+                self._dict_range_misses += 1
+                return None
+            # bucket the padded width so compiles amortize across batches
+            self._dict_gpad = max(8, int(bucket_capacity(max(span, 1))))
+        g_pad = self._dict_gpad
+
+        fused = self.kernels.get_or_build(
+            ("dict-fused", g_pad, batch_signature(batch)),
+            lambda: jax.jit(self._build_dict_fused(batch.capacity, g_pad)))
+        if batch.sparse is not None:
+            cols, n, excess = fused(batch.columns, batch.num_rows_i32,
+                                    batch.sparse)
+        else:
+            cols, n, excess = fused(batch.columns, batch.num_rows_i32)
+        from spark_rapids_tpu.utils import checks as CK
+        check = CK.register(CK.BatchCheck(
+            excess, f"dictGroupby[exec {self.exec_id}]",
+            self._disable_dict_path))
+        return ColumnarBatch(self._partial_schema(), list(cols), n,
+                             batch.checks + (check,))
+
+    def _disable_dict_path(self) -> None:
+        self._dict_range_misses = 1 << 20
+
+    #: static budget of per-batch overflow rows the fused kernel carries
+    #: INLINE as singleton partial groups (exact — partial aggregation
+    #: may emit duplicate keys; the final merge combines them).  Only
+    #: when a batch overflows past this does the deferred excess check
+    #: fire and deopt the query.
+    DICT_OVERFLOW_BUDGET = 1024
+
+    def _build_dict_fused(self, cap: int, g_pad: int):
+        """Sync-free fused dict kernel: ONE dispatch computes the key
+        window (anchored at this batch's own device-side kmin), the
+        Pallas one-hot grouped sum, the compacted partial batch, AND
+        folds out-of-window rows in as inline singleton partial groups.
+        Slot layout: [0, g_pad) dense key window, g_pad = null group,
+        g_pad+1 = masked (overflow + padding).  Returns
+        (columns, num_rows, excess_flag) — all device; nothing syncs."""
         from spark_rapids_tpu.ops.pallas_kernels import (_on_tpu,
                                                          grouped_sum_pallas)
-        sums, counts = grouped_sum_pallas(
-            slots, tuple(vals), batch.num_rows, n_groups=g_pad + 1,
-            capacity=batch.capacity, interpret=not _on_tpu())
-        fin = self.kernels.get_or_build(
-            ("dict-final", g_pad),
-            lambda: jax.jit(self._build_dict_finalize(g_pad)))
-        cols, n = fin(sums, counts, jnp.int64(kmin))
-        return ColumnarBatch(self._partial_schema(), list(cols), int(n))
+        key_expr = self._bound_groups[0]
+        plan, measures = self._dict_qual
+        kdt = self._group_fields[0].dtype
+        ovf_budget = min(self.DICT_OVERFLOW_BUDGET, cap)
+        w_cap = g_pad + 1
+        out_cap = int(bucket_capacity(w_cap + ovf_budget))
+        interp = not _on_tpu()
+
+        def fused(columns, num_rows, mask=None):
+            ctx = make_eval_context(columns, cap, num_rows, mask)
+            k = key_expr.eval(ctx)
+            ok = k.validity & ctx.row_mask
+            if k.narrow is not None:
+                # 32-bit fast lane: 64-bit elementwise ops are ~50-100x
+                # slower on TPU (emulated).  The unsigned-difference
+                # trick keeps the window test EXACT even if kd-kmin
+                # overflows int32: both fit i32, so the true offset
+                # fits u32.
+                k32 = k.narrow
+                kmin32 = jnp.min(jnp.where(ok, k32,
+                                           jnp.iinfo(jnp.int32).max))
+                offu = (k32 - kmin32).astype(jnp.uint32)
+                in_win = ok & (offu < jnp.uint32(g_pad))
+                off = offu.astype(jnp.int32)
+                kmin = kmin32.astype(jnp.int64)
+            else:
+                kd64 = k.data.astype(jnp.int64)
+                i64 = jnp.iinfo(jnp.int64)
+                kmin = jnp.min(jnp.where(ok, kd64, i64.max))
+                off = kd64 - kmin
+                in_win = ok & (off >= 0) & (off < g_pad)
+            slots = jnp.where(
+                in_win, off,
+                jnp.where(ctx.row_mask & ~k.validity, g_pad,
+                          g_pad + 1)).astype(jnp.int32)
+            ovf_mask = ok & ~in_win
+            ovf_cnt = ovf_mask.sum().astype(jnp.int32)
+            vals = []
+            raw = []  # (f64 value, valid) per measure for overflow rows
+            for kind, e in measures:
+                v = e.eval(ctx)
+                good = v.validity & ctx.row_mask
+                if kind == "val":
+                    v32 = (v.narrow if v.narrow is not None
+                           else v.data.astype(jnp.float32))
+                    vals.append(jnp.where(good, v32, jnp.float32(0)))
+                    # raw values stay UN-masked and UN-cast here: full-
+                    # width f64 selects/casts are slow emulated ops;
+                    # mask+cast happen after the (tiny) overflow gather
+                    raw.append((v.data, good))
+                else:
+                    vals.append(good.astype(jnp.float32))
+                    raw.append((good, good))
+            # row masking rides the SLOT sentinel (padding/filtered rows
+            # -> g_pad+1, never counted), so the kernel's prefix bound is
+            # the full capacity — mandatory for SPARSE inputs, whose live
+            # rows are scattered past the popcount
+            sums, counts = grouped_sum_pallas(
+                slots, tuple(vals), jnp.int32(cap), n_groups=g_pad + 1,
+                capacity=cap, interpret=interp)
+
+            # window-group compaction: null group FIRST, then dense keys
+            order = jnp.concatenate([jnp.asarray([g_pad]),
+                                     jnp.arange(g_pad)])
+            cnt_o = jnp.take(counts, order)
+            sums_o = jnp.take(sums, order, axis=0)
+            occupied = cnt_o > 0
+            n_win = occupied.sum().astype(jnp.int32)
+            (nz,) = jnp.nonzero(occupied, size=w_cap, fill_value=0)
+            slot_w = jnp.take(order, nz)
+            cnt_w = jnp.take(cnt_o, nz)
+            # overflow rows, compacted (first ovf_budget of them).  The
+            # compaction (a top_k over the full capacity, ~67ms at 2M) is
+            # gated behind lax.cond: the common case — zero overflow —
+            # pays only the (fused) mask/count it needed anyway.
+            def _compact_ovf():
+                iota = jnp.arange(cap, dtype=jnp.int32)
+                keyv = jnp.where(ovf_mask, iota,
+                                 jnp.iinfo(jnp.int32).max)
+                neg, _ = jax.lax.top_k(-keyv, ovf_budget)
+                return jnp.clip(-neg, 0, cap - 1)
+
+            oidx = jax.lax.cond(
+                ovf_cnt > 0, _compact_ovf,
+                lambda: jnp.full(ovf_budget, cap - 1, jnp.int32))
+            n_out = n_win + jnp.minimum(ovf_cnt, ovf_budget)
+            excess = ovf_cnt > ovf_budget
+
+            i = jnp.arange(out_cap)
+            valid_out = i < n_out
+            from_win = i < n_win
+            wi = jnp.clip(i, 0, w_cap - 1)
+            oi = jnp.take(oidx, jnp.clip(i - n_win, 0, ovf_budget - 1))
+
+            key_data = jnp.where(
+                from_win,
+                jnp.take((kmin + slot_w).astype(kdt.storage_dtype), wi),
+                jnp.take(k.data, oi).astype(kdt.storage_dtype))
+            key_valid = jnp.where(from_win,
+                                  jnp.take(slot_w != g_pad, wi),
+                                  jnp.take(k.validity, oi)) & valid_out
+            out = [ColumnVector(kdt, key_data, key_valid)]
+            cnt_mixed = jnp.where(from_win,
+                                  jnp.take(cnt_w.astype(jnp.int64), wi),
+                                  jnp.int64(1))
+            for kind, mi in plan:
+                if kind == "count_star":
+                    out.append(ColumnVector(T.INT64, cnt_mixed, valid_out))
+                    continue
+                if kind == "count_expr":
+                    win_c = jnp.round(jnp.take(sums_o[:, mi], nz)
+                                      ).astype(jnp.int64)
+                    _, good_o = raw[mi]
+                    ovf_c = jnp.take(good_o, oi).astype(jnp.int64)
+                    out.append(ColumnVector(
+                        T.INT64, jnp.where(from_win, jnp.take(win_c, wi),
+                                           ovf_c), valid_out))
+                    continue
+                s_w = jnp.take(sums_o[:, mi], nz)
+                f_w = jnp.round(jnp.take(sums_o[:, mi + 1], nz)
+                                ).astype(jnp.int64)
+                val_o, good_o = raw[mi]
+                some = jnp.where(from_win, jnp.take(f_w > 0, wi),
+                                 jnp.take(good_o, oi)) & valid_out
+                # mask AFTER the tiny gather: invalid cells read as 0, not
+                # garbage (downstream merges may touch masked data)
+                s = jnp.where(
+                    some,
+                    jnp.where(from_win, jnp.take(s_w, wi),
+                              jnp.take(val_o, oi).astype(jnp.float64)),
+                    jnp.float64(0))
+                out.append(ColumnVector(T.FLOAT64, s, some))
+                if kind == "average":
+                    cnt_col = jnp.where(
+                        from_win, jnp.take(f_w, wi),
+                        jnp.take(good_o, oi).astype(jnp.int64))
+                    out.append(ColumnVector(T.INT64, cnt_col, valid_out))
+            return out, n_out, excess
+        return fused
 
     def _build_dict_probe(self, cap: int):
         key_expr = self._bound_groups[0]
 
-        def probe(columns, num_rows):
-            ctx = make_eval_context(columns, cap, num_rows)
+        def probe(columns, num_rows, mask=None):
+            ctx = make_eval_context(columns, cap, num_rows, mask)
             k = key_expr.eval(ctx)
             ok = k.validity & ctx.row_mask
             kd = k.data.astype(jnp.int64)
@@ -300,71 +482,6 @@ class HashAggregateExec(UnaryExecBase):
             kmax = jnp.max(jnp.where(ok, kd, i64.min))
             return kmin, kmax
         return probe
-
-    def _build_dict_prep(self, cap: int, g_pad: int):
-        key_expr = self._bound_groups[0]
-        measures = self._dict_qual[1]
-
-        def prep(columns, num_rows, kmin):
-            ctx = make_eval_context(columns, cap, num_rows)
-            k = key_expr.eval(ctx)
-            ok = k.validity & ctx.row_mask
-            slots = jnp.where(ok, k.data.astype(jnp.int64) - kmin,
-                              g_pad).astype(jnp.int32)
-            vals = []
-            for kind, e in measures:
-                v = e.eval(ctx)
-                good = v.validity & ctx.row_mask
-                if kind == "val":
-                    vals.append(jnp.where(
-                        good, v.data.astype(jnp.float32),
-                        jnp.float32(0)))
-                else:
-                    vals.append(good.astype(jnp.float32))
-            return slots, vals
-        return prep
-
-    def _build_dict_finalize(self, g_pad: int):
-        plan = self._dict_qual[0]
-        kdt = self._group_fields[0].dtype
-        out_cap = int(bucket_capacity(g_pad + 1))
-
-        def finalize(sums, counts, kmin):
-            # order: null group FIRST (multi_key_argsort places nulls
-            # first ascending), then dense ascending keys
-            order = jnp.concatenate([jnp.asarray([g_pad]),
-                                     jnp.arange(g_pad)])
-            cnt_o = jnp.take(counts, order)
-            sums_o = jnp.take(sums, order, axis=0)
-            occupied = cnt_o > 0
-            n_out = occupied.sum().astype(jnp.int32)
-            (nz,) = jnp.nonzero(occupied, size=out_cap, fill_value=0)
-            valid_out = jnp.arange(out_cap) < n_out
-            slot = jnp.take(order, nz)
-            key_data = (kmin + slot).astype(kdt.storage_dtype)
-            key_valid = valid_out & (slot != g_pad)
-            out = [ColumnVector(kdt, key_data, key_valid)]
-            cnt_nz = jnp.take(cnt_o, nz)
-            for kind, mi in plan:
-                if kind == "count_star":
-                    out.append(ColumnVector(
-                        T.INT64, cnt_nz.astype(jnp.int64), valid_out))
-                    continue
-                if kind == "count_expr":
-                    flags = jnp.take(sums_o[:, mi], nz)
-                    out.append(ColumnVector(
-                        T.INT64, jnp.round(flags).astype(jnp.int64),
-                        valid_out))
-                    continue
-                s = jnp.take(sums_o[:, mi], nz)
-                f = jnp.round(jnp.take(sums_o[:, mi + 1], nz)
-                              ).astype(jnp.int64)
-                some = (f > 0) & valid_out
-                out.append(ColumnVector(T.FLOAT64, s, some))
-                if kind == "average":
-                    out.append(ColumnVector(T.INT64, f, valid_out))
-            return out, n_out
-        return finalize
 
     # -- execution ----------------------------------------------------------
     def process_partition(self, batches) -> Iterator[ColumnarBatch]:
@@ -376,7 +493,7 @@ class HashAggregateExec(UnaryExecBase):
         inter_fields = self._partial_schema()
         partials: list[ColumnarBatch] = []
         for batch in batches:
-            if batch.num_rows == 0:
+            if not batch.maybe_nonempty():
                 continue
             with self.metrics.timed(M.TOTAL_TIME):
                 fast = self._dict_groupby_batch(batch)
@@ -384,9 +501,14 @@ class HashAggregateExec(UnaryExecBase):
                     partials.append(fast)
                     continue
                 kern = self._groupby_kernel(batch, phase)
-                cols, n = kern(batch.columns, jnp.int32(batch.num_rows))
+                if batch.sparse is not None:
+                    cols, n = kern(batch.columns, batch.num_rows_i32,
+                                   batch.sparse)
+                else:
+                    cols, n = kern(batch.columns, batch.num_rows_i32)
                 partials.append(
-                    ColumnarBatch(inter_fields, list(cols), int(n)))
+                    ColumnarBatch(inter_fields, list(cols), n,
+                                  batch.checks))
 
         if not partials:
             return
@@ -399,10 +521,11 @@ class HashAggregateExec(UnaryExecBase):
         else:
             with self.metrics.timed(M.TOTAL_TIME):
                 kern = self._evaluate_kernel(merged)
-                cols = kern(merged.columns, jnp.int32(merged.num_rows))
+                cols = kern(merged.columns, merged.num_rows_i32)
                 out = ColumnarBatch(self._schema, list(cols),
-                                    merged.num_rows)
-        out = out.with_capacity(bucket_capacity(out.num_rows))
+                                    merged._rows, merged.checks)
+        if out.num_rows_known:
+            out = out.with_capacity(bucket_capacity(out.num_rows))
         self.update_output_metrics(out)
         yield out
 
@@ -425,8 +548,8 @@ class HashAggregateExec(UnaryExecBase):
         merge_exec = self._get_merge_exec(inter_schema)
         with self.metrics.timed(M.TOTAL_TIME):
             kern = merge_exec._groupby_kernel(merged, "merge")
-            cols, n = kern(merged.columns, jnp.int32(merged.num_rows))
-        return ColumnarBatch(inter_schema, list(cols), int(n))
+            cols, n = kern(merged.columns, merged.num_rows_i32)
+        return ColumnarBatch(inter_schema, list(cols), n, merged.checks)
 
     def _partial_schema(self) -> T.Schema:
         if self.mode == AggMode.FINAL:
@@ -445,8 +568,13 @@ class HashAggregateExec(UnaryExecBase):
         for batch in batches:
             with self.metrics.timed(M.TOTAL_TIME):
                 kern = self._reduce_kernel(batch, phase)
-                cols = kern(batch.columns, jnp.int32(batch.num_rows))
-                partials.append(ColumnarBatch(inter_schema, list(cols), 1))
+                if batch.sparse is not None:
+                    cols = kern(batch.columns, batch.num_rows_i32,
+                                batch.sparse)
+                else:
+                    cols = kern(batch.columns, batch.num_rows_i32)
+                partials.append(ColumnarBatch(inter_schema, list(cols), 1,
+                                              batch.checks))
         if not partials:
             # SQL: aggregate of empty input yields one row (e.g. COUNT=0)
             partials = [self._empty_partial(inter_schema)]
@@ -457,8 +585,8 @@ class HashAggregateExec(UnaryExecBase):
             out = merged
         else:
             kern = self._evaluate_kernel(merged)
-            cols = kern(merged.columns, jnp.int32(merged.num_rows))
-            out = ColumnarBatch(self._schema, list(cols), 1)
+            cols = kern(merged.columns, merged.num_rows_i32)
+            out = ColumnarBatch(self._schema, list(cols), 1, merged.checks)
         self.update_output_metrics(out)
         yield out
 
@@ -470,8 +598,8 @@ class HashAggregateExec(UnaryExecBase):
             funcs = self._funcs
 
             @jax.jit
-            def kernel(columns, num_rows):
-                ctx = make_eval_context(columns, cap, num_rows)
+            def kernel(columns, num_rows, mask=None):
+                ctx = make_eval_context(columns, cap, num_rows, mask)
                 seg_ids = jnp.zeros(cap, jnp.int32)
                 actx = AggContext(seg_ids, cap, ctx.row_mask)
                 out_cols = []
@@ -497,8 +625,8 @@ class HashAggregateExec(UnaryExecBase):
         merged = concat_batches(partials)
         agg = self._get_merge_exec(inter_schema)
         kern = agg._reduce_kernel(merged, "merge")
-        cols = kern(merged.columns, jnp.int32(merged.num_rows))
-        return ColumnarBatch(inter_schema, list(cols), 1)
+        cols = kern(merged.columns, merged.num_rows_i32)
+        return ColumnarBatch(inter_schema, list(cols), 1, merged.checks)
 
     def _empty_partial(self, inter_schema) -> ColumnarBatch:
         from spark_rapids_tpu.columnar.batch import empty_batch

@@ -58,7 +58,9 @@ def batch_signature(batch: ColumnarBatch) -> tuple:
     sig = [batch.capacity]
     for f, c in zip(batch.schema.fields, batch.columns):
         sig.append((f.dtype.id.value,
-                    c.char_cap if f.dtype.is_string else 0))
+                    c.char_cap if f.dtype.is_string else 0,
+                    c.narrow is not None))
+    sig.append(batch.sparse is not None)
     return tuple(sig)
 
 
@@ -128,8 +130,11 @@ class KernelCache:
 
 
 def make_eval_context(columns: list[ColumnVector], capacity: int,
-                      num_rows) -> EvalContext:
-    row_mask = jnp.arange(capacity) < num_rows
+                      num_rows, mask=None) -> EvalContext:
+    """`mask` (a sparse selection vector) overrides the prefix row mask —
+    sparse-aware kernels fold deferred selections in for free."""
+    row_mask = mask if mask is not None else (
+        jnp.arange(capacity) < num_rows)
     return EvalContext(columns, capacity, num_rows, row_mask)
 
 
@@ -214,6 +219,27 @@ class TpuExec:
             f"{type(self).__name__} does not support partitioned execution")
 
     def collect(self) -> ColumnarBatch:
+        """Materialize to one batch; the sync boundary where deferred
+        fast-path checks resolve.  On FastPathInvalid: disable the
+        offending fast path and re-execute once (plans are pure)."""
+        from spark_rapids_tpu.utils import checks as CK
+        mark = CK.snapshot()
+        try:
+            out = self._collect_once().dense()
+            out.prefetch()
+            CK.verify(out.checks)
+            CK.verify(CK.drain_since(mark))
+            return out
+        except CK.FastPathInvalid as e:
+            e.recover_all()
+            CK.drain_since(mark)  # discard THIS query's leftovers only
+            out = self._collect_once().dense()
+            out.prefetch()
+            CK.verify(out.checks)
+            CK.verify(CK.drain_since(mark))
+            return out
+
+    def _collect_once(self) -> ColumnarBatch:
         from spark_rapids_tpu.columnar.batch import concat_batches, empty_batch
         batches = list(self.execute_columnar())
         if not batches:
@@ -224,7 +250,7 @@ class TpuExec:
         return self.collect().to_pandas()
 
     def update_output_metrics(self, batch: ColumnarBatch) -> None:
-        self.metrics.add(M.NUM_OUTPUT_ROWS, batch.num_rows)
+        self.metrics.add(M.NUM_OUTPUT_ROWS, batch._rows)
         self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
 
     def name(self) -> str:

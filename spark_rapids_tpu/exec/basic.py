@@ -58,8 +58,8 @@ class ProjectExec(UnaryExecBase):
             cap = batch.capacity
 
             @jax.jit
-            def kernel(columns, num_rows):
-                ctx = make_eval_context(columns, cap, num_rows)
+            def kernel(columns, num_rows, mask=None):
+                ctx = make_eval_context(columns, cap, num_rows, mask)
                 return [e.eval(ctx) for e in bound]
 
             return kernel
@@ -70,9 +70,14 @@ class ProjectExec(UnaryExecBase):
         for batch in batches:
             with self.metrics.timed(M.TOTAL_TIME):
                 kernel = self._kernel(batch)
-                out_cols = kernel(batch.columns, jnp.int32(batch.num_rows))
+                if batch.sparse is not None:
+                    out_cols = kernel(batch.columns, batch.num_rows_i32,
+                                      batch.sparse)
+                else:
+                    out_cols = kernel(batch.columns, batch.num_rows_i32)
                 out = ColumnarBatch(self._schema, list(out_cols),
-                                    batch.num_rows)
+                                    batch._rows, batch.checks,
+                                    batch.sparse)
                 self.update_output_metrics(out)
             yield out
 
@@ -109,15 +114,11 @@ class FilterExec(UnaryExecBase):
             cap = batch.capacity
 
             @jax.jit
-            def kernel(columns, num_rows):
-                ctx = make_eval_context(columns, cap, num_rows)
+            def kernel(columns, num_rows, mask=None):
+                ctx = make_eval_context(columns, cap, num_rows, mask)
                 pred = bound.eval(ctx)
                 keep = pred.validity & pred.data.astype(bool) & ctx.row_mask
-                count = keep.sum().astype(jnp.int32)
-                (idx,) = jnp.nonzero(keep, size=cap, fill_value=cap - 1)
-                valid = jnp.arange(cap) < count
-                cols = [c.gather(idx, valid) for c in columns]
-                return cols, count
+                return keep, keep.sum().astype(jnp.int32)
 
             return kernel
 
@@ -127,9 +128,16 @@ class FilterExec(UnaryExecBase):
         for batch in batches:
             with self.metrics.timed(M.TOTAL_TIME):
                 kernel = self._kernel(batch)
-                cols, count = kernel(batch.columns, jnp.int32(batch.num_rows))
-                n = int(count)  # single scalar host sync per batch
-                out = ColumnarBatch(self._schema, list(cols), n)
+                if batch.sparse is not None:
+                    keep, count = kernel(batch.columns, batch.num_rows_i32,
+                                         batch.sparse)
+                else:
+                    keep, count = kernel(batch.columns, batch.num_rows_i32)
+                # DEFERRED SELECTION: no compaction here — the kept rows
+                # ride as a sparse mask; sparse-aware consumers fold it
+                # into their row masking, everyone else compacts lazily
+                out = ColumnarBatch(self._schema, batch.columns, count,
+                                    batch.checks, sparse=keep)
                 self.update_output_metrics(out)
             yield out
 
@@ -233,7 +241,8 @@ class UnionExec(TpuExec):
     def execute_columnar(self):
         for c in self.children:
             for b in c.execute_columnar():
-                out = ColumnarBatch(self._schema, b.columns, b.num_rows)
+                out = ColumnarBatch(self._schema, b.columns, b._rows,
+                                    b.checks, b.sparse)
                 self.update_output_metrics(out)
                 yield out
 

@@ -24,14 +24,14 @@ def coalesce_iterator(batches: Iterator[ColumnarBatch],
     the caller at plan time — the draining thread may not carry the
     session conf) caps emitted batch row counts for TargetSize goals."""
     if isinstance(goal, RequireSingleBatch):
-        got = [b for b in batches if b.num_rows > 0]
+        got = [b for b in batches if b.maybe_nonempty()]
         if not got:
             from spark_rapids_tpu.columnar.batch import empty_batch
             yield empty_batch(schema)
             return
         out = concat_batches(got) if len(got) > 1 else _rebucket(got[0])
         metrics.add(M.NUM_OUTPUT_BATCHES, 1)
-        metrics.add(M.NUM_OUTPUT_ROWS, out.num_rows)
+        metrics.add(M.NUM_OUTPUT_ROWS, out._rows)
         yield out
         return
 
@@ -44,26 +44,32 @@ def coalesce_iterator(batches: Iterator[ColumnarBatch],
     pending_rows = 0
     for big in batches:
         metrics.add(M.NUM_INPUT_BATCHES, 1)
-        metrics.add(M.NUM_INPUT_ROWS, big.num_rows)
-        if big.num_rows == 0:
+        metrics.add(M.NUM_INPUT_ROWS, big._rows)
+        if not big.maybe_nonempty():
             continue
         # row cap keeps capacities inside the bounded bucket set so
         # downstream kernels reuse compiled shapes; oversized batches
         # (row-expanding joins/expand) are sliced, not forwarded
         # lazy slicing: materializing every slice up front would hold a
         # second full copy of an oversized batch on device at once
-        pieces = ((big,) if big.num_rows <= max_rows else
+        # lazy batches are sized by CAPACITY (a safe upper bound on
+        # rows) so accumulation stays sync-free; only a lazy batch whose
+        # capacity exceeds the row cap forces a count sync to slice
+        big_rows = (big.num_rows if big.num_rows_known or
+                    big.capacity > max_rows else big.capacity)
+        pieces = ((big,) if big_rows <= max_rows else
                   (big.slice(lo, min(max_rows, big.num_rows - lo))
                    for lo in range(0, big.num_rows, max_rows)))
         for b in pieces:
-            est = _row_bytes(b) * b.num_rows
+            b_rows = (b.num_rows if b.num_rows_known else b.capacity)
+            est = _row_bytes(b) * b_rows
             if pending and (pending_bytes + est > target or
-                            pending_rows + b.num_rows > max_rows):
+                            pending_rows + b_rows > max_rows):
                 yield _emit(pending, metrics)
                 pending, pending_bytes, pending_rows = [], 0, 0
             pending.append(b)
             pending_bytes += est
-            pending_rows += b.num_rows
+            pending_rows += b_rows
     if pending:
         yield _emit(pending, metrics)
 
@@ -81,6 +87,8 @@ def _row_bytes(b: ColumnarBatch) -> int:
 def _rebucket(b: ColumnarBatch) -> ColumnarBatch:
     """Shrink an over-padded batch into its tight bucket (e.g. after a
     selective filter) so downstream kernels compile for a smaller shape."""
+    if not b.num_rows_known:
+        return b  # tightening needs the count; not worth a ~150ms sync
     tight = bucket_capacity(b.num_rows)
     if tight < b.capacity:
         return b.with_capacity(tight)
@@ -91,7 +99,7 @@ def _emit(pending: list[ColumnarBatch], metrics) -> ColumnarBatch:
     out = concat_batches(pending) if len(pending) > 1 else \
         _rebucket(pending[0])
     metrics.add(M.NUM_OUTPUT_BATCHES, 1)
-    metrics.add(M.NUM_OUTPUT_ROWS, out.num_rows)
+    metrics.add(M.NUM_OUTPUT_ROWS, out._rows)
     return out
 
 

@@ -122,11 +122,14 @@ class ExpandExec(UnaryExecBase):
     def process_partition(self, batches) -> Iterator[ColumnarBatch]:
         nproj = len(self._bound)
         for batch in batches:
+            batch = batch.dense()
             with self.metrics.timed(M.TOTAL_TIME):
                 kern = self._kernel(batch)
-                cols = kern(batch.columns, jnp.int32(batch.num_rows))
+                cols = kern(batch.columns, batch.num_rows_i32)
+                rows = (batch.num_rows * nproj if batch.num_rows_known
+                        else batch.num_rows_i32 * nproj)
                 out = ColumnarBatch(self._schema, list(cols),
-                                    batch.num_rows * nproj)
+                                    rows, batch.checks)
                 self.update_output_metrics(out)
             yield out
 

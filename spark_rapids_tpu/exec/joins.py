@@ -98,6 +98,21 @@ class HashJoinExec(TpuExec):
             fingerprint(self._probe_keys), fingerprint(self._build_keys),
             fingerprint(condition), fingerprint(lschema),
             fingerprint(rschema)))
+        # dense direct-address fast path: single integral equi-key,
+        # no residual condition, join types whose output is derivable
+        # from a per-probe-row lookup (FULL_OUTER needs unmatched-build
+        # emission -> sort path)
+        self._dense_qual = (
+            condition is None and
+            len(self._probe_keys) == 1 and
+            self._probe_keys[0].data_type(
+                self._probe.output_schema()).is_integral and
+            self._build_keys[0].data_type(
+                self._build.output_schema()).is_integral and
+            join_type in (JoinType.INNER, JoinType.LEFT_OUTER,
+                          JoinType.RIGHT_OUTER, JoinType.LEFT_SEMI,
+                          JoinType.LEFT_ANTI))
+        self._dense_tables: dict = {}
 
     def output_schema(self) -> T.Schema:
         return self._schema
@@ -267,6 +282,193 @@ class HashJoinExec(TpuExec):
 
         return self._join_cache.get_or_build(key, build_fn)
 
+    # -- dense direct-address fast path -----------------------------------
+    # Reference capability parallel: the role cuDF's hash-join build
+    # table plays (`GpuHashJoin.scala:282` doJoinLeftRight).  On TPU a
+    # pointer-chasing hash table is hostile (serialized gathers), but a
+    # DENSE table — one slot per key in [kmin, kmin+span) — turns the
+    # whole probe into two fused gathers.  Applicability is checked at
+    # build time (span fits budget, keys unique); the sort-merge kernel
+    # remains the general fallback.  PK-FK joins on TPC-style dense
+    # surrogate keys all take this lane.
+
+    def _try_dense_table(self, build: ColumnarBatch):
+        """Build (or fetch cached) the direct-address table; None when
+        the build side does not qualify (span too wide / dup keys)."""
+        import numpy as np
+        from spark_rapids_tpu import config as C
+        conf = C.get_active_conf()
+        if not conf[C.DENSE_JOIN_ENABLED]:
+            return None
+        if build.capacity >= (1 << 24) or build.capacity % 128:
+            return None  # f32 row-index exactness + pallas lane alignment
+        ck = (id(build), build.capacity)
+        cached = self._dense_tables.get(ck)
+        if cached is not None:
+            return cached[0]
+        probe = self._join_cache.get_or_build(
+            ("dense-probe", batch_signature(build)),
+            lambda: jax.jit(self._build_dense_probe(build.capacity)))
+        kmin, kmax = probe(build.columns, build.num_rows_i32)
+        kmin, kmax = int(kmin), int(kmax)
+        span = kmax - kmin + 1 if kmax >= kmin else 0
+        entry = None
+        if span <= int(conf[C.DENSE_JOIN_MAX_SPAN]):
+            g = int(bucket_capacity(max(span, 1)))
+            tab_kern = self._join_cache.get_or_build(
+                ("dense-table", g, batch_signature(build)),
+                lambda: jax.jit(self._build_dense_table_kernel(
+                    build.capacity, g)))
+            bidx_tab, cnt_tab, max_cnt = tab_kern(
+                build.columns, build.num_rows_i32, jnp.int64(kmin))
+            if int(max_cnt) <= 1:  # unique build keys required
+                entry = (kmin, g, bidx_tab, cnt_tab)
+        # single-entry cache (repeated collects rebuild the build batch
+        # each execute — keeping every old one would pin device memory);
+        # the strong ref to the build batch keeps id() valid
+        self._dense_tables = {ck: (entry, build)}
+        return entry
+
+    def _build_dense_probe(self, cap: int):
+        key_expr = self._build_keys[0]
+
+        def probe(columns, num_rows):
+            ctx = make_eval_context(columns, cap, num_rows)
+            k = key_expr.eval(ctx)
+            ok = k.validity & ctx.row_mask
+            if k.narrow is not None:
+                i32 = jnp.iinfo(jnp.int32)
+                kmin = jnp.min(jnp.where(ok, k.narrow, i32.max))
+                kmax = jnp.max(jnp.where(ok, k.narrow, i32.min))
+                return kmin.astype(jnp.int64), kmax.astype(jnp.int64)
+            kd = k.data.astype(jnp.int64)
+            i64 = jnp.iinfo(jnp.int64)
+            return (jnp.min(jnp.where(ok, kd, i64.max)),
+                    jnp.max(jnp.where(ok, kd, i64.min)))
+        return probe
+
+    def _build_dense_table_kernel(self, cap: int, g: int):
+        """slots <- key - kmin; table[slot] = build row index; counts
+        detect duplicates.  Built with an XLA scatter-add — slow on TPU
+        but paid ONCE per join build (and cached), unlike the per-probe
+        work, and it scales to multi-million-slot tables that the
+        one-hot kernel's VMEM cannot hold."""
+        key_expr = self._build_keys[0]
+
+        def kernel(columns, num_rows, kmin):
+            ctx = make_eval_context(columns, cap, num_rows)
+            k = key_expr.eval(ctx)
+            ok = k.validity & ctx.row_mask
+            if k.narrow is not None:
+                offu = (k.narrow - kmin.astype(jnp.int32)
+                        ).astype(jnp.uint32)
+                in_t = ok & (offu < jnp.uint32(g))
+                off = offu.astype(jnp.int32)
+            else:
+                off64 = k.data.astype(jnp.int64) - kmin
+                in_t = ok & (off64 >= 0) & (off64 < g)
+                off = off64
+            # sentinel slot g: masked rows scatter 0 there; it must read
+            # as count 0 for out-of-table probes, so only in_t rows add
+            slots = jnp.where(in_t, off, g).astype(jnp.int32)
+            cnt_tab = jnp.zeros(g + 1, jnp.int32).at[slots].add(
+                in_t.astype(jnp.int32))
+            bidx1 = jnp.zeros(g + 1, jnp.int32).at[slots].add(
+                jnp.where(in_t, jnp.arange(cap, dtype=jnp.int32) + 1, 0))
+            bidx_tab = bidx1 - 1
+            return bidx_tab, cnt_tab, cnt_tab[:g].max()
+        return kernel
+
+    def _dense_probe_kernel(self, build: ColumnarBatch,
+                            probe: ColumnarBatch, g: int,
+                            narrow_ok: bool):
+        key = ("dense-join", g, narrow_ok, batch_signature(build),
+               batch_signature(probe))
+        jt = self.join_type
+
+        def build_fn():
+            pcap = probe.capacity
+            probe_key = self._probe_keys[0]
+
+            @jax.jit
+            def kernel(pcols, pnum, bcols, bidx_tab, cnt_tab, kmin,
+                       pmask=None):
+                ctx = make_eval_context(pcols, pcap, pnum, pmask)
+                pk = probe_key.eval(ctx)
+                ok = pk.validity & ctx.row_mask
+                if pk.narrow is not None and narrow_ok:
+                    # narrow_ok: the CALLER verified [kmin, kmin+g)
+                    # fits int32, so the unsigned-difference window
+                    # test is exact (a kmin outside int32 would wrap
+                    # and fabricate matches)
+                    offu = (pk.narrow - kmin.astype(jnp.int32)
+                            ).astype(jnp.uint32)
+                    in_t = ok & (offu < jnp.uint32(g))
+                    off = offu.astype(jnp.int32)
+                else:
+                    off64 = pk.data.astype(jnp.int64) - kmin
+                    in_t = ok & (off64 >= 0) & (off64 < g)
+                    off = off64.astype(jnp.int32)
+                slot = jnp.where(in_t, off, g)
+                cnt = jnp.take(cnt_tab, slot, mode="clip")
+                matched = in_t & (cnt > 0)
+                if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+                    keep = (ctx.row_mask & ~matched
+                            if jt == JoinType.LEFT_ANTI
+                            else matched)
+                    return keep
+                bsel = jnp.where(matched,
+                                 jnp.take(bidx_tab, slot, mode="clip"), 0)
+                bout = [c.gather(bsel, matched) for c in bcols]
+                return bout, matched
+            return kernel
+
+        return self._join_cache.get_or_build(key, build_fn)
+
+    def _execute_dense(self, build, tab) -> Iterator[ColumnarBatch]:
+        kmin, g, bidx_tab, cnt_tab = tab
+        jt = self.join_type
+        kmin_op = jnp.int64(kmin)
+        i32 = np.iinfo(np.int32)
+        narrow_ok = i32.min <= kmin and kmin + g <= i32.max
+        for it in self._probe.execute_partitions():
+            for pb in it:
+                if not pb.maybe_nonempty():
+                    continue
+                with self.metrics.timed(M.TOTAL_TIME):
+                    kern = self._dense_probe_kernel(build, pb, g,
+                                                    narrow_ok)
+                    args = (pb.columns, pb.num_rows_i32, build.columns,
+                            bidx_tab, cnt_tab, kmin_op)
+                    if pb.sparse is not None:
+                        args = args + (pb.sparse,)
+                    if jt in _PROBE_ONLY:
+                        keep = kern(*args)
+                        out = ColumnarBatch(self._schema, pb.columns,
+                                            None, pb.checks, sparse=keep)
+                    elif jt == JoinType.INNER:
+                        bout, matched = kern(*args)
+                        out = self._assemble_sparse(pb.columns, bout,
+                                                    matched, pb.checks)
+                    else:  # LEFT/RIGHT OUTER (probe side preserved)
+                        bout, _ = kern(*args)
+                        out = self._assemble_sparse(pb.columns, bout,
+                                                    pb.sparse, pb.checks,
+                                                    rows=pb._rows)
+                if out.maybe_nonempty():
+                    self.update_output_metrics(out)
+                    yield out
+
+    def _assemble_sparse(self, pcols, bcols, sparse, checks, rows=None):
+        if self._flip:
+            cols = list(bcols) + list(pcols)
+        else:
+            cols = list(pcols) + list(bcols)
+        return ColumnarBatch(self._schema, cols,
+                             rows if sparse is None or rows is not None
+                             else None,
+                             checks, sparse=sparse)
+
     # -- execution --------------------------------------------------------
     def children_coalesce_goal(self):
         # build side needs a single batch
@@ -274,8 +476,8 @@ class HashJoinExec(TpuExec):
             [RequireSingleBatch(), None]
 
     def _build_batch(self) -> ColumnarBatch:
-        batches = [b for it in self._build.execute_partitions()
-                   for b in it if b.num_rows > 0]
+        batches = [b.dense() for it in self._build.execute_partitions()
+                   for b in it if b.maybe_nonempty()]
         if not batches:
             from spark_rapids_tpu.columnar.batch import empty_batch
             return empty_batch(self._build.output_schema())
@@ -291,14 +493,20 @@ class HashJoinExec(TpuExec):
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
         build = self._build_batch()
+        if self._dense_qual:
+            tab = self._try_dense_table(build)
+            if tab is not None:
+                yield from self._execute_dense(build, tab)
+                return
         jt = self.join_type
         outer_probe = jt in (JoinType.LEFT_OUTER, JoinType.RIGHT_OUTER,
                              JoinType.FULL_OUTER)
         bmatched_total = np.zeros(build.capacity, bool)
         for it in self._probe.execute_partitions():
             for pb in it:
-                if pb.num_rows == 0:
+                if not pb.maybe_nonempty():
                     continue
+                pb = pb.dense()
                 with self.metrics.timed(M.TOTAL_TIME):
                     mk = self._match_kernel(build, pb)
                     counts_p, start_p, perm, bmatched, total_inner = mk(
@@ -440,10 +648,15 @@ class NestedLoopJoinExec(TpuExec):
         return self._cache.get_or_build(key, build_fn)
 
     def execute_columnar(self):
-        right_batches = [b for it in self.children[1].execute_partitions()
-                         for b in it if b.num_rows > 0]
+        right_batches = [b.dense() for it in
+                         self.children[1].execute_partitions()
+                         for b in it if b.maybe_nonempty()]
+        right_batches = [b for b in right_batches if b.num_rows > 0]
         for it in self.children[0].execute_partitions():
             for lb in it:
+                if not lb.maybe_nonempty():
+                    continue
+                lb = lb.dense()
                 if lb.num_rows == 0:
                     continue
                 for rb in right_batches:

@@ -8,6 +8,29 @@ from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.exec.base import TpuExec, UnaryExecBase
 
 
+def _limited(batches, n: int, on_output) -> Iterator[ColumnarBatch]:
+    """Emit at most n rows.  Lazy-count batches avoid the ~150ms count
+    sync via take_head; the running `remaining` only syncs when ANOTHER
+    batch follows (the single-batch case — a limit over one sorted
+    batch — never syncs)."""
+    remaining = n
+    it = iter(batches)
+    prev = next(it, None)
+    while prev is not None and remaining > 0:
+        nxt = next(it, None)
+        if prev.num_rows_known and prev.num_rows <= remaining:
+            out = prev
+        else:
+            out = prev.take_head(remaining)
+        if nxt is not None:
+            remaining -= out.num_rows  # may sync; another batch follows
+        else:
+            remaining = 0
+        on_output(out)
+        yield out
+        prev = nxt
+
+
 class LocalLimitExec(UnaryExecBase):
     """Per-partition limit: slice batches until n rows emitted."""
 
@@ -22,19 +45,7 @@ class LocalLimitExec(UnaryExecBase):
         return f"LocalLimitExec({self.n})"
 
     def process_partition(self, batches) -> Iterator[ColumnarBatch]:
-        remaining = self.n
-        for b in batches:
-            if remaining <= 0:
-                break
-            if b.num_rows <= remaining:
-                remaining -= b.num_rows
-                self.update_output_metrics(b)
-                yield b
-            else:
-                out = b.slice(0, remaining)
-                remaining = 0
-                self.update_output_metrics(out)
-                yield out
+        yield from _limited(batches, self.n, self.update_output_metrics)
 
 
 class GlobalLimitExec(UnaryExecBase):
@@ -52,15 +63,10 @@ class GlobalLimitExec(UnaryExecBase):
         return f"GlobalLimitExec({self.n})"
 
     def execute_columnar(self):
-        remaining = self.n
-        for part in self.child.execute_partitions():
-            for b in part:
-                if remaining <= 0:
-                    return
-                out = b if b.num_rows <= remaining else b.slice(0, remaining)
-                remaining -= out.num_rows
-                self.update_output_metrics(out)
-                yield out
+        def chain():
+            for part in self.child.execute_partitions():
+                yield from part
+        yield from _limited(chain(), self.n, self.update_output_metrics)
 
     def output_partition_count(self) -> int:
         return 1

@@ -396,11 +396,12 @@ class WindowExec(UnaryExecBase):
         batches = coalesce_iterator(batches, RequireSingleBatch(),
                                     self._child_schema, self.metrics)
         for batch in batches:
+            batch = batch.dense()
             with self.metrics.timed(M.TOTAL_TIME):
                 kern = self._kernel(batch)
-                cols = kern(batch.columns, jnp.int32(batch.num_rows))
+                cols = kern(batch.columns, batch.num_rows_i32)
                 out = ColumnarBatch(self._schema, list(cols),
-                                    batch.num_rows)
+                                    batch._rows, batch.checks)
                 self.update_output_metrics(out)
             yield out
 

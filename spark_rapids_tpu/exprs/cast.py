@@ -665,6 +665,7 @@ def _string_to_float(c: ColumnVector, dst: T.DataType) -> ColumnVector:
     skipped = jnp.zeros(c.capacity, jnp.int32)
     frac_cnt = jnp.zeros(c.capacity, jnp.int32)
     sig_started = jnp.zeros(c.capacity, bool)
+    first_dropped = jnp.full(c.capacity, -1, jnp.int32)
     for kcol in range(cc):
         isd = mant_digits[:, kcol]
         lead_zero = isd & ~sig_started & (dig[:, kcol] == 0)
@@ -675,10 +676,18 @@ def _string_to_float(c: ColumnVector, dst: T.DataType) -> ColumnVector:
                         + dig[:, kcol].astype(jnp.uint64), acc)
         taken = taken + take.astype(jnp.int32)
         after_dot = has_dot & (kcol > dot_at) & (~dot_after_exp)
+        dropped = isd & ~lead_zero & ~room
+        first_dropped = jnp.where(
+            dropped & (first_dropped < 0),
+            dig[:, kcol].astype(jnp.int32), first_dropped)
         skipped = skipped + \
-            (isd & ~lead_zero & ~room & ~after_dot).astype(jnp.int32)
+            (dropped & ~after_dot).astype(jnp.int32)
         frac_cnt = frac_cnt + \
             (isd & after_dot & (take | lead_zero)).astype(jnp.int32)
+    # round-half-up on the 19th significant digit (ADVICE r2): tightens
+    # the 1-ulp caveat to genuinely rare double-rounding cases.  acc
+    # held <= 10^18-1, so +1 cannot overflow uint64.
+    acc = jnp.where(first_dropped >= 5, acc + jnp.uint64(1), acc)
 
     # explicit exponent parse (sign + up to 3 digits)
     epos0 = exp_at + 1

@@ -42,6 +42,20 @@ SPARK_LEGACY_DATETIME_KEY = b"org.apache.spark.legacyDateTime"
 READ_MODES = ("EXCEPTION", "CORRECTED", "LEGACY")
 
 
+def _verify_utc_session() -> None:
+    """CUTOVER_MICROS equals the date cutover ONLY for a UTC session
+    (non-UTC zones drift pre-1900).  The engine is UTC-only (reference
+    GpuOverrides.scala:397-409 tags timestamps off outside UTC); this
+    guard keeps the constant from silently going stale if a session
+    timezone conf is ever introduced (ADVICE r2)."""
+    from spark_rapids_tpu import config as C
+    tz = C.get_active_conf().get("spark.sql.session.timeZone", "UTC")
+    if tz not in ("UTC", "Etc/UTC", "GMT", "+00:00", "Z"):
+        raise AssertionError(
+            f"legacy-timestamp rebase detection requires a UTC session; "
+            f"got spark.sql.session.timeZone={tz!r}")
+
+
 class SparkUpgradeError(RuntimeError):
     """Analog of Spark's SparkUpgradeException (SPARK-31404)."""
 
@@ -114,6 +128,7 @@ def _version_at_least(version: str, floor: tuple) -> bool:
 
 
 def _arrow_col_needs_rebase(col) -> bool:
+    _verify_utc_session()
     import pyarrow as pa
     import pyarrow.compute as pc
     t = col.type
@@ -160,6 +175,7 @@ def apply_read_rebase(table, kv_meta: Optional[dict], mode: str,
 
 
 def batch_needs_rebase(batch) -> bool:
+    _verify_utc_session()
     """Write-side value check over a device ColumnarBatch (reference
     `RebaseHelper.isDateTimeRebaseNeededWrite`)."""
     from spark_rapids_tpu import types as T

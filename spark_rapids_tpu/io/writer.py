@@ -151,6 +151,7 @@ class SingleDirectoryDataWriter(DataWriter):
         self._writer = None
 
     def write(self, batch: ColumnarBatch) -> None:
+        batch = batch.dense()
         if batch.num_rows == 0:
             return
         if self._writer is None:
@@ -189,6 +190,7 @@ class DynamicPartitionDataWriter(DataWriter):
         self._current_key: Optional[tuple] = None
 
     def write(self, batch: ColumnarBatch) -> None:
+        batch = batch.dense()
         if batch.num_rows == 0:
             return
         n = batch.num_rows

@@ -58,7 +58,7 @@ class ColumnarRdd:
         if not batches:
             return {}
         from spark_rapids_tpu.columnar.batch import concat_batches
-        merged = concat_batches(batches)
+        merged = concat_batches(batches).dense()
         n = merged.num_rows
         out = {}
         for f, c in zip(merged.schema.fields, merged.columns):

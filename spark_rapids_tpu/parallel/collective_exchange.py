@@ -168,6 +168,36 @@ def build_all_to_all_exchange(mesh: Mesh, axis: str,
     return jax.jit(smapped)
 
 
+def build_count_exchange(mesh: Mesh, axis: str, schema: T.Schema,
+                         key_indices: Sequence[int], capacity: int):
+    """Phase-1 of the two-phase exchange (ADVICE r2): a counts-only
+    all-to-all so the data phase can size its receive buffers from the
+    ACTUAL per-device totals instead of the n_dev*cap worst case.
+    Returns a jitted fn: (arrs, num_rows[n_dev]) -> recv_total[n_dev]."""
+    n_dev = mesh.shape[axis]
+    key_idx = tuple(key_indices)
+
+    def per_device(arrs, num_rows):
+        local = [tuple(x[0] if x is not None else None for x in a)
+                 for a in arrs]
+        from spark_rapids_tpu.columnar.vector import ColumnVector
+        cols = [ColumnVector(f.dtype, d, v, l)
+                for f, (d, v, l) in zip(schema.fields, local)]
+        _, counts = _local_split(cols, num_rows[0], key_idx, n_dev,
+                                 capacity)
+        recv = jax.lax.all_to_all(counts.reshape(n_dev, 1), axis, 0, 0,
+                                  tiled=False).reshape(n_dev)
+        return recv.sum().astype(jnp.int32)[None]
+
+    smapped = shard_map(
+        per_device, mesh=mesh,
+        in_specs=([tuple(P(axis) if i < 2 or f.dtype.is_string else None
+                         for i in range(3))
+                   for f in schema.fields], P(axis)),
+        out_specs=P(axis))
+    return jax.jit(smapped)
+
+
 def stack_batches(batches, capacity: int):
     """Host helper: stack per-device ColumnarBatches into the pytree
     layout build_all_to_all_exchange expects."""

@@ -301,9 +301,24 @@ def _conv_union(meta, kids) -> TpuExec:
 
 def _conv_limit(meta, kids) -> TpuExec:
     node: N.CpuLimit = meta.node
+    child = kids[0]
     if node.global_limit:
-        return GlobalLimitExec(node.n, LocalLimitExec(node.n, kids[0]))
-    return LocalLimitExec(node.n, kids[0])
+        # ORDER BY + LIMIT -> top-N (Spark plans this shape as
+        # TakeOrderedAndProjectExec; our SortedTopNExec prunes each
+        # batch to n candidates — top_k fast path for single numeric
+        # keys — and re-sorts the merged candidates exactly)
+        from spark_rapids_tpu.exec.sort import SortedTopNExec
+        if (isinstance(child, SortExec) and child.global_sort and
+                node.n <= 1 << 14):
+            src = child.child
+            if (isinstance(src, ShuffleExchangeExec) and
+                    isinstance(src.partitioning, RangePartitioning)):
+                # the range exchange only existed to totally order the
+                # partitions; top-N prunes per partition instead
+                src = src.child
+            return SortedTopNExec(node.n, child.order, src)
+        return GlobalLimitExec(node.n, LocalLimitExec(node.n, child))
+    return LocalLimitExec(node.n, child)
 
 
 def _conv_sort(meta, kids) -> TpuExec:
@@ -405,18 +420,22 @@ def _tag_join(meta) -> None:
 
 
 def _strip_smj_sort(kid: TpuExec, keys) -> TpuExec:
-    """Drop a per-partition SortExec whose keys are covered by the join
-    keys — the sort only existed to feed the sort-merge join we are
-    replacing (reference GpuSortMergeJoinExec.scala:40-52 removes the
-    child GpuSortExecs it made redundant)."""
+    """Drop a per-partition SortExec that EXACTLY matches the ordering a
+    sort-merge join would have required (ascending join keys, in key
+    order, default null ordering) — that sort only existed to feed the
+    SMJ we are replacing (reference GpuSortMergeJoinExec.scala:40-52).
+    Anything else — a user's explicit descending/reordered
+    sortWithinPartitions — is kept (ADVICE r2)."""
     from spark_rapids_tpu.exprs.base import fingerprint
     if not isinstance(kid, SortExec) or kid.global_sort:
         return kid
-    sort_fps = {fingerprint(o.expr) for o in kid.order}
-    key_fps = {fingerprint(k) for k in keys}
-    if sort_fps <= key_fps:
-        return kid.child
-    return kid
+    if len(kid.order) != len(keys):
+        return kid
+    for o, k in zip(kid.order, keys):
+        if (not o.ascending or not o.resolved_nulls_first or
+                fingerprint(o.expr) != fingerprint(k)):
+            return kid
+    return kid.child
 
 
 def _conv_sort_merge_join(meta, kids) -> TpuExec:
@@ -862,6 +881,21 @@ def collect(plan, conf: Optional[C.RapidsConf] = None) -> "object":
 
 
 def _collect(plan, conf: C.RapidsConf) -> "object":
+    """Adds the deopt-and-retry boundary for PARTIALLY accelerated plans:
+    a mid-plan TPU->CPU transition (df_from_batch / serde) may raise
+    FastPathInvalid from a deferred fast-path check; the offending fast
+    path is disabled and the pure plan re-executes once."""
+    from spark_rapids_tpu.utils import checks as CK
+    mark = CK.snapshot()
+    try:
+        return _collect_inner(plan, conf)
+    except CK.FastPathInvalid as e:
+        e.recover_all()
+        CK.drain_since(mark)
+        return _collect_inner(plan, conf)
+
+
+def _collect_inner(plan, conf: C.RapidsConf) -> "object":
     if isinstance(plan, TpuExec):
         from spark_rapids_tpu.plan.transitions import df_from_batch
         if conf[C.ADAPTIVE_ENABLED]:

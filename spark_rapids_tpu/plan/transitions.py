@@ -72,7 +72,14 @@ def series_from_column(field: T.Field, vals, valid) -> pd.Series:
 def df_from_batch(batch: ColumnarBatch) -> pd.DataFrame:
     """Device batch -> host rows with nullable dtypes (storage model
     preserved: DATE32 stays int days, TIMESTAMP_US stays int micros), so
-    downstream CPU operators see exactly what cpu_eval expects."""
+    downstream CPU operators see exactly what cpu_eval expects.
+
+    Prefetches every buffer (async D2H) before converting: on a
+    tunnel-attached chip each blocking readback costs ~150ms, so the
+    whole batch must come back in one wave."""
+    batch = batch.dense()
+    batch.prefetch()
+    batch.verify_checks()
     out = {}
     for f, c in zip(batch.schema.fields, batch.columns):
         vals, valid = c.to_numpy(batch.num_rows)

@@ -53,8 +53,9 @@ class ShuffleExchangeExec(UnaryExecBase):
         Returns (inputs, small) — `small` means a one-partition exchange
         suffices.  Hash/round-robin callers must NOT use this: they
         stream batch-at-a-time so pre-split inputs are freed as they go."""
-        inputs = [b for it in self.child.execute_partitions()
-                  for b in it if b.num_rows > 0]
+        inputs = [b.dense() for it in self.child.execute_partitions()
+                  for b in it if b.maybe_nonempty()]
+        inputs = [b for b in inputs if b.num_rows > 0]
         total = sum(b.num_rows for b in inputs)
         n = self.partitioning.num_partitions
         small = total <= self.SMALL_RANGE_INPUT_ROWS or n == 1
@@ -74,13 +75,13 @@ class ShuffleExchangeExec(UnaryExecBase):
             batch_iter = iter(inputs)
         else:
             batch_iter = (b for it in self.child.execute_partitions()
-                          for b in it if b.num_rows > 0)
+                          for b in it if b.maybe_nonempty())
         buckets: list[list[ColumnarBatch]] = [[] for _ in range(n)]
         for batch in batch_iter:
             with self.metrics.timed(M.TOTAL_TIME):
                 slices = part.partition_batch(batch)
             for p, s in enumerate(slices):
-                if s is not None and s.num_rows > 0:
+                if s is not None and s.maybe_nonempty():
                     buckets[p].append(s)
                     self.metrics.add("dataSize", s.device_size_bytes())
         return buckets
@@ -125,7 +126,7 @@ class ShuffleExchangeExec(UnaryExecBase):
 
         def reader(bs: list[ColumnarBatch]):
             for b in bs:
-                self.metrics.add(M.NUM_OUTPUT_ROWS, b.num_rows)
+                self.metrics.add(M.NUM_OUTPUT_ROWS, b._rows)
                 self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
                 yield b
         return [reader(bs) for bs in buckets]
@@ -168,15 +169,18 @@ class ShuffleExchangeExec(UnaryExecBase):
         device-side into a worst-case-sized (overflow-proof) batch."""
         import numpy as np
         from spark_rapids_tpu.columnar.batch import empty_batch
+        from spark_rapids_tpu.columnar.vector import bucket_capacity
         from spark_rapids_tpu.parallel.collective_exchange import (
-            build_all_to_all_exchange, stack_batches, unstack_batches)
+            build_all_to_all_exchange, build_count_exchange,
+            stack_batches, unstack_batches)
         n = self.partitioning.num_partitions
         groups: list[list[ColumnarBatch]] = [[] for _ in range(n)]
         for i, it in enumerate(self.child.execute_partitions()):
             for b in it:
-                if b.num_rows > 0:
+                if b.maybe_nonempty():
                     groups[i % n].append(b)
-        locals_ = [concat_batches(g) if g else empty_batch(self._schema)
+        locals_ = [concat_batches(g).dense() if g
+                   else empty_batch(self._schema)
                    for g in groups]
         cap = max(b.capacity for b in locals_)
         locals_ = [b if b.capacity == cap else b.with_capacity(cap)
@@ -192,13 +196,23 @@ class ShuffleExchangeExec(UnaryExecBase):
             tuple((f.name, str(f.dtype)) for f in self._schema.fields),
             key_idx))
         schema = self._schema
-        step = cache.get_or_build(
-            ("step", cap),
-            lambda: build_all_to_all_exchange(
-                mesh, axis, schema, key_idx, cap, out_capacity=n * cap))
         ShuffleExchangeExec._MESH_EXCHANGES_RUN += 1
         with self.metrics.timed(M.TOTAL_TIME):
             arrs, num_rows = stack_batches(locals_, cap)
+            # two-phase exchange (ADVICE r2): a counts-only all-to-all
+            # sizes the data phase's receive buffers from ACTUAL totals
+            # — the old n_dev*cap worst case OOMs HBM-scale batches
+            count_fn = cache.get_or_build(
+                ("count", cap),
+                lambda: build_count_exchange(mesh, axis, schema,
+                                             key_idx, cap))
+            totals = np.asarray(count_fn(arrs, num_rows))
+            out_cap = int(bucket_capacity(max(int(totals.max()), 1)))
+            step = cache.get_or_build(
+                ("step", cap, out_cap),
+                lambda: build_all_to_all_exchange(
+                    mesh, axis, schema, key_idx, cap,
+                    out_capacity=out_cap))
             out_arrs, out_rows = step(arrs, num_rows)
         out = unstack_batches(out_arrs, np.asarray(out_rows),
                               self._schema)
@@ -307,9 +321,9 @@ class BroadcastExchangeExec(UnaryExecBase):
         if self._cached is None:
             with self.metrics.timed("broadcastTime"):
                 batches = [b for it in self.child.execute_partitions()
-                           for b in it if b.num_rows > 0]
+                           for b in it if b.maybe_nonempty()]
                 if batches:
-                    self._cached = concat_batches(batches)
+                    self._cached = concat_batches(batches).dense()
                 else:
                     from spark_rapids_tpu.columnar.batch import empty_batch
                     self._cached = empty_batch(self._schema)

@@ -49,8 +49,8 @@ def _split_kernel_for(cache: KernelCache, batch: ColumnarBatch,
         cap = batch.capacity
 
         @jax.jit
-        def kernel(columns, num_rows, salt, extra):
-            ctx = make_eval_context(columns, cap, num_rows)
+        def kernel(columns, num_rows, salt, extra, mask=None):
+            ctx = make_eval_context(columns, cap, num_rows, mask)
             pids = pid_fn(ctx, salt, extra)
             pids = jnp.where(ctx.row_mask, pids, num_partitions)
             # stable sort by pid: lexsort with row index implicit
@@ -67,12 +67,32 @@ def _split_kernel_for(cache: KernelCache, batch: ColumnarBatch,
     return cache.get_or_build(key, build)
 
 
-def _slice_partitions(batch_cols, counts: np.ndarray, schema: T.Schema,
-                      total_cap: int) -> list[ColumnarBatch]:
-    """Host-side: cut the pid-sorted batch into per-partition batches."""
+#: lazy slicing keeps slices at the INPUT batch's capacity (the count is
+#: still on device), so it only pays off when that capacity is small;
+#: past this cap the ~150ms count sync amortizes over real compute and
+#: tightly-bucketed slices matter more than the round trip.
+LAZY_SLICE_MAX_CAP = 1 << 16
+
+
+def _slice_partitions(batch_cols, counts, schema: T.Schema,
+                      total_cap: int, checks: tuple = ()
+                      ) -> list[ColumnarBatch]:
+    """Cut the pid-sorted batch into per-partition batches.  `counts`
+    may be a DEVICE vector: small batches slice sync-free (device
+    offsets, full-capacity slices, lazy row counts); large ones sync
+    once and cut tight host-side slices."""
+    n_parts = counts.shape[0]
+    if not isinstance(counts, np.ndarray) and total_cap <= LAZY_SLICE_MAX_CAP:
+        offs = jnp.cumsum(counts) - counts
+        total = jnp.sum(counts)
+        reordered = ColumnarBatch(schema, list(batch_cols), total, checks)
+        return [reordered.slice_lazy(offs[p], counts[p])
+                for p in range(n_parts)]
+    counts = np.asarray(counts)
     out = []
     offsets = np.concatenate([[0], np.cumsum(counts)])
-    reordered = ColumnarBatch(schema, list(batch_cols), int(offsets[-1]))
+    reordered = ColumnarBatch(schema, list(batch_cols), int(offsets[-1]),
+                              checks)
     for p in range(len(counts)):
         n = int(counts[p])
         if n == 0:
@@ -109,10 +129,10 @@ class HashPartitioning(TpuPartitioning):
             return partition_ids(keys, n)
 
         kern = _split_kernel_for(cache, batch, pid_fn, n, "hash")
-        cols, counts = kern(batch.columns, jnp.int32(batch.num_rows),
-                            jnp.int32(0), ())
-        return _slice_partitions(cols, np.asarray(counts), batch.schema,
-                                 batch.capacity)
+        cols, counts = kern(batch.columns, batch.num_rows_i32,
+                            jnp.int32(0), (), batch.sparse)
+        return _slice_partitions(cols, counts, batch.schema,
+                                 batch.capacity, batch.checks)
 
 
 @dataclasses.dataclass
@@ -138,10 +158,10 @@ class RoundRobinPartitioning(TpuPartitioning):
 
         kern = _split_kernel_for(cache, batch, pid_fn, n, "rr")
         salt = np.random.randint(0, n)  # start-partition randomization
-        cols, counts = kern(batch.columns, jnp.int32(batch.num_rows),
-                            jnp.int32(salt), ())
-        return _slice_partitions(cols, np.asarray(counts), batch.schema,
-                                 batch.capacity)
+        cols, counts = kern(batch.columns, batch.num_rows_i32,
+                            jnp.int32(salt), (), batch.sparse)
+        return _slice_partitions(cols, counts, batch.schema,
+                                 batch.capacity, batch.checks)
 
 
 @dataclasses.dataclass
@@ -223,10 +243,11 @@ class RangePartitioning(TpuPartitioning):
             for c in bounds.columns)
         kern = _split_kernel_for(cache, batch, pid_fn, n,
                                  ("range", k, bounds_sig))
-        cols, counts = kern(batch.columns, jnp.int32(batch.num_rows),
-                            jnp.int32(0), tuple(bounds.columns))
-        return _slice_partitions(cols, np.asarray(counts), batch.schema,
-                                 batch.capacity)
+        cols, counts = kern(batch.columns, batch.num_rows_i32,
+                            jnp.int32(0), tuple(bounds.columns),
+                            batch.sparse)
+        return _slice_partitions(cols, counts, batch.schema,
+                                 batch.capacity, batch.checks)
 
 
 def _row_less_than_bound(keys, bounds, bi: int, order) -> jnp.ndarray:

@@ -1,0 +1,104 @@
+"""Deferred device-side validity checks for optimistic fast paths.
+
+A fast path (e.g. the dictionary group-by window) may produce results
+whose validity is only known on device (a bool scalar: True = INVALID).
+Syncing per batch costs ~150ms through a tunnel-attached chip, so checks
+ride along until a host exit (collect / to_pandas / serde), where they
+are verified in one async readback wave together with the result data.
+
+On failure, `FastPathInvalid` carries recovery callbacks that disable
+the originating fast path; `TpuExec.collect`/`plan.collect` catch it,
+recover, and re-execute the (pure) plan once — the optimistic-
+optimization-with-deopt discipline.
+
+Checks attach to batches (`ColumnarBatch.checks`) AND register in a
+process-wide pending list, so a plan whose intermediate execs drop the
+per-batch tuple still fails safe at the next `verify_pending` boundary.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchCheck:
+    flag: object                      # device bool scalar; True = invalid
+    origin: str                       # human-readable fast-path name
+    recover: Optional[Callable] = None  # disables the fast path
+
+
+class FastPathInvalid(Exception):
+    def __init__(self, checks):
+        self.checks = list(checks)
+        super().__init__(
+            "optimistic fast path produced invalid results: "
+            + ", ".join(c.origin for c in self.checks))
+
+    def recover_all(self) -> None:
+        for c in self.checks:
+            if c.recover is not None:
+                c.recover()
+
+
+_LOCK = threading.Lock()
+_PENDING: list[BatchCheck] = []
+
+
+def register(check: BatchCheck) -> BatchCheck:
+    with _LOCK:
+        _PENDING.append(check)
+    return check
+
+
+def verify(checks) -> None:
+    """Resolve the given checks now (syncs); raise on any failure."""
+    checks = list(checks)
+    if not checks:
+        return
+    for c in checks:
+        try:
+            c.flag.copy_to_host_async()
+        except Exception:
+            pass
+    bad = [c for c in checks if bool(np.asarray(c.flag))]
+    with _LOCK:
+        for c in checks:
+            try:
+                _PENDING.remove(c)
+            except ValueError:
+                pass
+    if bad:
+        raise FastPathInvalid(bad)
+
+
+def snapshot() -> int:
+    """Mark the current registry position; checks registered after this
+    belong to the query now starting (the engine executes one query at
+    a time per process — concurrent registrations would interleave)."""
+    with _LOCK:
+        return len(_PENDING)
+
+
+def drain_since(mark: int) -> list:
+    """Remove and return every check registered after `mark`."""
+    with _LOCK:
+        checks = _PENDING[mark:]
+        del _PENDING[mark:]
+    return checks
+
+
+def verify_pending() -> None:
+    """Resolve EVERY outstanding registered check (the collect-boundary
+    safety net for execs that dropped per-batch check tuples)."""
+    with _LOCK:
+        checks = list(_PENDING)
+    verify(checks)
+
+
+def clear_pending() -> None:
+    with _LOCK:
+        _PENDING.clear()

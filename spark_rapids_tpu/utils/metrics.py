@@ -19,16 +19,40 @@ COMPILE_TIME = "compileTime"
 
 
 class MetricSet:
+    """Counters that accept LAZY (device-scalar) values: a metric add of
+    a not-yet-materialized row count must not force a ~150ms device sync
+    in the hot path, so lazy values queue and resolve only when a metric
+    is actually read (test assertions / UI display)."""
+
     def __init__(self):
         self._values = defaultdict(float)
+        self._pending: list = []
 
-    def add(self, name: str, value: float) -> None:
-        self._values[name] += value
+    def add(self, name: str, value) -> None:
+        if isinstance(value, (int, float)):
+            self._values[name] += value
+        else:
+            self._pending.append((name, value))
 
     def set_max(self, name: str, value: float) -> None:
+        self._resolve()
         self._values[name] = max(self._values[name], value)
 
+    def _resolve(self) -> None:
+        if not self._pending:
+            return
+        import numpy as np
+        pending, self._pending = self._pending, []
+        for _, v in pending:
+            try:
+                v.copy_to_host_async()
+            except Exception:
+                pass
+        for name, v in pending:
+            self._values[name] += float(np.asarray(v))
+
     def value(self, name: str) -> float:
+        self._resolve()
         return self._values[name]
 
     @contextmanager
@@ -40,7 +64,8 @@ class MetricSet:
             self.add(name, time.perf_counter_ns() - t0)
 
     def as_dict(self) -> dict:
+        self._resolve()
         return dict(self._values)
 
     def __repr__(self):
-        return f"MetricSet({dict(self._values)})"
+        return f"MetricSet({self.as_dict()})"

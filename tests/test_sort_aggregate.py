@@ -335,3 +335,54 @@ def test_dict_groupby_falls_back_on_minmax():
     np.testing.assert_allclose(
         got.sort_values("k")["mv"].astype(float).to_numpy(),
         exp["mv"].to_numpy(), rtol=1e-6)
+
+
+class TestDictFastPathDeopt:
+    def test_overflow_excess_deopts_and_recovers(self):
+        """First batch sizes a tiny key window; a later batch overflows
+        past the inline budget -> the deferred excess check fires at the
+        collect boundary, the fast path deopts, and the re-executed
+        query returns exact results (utils/checks.py discipline)."""
+        import numpy as np
+        import pandas as pd
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.exec.aggregate import (AggMode,
+                                                     HashAggregateExec)
+        from spark_rapids_tpu.exec.basic import LocalBatchSource
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.exprs.aggregates import Count, Sum
+        from spark_rapids_tpu.exprs.base import col
+
+        rng = np.random.default_rng(11)
+        k1 = rng.integers(0, 8, 512).astype(np.int64)
+        v1 = rng.uniform(0, 10, 512)
+        # batch 2: window anchored at its own kmin=0 with g_pad sized
+        # from batch 1 (8 -> padded) — thousands of distinct overflow
+        # keys blow the inline budget
+        k2 = np.concatenate([rng.integers(0, 8, 100),
+                             rng.integers(10_000, 90_000, 3000)]
+                            ).astype(np.int64)
+        v2 = rng.uniform(0, 10, 3100)
+        b1 = ColumnarBatch.from_numpy({"k": k1, "v": v1})
+        b2 = ColumnarBatch.from_numpy({"k": k2, "v": v2})
+        src = LocalBatchSource([[b1, b2]])
+        agg = HashAggregateExec(
+            [col("k")], [Sum(col("v")).alias("s"),
+                         Count(col("v")).alias("c")],
+            src, mode=AggMode.COMPLETE)
+        conf = C.RapidsConf(
+            {"spark.rapids.sql.variableFloatAgg.enabled": True})
+        with C.session(conf):
+            got = agg.collect().to_pandas().sort_values(
+                "k", ignore_index=True)
+        df = pd.DataFrame({"k": np.concatenate([k1, k2]),
+                           "v": np.concatenate([v1, v2])})
+        exp = df.groupby("k").agg(s=("v", "sum"), c=("v", "size")
+                                  ).reset_index()
+        assert len(got) == len(exp)
+        assert (got["c"].astype(int).to_numpy()
+                == exp["c"].to_numpy()).all()
+        np.testing.assert_allclose(got["s"].astype(float).to_numpy(),
+                                   exp["s"].to_numpy(), rtol=2e-3)
+        # the deopt disabled the fast path on this exec
+        assert agg._dict_range_misses >= 3
