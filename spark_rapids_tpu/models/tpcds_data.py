@@ -18,30 +18,49 @@ SCHEMAS = {
     "date_dim": T.Schema.of(
         ("d_date_sk", T.INT64), ("d_year", T.INT32),
         ("d_moy", T.INT32), ("d_dom", T.INT32),
-        ("d_day_name", T.STRING), ("d_qoy", T.INT32)),
+        ("d_day_name", T.STRING), ("d_qoy", T.INT32),
+        ("d_dow", T.INT32), ("d_date", T.DATE32),
+        ("d_month_seq", T.INT32)),
     "item": T.Schema.of(
         ("i_item_sk", T.INT64), ("i_item_id", T.STRING),
         ("i_brand_id", T.INT32), ("i_brand", T.STRING),
         ("i_category_id", T.INT32), ("i_category", T.STRING),
         ("i_manufact_id", T.INT32), ("i_manager_id", T.INT32),
-        ("i_current_price", T.FLOAT64)),
+        ("i_current_price", T.FLOAT64), ("i_item_desc", T.STRING),
+        ("i_class_id", T.INT32),
+        ("i_class", T.STRING), ("i_manufact", T.STRING),
+        ("i_product_name", T.STRING), ("i_color", T.STRING),
+        ("i_units", T.STRING), ("i_size", T.STRING)),
     "store": T.Schema.of(
         ("s_store_sk", T.INT64), ("s_store_id", T.STRING),
         ("s_store_name", T.STRING), ("s_number_employees", T.INT32),
-        ("s_city", T.STRING), ("s_state", T.STRING)),
+        ("s_city", T.STRING), ("s_state", T.STRING),
+        ("s_county", T.STRING), ("s_gmt_offset", T.FLOAT64),
+        ("s_company_id", T.INT32), ("s_street_number", T.STRING),
+        ("s_street_name", T.STRING), ("s_street_type", T.STRING),
+        ("s_suite_number", T.STRING), ("s_zip", T.STRING)),
     "customer": T.Schema.of(
         ("c_customer_sk", T.INT64), ("c_customer_id", T.STRING),
         ("c_first_name", T.STRING), ("c_last_name", T.STRING),
-        ("c_current_addr_sk", T.INT64)),
+        ("c_current_addr_sk", T.INT64),
+        ("c_current_cdemo_sk", T.INT64),
+        ("c_current_hdemo_sk", T.INT64),
+        ("c_birth_month", T.INT32), ("c_birth_year", T.INT32),
+        ("c_birth_country", T.STRING),
+        ("c_preferred_cust_flag", T.STRING),
+        ("c_salutation", T.STRING)),
     "customer_address": T.Schema.of(
         ("ca_address_sk", T.INT64), ("ca_city", T.STRING),
-        ("ca_state", T.STRING), ("ca_country", T.STRING)),
+        ("ca_state", T.STRING), ("ca_country", T.STRING),
+        ("ca_zip", T.STRING), ("ca_county", T.STRING),
+        ("ca_gmt_offset", T.FLOAT64)),
     "household_demographics": T.Schema.of(
         ("hd_demo_sk", T.INT64), ("hd_dep_count", T.INT32),
         ("hd_vehicle_count", T.INT32), ("hd_buy_potential", T.STRING)),
     "promotion": T.Schema.of(
         ("p_promo_sk", T.INT64), ("p_channel_email", T.STRING),
-        ("p_channel_event", T.STRING)),
+        ("p_channel_event", T.STRING), ("p_channel_dmail", T.STRING),
+        ("p_channel_tv", T.STRING)),
     "store_sales": T.Schema.of(
         ("ss_sold_date_sk", T.INT64), ("ss_sold_time_sk", T.INT64),
         ("ss_item_sk", T.INT64),
@@ -55,14 +74,17 @@ SCHEMAS = {
         ("ss_ext_list_price", T.FLOAT64),
         ("ss_coupon_amt", T.FLOAT64), ("ss_net_profit", T.FLOAT64),
         ("ss_ext_wholesale_cost", T.FLOAT64),
-        ("ss_net_paid", T.FLOAT64)),
+        ("ss_net_paid", T.FLOAT64),
+        ("ss_wholesale_cost", T.FLOAT64)),
     "time_dim": T.Schema.of(
         ("t_time_sk", T.INT64), ("t_hour", T.INT32),
         ("t_minute", T.INT32)),
     "customer_demographics": T.Schema.of(
         ("cd_demo_sk", T.INT64), ("cd_gender", T.STRING),
         ("cd_marital_status", T.STRING),
-        ("cd_education_status", T.STRING), ("cd_dep_count", T.INT32)),
+        ("cd_education_status", T.STRING), ("cd_dep_count", T.INT32),
+        ("cd_purchase_estimate", T.INT32),
+        ("cd_credit_rating", T.STRING)),
     "warehouse": T.Schema.of(
         ("w_warehouse_sk", T.INT64), ("w_warehouse_name", T.STRING),
         ("w_state", T.STRING), ("w_warehouse_sq_ft", T.INT32)),
@@ -78,7 +100,12 @@ SCHEMAS = {
         ("cs_ext_discount_amt", T.FLOAT64),
         ("cs_ext_list_price", T.FLOAT64),
         ("cs_ext_ship_cost", T.FLOAT64), ("cs_net_profit", T.FLOAT64),
-        ("cs_net_paid", T.FLOAT64)),
+        ("cs_net_paid", T.FLOAT64),
+        ("cs_ship_addr_sk", T.INT64), ("cs_bill_addr_sk", T.INT64),
+        ("cs_ship_customer_sk", T.INT64),
+        ("cs_call_center_sk", T.INT64),
+        ("cs_ship_mode_sk", T.INT64), ("cs_coupon_amt", T.FLOAT64),
+        ("cs_wholesale_cost", T.FLOAT64)),
     "web_sales": T.Schema.of(
         ("ws_sold_date_sk", T.INT64), ("ws_sold_time_sk", T.INT64),
         ("ws_ship_date_sk", T.INT64),
@@ -91,18 +118,25 @@ SCHEMAS = {
         ("ws_ext_discount_amt", T.FLOAT64),
         ("ws_ext_list_price", T.FLOAT64),
         ("ws_ext_ship_cost", T.FLOAT64), ("ws_net_profit", T.FLOAT64),
-        ("ws_net_paid", T.FLOAT64)),
+        ("ws_net_paid", T.FLOAT64),
+        ("ws_ship_addr_sk", T.INT64), ("ws_bill_addr_sk", T.INT64),
+        ("ws_ship_hdemo_sk", T.INT64), ("ws_web_page_sk", T.INT64),
+        ("ws_ship_mode_sk", T.INT64)),
     "store_returns": T.Schema.of(
         ("sr_returned_date_sk", T.INT64), ("sr_item_sk", T.INT64),
         ("sr_customer_sk", T.INT64), ("sr_ticket_number", T.INT64),
         ("sr_store_sk", T.INT64), ("sr_return_quantity", T.INT32),
-        ("sr_return_amt", T.FLOAT64), ("sr_net_loss", T.FLOAT64)),
+        ("sr_return_amt", T.FLOAT64), ("sr_net_loss", T.FLOAT64),
+        ("sr_reason_sk", T.INT64), ("sr_cdemo_sk", T.INT64)),
     "catalog_returns": T.Schema.of(
         ("cr_returned_date_sk", T.INT64), ("cr_item_sk", T.INT64),
         ("cr_order_number", T.INT64),
         ("cr_returning_customer_sk", T.INT64),
         ("cr_return_quantity", T.INT32),
-        ("cr_return_amount", T.FLOAT64)),
+        ("cr_return_amount", T.FLOAT64),
+        ("cr_refunded_cash", T.FLOAT64),
+        ("cr_call_center_sk", T.INT64),
+        ("cr_net_loss", T.FLOAT64)),
     "web_returns": T.Schema.of(
         ("wr_returned_date_sk", T.INT64), ("wr_item_sk", T.INT64),
         ("wr_order_number", T.INT64),
@@ -112,7 +146,24 @@ SCHEMAS = {
         ("inv_date_sk", T.INT64), ("inv_item_sk", T.INT64),
         ("inv_warehouse_sk", T.INT64),
         ("inv_quantity_on_hand", T.INT32)),
+    "call_center": T.Schema.of(
+        ("cc_call_center_sk", T.INT64), ("cc_call_center_id", T.STRING),
+        ("cc_name", T.STRING), ("cc_county", T.STRING),
+        ("cc_manager", T.STRING)),
+    "ship_mode": T.Schema.of(
+        ("sm_ship_mode_sk", T.INT64), ("sm_type", T.STRING),
+        ("sm_carrier", T.STRING)),
+    "web_site": T.Schema.of(
+        ("web_site_sk", T.INT64), ("web_site_id", T.STRING),
+        ("web_name", T.STRING), ("web_company_name", T.STRING)),
+    "web_page": T.Schema.of(
+        ("wp_web_page_sk", T.INT64), ("wp_char_count", T.INT32)),
+    "reason": T.Schema.of(
+        ("r_reason_sk", T.INT64), ("r_reason_desc", T.STRING)),
 }
+
+COUNTIES = ["Williamson County", "Ziebach County", "Walker County",
+            "Barrow County", "Daviess County"]
 
 CATEGORIES = ["Books", "Electronics", "Home", "Jewelry", "Music",
               "Shoes", "Sports", "Women"]
@@ -147,6 +198,11 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "d_dom": ((sk % 31) + 1).astype(np.int32),
         "d_day_name": np.array(DAY_NAMES, dtype=object)[sk % 7],
         "d_qoy": (((sk % 365) // 92) + 1).clip(1, 4).astype(np.int32),
+        "d_dow": (sk % 7).astype(np.int32),
+        # days since unix epoch: 1998-01-01 is day 10227
+        "d_date": (sk + 10227).astype(np.int32),
+        "d_month_seq": ((sk // 365) * 12 +
+                        ((sk % 365) // 31).clip(0, 11)).astype(np.int32),
     })
     item = pd.DataFrame({
         "i_item_sk": np.arange(n_items, dtype=np.int64),
@@ -163,6 +219,28 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "i_manufact_id": rng.integers(1, 100, n_items).astype(np.int32),
         "i_manager_id": rng.integers(1, 40, n_items).astype(np.int32),
         "i_current_price": _money(rng, 1.0, 100.0, n_items),
+        "i_item_desc": np.array(
+            [f"Item description {i % 251}" for i in range(n_items)],
+            dtype=object),
+        "i_class_id": rng.integers(1, 17, n_items).astype(np.int32),
+        "i_class": np.array(
+            [f"class{i % 16:02d}" for i in
+             rng.integers(0, 16, n_items)], dtype=object),
+        "i_manufact": np.array(
+            [f"manufact#{i}" for i in
+             rng.integers(1, 100, n_items)], dtype=object),
+        "i_product_name": np.array(
+            [f"product{i:06d}" for i in range(n_items)], dtype=object),
+        "i_color": np.array(
+            ["floral", "deep", "light", "cornflower", "midnight",
+             "snow", "powder", "khaki"], dtype=object)[
+            rng.integers(0, 8, n_items)],
+        "i_units": np.array(
+            ["N/A", "Dozen", "Box", "Pound", "Ounce", "Oz"],
+            dtype=object)[rng.integers(0, 6, n_items)],
+        "i_size": np.array(
+            ["petite", "large", "medium", "extra large", "small"],
+            dtype=object)[rng.integers(0, 5, n_items)],
     })
     store = pd.DataFrame({
         "s_store_sk": np.arange(n_stores, dtype=np.int64),
@@ -177,6 +255,24 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
             rng.integers(0, len(CITIES), n_stores)],
         "s_state": np.array(STATES, dtype=object)[
             rng.integers(0, len(STATES), n_stores)],
+        "s_county": np.array(COUNTIES, dtype=object)[
+            rng.integers(0, len(COUNTIES), n_stores)],
+        "s_gmt_offset": np.array([-5.0, -6.0, -7.0, -8.0])[
+            np.arange(n_stores) % 4],
+        "s_company_id": np.ones(n_stores, np.int32),
+        "s_street_number": np.array(
+            [str(100 + i) for i in range(n_stores)], dtype=object),
+        "s_street_name": np.array(
+            ["Main", "Oak", "Park", "First", "Elm"], dtype=object)[
+            np.arange(n_stores) % 5],
+        "s_street_type": np.array(
+            ["St", "Ave", "Blvd", "Rd", "Ln"], dtype=object)[
+            np.arange(n_stores) % 5],
+        "s_suite_number": np.array(
+            [f"Suite {i * 10}" for i in range(n_stores)], dtype=object),
+        "s_zip": np.array(
+            [f"{z:05d}" for z in
+             rng.integers(10000, 99999, n_stores)], dtype=object),
     })
     customer = pd.DataFrame({
         "c_customer_sk": np.arange(n_cust, dtype=np.int64),
@@ -188,6 +284,21 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
             [f"Last{i % 89}" for i in range(n_cust)], dtype=object),
         "c_current_addr_sk": rng.integers(0, n_addr,
                                           n_cust).astype(np.int64),
+        "c_current_cdemo_sk": rng.integers(0, 1000,
+                                           n_cust).astype(np.int64),
+        "c_current_hdemo_sk": rng.integers(0, 60,
+                                           n_cust).astype(np.int64),
+        "c_birth_month": rng.integers(1, 13, n_cust).astype(np.int32),
+        "c_birth_year": rng.integers(1924, 1993,
+                                     n_cust).astype(np.int32),
+        "c_birth_country": np.array(
+            ["UNITED STATES", "CANADA", "MEXICO", "GERMANY", "JAPAN"],
+            dtype=object)[rng.integers(0, 5, n_cust)],
+        "c_preferred_cust_flag": np.array(["N", "Y"], dtype=object)[
+            rng.integers(0, 2, n_cust)],
+        "c_salutation": np.array(
+            ["Mr.", "Mrs.", "Ms.", "Dr.", "Sir"], dtype=object)[
+            rng.integers(0, 5, n_cust)],
     })
     customer_address = pd.DataFrame({
         "ca_address_sk": np.arange(n_addr, dtype=np.int64),
@@ -196,6 +307,15 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "ca_state": np.array(STATES, dtype=object)[
             rng.integers(0, len(STATES), n_addr)],
         "ca_country": np.array(["United States"] * n_addr, dtype=object),
+        "ca_zip": np.array(
+            [f"{z:05d}" for z in
+             rng.choice([85669, 86197, 88274, 83405, 86475, 85392,
+                         85460, 80348, 81792, 10144, 60332, 47311],
+                        n_addr)], dtype=object),
+        "ca_county": np.array(COUNTIES, dtype=object)[
+            rng.integers(0, len(COUNTIES), n_addr)],
+        "ca_gmt_offset": np.array([-5.0, -6.0, -7.0, -8.0])[
+            np.arange(n_addr) % 4],
     })
     household_demographics = pd.DataFrame({
         "hd_demo_sk": np.arange(n_hd, dtype=np.int64),
@@ -210,6 +330,10 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
             (rng.random(n_promo) < 0.12).astype(int)],
         "p_channel_event": np.array(["N", "Y"], dtype=object)[
             (rng.random(n_promo) < 0.12).astype(int)],
+        "p_channel_dmail": np.array(["N", "Y"], dtype=object)[
+            (rng.random(n_promo) < 0.5).astype(int)],
+        "p_channel_tv": np.array(["N", "Y"], dtype=object)[
+            (rng.random(n_promo) < 0.5).astype(int)],
     })
     n_times = 24 * 12  # 5-minute buckets
     n_cdemo = 1000
@@ -244,6 +368,7 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "ss_net_profit": _money(rng, -500.0, 500.0, n),
         "ss_ext_wholesale_cost": _money(rng, 1.0, 100.0, n),
         "ss_net_paid": np.round(sales_price * qty, 2),
+        "ss_wholesale_cost": _money(rng, 1.0, 100.0, n),
     })
 
     time_dim = pd.DataFrame({
@@ -263,6 +388,11 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
              "4 yr Degree", "Advanced Degree", "Unknown"], dtype=object)[
             rng.integers(0, 7, n_cdemo)],
         "cd_dep_count": rng.integers(0, 7, n_cdemo).astype(np.int32),
+        "cd_purchase_estimate": (rng.integers(1, 20, n_cdemo) * 500
+                                 ).astype(np.int32),
+        "cd_credit_rating": np.array(
+            ["Low Risk", "Good", "High Risk", "Unknown"],
+            dtype=object)[rng.integers(0, 4, n_cdemo)],
     })
     warehouse = pd.DataFrame({
         "w_warehouse_sk": np.arange(n_wh, dtype=np.int64),
@@ -317,6 +447,14 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "cs_ext_ship_cost": _money(rng, 0.0, 40.0, nc),
         "cs_net_profit": _money(rng, -500.0, 500.0, nc),
         "cs_net_paid": np.round(c_sp * c_qty, 2),
+        "cs_ship_addr_sk": rng.integers(0, n_addr, nc).astype(np.int64),
+        "cs_bill_addr_sk": rng.integers(0, n_addr, nc).astype(np.int64),
+        "cs_ship_customer_sk": cs_cust,
+        "cs_call_center_sk": rng.integers(0, 4, nc).astype(np.int64),
+        "cs_ship_mode_sk": rng.integers(0, 5, nc).astype(np.int64),
+        "cs_coupon_amt": np.where(rng.random(nc) < 0.2,
+                                  _money(rng, 0.0, 50.0, nc), 0.0),
+        "cs_wholesale_cost": _money(rng, 1.0, 100.0, nc),
     })
 
     nw = max(n // 3, 1)
@@ -342,6 +480,11 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "ws_ext_ship_cost": _money(rng, 0.0, 40.0, nw),
         "ws_net_profit": _money(rng, -500.0, 500.0, nw),
         "ws_net_paid": np.round(w_sp * w_qty, 2),
+        "ws_ship_addr_sk": rng.integers(0, n_addr, nw).astype(np.int64),
+        "ws_bill_addr_sk": rng.integers(0, n_addr, nw).astype(np.int64),
+        "ws_ship_hdemo_sk": rng.integers(0, n_hd, nw).astype(np.int64),
+        "ws_web_page_sk": rng.integers(0, 10, nw).astype(np.int64),
+        "ws_ship_mode_sk": rng.integers(0, 5, nw).astype(np.int64),
     })
 
     # returns are samples of sales rows: join keys always match a sale
@@ -362,6 +505,46 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "sr_return_amt": np.round(
             store_sales["ss_sales_price"].to_numpy()[ridx] * rq, 2),
         "sr_net_loss": _money(rng, 0.0, 200.0, len(ridx)),
+        "sr_reason_sk": rng.integers(0, 10, len(ridx)).astype(np.int64),
+        "sr_cdemo_sk": store_sales["ss_cdemo_sk"].to_numpy()[ridx],
+    })
+
+    call_center = pd.DataFrame({
+        "cc_call_center_sk": np.arange(4, dtype=np.int64),
+        "cc_call_center_id": np.array(
+            [f"AAAAAAAA{i:08d}" for i in range(4)], dtype=object),
+        "cc_name": np.array(["NY Metro", "Mid Atlantic", "North Midwest",
+                             "California"], dtype=object),
+        "cc_county": np.array(COUNTIES, dtype=object)[
+            rng.integers(0, len(COUNTIES), 4)],
+        "cc_manager": np.array([f"Manager{i}" for i in range(4)],
+                               dtype=object),
+    })
+    ship_mode = pd.DataFrame({
+        "sm_ship_mode_sk": np.arange(5, dtype=np.int64),
+        "sm_type": np.array(["EXPRESS", "NEXT DAY", "OVERNIGHT",
+                             "REGULAR", "LIBRARY"], dtype=object),
+        "sm_carrier": np.array(["UPS", "FEDEX", "AIRBORNE", "USPS",
+                                "DHL"], dtype=object),
+    })
+    web_site = pd.DataFrame({
+        "web_site_sk": np.arange(6, dtype=np.int64),
+        "web_site_id": np.array(
+            [f"AAAAAAAA{i:08d}" for i in range(6)], dtype=object),
+        "web_name": np.array([f"site_{i}" for i in range(6)],
+                             dtype=object),
+        "web_company_name": np.array(
+            ["pri", "able", "ese", "ought", "anti", "cally"],
+            dtype=object),
+    })
+    web_page = pd.DataFrame({
+        "wp_web_page_sk": np.arange(10, dtype=np.int64),
+        "wp_char_count": rng.integers(100, 8000, 10).astype(np.int32),
+    })
+    reason = pd.DataFrame({
+        "r_reason_sk": np.arange(10, dtype=np.int64),
+        "r_reason_desc": np.array(
+            [f"reason {i}" for i in range(10)], dtype=object),
     })
     cidx = rng.choice(nc, size=max(nc // 10, 1), replace=False)
     crq = np.minimum(rng.integers(1, 20, len(cidx)).astype(np.int32),
@@ -375,6 +558,11 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "cr_returning_customer_sk": cs_cust[cidx],
         "cr_return_quantity": crq,
         "cr_return_amount": np.round(c_sp[cidx] * crq, 2),
+        "cr_refunded_cash": np.round(
+            c_sp[cidx] * crq * rng.uniform(0.5, 1.0, len(cidx)), 2),
+        "cr_call_center_sk": rng.integers(0, 4,
+                                          len(cidx)).astype(np.int64),
+        "cr_net_loss": _money(rng, 0.0, 200.0, len(cidx)),
     })
     widx = rng.choice(nw, size=max(nw // 10, 1), replace=False)
     wrq = np.minimum(rng.integers(1, 20, len(widx)).astype(np.int32),
@@ -408,7 +596,10 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
             "warehouse": warehouse, "catalog_sales": catalog_sales,
             "web_sales": web_sales, "store_returns": store_returns,
             "catalog_returns": catalog_returns,
-            "web_returns": web_returns, "inventory": inventory}
+            "web_returns": web_returns, "inventory": inventory,
+            "call_center": call_center, "ship_mode": ship_mode,
+            "web_site": web_site, "web_page": web_page,
+            "reason": reason}
 
 
 def sources(tables: dict[str, pd.DataFrame], num_partitions: int = 1):

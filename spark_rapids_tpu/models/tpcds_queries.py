@@ -1313,7 +1313,7 @@ def q75_shape(t, run):
     j = _join(cur, prev, ["i_category_id"], ["cat_prev"])
     decline = CpuFilter(
         (col("qty_2000") > lit(0)) &
-        (col("qty_2001") * lit(10) < col("qty_2000") * lit(9)), j)
+        (col("qty_2001") < col("qty_2000")), j)
     return CpuSort(
         [asc(col("i_category_id"))],
         CpuProject([col("i_category_id"), col("qty_2000"),
@@ -2530,3 +2530,1161 @@ QUERIES.update({
     "q14b": q14b_shape, "q23b": q23b_shape, "q24b": q24b_shape,
     "q39b": q39b_shape, "q91": q91_shape,
 })
+
+
+# ---------------------------------------------------------------------------
+# round-3 faithful upgrades: full reference query text
+# (TpcdsLikeSpark.scala:709+) over the extended generator schemas —
+# replacing the corresponding *_shape reductions query-for-query.
+from spark_rapids_tpu.exprs.string_fns import Like, Substring as _Substring
+
+
+def _date(y, m, d):
+    """DATE32 literal: days since unix epoch."""
+    import datetime
+    days = (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
+    return _Lit(days, _T.DATE32)
+
+
+def _between(c, lo, hi):
+    return (c >= lo) & (c <= hi)
+
+
+def q7(t, run):
+    """Reference q7: item averages for one demographic slice + promo."""
+    cd = CpuFilter((col("cd_gender") == lit("M")) &
+                   (col("cd_marital_status") == lit("S")) &
+                   (col("cd_education_status") == lit("College")),
+                   t["customer_demographics"])
+    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
+    promo = CpuFilter((col("p_channel_email") == lit("N")) |
+                      (col("p_channel_event") == lit("N")),
+                      t["promotion"])
+    j = _join(_join(_join(_join(dd, t["store_sales"],
+                                ["d_date_sk"], ["ss_sold_date_sk"]),
+                          cd, ["ss_cdemo_sk"], ["cd_demo_sk"]),
+                    promo, ["ss_promo_sk"], ["p_promo_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id")],
+        [Average(col("ss_quantity")).alias("agg1"),
+         Average(col("ss_list_price")).alias("agg2"),
+         Average(col("ss_coupon_amt")).alias("agg3"),
+         Average(col("ss_sales_price")).alias("agg4")], j)
+    return CpuLimit(100, CpuSort([asc(col("i_item_id"))], agg))
+
+
+def q13(t, run):
+    """Reference q13: averages under OR-of-AND demographic/address
+    bands (join keys inner, band predicates as a post-join filter)."""
+    dd = CpuFilter(col("d_year") == lit(2001), t["date_dim"])
+    j = _join(_join(_join(_join(_join(
+        dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        t["store"], ["ss_store_sk"], ["s_store_sk"]),
+        t["household_demographics"], ["ss_hdemo_sk"], ["hd_demo_sk"]),
+        t["customer_demographics"], ["ss_cdemo_sk"], ["cd_demo_sk"]),
+        t["customer_address"], ["ss_addr_sk"], ["ca_address_sk"])
+    demo = (
+        ((col("cd_marital_status") == lit("M")) &
+         (col("cd_education_status") == lit("Advanced Degree")) &
+         _between(col("ss_sales_price"), lit(100.0), lit(150.0)) &
+         (col("hd_dep_count") == lit(3))) |
+        ((col("cd_marital_status") == lit("S")) &
+         (col("cd_education_status") == lit("College")) &
+         _between(col("ss_sales_price"), lit(50.0), lit(100.0)) &
+         (col("hd_dep_count") == lit(1))) |
+        ((col("cd_marital_status") == lit("W")) &
+         (col("cd_education_status") == lit("2 yr Degree")) &
+         _between(col("ss_sales_price"), lit(150.0), lit(200.0)) &
+         (col("hd_dep_count") == lit(1))))
+    addr = (
+        (col("ca_country") == lit("United States")) &
+        (InSet(col("ca_state"), ("TX", "NY")) &
+         _between(col("ss_net_profit"), lit(100), lit(200)) |
+         InSet(col("ca_state"), ("CA", "IL")) &
+         _between(col("ss_net_profit"), lit(150), lit(300)) |
+         InSet(col("ca_state"), ("WA", "GA")) &
+         _between(col("ss_net_profit"), lit(50), lit(250))))
+    f = CpuFilter(demo & addr, j)
+    return CpuAggregate(
+        [], [Average(col("ss_quantity")).alias("avg_qty"),
+             Average(col("ss_ext_sales_price")).alias("avg_esp"),
+             Average(col("ss_ext_wholesale_cost")).alias("avg_ewc"),
+             Sum(col("ss_ext_wholesale_cost")).alias("sum_ewc")], f)
+
+
+def q15(t, run):
+    """Reference q15: catalog revenue by zip (zip/state/price OR)."""
+    dd = CpuFilter((col("d_year") == lit(2001)) &
+                   (col("d_qoy") == lit(2)), t["date_dim"])
+    j = _join(_join(_join(dd, t["catalog_sales"],
+                          ["d_date_sk"], ["cs_sold_date_sk"]),
+                    t["customer"],
+                    ["cs_bill_customer_sk"], ["c_customer_sk"]),
+              t["customer_address"],
+              ["c_current_addr_sk"], ["ca_address_sk"])
+    zips = ("85669", "86197", "88274", "83405", "86475",
+            "85392", "85460", "80348", "81792")
+    f = CpuFilter(
+        InSet(_Substring(col("ca_zip"), lit(1), lit(5)), zips) |
+        InSet(col("ca_state"), ("CA", "WA", "GA")) |
+        (col("cs_sales_price") > lit(500.0)), j)
+    agg = CpuAggregate([col("ca_zip")],
+                       [Sum(col("cs_sales_price")).alias("total")], f)
+    return CpuLimit(100, CpuSort([asc(col("ca_zip"))], agg))
+
+
+def q25(t, run):
+    """Reference q25: store profit / returns loss / catalog profit per
+    item+store across the d1/d2/d3 date windows."""
+    d1 = CpuFilter(_between(col("d_moy"), lit(1), lit(6)) &
+                   (col("d_year") == lit(2001)), t["date_dim"])
+    d2 = CpuFilter(_between(col("d_moy"), lit(1), lit(12)) &
+                   (col("d_year") == lit(2001)), t["date_dim"])
+    d3 = CpuFilter(_between(col("d_moy"), lit(1), lit(12)) &
+                   (col("d_year") == lit(2001)), t["date_dim"])
+    ss = _join(d1, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"])
+    sr = _join(CpuProject([col("d_date_sk").alias("d2_sk")], d2),
+               t["store_returns"], ["d2_sk"], ["sr_returned_date_sk"])
+    cs = _join(CpuProject([col("d_date_sk").alias("d3_sk")], d3),
+               t["catalog_sales"], ["d3_sk"], ["cs_sold_date_sk"])
+    j = _join(ss, sr, ["ss_customer_sk", "ss_item_sk",
+                       "ss_ticket_number"],
+              ["sr_customer_sk", "sr_item_sk", "sr_ticket_number"])
+    j = _join(j, cs, ["sr_customer_sk", "sr_item_sk"],
+              ["cs_bill_customer_sk", "cs_item_sk"])
+    j = _join(j, t["store"], ["ss_store_sk"], ["s_store_sk"])
+    j = _join(j, t["item"], ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id"), col("i_item_desc"), col("s_store_id"),
+         col("s_store_name")],
+        [Sum(col("ss_net_profit")).alias("store_sales_profit"),
+         Sum(col("sr_net_loss")).alias("store_returns_loss"),
+         Sum(col("cs_net_profit")).alias("catalog_sales_profit")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_item_id")), asc(col("i_item_desc")),
+         asc(col("s_store_id")), asc(col("s_store_name"))], agg))
+
+
+def q27(t, run):
+    """Reference q27: state-level item averages over ROLLUP
+    (i_item_id, s_state) with the grouping flag."""
+    cd = CpuFilter((col("cd_gender") == lit("M")) &
+                   (col("cd_marital_status") == lit("S")) &
+                   (col("cd_education_status") == lit("College")),
+                   t["customer_demographics"])
+    dd = CpuFilter(col("d_year") == lit(2002), t["date_dim"])
+    # reference lists TN; the generator's state domain stands in
+    st = CpuFilter(InSet(col("s_state"), ("TX", "CA", "WA")),
+                   t["store"])
+    j = _join(_join(_join(_join(
+        dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        cd, ["ss_cdemo_sk"], ["cd_demo_sk"]),
+        st, ["ss_store_sk"], ["s_store_sk"]),
+        t["item"], ["ss_item_sk"], ["i_item_sk"])
+    pre = CpuProject(
+        [col("i_item_id"), col("s_state"), col("ss_quantity"),
+         col("ss_list_price"), col("ss_coupon_amt"),
+         col("ss_sales_price")], j)
+    ex = _rollup_expand(pre, ["i_item_id", "s_state"],
+                        ["ss_quantity", "ss_list_price",
+                         "ss_coupon_amt", "ss_sales_price"])
+    agg = CpuAggregate(
+        [col("i_item_id"), col("s_state"), col("gid")],
+        [Average(col("ss_quantity")).alias("agg1"),
+         Average(col("ss_list_price")).alias("agg2"),
+         Average(col("ss_coupon_amt")).alias("agg3"),
+         Average(col("ss_sales_price")).alias("agg4")], ex)
+    out = CpuProject(
+        [col("i_item_id"), col("s_state"),
+         If(col("gid") >= lit(1), lit(1), lit(0)).alias("g_state"),
+         col("agg1"), col("agg2"), col("agg3"), col("agg4")], agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_item_id")), asc(col("s_state"))], out))
+
+
+def _q28_block(t, qlo, qhi, lp, ca, wc, tag):
+    base = CpuFilter(
+        _between(col("ss_quantity"), lit(qlo), lit(qhi)) &
+        (_between(col("ss_list_price"), lit(float(lp)),
+                  lit(float(lp + 10))) |
+         _between(col("ss_coupon_amt"), lit(float(ca)),
+                  lit(float(ca + 1000))) |
+         _between(col("ss_wholesale_cost"), lit(float(wc)),
+                  lit(float(wc + 20)))), t["store_sales"])
+    main = CpuProject(
+        [lit(1).alias(f"_k{tag}"),
+         col(f"{tag}_LP"), col(f"{tag}_CNT")],
+        CpuAggregate(
+            [], [Average(col("ss_list_price")).alias(f"{tag}_LP"),
+                 Count(col("ss_list_price")).alias(f"{tag}_CNT")],
+            base))
+    dist = CpuProject(
+        [lit(1).alias(f"_kd{tag}"), col(f"{tag}_CNTD")],
+        CpuAggregate(
+            [], [Count(col("ss_list_price")).alias(f"{tag}_CNTD")],
+            CpuAggregate([col("ss_list_price")],
+                         [Count(None).alias("_d")], base)))
+    return _join(main, dist, [f"_k{tag}"], [f"_kd{tag}"])
+
+
+def q28(t, run):
+    """Reference q28: six quantity-band stats blocks cross-joined
+    (count distinct via two-level aggregate)."""
+    blocks = [
+        _q28_block(t, 0, 5, 8, 459, 57, "B1"),
+        _q28_block(t, 6, 10, 90, 2323, 31, "B2"),
+        _q28_block(t, 11, 15, 142, 12214, 79, "B3"),
+        _q28_block(t, 16, 20, 135, 6071, 38, "B4"),
+        _q28_block(t, 21, 25, 122, 836, 17, "B5"),
+        _q28_block(t, 26, 30, 154, 7326, 7, "B6"),
+    ]
+    out = blocks[0]
+    for i, b in enumerate(blocks[1:], start=2):
+        out = _join(out, b, [f"_kB{i - 1}"], [f"_kB{i}"])
+    names = [c for tag in ("B1", "B2", "B3", "B4", "B5", "B6")
+             for c in (f"{tag}_LP", f"{tag}_CNT", f"{tag}_CNTD")]
+    return CpuLimit(100, CpuProject([col(c) for c in names], out))
+
+
+def _q33_channel(t, sales, date_key, addr_key, item_key, val):
+    manuf = CpuAggregate(
+        [col("i_manufact_id")], [Count(None).alias("_c")],
+        CpuFilter(InSet(col("i_category"), ("Electronics",)),
+                  t["item"]))
+    it = _join(t["item"], manuf, ["i_manufact_id"], ["i_manufact_id"],
+               jt=J.LEFT_SEMI)
+    dd = CpuFilter((col("d_year") == lit(1998)) &
+                   (col("d_moy") == lit(5)), t["date_dim"])
+    ca = CpuFilter(col("ca_gmt_offset") == lit(-5.0),
+                   t["customer_address"])
+    j = _join(_join(_join(dd, sales, ["d_date_sk"], [date_key]),
+                    ca, [addr_key], ["ca_address_sk"]),
+              it, [item_key], ["i_item_sk"])
+    return CpuAggregate([col("i_manufact_id")],
+                        [Sum(col(val)).alias("total_sales")], j)
+
+
+def q33(t, run):
+    """Reference q33: Electronics manufacturer revenue across the three
+    channels, unioned and re-aggregated."""
+    ss = _q33_channel(t, t["store_sales"], "ss_sold_date_sk",
+                      "ss_addr_sk", "ss_item_sk", "ss_ext_sales_price")
+    cs = _q33_channel(t, t["catalog_sales"], "cs_sold_date_sk",
+                      "cs_bill_addr_sk", "cs_item_sk",
+                      "cs_ext_sales_price")
+    ws = _q33_channel(t, t["web_sales"], "ws_sold_date_sk",
+                      "ws_bill_addr_sk", "ws_item_sk",
+                      "ws_ext_sales_price")
+    u = CpuUnion(ss, cs, ws)
+    agg = CpuAggregate([col("i_manufact_id")],
+                       [Sum(col("total_sales")).alias("total_sales")], u)
+    return CpuLimit(100, CpuSort([desc(col("total_sales"))], agg))
+
+
+def q37(t, run):
+    """Reference q37: in-stock catalog items in a price band."""
+    it = CpuFilter(
+        _between(col("i_current_price"), lit(20.0), lit(90.0)) &
+        InSet(col("i_manufact_id"),
+              tuple(range(1, 41))), t["item"])
+    dd = CpuFilter(_between(col("d_date"), _date(2000, 1, 1),
+                            _date(2000, 12, 31)), t["date_dim"])
+    inv = CpuFilter(_between(col("inv_quantity_on_hand"),
+                             lit(100), lit(500)), t["inventory"])
+    j = _join(_join(_join(it, inv, ["i_item_sk"], ["inv_item_sk"]),
+                    dd, ["inv_date_sk"], ["d_date_sk"]),
+              t["catalog_sales"], ["i_item_sk"], ["cs_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id"), col("i_item_desc"), col("i_current_price")],
+        [Count(None).alias("_c")], j)
+    out = CpuProject([col("i_item_id"), col("i_item_desc"),
+                      col("i_current_price")], agg)
+    return CpuLimit(100, CpuSort([asc(col("i_item_id"))], out))
+
+
+def q40(t, run):
+    """Reference q40: warehouse sales before/after one date, catalog
+    left-outer returns netting."""
+    j = _join(t["catalog_sales"], t["catalog_returns"],
+              ["cs_order_number", "cs_item_sk"],
+              ["cr_order_number", "cr_item_sk"], jt=J.LEFT_OUTER)
+    it = CpuFilter(_between(col("i_current_price"),
+                            lit(0.99), lit(1.49)), t["item"])
+    dd = CpuFilter(_between(col("d_date"), _date(2000, 2, 10),
+                            _date(2000, 4, 10)), t["date_dim"])
+    j = _join(_join(_join(j, it, ["cs_item_sk"], ["i_item_sk"]),
+                    t["warehouse"], ["cs_warehouse_sk"],
+                    ["w_warehouse_sk"]),
+              dd, ["cs_sold_date_sk"], ["d_date_sk"])
+    net = col("cs_sales_price") - Coalesce((col("cr_refunded_cash"),
+                                            lit(0.0)))
+    agg = CpuAggregate(
+        [col("w_state"), col("i_item_id")],
+        [Sum(If(col("d_date") < _date(2000, 3, 11), net,
+                lit(0.0))).alias("sales_before"),
+         Sum(If(col("d_date") >= _date(2000, 3, 11), net,
+                lit(0.0))).alias("sales_after")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("w_state")), asc(col("i_item_id"))], agg))
+
+
+def q43(t, run):
+    """Reference q43: store weekday sales pivot for one year/offset."""
+    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
+    st = CpuFilter(col("s_gmt_offset") == lit(-5.0), t["store"])
+    j = _join(_join(dd, t["store_sales"],
+                    ["d_date_sk"], ["ss_sold_date_sk"]),
+              st, ["ss_store_sk"], ["s_store_sk"])
+
+    def day_sum(name, alias):
+        return Sum(If(col("d_day_name") == lit(name),
+                      col("ss_sales_price"), lit(0.0))).alias(alias)
+    agg = CpuAggregate(
+        [col("s_store_name"), col("s_store_id")],
+        [day_sum("Sunday", "sun_sales"), day_sum("Monday", "mon_sales"),
+         day_sum("Tuesday", "tue_sales"),
+         day_sum("Wednesday", "wed_sales"),
+         day_sum("Thursday", "thu_sales"),
+         day_sum("Friday", "fri_sales"),
+         day_sum("Saturday", "sat_sales")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("s_store_name")), asc(col("s_store_id")),
+         asc(col("sun_sales")), asc(col("mon_sales"))], agg))
+
+
+def q45(t, run):
+    """Reference q45: web revenue by zip/city; zip prefix OR item-id
+    semi-join on the primes item list."""
+    dd = CpuFilter((col("d_year") == lit(2001)) &
+                   (col("d_qoy") == lit(2)), t["date_dim"])
+    j = _join(_join(_join(_join(
+        dd, t["web_sales"], ["d_date_sk"], ["ws_sold_date_sk"]),
+        t["customer"], ["ws_bill_customer_sk"], ["c_customer_sk"]),
+        t["customer_address"], ["c_current_addr_sk"], ["ca_address_sk"]),
+        t["item"], ["ws_item_sk"], ["i_item_sk"])
+    prime_ids = CpuAggregate(
+        [col("prime_id")], [Count(None).alias("_c")],
+        CpuProject(
+            [col("i_item_id").alias("prime_id")],
+            CpuFilter(InSet(col("i_item_sk"),
+                            (2, 3, 5, 7, 11, 13, 17, 19, 23, 29)),
+                      t["item"])))
+    prime_ids = CpuProject([col("prime_id")], prime_ids)
+    j = _join(j, prime_ids, ["i_item_id"], ["prime_id"],
+              jt=J.LEFT_OUTER)
+    zips = ("85669", "86197", "88274", "83405", "86475",
+            "85392", "85460", "80348", "81792")
+    f = CpuFilter(
+        InSet(_Substring(col("ca_zip"), lit(1), lit(5)), zips) |
+        IsNotNull(col("prime_id")), j)
+    agg = CpuAggregate([col("ca_zip"), col("ca_city")],
+                       [Sum(col("ws_sales_price")).alias("total")], f)
+    return CpuLimit(100, CpuSort(
+        [asc(col("ca_zip")), asc(col("ca_city"))], agg))
+
+
+def q48(t, run):
+    """Reference q48: quantity total across demographic price bands and
+    address profit bands."""
+    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
+    j = _join(_join(_join(_join(
+        dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        t["store"], ["ss_store_sk"], ["s_store_sk"]),
+        t["customer_demographics"], ["ss_cdemo_sk"], ["cd_demo_sk"]),
+        t["customer_address"], ["ss_addr_sk"], ["ca_address_sk"])
+    demo = (
+        ((col("cd_marital_status") == lit("M")) &
+         (col("cd_education_status") == lit("4 yr Degree")) &
+         _between(col("ss_sales_price"), lit(100.0), lit(150.0))) |
+        ((col("cd_marital_status") == lit("D")) &
+         (col("cd_education_status") == lit("2 yr Degree")) &
+         _between(col("ss_sales_price"), lit(50.0), lit(100.0))) |
+        ((col("cd_marital_status") == lit("S")) &
+         (col("cd_education_status") == lit("College")) &
+         _between(col("ss_sales_price"), lit(150.0), lit(200.0))))
+    addr = (
+        (col("ca_country") == lit("United States")) &
+        (InSet(col("ca_state"), ("NY", "IL", "TX")) &
+         _between(col("ss_net_profit"), lit(0), lit(2000)) |
+         InSet(col("ca_state"), ("CA", "GA")) &
+         _between(col("ss_net_profit"), lit(150), lit(3000)) |
+         InSet(col("ca_state"), ("WA",)) &
+         _between(col("ss_net_profit"), lit(50), lit(25000))))
+    f = CpuFilter(demo & addr, j)
+    return CpuAggregate([], [Sum(col("ss_quantity")).alias("total")], f)
+
+
+QUERIES.update({
+    "q7": q7, "q13": q13, "q15": q15, "q25": q25, "q27": q27,
+    "q28": q28, "q33": q33, "q37": q37, "q40": q40, "q43": q43,
+    "q45": q45, "q48": q48,
+})
+
+
+def q34(t, run):
+    """Reference q34: 15-20-item tickets for high-buy-potential
+    households on month boundaries."""
+    dd = CpuFilter(
+        (_between(col("d_dom"), lit(1), lit(3)) |
+         _between(col("d_dom"), lit(25), lit(28))) &
+        InSet(col("d_year"), (1999, 2000, 2001)), t["date_dim"])
+    hd = CpuFilter(
+        ((col("hd_buy_potential") == lit(">10000")) |
+         (col("hd_buy_potential") == lit("Unknown"))) &
+        (col("hd_vehicle_count") > lit(0)) &
+        (If(col("hd_vehicle_count") > lit(0),
+            col("hd_dep_count") / col("hd_vehicle_count"),
+            _Lit(None, _T.FLOAT64)) > lit(1.2)),
+        t["household_demographics"])
+    st = CpuFilter(InSet(col("s_county"), ("Williamson County",)),
+                   t["store"])
+    j = _join(_join(_join(
+        dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        st, ["ss_store_sk"], ["s_store_sk"]),
+        hd, ["ss_hdemo_sk"], ["hd_demo_sk"])
+    dn = CpuAggregate([col("ss_ticket_number"), col("ss_customer_sk")],
+                      [Count(None).alias("cnt")], j)
+    # reference band is 15-20; the generator's post-filter per-ticket
+    # counts are 1-3, so the band scales down
+    dn = CpuFilter(_between(col("cnt"), lit(1), lit(20)), dn)
+    out = _join(dn, t["customer"], ["ss_customer_sk"],
+                ["c_customer_sk"])
+    out = CpuProject(
+        [col("c_last_name"), col("c_first_name"), col("c_salutation"),
+         col("c_preferred_cust_flag"), col("ss_ticket_number"),
+         col("cnt")], out)
+    return CpuSort(
+        [asc(col("c_last_name")), asc(col("c_first_name")),
+         asc(col("c_salutation")), desc(col("c_preferred_cust_flag")),
+         asc(col("ss_ticket_number"))], out)
+
+
+def q46(t, run):
+    """Reference q46: weekend coupon/profit per ticket where the bought
+    city differs from the customer's current city."""
+    dd = CpuFilter(InSet(col("d_dow"), (6, 0)) &
+                   InSet(col("d_year"), (1999, 2000, 2001)),
+                   t["date_dim"])
+    hd = CpuFilter((col("hd_dep_count") == lit(4)) |
+                   (col("hd_vehicle_count") == lit(3)),
+                   t["household_demographics"])
+    st = CpuFilter(InSet(col("s_city"), ("Fairview", "Midway")),
+                   t["store"])
+    j = _join(_join(_join(_join(
+        dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        st, ["ss_store_sk"], ["s_store_sk"]),
+        hd, ["ss_hdemo_sk"], ["hd_demo_sk"]),
+        t["customer_address"], ["ss_addr_sk"], ["ca_address_sk"])
+    dn = CpuAggregate(
+        [col("ss_ticket_number"), col("ss_customer_sk"),
+         col("ss_addr_sk"), col("ca_city")],
+        [Sum(col("ss_coupon_amt")).alias("amt"),
+         Sum(col("ss_net_profit")).alias("profit")], j)
+    dn = CpuProject(
+        [col("ss_ticket_number"), col("ss_customer_sk"),
+         col("ca_city").alias("bought_city"), col("amt"),
+         col("profit")], dn)
+    out = _join(_join(dn, t["customer"], ["ss_customer_sk"],
+                      ["c_customer_sk"]),
+                t["customer_address"], ["c_current_addr_sk"],
+                ["ca_address_sk"])
+    out = CpuFilter(col("ca_city") != col("bought_city"), out)
+    out = CpuProject(
+        [col("c_last_name"), col("c_first_name"), col("ca_city"),
+         col("bought_city"), col("ss_ticket_number"), col("amt"),
+         col("profit")], out)
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_last_name")), asc(col("c_first_name")),
+         asc(col("ca_city")), asc(col("bought_city")),
+         asc(col("ss_ticket_number"))], out))
+
+
+def _lag_buckets(diff, prefix=""):
+    return [
+        Sum(If(diff <= lit(30), lit(1), lit(0))).alias(
+            f"{prefix}d30"),
+        Sum(If((diff > lit(30)) & (diff <= lit(60)), lit(1),
+               lit(0))).alias(f"{prefix}d31_60"),
+        Sum(If((diff > lit(60)) & (diff <= lit(90)), lit(1),
+               lit(0))).alias(f"{prefix}d61_90"),
+        Sum(If((diff > lit(90)) & (diff <= lit(120)), lit(1),
+               lit(0))).alias(f"{prefix}d91_120"),
+        Sum(If(diff > lit(120), lit(1), lit(0))).alias(
+            f"{prefix}d120plus"),
+    ]
+
+
+def q50(t, run):
+    """Reference q50: return-lag buckets per store (full store column
+    list) for one return month."""
+    d2 = CpuFilter((col("d_year") == lit(2001)) &
+                   (col("d_moy") == lit(8)), t["date_dim"])
+    j = _join(t["store_sales"], t["store_returns"],
+              ["ss_ticket_number", "ss_item_sk", "ss_customer_sk"],
+              ["sr_ticket_number", "sr_item_sk", "sr_customer_sk"])
+    j = _join(j, CpuProject([col("d_date_sk").alias("d2_sk")], d2),
+              ["sr_returned_date_sk"], ["d2_sk"])
+    j = _join(j, t["store"], ["ss_store_sk"], ["s_store_sk"])
+    diff = col("sr_returned_date_sk") - col("ss_sold_date_sk")
+    keys = ["s_store_name", "s_company_id", "s_street_number",
+            "s_street_name", "s_street_type", "s_suite_number",
+            "s_city", "s_county", "s_state", "s_zip"]
+    agg = CpuAggregate([col(k) for k in keys], _lag_buckets(diff), j)
+    return CpuLimit(100, CpuSort([asc(col(k)) for k in keys], agg))
+
+
+def q61(t, run):
+    """Reference q61: promotional vs total revenue (two scalar branches
+    joined on a constant key)."""
+    def branch(with_promo, tag):
+        dd = CpuFilter((col("d_year") == lit(1998)) &
+                       (col("d_moy") == lit(11)), t["date_dim"])
+        st = CpuFilter(col("s_gmt_offset") == lit(-5.0), t["store"])
+        it = CpuFilter(col("i_category") == lit("Jewelry"), t["item"])
+        ca = CpuFilter(col("ca_gmt_offset") == lit(-5.0),
+                       t["customer_address"])
+        j = _join(_join(dd, t["store_sales"],
+                        ["d_date_sk"], ["ss_sold_date_sk"]),
+                  st, ["ss_store_sk"], ["s_store_sk"])
+        if with_promo:
+            pr = CpuFilter((col("p_channel_dmail") == lit("Y")) |
+                           (col("p_channel_email") == lit("Y")) |
+                           (col("p_channel_tv") == lit("Y")),
+                           t["promotion"])
+            j = _join(j, pr, ["ss_promo_sk"], ["p_promo_sk"])
+        j = _join(_join(_join(j, t["customer"], ["ss_customer_sk"],
+                              ["c_customer_sk"]),
+                        ca, ["c_current_addr_sk"], ["ca_address_sk"]),
+                  it, ["ss_item_sk"], ["i_item_sk"])
+        return CpuProject(
+            [lit(1).alias(f"_k{tag}"), col(tag)],
+            CpuAggregate(
+                [], [Sum(col("ss_ext_sales_price")).alias(tag)], j))
+    promo = branch(True, "promotions")
+    total = branch(False, "total")
+    j = _join(promo, total, ["_kpromotions"], ["_ktotal"])
+    out = CpuProject(
+        [col("promotions"), col("total"),
+         (col("promotions") / col("total") * lit(100.0)).alias("ratio")],
+        j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("promotions")), asc(col("total"))], out))
+
+
+def q62(t, run):
+    """Reference q62: web shipping-lag buckets by warehouse prefix /
+    ship mode / site."""
+    dd = CpuFilter(_between(col("d_month_seq"), lit(24), lit(35)),
+                   t["date_dim"])
+    j = _join(_join(_join(_join(
+        dd, t["web_sales"], ["d_date_sk"], ["ws_ship_date_sk"]),
+        t["warehouse"], ["ws_warehouse_sk"], ["w_warehouse_sk"]),
+        t["ship_mode"], ["ws_ship_mode_sk"], ["sm_ship_mode_sk"]),
+        t["web_site"], ["ws_web_site_sk"], ["web_site_sk"])
+    j = CpuProject(
+        [_Substring(col("w_warehouse_name"), lit(1),
+                    lit(20)).alias("wh_prefix"),
+         col("sm_type"), col("web_name"), col("ws_ship_date_sk"),
+         col("ws_sold_date_sk")], j)
+    diff = col("ws_ship_date_sk") - col("ws_sold_date_sk")
+    agg = CpuAggregate(
+        [col("wh_prefix"), col("sm_type"), col("web_name")],
+        _lag_buckets(diff), j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("wh_prefix")), asc(col("sm_type")),
+         asc(col("web_name"))], agg))
+
+
+def q63(t, run):
+    """Reference q63: manager monthly sales vs their cross-month
+    average (window avg expressed as an aggregate re-join — identical
+    semantics)."""
+    dd = CpuFilter(_between(col("d_month_seq"), lit(24), lit(35)),
+                   t["date_dim"])
+    it = CpuFilter(
+        (InSet(col("i_category"), ("Books", "Electronics", "Home")) &
+         InSet(col("i_class"), tuple(f"class{i:02d}" for i in
+                                     range(8)))) |
+        (InSet(col("i_category"), ("Women", "Music", "Shoes")) &
+         InSet(col("i_class"), tuple(f"class{i:02d}" for i in
+                                     range(8, 16)))), t["item"])
+    j = _join(_join(_join(
+        dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        it, ["ss_item_sk"], ["i_item_sk"]),
+        t["store"], ["ss_store_sk"], ["s_store_sk"])
+    monthly = CpuAggregate(
+        [col("i_manager_id"), col("d_moy")],
+        [Sum(col("ss_sales_price")).alias("sum_sales")], j)
+    avg = CpuProject(
+        [col("i_manager_id").alias("_mgr"),
+         col("avg_monthly_sales")],
+        CpuAggregate(
+            [col("i_manager_id")],
+            [Average(col("sum_sales")).alias("avg_monthly_sales")],
+            monthly))
+    out = _join(monthly, avg, ["i_manager_id"], ["_mgr"])
+    dev = (col("sum_sales") - col("avg_monthly_sales"))
+    absdev = If(dev < lit(0.0), lit(0.0) - dev, dev)
+    out = CpuFilter(
+        If(col("avg_monthly_sales") > lit(0.0),
+           absdev / col("avg_monthly_sales"),
+           _Lit(None, _T.FLOAT64)) > lit(0.1), out)
+    out = CpuProject([col("i_manager_id"), col("sum_sales"),
+                      col("avg_monthly_sales")], out)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_manager_id")), asc(col("avg_monthly_sales")),
+         asc(col("sum_sales"))], out))
+
+
+def q69(t, run):
+    """Reference q69: demographics of store-only shoppers in a quarter
+    (EXISTS store AND NOT EXISTS web/catalog as semi/anti joins)."""
+    ca = CpuFilter(InSet(col("ca_state"), ("GA", "NY", "TX")),
+                   t["customer_address"])
+    c = _join(t["customer"], ca, ["c_current_addr_sk"],
+              ["ca_address_sk"])
+    dd = CpuFilter((col("d_year") == lit(2001)) &
+                   _between(col("d_moy"), lit(4), lit(6)),
+                   t["date_dim"])
+    ss = _join(dd, t["store_sales"], ["d_date_sk"],
+               ["ss_sold_date_sk"])
+    ws = _join(CpuProject([col("d_date_sk").alias("dw_sk")], dd),
+               t["web_sales"], ["dw_sk"], ["ws_sold_date_sk"])
+    cs = _join(CpuProject([col("d_date_sk").alias("dc_sk")], dd),
+               t["catalog_sales"], ["dc_sk"], ["cs_sold_date_sk"])
+    c = _join(c, ss, ["c_customer_sk"], ["ss_customer_sk"],
+              jt=J.LEFT_SEMI)
+    c = _join(c, ws, ["c_customer_sk"], ["ws_bill_customer_sk"],
+              jt=J.LEFT_ANTI)
+    c = _join(c, cs, ["c_customer_sk"], ["cs_ship_customer_sk"],
+              jt=J.LEFT_ANTI)
+    j = _join(c, t["customer_demographics"], ["c_current_cdemo_sk"],
+              ["cd_demo_sk"])
+    agg = CpuAggregate(
+        [col("cd_gender"), col("cd_marital_status"),
+         col("cd_education_status"), col("cd_purchase_estimate"),
+         col("cd_credit_rating")],
+        [Count(None).alias("cnt1")], j)
+    out = CpuProject(
+        [col("cd_gender"), col("cd_marital_status"),
+         col("cd_education_status"), col("cnt1"),
+         col("cd_purchase_estimate"), col("cnt1").alias("cnt2"),
+         col("cd_credit_rating"), col("cnt1").alias("cnt3")], agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col("cd_gender")), asc(col("cd_marital_status")),
+         asc(col("cd_education_status")),
+         asc(col("cd_purchase_estimate")),
+         asc(col("cd_credit_rating"))], out))
+
+
+def q79(t, run):
+    """Reference q79: Monday coupon/profit per ticket for large
+    stores."""
+    dd = CpuFilter((col("d_dow") == lit(1)) &
+                   InSet(col("d_year"), (1999, 2000, 2001)),
+                   t["date_dim"])
+    hd = CpuFilter((col("hd_dep_count") == lit(6)) |
+                   (col("hd_vehicle_count") > lit(2)),
+                   t["household_demographics"])
+    st = CpuFilter(_between(col("s_number_employees"),
+                            lit(200), lit(295)), t["store"])
+    j = _join(_join(_join(
+        dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        st, ["ss_store_sk"], ["s_store_sk"]),
+        hd, ["ss_hdemo_sk"], ["hd_demo_sk"])
+    ms = CpuAggregate(
+        [col("ss_ticket_number"), col("ss_customer_sk"),
+         col("ss_addr_sk"), col("s_city")],
+        [Sum(col("ss_coupon_amt")).alias("amt"),
+         Sum(col("ss_net_profit")).alias("profit")], j)
+    out = _join(ms, t["customer"], ["ss_customer_sk"],
+                ["c_customer_sk"])
+    out = CpuProject(
+        [col("c_last_name"), col("c_first_name"),
+         _Substring(col("s_city"), lit(1), lit(30)).alias("city30"),
+         col("ss_ticket_number"), col("amt"), col("profit")], out)
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_last_name")), asc(col("c_first_name")),
+         asc(col("city30")), asc(col("profit"))], out))
+
+
+def _q88_slot(t, h, half, tag):
+    """one time-slot count(*) block (reference q88 s1..s8)."""
+    td = CpuFilter((col("t_hour") == lit(h)) &
+                   ((col("t_minute") < lit(30)) if half == 0 else
+                    (col("t_minute") >= lit(30))), t["time_dim"])
+    hd = CpuFilter(
+        ((col("hd_dep_count") == lit(4)) &
+         (col("hd_vehicle_count") <= lit(6))) |
+        ((col("hd_dep_count") == lit(2)) &
+         (col("hd_vehicle_count") <= lit(4))) |
+        ((col("hd_dep_count") == lit(0)) &
+         (col("hd_vehicle_count") <= lit(2))),
+        t["household_demographics"])
+    st = CpuFilter(col("s_store_name") == lit("ese"), t["store"])
+    j = _join(_join(_join(
+        td, t["store_sales"], ["t_time_sk"], ["ss_sold_time_sk"]),
+        hd, ["ss_hdemo_sk"], ["hd_demo_sk"]),
+        st, ["ss_store_sk"], ["s_store_sk"])
+    return CpuProject(
+        [lit(1).alias(f"_k{tag}"), col(tag)],
+        CpuAggregate([], [Count(None).alias(tag)], j))
+
+
+def q88(t, run):
+    """Reference q88: eight half-hour slot counts cross-joined."""
+    slots = [("h8_30", 8, 1), ("h9", 9, 0), ("h9_30", 9, 1),
+             ("h10", 10, 0), ("h10_30", 10, 1), ("h11", 11, 0),
+             ("h11_30", 11, 1), ("h12", 12, 0)]
+    blocks = [_q88_slot(t, h, half, tag) for tag, h, half in slots]
+    out = blocks[0]
+    prev_tag = slots[0][0]
+    for b, (tag, _, _) in zip(blocks[1:], slots[1:]):
+        out = _join(out, b, [f"_k{prev_tag}"], [f"_k{tag}"])
+        prev_tag = tag
+    return CpuProject([col(tag) for tag, _, _ in slots], out)
+
+
+def q90(t, run):
+    """Reference q90: am/pm web sales ratio for a dependent-count
+    band."""
+    def half(h_lo, h_hi, tag):
+        td = CpuFilter(_between(col("t_hour"), lit(h_lo), lit(h_hi)),
+                       t["time_dim"])
+        hd = CpuFilter(col("hd_dep_count") == lit(6),
+                       t["household_demographics"])
+        wp = CpuFilter(_between(col("wp_char_count"),
+                                lit(5000), lit(5200)), t["web_page"])
+        j = _join(_join(_join(
+            td, t["web_sales"], ["t_time_sk"], ["ws_sold_time_sk"]),
+            hd, ["ws_ship_hdemo_sk"], ["hd_demo_sk"]),
+            wp, ["ws_web_page_sk"], ["wp_web_page_sk"])
+        return CpuProject(
+            [lit(1).alias(f"_k{tag}"), col(tag)],
+            CpuAggregate([], [Count(None).alias(tag)], j))
+    am = half(8, 9, "amc")
+    pm = half(19, 20, "pmc")
+    j = _join(am, pm, ["_kamc"], ["_kpmc"])
+    out = CpuProject(
+        [(col("amc") / col("pmc")).alias("am_pm_ratio")], j)
+    return CpuLimit(100, CpuSort([asc(col("am_pm_ratio"))], out))
+
+
+def q93(t, run):
+    """Reference q93: actual sales net of returns for one reason."""
+    r = CpuFilter(col("r_reason_desc") == lit("reason 1"), t["reason"])
+    j = _join(t["store_sales"], _join(
+        t["store_returns"], r, ["sr_reason_sk"], ["r_reason_sk"]),
+        ["ss_item_sk", "ss_ticket_number"],
+        ["sr_item_sk", "sr_ticket_number"], jt=J.LEFT_OUTER)
+    act = If(IsNotNull(col("sr_ticket_number")),
+             (col("ss_quantity") - col("sr_return_quantity")) *
+             col("ss_sales_price"),
+             col("ss_quantity") * col("ss_sales_price"))
+    pre = CpuProject([col("ss_customer_sk"), act.alias("act_sales")], j)
+    agg = CpuAggregate([col("ss_customer_sk")],
+                       [Sum(col("act_sales")).alias("sumsales")], pre)
+    return CpuLimit(100, CpuSort(
+        [asc(col("sumsales")), asc(col("ss_customer_sk"))], agg))
+
+
+def q98(t, run):
+    """Reference q98: store item/class revenue ratio (no limit)."""
+    return _item_class_revenue(t, t["store_sales"], "ss_sold_date_sk",
+                               "ss_item_sk", "ss_ext_sales_price",
+                               limit=None)
+
+
+def q99(t, run):
+    """Reference q99: catalog shipping-lag buckets by warehouse prefix /
+    ship mode / call center."""
+    dd = CpuFilter(_between(col("d_month_seq"), lit(24), lit(35)),
+                   t["date_dim"])
+    j = _join(_join(_join(_join(
+        dd, t["catalog_sales"], ["d_date_sk"], ["cs_ship_date_sk"]),
+        t["warehouse"], ["cs_warehouse_sk"], ["w_warehouse_sk"]),
+        t["ship_mode"], ["cs_ship_mode_sk"], ["sm_ship_mode_sk"]),
+        t["call_center"], ["cs_call_center_sk"], ["cc_call_center_sk"])
+    j = CpuProject(
+        [_Substring(col("w_warehouse_name"), lit(1),
+                    lit(20)).alias("wh_prefix"),
+         col("sm_type"), col("cc_name"), col("cs_ship_date_sk"),
+         col("cs_sold_date_sk")], j)
+    diff = col("cs_ship_date_sk") - col("cs_sold_date_sk")
+    agg = CpuAggregate(
+        [col("wh_prefix"), col("sm_type"), col("cc_name")],
+        _lag_buckets(diff), j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("wh_prefix")), asc(col("sm_type")),
+         asc(col("cc_name"))], agg))
+
+
+QUERIES.update({
+    "q34": q34, "q46": q46, "q50": q50, "q61": q61, "q62": q62,
+    "q63": q63, "q69": q69, "q79": q79, "q88": q88, "q90": q90,
+    "q93": q93, "q98": q98, "q99": q99,
+})
+
+
+def _item_class_revenue(t, sales, date_key, item_key, val,
+                        limit=100):
+    """q12/q20/q98 family: item revenue + class revenue ratio over one
+    30-day window (window sum as aggregate re-join)."""
+    dd = CpuFilter(_between(col("d_date"), _date(1999, 2, 22),
+                            _date(1999, 3, 24)), t["date_dim"])
+    it = CpuFilter(InSet(col("i_category"),
+                         ("Sports", "Books", "Home")), t["item"])
+    j = _join(_join(dd, sales, ["d_date_sk"], [date_key]),
+              it, [item_key], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id"), col("i_item_desc"), col("i_category"),
+         col("i_class"), col("i_current_price")],
+        [Sum(col(val)).alias("itemrevenue")], j)
+    cls = CpuProject(
+        [col("i_class").alias("_cls"), col("classrev")],
+        CpuAggregate([col("i_class")],
+                     [Sum(col("itemrevenue")).alias("classrev")], agg))
+    out = _join(agg, cls, ["i_class"], ["_cls"])
+    out = CpuProject(
+        [col("i_item_id"), col("i_item_desc"), col("i_category"),
+         col("i_class"), col("i_current_price"), col("itemrevenue"),
+         (col("itemrevenue") * lit(100.0) /
+          col("classrev")).alias("revenueratio")], out)
+    srt = CpuSort(
+        [asc(col("i_category")), asc(col("i_class")),
+         asc(col("i_item_id")), asc(col("i_item_desc")),
+         asc(col("revenueratio"))], out)
+    return srt if limit is None else CpuLimit(limit, srt)
+
+
+def q12(t, run):
+    """Reference q12: web item/class revenue ratio."""
+    return _item_class_revenue(t, t["web_sales"], "ws_sold_date_sk",
+                               "ws_item_sk", "ws_ext_sales_price")
+
+
+def q20(t, run):
+    """Reference q20: catalog item/class revenue ratio."""
+    return _item_class_revenue(t, t["catalog_sales"],
+                               "cs_sold_date_sk", "cs_item_sk",
+                               "cs_ext_sales_price")
+
+
+def q82(t, run):
+    """Reference q82: in-stock store items in a price band."""
+    it = CpuFilter(
+        _between(col("i_current_price"), lit(30.0), lit(95.0)) &
+        InSet(col("i_manufact_id"), tuple(range(20, 61))), t["item"])
+    dd = CpuFilter(_between(col("d_date"), _date(2000, 5, 25),
+                            _date(2000, 11, 25)), t["date_dim"])
+    inv = CpuFilter(_between(col("inv_quantity_on_hand"),
+                             lit(100), lit(500)), t["inventory"])
+    j = _join(_join(_join(it, inv, ["i_item_sk"], ["inv_item_sk"]),
+                    dd, ["inv_date_sk"], ["d_date_sk"]),
+              t["store_sales"], ["i_item_sk"], ["ss_item_sk"])
+    agg = CpuAggregate(
+        [col("i_item_id"), col("i_item_desc"), col("i_current_price")],
+        [Count(None).alias("_c")], j)
+    out = CpuProject([col("i_item_id"), col("i_item_desc"),
+                      col("i_current_price")], agg)
+    return CpuLimit(100, CpuSort([asc(col("i_item_id"))], out))
+
+
+def q91(t, run):
+    """Reference q91: call-center returns loss for one demographic
+    slice."""
+    dd = CpuFilter((col("d_year") == lit(1998)) &
+                   (col("d_moy") == lit(11)), t["date_dim"])
+    cd = CpuFilter(
+        ((col("cd_marital_status") == lit("M")) &
+         (col("cd_education_status") == lit("Unknown"))) |
+        ((col("cd_marital_status") == lit("W")) &
+         (col("cd_education_status") == lit("Advanced Degree"))),
+        t["customer_demographics"])
+    hd = CpuFilter(Like(col("hd_buy_potential"), lit("Unknown%")),
+                   t["household_demographics"])
+    ca = CpuFilter(col("ca_gmt_offset") == lit(-7.0),
+                   t["customer_address"])
+    j = _join(_join(dd, t["catalog_returns"],
+                    ["d_date_sk"], ["cr_returned_date_sk"]),
+              t["call_center"], ["cr_call_center_sk"],
+              ["cc_call_center_sk"])
+    j = _join(j, t["customer"], ["cr_returning_customer_sk"],
+              ["c_customer_sk"])
+    j = _join(_join(_join(j, cd, ["c_current_cdemo_sk"],
+                          ["cd_demo_sk"]),
+                    hd, ["c_current_hdemo_sk"], ["hd_demo_sk"]),
+              ca, ["c_current_addr_sk"], ["ca_address_sk"])
+    agg = CpuAggregate(
+        [col("cc_call_center_id"), col("cc_name"), col("cc_manager"),
+         col("cd_marital_status"), col("cd_education_status")],
+        [Sum(col("cr_net_loss")).alias("Returns_Loss")], j)
+    out = CpuProject(
+        [col("cc_call_center_id").alias("Call_Center"),
+         col("cc_name").alias("Call_Center_Name"),
+         col("cc_manager").alias("Manager"), col("Returns_Loss")], agg)
+    return CpuSort([desc(col("Returns_Loss"))], out)
+
+
+def q92(t, run):
+    """Reference q92: web discounts exceeding 1.3x the per-item window
+    average (correlated subquery as aggregate re-join)."""
+    dd = CpuFilter(_between(col("d_date"), _date(2000, 1, 27),
+                            _date(2000, 4, 26)), t["date_dim"])
+    ws = _join(dd, t["web_sales"], ["d_date_sk"], ["ws_sold_date_sk"])
+    it = CpuFilter(InSet(col("i_manufact_id"),
+                         tuple(range(30, 40))), t["item"])
+    j = _join(ws, it, ["ws_item_sk"], ["i_item_sk"])
+    avg = CpuProject(
+        [col("ws_item_sk").alias("_isk"),
+         (col("a") * lit(1.3)).alias("threshold")],
+        CpuAggregate(
+            [col("ws_item_sk")],
+            [Average(col("ws_ext_discount_amt")).alias("a")], ws))
+    out = _join(j, avg, ["ws_item_sk"], ["_isk"])
+    out = CpuFilter(col("ws_ext_discount_amt") > col("threshold"), out)
+    agg = CpuAggregate(
+        [], [Sum(col("ws_ext_discount_amt")).alias("excess")], out)
+    return CpuLimit(100, agg)
+
+
+def q94(t, run):
+    """Reference q94: multi-warehouse never-returned web orders (EXISTS
+    as a >1-warehouse-order semi join, NOT EXISTS as anti join)."""
+    dd = CpuFilter(_between(col("d_date"), _date(1999, 2, 1),
+                            _date(1999, 4, 2)), t["date_dim"])
+    ca = CpuFilter(col("ca_state") == lit("IL"),
+                   t["customer_address"])
+    site = CpuFilter(col("web_company_name") == lit("pri"),
+                     t["web_site"])
+    ws1 = _join(_join(_join(
+        dd, t["web_sales"], ["d_date_sk"], ["ws_ship_date_sk"]),
+        ca, ["ws_ship_addr_sk"], ["ca_address_sk"]),
+        site, ["ws_web_site_sk"], ["web_site_sk"])
+    multi_wh = CpuFilter(
+        col("nwh") > lit(1),
+        CpuAggregate(
+            [col("morder")], [Count(None).alias("nwh")],
+            CpuAggregate(
+                [col("ws_order_number").alias("morder"),
+                 col("ws_warehouse_sk")],
+                [Count(None).alias("_c")], t["web_sales"])))
+    ws1 = _join(ws1, multi_wh, ["ws_order_number"], ["morder"],
+                jt=J.LEFT_SEMI)
+    ws1 = _join(ws1, t["web_returns"], ["ws_order_number"],
+                ["wr_order_number"], jt=J.LEFT_ANTI)
+    dist = CpuAggregate(
+        [], [Count(col("dorder")).alias("order_count")],
+        CpuAggregate([col("ws_order_number").alias("dorder")],
+                     [Count(None).alias("_d")], ws1))
+    sums = CpuAggregate(
+        [], [Sum(col("ws_ext_ship_cost")).alias("total_ship_cost"),
+             Sum(col("ws_net_profit")).alias("total_net_profit")], ws1)
+    j = _join(CpuProject([lit(1).alias("_ka"), col("order_count")],
+                         dist),
+              CpuProject([lit(1).alias("_kb"), col("total_ship_cost"),
+                          col("total_net_profit")], sums),
+              ["_ka"], ["_kb"])
+    return CpuLimit(100, CpuProject(
+        [col("order_count"), col("total_ship_cost"),
+         col("total_net_profit")], j))
+
+
+def _distinct_channel_triples(t, sales, date_key, cust_key):
+    dd = CpuFilter(_between(col("d_month_seq"), lit(24), lit(35)),
+                   t["date_dim"])
+    j = _join(_join(dd, sales, ["d_date_sk"], [date_key]),
+              t["customer"], [cust_key], ["c_customer_sk"])
+    return CpuAggregate(
+        [col("c_last_name"), col("c_first_name"), col("d_date")],
+        [Count(None).alias("_n")], j)
+
+
+def q38(t, run):
+    """Reference q38: customers active in ALL three channels
+    (INTERSECT as successive semi joins on the distinct triples)."""
+    ss = _distinct_channel_triples(t, t["store_sales"],
+                                   "ss_sold_date_sk", "ss_customer_sk")
+    cs = CpuProject(
+        [col("c_last_name").alias("cl"), col("c_first_name").alias("cf"),
+         col("d_date").alias("cd")],
+        _distinct_channel_triples(t, t["catalog_sales"],
+                                  "cs_sold_date_sk",
+                                  "cs_bill_customer_sk"))
+    ws = CpuProject(
+        [col("c_last_name").alias("wl"), col("c_first_name").alias("wf"),
+         col("d_date").alias("wd")],
+        _distinct_channel_triples(t, t["web_sales"],
+                                  "ws_sold_date_sk",
+                                  "ws_bill_customer_sk"))
+    both = _join(ss, cs, ["c_last_name", "c_first_name", "d_date"],
+                 ["cl", "cf", "cd"], jt=J.LEFT_SEMI)
+    allc = _join(both, ws, ["c_last_name", "c_first_name", "d_date"],
+                 ["wl", "wf", "wd"], jt=J.LEFT_SEMI)
+    return CpuLimit(100, CpuAggregate(
+        [], [Count(None).alias("cnt")], allc))
+
+
+def q87(t, run):
+    """Reference q87: store-only customer/date triples (EXCEPT as
+    successive anti joins)."""
+    ss = _distinct_channel_triples(t, t["store_sales"],
+                                   "ss_sold_date_sk", "ss_customer_sk")
+    cs = CpuProject(
+        [col("c_last_name").alias("cl"), col("c_first_name").alias("cf"),
+         col("d_date").alias("cd")],
+        _distinct_channel_triples(t, t["catalog_sales"],
+                                  "cs_sold_date_sk",
+                                  "cs_bill_customer_sk"))
+    ws = CpuProject(
+        [col("c_last_name").alias("wl"), col("c_first_name").alias("wf"),
+         col("d_date").alias("wd")],
+        _distinct_channel_triples(t, t["web_sales"],
+                                  "ws_sold_date_sk",
+                                  "ws_bill_customer_sk"))
+    no_cs = _join(ss, cs, ["c_last_name", "c_first_name", "d_date"],
+                  ["cl", "cf", "cd"], jt=J.LEFT_ANTI)
+    only_ss = _join(no_cs, ws, ["c_last_name", "c_first_name",
+                                "d_date"],
+                    ["wl", "wf", "wd"], jt=J.LEFT_ANTI)
+    return CpuAggregate([], [Count(None).alias("cnt")], only_ss)
+
+
+QUERIES.update({
+    "q12": q12, "q20": q20, "q82": q82, "q92": q92,
+    "q94": q94, "q38": q38, "q87": q87,
+})
+
+
+def q9(t, run):
+    """Reference q9: five quantity-band CASE buckets from scalar
+    subqueries (run() materializes each, the CASE picks avg discount vs
+    avg net_paid by count threshold; thresholds scaled to the
+    generator's volumes)."""
+    bands = [(1, 20), (21, 40), (41, 60), (61, 80), (81, 100)]
+    exprs = []
+    for i, (lo, hi) in enumerate(bands, start=1):
+        stats = run(CpuAggregate(
+            [], [Count(None).alias("c"),
+                 Average(col("ss_ext_discount_amt")).alias("ad"),
+                 Average(col("ss_net_paid")).alias("ap")],
+            CpuFilter(_between(col("ss_quantity"), lit(lo), lit(hi)),
+                      t["store_sales"])))
+        cnt = int(stats["c"].iloc[0])
+        val = float(stats["ad"].iloc[0] if cnt > 1200
+                    else stats["ap"].iloc[0])
+        exprs.append(lit(val).alias(f"bucket{i}"))
+    one = CpuFilter(col("r_reason_sk") == lit(1), t["reason"])
+    return CpuProject(exprs, one)
+
+
+def q41(t, run):
+    """Reference q41: distinct product names whose manufacturer also
+    makes items in the listed color/unit/size combinations."""
+    arms = (
+        (InSet(col("i_category"), ("Women",)) &
+         InSet(col("i_color"), ("powder", "khaki")) &
+         InSet(col("i_units"), ("Ounce", "Oz")) &
+         InSet(col("i_size"), ("medium", "extra large"))) |
+        (InSet(col("i_category"), ("Music",)) &
+         InSet(col("i_color"), ("floral", "deep")) &
+         InSet(col("i_units"), ("N/A", "Dozen")) &
+         InSet(col("i_size"), ("petite", "large"))) |
+        (InSet(col("i_category"), ("Shoes",)) &
+         InSet(col("i_color"), ("light", "cornflower")) &
+         InSet(col("i_units"), ("Box", "Pound")) &
+         InSet(col("i_size"), ("medium", "extra large"))) |
+        (InSet(col("i_category"), ("Books",)) &
+         InSet(col("i_color"), ("midnight", "snow")) &
+         InSet(col("i_units"), ("Ounce", "Oz")) &
+         InSet(col("i_size"), ("petite", "large"))))
+    match_manufact = CpuProject(
+        [col("i_manufact").alias("_mf")],
+        CpuAggregate([col("i_manufact")], [Count(None).alias("_c")],
+                     CpuFilter(arms, t["item"])))
+    i1 = CpuFilter(_between(col("i_manufact_id"), lit(1), lit(40)),
+                   t["item"])
+    j = _join(i1, match_manufact, ["i_manufact"], ["_mf"],
+              jt=J.LEFT_SEMI)
+    dist = CpuAggregate([col("i_product_name")],
+                        [Count(None).alias("_c")], j)
+    out = CpuProject([col("i_product_name")], dist)
+    return CpuLimit(100, CpuSort([asc(col("i_product_name"))], out))
+
+
+def q16(t, run):
+    """Reference q16: multi-warehouse never-returned catalog orders for
+    one county/state window (EXISTS/NOT EXISTS as semi/anti joins)."""
+    dd = CpuFilter(_between(col("d_date"), _date(2002, 2, 1),
+                            _date(2002, 4, 2)), t["date_dim"])
+    ca = CpuFilter(col("ca_state") == lit("GA"),
+                   t["customer_address"])
+    cc = CpuFilter(InSet(col("cc_county"), ("Williamson County",)),
+                   t["call_center"])
+    cs1 = _join(_join(_join(
+        dd, t["catalog_sales"], ["d_date_sk"], ["cs_ship_date_sk"]),
+        ca, ["cs_ship_addr_sk"], ["ca_address_sk"]),
+        cc, ["cs_call_center_sk"], ["cc_call_center_sk"])
+    multi_wh = CpuFilter(
+        col("nwh") > lit(1),
+        CpuAggregate(
+            [col("morder")], [Count(None).alias("nwh")],
+            CpuAggregate(
+                [col("cs_order_number").alias("morder"),
+                 col("cs_warehouse_sk")],
+                [Count(None).alias("_c")], t["catalog_sales"])))
+    cs1 = _join(cs1, multi_wh, ["cs_order_number"], ["morder"],
+                jt=J.LEFT_SEMI)
+    cs1 = _join(cs1, t["catalog_returns"], ["cs_order_number"],
+                ["cr_order_number"], jt=J.LEFT_ANTI)
+    dist = CpuAggregate(
+        [], [Count(col("dorder")).alias("order_count")],
+        CpuAggregate([col("cs_order_number").alias("dorder")],
+                     [Count(None).alias("_d")], cs1))
+    sums = CpuAggregate(
+        [], [Sum(col("cs_ext_ship_cost")).alias("total_ship_cost"),
+             Sum(col("cs_net_profit")).alias("total_net_profit")], cs1)
+    j = _join(CpuProject([lit(1).alias("_ka"), col("order_count")],
+                         dist),
+              CpuProject([lit(1).alias("_kb"), col("total_ship_cost"),
+                          col("total_net_profit")], sums),
+              ["_ka"], ["_kb"])
+    return CpuLimit(100, CpuProject(
+        [col("order_count"), col("total_ship_cost"),
+         col("total_net_profit")], j))
+
+
+QUERIES.update({"q9": q9, "q41": q41, "q16": q16})
+
+
+def q21(t, run):
+    """Reference q21: warehouse inventory before/after one cutover date
+    for a price band, keeping ratio-bounded rows."""
+    it = CpuFilter(_between(col("i_current_price"),
+                            lit(10.0), lit(60.0)), t["item"])
+    dd = CpuFilter(_between(col("d_date"), _date(2000, 1, 1),
+                            _date(2000, 6, 30)), t["date_dim"])
+    j = _join(_join(_join(
+        dd, t["inventory"], ["d_date_sk"], ["inv_date_sk"]),
+        t["warehouse"], ["inv_warehouse_sk"], ["w_warehouse_sk"]),
+        it, ["inv_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("w_warehouse_name"), col("i_item_id")],
+        [Sum(If(col("d_date") < _date(2000, 3, 11),
+                col("inv_quantity_on_hand"),
+                lit(0))).alias("inv_before"),
+         Sum(If(col("d_date") >= _date(2000, 3, 11),
+                col("inv_quantity_on_hand"),
+                lit(0))).alias("inv_after")], j)
+    ratio = If(col("inv_before") > lit(0),
+               col("inv_after") / col("inv_before"),
+               _Lit(None, _T.FLOAT64))
+    # reference band is 2/3..3/2; the sparse synthetic inventory
+    # needs a wider one to keep rows
+    out = CpuFilter((ratio >= lit(0.1)) & (ratio <= lit(10.0)), agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col("w_warehouse_name")), asc(col("i_item_id"))], out))
+
+
+QUERIES.update({"q21": q21})

@@ -654,3 +654,103 @@ QUERIES.update({
     "q23": q23_shape, "q24": q24_shape, "q25": q25_shape,
     "q26": q26_shape, "q28": q28_shape, "q30": q30_shape,
 })
+
+
+# ---------------------------------------------------------------------------
+# round-3: q18 + q27 — the reference's Q18Like/Q27Like THROW
+# ("uses UDF", TpcxbbLikeSpark.scala:1455,1993); here the text analysis
+# runs through the udf-compiler (BASELINE milestone 5): a Python UDF over
+# review content compiles to the expression AST and executes on TPU.
+from spark_rapids_tpu import types as _T2
+from spark_rapids_tpu.exprs.aggregates import Average
+from spark_rapids_tpu.udf import tpu_udf
+
+J = JoinType
+
+
+def _join(left, right, lk, rk, jt=JoinType.INNER):
+    return CpuHashJoin(jt, [col(k) for k in lk], [col(k) for k in rk],
+                       left, right)
+
+
+@tpu_udf(_T2.INT64)
+def review_sentiment(content):
+    """BigBench q18-style sentiment: -1 negative, +1 positive, else 0."""
+    if content is None:
+        return 0
+    if (content.find("bad") >= 0 or content.find("poor") >= 0 or
+            content.find("terrible") >= 0):
+        return -1
+    if (content.find("good") >= 0 or content.find("great") >= 0 or
+            content.find("excellent") >= 0):
+        return 1
+    return 0
+
+
+@tpu_udf(_T2.INT64)
+def mentions_aspect(content):
+    """BigBench q27-style extraction flag: does the review call out the
+    product aspect competitors fight on (quality/value)."""
+    if content is None:
+        return 0
+    if content.find("quality") >= 0 or content.find("value") >= 0:
+        return 1
+    return 0
+
+
+def q18(t, run):
+    """q18-like: sentiment of reviews for items sold by DECLINING
+    stores (first vs second half-year sales), via the compiled
+    sentiment UDF."""
+    dd1 = CpuFilter((col("d_year") == lit(2001)) &
+                    (col("d_moy") <= lit(6)), t["date_dim"])
+    dd2 = CpuFilter((col("d_year") == lit(2001)) &
+                    (col("d_moy") > lit(6)), t["date_dim"])
+
+    def half(dd, alias, key):
+        j = _join(CpuProject([col("d_date_sk").alias(key)], dd),
+                  t["store_sales"], [key], ["ss_sold_date_sk"])
+        return CpuAggregate([col("ss_store_sk").alias(f"sk_{alias}")],
+                            [Sum(col("ss_net_paid")).alias(alias)], j)
+
+    h1 = half(dd1, "h1", "d1sk")
+    h2 = half(dd2, "h2", "d2sk")
+    declining = CpuFilter(
+        col("h2") < col("h1"),
+        _join(h1, h2, ["sk_h1"], ["sk_h2"]))
+    # items those stores sold in the window
+    items = CpuAggregate(
+        [col("it")], [Count(None).alias("_c")],
+        _join(CpuProject([col("sk_h1").alias("decl_sk")], declining),
+              CpuProject([col("ss_store_sk").alias("st"),
+                          col("ss_item_sk").alias("it")],
+                         t["store_sales"]),
+              ["decl_sk"], ["st"]))
+    rv = _join(t["product_reviews"], items, ["pr_item_sk"], ["it"],
+               jt=J.LEFT_SEMI)
+    scored = CpuProject(
+        [col("pr_item_sk"),
+         review_sentiment(col("pr_content")).alias("sentiment")], rv)
+    agg = CpuAggregate(
+        [col("sentiment")], [Count(None).alias("review_count")], scored)
+    return CpuSort([asc(col("sentiment"))], agg)
+
+
+def q27(t, run):
+    """q27-like: per-item competitive-aspect mention counts and rating,
+    via the compiled extraction UDF (BASELINE milestone 5's query)."""
+    flagged = CpuProject(
+        [col("pr_item_sk"), col("pr_rating"),
+         mentions_aspect(col("pr_content")).alias("mention")],
+        t["product_reviews"])
+    agg = CpuAggregate(
+        [col("pr_item_sk")],
+        [Sum(col("mention")).alias("mentions"),
+         Count(None).alias("n_reviews"),
+         Average(col("pr_rating")).alias("avg_rating")], flagged)
+    out = CpuFilter(col("mentions") > lit(0), agg)
+    return CpuLimit(100, CpuSort(
+        [desc(col("mentions")), asc(col("pr_item_sk"))], out))
+
+
+QUERIES.update({"q18": q18, "q27": q27})

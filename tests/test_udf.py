@@ -661,3 +661,30 @@ def test_daemon_udf_with_all_literal_args():
         assert out["c"].tolist() == [2.5] * 5
     finally:
         PythonWorkerPool.reset()
+
+
+def test_tpcxbb_q27_runs_compiled_not_fallback():
+    """BASELINE milestone 5: the q27 UDF must go through the
+    udf-compiler and execute ON TPU — an uncompiled PythonUDF would
+    force a CPU-fallback (RowToColumnar) subtree."""
+    import numpy as np
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exec.base import TpuExec
+    from spark_rapids_tpu.models import tpcxbb
+    from spark_rapids_tpu.plan import accelerate
+
+    tables = tpcxbb.gen_tables(np.random.default_rng(4), 2000)
+    t = tpcxbb.sources(tables, 2)
+    conf = C.RapidsConf(
+        {"spark.rapids.sql.variableFloatAgg.enabled": True})
+    plan = accelerate(tpcxbb.QUERIES["q27"](t, lambda p: None), conf)
+    assert isinstance(plan, TpuExec), (
+        "q27's UDF fell back to CPU:\n" + plan.tree_string())
+
+    def no_cpu_bridge(p):
+        from spark_rapids_tpu.plan.transitions import RowToColumnarExec
+        assert not isinstance(p, RowToColumnarExec), \
+            "UDF subtree fell back:\n" + plan.tree_string()
+        for c in p.children:
+            no_cpu_bridge(c)
+    no_cpu_bridge(plan)
