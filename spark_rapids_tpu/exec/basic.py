@@ -28,6 +28,22 @@ from spark_rapids_tpu.exprs.base import Expression, output_name
 from spark_rapids_tpu.utils import metrics as M
 
 
+def _register_ansi(flags, labels) -> tuple:
+    """Register ANSI-mode expression checks (flags returned by the
+    kernel, labels captured at trace time) as FATAL deferred checks."""
+    if not flags:
+        return ()
+    from spark_rapids_tpu.utils import checks as CK
+    out = []
+    for i, flag in enumerate(flags):
+        label = labels[i] if i < len(labels) else "ANSI expression check"
+        out.append(CK.register(CK.BatchCheck(
+            flag, label,
+            error=lambda label=label: ArithmeticError(
+                f"{label} (spark.sql.ansi.enabled semantics)"))))
+    return tuple(out)
+
+
 class ProjectExec(UnaryExecBase):
     """Reference GpuProjectExec."""
 
@@ -57,11 +73,18 @@ class ProjectExec(UnaryExecBase):
             bound = self._bound
             cap = batch.capacity
 
+            labels: list = []
+
             @jax.jit
             def kernel(columns, num_rows, mask=None):
                 ctx = make_eval_context(columns, cap, num_rows, mask)
-                return [e.eval(ctx) for e in bound]
+                out = [e.eval(ctx) for e in bound]
+                # labels are static per trace; flags are traced outputs
+                labels.clear()
+                labels.extend(l for l, _ in ctx.pending_checks)
+                return out, tuple(f for _, f in ctx.pending_checks)
 
+            kernel._ansi_labels = labels
             return kernel
 
         return self.kernels.get_or_build(key, build)
@@ -71,12 +94,16 @@ class ProjectExec(UnaryExecBase):
             with self.metrics.timed(M.TOTAL_TIME):
                 kernel = self._kernel(batch)
                 if batch.sparse is not None:
-                    out_cols = kernel(batch.columns, batch.num_rows_i32,
-                                      batch.sparse)
+                    out_cols, pend = kernel(batch.columns,
+                                            batch.num_rows_i32,
+                                            batch.sparse)
                 else:
-                    out_cols = kernel(batch.columns, batch.num_rows_i32)
+                    out_cols, pend = kernel(batch.columns,
+                                            batch.num_rows_i32)
+                checks = batch.checks + _register_ansi(
+                    pend, kernel._ansi_labels)
                 out = ColumnarBatch(self._schema, list(out_cols),
-                                    batch._rows, batch.checks,
+                                    batch._rows, checks,
                                     batch.sparse)
                 self.update_output_metrics(out)
             yield out
@@ -113,13 +140,19 @@ class FilterExec(UnaryExecBase):
             bound = self._bound
             cap = batch.capacity
 
+            labels: list = []
+
             @jax.jit
             def kernel(columns, num_rows, mask=None):
                 ctx = make_eval_context(columns, cap, num_rows, mask)
                 pred = bound.eval(ctx)
                 keep = pred.validity & pred.data.astype(bool) & ctx.row_mask
-                return keep, keep.sum().astype(jnp.int32)
+                labels.clear()
+                labels.extend(l for l, _ in ctx.pending_checks)
+                return (keep, keep.sum().astype(jnp.int32),
+                        tuple(f for _, f in ctx.pending_checks))
 
+            kernel._ansi_labels = labels
             return kernel
 
         return self.kernels.get_or_build(key, build)
@@ -129,15 +162,19 @@ class FilterExec(UnaryExecBase):
             with self.metrics.timed(M.TOTAL_TIME):
                 kernel = self._kernel(batch)
                 if batch.sparse is not None:
-                    keep, count = kernel(batch.columns, batch.num_rows_i32,
-                                         batch.sparse)
+                    keep, count, pend = kernel(batch.columns,
+                                               batch.num_rows_i32,
+                                               batch.sparse)
                 else:
-                    keep, count = kernel(batch.columns, batch.num_rows_i32)
+                    keep, count, pend = kernel(batch.columns,
+                                               batch.num_rows_i32)
                 # DEFERRED SELECTION: no compaction here — the kept rows
                 # ride as a sparse mask; sparse-aware consumers fold it
                 # into their row masking, everyone else compacts lazily
+                checks = batch.checks + _register_ansi(
+                    pend, kernel._ansi_labels)
                 out = ColumnarBatch(self._schema, batch.columns, count,
-                                    batch.checks, sparse=keep)
+                                    checks, sparse=keep)
                 self.update_output_metrics(out)
             yield out
 

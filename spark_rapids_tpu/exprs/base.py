@@ -26,11 +26,19 @@ from spark_rapids_tpu.columnar.vector import (
 @dataclasses.dataclass(eq=False)
 class EvalContext:
     """Per-kernel evaluation context: the input columns (traced), static
-    capacity, and the traced valid-row mask."""
+    capacity, and the traced valid-row mask.
+
+    `pending_checks` collects (label, traced bool scalar) pairs raised
+    by ANSI-mode expressions during trace (True = error); kernels return
+    them alongside their outputs and the exec registers them as
+    deferred checks (utils/checks.py) resolved at the collect boundary
+    — the engine's analog of the reference's ANSI runtime exceptions
+    (GpuCast.scala:188 ansiMode)."""
     columns: list[ColumnVector]
     capacity: int
     num_rows: Any  # traced int32 scalar
     row_mask: Any  # traced bool[capacity]
+    pending_checks: list = dataclasses.field(default_factory=list)
 
 
 class Expression:

@@ -65,6 +65,14 @@ class Cast(Expression):
             return ColumnVector(
                 dst, c.data.astype(dst.storage_dtype), c.validity)
         if src.is_floating and dst.is_integral:
+            if self.ansi:
+                lo, hi = _INT_BOUNDS[dst.id if dst.id in _INT_BOUNDS
+                                     else T.TypeId.INT64]
+                t = jnp.trunc(jnp.where(jnp.isnan(c.data), 0.0, c.data))
+                bad = (jnp.isnan(c.data) | (t < float(lo)) |
+                       (t > float(hi))) & c.validity & ctx.row_mask
+                ctx.pending_checks.append(
+                    (f"ANSI cast {src} -> {dst} overflow", bad.any()))
             return _float_to_int(c, dst)
         if src.id == T.TypeId.TIMESTAMP_US and dst.id == T.TypeId.DATE32:
             return ColumnVector(
@@ -92,7 +100,16 @@ class Cast(Expression):
                                     c.validity & ~bad)
             data = c.data.astype(jnp.int64) * DT.MICROS_PER_SECOND
             return ColumnVector(T.TIMESTAMP_US, data, c.validity)
-        # plain numeric widening/narrowing: wraps like Java (non-ANSI)
+        # plain numeric widening/narrowing: wraps like Java; under ANSI
+        # an out-of-range value raises (deferred to the collect boundary
+        # via the checks registry — GpuCast.scala:188 ansiMode analog)
+        if self.ansi and src.is_integral and dst.is_integral and \
+                dst.id in _INT_BOUNDS:
+            lo, hi = _INT_BOUNDS[dst.id]
+            v = c.data.astype(jnp.int64)
+            bad = ((v < lo) | (v > hi)) & c.validity & ctx.row_mask
+            ctx.pending_checks.append(
+                (f"ANSI cast {src} -> {dst} overflow", bad.any()))
         return ColumnVector(dst, c.data.astype(dst.storage_dtype), c.validity)
 
     def __repr__(self):

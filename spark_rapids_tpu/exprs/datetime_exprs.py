@@ -328,3 +328,50 @@ class TruncDate(Expression):
         else:
             raise ValueError(f"unsupported trunc format {f!r}")
         return ColumnVector(T.DATE32, out, c.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class WeekDay(UnaryExpression):
+    """weekday(date): 0=Monday ... 6=Sunday (reference
+    datetimeExpressions.scala GpuWeekDay)."""
+    child: Expression
+
+    def data_type(self, schema):
+        return T.INT32
+
+    def do_columnar(self, c, ctx):
+        d = c.data.astype(jnp.int64)
+        # 1970-01-01 was a Thursday (weekday 3 in Monday-first scheme)
+        out = ((d + 3) % 7).astype(jnp.int32)
+        return ColumnVector(T.INT32, out, c.validity)
+
+
+@dataclasses.dataclass(eq=False)
+class ToUnixTimestamp(UnaryExpression):
+    """to_unix_timestamp(ts): seconds since epoch — same kernel as
+    UnixTimestamp, separate Catalyst expression (reference registers
+    both, GpuOverrides.scala datetime region)."""
+    child: Expression
+
+    def data_type(self, schema):
+        return T.INT64
+
+    def do_columnar(self, c, ctx):
+        return UnixTimestamp(self.child).do_columnar(c, ctx)
+
+
+@dataclasses.dataclass(eq=False)
+class TimeAdd(BinaryExpression):
+    """timestamp + CalendarInterval (microseconds component only, same
+    restriction as the reference GpuTimeAdd: tagged off for month
+    intervals — datetimeExpressions.scala)."""
+    left: Expression   # timestamp
+    right: Expression  # interval micros (int64)
+
+    def data_type(self, schema):
+        return T.TIMESTAMP_US
+
+    def do_columnar(self, l, r, ctx):
+        us = l.data.astype(jnp.int64) + r.data.astype(jnp.int64)
+        return ColumnVector(T.TIMESTAMP_US, us,
+                            l.validity & r.validity)

@@ -208,3 +208,37 @@ class Round(Expression):
         out = r / mul
         return ColumnVector(c.dtype, out.astype(c.dtype.storage_dtype),
                             c.validity)
+
+
+class Cot(_UnaryMath):
+    """cot(x) = 1/tan(x) (reference mathExpressions.scala GpuCot)."""
+    def op(self, x): return 1.0 / jnp.tan(x)
+
+
+class Acosh(_UnaryMath):
+    """acosh (reference improved-float family GpuAcosh)."""
+    def op(self, x): return jnp.arccosh(x)
+
+
+class Asinh(_UnaryMath):
+    def op(self, x): return jnp.arcsinh(x)
+
+
+class Atanh(_UnaryMath):
+    def op(self, x): return jnp.arctanh(x)
+
+
+@dataclasses.dataclass(eq=False)
+class Logarithm(BinaryExpression):
+    """log(base, x) (reference GpuLogarithm): ln(x)/ln(base)."""
+    left: Expression   # base
+    right: Expression  # value
+
+    def data_type(self, schema):
+        return T.FLOAT64
+
+    def do_columnar(self, l, r, ctx):
+        base = l.data.astype(jnp.float64)
+        val = r.data.astype(jnp.float64)
+        out = jnp.log(val) / jnp.log(base)
+        return ColumnVector(T.FLOAT64, out, l.validity & r.validity)

@@ -743,3 +743,69 @@ class StringSplit(Expression):
             "StringSplit must be consumed by GetArrayItem (split(s,d)[i]) "
             "— no array columns in the v0 type matrix; the planner tags "
             "bare use for CPU fallback")
+
+
+@dataclasses.dataclass(eq=False)
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count) (reference GpuSubstringIndex,
+    stringFunctions.scala:561): count>0 keeps everything before the
+    count-th delimiter, count<0 everything after the count-th from the
+    end.  delim and count must be literals (same restriction as the
+    reference's regexp-as-literal discipline)."""
+    child: Expression
+    delim: Expression
+    count: Expression
+
+    def data_type(self, schema):
+        return T.STRING
+
+    def children(self):
+        return (self.child, self.delim, self.count)
+
+    def with_children(self, kids):
+        return SubstringIndex(*kids)
+
+    def literal_args(self):
+        d = self.delim.value if isinstance(self.delim, Literal) else None
+        n = self.count.value if isinstance(self.count, Literal) else None
+        return d, n
+
+    def eval(self, ctx):
+        d, n = self.literal_args()
+        if d is None or n is None:
+            raise NotImplementedError(
+                "substring_index needs literal delim/count (plan-time "
+                "tagged)")
+        c = self.child.eval(ctx)
+        data, lengths = c.data, c.lengths
+        cc = data.shape[1]
+        dbytes = str(d).encode("utf-8")
+        L = len(dbytes)
+        n = int(n)
+        if L == 0 or n == 0:
+            # Spark: empty delim or count 0 -> empty string
+            zl = jnp.zeros_like(lengths)
+            return ColumnVector(T.STRING, jnp.zeros_like(data),
+                                c.validity, zl)
+        pos_b = jnp.arange(cc)[None, :]
+        match = (pos_b + L) <= lengths[:, None]
+        for k, b in enumerate(dbytes):
+            shifted = jnp.pad(data, ((0, 0), (0, L)))[:, k:k + cc]
+            match = match & (shifted == b)
+        occ = jnp.cumsum(match.astype(jnp.int32), axis=1)
+        total = occ[:, -1]
+        big = jnp.int32(cc + L + 1)
+        if n > 0:
+            has = total >= n
+            cut = jnp.argmax(occ >= n, axis=1).astype(jnp.int32)
+            cut = jnp.where(has, cut, big)
+            sel = pos_b < cut[:, None]
+        else:
+            k1 = total + n + 1  # 1-based index of the anchor delimiter
+            has = k1 >= 1
+            cut = jnp.argmax(occ >= k1[:, None], axis=1).astype(jnp.int32)
+            start = jnp.where(has, cut + L, 0)
+            sel = pos_b >= start[:, None]
+        in_str = pos_b < lengths[:, None]
+        out, new_len = _compact_bytes(data, lengths, sel & in_str)
+        return ColumnVector(T.STRING, out, c.validity, new_len)

@@ -113,6 +113,7 @@ StringTrimRight ConcatStrings Contains StartsWith EndsWith Like
 StringLocate StringReplace LPad RPad
 Sum Count Min Max First Last
 GroupRef
+Logarithm WeekDay ToUnixTimestamp TimeAdd
 """.split()
 for _name in _SIMPLE_EXPRS:
     expr(_name, f"TPU implementation of {_name}")
@@ -120,7 +121,8 @@ for _name in _SIMPLE_EXPRS:
 # transcendentals differ in ulp from JVM StrictMath (reference marks these
 # incompat the same way)
 for _name in ("Sin", "Cos", "Tan", "Asin", "Acos", "Atan", "Sinh", "Cosh",
-              "Tanh", "ToDegrees", "ToRadians"):
+              "Tanh", "ToDegrees", "ToRadians", "Cot", "Acosh", "Asinh",
+              "Atanh"):
     expr(_name, f"TPU implementation of {_name}",
          incompat="floating point results differ in ulp from the JVM")
 
@@ -134,8 +136,6 @@ def _tag_cast(m) -> None:
     bit-identical to the JVM; everything the kernels cannot do tags the
     plan for CPU fallback instead of raising at execution time."""
     e = m.expr
-    if getattr(e, "ansi", False):
-        m.will_not_work_on_tpu("ANSI cast mode is not supported on TPU")
     src = None
     for schema in m.input_schemas():
         try:
@@ -146,6 +146,15 @@ def _tag_cast(m) -> None:
     if src is None:
         return  # unresolvable child type: leave to downstream tagging
     dst = e.to
+    if getattr(e, "ansi", False):
+        # ANSI numeric overflow checks are implemented (deferred-check
+        # raise at the collect boundary, GpuCast.scala:188 analog);
+        # other ANSI directions still fall back
+        if not (src.is_numeric and dst.is_numeric and
+                not dst.is_floating):
+            m.will_not_work_on_tpu(
+                "ANSI cast supported only for numeric -> integral "
+                "overflow checks")
     if src.is_floating and dst.is_string and \
             not m.conf[C.CASTS_FLOAT_TO_STRING]:
         m.will_not_work_on_tpu(
@@ -164,6 +173,17 @@ def _tag_cast(m) -> None:
 
 
 expr("Cast", "TPU implementation of Cast", tag_extra=_tag_cast)
+
+
+def _tag_substring_index(m) -> None:
+    d, n = m.expr.literal_args()
+    if d is None or n is None:
+        m.will_not_work_on_tpu(
+            "substring_index delimiter and count must be literals")
+
+
+expr("SubstringIndex", "TPU implementation of SubstringIndex",
+     tag_extra=_tag_substring_index)
 
 
 def _tag_string_split(m) -> None:

@@ -29,6 +29,9 @@ class BatchCheck:
     flag: object                      # device bool scalar; True = invalid
     origin: str                       # human-readable fast-path name
     recover: Optional[Callable] = None  # disables the fast path
+    #: factory for a FATAL error (e.g. ANSI overflow): raised directly
+    #: instead of the deopt-and-retry FastPathInvalid
+    error: Optional[Callable] = None
 
 
 class FastPathInvalid(Exception):
@@ -71,6 +74,9 @@ def verify(checks) -> None:
                 _PENDING.remove(c)
             except ValueError:
                 pass
+    for c in bad:
+        if c.error is not None:
+            raise c.error()
     if bad:
         raise FastPathInvalid(bad)
 

@@ -250,3 +250,125 @@ def test_months_between_timestamp_fraction():
                       src).collect()
     got = out.column("r").to_pylist(1)[0]
     assert abs(got - (1 + 0.5 / 31)) < 1e-8
+
+
+# -- round-3 expression tail -------------------------------------------------
+class TestExpressionTail:
+    def _parity(self, df, exprs, rtol=1e-12):
+        import pandas as pd
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.plan import CpuProject, CpuSource, \
+            accelerate, collect
+        src = CpuSource.from_pandas(df)
+        plan = CpuProject(exprs, src)
+        conf = C.RapidsConf({})
+        exp = plan.collect()
+        got = collect(accelerate(plan, conf), conf)
+        for c in exp.columns:
+            e = exp[c].astype(float) if exp[c].dtype.kind == "f" else exp[c]
+            g = got[c].astype(float) if exp[c].dtype.kind == "f" else got[c]
+            if exp[c].dtype.kind == "f":
+                np.testing.assert_allclose(
+                    g.to_numpy(float), e.to_numpy(float), rtol=1e-6,
+                    equal_nan=True)
+            else:
+                assert list(g.fillna(-999)) == list(e.fillna(-999)), c
+        return got
+
+    def test_math_tail(self):
+        import pandas as pd
+        from spark_rapids_tpu.exprs.base import col, lit
+        from spark_rapids_tpu.exprs.math_exprs import (Acosh, Asinh,
+                                                       Atanh, Cot,
+                                                       Logarithm)
+        df = pd.DataFrame({"x": [1.5, 2.0, 0.5, 3.0],
+                           "b": [2.0, 10.0, 2.0, 3.0]})
+        self._parity(df, [
+            Cot(col("x")).alias("cot"),
+            Acosh(col("x") + lit(1.0)).alias("acosh"),
+            Asinh(col("x")).alias("asinh"),
+            Atanh(col("x") - lit(0.4)).alias("atanh"),
+            Logarithm(col("b"), col("x") + lit(1.0)).alias("logb"),
+        ])
+
+    def test_weekday_timeadd_tounix(self):
+        import pandas as pd
+        df = pd.DataFrame({
+            "d": pd.array([0, 3, 10227, 19000], "Int32"),
+            "ts": pd.array([0, 86400_000_000, 123_456_789, 7], "Int64"),
+        })
+        self._check_dt(df)
+
+    def _check_dt(self, df):
+        import numpy as np
+        from spark_rapids_tpu import config as C, types as T
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        from spark_rapids_tpu.exec.base import make_eval_context
+        from spark_rapids_tpu.exprs.base import Literal, col
+        from spark_rapids_tpu.exprs.datetime_exprs import (
+            TimeAdd, ToUnixTimestamp, WeekDay)
+        schema = T.Schema.of(("d", T.DATE32), ("ts", T.TIMESTAMP_US))
+        b = ColumnarBatch.from_numpy(
+            {"d": np.asarray(df["d"], np.int32),
+             "ts": np.asarray(df["ts"], np.int64)}, schema)
+        ctx = make_eval_context(b.columns, b.capacity, b.num_rows)
+        wd = WeekDay(col("d").bind(schema)).eval(ctx)
+        # 1970-01-01 (day 0) was a Thursday -> weekday 3 (Monday=0)
+        got = wd.to_pylist(b.num_rows)
+        import datetime
+        exp = [(datetime.date(1970, 1, 1) +
+                datetime.timedelta(days=int(x))).weekday()
+               for x in df["d"]]
+        assert got == exp, (got, exp)
+        tu = ToUnixTimestamp(col("ts").bind(schema)).eval(ctx)
+        assert tu.to_pylist(b.num_rows) == [
+            int(x) // 1_000_000 for x in df["ts"]]
+        ta = TimeAdd(col("ts").bind(schema),
+                     Literal(3_600_000_000, T.INT64)).eval(ctx)
+        assert ta.to_pylist(b.num_rows) == [
+            int(x) + 3_600_000_000 for x in df["ts"]]
+
+    def test_substring_index_parity(self):
+        import pandas as pd
+        from spark_rapids_tpu.exprs.base import col, lit
+        from spark_rapids_tpu.exprs.string_fns import SubstringIndex
+        df = pd.DataFrame({"s": ["a.b.c", "nodot", "", "x.y",
+                                 ".lead", "trail."]})
+        got = self._parity(df, [
+            SubstringIndex(col("s"), lit("."), lit(2)).alias("a"),
+            SubstringIndex(col("s"), lit("."), lit(-1)).alias("b"),
+        ])
+
+    def test_ansi_cast_overflow_raises(self):
+        import pandas as pd
+        import pytest
+        from spark_rapids_tpu import config as C, types as T
+        from spark_rapids_tpu.exprs.cast import Cast
+        from spark_rapids_tpu.exprs.base import col
+        from spark_rapids_tpu.plan import CpuProject, CpuSource, \
+            accelerate, collect
+        df = pd.DataFrame({"x": pd.array([1, 2, 1 << 40], "Int64")})
+        plan = CpuProject(
+            [Cast(col("x"), T.INT32, ansi=True).alias("y")],
+            CpuSource.from_pandas(df))
+        conf = C.RapidsConf({})
+        tplan = accelerate(plan, conf)
+        from spark_rapids_tpu.exec.base import TpuExec
+        assert isinstance(tplan, TpuExec)  # ANSI numeric cast accelerates
+        with pytest.raises(ArithmeticError):
+            collect(tplan, conf)
+
+    def test_ansi_cast_in_range_ok(self):
+        import pandas as pd
+        from spark_rapids_tpu import config as C, types as T
+        from spark_rapids_tpu.exprs.cast import Cast
+        from spark_rapids_tpu.exprs.base import col
+        from spark_rapids_tpu.plan import CpuProject, CpuSource, \
+            accelerate, collect
+        df = pd.DataFrame({"x": pd.array([1, -5, 1000], "Int64")})
+        plan = CpuProject(
+            [Cast(col("x"), T.INT32, ansi=True).alias("y")],
+            CpuSource.from_pandas(df))
+        conf = C.RapidsConf({})
+        got = collect(accelerate(plan, conf), conf)
+        assert list(got["y"].astype(int)) == [1, -5, 1000]
