@@ -20,8 +20,8 @@ def run_cpu(build_plan, t):
     return build_plan(t, lambda p: p.collect()).collect()
 
 
-def run_tpu(build_plan, t):
-    conf = tpu_conf()
+def run_tpu(build_plan, t, conf=None):
+    conf = conf or tpu_conf()
 
     def run(p):
         return collect(accelerate(p, conf), conf)
