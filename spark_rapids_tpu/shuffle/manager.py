@@ -306,7 +306,16 @@ class CachingShuffleReader:
             elif kind == "error":
                 raise FetchFailedError("remote", None, payload)
             elif kind == "fatal":
-                raise errors[0] if errors else FetchFailedError(
+                err = errors[0] if errors else None
+                if isinstance(err, (OSError, ConnectionError, EOFError)):
+                    # a dead/unreachable server is a FetchFailed (stage
+                    # retry), never a raw socket error (reference
+                    # RapidsShuffleIterator error path -> Spark
+                    # FetchFailedException)
+                    raise FetchFailedError(
+                        "remote", None,
+                        f"shuffle server unreachable: {err}") from err
+                raise err if err is not None else FetchFailedError(
                     "remote", None, payload)
             elif kind == "done":
                 finished = True

@@ -23,7 +23,10 @@ from spark_rapids_tpu.shuffle.manager import (MapOutputRegistry,
                                               TpuShuffleManager)
 
 spec = json.loads(sys.stdin.read())
-with C.session(C.RapidsConf({"spark.rapids.shuffle.enabled": True})):
+conf_map = {"spark.rapids.shuffle.enabled": True}
+if spec.get("codec"):
+    conf_map["spark.rapids.shuffle.compression.codec"] = spec["codec"]
+with C.session(C.RapidsConf(conf_map)):
     mgr = TpuShuffleManager("executor-B")
     # MapStatus entries arrive over the wire (the MapOutputTracker role);
     # the loop:// address is unreachable from this process, so the
@@ -34,14 +37,31 @@ with C.session(C.RapidsConf({"spark.rapids.shuffle.enabled": True})):
             MapStatus(m["executor_id"], m["address"],
                       m["partition_sizes"], tcp_address=m["tcp_address"]))
     result = {}
-    for p in range(spec["num_partitions"]):
-        rows = 0
-        ksum = 0
-        for batch in mgr.get_reader(spec["shuffle_id"], p, timeout=30.0):
-            df = batch.to_pandas()
-            rows += len(df)
-            ksum += int(df["k"].sum())
-        result[str(p)] = {"rows": rows, "ksum": ksum}
+    lo, hi = spec.get("partition_range",
+                      [0, spec["num_partitions"]])
+    timeout = spec.get("timeout", 30.0)
+    try:
+        for p in range(lo, hi):
+            rows = 0
+            ksum = 0
+            for batch in mgr.get_reader(spec["shuffle_id"], p,
+                                        timeout=timeout):
+                df = batch.to_pandas()
+                rows += len(df)
+                ksum += int(df["k"].sum())
+            result[str(p)] = {"rows": rows, "ksum": ksum}
+    except Exception as e:
+        if spec.get("expect_fetch_failed"):
+            from spark_rapids_tpu.shuffle.client_server import \
+                FetchFailedError
+            kind = ("FETCH_FAILED"
+                    if isinstance(e, FetchFailedError)
+                    else type(e).__name__)
+            print("RESULT:" + json.dumps({"error": kind}))
+            print(kind)
+            mgr.close()
+            sys.exit(0)
+        raise
     mgr.close()
 print("RESULT:" + json.dumps(result))
 """
@@ -97,3 +117,167 @@ def test_cross_process_fetch_via_tcp():
             assert got[str(p)] == expected[p], f"partition {p}"
         mgr.unregister_shuffle(shuffle_id)
         mgr.close()
+
+
+def _write_maps(mgr, shuffle_id, n_parts, n_maps=2, rng_seed=17,
+                conf_extra=None):
+    """Shared map-side: returns (outputs spec list, expected totals)."""
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    rng = np.random.default_rng(rng_seed)
+    expected = {p: {"rows": 0, "ksum": 0} for p in range(n_parts)}
+    outputs = []
+    for map_id in range(n_maps):
+        writer = mgr.get_writer(shuffle_id, map_id)
+        for p in range(n_parts):
+            k = rng.integers(0, 1000, 40 + 10 * p).astype(np.int64)
+            batch = ColumnarBatch.from_pandas(pd.DataFrame({"k": k}))
+            writer.write_partition(p, batch)
+            expected[p]["rows"] += len(k)
+            expected[p]["ksum"] += int(k.sum())
+        status = writer.commit(n_parts)
+        outputs.append({
+            "map_id": map_id,
+            "executor_id": status.executor_id,
+            "address": status.address,
+            "tcp_address": status.tcp_address,
+            "partition_sizes": status.partition_sizes,
+        })
+    return outputs, expected
+
+
+def _spawn_reader(spec_dict):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    return subprocess.run(
+        [sys.executable, "-c", CHILD],
+        input=json.dumps(spec_dict).encode(),
+        capture_output=True, timeout=240, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _check_child(proc, expected, n_parts):
+    out = proc.stdout.decode()
+    assert proc.returncode == 0, \
+        f"child failed:\n{out}\n{proc.stderr.decode()[-2000:]}"
+    line = [ln for ln in out.splitlines()
+            if ln.startswith("RESULT:")][-1]
+    got = json.loads(line[len("RESULT:"):])
+    for p in range(n_parts):
+        assert got[str(p)] == expected[p], f"partition {p}"
+
+
+def test_cross_process_fetch_compressed():
+    """Remote fetch of lz4-framed (CRC-checked) compressed payloads —
+    the reference's TableCompressionCodec path over a real wire."""
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    n_parts, shuffle_id = 3, 4243
+    conf = C.RapidsConf({
+        "spark.rapids.shuffle.enabled": True,
+        "spark.rapids.shuffle.compression.codec": "lz4"})
+    with C.session(conf):
+        mgr = TpuShuffleManager("executor-A")
+        mgr.register_shuffle(shuffle_id)
+        outputs, expected = _write_maps(mgr, shuffle_id, n_parts,
+                                        rng_seed=19)
+        proc = _spawn_reader({"shuffle_id": shuffle_id,
+                              "num_partitions": n_parts,
+                              "outputs": outputs,
+                              "codec": "lz4"})
+        _check_child(proc, expected, n_parts)
+        mgr.unregister_shuffle(shuffle_id)
+        mgr.close()
+
+
+def test_cross_process_fetch_spilled_tier():
+    """The remote side fetches buffers that were spilled device->host
+    (and partially ->disk) BEFORE the fetch: BufferSendState must pull
+    from whatever tier holds the data (reference
+    RapidsShuffleServer.scala:380 acquires from any tier)."""
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.memory.env import ResourceEnv
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    n_parts, shuffle_id = 3, 4244
+    with C.session(C.RapidsConf({"spark.rapids.shuffle.enabled": True})):
+        env = ResourceEnv.get()
+        mgr = TpuShuffleManager("executor-A")
+        mgr.register_shuffle(shuffle_id)
+        outputs, expected = _write_maps(mgr, shuffle_id, n_parts,
+                                        rng_seed=23)
+        spilled = env.device_store.synchronous_spill(0)
+        assert spilled > 0
+        # push part of the host tier onward to disk too
+        env.host_store.synchronous_spill(env.host_store.spillable_size
+                                         // 2)
+        proc = _spawn_reader({"shuffle_id": shuffle_id,
+                              "num_partitions": n_parts,
+                              "outputs": outputs})
+        _check_child(proc, expected, n_parts)
+        mgr.unregister_shuffle(shuffle_id)
+        mgr.close()
+
+
+def test_cross_process_two_concurrent_reducers():
+    """Two reader PROCESSES fetch different partitions concurrently
+    from one server (the reference's throttled multi-client serving)."""
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    n_parts, shuffle_id = 4, 4245
+    with C.session(C.RapidsConf({"spark.rapids.shuffle.enabled": True})):
+        mgr = TpuShuffleManager("executor-A")
+        mgr.register_shuffle(shuffle_id)
+        outputs, expected = _write_maps(mgr, shuffle_id, n_parts,
+                                        rng_seed=29)
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        procs = []
+        for lo, hi in ((0, 2), (2, 4)):
+            spec = json.dumps({"shuffle_id": shuffle_id,
+                               "num_partitions": n_parts,
+                               "partition_range": [lo, hi],
+                               "outputs": outputs})
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", CHILD],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, env=env, cwd=cwd))
+            procs[-1].stdin.write(spec.encode())
+            procs[-1].stdin.close()
+        results = {}
+        for proc, (lo, hi) in zip(procs, ((0, 2), (2, 4))):
+            out = proc.stdout.read().decode()
+            err = proc.stderr.read().decode()
+            assert proc.wait(timeout=240) == 0, f"{out}\n{err[-2000:]}"
+            line = [ln for ln in out.splitlines()
+                    if ln.startswith("RESULT:")][-1]
+            got = json.loads(line[len("RESULT:"):])
+            for p in range(lo, hi):
+                results[p] = got[str(p)]
+        for p in range(n_parts):
+            assert results[p] == expected[p], f"partition {p}"
+        mgr.unregister_shuffle(shuffle_id)
+        mgr.close()
+
+
+def test_cross_process_dead_server_fetch_failed():
+    """Fetching from a server that has gone away must surface the
+    FetchFailed semantics (stage-retry signal), not hang (reference
+    RapidsShuffleIterator error path)."""
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+    n_parts, shuffle_id = 2, 4246
+    with C.session(C.RapidsConf({"spark.rapids.shuffle.enabled": True})):
+        mgr = TpuShuffleManager("executor-A")
+        mgr.register_shuffle(shuffle_id)
+        outputs, _ = _write_maps(mgr, shuffle_id, n_parts, rng_seed=31)
+        # kill the serving executor BEFORE the fetch
+        mgr.close()
+        proc = _spawn_reader({"shuffle_id": shuffle_id,
+                              "num_partitions": n_parts,
+                              "outputs": outputs,
+                              "expect_fetch_failed": True,
+                              "timeout": 6.0})
+        out = proc.stdout.decode()
+        assert proc.returncode == 0, \
+            f"{out}\n{proc.stderr.decode()[-2000:]}"
+        assert "FETCH_FAILED" in out, out
