@@ -161,6 +161,8 @@ class ShuffleExchangeExec(UnaryExecBase):
     #: test-facing counter (ExecutionPlanCapture discipline): number of
     #: exchanges actually routed through the mesh collective lane
     _MESH_EXCHANGES_RUN = 0
+    #: oversized single batches sharded across the mesh (SURVEY §5)
+    _OVERSIZED_SPLITS = 0
 
     def _execute_via_mesh(self, mesh, axis):
         """Accelerated path: one SPMD all-to-all over the mesh replaces
@@ -174,11 +176,29 @@ class ShuffleExchangeExec(UnaryExecBase):
             build_all_to_all_exchange, build_count_exchange,
             stack_batches, unstack_batches)
         n = self.partitioning.num_partitions
+        from spark_rapids_tpu import config as C
+        max_rows = C.get_active_conf()[C.MAX_BATCH_ROWS]
         groups: list[list[ColumnarBatch]] = [[] for _ in range(n)]
-        for i, it in enumerate(self.child.execute_partitions()):
+        slot = 0
+        for it in self.child.execute_partitions():
             for b in it:
-                if b.maybe_nonempty():
-                    groups[i % n].append(b)
+                if not b.maybe_nonempty():
+                    continue
+                if b.num_rows_known and b.num_rows > max_rows:
+                    # SURVEY §5 long-context analog: ONE batch larger
+                    # than the per-chip budget is sharded ACROSS the
+                    # mesh before the all-to-all (the sp lane), instead
+                    # of overflowing one chip's HBM (reference guard:
+                    # GpuCoalesceBatches.scala:166-169 + spill tiers)
+                    per = -(-b.num_rows // n)
+                    ShuffleExchangeExec._OVERSIZED_SPLITS += 1
+                    for lo in range(0, b.num_rows, per):
+                        groups[slot % n].append(
+                            b.slice(lo, min(per, b.num_rows - lo)))
+                        slot += 1
+                else:
+                    groups[slot % n].append(b)
+                    slot += 1
         locals_ = [concat_batches(g).dense() if g
                    else empty_batch(self._schema)
                    for g in groups]

@@ -107,3 +107,54 @@ def test_tpch_mesh_exchange_parity(tpch_tables, mesh8, query):
     assert ShuffleExchangeExec._MESH_EXCHANGES_RUN > 0, \
         "no exchange actually took the mesh collective lane"
     compare_frames(expected, got, f"q{query}-mesh")
+
+
+def test_oversized_single_batch_shards_across_mesh(mesh8):
+    """SURVEY §5 long-context analog: ONE batch beyond the per-chip
+    budget is split over the mesh devices before the all-to-all, and
+    the exchanged result stays exact (planner + mesh halves of the
+    VERDICT r2 #9 done-criterion)."""
+    import pandas as pd
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exec.basic import LocalBatchSource
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.plan.transitions import batch_from_df
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+    from spark_rapids_tpu.parallel.mesh import active_mesh
+
+    rng = np.random.default_rng(33)
+    rows = 4000
+    df = pd.DataFrame({
+        "k": rng.integers(0, 500, rows).astype(np.int64),
+        "v": rng.uniform(0, 1, rows)})
+    schema_src = batch_from_df(df, None) if False else None
+    from spark_rapids_tpu.plan.nodes import CpuSource
+    schema = CpuSource.from_pandas(df).output_schema()
+    big = batch_from_df(df, schema)  # ONE oversized batch
+    src = LocalBatchSource([[big]], schema)
+    conf = C.RapidsConf({"spark.rapids.tpu.batchMaxRows": 512})
+    before = ShuffleExchangeExec._OVERSIZED_SPLITS
+    with C.session(conf), active_mesh(mesh8):
+        ex = ShuffleExchangeExec(HashPartitioning([col("k")], 8), src)
+        outs = [b for it in ex.execute_partitions() for b in it]
+    assert ShuffleExchangeExec._OVERSIZED_SPLITS > before, \
+        "oversized batch was not sharded"
+    got = pd.concat([b.to_pandas() for b in outs], ignore_index=True)
+    assert len(got) == rows
+    assert int(got["k"].sum()) == int(df["k"].sum())
+    # partition routing is still murmur3-exact after the split
+    from spark_rapids_tpu.ops.murmur3 import partition_ids
+    import jax.numpy as jnp
+    for p, b in enumerate(outs):
+        if b.num_rows == 0:
+            continue
+        pb = b.to_pandas()
+        import numpy as _np
+        kcol = big.column("k")
+        # recompute expected partition of each routed key via the engine
+        from spark_rapids_tpu.columnar.batch import ColumnarBatch
+        chk = ColumnarBatch.from_pandas(pb[["k"]])
+        pids = _np.asarray(partition_ids([chk.column("k")], 8)
+                           )[:chk.num_rows]
+        assert (pids == p).all()
