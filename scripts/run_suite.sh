@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# CI suite runner (reference jenkins/spark-tests.sh analog): runs the
+# fast unit tier, the scale ("slow") tier, a shim version matrix over
+# the version-sensitive suites, and a bench smoke. Usage:
+#   scripts/run_suite.sh [fast|slow|shims|bench|all]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TIER="${1:-fast}"
+PYTEST=(python -m pytest -q -p no:randomly)
+
+run_fast() {
+  echo "== fast tier (unit + integration, virtual 8-device CPU mesh) =="
+  "${PYTEST[@]}" tests/ -m "not slow" --ignore=tests/test_workloads.py
+  echo "== workload parity (TPC-H / TPC-DS / TPCx-BB / Mortgage) =="
+  "${PYTEST[@]}" tests/test_workloads.py
+}
+
+run_slow() {
+  echo "== slow tier (multi-batch scale + asserted spill) =="
+  "${PYTEST[@]}" tests/test_scale_workloads.py -m slow
+}
+
+run_shims() {
+  # the shim suite internally parametrizes the full version matrix
+  # (3.0.0 / 3.0.1 / 3.0.2 / 3.1.0 / databricks) via
+  # spark.rapids.tpu.sparkVersion — the per-version premerge analog
+  # (reference jenkins/Jenkinsfile.30*)
+  echo "== shim version matrix =="
+  "${PYTEST[@]}" tests/test_shims.py tests/test_plan_overrides.py
+}
+
+run_bench() {
+  echo "== bench smoke (one JSON line per metric; real chip if present) =="
+  python bench.py
+}
+
+case "$TIER" in
+  fast)  run_fast ;;
+  slow)  run_slow ;;
+  shims) run_shims ;;
+  bench) run_bench ;;
+  all)   run_fast; run_slow; run_shims; run_bench ;;
+  *) echo "usage: $0 [fast|slow|shims|bench|all]" >&2; exit 2 ;;
+esac
