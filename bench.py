@@ -13,14 +13,20 @@ Mirrors the reference bench harness shape (cold + hot runs,
      wall clock approaches the memory-bound roofline.  Reports
      effective GB/s and fraction of a v5e's ~819 GB/s.
   3. groupby_sf1     — BASELINE milestone 2: group-by sum/count on a
-     TPC-H SF1-sized lineitem through the REAL exec path
-     (accelerate()'d plan, kernel cache, coalesce, metrics).
-  4. join_sort_q3    — milestone 3: shuffled hash join + sort, q3 shape.
+     TPC-H SF1-sized lineitem through the REAL exec path with the
+     planner-automatic dictGroupby fast lane (accelerate()'d plan,
+     kernel cache, coalesce, metrics); groupby_sf1_sort records the
+     general sort-based lane.
+  4. join_sort_q3    — milestone 3: dense direct-address join + full
+     sort + limit 10 (real q3 tail); join_topn_q3 is the same query
+     through the planner's TakeOrderedAndProject lowering (the plan
+     shape Spark itself produces).
   5. exchange_mgr    — milestone 4 (single-executor form): hash exchange
      routed through TpuShuffleManager's spillable catalog.
-  6. groupby_dict_kernel — the Pallas dictionary-encoded grouped-sum
-     kernel on milestone 2's shape (the sort-free path the planner will
-     adopt with dictionary detection; `mode: "kernel"`).
+  6. groupby_dict_kernel — the bare Pallas dictionary grouped-sum
+     kernel on milestone 2's shape (`mode: "kernel"`).
+  7. udf_q27         — milestone 5: TPCx-BB q27 with its text UDF
+     compiled by the udf-compiler and run on TPU.
 
 Every hot dispatch gets distinct inputs (the axon tunnel memoizes
 identical calls, and `block_until_ready` does not reliably fence — a
