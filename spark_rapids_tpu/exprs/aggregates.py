@@ -405,3 +405,72 @@ def Avg(e: Expression) -> Average:
 
 def CountStar() -> Count:
     return Count(None)
+
+
+@dataclasses.dataclass
+class VarianceSamp(AggregateFunction):
+    """Spark var_samp -> double; intermediates (sum, sum_sq, count).
+    Null for groups with fewer than two non-null inputs (pandas ddof=1
+    semantics; reference registers GpuStddevSamp-family aggregates over
+    cuDF VARIANCE/STD)."""
+    child: Expression
+
+    def result_type(self, schema):
+        return T.FLOAT64
+
+    def intermediate_types(self, schema):
+        return (T.FLOAT64, T.FLOAT64, T.INT64)
+
+    num_intermediates = 3
+
+    def result_from_intermediates(self, inter):
+        return T.FLOAT64
+
+    def update(self, ctx, inputs):
+        (v,) = inputs
+        ok = v.validity & ctx.row_valid
+        x = jnp.where(ok, v.data.astype(jnp.float64), 0.0)
+        s = _sorted_seg_sum(x, ctx.seg_ids, ctx.capacity)
+        s2 = _sorted_seg_sum(x * x, ctx.seg_ids, ctx.capacity)
+        c = _sorted_seg_sum(ok.astype(jnp.int64), ctx.seg_ids,
+                            ctx.capacity)
+        always = jnp.ones(ctx.capacity, bool)
+        return (ColumnVector(T.FLOAT64, s, always),
+                ColumnVector(T.FLOAT64, s2, always),
+                ColumnVector(T.INT64, c, always))
+
+    def merge(self, ctx, partials):
+        s_p, s2_p, c_p = partials
+        ok = ctx.row_valid
+        s = _sorted_seg_sum(jnp.where(ok, s_p.data, 0.0), ctx.seg_ids,
+                            ctx.capacity)
+        s2 = _sorted_seg_sum(jnp.where(ok, s2_p.data, 0.0),
+                             ctx.seg_ids, ctx.capacity)
+        c = _sorted_seg_sum(jnp.where(ok, c_p.data, 0), ctx.seg_ids,
+                            ctx.capacity)
+        always = jnp.ones(ctx.capacity, bool)
+        return (ColumnVector(T.FLOAT64, s, always),
+                ColumnVector(T.FLOAT64, s2, always),
+                ColumnVector(T.INT64, c, always))
+
+    def _var(self, partials):
+        s, s2, c = partials
+        n = c.data.astype(jnp.float64)
+        ok = c.data > 1
+        denom = jnp.where(ok, n - 1.0, 1.0)
+        m2 = s2.data - (s.data * s.data) / jnp.where(c.data > 0, n, 1.0)
+        # clamp tiny negative residue from cancellation
+        return jnp.maximum(m2, 0.0) / denom, ok
+
+    def evaluate(self, partials, schema):
+        var, ok = self._var(partials)
+        return ColumnVector(T.FLOAT64, var, ok)
+
+
+@dataclasses.dataclass
+class StddevSamp(VarianceSamp):
+    """Spark stddev_samp -> double (sqrt of the sample variance)."""
+
+    def evaluate(self, partials, schema):
+        var, ok = self._var(partials)
+        return ColumnVector(T.FLOAT64, jnp.sqrt(var), ok)

@@ -1,11 +1,11 @@
-"""TPC-DS-like query set (reference
-`integration_tests/.../tpcds/TpcdsLikeSpark.scala`).  Same plan-tree
-style as tpch_queries; queries marked "-shape" follow the reference
-query's operator shape over the engine's v0 type matrix (no decimals,
-reduced column sets).  Coverage spans the reference's main families:
-star-join reports, returns-vs-average correlated shapes, multi-channel
-unions, semi/anti-join existence tests, left-outer returns netting,
-shipping-lag bucketing, time-slot pivots, and ratio reports."""
+"""TPC-DS-like query set: all 103 queries of the reference suite
+(`integration_tests/.../tpcds/TpcdsLikeSpark.scala:708+`), each built
+faithfully from the reference query text as a plan tree in the same
+DSL style as tpch_queries, over the engine's v0 type matrix (no
+decimals; money as float64).  Where a reference literal has no support
+in the synthetic data domain (state lists, month-seq anchors,
+price/cov thresholds), a stand-in literal is used and commented at the
+site."""
 from __future__ import annotations
 
 from spark_rapids_tpu.exec.joins import JoinType
@@ -91,7 +91,8 @@ def q55(t, run):
     """Brand revenue for one manager, month, year."""
     dd = CpuFilter((col("d_year") == lit(2001)) &
                    (col("d_moy") == lit(12)), t["date_dim"])
-    it = CpuFilter(col("i_manager_id") == lit(28), t["item"])
+    # reference manager 28 stands in as 4 (a zipf-hot head slice)
+    it = CpuFilter(col("i_manager_id") == lit(4), t["item"])
     j = _join(_join(dd, t["store_sales"],
                     ["d_date_sk"], ["ss_sold_date_sk"]),
               it, ["ss_item_sk"], ["i_item_sk"])
@@ -100,44 +101,6 @@ def q55(t, run):
         [Sum(col("ss_ext_sales_price")).alias("ext_price")], j)
     return CpuLimit(100, CpuSort(
         [desc(col("ext_price")), asc(col("i_brand_id"))], agg))
-
-
-def q7_shape(t, run):
-    """Average metrics per item under promotion (q7 without cdemo)."""
-    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
-    promo = CpuFilter((col("p_channel_email") == lit("N")) |
-                      (col("p_channel_event") == lit("N")),
-                      t["promotion"])
-    j = _join(_join(_join(dd, t["store_sales"],
-                          ["d_date_sk"], ["ss_sold_date_sk"]),
-                    promo, ["ss_promo_sk"], ["p_promo_sk"]),
-              t["item"], ["ss_item_sk"], ["i_item_sk"])
-    agg = CpuAggregate(
-        [col("i_item_id")],
-        [Average(col("ss_quantity")).alias("agg1"),
-         Average(col("ss_list_price")).alias("agg2"),
-         Average(col("ss_coupon_amt")).alias("agg3"),
-         Average(col("ss_sales_price")).alias("agg4")], j)
-    return CpuLimit(100, CpuSort([asc(col("i_item_id"))], agg))
-
-
-def q27_shape(t, run):
-    """State-level item averages (q27 without cdemo rollup)."""
-    dd = CpuFilter(col("d_year") == lit(2002), t["date_dim"])
-    st = CpuFilter(InSet(col("s_state"), ("TX", "CA", "WA", "NY")),
-                   t["store"])
-    j = _join(_join(_join(dd, t["store_sales"],
-                          ["d_date_sk"], ["ss_sold_date_sk"]),
-                    st, ["ss_store_sk"], ["s_store_sk"]),
-              t["item"], ["ss_item_sk"], ["i_item_sk"])
-    agg = CpuAggregate(
-        [col("i_item_id"), col("s_state")],
-        [Average(col("ss_quantity")).alias("agg1"),
-         Average(col("ss_list_price")).alias("agg2"),
-         Average(col("ss_coupon_amt")).alias("agg3"),
-         Average(col("ss_sales_price")).alias("agg4")], j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("i_item_id")), asc(col("s_state"))], agg))
 
 
 def q68(t, run):
@@ -204,23 +167,6 @@ def q96(t, run):
     return CpuAggregate([], [Count(None).alias("cnt")], j)
 
 
-def q98_shape(t, run):
-    """Revenue by item within categories over one month."""
-    dd = CpuFilter((col("d_year") == lit(1999)) &
-                   (col("d_moy") == lit(2)), t["date_dim"])
-    it = CpuFilter(InSet(col("i_category"),
-                         ("Sports", "Books", "Home")), t["item"])
-    j = _join(_join(dd, t["store_sales"],
-                    ["d_date_sk"], ["ss_sold_date_sk"]),
-              it, ["ss_item_sk"], ["i_item_sk"])
-    agg = CpuAggregate(
-        [col("i_item_id"), col("i_category"), col("i_current_price")],
-        [Sum(col("ss_ext_sales_price")).alias("itemrevenue")], j)
-    return CpuSort([asc(col("i_category")), asc(col("i_item_id"))], agg)
-
-
-
-
 # ---------------------------------------------------------------------------
 # returns / correlated-average shapes
 def q1(t, run):
@@ -248,33 +194,6 @@ def q1(t, run):
         CpuProject([col("c_customer_id")], j)))
 
 
-def q6_shape(t, run):
-    """States of customers buying items priced 1.2x above their
-    category average."""
-    avg_cat = CpuAggregate(
-        [col("i_category")],
-        [Average(col("i_current_price")).alias("avg_p")], t["item"])
-    pricey = CpuFilter(
-        col("i_current_price") > col("avg_p") * lit(1.2),
-        _join(t["item"], CpuProject(
-            [col("i_category").alias("cat2"), col("avg_p")], avg_cat),
-            ["i_category"], ["cat2"]))
-    dd = CpuFilter((col("d_year") == lit(2000)) &
-                   (col("d_moy") == lit(1)), t["date_dim"])
-    j = _join(_join(_join(_join(dd, t["store_sales"],
-                                ["d_date_sk"], ["ss_sold_date_sk"]),
-                          pricey, ["ss_item_sk"], ["i_item_sk"]),
-                    t["customer"],
-                    ["ss_customer_sk"], ["c_customer_sk"]),
-              t["customer_address"],
-              ["c_current_addr_sk"], ["ca_address_sk"])
-    agg = CpuAggregate([col("ca_state")],
-                       [Count(None).alias("cnt")], j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("cnt")), asc(col("ca_state"))],
-        CpuFilter(col("cnt") >= lit(3), agg)))
-
-
 def q65(t, run):
     """Store items whose revenue is at most 10% of the store's average
     item revenue."""
@@ -300,21 +219,6 @@ def q65(t, run):
 
 # ---------------------------------------------------------------------------
 # catalog / web channel star joins
-def q15_shape(t, run):
-    """Catalog revenue by customer state for one quarter."""
-    dd = CpuFilter((col("d_year") == lit(2001)) &
-                   (col("d_qoy") == lit(2)), t["date_dim"])
-    j = _join(_join(_join(dd, t["catalog_sales"],
-                          ["d_date_sk"], ["cs_sold_date_sk"]),
-                    t["customer"],
-                    ["cs_bill_customer_sk"], ["c_customer_sk"]),
-              t["customer_address"],
-              ["c_current_addr_sk"], ["ca_address_sk"])
-    agg = CpuAggregate([col("ca_state")],
-                       [Sum(col("cs_sales_price")).alias("total")], j)
-    return CpuLimit(100, CpuSort([asc(col("ca_state"))], agg))
-
-
 def q26(t, run):
     """Catalog item averages for one demographic slice (q7's catalog
     twin)."""
@@ -335,134 +239,10 @@ def q26(t, run):
     return CpuLimit(100, CpuSort([asc(col("i_item_id"))], agg))
 
 
-def q45_shape(t, run):
-    """Web revenue by customer state for one quarter."""
-    dd = CpuFilter((col("d_year") == lit(2001)) &
-                   (col("d_qoy") == lit(2)), t["date_dim"])
-    j = _join(_join(_join(dd, t["web_sales"],
-                          ["d_date_sk"], ["ws_sold_date_sk"]),
-                    t["customer"],
-                    ["ws_bill_customer_sk"], ["c_customer_sk"]),
-              t["customer_address"],
-              ["c_current_addr_sk"], ["ca_address_sk"])
-    agg = CpuAggregate([col("ca_state")],
-                       [Sum(col("ws_sales_price")).alias("total")], j)
-    return CpuLimit(100, CpuSort([asc(col("ca_state"))], agg))
-
-
-def q48_shape(t, run):
-    """Store quantity total across demographic/quantity-band slices."""
-    cd = CpuFilter(
-        ((col("cd_marital_status") == lit("M")) &
-         (col("cd_education_status") == lit("4 yr Degree"))) |
-        ((col("cd_marital_status") == lit("D")) &
-         (col("cd_education_status") == lit("2 yr Degree"))),
-        t["customer_demographics"])
-    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
-    sales = CpuFilter(
-        ((col("ss_quantity") >= lit(1)) &
-         (col("ss_quantity") <= lit(40))) |
-        ((col("ss_quantity") >= lit(61)) &
-         (col("ss_quantity") <= lit(100))), t["store_sales"])
-    j = _join(_join(_join(dd, sales,
-                          ["d_date_sk"], ["ss_sold_date_sk"]),
-                    cd, ["ss_cdemo_sk"], ["cd_demo_sk"]),
-              t["store"], ["ss_store_sk"], ["s_store_sk"])
-    return CpuAggregate([], [Sum(col("ss_quantity")).alias("total")], j)
-
-
 # ---------------------------------------------------------------------------
 # multi-channel unions
-def q33_shape(t, run):
-    """Manufacturer revenue across all three channels for one month
-    (reference q33/q56/q60 family)."""
-    dd = CpuFilter((col("d_year") == lit(1999)) &
-                   (col("d_moy") == lit(3)), t["date_dim"])
-    it = CpuFilter(col("i_category") == lit("Books"), t["item"])
-
-    def channel(sales, date_key, item_key, price):
-        j = _join(_join(dd, t[sales], ["d_date_sk"], [date_key]),
-                  it, [item_key], ["i_item_sk"])
-        return CpuProject(
-            [col("i_manufact_id"),
-             col(price).alias("total_sales")], j)
-
-    u = CpuUnion(channel("store_sales", "ss_sold_date_sk",
-                         "ss_item_sk", "ss_ext_sales_price"),
-                 channel("catalog_sales", "cs_sold_date_sk",
-                         "cs_item_sk", "cs_ext_sales_price"),
-                 channel("web_sales", "ws_sold_date_sk",
-                         "ws_item_sk", "ws_ext_sales_price"))
-    agg = CpuAggregate([col("i_manufact_id")],
-                       [Sum(col("total_sales")).alias("total_sales")], u)
-    return CpuLimit(100, CpuSort([desc(col("total_sales")),
-                                  asc(col("i_manufact_id"))], agg))
-
-
-def q28_shape(t, run):
-    """Six price-band averages over store_sales (reference q28's six
-    bucket subqueries, united instead of cross-joined)."""
-    bands = [(0, 5, 11), (6, 51, 57), (11, 91, 97),
-             (16, 131, 137), (21, 171, 177), (26, 100, 200)]
-    parts = []
-    for i, (qlo, plo, phi) in enumerate(bands):
-        f = CpuFilter(
-            (col("ss_quantity") >= lit(qlo)) &
-            (col("ss_quantity") <= lit(qlo + 4)) &
-            (col("ss_list_price") >= lit(float(plo))) &
-            (col("ss_list_price") <= lit(float(phi))),
-            t["store_sales"])
-        agg = CpuAggregate(
-            [], [Average(col("ss_list_price")).alias("avg_price"),
-                 Count(col("ss_list_price")).alias("cnt")], f)
-        parts.append(CpuProject(
-            [lit(i).alias("bucket"), col("avg_price"), col("cnt")], agg))
-    return CpuSort([asc(col("bucket"))], CpuUnion(*parts))
-
-
 # ---------------------------------------------------------------------------
 # existence tests (semi/anti joins)
-def q16_shape(t, run):
-    """Catalog orders in a date window with no returns: order count +
-    cost sums (reference q16's `not exists` as a LEFT_ANTI join;
-    distinct order count as a per-order pre-aggregate)."""
-    dd = CpuFilter((col("d_year") == lit(2000)) &
-                   (col("d_moy") <= lit(4)), t["date_dim"])
-    sales = _join(dd, t["catalog_sales"],
-                  ["d_date_sk"], ["cs_sold_date_sk"])
-    no_ret = CpuHashJoin(
-        J.LEFT_ANTI, [col("cs_order_number")], [col("cr_order_number")],
-        sales, t["catalog_returns"])
-    per_order = CpuAggregate(
-        [col("cs_order_number")],
-        [Sum(col("cs_ext_ship_cost")).alias("ship_cost"),
-         Sum(col("cs_net_profit")).alias("net_profit")], no_ret)
-    return CpuAggregate(
-        [], [Count(None).alias("order_count"),
-             Sum(col("ship_cost")).alias("total_shipping_cost"),
-             Sum(col("net_profit")).alias("total_net_profit")],
-        per_order)
-
-
-def q37_shape(t, run):
-    """Items in a price band with healthy inventory that sold through
-    catalog (reference q37: inventory + semi-join on catalog sales)."""
-    it = CpuFilter(
-        (col("i_current_price") >= lit(20.0)) &
-        (col("i_current_price") <= lit(50.0)), t["item"])
-    inv = CpuFilter(
-        (col("inv_quantity_on_hand") >= lit(100)) &
-        (col("inv_quantity_on_hand") <= lit(500)), t["inventory"])
-    stocked = _join(it, inv, ["i_item_sk"], ["inv_item_sk"])
-    sold = CpuHashJoin(
-        J.LEFT_SEMI, [col("i_item_sk")], [col("cs_item_sk")],
-        stocked, t["catalog_sales"])
-    agg = CpuAggregate(
-        [col("i_item_id"), col("i_current_price")],
-        [Count(None).alias("stock_rows")], sold)
-    return CpuLimit(100, CpuSort([asc(col("i_item_id"))], agg))
-
-
 def q97(t, run):
     """Customer-item overlap between store and catalog channels
     (reference q97: FULL OUTER join of deduplicated channel pairs)."""
@@ -488,77 +268,6 @@ def q97(t, run):
 
 # ---------------------------------------------------------------------------
 # returns netting / outer joins
-def q93_shape(t, run):
-    """Actual net paid per customer: sold quantity minus returned
-    quantity (reference q93's LEFT OUTER store_returns netting)."""
-    j = CpuHashJoin(
-        J.LEFT_OUTER,
-        [col("ss_item_sk"), col("ss_ticket_number")],
-        [col("sr_item_sk"), col("sr_ticket_number")],
-        t["store_sales"], t["store_returns"])
-    paid = CpuProject(
-        [col("ss_customer_sk"),
-         If(IsNotNull(col("sr_return_quantity")),
-            (col("ss_quantity") - col("sr_return_quantity"))
-            * col("ss_sales_price"),
-            col("ss_quantity") * col("ss_sales_price")).alias("act_sales")],
-        j)
-    agg = CpuAggregate([col("ss_customer_sk")],
-                       [Sum(col("act_sales")).alias("sumsales")], paid)
-    return CpuLimit(100, CpuSort(
-        [desc(col("sumsales")), asc(col("ss_customer_sk"))], agg))
-
-
-def q40_shape(t, run):
-    """Catalog sales netted against returns by warehouse state, split
-    around a pivot date (reference q40's before/after CASE sums)."""
-    j = CpuHashJoin(
-        J.LEFT_OUTER,
-        [col("cs_order_number"), col("cs_item_sk")],
-        [col("cr_order_number"), col("cr_item_sk")],
-        t["catalog_sales"], t["catalog_returns"])
-    j = _join(_join(j, t["warehouse"],
-                    ["cs_warehouse_sk"], ["w_warehouse_sk"]),
-              CpuFilter(col("d_year") == lit(2000), t["date_dim"]),
-              ["cs_sold_date_sk"], ["d_date_sk"])
-    net = col("cs_sales_price") - Coalesce(
-        (col("cr_return_amount"), lit(0.0)))
-    agg = CpuAggregate(
-        [col("w_state")],
-        [Sum(If(col("d_moy") < lit(6), net, lit(0.0))).alias(
-            "sales_before"),
-         Sum(If(col("d_moy") >= lit(6), net, lit(0.0))).alias(
-            "sales_after")], j)
-    return CpuSort([asc(col("w_state"))], agg)
-
-
-def q25_shape(t, run):
-    """Items sold, returned, then re-bought on catalog (reference q25's
-    three-fact join), with profit rollups."""
-    ss = _join(CpuFilter(col("d_year") == lit(2000), t["date_dim"]),
-               t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"])
-    sr = CpuHashJoin(
-        J.INNER,
-        [col("ss_customer_sk"), col("ss_item_sk"),
-         col("ss_ticket_number")],
-        [col("sr_customer_sk"), col("sr_item_sk"),
-         col("sr_ticket_number")], ss, t["store_returns"])
-    cs = CpuHashJoin(
-        J.INNER,
-        [col("sr_customer_sk"), col("sr_item_sk")],
-        [col("cs_bill_customer_sk"), col("cs_item_sk")],
-        sr, t["catalog_sales"])
-    j = _join(_join(cs, t["store"], ["ss_store_sk"], ["s_store_sk"]),
-              t["item"], ["ss_item_sk"], ["i_item_sk"])
-    agg = CpuAggregate(
-        [col("i_item_id"), col("s_store_id")],
-        [Sum(col("ss_net_profit")).alias("store_sales_profit"),
-         Sum(col("sr_net_loss")).alias("store_returns_loss"),
-         Sum(col("cs_net_profit")).alias("catalog_sales_profit")], j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("i_item_id")), asc(col("s_store_id"))], agg))
-
-
 # ---------------------------------------------------------------------------
 # shipping-lag bucketing
 def _lag_buckets(lag, prefix):
@@ -571,1052 +280,11 @@ def _lag_buckets(lag, prefix):
     ]
 
 
-def q62_shape(t, run):
-    """Web shipping-lag day buckets per warehouse (reference q62)."""
-    j = _join(t["web_sales"], t["warehouse"],
-              ["ws_warehouse_sk"], ["w_warehouse_sk"])
-    lag = col("ws_ship_date_sk") - col("ws_sold_date_sk")
-    agg = CpuAggregate([col("w_warehouse_name")],
-                       _lag_buckets(lag, ""), j)
-    return CpuSort([asc(col("w_warehouse_name"))], agg)
-
-
-def q99_shape(t, run):
-    """Catalog shipping-lag day buckets per warehouse (reference q99)."""
-    j = _join(t["catalog_sales"], t["warehouse"],
-              ["cs_warehouse_sk"], ["w_warehouse_sk"])
-    lag = col("cs_ship_date_sk") - col("cs_sold_date_sk")
-    agg = CpuAggregate([col("w_warehouse_name")],
-                       _lag_buckets(lag, ""), j)
-    return CpuSort([asc(col("w_warehouse_name"))], agg)
-
-
-def q50_shape(t, run):
-    """Store return-lag day buckets per store (reference q50)."""
-    j = CpuHashJoin(
-        J.INNER,
-        [col("ss_item_sk"), col("ss_ticket_number"),
-         col("ss_customer_sk")],
-        [col("sr_item_sk"), col("sr_ticket_number"),
-         col("sr_customer_sk")],
-        t["store_sales"], t["store_returns"])
-    j = _join(j, t["store"], ["ss_store_sk"], ["s_store_sk"])
-    lag = col("sr_returned_date_sk") - col("ss_sold_date_sk")
-    agg = CpuAggregate([col("s_store_name")],
-                       _lag_buckets(lag, ""), j)
-    return CpuSort([asc(col("s_store_name"))], agg)
-
-
 # ---------------------------------------------------------------------------
 # pivots, time slots, ratios
-def q43_shape(t, run):
-    """Day-of-week sales pivot per store (reference q43)."""
-    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
-    j = _join(_join(dd, t["store_sales"],
-                    ["d_date_sk"], ["ss_sold_date_sk"]),
-              t["store"], ["ss_store_sk"], ["s_store_sk"])
-    day = lambda name: Sum(If(col("d_day_name") == lit(name),
-                              col("ss_sales_price"), lit(0.0)))
-    agg = CpuAggregate(
-        [col("s_store_name"), col("s_store_id")],
-        [day("Sunday").alias("sun_sales"),
-         day("Monday").alias("mon_sales"),
-         day("Tuesday").alias("tue_sales"),
-         day("Wednesday").alias("wed_sales"),
-         day("Thursday").alias("thu_sales"),
-         day("Friday").alias("fri_sales"),
-         day("Saturday").alias("sat_sales")], j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("s_store_name")), asc(col("s_store_id"))], agg))
-
-
-def q88_shape(t, run):
-    """Counts of store sales in four afternoon time slots for one
-    demographic (reference q88's eight-way self-join, as one pivot)."""
-    hd = CpuFilter(col("hd_dep_count") == lit(3),
-                   t["household_demographics"])
-    j = _join(_join(t["store_sales"], hd,
-                    ["ss_hdemo_sk"], ["hd_demo_sk"]),
-              t["time_dim"], ["ss_sold_time_sk"], ["t_time_sk"])
-    slot = lambda h: Sum(If((col("t_hour") == lit(h)), lit(1), lit(0)))
-    return CpuAggregate(
-        [], [slot(12).alias("h12"), slot(13).alias("h13"),
-             slot(14).alias("h14"), slot(15).alias("h15")], j)
-
-
-def q90_shape(t, run):
-    """Web AM/PM order ratio (reference q90)."""
-    j = _join(t["web_sales"], t["time_dim"],
-              ["ws_sold_time_sk"], ["t_time_sk"])
-    counts = CpuAggregate(
-        [], [Sum(If((col("t_hour") >= lit(8)) & (col("t_hour") < lit(12)),
-                    lit(1), lit(0))).alias("amc"),
-             Sum(If((col("t_hour") >= lit(14)) &
-                    (col("t_hour") < lit(18)),
-                    lit(1), lit(0))).alias("pmc")], j)
-    return CpuProject(
-        [col("amc"), col("pmc"),
-         (col("amc") / col("pmc")).alias("am_pm_ratio")], counts)
-
-
-def q61_shape(t, run):
-    """Promotional vs total store revenue ratio for one month
-    (reference q61's two-aggregate cross join via a key literal)."""
-    dd = CpuFilter((col("d_year") == lit(1999)) &
-                   (col("d_moy") == lit(11)), t["date_dim"])
-    base = _join(dd, t["store_sales"],
-                 ["d_date_sk"], ["ss_sold_date_sk"])
-    promo_rows = _join(base, CpuFilter(
-        (col("p_channel_email") == lit("Y")) |
-        (col("p_channel_event") == lit("Y")), t["promotion"]),
-        ["ss_promo_sk"], ["p_promo_sk"])
-    promos = CpuProject(
-        [lit(1).alias("k1"),
-         col("promotions")],
-        CpuAggregate([], [Sum(col("ss_ext_sales_price")).alias(
-            "promotions")], promo_rows))
-    total = CpuProject(
-        [lit(1).alias("k2"), col("total")],
-        CpuAggregate([], [Sum(col("ss_ext_sales_price")).alias(
-            "total")], base))
-    j = _join(promos, total, ["k1"], ["k2"])
-    return CpuProject(
-        [col("promotions"), col("total"),
-         (col("promotions") / col("total") * lit(100.0)).alias(
-             "promo_pct")], j)
-
-
-def q79_shape(t, run):
-    """Per-ticket profile for large stores and high-dependency
-    households (reference q79's q68 sibling)."""
-    dd = CpuFilter(col("d_year") == lit(1999), t["date_dim"])
-    hd = CpuFilter((col("hd_dep_count") == lit(6)) |
-                   (col("hd_vehicle_count") > lit(2)),
-                   t["household_demographics"])
-    st = CpuFilter(col("s_number_employees") >= lit(200), t["store"])
-    j = _join(_join(_join(dd, t["store_sales"],
-                          ["d_date_sk"], ["ss_sold_date_sk"]),
-                    hd, ["ss_hdemo_sk"], ["hd_demo_sk"]),
-              st, ["ss_store_sk"], ["s_store_sk"])
-    per_ticket = CpuAggregate(
-        [col("ss_ticket_number"), col("ss_customer_sk"),
-         col("s_city")],
-        [Sum(col("ss_coupon_amt")).alias("amt"),
-         Sum(col("ss_net_profit")).alias("profit")], j)
-    j2 = _join(per_ticket, t["customer"],
-               ["ss_customer_sk"], ["c_customer_sk"])
-    return CpuLimit(100, CpuSort(
-        [asc(col("c_last_name")), asc(col("c_first_name")),
-         asc(col("ss_ticket_number"))],
-        CpuProject([col("c_last_name"), col("c_first_name"),
-                    col("s_city"), col("ss_ticket_number"),
-                    col("amt"), col("profit")], j2)))
-
-
-def q46_shape(t, run):
-    """Per-ticket city/amount profile on weekend days (reference q46)."""
-    dd = CpuFilter(InSet(col("d_day_name"), ("Saturday", "Sunday")) &
-                   (col("d_year") == lit(1999)), t["date_dim"])
-    hd = CpuFilter((col("hd_dep_count") == lit(4)) |
-                   (col("hd_vehicle_count") == lit(3)),
-                   t["household_demographics"])
-    st = CpuFilter(InSet(col("s_city"), ("Midway", "Fairview")),
-                   t["store"])
-    j = _join(_join(_join(_join(dd, t["store_sales"],
-                                ["d_date_sk"], ["ss_sold_date_sk"]),
-                          hd, ["ss_hdemo_sk"], ["hd_demo_sk"]),
-                    st, ["ss_store_sk"], ["s_store_sk"]),
-              t["customer_address"], ["ss_addr_sk"], ["ca_address_sk"])
-    per_ticket = CpuAggregate(
-        [col("ss_ticket_number"), col("ss_customer_sk"),
-         col("ca_city")],
-        [Sum(col("ss_coupon_amt")).alias("amt"),
-         Sum(col("ss_net_profit")).alias("profit")], j)
-    j2 = _join(per_ticket, t["customer"],
-               ["ss_customer_sk"], ["c_customer_sk"])
-    return CpuLimit(100, CpuSort(
-        [asc(col("c_last_name")), asc(col("ss_ticket_number"))],
-        CpuProject([col("c_last_name"), col("c_first_name"),
-                    col("ca_city"), col("ss_ticket_number"),
-                    col("amt"), col("profit")], j2)))
-
-
-def q92_shape(t, run):
-    """Web sales with discount above 1.3x the item's average discount
-    (reference q92's excess-discount correlated subquery)."""
-    avg_disc = CpuAggregate(
-        [col("ws_item_sk")],
-        [Average(col("ws_ext_discount_amt")).alias("avg_disc")],
-        t["web_sales"])
-    j = _join(t["web_sales"],
-              CpuProject([col("ws_item_sk").alias("isk2"),
-                          col("avg_disc")], avg_disc),
-              ["ws_item_sk"], ["isk2"])
-    excess = CpuFilter(
-        col("ws_ext_discount_amt") > col("avg_disc") * lit(1.3), j)
-    return CpuAggregate(
-        [], [Sum(col("ws_ext_discount_amt")).alias("excess_discount")],
-        excess)
-
-
-
-
-
-def q2_shape(t, run):
-    """Week-day revenue share, store vs web channels united (reference
-    q2's cross-channel weekly comparison)."""
-    u = CpuUnion(
-        CpuProject([col("ss_sold_date_sk").alias("sold_date_sk"),
-                    col("ss_ext_sales_price").alias("price")],
-                   t["store_sales"]),
-        CpuProject([col("ws_sold_date_sk").alias("sold_date_sk"),
-                    col("ws_ext_sales_price").alias("price")],
-                   t["web_sales"]))
-    j = _join(u, t["date_dim"], ["sold_date_sk"], ["d_date_sk"])
-    day = lambda n: Sum(If(col("d_day_name") == lit(n), col("price"),
-                           lit(0.0)))
-    agg = CpuAggregate(
-        [col("d_year")],
-        [day("Sunday").alias("sun"), day("Monday").alias("mon"),
-         day("Friday").alias("fri"), day("Saturday").alias("sat")], j)
-    return CpuSort([asc(col("d_year"))], agg)
-
-
-def q13_shape(t, run):
-    """Store averages across demographic/price-band OR-slices
-    (reference q13)."""
-    cd = CpuFilter(
-        ((col("cd_marital_status") == lit("M")) &
-         (col("cd_education_status") == lit("Advanced Degree"))) |
-        ((col("cd_marital_status") == lit("S")) &
-         (col("cd_education_status") == lit("College"))),
-        t["customer_demographics"])
-    hd = CpuFilter(InSet(col("hd_dep_count"), (1, 3)),
-                   t["household_demographics"])
-    j = _join(_join(_join(
-        CpuFilter(col("d_year") == lit(2001), t["date_dim"]),
-        t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
-        cd, ["ss_cdemo_sk"], ["cd_demo_sk"]),
-        hd, ["ss_hdemo_sk"], ["hd_demo_sk"])
-    return CpuAggregate(
-        [], [Average(col("ss_quantity")).alias("avg_qty"),
-             Average(col("ss_ext_sales_price")).alias("avg_price"),
-             Average(col("ss_ext_wholesale_cost")).alias("avg_cost"),
-             Sum(col("ss_ext_wholesale_cost")).alias("sum_cost")], j)
-
-
-def q18_shape(t, run):
-    """Catalog purchase averages by customer state for one demographic
-    (reference q18 without the rollup)."""
-    cd = CpuFilter(col("cd_gender") == lit("F"),
-                   t["customer_demographics"])
-    j = _join(_join(_join(_join(
-        CpuFilter(col("d_year") == lit(2001), t["date_dim"]),
-        t["catalog_sales"], ["d_date_sk"], ["cs_sold_date_sk"]),
-        cd, ["cs_bill_cdemo_sk"], ["cd_demo_sk"]),
-        t["customer"], ["cs_bill_customer_sk"], ["c_customer_sk"]),
-        t["customer_address"], ["c_current_addr_sk"], ["ca_address_sk"])
-    agg = CpuAggregate(
-        [col("ca_state")],
-        [Average(col("cs_quantity")).alias("agg1"),
-         Average(col("cs_list_price")).alias("agg2"),
-         Average(col("cs_sales_price")).alias("agg3"),
-         Average(col("cs_net_profit")).alias("agg4")], j)
-    return CpuLimit(100, CpuSort([asc(col("ca_state"))], agg))
-
-
-def q21ds_shape(t, run):
-    """Inventory before/after a pivot date for a price band of items
-    (reference q21)."""
-    it = CpuFilter((col("i_current_price") >= lit(10.0)) &
-                   (col("i_current_price") <= lit(60.0)), t["item"])
-    j = _join(_join(_join(t["inventory"], it,
-                          ["inv_item_sk"], ["i_item_sk"]),
-                    t["warehouse"],
-                    ["inv_warehouse_sk"], ["w_warehouse_sk"]),
-              CpuFilter(col("d_year") == lit(2000), t["date_dim"]),
-              ["inv_date_sk"], ["d_date_sk"])
-    agg = CpuAggregate(
-        [col("w_warehouse_name"), col("i_item_id")],
-        [Sum(If(col("d_moy") < lit(6), col("inv_quantity_on_hand"),
-                lit(0))).alias("inv_before"),
-         Sum(If(col("d_moy") >= lit(6), col("inv_quantity_on_hand"),
-                lit(0))).alias("inv_after")], j)
-    ok = CpuFilter(
-        (col("inv_before") > lit(0)) &
-        (col("inv_after") * lit(10) >= col("inv_before") * lit(5)) &
-        (col("inv_after") * lit(2) <= col("inv_before") * lit(3)), agg)
-    return CpuLimit(100, CpuSort(
-        [asc(col("w_warehouse_name")), asc(col("i_item_id"))], ok))
-
-
-def q32_shape(t, run):
-    """Catalog sales with discount above 1.3x the item's average
-    (reference q32, q92's catalog twin)."""
-    avg_disc = CpuAggregate(
-        [col("cs_item_sk")],
-        [Average(col("cs_ext_discount_amt")).alias("avg_disc")],
-        t["catalog_sales"])
-    j = _join(t["catalog_sales"],
-              CpuProject([col("cs_item_sk").alias("isk2"),
-                          col("avg_disc")], avg_disc),
-              ["cs_item_sk"], ["isk2"])
-    excess = CpuFilter(
-        col("cs_ext_discount_amt") > col("avg_disc") * lit(1.3), j)
-    return CpuAggregate(
-        [], [Sum(col("cs_ext_discount_amt")).alias("excess_discount")],
-        excess)
-
-
-def q34_shape(t, run):
-    """Mid-size-basket customers for given buy potentials (reference
-    q34, q73's sibling; its 15-20 basket band is widened to 3-20 for
-    the small-scale synthetic data)."""
-    hd = CpuFilter(InSet(col("hd_buy_potential"),
-                         (">10000", "5001-10000")),
-                   t["household_demographics"])
-    j = _join(_join(CpuFilter(col("d_year") == lit(2000),
-                              t["date_dim"]),
-                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
-              hd, ["ss_hdemo_sk"], ["hd_demo_sk"])
-    per_ticket = CpuAggregate(
-        [col("ss_ticket_number"), col("ss_customer_sk")],
-        [Count(None).alias("cnt")], j)
-    band = CpuFilter((col("cnt") >= lit(3)) & (col("cnt") <= lit(20)),
-                     per_ticket)
-    j2 = _join(band, t["customer"],
-               ["ss_customer_sk"], ["c_customer_sk"])
-    return CpuLimit(100, CpuSort(
-        [asc(col("c_last_name")), desc(col("cnt")),
-         asc(col("ss_ticket_number"))],
-        CpuProject([col("c_last_name"), col("c_first_name"),
-                    col("ss_ticket_number"), col("cnt")], j2)))
-
-
-def q36_shape(t, run):
-    """Gross margin ratio by item category (reference q36 without the
-    rollup/window rank)."""
-    j = _join(_join(CpuFilter(col("d_year") == lit(2001),
-                              t["date_dim"]),
-                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
-              t["item"], ["ss_item_sk"], ["i_item_sk"])
-    agg = CpuAggregate(
-        [col("i_category")],
-        [Sum(col("ss_net_profit")).alias("profit"),
-         Sum(col("ss_ext_sales_price")).alias("sales")], j)
-    return CpuSort(
-        [asc(col("i_category"))],
-        CpuProject([col("i_category"),
-                    (col("profit") / col("sales")).alias(
-                        "gross_margin")], agg))
-
-
-def q38_shape(t, run):
-    """Customers active in all three channels (reference q38's
-    intersect, as chained semi joins over deduplicated customers)."""
-    ss_c = CpuAggregate([col("ss_customer_sk")],
-                        [Count(None).alias("_a")], t["store_sales"])
-    in_web = CpuHashJoin(
-        J.LEFT_SEMI, [col("ss_customer_sk")],
-        [col("ws_bill_customer_sk")], ss_c, t["web_sales"])
-    in_all = CpuHashJoin(
-        J.LEFT_SEMI, [col("ss_customer_sk")],
-        [col("cs_bill_customer_sk")], in_web, t["catalog_sales"])
-    return CpuAggregate([], [Count(None).alias("num_customers")],
-                        in_all)
-
-
-def q60_shape(t, run):
-    """Per-item revenue across the three channels for one category and
-    month (reference q60, q33's by-item sibling)."""
-    dd = CpuFilter((col("d_year") == lit(1999)) &
-                   (col("d_moy") == lit(9)), t["date_dim"])
-    it = CpuFilter(col("i_category") == lit("Music"), t["item"])
-
-    def channel(sales, date_key, item_key, price):
-        j = _join(_join(dd, t[sales], ["d_date_sk"], [date_key]),
-                  it, [item_key], ["i_item_sk"])
-        return CpuProject(
-            [col("i_item_id"), col(price).alias("total_sales")], j)
-
-    u = CpuUnion(channel("store_sales", "ss_sold_date_sk",
-                         "ss_item_sk", "ss_ext_sales_price"),
-                 channel("catalog_sales", "cs_sold_date_sk",
-                         "cs_item_sk", "cs_ext_sales_price"),
-                 channel("web_sales", "ws_sold_date_sk",
-                         "ws_item_sk", "ws_ext_sales_price"))
-    agg = CpuAggregate([col("i_item_id")],
-                       [Sum(col("total_sales")).alias("total_sales")], u)
-    return CpuLimit(100, CpuSort(
-        [asc(col("i_item_id")), desc(col("total_sales"))], agg))
-
-
-def q69_shape(t, run):
-    """Demographics of store customers with no web or catalog activity
-    in a window (reference q69's exists/not-exists combination)."""
-    dd = CpuFilter((col("d_year") == lit(2001)) &
-                   (col("d_moy") <= lit(3)), t["date_dim"])
-    store_c = CpuAggregate(
-        [col("ss_customer_sk")], [Count(None).alias("_a")],
-        _join(dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]))
-    web_c = CpuProject(
-        [col("ws_bill_customer_sk")],
-        _join(dd, t["web_sales"], ["d_date_sk"], ["ws_sold_date_sk"]))
-    cat_c = CpuProject(
-        [col("cs_bill_customer_sk")],
-        _join(dd, t["catalog_sales"],
-              ["d_date_sk"], ["cs_sold_date_sk"]))
-    only_store = CpuHashJoin(
-        J.LEFT_ANTI, [col("ss_customer_sk")],
-        [col("cs_bill_customer_sk")],
-        CpuHashJoin(J.LEFT_ANTI, [col("ss_customer_sk")],
-                    [col("ws_bill_customer_sk")], store_c, web_c),
-        cat_c)
-    j = _join(_join(only_store, t["customer"],
-                    ["ss_customer_sk"], ["c_customer_sk"]),
-              t["customer_address"],
-              ["c_current_addr_sk"], ["ca_address_sk"])
-    agg = CpuAggregate([col("ca_state")],
-                       [Count(None).alias("cnt")], j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("ca_state"))], agg))
-
-
-def q87_shape(t, run):
-    """Store customers absent from the web channel (reference q87's
-    EXCEPT, as a LEFT_ANTI join over deduplicated customers)."""
-    ss_c = CpuAggregate([col("ss_customer_sk")],
-                        [Count(None).alias("_a")], t["store_sales"])
-    not_web = CpuHashJoin(
-        J.LEFT_ANTI, [col("ss_customer_sk")],
-        [col("ws_bill_customer_sk")], ss_c, t["web_sales"])
-    return CpuAggregate([], [Count(None).alias("num_customers")],
-                        not_web)
-
-
-def q41_shape(t, run):
-    """Distinct item ids in a price/category slice (reference q41's
-    item-only filter query)."""
-    it = CpuFilter(
-        (col("i_current_price") >= lit(30.0)) &
-        (col("i_current_price") <= lit(60.0)) &
-        InSet(col("i_category"), ("Women", "Shoes", "Jewelry")),
-        t["item"])
-    dedup = CpuAggregate([col("i_item_id")],
-                         [Count(None).alias("_c")], it)
-    return CpuLimit(100, CpuSort(
-        [asc(col("i_item_id"))],
-        CpuProject([col("i_item_id")], dedup)))
-
-
-
-
-
-
-
-def q63_shape(t, run):
-    """Manager monthly sales vs their average month (reference q63/q53's
-    windowed deviation filter)."""
-    from spark_rapids_tpu.exec.sort import asc as _asc
-    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
-                                              WindowSpec, WinAvg)
-    j = _join(_join(CpuFilter(col("d_year") == lit(2001),
-                              t["date_dim"]),
-                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
-              t["item"], ["ss_item_sk"], ["i_item_sk"])
-    monthly = CpuAggregate(
-        [col("i_manager_id"), col("d_moy")],
-        [Sum(col("ss_sales_price")).alias("sum_sales")], j)
-    w = CpuWindow(
-        [WinAvg(col("sum_sales")).alias("avg_monthly_sales")],
-        WindowSpec([col("i_manager_id")], [],
-                   WindowFrame(is_rows=True, lower=None, upper=None)),
-        monthly)
-    dev = CpuFilter(
-        (col("avg_monthly_sales") > lit(0.0)) &
-        ((col("sum_sales") > col("avg_monthly_sales") * lit(1.1)) |
-         (col("sum_sales") < col("avg_monthly_sales") * lit(0.9))), w)
-    return CpuLimit(100, CpuSort(
-        [asc(col("i_manager_id")), asc(col("d_moy"))],
-        CpuProject([col("i_manager_id"), col("d_moy"),
-                    col("sum_sales"), col("avg_monthly_sales")], dev)))
-
-
-def q67_shape(t, run):
-    """Top-ranked items by revenue within each category (reference
-    q67's windowed rank over rollup, without the rollup)."""
-    from spark_rapids_tpu.exec.sort import desc as _desc
-    from spark_rapids_tpu.exec.window import (CpuWindow, Rank,
-                                              WindowSpec)
-    j = _join(_join(CpuFilter(col("d_year") == lit(2000),
-                              t["date_dim"]),
-                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
-              t["item"], ["ss_item_sk"], ["i_item_sk"])
-    by_item = CpuAggregate(
-        [col("i_category"), col("i_item_id")],
-        [Sum(col("ss_ext_sales_price")).alias("sales")], j)
-    ranked = CpuWindow(
-        [Rank().alias("rk")],
-        WindowSpec([col("i_category")], [_desc(col("sales"))]),
-        by_item)
-    top = CpuFilter(col("rk") <= lit(3), ranked)
-    return CpuSort(
-        [asc(col("i_category")), asc(col("rk")),
-         asc(col("i_item_id"))],
-        CpuProject([col("i_category"), col("i_item_id"),
-                    col("sales"), col("rk")], top))
-
-
-
-
-
-
-
-def q47_shape(t, run):
-    """Brand monthly sales vs neighbors and the brand average
-    (reference q47/q57: stacked windows — lag/lead over time plus a
-    whole-partition average)."""
-    from spark_rapids_tpu.exec.sort import asc as _asc
-    from spark_rapids_tpu.exec.window import (CpuWindow, Lag, Lead,
-                                              WindowFrame, WindowSpec,
-                                              WinAvg)
-    j = _join(_join(CpuFilter(col("d_year") == lit(2000),
-                              t["date_dim"]),
-                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
-              t["item"], ["ss_item_sk"], ["i_item_sk"])
-    monthly = CpuAggregate(
-        [col("i_brand"), col("d_moy")],
-        [Sum(col("ss_sales_price")).alias("sum_sales")], j)
-    with_neighbors = CpuWindow(
-        [Lag(col("sum_sales")).alias("psum"),
-         Lead(col("sum_sales")).alias("nsum")],
-        WindowSpec([col("i_brand")], [_asc(col("d_moy"))]),
-        monthly)
-    with_avg = CpuWindow(
-        [WinAvg(col("sum_sales")).alias("avg_monthly")],
-        WindowSpec([col("i_brand")], [],
-                   WindowFrame(is_rows=True, lower=None, upper=None)),
-        with_neighbors)
-    dev = CpuFilter(
-        (col("avg_monthly") > lit(0.0)) &
-        (col("sum_sales") > col("avg_monthly") * lit(1.5)), with_avg)
-    return CpuLimit(100, CpuSort(
-        [asc(col("i_brand")), asc(col("d_moy"))],
-        CpuProject([col("i_brand"), col("d_moy"), col("sum_sales"),
-                    col("psum"), col("nsum"), col("avg_monthly")], dev)))
-
-
-def q51_shape(t, run):
-    """Running cumulative revenue per item over months, web vs store,
-    reporting months where the web cumulative overtakes the store one
-    (reference q51's full-outer join of windowed cumulatives)."""
-    from spark_rapids_tpu.exec.sort import asc as _asc
-    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
-                                              WindowSpec, WinSum)
-
-    def cum(sales, date_key, item_key, price, prefix):
-        monthly = CpuAggregate(
-            [col(item_key), col("d_moy")],
-            [Sum(col(price)).alias(f"{prefix}_sales")],
-            _join(CpuFilter(col("d_year") == lit(2000), t["date_dim"]),
-                  t[sales], ["d_date_sk"], [date_key]))
-        w = CpuWindow(
-            [WinSum(col(f"{prefix}_sales")).alias(f"{prefix}_cum")],
-            WindowSpec([col(item_key)], [_asc(col("d_moy"))],
-                       WindowFrame(is_rows=True, lower=None, upper=0)),
-            monthly)
-        return CpuProject(
-            [col(item_key).alias(f"{prefix}_item"),
-             col("d_moy").alias(f"{prefix}_moy"),
-             col(f"{prefix}_cum")], w)
-
-    web = cum("web_sales", "ws_sold_date_sk", "ws_item_sk",
-              "ws_ext_sales_price", "web")
-    store = cum("store_sales", "ss_sold_date_sk", "ss_item_sk",
-                "ss_ext_sales_price", "store")
-    j = CpuHashJoin(
-        J.FULL_OUTER, [col("web_item"), col("web_moy")],
-        [col("store_item"), col("store_moy")], web, store)
-    ahead = CpuFilter(
-        IsNotNull(col("web_cum")) & IsNotNull(col("store_cum")) &
-        (col("web_cum") > col("store_cum")), j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("web_item")), asc(col("web_moy"))],
-        CpuProject([col("web_item"), col("web_moy"), col("web_cum"),
-                    col("store_cum")], ahead)))
-
-
-
-
-
-
-
-def q44_shape(t, run):
-    """Best and worst items by average profit via two window ranks
-    (reference q44's asc/desc rank pair)."""
-    from spark_rapids_tpu.exec.sort import asc as _asc, desc as _desc
-    from spark_rapids_tpu.exec.window import CpuWindow, Rank, WindowSpec
-    by_item = CpuAggregate(
-        [col("ss_item_sk")],
-        [Average(col("ss_net_profit")).alias("avg_profit")],
-        t["store_sales"])
-    ranked = CpuWindow(
-        [Rank().alias("best_rk")],
-        WindowSpec([], [_desc(col("avg_profit"))]), by_item)
-    ranked = CpuWindow(
-        [Rank().alias("worst_rk")],
-        WindowSpec([], [_asc(col("avg_profit"))]), ranked)
-    top = CpuFilter((col("best_rk") <= lit(10)) |
-                    (col("worst_rk") <= lit(10)), ranked)
-    j = _join(top, t["item"], ["ss_item_sk"], ["i_item_sk"])
-    return CpuSort(
-        [asc(col("best_rk")), asc(col("worst_rk")),
-         asc(col("i_item_id"))],
-        CpuProject([col("i_item_id"), col("avg_profit"),
-                    col("best_rk"), col("worst_rk")], j))
-
-
-def q58_shape(t, run):
-    """Items whose revenue is roughly equal across all three channels
-    (reference q58's three-way join with ratio bands)."""
-    def chan(sales, item_key, price, name):
-        agg = CpuAggregate(
-            [col(item_key)], [Sum(col(price)).alias(name)], t[sales])
-        return CpuProject(
-            [col(item_key).alias(f"{name}_item"), col(name)], agg)
-
-    ss = chan("store_sales", "ss_item_sk", "ss_ext_sales_price",
-              "ss_rev")
-    cs = chan("catalog_sales", "cs_item_sk", "cs_ext_sales_price",
-              "cs_rev")
-    ws = chan("web_sales", "ws_item_sk", "ws_ext_sales_price", "ws_rev")
-    j = _join(_join(ss, cs, ["ss_rev_item"], ["cs_rev_item"]),
-              ws, ["ss_rev_item"], ["ws_rev_item"])
-    avg3 = (col("ss_rev") + col("cs_rev") + col("ws_rev")) / lit(3.0)
-    close = CpuFilter(
-        (col("ss_rev") >= avg3 * lit(0.6)) &
-        (col("ss_rev") <= avg3 * lit(1.4)) &
-        (col("cs_rev") >= avg3 * lit(0.6)) &
-        (col("cs_rev") <= avg3 * lit(1.4)) &
-        (col("ws_rev") >= avg3 * lit(0.6)) &
-        (col("ws_rev") <= avg3 * lit(1.4)), j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("ss_rev_item"))],
-        CpuProject([col("ss_rev_item"), col("ss_rev"), col("cs_rev"),
-                    col("ws_rev")], close)))
-
-
-def q59_shape(t, run):
-    """Week-day store revenue pivot compared year over year (reference
-    q59's self-join of weekly pivots)."""
-    def pivot(year, suffix):
-        j = _join(CpuFilter(col("d_year") == lit(year), t["date_dim"]),
-                  t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"])
-        day = lambda n: Sum(If(col("d_day_name") == lit(n),
-                               col("ss_sales_price"), lit(0.0)))
-        agg = CpuAggregate(
-            [col("ss_store_sk")],
-            [day("Sunday").alias(f"sun{suffix}"),
-             day("Wednesday").alias(f"wed{suffix}"),
-             day("Saturday").alias(f"sat{suffix}")], j)
-        return CpuProject(
-            [col("ss_store_sk").alias(f"store{suffix}"),
-             col(f"sun{suffix}"), col(f"wed{suffix}"),
-             col(f"sat{suffix}")], agg)
-
-    y1 = pivot(2000, "1")
-    y2 = pivot(2001, "2")
-    j = _join(y1, y2, ["store1"], ["store2"])
-    safe = CpuFilter((col("sun2") > lit(0.0)) &
-                     (col("wed2") > lit(0.0)) &
-                     (col("sat2") > lit(0.0)), j)
-    return CpuSort(
-        [asc(col("store1"))],
-        CpuProject([col("store1"),
-                    (col("sun1") / col("sun2")).alias("sun_ratio"),
-                    (col("wed1") / col("wed2")).alias("wed_ratio"),
-                    (col("sat1") / col("sat2")).alias("sat_ratio")],
-                   safe))
-
-
-def q66_shape(t, run):
-    """Warehouse monthly revenue pivot, web + catalog united
-    (reference q66's 12-month If-sum pivot)."""
-    u = CpuUnion(
-        CpuProject([col("ws_warehouse_sk").alias("wh"),
-                    col("ws_sold_date_sk").alias("sold"),
-                    col("ws_ext_sales_price").alias("price")],
-                   t["web_sales"]),
-        CpuProject([col("cs_warehouse_sk").alias("wh"),
-                    col("cs_sold_date_sk").alias("sold"),
-                    col("cs_ext_sales_price").alias("price")],
-                   t["catalog_sales"]))
-    j = _join(_join(u, CpuFilter(col("d_year") == lit(2001),
-                                 t["date_dim"]),
-                    ["sold"], ["d_date_sk"]),
-              t["warehouse"], ["wh"], ["w_warehouse_sk"])
-    mo = lambda m: Sum(If(col("d_moy") == lit(m), col("price"),
-                          lit(0.0)))
-    agg = CpuAggregate(
-        [col("w_warehouse_name"), col("w_warehouse_sq_ft")],
-        [mo(m).alias(f"m{m:02d}_sales") for m in range(1, 13)], j)
-    return CpuSort([asc(col("w_warehouse_name"))], agg)
-
-
-def q70_shape(t, run):
-    """States ranked by store profit, top 5 (reference q70's windowed
-    state rank without the rollup)."""
-    from spark_rapids_tpu.exec.sort import desc as _desc
-    from spark_rapids_tpu.exec.window import CpuWindow, Rank, WindowSpec
-    j = _join(_join(CpuFilter(col("d_year") == lit(2000),
-                              t["date_dim"]),
-                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
-              t["store"], ["ss_store_sk"], ["s_store_sk"])
-    by_state = CpuAggregate(
-        [col("s_state")],
-        [Sum(col("ss_net_profit")).alias("total_profit")], j)
-    ranked = CpuWindow([Rank().alias("rk")],
-                       WindowSpec([], [_desc(col("total_profit"))]),
-                       by_state)
-    return CpuSort(
-        [asc(col("rk")), asc(col("s_state"))],
-        CpuFilter(col("rk") <= lit(5), ranked))
-
-
-def q75_shape(t, run):
-    """Year-over-year quantity change per category across all channels
-    (reference q75's union + prior-year self-join)."""
-    def year_qty(year):
-        u = CpuUnion(
-            CpuProject([col("ss_sold_date_sk").alias("sold"),
-                        col("ss_item_sk").alias("it"),
-                        col("ss_quantity").alias("qty")],
-                       t["store_sales"]),
-            CpuProject([col("cs_sold_date_sk").alias("sold"),
-                        col("cs_item_sk").alias("it"),
-                        col("cs_quantity").alias("qty")],
-                       t["catalog_sales"]),
-            CpuProject([col("ws_sold_date_sk").alias("sold"),
-                        col("ws_item_sk").alias("it"),
-                        col("ws_quantity").alias("qty")],
-                       t["web_sales"]))
-        j = _join(_join(u, CpuFilter(col("d_year") == lit(year),
-                                     t["date_dim"]),
-                        ["sold"], ["d_date_sk"]),
-                  t["item"], ["it"], ["i_item_sk"])
-        return CpuAggregate([col("i_category_id")],
-                            [Sum(col("qty")).alias(f"qty_{year}")], j)
-
-    cur = year_qty(2001)
-    prev = CpuProject([col("i_category_id").alias("cat_prev"),
-                       col("qty_2000")], year_qty(2000))
-    j = _join(cur, prev, ["i_category_id"], ["cat_prev"])
-    decline = CpuFilter(
-        (col("qty_2000") > lit(0)) &
-        (col("qty_2001") < col("qty_2000")), j)
-    return CpuSort(
-        [asc(col("i_category_id"))],
-        CpuProject([col("i_category_id"), col("qty_2000"),
-                    col("qty_2001")], decline))
-
-
-def q77_shape(t, run):
-    """Profit and returns per channel, united into one report
-    (reference q77's channel union with loss netting)."""
-    def channel(name, sales_profit, returns_amt):
-        return CpuProject(
-            [lit(name).alias("channel"), col("profit"),
-             col("returns_amt")],
-            _join(sales_profit, returns_amt, ["k1"], ["k2"]))
-
-    def one_row(node, alias_, key):
-        return CpuProject(
-            [lit(1).alias(key), col(alias_)],
-            node)
-
-    ss = one_row(CpuAggregate(
-        [], [Sum(col("ss_net_profit")).alias("profit")],
-        t["store_sales"]), "profit", "k1")
-    sr = one_row(CpuAggregate(
-        [], [Sum(col("sr_return_amt")).alias("returns_amt")],
-        t["store_returns"]), "returns_amt", "k2")
-    cs = one_row(CpuAggregate(
-        [], [Sum(col("cs_net_profit")).alias("profit")],
-        t["catalog_sales"]), "profit", "k1")
-    cr = one_row(CpuAggregate(
-        [], [Sum(col("cr_return_amount")).alias("returns_amt")],
-        t["catalog_returns"]), "returns_amt", "k2")
-    ws = one_row(CpuAggregate(
-        [], [Sum(col("ws_net_profit")).alias("profit")],
-        t["web_sales"]), "profit", "k1")
-    wr = one_row(CpuAggregate(
-        [], [Sum(col("wr_return_amt")).alias("returns_amt")],
-        t["web_returns"]), "returns_amt", "k2")
-    u = CpuUnion(channel("store", ss, sr),
-                 channel("catalog", cs, cr),
-                 channel("web", ws, wr))
-    return CpuSort([asc(col("channel"))], u)
-
-
-def q80_shape(t, run):
-    """Per-store revenue net of returns with promo split (reference
-    q80's store-channel report)."""
-    j = CpuHashJoin(
-        J.LEFT_OUTER,
-        [col("ss_item_sk"), col("ss_ticket_number")],
-        [col("sr_item_sk"), col("sr_ticket_number")],
-        t["store_sales"], t["store_returns"])
-    j = _join(j, t["store"], ["ss_store_sk"], ["s_store_sk"])
-    net = col("ss_ext_sales_price") - Coalesce(
-        (col("sr_return_amt"), lit(0.0)))
-    agg = CpuAggregate(
-        [col("s_store_id")],
-        [Sum(net).alias("sales_net"),
-         Sum(Coalesce((col("sr_return_amt"), lit(0.0)))).alias(
-             "returns_amt"),
-         Sum(col("ss_net_profit")).alias("profit")], j)
-    return CpuSort([asc(col("s_store_id"))], agg)
-
-
-
-
-
-
-
-def q8_shape(t, run):
-    """Store revenue limited to customer states with enough customers
-    (reference q8's zip-list filter, by state)."""
-    by_state = CpuAggregate(
-        [col("ca_state")], [Count(None).alias("n_cust")],
-        t["customer_address"])
-    big = CpuFilter(col("n_cust") >= lit(10), by_state)
-    j = _join(_join(_join(
-        CpuFilter(col("d_year") == lit(2000), t["date_dim"]),
-        t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
-        t["customer"], ["ss_customer_sk"], ["c_customer_sk"]),
-        t["customer_address"], ["c_current_addr_sk"], ["ca_address_sk"])
-    j = CpuHashJoin(J.LEFT_SEMI, [col("ca_state")], [col("ca_state")],
-                    j, CpuProject([col("ca_state")], big))
-    agg = CpuAggregate(
-        [col("ca_state")],
-        [Sum(col("ss_net_profit")).alias("net_profit")], j)
-    return CpuSort([asc(col("ca_state"))], agg)
-
-
-def q10_shape(t, run):
-    """Demographics of customers active in web or catalog (reference
-    q10's exists-any-channel, as a semi join over a union)."""
-    active = CpuUnion(
-        CpuProject([col("ws_bill_customer_sk").alias("cust")],
-                   t["web_sales"]),
-        CpuProject([col("cs_bill_customer_sk").alias("cust")],
-                   t["catalog_sales"]))
-    store = _join(t["store_sales"], t["customer_demographics"],
-                  ["ss_cdemo_sk"], ["cd_demo_sk"])
-    j = CpuHashJoin(J.LEFT_SEMI, [col("ss_customer_sk")], [col("cust")],
-                    store, active)
-    agg = CpuAggregate(
-        [col("cd_gender"), col("cd_marital_status"),
-         col("cd_education_status")],
-        [Count(None).alias("cnt")], j)
-    return CpuSort(
-        [asc(col("cd_gender")), asc(col("cd_marital_status")),
-         asc(col("cd_education_status"))], agg)
-
-
-def q23_shape(t, run):
-    """Catalog revenue from frequent store items bought by the best
-    store customers (reference q23's two semi-join subqueries)."""
-    freq_items = CpuFilter(
-        col("n_sold") >= lit(8),
-        CpuAggregate([col("ss_item_sk")],
-                     [Count(None).alias("n_sold")], t["store_sales"]))
-    spend = CpuAggregate(
-        [col("ss_customer_sk")],
-        [Sum(col("ss_net_paid")).alias("spend")], t["store_sales"])
-    avg_spend = CpuProject(
-        [lit(1).alias("k"), col("avg_spend")],
-        CpuAggregate([], [Average(col("spend")).alias("avg_spend")],
-                     CpuProject([col("spend")], spend)))
-    best = CpuFilter(
-        col("spend") > col("avg_spend") * lit(1.2),
-        _join(CpuProject([col("ss_customer_sk"), col("spend"),
-                          lit(1).alias("k2")], spend),
-              avg_spend, ["k2"], ["k"]))
-    cs = CpuHashJoin(
-        J.LEFT_SEMI, [col("cs_item_sk")], [col("ss_item_sk")],
-        t["catalog_sales"],
-        CpuProject([col("ss_item_sk")], freq_items))
-    cs = CpuHashJoin(
-        J.LEFT_SEMI, [col("cs_bill_customer_sk")],
-        [col("ss_customer_sk")], cs,
-        CpuProject([col("ss_customer_sk")], best))
-    return CpuAggregate(
-        [], [Sum(col("cs_ext_sales_price")).alias("sales")], cs)
-
-
-def q30_shape(t, run):
-    """Customers whose web-return total exceeds 1.2x their state's
-    average (reference q30, q1's web twin)."""
-    ctr = CpuAggregate(
-        [col("wr_returning_customer_sk")],
-        [Sum(col("wr_return_amt")).alias("ctr_total")],
-        t["web_returns"])
-    j = _join(_join(ctr, t["customer"],
-                    ["wr_returning_customer_sk"], ["c_customer_sk"]),
-              t["customer_address"],
-              ["c_current_addr_sk"], ["ca_address_sk"])
-    avg_state = CpuAggregate(
-        [col("ca_state")],
-        [Average(col("ctr_total")).alias("avg_ret")],
-        CpuProject([col("ca_state"), col("ctr_total")], j))
-    big = CpuFilter(
-        col("ctr_total") > col("avg_ret") * lit(1.2),
-        _join(j, CpuProject([col("ca_state").alias("st2"),
-                             col("avg_ret")], avg_state),
-              ["ca_state"], ["st2"]))
-    return CpuLimit(100, CpuSort(
-        [asc(col("c_customer_id"))],
-        CpuProject([col("c_customer_id"), col("ca_state"),
-                    col("ctr_total")], big)))
-
-
-def q31_shape(t, run):
-    """States where web revenue grew faster than store revenue between
-    quarters (reference q31's growth-ratio comparison)."""
-    def qrev(sales, date_key, cust_key, price, qoy, name):
-        j = _join(_join(_join(
-            CpuFilter((col("d_year") == lit(2000)) &
-                      (col("d_qoy") == lit(qoy)), t["date_dim"]),
-            t[sales], ["d_date_sk"], [date_key]),
-            t["customer"], [cust_key], ["c_customer_sk"]),
-            t["customer_address"],
-            ["c_current_addr_sk"], ["ca_address_sk"])
-        agg = CpuAggregate([col("ca_state")],
-                           [Sum(col(price)).alias(name)], j)
-        return CpuProject(
-            [col("ca_state").alias(f"{name}_state"), col(name)], agg)
-
-    ss1 = qrev("store_sales", "ss_sold_date_sk", "ss_customer_sk",
-               "ss_ext_sales_price", 1, "ss1")
-    ss2 = qrev("store_sales", "ss_sold_date_sk", "ss_customer_sk",
-               "ss_ext_sales_price", 2, "ss2")
-    ws1 = qrev("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
-               "ws_ext_sales_price", 1, "ws1")
-    ws2 = qrev("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
-               "ws_ext_sales_price", 2, "ws2")
-    j = _join(_join(_join(ss1, ss2, ["ss1_state"], ["ss2_state"]),
-                    ws1, ["ss1_state"], ["ws1_state"]),
-              ws2, ["ss1_state"], ["ws2_state"])
-    grew = CpuFilter(
-        (col("ss1") > lit(0.0)) & (col("ws1") > lit(0.0)) &
-        (col("ws2") * col("ss1") > col("ss2") * col("ws1")), j)
-    return CpuSort(
-        [asc(col("ss1_state"))],
-        CpuProject([col("ss1_state"), col("ss1"), col("ss2"),
-                    col("ws1"), col("ws2")], grew))
-
-
-def q71_shape(t, run):
-    """Brand revenue by hour band across all channels for one month
-    (reference q71's time-of-day breakdown)."""
-    dd = CpuFilter((col("d_year") == lit(2000)) &
-                   (col("d_moy") == lit(12)), t["date_dim"])
-    u = CpuUnion(
-        CpuProject([col("ss_sold_date_sk").alias("sold"),
-                    col("ss_sold_time_sk").alias("tsk"),
-                    col("ss_item_sk").alias("it"),
-                    col("ss_ext_sales_price").alias("price")],
-                   t["store_sales"]),
-        CpuProject([col("cs_sold_date_sk").alias("sold"),
-                    col("cs_sold_time_sk").alias("tsk"),
-                    col("cs_item_sk").alias("it"),
-                    col("cs_ext_sales_price").alias("price")],
-                   t["catalog_sales"]),
-        CpuProject([col("ws_sold_date_sk").alias("sold"),
-                    col("ws_sold_time_sk").alias("tsk"),
-                    col("ws_item_sk").alias("it"),
-                    col("ws_ext_sales_price").alias("price")],
-                   t["web_sales"]))
-    j = _join(_join(_join(u, dd, ["sold"], ["d_date_sk"]),
-                    t["item"], ["it"], ["i_item_sk"]),
-              t["time_dim"], ["tsk"], ["t_time_sk"])
-    agg = CpuAggregate(
-        [col("i_brand_id")],
-        [Sum(If((col("t_hour") >= lit(8)) & (col("t_hour") < lit(12)),
-                col("price"), lit(0.0))).alias("morning"),
-         Sum(If((col("t_hour") >= lit(12)) & (col("t_hour") < lit(18)),
-                col("price"), lit(0.0))).alias("afternoon"),
-         Sum(If((col("t_hour") >= lit(18)),
-                col("price"), lit(0.0))).alias("evening")], j)
-    return CpuSort([asc(col("i_brand_id"))], agg)
-
-
-def q82_shape(t, run):
-    """Items in a price band with healthy inventory sold in stores
-    (reference q82, q37's store twin)."""
-    it = CpuFilter(
-        (col("i_current_price") >= lit(30.0)) &
-        (col("i_current_price") <= lit(70.0)), t["item"])
-    inv = CpuFilter(
-        (col("inv_quantity_on_hand") >= lit(100)) &
-        (col("inv_quantity_on_hand") <= lit(500)), t["inventory"])
-    stocked = _join(it, inv, ["i_item_sk"], ["inv_item_sk"])
-    sold = CpuHashJoin(
-        J.LEFT_SEMI, [col("i_item_sk")], [col("ss_item_sk")],
-        stocked, t["store_sales"])
-    agg = CpuAggregate(
-        [col("i_item_id"), col("i_current_price")],
-        [Count(None).alias("stock_rows")], sold)
-    return CpuLimit(100, CpuSort([asc(col("i_item_id"))], agg))
-
-
-def q94_shape(t, run):
-    """Web orders in a window with no returns: order count + cost sums
-    (reference q94, q16's web twin)."""
-    dd = CpuFilter((col("d_year") == lit(2001)) &
-                   (col("d_moy") <= lit(4)), t["date_dim"])
-    sales = _join(dd, t["web_sales"], ["d_date_sk"], ["ws_sold_date_sk"])
-    no_ret = CpuHashJoin(
-        J.LEFT_ANTI, [col("ws_order_number")], [col("wr_order_number")],
-        sales, t["web_returns"])
-    per_order = CpuAggregate(
-        [col("ws_order_number")],
-        [Sum(col("ws_ext_ship_cost")).alias("ship_cost"),
-         Sum(col("ws_net_profit")).alias("net_profit")], no_ret)
-    return CpuAggregate(
-        [], [Count(None).alias("order_count"),
-             Sum(col("ship_cost")).alias("total_shipping_cost"),
-             Sum(col("net_profit")).alias("total_net_profit")],
-        per_order)
-
-
-
-
-
 QUERIES = {
-    "q1": q1, "q2": q2_shape, "q3": q3, "q6": q6_shape, "q7": q7_shape,
-    "q8": q8_shape, "q10": q10_shape, "q23": q23_shape,
-    "q30": q30_shape, "q31": q31_shape, "q71": q71_shape,
-    "q82": q82_shape, "q94": q94_shape,
-    "q13": q13_shape, "q18": q18_shape, "q21": q21ds_shape,
-    "q32": q32_shape, "q34": q34_shape, "q36": q36_shape,
-    "q38": q38_shape, "q41": q41_shape, "q60": q60_shape,
-    "q44": q44_shape, "q47": q47_shape, "q51": q51_shape,
-    "q58": q58_shape, "q59": q59_shape, "q66": q66_shape,
-    "q70": q70_shape, "q75": q75_shape, "q77": q77_shape,
-    "q80": q80_shape,
-    "q63": q63_shape, "q67": q67_shape,
-    "q69": q69_shape, "q87": q87_shape,
-    "q15": q15_shape, "q16": q16_shape, "q19": q19, "q25": q25_shape,
-    "q26": q26, "q27": q27_shape, "q28": q28_shape, "q33": q33_shape,
-    "q37": q37_shape, "q40": q40_shape, "q42": q42, "q43": q43_shape,
-    "q45": q45_shape, "q46": q46_shape, "q48": q48_shape,
-    "q50": q50_shape, "q52": q52, "q55": q55, "q61": q61_shape,
-    "q62": q62_shape, "q65": q65, "q68": q68, "q73": q73,
-    "q79": q79_shape, "q88": q88_shape, "q90": q90_shape,
-    "q92": q92_shape, "q93": q93_shape, "q96": q96, "q97": q97,
-    "q98": q98_shape, "q99": q99_shape,
+    "q1": q1, "q3": q3, "q19": q19,
+    "q26": q26, "q42": q42, "q52": q52, "q55": q55, "q65": q65, "q68": q68, "q73": q73, "q96": q96, "q97": q97,
 }
 
 
@@ -1667,92 +335,6 @@ def _yoy_growth(t, sales, date_key, cust_key, val, year1=1999):
                        (col("total2") / col("total1")).alias("growth")], j)
 
 
-def q4_shape(t, run):
-    """Customers whose catalog growth beats their store growth
-    (reference q4's 3-channel year-over-year self-joins, 2 channels in
-    the v0 shape)."""
-    ss = _yoy_growth(t, "store_sales", "ss_sold_date_sk",
-                     "ss_customer_sk", "ss_net_paid")
-    cs = CpuProject([col("c_customer_id").alias("ccid"),
-                     col("growth").alias("c_growth")],
-                    _yoy_growth(t, "catalog_sales", "cs_sold_date_sk",
-                                "cs_bill_customer_sk", "cs_net_paid"))
-    j = _join(ss, cs, ["c_customer_id"], ["ccid"])
-    keep = CpuFilter(col("c_growth") > col("growth"), j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("c_customer_id"))],
-        CpuProject([col("c_customer_id")], keep)))
-
-
-def q11_shape(t, run):
-    """Web growth beats store growth (reference q11)."""
-    ss = _yoy_growth(t, "store_sales", "ss_sold_date_sk",
-                     "ss_customer_sk", "ss_ext_list_price")
-    ws = CpuProject([col("c_customer_id").alias("wcid"),
-                     col("growth").alias("w_growth")],
-                    _yoy_growth(t, "web_sales", "ws_sold_date_sk",
-                                "ws_bill_customer_sk",
-                                "ws_ext_list_price"))
-    j = _join(ss, ws, ["c_customer_id"], ["wcid"])
-    keep = CpuFilter(col("w_growth") > col("growth"), j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("c_customer_id"))],
-        CpuProject([col("c_customer_id")], keep)))
-
-
-def q74_shape(t, run):
-    """q11's sibling over net_paid sums (reference q74)."""
-    ss = _yoy_growth(t, "store_sales", "ss_sold_date_sk",
-                     "ss_customer_sk", "ss_net_paid", year1=2000)
-    ws = CpuProject([col("c_customer_id").alias("wcid"),
-                     col("growth").alias("w_growth")],
-                    _yoy_growth(t, "web_sales", "ws_sold_date_sk",
-                                "ws_bill_customer_sk", "ws_net_paid",
-                                year1=2000))
-    j = _join(ss, ws, ["c_customer_id"], ["wcid"])
-    keep = CpuFilter(col("w_growth") > col("growth"), j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("c_customer_id"))],
-        CpuProject([col("c_customer_id")], keep)))
-
-
-def q5_shape(t, run):
-    """Per-channel sales/returns/profit report with ROLLUP(channel, id)
-    through CpuExpand (reference q5)."""
-    def channel(label, sales, skey, sval, sprofit, rets, rkey, rval):
-        s = CpuProject([lit(label).alias("channel"),
-                        col(skey).alias("id"),
-                        col(sval).alias("sales"),
-                        lit(0.0).alias("returns_amt"),
-                        col(sprofit).alias("profit")], t[sales])
-        r = CpuProject([lit(label).alias("channel"),
-                        col(rkey).alias("id"),
-                        lit(0.0).alias("sales"),
-                        col(rval).alias("returns_amt"),
-                        lit(0.0).alias("profit")], t[rets])
-        return CpuUnion(s, r)
-
-    u = CpuUnion(
-        channel("store channel", "store_sales", "ss_store_sk",
-                "ss_ext_sales_price", "ss_net_profit",
-                "store_returns", "sr_store_sk", "sr_return_amt"),
-        channel("catalog channel", "catalog_sales", "cs_item_sk",
-                "cs_ext_sales_price", "cs_net_profit",
-                "catalog_returns", "cr_item_sk", "cr_return_amount"),
-        channel("web channel", "web_sales", "ws_web_site_sk",
-                "ws_ext_sales_price", "ws_net_profit",
-                "web_returns", "wr_item_sk", "wr_return_amt"))
-    ex = _rollup_expand(u, ["channel", "id"],
-                        ["sales", "returns_amt", "profit"])
-    agg = CpuAggregate(
-        [col("channel"), col("id"), col("gid")],
-        [Sum(col("sales")).alias("sales"),
-         Sum(col("returns_amt")).alias("returns_amt"),
-         Sum(col("profit")).alias("profit")], ex)
-    return CpuLimit(100, CpuSort(
-        [asc(col("channel")), asc(col("id")), asc(col("gid"))], agg))
-
-
 def q22_rollup(t, run):
     """Inventory average quantity on hand, ROLLUP(category, brand) — a
     true grouping-sets plan through CpuExpand (reference q22)."""
@@ -1785,21 +367,6 @@ def q86_rollup(t, run):
          asc(col("i_brand")), asc(col("gid"))], agg))
 
 
-def q9_shape(t, run):
-    """Quantity-range bucket statistics as one reduction over
-    store_sales (reference q9's CASE WHEN scalar subqueries)."""
-    ss = t["store_sales"]
-    aggs = []
-    for i, (lo, hi) in enumerate(((1, 10), (11, 20), (21, 30),
-                                  (31, 40), (41, 50))):
-        inb = (col("ss_quantity") >= lit(lo)) & \
-            (col("ss_quantity") <= lit(hi))
-        aggs.append(Sum(If(inb, lit(1), lit(0))).alias(f"cnt_{i}"))
-        aggs.append(Sum(If(inb, col("ss_ext_discount_amt"),
-                           lit(0.0))).alias(f"disc_{i}"))
-    return CpuAggregate([], aggs, ss)
-
-
 def _cat_ratio(t, sales, date_key, item_key, price, year, moy):
     """q12/q20/q98 scaffold: item revenue + windowed share of its
     category's revenue."""
@@ -1829,713 +396,23 @@ def _cat_ratio(t, sales, date_key, item_key, price, year, moy):
          asc(col("revenueratio"))], share))
 
 
-def q12_shape(t, run):
-    return _cat_ratio(t, "web_sales", "ws_sold_date_sk", "ws_item_sk",
-                      "ws_ext_sales_price", 1999, 2)
-
-
-def q20_shape(t, run):
-    return _cat_ratio(t, "catalog_sales", "cs_sold_date_sk",
-                      "cs_item_sk", "cs_ext_sales_price", 2000, 3)
-
-
-def q14_shape(t, run):
-    """Items selling in ALL three channels: chained semi joins, then a
-    brand revenue report (reference q14's cross-channel intersection)."""
-    it = t["item"]
-    in_ss = CpuHashJoin(J.LEFT_SEMI, [col("i_item_sk")],
-                        [col("ss_item_sk")], it, t["store_sales"])
-    in_cs = CpuHashJoin(J.LEFT_SEMI, [col("i_item_sk")],
-                        [col("cs_item_sk")], in_ss, t["catalog_sales"])
-    in_all = CpuHashJoin(J.LEFT_SEMI, [col("i_item_sk")],
-                         [col("ws_item_sk")], in_cs, t["web_sales"])
-    j = _join(in_all, t["store_sales"], ["i_item_sk"], ["ss_item_sk"])
-    agg = CpuAggregate(
-        [col("i_brand_id"), col("i_category_id")],
-        [Sum(col("ss_ext_sales_price")).alias("sales"),
-         Count(col("ss_ext_sales_price")).alias("number_sales")], j)
-    return CpuLimit(100, CpuSort(
-        [desc(col("sales")), asc(col("i_brand_id")),
-         asc(col("i_category_id"))], agg))
-
-
-def q17_shape(t, run):
-    """Store sale -> return -> catalog repurchase chain: per-item
-    quantity statistics (reference q17; stddev reduced to avg/min/max,
-    outside the v0 aggregate set like the reference's own gates)."""
-    from spark_rapids_tpu.exprs.aggregates import Max, Min
-    ssr = CpuHashJoin(
-        J.INNER, [col("ss_ticket_number"), col("ss_item_sk")],
-        [col("sr_ticket_number"), col("sr_item_sk")],
-        t["store_sales"], t["store_returns"])
-    chain = CpuHashJoin(
-        J.INNER, [col("sr_customer_sk"), col("sr_item_sk")],
-        [col("cs_bill_customer_sk"), col("cs_item_sk")],
-        ssr, t["catalog_sales"])
-    j = _join(chain, t["item"], ["ss_item_sk"], ["i_item_sk"])
-    agg = CpuAggregate(
-        [col("i_item_id")],
-        [Count(col("ss_quantity")).alias("store_sales_cnt"),
-         Average(col("ss_quantity")).alias("store_sales_avg"),
-         Min(col("sr_return_quantity")).alias("ret_min"),
-         Max(col("cs_quantity")).alias("cat_max")], j)
-    return CpuLimit(100, CpuSort([asc(col("i_item_id"))], agg))
-
-
-def q29_shape(t, run):
-    """q17's quantity-sum sibling (reference q29)."""
-    ssr = CpuHashJoin(
-        J.INNER, [col("ss_ticket_number"), col("ss_item_sk")],
-        [col("sr_ticket_number"), col("sr_item_sk")],
-        t["store_sales"], t["store_returns"])
-    chain = CpuHashJoin(
-        J.INNER, [col("sr_customer_sk"), col("sr_item_sk")],
-        [col("cs_bill_customer_sk"), col("cs_item_sk")],
-        ssr, t["catalog_sales"])
-    j = _join(chain, t["item"], ["ss_item_sk"], ["i_item_sk"])
-    agg = CpuAggregate(
-        [col("i_item_id"), col("i_brand")],
-        [Sum(col("ss_quantity")).alias("store_qty"),
-         Sum(col("sr_return_quantity")).alias("return_qty"),
-         Sum(col("cs_quantity")).alias("catalog_qty")], j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("i_item_id")), asc(col("i_brand"))], agg))
-
-
-def q24_shape(t, run):
-    """Returned-ticket net paid by customer/store/brand, kept when above
-    5% of the overall average (reference q24's HAVING-over-subquery via
-    an unpartitioned window average)."""
-    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
-                                              WindowSpec, WinAvg)
-    ssr = CpuHashJoin(
-        J.INNER, [col("ss_ticket_number"), col("ss_item_sk")],
-        [col("sr_ticket_number"), col("sr_item_sk")],
-        t["store_sales"], t["store_returns"])
-    j = _join(_join(_join(ssr, t["store"], ["ss_store_sk"],
-                          ["s_store_sk"]),
-                    t["item"], ["ss_item_sk"], ["i_item_sk"]),
-              t["customer"], ["ss_customer_sk"], ["c_customer_sk"])
-    agg = CpuAggregate(
-        [col("c_last_name"), col("s_store_name"), col("i_brand")],
-        [Sum(col("ss_net_paid")).alias("netpaid")], j)
-    w = CpuWindow(
-        [WinAvg(col("netpaid")).alias("avg_netpaid")],
-        WindowSpec([], [], WindowFrame(is_rows=True, lower=None,
-                                       upper=None)), agg)
-    keep = CpuFilter(col("netpaid") > col("avg_netpaid") * lit(0.05), w)
-    return CpuLimit(100, CpuSort(
-        [asc(col("c_last_name")), asc(col("s_store_name")),
-         asc(col("i_brand"))],
-        CpuProject([col("c_last_name"), col("s_store_name"),
-                    col("i_brand"), col("netpaid")], keep)))
-
-
-def q35_shape(t, run):
-    """Customer-demographic profile of store customers who also bought
-    through catalog or web (reference q35's EXISTS shapes as semi
-    joins)."""
-    cust = CpuHashJoin(J.LEFT_SEMI, [col("c_customer_sk")],
-                       [col("ss_customer_sk")], t["customer"],
-                       t["store_sales"])
-    cs_side = CpuProject([col("cs_bill_customer_sk").alias("buyer")],
-                         t["catalog_sales"])
-    ws_side = CpuProject([col("ws_bill_customer_sk").alias("buyer")],
-                         t["web_sales"])
-    cust2 = CpuHashJoin(J.LEFT_SEMI, [col("c_customer_sk")],
-                        [col("buyer")], cust,
-                        CpuUnion(cs_side, ws_side))
-    j = _join(cust2, t["customer_address"], ["c_current_addr_sk"],
-              ["ca_address_sk"])
-    agg = CpuAggregate(
-        [col("ca_state")],
-        [Count(col("c_customer_sk")).alias("cnt")], j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("ca_state"))], agg))
-
-
-def q39_shape(t, run):
-    """Inventory monthly mean by warehouse/item, self-joined on the next
-    month (reference q39's consecutive-month covariance pairs; variance
-    reduced to avg like the reference's own gating of unsupported
-    aggs)."""
-    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
-    j = _join(_join(dd, t["inventory"], ["d_date_sk"], ["inv_date_sk"]),
-              t["warehouse"], ["inv_warehouse_sk"], ["w_warehouse_sk"])
-    monthly = CpuAggregate(
-        [col("w_warehouse_sk"), col("inv_item_sk"), col("d_moy")],
-        [Average(col("inv_quantity_on_hand")).alias("qoh")], j)
-    m1 = CpuProject([col("w_warehouse_sk"), col("inv_item_sk"),
-                     (col("d_moy") + lit(1)).alias("next_moy"),
-                     col("qoh").alias("qoh1")], monthly)
-    m2 = CpuProject([col("w_warehouse_sk").alias("w2"),
-                     col("inv_item_sk").alias("i2"),
-                     col("d_moy").alias("moy2"),
-                     col("qoh").alias("qoh2")], monthly)
-    pair = CpuHashJoin(
-        J.INNER, [col("w_warehouse_sk"), col("inv_item_sk"),
-                  col("next_moy")],
-        [col("w2"), col("i2"), col("moy2")], m1, m2)
-    return CpuLimit(100, CpuSort(
-        [asc(col("w_warehouse_sk")), asc(col("inv_item_sk")),
-         asc(col("next_moy"))],
-        CpuProject([col("w_warehouse_sk"), col("inv_item_sk"),
-                    col("next_moy"), col("qoh1"), col("qoh2")], pair)))
-
-
-def q49_shape(t, run):
-    """Per-channel return ratios with a rank window, worst offenders
-    first (reference q49's three ranked channel blocks)."""
-    from spark_rapids_tpu.exec.sort import desc as _desc
-    from spark_rapids_tpu.exec.window import (CpuWindow, Rank,
-                                              WindowSpec)
-
-    def channel(label, sales, skey_o, skey_i, qty, rets, rkey_o,
-                rkey_i, rqty):
-        j = CpuHashJoin(
-            J.INNER, [col(skey_o), col(skey_i)],
-            [col(rkey_o), col(rkey_i)], t[sales], t[rets])
-        agg = CpuAggregate(
-            [col(skey_i)],
-            [Sum(col(rqty)).alias("ret"), Sum(col(qty)).alias("sold")], j)
-        ratio = CpuProject(
-            [lit(label).alias("channel"), col(skey_i).alias("item"),
-             (col("ret") / col("sold")).alias("return_ratio")],
-            CpuFilter(col("sold") > lit(0), agg))
-        ranked = CpuWindow(
-            [Rank().alias("return_rank")],
-            WindowSpec([], [_desc(col("return_ratio"))]), ratio)
-        return CpuFilter(col("return_rank") <= lit(10), ranked)
-
-    u = CpuUnion(
-        channel("web", "web_sales", "ws_order_number", "ws_item_sk",
-                "ws_quantity", "web_returns", "wr_order_number",
-                "wr_item_sk", "wr_return_quantity"),
-        channel("catalog", "catalog_sales", "cs_order_number",
-                "cs_item_sk", "cs_quantity", "catalog_returns",
-                "cr_order_number", "cr_item_sk", "cr_return_quantity"),
-        channel("store", "store_sales", "ss_ticket_number",
-                "ss_item_sk", "ss_quantity", "store_returns",
-                "sr_ticket_number", "sr_item_sk", "sr_return_quantity"))
-    return CpuLimit(100, CpuSort(
-        [asc(col("channel")), asc(col("return_rank")),
-         asc(col("item"))], u))
-
-
-def q53_shape(t, run):
-    """Manufacturer quarterly revenue vs its own average (reference
-    q53/q63 family; q63 already covers the monthly variant)."""
-    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
-                                              WindowSpec, WinAvg)
-    j = _join(_join(CpuFilter(col("d_year") == lit(2001),
-                              t["date_dim"]),
-                    t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
-              t["item"], ["ss_item_sk"], ["i_item_sk"])
-    agg = CpuAggregate(
-        [col("i_manufact_id"), col("d_qoy")],
-        [Sum(col("ss_sales_price")).alias("sum_sales")], j)
-    w = CpuWindow(
-        [WinAvg(col("sum_sales")).alias("avg_quarterly")],
-        WindowSpec([col("i_manufact_id")], [],
-                   WindowFrame(is_rows=True, lower=None, upper=None)),
-        agg)
-    from spark_rapids_tpu.exprs.arithmetic import Abs as _Abs
-    keep = CpuFilter(
-        (col("avg_quarterly") > lit(0.0)) &
-        (_Abs(col("sum_sales") - col("avg_quarterly")) /
-         col("avg_quarterly") > lit(0.1)), w)
-    return CpuLimit(100, CpuSort(
-        [asc(col("i_manufact_id")), asc(col("d_qoy"))],
-        CpuProject([col("i_manufact_id"), col("d_qoy"),
-                    col("sum_sales"), col("avg_quarterly")], keep)))
-
-
 def _cast_i64(e):
     from spark_rapids_tpu.exprs.cast import Cast
     return Cast(e, _T.INT64)
 
 
-def q54_shape(t, run):
-    """Revenue buckets of customers who bought a target category through
-    catalog or web (reference q54's cohort + bucketed histogram)."""
-    it = CpuFilter(col("i_category") == lit("Books"), t["item"])
-    cs_b = CpuProject([col("cs_bill_customer_sk").alias("buyer")],
-                      _join(it, t["catalog_sales"], ["i_item_sk"],
-                            ["cs_item_sk"]))
-    ws_b = CpuProject([col("ws_bill_customer_sk").alias("buyer")],
-                      _join(it, t["web_sales"], ["i_item_sk"],
-                            ["ws_item_sk"]))
-    cohort = CpuHashJoin(J.LEFT_SEMI, [col("c_customer_sk")],
-                         [col("buyer")], t["customer"],
-                         CpuUnion(cs_b, ws_b))
-    rev = CpuAggregate(
-        [col("c_customer_sk")],
-        [Sum(col("ss_ext_sales_price")).alias("revenue")],
-        _join(cohort, t["store_sales"], ["c_customer_sk"],
-              ["ss_customer_sk"]))
-    bucket = CpuProject(
-        [_cast_i64(col("revenue") / lit(50.0)).alias("segment")], rev)
-    agg = CpuAggregate([col("segment")],
-                       [Count(col("segment")).alias("num_customers")],
-                       bucket)
-    return CpuLimit(100, CpuSort(
-        [asc(col("segment")), asc(col("num_customers"))], agg))
-
-
-def q56_shape(t, run):
-    """Per-item revenue across the three channels for address-filtered
-    sales (reference q56, the q33/q60 sibling keyed by item_id)."""
-    dd = CpuFilter((col("d_year") == lit(2001)) &
-                   (col("d_moy") == lit(2)), t["date_dim"])
-    it = CpuFilter(InSet(col("i_category"), ("Home", "Shoes")),
-                   t["item"])
-
-    def channel(sales, date_key, item_key, price):
-        j = _join(_join(dd, t[sales], ["d_date_sk"], [date_key]),
-                  it, [item_key], ["i_item_sk"])
-        return CpuProject(
-            [col("i_item_id"), col(price).alias("total_sales")], j)
-
-    u = CpuUnion(channel("store_sales", "ss_sold_date_sk",
-                         "ss_item_sk", "ss_ext_sales_price"),
-                 channel("catalog_sales", "cs_sold_date_sk",
-                         "cs_item_sk", "cs_ext_sales_price"),
-                 channel("web_sales", "ws_sold_date_sk",
-                         "ws_item_sk", "ws_ext_sales_price"))
-    agg = CpuAggregate([col("i_item_id")],
-                       [Sum(col("total_sales")).alias("total_sales")], u)
-    return CpuLimit(100, CpuSort(
-        [asc(col("total_sales")), asc(col("i_item_id"))], agg))
-
-
-def q57_shape(t, run):
-    """Catalog monthly brand revenue vs neighbors (reference q57 — the
-    catalog sibling of q47's stacked windows)."""
-    from spark_rapids_tpu.exec.sort import asc as _asc
-    from spark_rapids_tpu.exec.window import (CpuWindow, Lag, Lead,
-                                              WindowFrame, WindowSpec,
-                                              WinAvg)
-    j = _join(_join(CpuFilter(col("d_year") == lit(1999),
-                              t["date_dim"]),
-                    t["catalog_sales"], ["d_date_sk"],
-                    ["cs_sold_date_sk"]),
-              t["item"], ["cs_item_sk"], ["i_item_sk"])
-    monthly = CpuAggregate(
-        [col("i_brand"), col("d_moy")],
-        [Sum(col("cs_sales_price")).alias("sum_sales")], j)
-    w = CpuWindow(
-        [Lag(col("sum_sales")).alias("psum"),
-         Lead(col("sum_sales")).alias("nsum")],
-        WindowSpec([col("i_brand")], [_asc(col("d_moy"))]), monthly)
-    wavg = CpuWindow(
-        [WinAvg(col("sum_sales")).alias("avg_monthly")],
-        WindowSpec([col("i_brand")], [],
-                   WindowFrame(is_rows=True, lower=None, upper=None)), w)
-    return CpuLimit(100, CpuSort(
-        [asc(col("i_brand")), asc(col("d_moy"))],
-        CpuProject([col("i_brand"), col("d_moy"), col("sum_sales"),
-                    col("psum"), col("nsum"), col("avg_monthly")],
-                   wavg)))
-
-
-def q64_shape(t, run):
-    """Returned store purchases by city and brand (reference q64's
-    cross-sale pairs, reduced to the store arm over the v0 schema)."""
-    ssr = CpuHashJoin(
-        J.INNER, [col("ss_ticket_number"), col("ss_item_sk")],
-        [col("sr_ticket_number"), col("sr_item_sk")],
-        t["store_sales"], t["store_returns"])
-    j = _join(_join(_join(ssr, t["item"], ["ss_item_sk"],
-                          ["i_item_sk"]),
-                    t["customer"], ["ss_customer_sk"],
-                    ["c_customer_sk"]),
-              t["customer_address"], ["c_current_addr_sk"],
-              ["ca_address_sk"])
-    agg = CpuAggregate(
-        [col("ca_city"), col("i_brand")],
-        [Count(col("ss_ticket_number")).alias("cnt"),
-         Sum(col("ss_net_paid")).alias("paid"),
-         Sum(col("sr_return_amt")).alias("returned")], j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("ca_city")), asc(col("i_brand"))], agg))
-
-
-def q72_shape(t, run):
-    """Catalog orders vs on-hand inventory, promo split (reference q72's
-    inventory shortage join)."""
-    j = CpuHashJoin(J.INNER, [col("cs_item_sk")], [col("inv_item_sk")],
-                    t["catalog_sales"], t["inventory"],
-                    condition=col("inv_quantity_on_hand") <
-                    col("cs_quantity"))
-    p = CpuHashJoin(J.LEFT_OUTER, [col("cs_promo_sk")],
-                    [col("p_promo_sk")], j, t["promotion"])
-    flagged = CpuProject(
-        [col("cs_item_sk"),
-         If(IsNull(col("p_promo_sk")), lit(1), lit(0)).alias("no_promo"),
-         If(IsNotNull(col("p_promo_sk")), lit(1), lit(0)).alias("promo")],
-        p)
-    agg = CpuAggregate(
-        [col("cs_item_sk")],
-        [Sum(col("no_promo")).alias("no_promo"),
-         Sum(col("promo")).alias("promo"),
-         Count(col("cs_item_sk")).alias("total_cnt")], flagged)
-    return CpuLimit(100, CpuSort(
-        [desc(col("total_cnt")), asc(col("cs_item_sk"))], agg))
-
-
-def q76_shape(t, run):
-    """Channel/year/category sales counts over the union of all three
-    channels (reference q76's null-key audit, keyed by channel here)."""
-    def channel(label, sales, date_key, item_key, price):
-        j = _join(_join(t["date_dim"], t[sales], ["d_date_sk"],
-                        [date_key]),
-                  t["item"], [item_key], ["i_item_sk"])
-        return CpuProject(
-            [lit(label).alias("channel"), col("d_year"),
-             col("i_category"), col(price).alias("ext_sales_price")], j)
-
-    u = CpuUnion(
-        channel("store", "store_sales", "ss_sold_date_sk", "ss_item_sk",
-                "ss_ext_sales_price"),
-        channel("web", "web_sales", "ws_sold_date_sk", "ws_item_sk",
-                "ws_ext_sales_price"),
-        channel("catalog", "catalog_sales", "cs_sold_date_sk",
-                "cs_item_sk", "cs_ext_sales_price"))
-    agg = CpuAggregate(
-        [col("channel"), col("d_year"), col("i_category")],
-        [Count(col("ext_sales_price")).alias("sales_cnt"),
-         Sum(col("ext_sales_price")).alias("sales_amt")], u)
-    return CpuLimit(100, CpuSort(
-        [asc(col("channel")), asc(col("d_year")),
-         asc(col("i_category"))], agg))
-
-
-def q78_shape(t, run):
-    """Unreturned web sales per item/year vs store equivalents
-    (reference q78's returns-netting left outer + null filter)."""
-    def unreturned(sales, okey, ikey, dkey, qty, rets, rokey, rikey):
-        jo = CpuHashJoin(
-            J.LEFT_OUTER, [col(okey), col(ikey)],
-            [col(rokey), col(rikey)], t[sales], t[rets])
-        kept = CpuFilter(IsNull(col(rokey)), jo)
-        jd = _join(t["date_dim"], kept, ["d_date_sk"], [dkey])
-        return CpuAggregate(
-            [col("d_year"), col(ikey)],
-            [Sum(col(qty)).alias("qty")], jd)
-
-    ws = unreturned("web_sales", "ws_order_number", "ws_item_sk",
-                    "ws_sold_date_sk", "ws_quantity",
-                    "web_returns", "wr_order_number", "wr_item_sk")
-    ss = CpuProject(
-        [col("d_year").alias("ss_year"),
-         col("ss_item_sk").alias("s_item"),
-         col("qty").alias("ss_qty")],
-        unreturned("store_sales", "ss_ticket_number", "ss_item_sk",
-                   "ss_sold_date_sk", "ss_quantity",
-                   "store_returns", "sr_ticket_number", "sr_item_sk"))
-    j = CpuHashJoin(J.INNER, [col("d_year"), col("ws_item_sk")],
-                    [col("ss_year"), col("s_item")], ws, ss)
-    out = CpuProject(
-        [col("d_year"), col("ws_item_sk"), col("qty"), col("ss_qty"),
-         (col("qty") / col("ss_qty")).alias("ratio")], j)
-    return CpuLimit(100, CpuSort(
-        [desc(col("ratio")), asc(col("ws_item_sk")),
-         asc(col("d_year"))], out))
-
-
-def q81_shape(t, run):
-    """Catalog returners above 1.2x their state's average return amount
-    (reference q81's correlated HAVING via a per-state window)."""
-    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
-                                              WindowSpec, WinAvg)
-    j = _join(_join(t["catalog_returns"], t["customer"],
-                    ["cr_returning_customer_sk"], ["c_customer_sk"]),
-              t["customer_address"], ["c_current_addr_sk"],
-              ["ca_address_sk"])
-    per_cust = CpuAggregate(
-        [col("c_customer_id"), col("ca_state")],
-        [Sum(col("cr_return_amount")).alias("ctr_total_return")], j)
-    w = CpuWindow(
-        [WinAvg(col("ctr_total_return")).alias("state_avg")],
-        WindowSpec([col("ca_state")], [],
-                   WindowFrame(is_rows=True, lower=None, upper=None)),
-        per_cust)
-    keep = CpuFilter(
-        col("ctr_total_return") > col("state_avg") * lit(1.2), w)
-    return CpuLimit(100, CpuSort(
-        [asc(col("c_customer_id"))],
-        CpuProject([col("c_customer_id"), col("ca_state"),
-                    col("ctr_total_return")], keep)))
-
-
-def q83_shape(t, run):
-    """Return quantities by item across the three return tables
-    (reference q83's three-way item join)."""
-    sr = CpuAggregate([col("sr_item_sk")],
-                      [Sum(col("sr_return_quantity")).alias("sr_qty")],
-                      t["store_returns"])
-    cr = CpuProject([col("cr_item_sk").alias("c_item"),
-                     col("cr_qty")],
-                    CpuAggregate(
-                        [col("cr_item_sk")],
-                        [Sum(col("cr_return_quantity")).alias("cr_qty")],
-                        t["catalog_returns"]))
-    wr = CpuProject([col("wr_item_sk").alias("w_item"),
-                     col("wr_qty")],
-                    CpuAggregate(
-                        [col("wr_item_sk")],
-                        [Sum(col("wr_return_quantity")).alias("wr_qty")],
-                        t["web_returns"]))
-    j = CpuHashJoin(J.INNER, [col("sr_item_sk")], [col("c_item")],
-                    sr, cr)
-    j = CpuHashJoin(J.INNER, [col("sr_item_sk")], [col("w_item")],
-                    j, wr)
-    out = CpuProject(
-        [col("sr_item_sk"), col("sr_qty"), col("cr_qty"), col("wr_qty"),
-         ((col("sr_qty") + col("cr_qty") + col("wr_qty")) / lit(3.0))
-         .alias("average")], j)
-    return CpuLimit(100, CpuSort(
-        [asc(col("sr_item_sk"))], out))
-
-
-def q84_shape(t, run):
-    """Customer directory for one city, names concatenated (reference
-    q84's customer/address/demographics lookup)."""
-    from spark_rapids_tpu.exprs.string_fns import ConcatStrings
-    ca = CpuFilter(col("ca_city") == lit("Midway"),
-                   t["customer_address"])
-    j = _join(t["customer"], ca, ["c_current_addr_sk"],
-              ["ca_address_sk"])
-    out = CpuProject(
-        [col("c_customer_id").alias("customer_id"),
-         ConcatStrings((col("c_last_name"), lit(", "),
-                        col("c_first_name"))).alias("customername")], j)
-    return CpuLimit(100, CpuSort([asc(col("customer_id"))], out))
-
-
-def q85_shape(t, run):
-    """Catalog returns profiled by buyer demographics (reference q85's
-    reason-bucketed web returns, carried by the catalog arm where the
-    v0 schema has the demographics link)."""
-    j = CpuHashJoin(
-        J.INNER, [col("cs_order_number"), col("cs_item_sk")],
-        [col("cr_order_number"), col("cr_item_sk")],
-        t["catalog_sales"], t["catalog_returns"])
-    jd = _join(j, t["customer_demographics"], ["cs_bill_cdemo_sk"],
-               ["cd_demo_sk"])
-    agg = CpuAggregate(
-        [col("cd_marital_status"), col("cd_education_status")],
-        [Average(col("cs_quantity")).alias("avg_qty"),
-         Average(col("cr_return_quantity")).alias("avg_ret_qty"),
-         Count(col("cs_order_number")).alias("cnt")], jd)
-    return CpuLimit(100, CpuSort(
-        [asc(col("cd_marital_status")),
-         asc(col("cd_education_status"))], agg))
-
-
-def q89_shape(t, run):
-    """Monthly category/brand/store revenue vs the yearly average
-    (reference q89)."""
-    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
-                                              WindowSpec, WinAvg)
-    j = _join(_join(_join(CpuFilter(col("d_year") == lit(2000),
-                                    t["date_dim"]),
-                          t["store_sales"], ["d_date_sk"],
-                          ["ss_sold_date_sk"]),
-                    t["item"], ["ss_item_sk"], ["i_item_sk"]),
-              t["store"], ["ss_store_sk"], ["s_store_sk"])
-    monthly = CpuAggregate(
-        [col("i_category"), col("i_brand"), col("s_store_name"),
-         col("d_moy")],
-        [Sum(col("ss_sales_price")).alias("sum_sales")], j)
-    w = CpuWindow(
-        [WinAvg(col("sum_sales")).alias("avg_monthly_sales")],
-        WindowSpec([col("i_category"), col("i_brand"),
-                    col("s_store_name")], [],
-                   WindowFrame(is_rows=True, lower=None, upper=None)),
-        monthly)
-    keep = CpuFilter(
-        col("sum_sales") > col("avg_monthly_sales") * lit(1.1), w)
-    return CpuLimit(100, CpuSort(
-        [asc(col("i_category")), asc(col("i_brand")),
-         asc(col("s_store_name")), asc(col("d_moy"))],
-        CpuProject([col("i_category"), col("i_brand"),
-                    col("s_store_name"), col("d_moy"), col("sum_sales"),
-                    col("avg_monthly_sales")], keep)))
-
-
-def q95_shape(t, run):
-    """Web orders shipped from more than one warehouse that were also
-    returned (reference q95's double-EXISTS over ws self-join + wr)."""
-    ws2 = CpuProject([col("ws_order_number").alias("o2"),
-                      col("ws_warehouse_sk").alias("w2")],
-                     t["web_sales"])
-    multi = CpuHashJoin(
-        J.LEFT_SEMI, [col("ws_order_number")], [col("o2")],
-        t["web_sales"], ws2,
-        condition=col("ws_warehouse_sk") != col("w2"))
-    returned = CpuHashJoin(
-        J.LEFT_SEMI, [col("ws_order_number")], [col("wr_order_number")],
-        multi, t["web_returns"])
-    per_order = CpuAggregate(
-        [col("ws_order_number")],
-        [Sum(col("ws_ext_ship_cost")).alias("ship_cost"),
-         Sum(col("ws_net_profit")).alias("profit")], returned)
-    total = CpuAggregate(
-        [],
-        [Count(col("ws_order_number")).alias("order_count"),
-         Sum(col("ship_cost")).alias("total_shipping"),
-         Sum(col("profit")).alias("total_profit")], per_order)
-    return total
-
-
-QUERIES.update({
-    "q4": q4_shape, "q5": q5_shape, "q9": q9_shape, "q11": q11_shape,
-    "q12": q12_shape, "q14": q14_shape, "q17": q17_shape,
-    "q20": q20_shape, "q22": q22_rollup, "q24": q24_shape,
-    "q29": q29_shape, "q35": q35_shape, "q39": q39_shape,
-    "q49": q49_shape, "q53": q53_shape, "q54": q54_shape,
-    "q56": q56_shape, "q57": q57_shape, "q64": q64_shape,
-    "q72": q72_shape, "q74": q74_shape, "q76": q76_shape,
-    "q78": q78_shape, "q81": q81_shape, "q83": q83_shape,
-    "q84": q84_shape, "q85": q85_shape, "q86": q86_rollup,
-    "q89": q89_shape, "q95": q95_shape,
+QUERIES.update({ "q22": q22_rollup, "q86": q86_rollup,
 })
 
 
 # a/b variants (the reference counts q14a/b, q23a/b, q24a/b, q39a/b as
 # separate queries — TpcdsLikeSpark.scala) + q91.
-def q14b_shape(t, run):
-    """Cross-channel items: this-year vs last-year sales comparison for
-    items sold in both store and catalog (reference q14b's
-    year-over-year arm; q14(a) covers the 3-channel intersection)."""
-    both = CpuHashJoin(J.LEFT_SEMI, [col("i_item_sk")],
-                       [col("cs_item_sk")],
-                       CpuHashJoin(J.LEFT_SEMI, [col("i_item_sk")],
-                                   [col("ss_item_sk")], t["item"],
-                                   t["store_sales"]),
-                       t["catalog_sales"])
-
-    def year_sales(y, alias):
-        dd = CpuFilter(col("d_year") == lit(y), t["date_dim"])
-        j = _join(_join(dd, t["store_sales"], ["d_date_sk"],
-                        ["ss_sold_date_sk"]),
-                  both, ["ss_item_sk"], ["i_item_sk"])
-        return CpuAggregate(
-            [col("i_brand_id")],
-            [Sum(col("ss_ext_sales_price")).alias(alias)], j)
-
-    this_y = year_sales(2000, "this_year")
-    last_y = CpuProject([col("i_brand_id").alias("b2"),
-                         col("last_year")],
-                        year_sales(1999, "last_year"))
-    j = CpuHashJoin(J.INNER, [col("i_brand_id")], [col("b2")],
-                    this_y, last_y)
-    return CpuLimit(100, CpuSort(
-        [desc(col("this_year")), asc(col("i_brand_id"))],
-        CpuProject([col("i_brand_id"), col("this_year"),
-                    col("last_year")], j)))
-
-
-def q23b_shape(t, run):
-    """Best store customers' catalog spend on frequently-sold items
-    (reference q23b; q23(a) covers the frequent-item monthly totals)."""
-    freq = CpuFilter(col("cnt") > lit(4), CpuAggregate(
-        [col("ss_item_sk")], [Count(None).alias("cnt")],
-        t["store_sales"]))
-    best = CpuFilter(col("spend") > lit(1000.0), CpuAggregate(
-        [col("ss_customer_sk")],
-        [Sum(col("ss_net_paid")).alias("spend")], t["store_sales"]))
-    cs = CpuHashJoin(J.LEFT_SEMI, [col("cs_item_sk")],
-                     [col("ss_item_sk")], t["catalog_sales"], freq)
-    cs = CpuHashJoin(J.LEFT_SEMI, [col("cs_bill_customer_sk")],
-                     [col("ss_customer_sk")], cs, best)
-    agg = CpuAggregate(
-        [col("cs_bill_customer_sk")],
-        [Sum(col("cs_sales_price")).alias("sales")], cs)
-    return CpuLimit(100, CpuSort(
-        [desc(col("sales")), asc(col("cs_bill_customer_sk"))], agg))
-
-
-def q24b_shape(t, run):
-    """q24's sibling keyed by category instead of brand (the reference
-    differs only in the color filter; the v0 item schema has no color)."""
-    from spark_rapids_tpu.exec.window import (CpuWindow, WindowFrame,
-                                              WindowSpec, WinAvg)
-    ssr = CpuHashJoin(
-        J.INNER, [col("ss_ticket_number"), col("ss_item_sk")],
-        [col("sr_ticket_number"), col("sr_item_sk")],
-        t["store_sales"], t["store_returns"])
-    j = _join(_join(_join(ssr, t["store"], ["ss_store_sk"],
-                          ["s_store_sk"]),
-                    t["item"], ["ss_item_sk"], ["i_item_sk"]),
-              t["customer"], ["ss_customer_sk"], ["c_customer_sk"])
-    agg = CpuAggregate(
-        [col("c_last_name"), col("s_store_name"), col("i_category")],
-        [Sum(col("ss_net_paid")).alias("netpaid")], j)
-    w = CpuWindow(
-        [WinAvg(col("netpaid")).alias("avg_netpaid")],
-        WindowSpec([], [], WindowFrame(is_rows=True, lower=None,
-                                       upper=None)), agg)
-    keep = CpuFilter(col("netpaid") > col("avg_netpaid") * lit(0.05), w)
-    return CpuLimit(100, CpuSort(
-        [asc(col("c_last_name")), asc(col("s_store_name")),
-         asc(col("i_category"))],
-        CpuProject([col("c_last_name"), col("s_store_name"),
-                    col("i_category"), col("netpaid")], keep)))
-
-
-def q39b_shape(t, run):
-    """q39's second arm: only pairs whose month-over-month quantity
-    swing is large (reference q39b tightens the covariance filter)."""
-    base = q39_shape(t, run)
-    # re-filter the paired report: keep rows with a >30% swing
-    from spark_rapids_tpu.exprs.arithmetic import Abs as _Abs
-    inner = base.child.child if isinstance(base, CpuLimit) else base
-    swing = CpuFilter(
-        (col("qoh1") > lit(0.0)) &
-        (_Abs(col("qoh2") - col("qoh1")) / col("qoh1") > lit(0.3)),
-        inner)
-    return CpuLimit(100, CpuSort(
-        [asc(col("w_warehouse_sk")), asc(col("inv_item_sk")),
-         asc(col("next_moy"))], swing))
-
-
-def q91_shape(t, run):
-    """Catalog returns profiled by buyer demographics and customer state
-    (reference q91 groups by call center — outside the v0 table set;
-    the demographic link rides the originating catalog sale's
-    cs_bill_cdemo_sk, the same path q85 uses)."""
-    ret = CpuHashJoin(
-        J.INNER, [col("cr_order_number"), col("cr_item_sk")],
-        [col("cs_order_number"), col("cs_item_sk")],
-        t["catalog_returns"], t["catalog_sales"])
-    j = _join(_join(_join(ret, t["customer"],
-                          ["cr_returning_customer_sk"],
-                          ["c_customer_sk"]),
-                    t["customer_address"], ["c_current_addr_sk"],
-                    ["ca_address_sk"]),
-              t["customer_demographics"],
-              ["cs_bill_cdemo_sk"], ["cd_demo_sk"])
-    agg = CpuAggregate(
-        [col("ca_state"), col("cd_marital_status")],
-        [Sum(col("cr_return_amount")).alias("returns_loss"),
-         Count(None).alias("cnt")], j)
-    return CpuLimit(100, CpuSort(
-        [desc(col("returns_loss")), asc(col("ca_state")),
-         asc(col("cd_marital_status"))], agg))
-
-
-QUERIES.update({
-    "q14b": q14b_shape, "q23b": q23b_shape, "q24b": q24b_shape,
-    "q39b": q39b_shape, "q91": q91_shape,
-})
 
 
 # ---------------------------------------------------------------------------
 # round-3 faithful upgrades: full reference query text
 # (TpcdsLikeSpark.scala:709+) over the extended generator schemas —
-# replacing the corresponding *_shape reductions query-for-query.
+# (every earlier reduced variant is replaced query-for-query).
 from spark_rapids_tpu.exprs.string_fns import Like, Substring as _Substring
 
 
@@ -2809,8 +686,10 @@ def q40(t, run):
     j = _join(t["catalog_sales"], t["catalog_returns"],
               ["cs_order_number", "cs_item_sk"],
               ["cr_order_number", "cr_item_sk"], jt=J.LEFT_OUTER)
+    # reference band is 0.99..1.49 over 2000-02-10..04-10; a wider
+    # price band keeps the sparse synthetic item table populated
     it = CpuFilter(_between(col("i_current_price"),
-                            lit(0.99), lit(1.49)), t["item"])
+                            lit(0.99), lit(3.49)), t["item"])
     dd = CpuFilter(_between(col("d_date"), _date(2000, 2, 10),
                             _date(2000, 4, 10)), t["date_dim"])
     j = _join(_join(_join(j, it, ["cs_item_sk"], ["i_item_sk"]),
@@ -3391,42 +1270,6 @@ def q82(t, run):
     return CpuLimit(100, CpuSort([asc(col("i_item_id"))], out))
 
 
-def q91(t, run):
-    """Reference q91: call-center returns loss for one demographic
-    slice."""
-    dd = CpuFilter((col("d_year") == lit(1998)) &
-                   (col("d_moy") == lit(11)), t["date_dim"])
-    cd = CpuFilter(
-        ((col("cd_marital_status") == lit("M")) &
-         (col("cd_education_status") == lit("Unknown"))) |
-        ((col("cd_marital_status") == lit("W")) &
-         (col("cd_education_status") == lit("Advanced Degree"))),
-        t["customer_demographics"])
-    hd = CpuFilter(Like(col("hd_buy_potential"), lit("Unknown%")),
-                   t["household_demographics"])
-    ca = CpuFilter(col("ca_gmt_offset") == lit(-7.0),
-                   t["customer_address"])
-    j = _join(_join(dd, t["catalog_returns"],
-                    ["d_date_sk"], ["cr_returned_date_sk"]),
-              t["call_center"], ["cr_call_center_sk"],
-              ["cc_call_center_sk"])
-    j = _join(j, t["customer"], ["cr_returning_customer_sk"],
-              ["c_customer_sk"])
-    j = _join(_join(_join(j, cd, ["c_current_cdemo_sk"],
-                          ["cd_demo_sk"]),
-                    hd, ["c_current_hdemo_sk"], ["hd_demo_sk"]),
-              ca, ["c_current_addr_sk"], ["ca_address_sk"])
-    agg = CpuAggregate(
-        [col("cc_call_center_id"), col("cc_name"), col("cc_manager"),
-         col("cd_marital_status"), col("cd_education_status")],
-        [Sum(col("cr_net_loss")).alias("Returns_Loss")], j)
-    out = CpuProject(
-        [col("cc_call_center_id").alias("Call_Center"),
-         col("cc_name").alias("Call_Center_Name"),
-         col("cc_manager").alias("Manager"), col("Returns_Loss")], agg)
-    return CpuSort([desc(col("Returns_Loss"))], out)
-
-
 def q92(t, run):
     """Reference q92: web discounts exceeding 1.3x the per-item window
     average (correlated subquery as aggregate re-join)."""
@@ -3688,3 +1531,2810 @@ def q21(t, run):
 
 
 QUERIES.update({"q21": q21})
+
+
+# ---------------------------------------------------------------------------
+# round-3 continued: faithful upgrades over the extended generator
+# (d_week_seq, t_meal_time, income_band, hd_income_band_sk, nullable
+# channel fks).  Reference text: TpcdsLikeSpark.scala:708+.
+from spark_rapids_tpu.exprs.math_exprs import Round
+from spark_rapids_tpu.exprs.string_fns import ConcatStrings
+
+def _day_sum(val: str, name: str, alias: str):
+    """sum(case when d_day_name='<name>' then <val> else null end)"""
+    return Sum(If(col("d_day_name") == lit(name), col(val),
+                  _Lit(None, _T.FLOAT64))).alias(alias)
+
+
+_DAYS = [("Sunday", "sun"), ("Monday", "mon"), ("Tuesday", "tue"),
+         ("Wednesday", "wed"), ("Thursday", "thu"), ("Friday", "fri"),
+         ("Saturday", "sat")]
+
+
+def q2(t, run):
+    """Reference q2: web+catalog weekly day-of-week sales, year-over-
+    year ratio on week_seq1 = week_seq2 - 53."""
+    wscs = CpuUnion(
+        CpuProject([col("ws_sold_date_sk").alias("sold_date_sk"),
+                    col("ws_ext_sales_price").alias("sales_price")],
+                   t["web_sales"]),
+        CpuProject([col("cs_sold_date_sk").alias("sold_date_sk"),
+                    col("cs_ext_sales_price").alias("sales_price")],
+                   t["catalog_sales"]))
+    j = _join(t["date_dim"], wscs, ["d_date_sk"], ["sold_date_sk"])
+    wswscs = CpuAggregate(
+        [col("d_week_seq")],
+        [_day_sum("sales_price", d, f"{p}_sales") for d, p in _DAYS], j)
+
+    def year_slice(year, tag):
+        wk = CpuProject([col("d_week_seq").alias(f"{tag}_wk")],
+                        CpuFilter(col("d_year") == lit(year),
+                                  t["date_dim"]))
+        named = CpuProject(
+            [col("d_week_seq").alias(f"d_week_seq{tag}")] +
+            [col(f"{p}_sales").alias(f"{p}_sales{tag}")
+             for _, p in _DAYS], wswscs)
+        return _join(named, wk, [f"d_week_seq{tag}"], [f"{tag}_wk"])
+
+    y = year_slice(2001, "1")
+    z = CpuProject(
+        [(col("d_week_seq2") - lit(53)).alias("z_key")] +
+        [col(f"{p}_sales2") for _, p in _DAYS], year_slice(2002, "2"))
+    j2 = _join(y, z, ["d_week_seq1"], ["z_key"])
+    out = CpuProject(
+        [col("d_week_seq1")] +
+        [Round(col(f"{p}_sales1") / col(f"{p}_sales2"), 2
+               ).alias(f"r_{p}") for _, p in _DAYS], j2)
+    return CpuSort([asc(col("d_week_seq1"))], out)
+
+
+def q59(t, run):
+    """Reference q59: store weekly day-of-week sales, consecutive
+    12-month windows ratio (week_seq1 = week_seq2 - 52)."""
+    j = _join(t["date_dim"], t["store_sales"],
+              ["d_date_sk"], ["ss_sold_date_sk"])
+    wss = CpuAggregate(
+        [col("d_week_seq"), col("ss_store_sk")],
+        [_day_sum("ss_sales_price", d, f"{p}_sales")
+         for d, p in _DAYS], j)
+
+    def window(mlo, mhi, tag, days):
+        # reference month_seq window 1212..1212+11 stands in as the
+        # generator's month_seq domain [0, 59]
+        wk = CpuProject(
+            [col("d_week_seq").alias(f"{tag}_wk")],
+            CpuFilter(_between(col("d_month_seq"), lit(mlo), lit(mhi)),
+                      t["date_dim"]))
+        named = CpuProject(
+            [col("d_week_seq").alias(f"d_week_seq{tag}"),
+             col("ss_store_sk").alias(f"store_sk{tag}")] +
+            [col(f"{p}_sales").alias(f"{p}_sales{tag}") for p in days],
+            wss)
+        st = _join(named, t["store"], [f"store_sk{tag}"], ["s_store_sk"])
+        keep = ([col(f"d_week_seq{tag}"),
+                 col("s_store_name").alias(f"s_store_name{tag}"),
+                 col("s_store_id").alias(f"s_store_id{tag}")] +
+                [col(f"{p}_sales{tag}") for p in days])
+        return _join(CpuProject(keep, st), wk,
+                     [f"d_week_seq{tag}"], [f"{tag}_wk"])
+
+    days1 = [p for _, p in _DAYS]
+    y = window(12, 23, "1", days1)
+    x = CpuProject(
+        [(col("d_week_seq2") - lit(52)).alias("x_key"),
+         col("s_store_id2")] +
+        [col(f"{p}_sales2") for p in days1],
+        window(24, 35, "2", days1))
+    j2 = _join(y, x, ["d_week_seq1", "s_store_id1"],
+               ["x_key", "s_store_id2"])
+    out = CpuProject(
+        [col("s_store_name1"), col("s_store_id1"), col("d_week_seq1")] +
+        [(col(f"{p}_sales1") / col(f"{p}_sales2")).alias(f"r_{p}")
+         for p in days1], j2)
+    return CpuLimit(100, CpuSort(
+        [asc(col("s_store_name1")), asc(col("s_store_id1")),
+         asc(col("d_week_seq1"))], out))
+
+
+def _q76_channel(t, sales, null_col, date_key, item_key, price, chan):
+    f = CpuFilter(IsNull(col(null_col)), sales)
+    j = _join(_join(f, t["item"], [item_key], ["i_item_sk"]),
+              t["date_dim"], [date_key], ["d_date_sk"])
+    return CpuProject(
+        [lit(chan).alias("channel"), col(null_col).alias("col_name"),
+         col("d_year"), col("d_qoy"), col("i_category"),
+         col(price).alias("ext_sales_price")], j)
+
+
+def q76(t, run):
+    """Reference q76: sales rows with a NULL channel fk, per channel."""
+    u = CpuUnion(
+        _q76_channel(t, t["store_sales"], "ss_store_sk",
+                     "ss_sold_date_sk", "ss_item_sk",
+                     "ss_ext_sales_price", "store"),
+        _q76_channel(t, t["web_sales"], "ws_ship_customer_sk",
+                     "ws_sold_date_sk", "ws_item_sk",
+                     "ws_ext_sales_price", "web"),
+        _q76_channel(t, t["catalog_sales"], "cs_ship_addr_sk",
+                     "cs_sold_date_sk", "cs_item_sk",
+                     "cs_ext_sales_price", "catalog"))
+    agg = CpuAggregate(
+        [col("channel"), col("col_name"), col("d_year"), col("d_qoy"),
+         col("i_category")],
+        [Count(None).alias("sales_cnt"),
+         Sum(col("ext_sales_price")).alias("sales_amt")], u)
+    return CpuLimit(100, CpuSort(
+        [asc(col("channel")), asc(col("col_name")), asc(col("d_year")),
+         asc(col("d_qoy")), asc(col("i_category"))], agg))
+
+
+def q84(t, run):
+    """Reference q84: customers in one city within an income band who
+    returned something."""
+    ca = CpuFilter(col("ca_city") == lit("Edgewood"),
+                   t["customer_address"])
+    ib = CpuFilter((col("ib_lower_bound") >= lit(38128)) &
+                   (col("ib_upper_bound") <= lit(38128 + 50000)),
+                   t["income_band"])
+    j = _join(t["customer"], ca, ["c_current_addr_sk"],
+              ["ca_address_sk"])
+    j = _join(j, t["customer_demographics"],
+              ["c_current_cdemo_sk"], ["cd_demo_sk"])
+    j = _join(j, t["household_demographics"],
+              ["c_current_hdemo_sk"], ["hd_demo_sk"])
+    j = _join(j, ib, ["hd_income_band_sk"], ["ib_income_band_sk"])
+    j = _join(j, t["store_returns"], ["cd_demo_sk"], ["sr_cdemo_sk"])
+    out = CpuProject(
+        [col("c_customer_id").alias("customer_id"),
+         ConcatStrings((Coalesce((col("c_last_name"), lit(""))),
+                        lit(", "),
+                        Coalesce((col("c_first_name"), lit(""))))
+         ).alias("customername")], j)
+    return CpuLimit(100, CpuSort([asc(col("customer_id"))], out))
+
+
+def q91(t, run):
+    """Reference q91: call-center catalog returns loss for one month by
+    demographic slice."""
+    # reference window is 1998-11; the full year stands in for the
+    # sparse synthetic returns table
+    dd = CpuFilter(col("d_year") == lit(1998), t["date_dim"])
+    cd = CpuFilter(
+        ((col("cd_marital_status") == lit("M")) &
+         (col("cd_education_status") == lit("Unknown"))) |
+        ((col("cd_marital_status") == lit("W")) &
+         (col("cd_education_status") == lit("Advanced Degree"))),
+        t["customer_demographics"])
+    hd = CpuFilter(Like(col("hd_buy_potential"), lit("Unknown%")),
+                   t["household_demographics"])
+    ca = CpuFilter(col("ca_gmt_offset") == lit(-7.0),
+                   t["customer_address"])
+    j = _join(t["call_center"], t["catalog_returns"],
+              ["cc_call_center_sk"], ["cr_call_center_sk"])
+    j = _join(j, dd, ["cr_returned_date_sk"], ["d_date_sk"])
+    j = _join(j, t["customer"], ["cr_returning_customer_sk"],
+              ["c_customer_sk"])
+    j = _join(j, cd, ["c_current_cdemo_sk"], ["cd_demo_sk"])
+    j = _join(j, hd, ["c_current_hdemo_sk"], ["hd_demo_sk"])
+    j = _join(j, ca, ["c_current_addr_sk"], ["ca_address_sk"])
+    agg = CpuAggregate(
+        [col("cc_call_center_id"), col("cc_name"), col("cc_manager"),
+         col("cd_marital_status"), col("cd_education_status")],
+        [Sum(col("cr_net_loss")).alias("returns_loss")], j)
+    out = CpuProject(
+        [col("cc_call_center_id").alias("call_center"),
+         col("cc_name").alias("call_center_name"),
+         col("cc_manager").alias("manager"),
+         col("returns_loss")], agg)
+    return CpuSort([desc(col("returns_loss"))], out)
+
+
+def q71(t, run):
+    """Reference q71: one manager's brand revenue by hour/minute over
+    all three channels, breakfast + dinner meal times."""
+    def chan(sales, price, date_key, item_key, time_key):
+        dd = CpuFilter((col("d_moy") == lit(11)) &
+                       (col("d_year") == lit(1999)), t["date_dim"])
+        j = _join(dd, sales, ["d_date_sk"], [date_key])
+        return CpuProject(
+            [col(price).alias("ext_price"),
+             col(item_key).alias("sold_item_sk"),
+             col(time_key).alias("time_sk")], j)
+
+    u = CpuUnion(
+        chan(t["web_sales"], "ws_ext_sales_price", "ws_sold_date_sk",
+             "ws_item_sk", "ws_sold_time_sk"),
+        chan(t["catalog_sales"], "cs_ext_sales_price",
+             "cs_sold_date_sk", "cs_item_sk", "cs_sold_time_sk"),
+        chan(t["store_sales"], "ss_ext_sales_price", "ss_sold_date_sk",
+             "ss_item_sk", "ss_sold_time_sk"))
+    it = CpuFilter(col("i_manager_id") == lit(1), t["item"])
+    td = CpuFilter((col("t_meal_time") == lit("breakfast")) |
+                   (col("t_meal_time") == lit("dinner")), t["time_dim"])
+    j = _join(_join(u, it, ["sold_item_sk"], ["i_item_sk"]),
+              td, ["time_sk"], ["t_time_sk"])
+    agg = CpuAggregate(
+        [col("i_brand"), col("i_brand_id"), col("t_hour"),
+         col("t_minute")],
+        [Sum(col("ext_price")).alias("ext_price")], j)
+    out = CpuProject(
+        [col("i_brand_id").alias("brand_id"),
+         col("i_brand").alias("brand"), col("t_hour"), col("t_minute"),
+         col("ext_price")], agg)
+    return CpuSort([desc(col("ext_price")), asc(col("brand_id"))], out)
+
+
+def q8(t, run):
+    """Reference q8: store profit for stores whose zip prefix matches
+    zips that are both on the campaign list and dense in preferred
+    customers (INTERSECT via inner join of the two distinct sets)."""
+    # reference's 400-zip campaign list stands in as its intersection
+    # with the generator zip pool + three pool zips
+    zips = ("10144", "10336", "10390", "10445", "10516", "10567",
+            "85669", "86197", "88274")
+    listed = CpuAggregate(
+        [col("zip5")], [Count(None).alias("_a")],
+        CpuProject([_Substring(col("ca_zip"), lit(1),
+                               lit(5)).alias("zip5")],
+                   CpuFilter(InSet(_Substring(col("ca_zip"), lit(1),
+                                              lit(5)), zips),
+                             t["customer_address"])))
+    pref = _join(t["customer_address"], 
+                 CpuFilter(col("c_preferred_cust_flag") == lit("Y"),
+                           t["customer"]),
+                 ["ca_address_sk"], ["c_current_addr_sk"])
+    dense = CpuFilter(
+        col("cnt") > lit(10),
+        CpuAggregate([col("pzip")], [Count(None).alias("cnt")],
+                     CpuProject([_Substring(col("ca_zip"), lit(1),
+                                            lit(5)).alias("pzip")],
+                                pref)))
+    v1 = CpuProject(
+        [_Substring(col("zip5"), lit(1), lit(2)).alias("zip2")],
+        _join(listed, dense, ["zip5"], ["pzip"]))
+    dd = CpuFilter((col("d_qoy") == lit(2)) &
+                   (col("d_year") == lit(1998)), t["date_dim"])
+    j = _join(_join(dd, t["store_sales"],
+                    ["d_date_sk"], ["ss_sold_date_sk"]),
+              t["store"], ["ss_store_sk"], ["s_store_sk"])
+    j = CpuProject(
+        [col("s_store_name"), col("ss_net_profit"),
+         _Substring(col("s_zip"), lit(1), lit(2)).alias("szip2")], j)
+    j = _join(j, CpuAggregate([col("zip2")],
+                              [Count(None).alias("_b")], v1),
+              ["szip2"], ["zip2"], jt=J.LEFT_SEMI)
+    agg = CpuAggregate([col("s_store_name")],
+                       [Sum(col("ss_net_profit")).alias("profit")], j)
+    return CpuLimit(100, CpuSort([asc(col("s_store_name"))], agg))
+
+
+QUERIES.update({
+    "q2": q2, "q59": q59, "q76": q76, "q84": q84, "q91": q91,
+    "q71": q71, "q8": q8,
+})
+
+
+def _year_total_slice(t, chan, year, amount, group_extra=()):
+    """One year_total CTE slice (q4/q11/q74): per-customer yearly sum
+    for one channel.  `amount(prefix)` builds the summed expression."""
+    sales, cust_key, date_key = {
+        "s": ("store_sales", "ss_customer_sk", "ss_sold_date_sk"),
+        "c": ("catalog_sales", "cs_bill_customer_sk",
+              "cs_sold_date_sk"),
+        "w": ("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk"),
+    }[chan]
+    dd = CpuFilter(col("d_year") == lit(year), t["date_dim"])
+    j = _join(_join(dd, t[sales], ["d_date_sk"], [date_key]),
+              t["customer"], [cust_key], ["c_customer_sk"])
+    groups = ["c_customer_id", "c_first_name", "c_last_name",
+              "c_preferred_cust_flag", "c_birth_country", "c_login",
+              "c_email_address"] + list(group_extra)
+    return CpuAggregate([col(g) for g in groups],
+                        [Sum(amount).alias("year_total")], j)
+
+
+def _yt_named(slice_plan, tag, keep):
+    """Project a year_total slice to tag-prefixed columns."""
+    return CpuProject(
+        [col(c).alias(f"{tag}_{c}") for c in keep] +
+        [col("year_total").alias(f"{tag}_total")], slice_plan)
+
+
+def q4(t, run):
+    """Reference q4: customers whose catalog growth beats both store
+    and web growth, across all three channels."""
+    y1, y2 = 2001, 2002
+    amt = {
+        "s": ((col("ss_ext_list_price") - col("ss_ext_wholesale_cost")
+               - col("ss_ext_discount_amt")) +
+              col("ss_ext_sales_price")) / lit(2.0),
+        "c": ((col("cs_ext_list_price") - col("cs_wholesale_cost")
+               - col("cs_ext_discount_amt")) +
+              col("cs_ext_sales_price")) / lit(2.0),
+        "w": ((col("ws_ext_list_price") - col("ws_ext_discount_amt"))
+              + col("ws_ext_sales_price")) / lit(2.0),
+    }
+    named = {}
+    for ch in ("s", "c", "w"):
+        keep = (["c_customer_id", "c_first_name", "c_last_name",
+                 "c_preferred_cust_flag"] if ch == "s" else
+                ["c_customer_id"])
+        named[(ch, 1)] = _yt_named(
+            _year_total_slice(t, ch, y1, amt[ch]), f"{ch}1",
+            ["c_customer_id"])
+        named[(ch, 2)] = _yt_named(
+            _year_total_slice(t, ch, y2, amt[ch]), f"{ch}2",
+            keep if ch == "s" else ["c_customer_id"])
+    j = _join(named[("s", 1)], named[("s", 2)],
+              ["s1_c_customer_id"], ["s2_c_customer_id"])
+    for ch in ("c", "w"):
+        j = _join(j, named[(ch, 1)], ["s1_c_customer_id"],
+                  [f"{ch}1_c_customer_id"])
+        j = _join(j, named[(ch, 2)], ["s1_c_customer_id"],
+                  [f"{ch}2_c_customer_id"])
+
+    def growth(ch):
+        return If(col(f"{ch}1_total") > lit(0.0),
+                  col(f"{ch}2_total") / col(f"{ch}1_total"),
+                  _Lit(None, _T.FLOAT64))
+    f = CpuFilter(
+        (col("s1_total") > lit(0.0)) &
+        (col("c1_total") > lit(0.0)) &
+        (col("w1_total") > lit(0.0)) &
+        (growth("c") > growth("s")) & (growth("c") > growth("w")), j)
+    out = CpuProject(
+        [col("s2_c_customer_id").alias("customer_id"),
+         col("s2_c_first_name").alias("customer_first_name"),
+         col("s2_c_last_name").alias("customer_last_name"),
+         col("s2_c_preferred_cust_flag"
+             ).alias("customer_preferred_cust_flag")], f)
+    return CpuLimit(100, CpuSort(
+        [asc(col("customer_id")), asc(col("customer_first_name")),
+         asc(col("customer_last_name")),
+         asc(col("customer_preferred_cust_flag"))], out))
+
+
+def q11(t, run):
+    """Reference q11: customers whose web growth beats store growth
+    (else-0.0 ratio semantics)."""
+    amt_s = col("ss_ext_list_price") - col("ss_ext_discount_amt")
+    amt_w = col("ws_ext_list_price") - col("ws_ext_discount_amt")
+    y1, y2 = 2001, 2002
+    named = {
+        ("s", 1): _yt_named(_year_total_slice(t, "s", y1, amt_s), "s1",
+                            ["c_customer_id"]),
+        ("s", 2): _yt_named(_year_total_slice(t, "s", y2, amt_s), "s2",
+                            ["c_customer_id", "c_first_name",
+                             "c_last_name", "c_preferred_cust_flag"]),
+        ("w", 1): _yt_named(_year_total_slice(t, "w", y1, amt_w), "w1",
+                            ["c_customer_id"]),
+        ("w", 2): _yt_named(_year_total_slice(t, "w", y2, amt_w), "w2",
+                            ["c_customer_id"]),
+    }
+    j = _join(named[("s", 1)], named[("s", 2)],
+              ["s1_c_customer_id"], ["s2_c_customer_id"])
+    j = _join(j, named[("w", 1)], ["s1_c_customer_id"],
+              ["w1_c_customer_id"])
+    j = _join(j, named[("w", 2)], ["s1_c_customer_id"],
+              ["w2_c_customer_id"])
+
+    def growth(ch):
+        return If(col(f"{ch}1_total") > lit(0.0),
+                  col(f"{ch}2_total") / col(f"{ch}1_total"), lit(0.0))
+    f = CpuFilter(
+        (col("s1_total") > lit(0.0)) & (col("w1_total") > lit(0.0)) &
+        (growth("w") > growth("s")), j)
+    out = CpuProject(
+        [col("s2_c_customer_id").alias("customer_id"),
+         col("s2_c_first_name").alias("customer_first_name"),
+         col("s2_c_last_name").alias("customer_last_name"),
+         col("s2_c_preferred_cust_flag"
+             ).alias("customer_preferred_cust_flag")], f)
+    return CpuLimit(100, CpuSort(
+        [asc(col("customer_id")), asc(col("customer_first_name")),
+         asc(col("customer_last_name")),
+         asc(col("customer_preferred_cust_flag"))], out))
+
+
+def q74(t, run):
+    """Reference q74: q11's shape on ss/ws_net_paid, null-else ratio,
+    ordered by customer_id."""
+    y1, y2 = 2001, 2002
+    named = {
+        ("s", 1): _yt_named(
+            _year_total_slice(t, "s", y1, col("ss_net_paid")), "s1",
+            ["c_customer_id"]),
+        ("s", 2): _yt_named(
+            _year_total_slice(t, "s", y2, col("ss_net_paid")), "s2",
+            ["c_customer_id", "c_first_name", "c_last_name"]),
+        ("w", 1): _yt_named(
+            _year_total_slice(t, "w", y1, col("ws_net_paid")), "w1",
+            ["c_customer_id"]),
+        ("w", 2): _yt_named(
+            _year_total_slice(t, "w", y2, col("ws_net_paid")), "w2",
+            ["c_customer_id"]),
+    }
+    j = _join(named[("s", 1)], named[("s", 2)],
+              ["s1_c_customer_id"], ["s2_c_customer_id"])
+    j = _join(j, named[("w", 1)], ["s1_c_customer_id"],
+              ["w1_c_customer_id"])
+    j = _join(j, named[("w", 2)], ["s1_c_customer_id"],
+              ["w2_c_customer_id"])
+
+    def growth(ch):
+        return If(col(f"{ch}1_total") > lit(0.0),
+                  col(f"{ch}2_total") / col(f"{ch}1_total"),
+                  _Lit(None, _T.FLOAT64))
+    f = CpuFilter(
+        (col("s1_total") > lit(0.0)) & (col("w1_total") > lit(0.0)) &
+        (growth("w") > growth("s")), j)
+    out = CpuProject(
+        [col("s2_c_customer_id").alias("customer_id"),
+         col("s2_c_first_name").alias("customer_first_name"),
+         col("s2_c_last_name").alias("customer_last_name")], f)
+    return CpuLimit(100, CpuSort([asc(col("customer_id"))], out))
+
+
+QUERIES.update({"q4": q4, "q11": q11, "q74": q74})
+
+
+def _ctr_plan(t, returns, date_key, cust_key, addr_key, amt, year):
+    """customer_total_return CTE (q30/q81): per-customer, per-state
+    return totals for one year."""
+    dd = CpuFilter(col("d_year") == lit(year), t["date_dim"])
+    j = _join(_join(dd, returns, ["d_date_sk"], [date_key]),
+              t["customer_address"], [addr_key], ["ca_address_sk"])
+    return CpuAggregate(
+        [col(cust_key), col("ca_state")],
+        [Sum(col(amt)).alias("ctr_total_return")], j)
+
+
+def _ctr_above_state_avg(t, ctr, cust_key):
+    """ctr rows above 1.2x their state's average total return
+    (correlated scalar subquery as aggregate re-join)."""
+    avg = CpuProject(
+        [col("ca_state").alias("avg_state"),
+         (col("_a") * lit(1.2)).alias("threshold")],
+        CpuAggregate([col("ca_state")],
+                     [Average(col("ctr_total_return")).alias("_a")],
+                     ctr))
+    j = _join(ctr, avg, ["ca_state"], ["avg_state"])
+    return CpuFilter(col("ctr_total_return") > col("threshold"), j)
+
+
+def q30(t, run):
+    """Reference q30: GA customers whose web-return total beats 1.2x
+    their state average, with the full customer attribute list."""
+    ctr = _ctr_plan(t, t["web_returns"], "wr_returned_date_sk",
+                    "wr_returning_customer_sk", "wr_returning_addr_sk",
+                    "wr_return_amt", 2002)
+    top = _ctr_above_state_avg(t, ctr, "wr_returning_customer_sk")
+    ga = CpuFilter(col("ca_state") == lit("GA"),
+                   t["customer_address"])
+    ga = CpuProject([col("ca_address_sk").alias("ga_addr")], ga)
+    j = _join(top, t["customer"], ["wr_returning_customer_sk"],
+              ["c_customer_sk"])
+    j = _join(j, ga, ["c_current_addr_sk"], ["ga_addr"])
+    cols = ["c_customer_id", "c_salutation", "c_first_name",
+            "c_last_name", "c_preferred_cust_flag", "c_birth_day",
+            "c_birth_month", "c_birth_year", "c_birth_country",
+            "c_login", "c_email_address", "c_last_review_date"]
+    out = CpuProject([col(c) for c in cols] +
+                     [col("ctr_total_return")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col(c)) for c in cols] +
+        [asc(col("ctr_total_return"))], out))
+
+
+def q81(t, run):
+    """Reference q81: q30's shape on catalog returns (amt_inc_tax),
+    returning the full address attribute list."""
+    ctr = _ctr_plan(t, t["catalog_returns"], "cr_returned_date_sk",
+                    "cr_returning_customer_sk", "cr_returning_addr_sk",
+                    "cr_return_amt_inc_tax", 2000)
+    top = _ctr_above_state_avg(t, ctr, "cr_returning_customer_sk")
+    j = _join(top, t["customer"], ["cr_returning_customer_sk"],
+              ["c_customer_sk"])
+    ga = CpuFilter(col("ca_state") == lit("GA"),
+                   t["customer_address"])
+    ga = CpuProject(
+        [col("ca_address_sk").alias("ga_addr"),
+         col("ca_street_number"), col("ca_street_name"),
+         col("ca_street_type"), col("ca_suite_number"),
+         col("ca_city").alias("cust_city"),
+         col("ca_county").alias("cust_county"),
+         col("ca_state").alias("cust_state"),
+         col("ca_zip").alias("cust_zip"),
+         col("ca_country").alias("cust_country"),
+         col("ca_gmt_offset").alias("cust_gmt"),
+         col("ca_location_type")], ga)
+    j = _join(j, ga, ["c_current_addr_sk"], ["ga_addr"])
+    cols = ["c_customer_id", "c_salutation", "c_first_name",
+            "c_last_name", "ca_street_number", "ca_street_name",
+            "ca_street_type", "ca_suite_number", "cust_city",
+            "cust_county", "cust_state", "cust_zip", "cust_country",
+            "cust_gmt", "ca_location_type"]
+    out = CpuProject([col(c) for c in cols] +
+                     [col("ctr_total_return")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col(c)) for c in cols] +
+        [asc(col("ctr_total_return"))], out))
+
+
+def q31(t, run):
+    """Reference q31: counties where web sales grew faster than store
+    sales across 2000 Q1->Q2->Q3."""
+    def chan(sales, date_key, addr_key, val, alias):
+        j = _join(_join(t["date_dim"], sales,
+                        ["d_date_sk"], [date_key]),
+                  t["customer_address"], [addr_key], ["ca_address_sk"])
+        return CpuAggregate(
+            [col("ca_county"), col("d_qoy"), col("d_year")],
+            [Sum(col(val)).alias(alias)], j)
+
+    ss = chan(t["store_sales"], "ss_sold_date_sk", "ss_addr_sk",
+              "ss_ext_sales_price", "store_sales")
+    ws = chan(t["web_sales"], "ws_sold_date_sk", "ws_bill_addr_sk",
+              "ws_ext_sales_price", "web_sales")
+
+    def slice_q(plan, qoy, tag, val):
+        return CpuProject(
+            [col("ca_county").alias(f"{tag}_county"),
+             col(val).alias(f"{tag}_total")],
+            CpuFilter((col("d_qoy") == lit(qoy)) &
+                      (col("d_year") == lit(2000)), plan))
+
+    j = _join(slice_q(ss, 1, "ss1", "store_sales"),
+              slice_q(ss, 2, "ss2", "store_sales"),
+              ["ss1_county"], ["ss2_county"])
+    j = _join(j, slice_q(ss, 3, "ss3", "store_sales"),
+              ["ss1_county"], ["ss3_county"])
+    j = _join(j, slice_q(ws, 1, "ws1", "web_sales"),
+              ["ss1_county"], ["ws1_county"])
+    j = _join(j, slice_q(ws, 2, "ws2", "web_sales"),
+              ["ss1_county"], ["ws2_county"])
+    j = _join(j, slice_q(ws, 3, "ws3", "web_sales"),
+              ["ss1_county"], ["ws3_county"])
+
+    def ratio(hi, lo):
+        return If(col(f"{lo}_total") > lit(0.0),
+                  col(f"{hi}_total") / col(f"{lo}_total"),
+                  _Lit(None, _T.FLOAT64))
+    f = CpuFilter(
+        (ratio("ws2", "ws1") > ratio("ss2", "ss1")) &
+        (ratio("ws3", "ws2") > ratio("ss3", "ss2")), j)
+    out = CpuProject(
+        [col("ss1_county").alias("ca_county"),
+         lit(2000).alias("d_year"),
+         (col("ws2_total") / col("ws1_total")
+          ).alias("web_q1_q2_increase"),
+         (col("ss2_total") / col("ss1_total")
+          ).alias("store_q1_q2_increase"),
+         (col("ws3_total") / col("ws2_total")
+          ).alias("web_q2_q3_increase"),
+         (col("ss3_total") / col("ss2_total")
+          ).alias("store_q2_q3_increase")], f)
+    return CpuSort([asc(col("ca_county"))], out)
+
+
+def q6(t, run):
+    """Reference q6: states with >=10 customers who bought items priced
+    over 1.2x their category average in one month."""
+    month = CpuProject(
+        [col("d_month_seq").alias("target_seq"), lit(1).alias("_mk")],
+        CpuAggregate(
+            [col("d_month_seq")], [Count(None).alias("_c")],
+            CpuFilter((col("d_year") == lit(2001)) &
+                      (col("d_moy") == lit(1)), t["date_dim"])))
+    dd = CpuProject(
+        [col("d_date_sk")],
+        CpuFilter(col("d_month_seq") == col("target_seq"),
+                  _join(CpuProject([col("d_date_sk"),
+                                    col("d_month_seq"),
+                                    lit(1).alias("_dk")],
+                                   t["date_dim"]),
+                        month, ["_dk"], ["_mk"])))
+    cat_avg = CpuProject(
+        [col("i_category").alias("avg_cat"),
+         (col("_a") * lit(1.2)).alias("cat_threshold")],
+        CpuAggregate([col("i_category")],
+                     [Average(col("i_current_price")).alias("_a")],
+                     t["item"]))
+    it = CpuFilter(
+        col("i_current_price") > col("cat_threshold"),
+        _join(t["item"], cat_avg, ["i_category"], ["avg_cat"]))
+    j = _join(_join(_join(_join(
+        dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        t["customer"], ["ss_customer_sk"], ["c_customer_sk"]),
+        t["customer_address"], ["c_current_addr_sk"],
+        ["ca_address_sk"]),
+        it, ["ss_item_sk"], ["i_item_sk"])
+    agg = CpuAggregate([col("ca_state")],
+                       [Count(None).alias("cnt")], j)
+    # reference keeps states with >= 10 such customers; >= 5 stands
+    # in at synthetic scale
+    f = CpuFilter(col("cnt") >= lit(5), agg)
+    out = CpuProject([col("ca_state").alias("state"), col("cnt")], f)
+    return CpuLimit(100, CpuSort([asc(col("cnt"))], out))
+
+
+def q32(t, run):
+    """Reference q32: catalog discounts above 1.3x the per-item window
+    average for one manufacturer."""
+    dd = CpuFilter(_between(col("d_date"), _date(2000, 1, 27),
+                            _date(2000, 4, 26)), t["date_dim"])
+    cs = _join(dd, t["catalog_sales"],
+               ["d_date_sk"], ["cs_sold_date_sk"])
+    # reference manufacturer 977 stands in as 7 (a zipf-hot slice)
+    it = CpuFilter(col("i_manufact_id") == lit(7), t["item"])
+    j = _join(cs, it, ["cs_item_sk"], ["i_item_sk"])
+    avg = CpuProject(
+        [col("cs_item_sk").alias("_isk"),
+         (col("_a") * lit(1.3)).alias("threshold")],
+        CpuAggregate(
+            [col("cs_item_sk")],
+            [Average(col("cs_ext_discount_amt")).alias("_a")], cs))
+    out = _join(j, avg, ["cs_item_sk"], ["_isk"])
+    f = CpuFilter(col("cs_ext_discount_amt") > col("threshold"), out)
+    return CpuLimit(100, CpuAggregate(
+        [], [Sum(col("cs_ext_discount_amt")
+                 ).alias("excess_discount_amount")], f))
+
+
+def q83(t, run):
+    """Reference q83: per-item return quantities across the three
+    channels for three chosen weeks, with channel shares."""
+    wk = CpuProject(
+        [col("d_week_seq").alias("sel_wk")],
+        CpuAggregate(
+            [col("d_week_seq")], [Count(None).alias("_c")],
+            CpuFilter(InSet(col("d_date"),
+                            (_date(2000, 6, 30).value,
+                             _date(2000, 9, 27).value,
+                             _date(2000, 11, 17).value)),
+                      t["date_dim"])))
+    dates = CpuProject(
+        [col("d_date_sk")],
+        _join(t["date_dim"], wk, ["d_week_seq"], ["sel_wk"],
+              jt=J.LEFT_SEMI))
+
+    def items(returns, date_key, item_key, qty, alias):
+        j = _join(_join(dates, returns, ["d_date_sk"], [date_key]),
+                  t["item"], [item_key], ["i_item_sk"])
+        return CpuAggregate([col("i_item_id")],
+                            [Sum(col(qty)).alias(alias)], j)
+
+    sr = items(t["store_returns"], "sr_returned_date_sk",
+               "sr_item_sk", "sr_return_quantity", "sr_item_qty")
+    cr = CpuProject(
+        [col("i_item_id").alias("cr_id"), col("cr_item_qty")],
+        items(t["catalog_returns"], "cr_returned_date_sk",
+              "cr_item_sk", "cr_return_quantity", "cr_item_qty"))
+    wr = CpuProject(
+        [col("i_item_id").alias("wr_id"), col("wr_item_qty")],
+        items(t["web_returns"], "wr_returned_date_sk",
+              "wr_item_sk", "wr_return_quantity", "wr_item_qty"))
+    j = _join(_join(sr, cr, ["i_item_id"], ["cr_id"]),
+              wr, ["i_item_id"], ["wr_id"])
+    total = (col("sr_item_qty") + col("cr_item_qty") +
+             col("wr_item_qty"))
+
+    def dev(q):
+        return (col(q) / total / lit(3.0) * lit(100.0)
+                ).alias(q.replace("item_qty", "dev"))
+    out = CpuProject(
+        [col("i_item_id").alias("item_id"), col("sr_item_qty"),
+         dev("sr_item_qty"), col("cr_item_qty"), dev("cr_item_qty"),
+         col("wr_item_qty"), dev("wr_item_qty"),
+         (total / lit(3.0)).alias("average")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("item_id")), asc(col("sr_item_qty"))], out))
+
+
+QUERIES.update({
+    "q30": q30, "q81": q81, "q31": q31, "q6": q6, "q32": q32,
+    "q83": q83,
+})
+
+
+from spark_rapids_tpu.exprs.arithmetic import Abs as _Abs
+
+
+def q36(t, run):
+    """Reference q36: gross margin over ROLLUP(i_category, i_class)
+    with grouping()-derived hierarchy level and rank within parent."""
+    from spark_rapids_tpu.exec.window import CpuWindow, Rank, WindowSpec
+    dd = CpuFilter(col("d_year") == lit(2001), t["date_dim"])
+    # reference pins TN; the generator state domain stands in
+    st = CpuFilter(InSet(col("s_state"), ("TX", "CA", "WA")),
+                   t["store"])
+    j = _join(_join(_join(
+        dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        t["item"], ["ss_item_sk"], ["i_item_sk"]),
+        st, ["ss_store_sk"], ["s_store_sk"])
+    pre = CpuProject(
+        [col("i_category"), col("i_class"), col("ss_net_profit"),
+         col("ss_ext_sales_price")], j)
+    ex = _rollup_expand(pre, ["i_category", "i_class"],
+                        ["ss_net_profit", "ss_ext_sales_price"])
+    agg = CpuAggregate(
+        [col("i_category"), col("i_class"), col("gid")],
+        [Sum(col("ss_net_profit")).alias("_np"),
+         Sum(col("ss_ext_sales_price")).alias("_esp")], ex)
+    pre_w = CpuProject(
+        [(col("_np") / col("_esp")).alias("gross_margin"),
+         col("i_category"), col("i_class"),
+         If(col("gid") == lit(3), lit(2),
+            If(col("gid") == lit(1), lit(1), lit(0))
+            ).alias("lochierarchy"),
+         If(col("gid") == lit(0), col("i_category"),
+            _Lit(None, _T.STRING)).alias("_parent_cat")], agg)
+    w = CpuWindow(
+        [Rank().alias("rank_within_parent")],
+        WindowSpec([col("lochierarchy"), col("_parent_cat")],
+                   [asc(col("gross_margin"))]), pre_w)
+    keyed = CpuProject(
+        [col("gross_margin"), col("i_category"), col("i_class"),
+         col("lochierarchy"), col("rank_within_parent"),
+         If(col("lochierarchy") == lit(0), col("i_category"),
+            _Lit(None, _T.STRING)).alias("_ocat")], w)
+    out = CpuLimit(100, CpuSort(
+        [desc(col("lochierarchy")), asc(col("_ocat")),
+         asc(col("rank_within_parent"))], keyed))
+    return CpuProject(
+        [col("gross_margin"), col("i_category"), col("i_class"),
+         col("lochierarchy"), col("rank_within_parent")], out)
+
+
+def q44(t, run):
+    """Reference q44: best vs worst performing items by average net
+    profit at one store, rank-aligned."""
+    from spark_rapids_tpu.exec.window import CpuWindow, Rank, WindowSpec
+    # reference store 4 stands in as the generator's store 2
+    base = CpuFilter(col("ss_store_sk") == lit(2), t["store_sales"])
+    per_item = CpuAggregate(
+        [col("ss_item_sk")],
+        [Average(col("ss_net_profit")).alias("rank_col")], base)
+    null_addr = CpuProject(
+        [(col("_a") * lit(0.9)).alias("threshold"),
+         lit(1).alias("_tk")],
+        CpuAggregate(
+            [col("ss_store_sk")],
+            [Average(col("ss_net_profit")).alias("_a")],
+            CpuFilter(IsNull(col("ss_addr_sk")), base)))
+    keyed = CpuProject(
+        [col("ss_item_sk").alias("item_sk"), col("rank_col"),
+         lit(1).alias("_pk")], per_item)
+    v1 = CpuFilter(col("rank_col") > col("threshold"),
+                   _join(keyed, null_addr, ["_pk"], ["_tk"]))
+
+    def ranked(direction, tag):
+        order = [asc(col("rank_col"))] if direction == "asc" else \
+            [desc(col("rank_col"))]
+        w = CpuWindow([Rank().alias("rnk")],
+                      WindowSpec([], order), v1)
+        return CpuProject(
+            [col("item_sk").alias(f"{tag}_sk"),
+             col("rnk").alias(f"{tag}_rnk")],
+            CpuFilter(col("rnk") < lit(11), w))
+
+    j = _join(ranked("asc", "up"), ranked("desc", "down"),
+              ["up_rnk"], ["down_rnk"])
+    i1 = CpuProject([col("i_item_sk").alias("i1_sk"),
+                     col("i_product_name").alias("best_performing")],
+                    t["item"])
+    i2 = CpuProject([col("i_item_sk").alias("i2_sk"),
+                     col("i_product_name").alias("worst_performing")],
+                    t["item"])
+    j = _join(_join(j, i1, ["up_sk"], ["i1_sk"]),
+              i2, ["down_sk"], ["i2_sk"])
+    out = CpuProject(
+        [col("up_rnk").alias("rnk"), col("best_performing"),
+         col("worst_performing")], j)
+    return CpuLimit(100, CpuSort([asc(col("rnk"))], out))
+
+
+def _v1_monthly(t, sales, date_key, item_key, entity_joins, groups,
+                val):
+    """q47/q57 v1 CTE: monthly sums + whole-partition average + rank
+    over the month sequence."""
+    from spark_rapids_tpu.exec.window import (CpuWindow, Rank, WinAvg,
+                                              WindowFrame, WindowSpec)
+    dd = CpuFilter(
+        (col("d_year") == lit(1999)) |
+        ((col("d_year") == lit(1998)) & (col("d_moy") == lit(12))) |
+        ((col("d_year") == lit(2000)) & (col("d_moy") == lit(1))),
+        t["date_dim"])
+    j = _join(_join(dd, sales, ["d_date_sk"], [date_key]),
+              t["item"], [item_key], ["i_item_sk"])
+    for right, lk, rk in entity_joins:
+        j = _join(j, right, lk, rk)
+    agg = CpuAggregate(
+        [col(g) for g in groups + ["d_year", "d_moy"]],
+        [Sum(col(val)).alias("sum_sales")], j)
+    w = CpuWindow(
+        [WinAvg(col("sum_sales")).alias("avg_monthly_sales")],
+        WindowSpec([col(g) for g in groups] + [col("d_year")], [],
+                   WindowFrame(is_rows=True, lower=None, upper=None)),
+        agg)
+    return CpuWindow(
+        [Rank().alias("rn")],
+        WindowSpec([col(g) for g in groups],
+                   [asc(col("d_year")), asc(col("d_moy"))]), w)
+
+
+def _lag_lead_tail(v1, groups, out_extra):
+    """q47/q57 v2 + final filter: self-join v1 against rn+-1."""
+    lag = CpuProject(
+        [col(g).alias(f"lag_{g}") for g in groups] +
+        [(col("rn") + lit(1)).alias("lag_rn"),
+         col("sum_sales").alias("psum")], v1)
+    lead = CpuProject(
+        [col(g).alias(f"lead_{g}") for g in groups] +
+        [(col("rn") - lit(1)).alias("lead_rn"),
+         col("sum_sales").alias("nsum")], v1)
+    j = _join(v1, lag, groups + ["rn"],
+              [f"lag_{g}" for g in groups] + ["lag_rn"])
+    j = _join(j, lead, groups + ["rn"],
+              [f"lead_{g}" for g in groups] + ["lead_rn"])
+    ratio = If(col("avg_monthly_sales") > lit(0.0),
+               _Abs(col("sum_sales") - col("avg_monthly_sales")) /
+               col("avg_monthly_sales"), _Lit(None, _T.FLOAT64))
+    f = CpuFilter((col("d_year") == lit(1999)) &
+                  (col("avg_monthly_sales") > lit(0.0)) &
+                  (ratio > lit(0.1)), j)
+    out = CpuProject(
+        [col(g) for g in groups] +
+        [col("d_year"), col("d_moy"), col("avg_monthly_sales"),
+         col("sum_sales"), col("psum"), col("nsum"),
+         (col("sum_sales") - col("avg_monthly_sales")).alias("_dev")] +
+        out_extra, f)
+    return CpuLimit(100, CpuSort(
+        [asc(col("_dev")), asc(col(groups[0]))], out))
+
+
+def q47(t, run):
+    """Reference q47: store monthly sales deviations with neighboring
+    months via rank self-joins."""
+    groups = ["i_category", "i_brand", "s_store_name",
+              "s_company_name"]
+    v1 = _v1_monthly(
+        t, t["store_sales"], "ss_sold_date_sk", "ss_item_sk",
+        [(t["store"], ["ss_store_sk"], ["s_store_sk"])], groups,
+        "ss_sales_price")
+    return _lag_lead_tail(v1, groups, [])
+
+
+def q57(t, run):
+    """Reference q57: q47's shape on catalog sales by call center."""
+    groups = ["i_category", "i_brand", "cc_name"]
+    v1 = _v1_monthly(
+        t, t["catalog_sales"], "cs_sold_date_sk", "cs_item_sk",
+        [(t["call_center"], ["cs_call_center_sk"],
+          ["cc_call_center_sk"])], groups, "cs_sales_price")
+    return _lag_lead_tail(v1, groups, [])
+
+
+def q53(t, run):
+    """Reference q53: manufacturer quarterly sales vs their windowed
+    average, banded item slices."""
+    from spark_rapids_tpu.exec.window import (CpuWindow, WinAvg,
+                                              WindowFrame, WindowSpec)
+    # reference month_seq window 1200..1211 stands in as 12..23
+    dd = CpuFilter(_between(col("d_month_seq"), lit(12), lit(23)),
+                   t["date_dim"])
+    # reference brand/class lists stand in as generator domains
+    it = CpuFilter(
+        (InSet(col("i_category"), ("Books", "Electronics", "Home")) &
+         InSet(col("i_class"), ("class00", "class01", "class02",
+                                "class03")) &
+         InSet(col("i_brand"), ("brand#1", "brand#2"))) |
+        (InSet(col("i_category"), ("Women", "Music", "Shoes")) &
+         InSet(col("i_class"), ("class04", "class05", "class06",
+                                "class07")) &
+         InSet(col("i_brand"), ("brand#3", "brand#4"))), t["item"])
+    j = _join(_join(_join(
+        dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        it, ["ss_item_sk"], ["i_item_sk"]),
+        t["store"], ["ss_store_sk"], ["s_store_sk"])
+    agg = CpuAggregate(
+        [col("i_manufact_id"), col("d_qoy")],
+        [Sum(col("ss_sales_price")).alias("sum_sales")], j)
+    w = CpuWindow(
+        [WinAvg(col("sum_sales")).alias("avg_quarterly_sales")],
+        WindowSpec([col("i_manufact_id")], [],
+                   WindowFrame(is_rows=True, lower=None, upper=None)),
+        agg)
+    ratio = If(col("avg_quarterly_sales") > lit(0.0),
+               _Abs(col("sum_sales") - col("avg_quarterly_sales")) /
+               col("avg_quarterly_sales"), _Lit(None, _T.FLOAT64))
+    f = CpuFilter(ratio > lit(0.1), w)
+    out = CpuProject([col("i_manufact_id"), col("sum_sales"),
+                      col("avg_quarterly_sales")], f)
+    return CpuLimit(100, CpuSort(
+        [asc(col("avg_quarterly_sales")), asc(col("sum_sales")),
+         asc(col("i_manufact_id"))], out))
+
+
+def q89(t, run):
+    """Reference q89: monthly class sales vs the brand/store windowed
+    average."""
+    from spark_rapids_tpu.exec.window import (CpuWindow, WinAvg,
+                                              WindowFrame, WindowSpec)
+    dd = CpuFilter(col("d_year") == lit(1999), t["date_dim"])
+    it = CpuFilter(
+        (InSet(col("i_category"), ("Books", "Electronics", "Sports")) &
+         InSet(col("i_class"), ("class00", "class01", "class02"))) |
+        (InSet(col("i_category"), ("Men", "Jewelry", "Women")) &
+         InSet(col("i_class"), ("class03", "class04", "class05"))),
+        t["item"])
+    j = _join(_join(_join(
+        dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        it, ["ss_item_sk"], ["i_item_sk"]),
+        t["store"], ["ss_store_sk"], ["s_store_sk"])
+    agg = CpuAggregate(
+        [col("i_category"), col("i_class"), col("i_brand"),
+         col("s_store_name"), col("s_company_name"), col("d_moy")],
+        [Sum(col("ss_sales_price")).alias("sum_sales")], j)
+    w = CpuWindow(
+        [WinAvg(col("sum_sales")).alias("avg_monthly_sales")],
+        WindowSpec([col("i_category"), col("i_brand"),
+                    col("s_store_name"), col("s_company_name")], [],
+                   WindowFrame(is_rows=True, lower=None, upper=None)),
+        agg)
+    ratio = If(col("avg_monthly_sales") != lit(0.0),
+               _Abs(col("sum_sales") - col("avg_monthly_sales")) /
+               col("avg_monthly_sales"), _Lit(None, _T.FLOAT64))
+    f = CpuFilter(ratio > lit(0.1), w)
+    out = CpuProject(
+        [col("i_category"), col("i_class"), col("i_brand"),
+         col("s_store_name"), col("s_company_name"), col("d_moy"),
+         col("sum_sales"), col("avg_monthly_sales"),
+         (col("sum_sales") - col("avg_monthly_sales")).alias("_dev")],
+        f)
+    return CpuLimit(100, CpuSort(
+        [asc(col("_dev")), asc(col("s_store_name"))], out))
+
+
+QUERIES.update({
+    "q36": q36, "q44": q44, "q47": q47, "q57": q57, "q53": q53,
+    "q89": q89,
+})
+
+
+def _q49_channel(t, sales, returns, order_keys, ret_keys, qty, rqty,
+                 amt, ramt, paid, profit, date_key, item_key, chan):
+    from spark_rapids_tpu.exec.window import CpuWindow, Rank, WindowSpec
+    j = _join(t[sales], t[returns], order_keys, ret_keys,
+              jt=J.LEFT_OUTER)
+    # reference thresholds (return_amt > 10000, d_moy = 12) stand in
+    # as amounts/periods the synthetic scale can populate
+    dd = CpuFilter(col("d_year") == lit(2001), t["date_dim"])
+    j = _join(j, dd, [date_key], ["d_date_sk"])
+    f = CpuFilter((col(ramt) > lit(500.0)) &
+                  (col(profit) > lit(1.0)) &
+                  (col(paid) > lit(0.0)) &
+                  (col(qty) > lit(0)), j)
+    agg = CpuAggregate(
+        [col(item_key)],
+        [Sum(Coalesce((col(rqty), lit(0)))).alias("_rq"),
+         Sum(Coalesce((col(qty), lit(0)))).alias("_q"),
+         Sum(Coalesce((col(ramt), lit(0.0)))).alias("_ra"),
+         Sum(Coalesce((col(paid), lit(0.0)))).alias("_p")], f)
+    ratios = CpuProject(
+        [col(item_key).alias("item"),
+         (col("_rq") / col("_q")).alias("return_ratio"),
+         (col("_ra") / col("_p")).alias("currency_ratio")], agg)
+    w = CpuWindow(
+        [Rank().alias("return_rank")],
+        WindowSpec([], [asc(col("return_ratio"))]), ratios)
+    w = CpuWindow(
+        [Rank().alias("currency_rank")],
+        WindowSpec([], [asc(col("currency_ratio"))]), w)
+    f2 = CpuFilter((col("return_rank") <= lit(10)) |
+                   (col("currency_rank") <= lit(10)), w)
+    return CpuProject(
+        [lit(chan).alias("channel"), col("item"), col("return_ratio"),
+         col("return_rank"), col("currency_rank")], f2)
+
+
+def q49(t, run):
+    """Reference q49: worst return ratios by channel, rank-unioned
+    (UNION distinct via grouped dedup)."""
+    u = CpuUnion(
+        _q49_channel(t, "web_sales", "web_returns",
+                     ["ws_order_number", "ws_item_sk"],
+                     ["wr_order_number", "wr_item_sk"],
+                     "ws_quantity", "wr_return_quantity",
+                     "ws_ext_sales_price", "wr_return_amt",
+                     "ws_net_paid", "ws_net_profit",
+                     "ws_sold_date_sk", "ws_item_sk", "web"),
+        _q49_channel(t, "catalog_sales", "catalog_returns",
+                     ["cs_order_number", "cs_item_sk"],
+                     ["cr_order_number", "cr_item_sk"],
+                     "cs_quantity", "cr_return_quantity",
+                     "cs_ext_sales_price", "cr_return_amount",
+                     "cs_net_paid", "cs_net_profit",
+                     "cs_sold_date_sk", "cs_item_sk", "catalog"),
+        _q49_channel(t, "store_sales", "store_returns",
+                     ["ss_ticket_number", "ss_item_sk"],
+                     ["sr_ticket_number", "sr_item_sk"],
+                     "ss_quantity", "sr_return_quantity",
+                     "ss_ext_sales_price", "sr_return_amt",
+                     "ss_net_paid", "ss_net_profit",
+                     "ss_sold_date_sk", "ss_item_sk", "store"))
+    dedup = CpuProject(
+        [col("channel"), col("item"), col("return_ratio"),
+         col("return_rank"), col("currency_rank")],
+        CpuAggregate(
+            [col("channel"), col("item"), col("return_ratio"),
+             col("return_rank"), col("currency_rank")],
+            [Count(None).alias("_n")], u))
+    return CpuLimit(100, CpuSort(
+        [asc(col("channel")), asc(col("return_rank")),
+         asc(col("currency_rank"))], dedup))
+
+
+def q51(t, run):
+    """Reference q51: cumulative web vs store sales per item (windowed
+    running sums, full outer join, running max comparison)."""
+    from spark_rapids_tpu.exec.window import (CpuWindow, WinMax, WinSum,
+                                              WindowFrame, WindowSpec)
+    cume_frame = WindowFrame(is_rows=True, lower=None, upper=0)
+
+    def v1(sales, date_key, item_key, price, tag):
+        # reference month_seq window 1200..1211 stands in as 12..23
+        dd = CpuFilter(_between(col("d_month_seq"), lit(12), lit(23)),
+                       t["date_dim"])
+        j = _join(dd, t[sales], ["d_date_sk"], [date_key])
+        agg = CpuAggregate(
+            [col(item_key), col("d_date")],
+            [Sum(col(price)).alias("_s")], j)
+        w = CpuWindow(
+            [WinSum(col("_s")).alias("cume_sales")],
+            WindowSpec([col(item_key)], [asc(col("d_date"))],
+                       cume_frame), agg)
+        return CpuProject(
+            [col(item_key).alias(f"{tag}_item"),
+             col("d_date").alias(f"{tag}_date"),
+             col("cume_sales").alias(f"{tag}_cume")], w)
+
+    web = v1("web_sales", "ws_sold_date_sk", "ws_item_sk",
+             "ws_sales_price", "web")
+    store = v1("store_sales", "ss_sold_date_sk", "ss_item_sk",
+               "ss_sales_price", "store")
+    fo = _join(web, store, ["web_item", "web_date"],
+               ["store_item", "store_date"], jt=J.FULL_OUTER)
+    x = CpuProject(
+        [If(IsNotNull(col("web_item")), col("web_item"),
+            col("store_item")).alias("item_sk"),
+         If(IsNotNull(col("web_date")), col("web_date"),
+            col("store_date")).alias("d_date"),
+         col("web_cume").alias("web_sales"),
+         col("store_cume").alias("store_sales")], fo)
+    y = CpuWindow(
+        [WinMax(col("web_sales")).alias("web_cumulative"),
+         WinMax(col("store_sales")).alias("store_cumulative")],
+        WindowSpec([col("item_sk")], [asc(col("d_date"))], cume_frame),
+        x)
+    f = CpuFilter(col("web_cumulative") > col("store_cumulative"), y)
+    out = CpuProject(
+        [col("item_sk"), col("d_date"), col("web_sales"),
+         col("store_sales"), col("web_cumulative"),
+         col("store_cumulative")], f)
+    return CpuLimit(100, CpuSort(
+        [asc(col("item_sk")), asc(col("d_date"))], out))
+
+
+def q67(t, run):
+    """Reference q67: top items by sales over an 8-level ROLLUP, ranked
+    within category."""
+    from spark_rapids_tpu.exec.window import CpuWindow, Rank, WindowSpec
+    dd = CpuFilter(_between(col("d_month_seq"), lit(12), lit(23)),
+                   t["date_dim"])
+    j = _join(_join(_join(
+        dd, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"]),
+        t["store"], ["ss_store_sk"], ["s_store_sk"]),
+        t["item"], ["ss_item_sk"], ["i_item_sk"])
+    pre = CpuProject(
+        [col("i_category"), col("i_class"), col("i_brand"),
+         col("i_product_name"), col("d_year"), col("d_qoy"),
+         col("d_moy"), col("s_store_id"),
+         Coalesce((col("ss_sales_price") * col("ss_quantity"),
+                   lit(0.0))).alias("_amt")], j)
+    keys = ["i_category", "i_class", "i_brand", "i_product_name",
+            "d_year", "d_qoy", "d_moy", "s_store_id"]
+    ex = _rollup_expand(pre, keys, ["_amt"])
+    agg = CpuAggregate(
+        [col(k) for k in keys] + [col("gid")],
+        [Sum(col("_amt")).alias("sumsales")], ex)
+    w = CpuWindow(
+        [Rank().alias("rk")],
+        WindowSpec([col("i_category")], [desc(col("sumsales"))]), agg)
+    f = CpuFilter(col("rk") <= lit(100), w)
+    out = CpuProject([col(k) for k in keys] +
+                     [col("sumsales"), col("rk")], f)
+    return CpuLimit(100, CpuSort(
+        [asc(col(k)) for k in keys] +
+        [asc(col("sumsales")), asc(col("rk"))], out))
+
+
+def q70(t, run):
+    """Reference q70: profit over ROLLUP(s_state, s_county) limited to
+    top-ranked states, with hierarchy ranks."""
+    from spark_rapids_tpu.exec.window import CpuWindow, Rank, WindowSpec
+    dd = CpuFilter(_between(col("d_month_seq"), lit(12), lit(23)),
+                   t["date_dim"])
+    base = _join(_join(dd, t["store_sales"],
+                       ["d_date_sk"], ["ss_sold_date_sk"]),
+                 t["store"], ["ss_store_sk"], ["s_store_sk"])
+    by_state = CpuAggregate(
+        [col("s_state")], [Sum(col("ss_net_profit")).alias("_p")],
+        base)
+    ranked = CpuWindow(
+        [Rank().alias("ranking")],
+        WindowSpec([col("s_state")], [desc(col("_p"))]), by_state)
+    top_states = CpuProject(
+        [col("s_state").alias("sel_state")],
+        CpuFilter(col("ranking") <= lit(5), ranked))
+    j = _join(base, top_states, ["s_state"], ["sel_state"],
+              jt=J.LEFT_SEMI)
+    pre = CpuProject(
+        [col("s_state"), col("s_county"), col("ss_net_profit")], j)
+    ex = _rollup_expand(pre, ["s_state", "s_county"],
+                        ["ss_net_profit"])
+    agg = CpuAggregate(
+        [col("s_state"), col("s_county"), col("gid")],
+        [Sum(col("ss_net_profit")).alias("total_sum")], ex)
+    pre_w = CpuProject(
+        [col("total_sum"), col("s_state"), col("s_county"),
+         If(col("gid") == lit(3), lit(2),
+            If(col("gid") == lit(1), lit(1), lit(0))
+            ).alias("lochierarchy"),
+         If(col("gid") == lit(0), col("s_state"),
+            _Lit(None, _T.STRING)).alias("_parent_state")], agg)
+    w = CpuWindow(
+        [Rank().alias("rank_within_parent")],
+        WindowSpec([col("lochierarchy"), col("_parent_state")],
+                   [desc(col("total_sum"))]), pre_w)
+    keyed = CpuProject(
+        [col("total_sum"), col("s_state"), col("s_county"),
+         col("lochierarchy"), col("rank_within_parent"),
+         If(col("lochierarchy") == lit(0), col("s_state"),
+            _Lit(None, _T.STRING)).alias("_ostate")], w)
+    out = CpuLimit(100, CpuSort(
+        [desc(col("lochierarchy")), asc(col("_ostate")),
+         asc(col("rank_within_parent"))], keyed))
+    return CpuProject(
+        [col("total_sum"), col("s_state"), col("s_county"),
+         col("lochierarchy"), col("rank_within_parent")], out)
+
+
+QUERIES.update({"q49": q49, "q51": q51, "q67": q67, "q70": q70})
+
+
+def _active_customers(t, year):
+    """q10/q35 EXISTS machinery: distinct active-customer key sets per
+    channel; exists A and (exists B or exists C) = semi join on A, then
+    semi join on (B union C)."""
+    def chan(sales, date_key, cust_key, extra):
+        dd = CpuFilter((col("d_year") == lit(year)) & extra,
+                       t["date_dim"])
+        j = _join(dd, sales, ["d_date_sk"], [date_key])
+        return CpuProject(
+            [col("_k")],
+            CpuAggregate([col(cust_key).alias("_k")],
+                         [Count(None).alias("_n")],
+                         CpuProject([col(cust_key)], j)))
+    return chan
+
+
+def _q10_35(t, year, extra, group_cols, aggs, order_cols):
+    chan = _active_customers(t, year)
+    ss = chan(t["store_sales"], "ss_sold_date_sk", "ss_customer_sk",
+              extra)
+    ws = chan(t["web_sales"], "ws_sold_date_sk",
+              "ws_bill_customer_sk", extra)
+    cs = chan(t["catalog_sales"], "cs_sold_date_sk",
+              "cs_ship_customer_sk", extra)
+    wc = CpuProject(
+        [col("_k")],
+        CpuAggregate([col("_k")], [Count(None).alias("_n")],
+                     CpuUnion(ws, cs)))
+    j = _join(t["customer"], t["customer_address"],
+              ["c_current_addr_sk"], ["ca_address_sk"])
+    j = _join(j, t["customer_demographics"],
+              ["c_current_cdemo_sk"], ["cd_demo_sk"])
+    j = _join(j, ss, ["c_customer_sk"], ["_k"], jt=J.LEFT_SEMI)
+    j = _join(j, wc, ["c_customer_sk"], ["_k"], jt=J.LEFT_SEMI)
+    return j
+
+
+def q10(t, run):
+    """Reference q10: demographic profile of county customers active
+    in store AND (web OR catalog) channels in one quarter."""
+    from spark_rapids_tpu.exec.joins import JoinType as _JT
+    extra = _between(col("d_moy"), lit(1), lit(4))
+    j = _q10_35(t, 2002, extra, None, None, None)
+    # reference county list stands in as the generator county domain
+    j = CpuFilter(InSet(col("ca_county"),
+                        ("Williamson County", "Ziebach County",
+                         "Walker County")), j)
+    groups = ["cd_gender", "cd_marital_status", "cd_education_status",
+              "cd_purchase_estimate", "cd_credit_rating",
+              "cd_dep_count", "cd_dep_employed_count",
+              "cd_dep_college_count"]
+    agg = CpuAggregate([col(g) for g in groups],
+                       [Count(None).alias("cnt")], j)
+    out = CpuProject(
+        [col("cd_gender"), col("cd_marital_status"),
+         col("cd_education_status"), col("cnt").alias("cnt1"),
+         col("cd_purchase_estimate"), col("cnt").alias("cnt2"),
+         col("cd_credit_rating"), col("cnt").alias("cnt3"),
+         col("cd_dep_count"), col("cnt").alias("cnt4"),
+         col("cd_dep_employed_count"), col("cnt").alias("cnt5"),
+         col("cd_dep_college_count"), col("cnt").alias("cnt6")], agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col(g)) for g in ("cd_gender", "cd_marital_status",
+                               "cd_education_status",
+                               "cd_purchase_estimate",
+                               "cd_credit_rating", "cd_dep_count",
+                               "cd_dep_employed_count",
+                               "cd_dep_college_count")], out))
+
+
+def q35(t, run):
+    """Reference q35: per-state dependent-count stats for multi-channel
+    active customers."""
+    from spark_rapids_tpu.exprs.aggregates import Max, Min
+    extra = col("d_qoy") < lit(4)
+    j = _q10_35(t, 2002, extra, None, None, None)
+    groups = ["ca_state", "cd_gender", "cd_marital_status",
+              "cd_dep_count", "cd_dep_employed_count",
+              "cd_dep_college_count"]
+    agg = CpuAggregate(
+        [col(g) for g in groups],
+        [Count(None).alias("cnt1"),
+         Min(col("cd_dep_count")).alias("min_dep"),
+         Max(col("cd_dep_count")).alias("max_dep"),
+         Average(col("cd_dep_count")).alias("avg_dep"),
+         Min(col("cd_dep_employed_count")).alias("min_emp"),
+         Max(col("cd_dep_employed_count")).alias("max_emp"),
+         Average(col("cd_dep_employed_count")).alias("avg_emp"),
+         Min(col("cd_dep_college_count")).alias("min_col"),
+         Max(col("cd_dep_college_count")).alias("max_col"),
+         Average(col("cd_dep_college_count")).alias("avg_col")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col(g)) for g in groups], agg))
+
+
+def q85(t, run):
+    """Reference q85: web-return reasons under matched refunding/
+    returning demographics and address/profit bands."""
+    j = _join(t["web_sales"], t["web_returns"],
+              ["ws_item_sk", "ws_order_number"],
+              ["wr_item_sk", "wr_order_number"])
+    j = _join(j, t["web_page"], ["ws_web_page_sk"],
+              ["wp_web_page_sk"])
+    dd = CpuFilter(col("d_year") == lit(2000), t["date_dim"])
+    j = _join(j, dd, ["ws_sold_date_sk"], ["d_date_sk"])
+    cd1 = CpuProject(
+        [col("cd_demo_sk").alias("cd1_sk"),
+         col("cd_marital_status").alias("cd1_ms"),
+         col("cd_education_status").alias("cd1_es")],
+        t["customer_demographics"])
+    cd2 = CpuProject(
+        [col("cd_demo_sk").alias("cd2_sk"),
+         col("cd_marital_status").alias("cd2_ms"),
+         col("cd_education_status").alias("cd2_es")],
+        t["customer_demographics"])
+    j = _join(j, cd1, ["wr_refunded_cdemo_sk"], ["cd1_sk"])
+    j = _join(j, cd2, ["wr_returning_cdemo_sk"], ["cd2_sk"])
+    j = _join(j, t["customer_address"], ["wr_refunded_addr_sk"],
+              ["ca_address_sk"])
+    j = _join(j, t["reason"], ["wr_reason_sk"], ["r_reason_sk"])
+
+    def band(ms, es, lo, hi):
+        return ((col("cd1_ms") == lit(ms)) &
+                (col("cd1_ms") == col("cd2_ms")) &
+                (col("cd1_es") == lit(es)) &
+                (col("cd1_es") == col("cd2_es")) &
+                _between(col("ws_sales_price"), lit(lo), lit(hi)))
+    # reference price bands (100-150 etc.) stand in as wider bands
+    # the synthetic price range populates
+    demo = (band("M", "Advanced Degree", 50.0, 250.0) |
+            band("S", "College", 25.0, 250.0) |
+            band("W", "2 yr Degree", 50.0, 250.0))
+    # reference profit bands (100..200 etc.) stand in as the wider
+    # q48-style bands the synthetic profit range supports
+    addr = (
+        (col("ca_country") == lit("United States")) &
+        (InSet(col("ca_state"), ("TX", "NY")) &
+         _between(col("ws_net_profit"), lit(-500), lit(2000)) |
+         InSet(col("ca_state"), ("CA", "IL")) &
+         _between(col("ws_net_profit"), lit(-250), lit(3000)) |
+         InSet(col("ca_state"), ("WA", "GA")) &
+         _between(col("ws_net_profit"), lit(0), lit(25000))))
+    f = CpuFilter(demo & addr, j)
+    agg = CpuAggregate(
+        [col("r_reason_desc")],
+        [Average(col("ws_quantity")).alias("avg_qty"),
+         Average(col("wr_refunded_cash")).alias("avg_cash"),
+         Average(col("wr_fee")).alias("avg_fee")], f)
+    out = CpuProject(
+        [_Substring(col("r_reason_desc"), lit(1),
+                    lit(20)).alias("reason"),
+         col("avg_qty"), col("avg_cash"), col("avg_fee")], agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col("reason")), asc(col("avg_qty")), asc(col("avg_cash")),
+         asc(col("avg_fee"))], out))
+
+
+def q18(t, run):
+    """Reference q18: catalog averages over ROLLUP(i_item_id,
+    ca_country, ca_state, ca_county) for one demographic slice."""
+    cd1 = CpuProject(
+        [col("cd_demo_sk").alias("cd1_sk"),
+         col("cd_dep_count").alias("cd1_dep")],
+        CpuFilter((col("cd_gender") == lit("F")) &
+                  (col("cd_education_status") == lit("Unknown")),
+                  t["customer_demographics"]))
+    cd2 = CpuProject([col("cd_demo_sk").alias("cd2_sk")],
+                     t["customer_demographics"])
+    dd = CpuFilter(col("d_year") == lit(1998), t["date_dim"])
+    cust = CpuFilter(InSet(col("c_birth_month"), (1, 6, 8, 9, 12, 2)),
+                     t["customer"])
+    j = _join(dd, t["catalog_sales"], ["d_date_sk"],
+              ["cs_sold_date_sk"])
+    j = _join(j, cd1, ["cs_bill_cdemo_sk"], ["cd1_sk"])
+    j = _join(j, cust, ["cs_bill_customer_sk"], ["c_customer_sk"])
+    j = _join(j, cd2, ["c_current_cdemo_sk"], ["cd2_sk"])
+    # reference state list stands in as the generator state domain
+    ca = CpuFilter(InSet(col("ca_state"), ("TX", "NY", "CA")),
+                   t["customer_address"])
+    j = _join(j, ca, ["c_current_addr_sk"], ["ca_address_sk"])
+    j = _join(j, t["item"], ["cs_item_sk"], ["i_item_sk"])
+    pre = CpuProject(
+        [col("i_item_id"), col("ca_country"), col("ca_state"),
+         col("ca_county"), col("cs_quantity"), col("cs_list_price"),
+         col("cs_coupon_amt"), col("cs_sales_price"),
+         col("cs_net_profit"), col("c_birth_year"), col("cd1_dep")], j)
+    keys = ["i_item_id", "ca_country", "ca_state", "ca_county"]
+    ex = _rollup_expand(pre, keys,
+                        ["cs_quantity", "cs_list_price",
+                         "cs_coupon_amt", "cs_sales_price",
+                         "cs_net_profit", "c_birth_year", "cd1_dep"])
+    agg = CpuAggregate(
+        [col(k) for k in keys] + [col("gid")],
+        [Average(col("cs_quantity")).alias("agg1"),
+         Average(col("cs_list_price")).alias("agg2"),
+         Average(col("cs_coupon_amt")).alias("agg3"),
+         Average(col("cs_sales_price")).alias("agg4"),
+         Average(col("cs_net_profit")).alias("agg5"),
+         Average(col("c_birth_year")).alias("agg6"),
+         Average(col("cd1_dep")).alias("agg7")], ex)
+    out = CpuProject([col(k) for k in keys] +
+                     [col(f"agg{i}") for i in range(1, 8)], agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col("ca_country")), asc(col("ca_state")),
+         asc(col("ca_county")), asc(col("i_item_id"))], out))
+
+
+QUERIES.update({"q10": q10, "q35": q35, "q85": q85, "q18": q18})
+
+
+def _rollup_channel_tail(u):
+    """q5/q77/q80 shared tail: re-aggregate the unioned channel rows
+    over ROLLUP(channel, id)."""
+    ex = _rollup_expand(u, ["channel", "id"],
+                        ["sales", "returns", "profit"])
+    agg = CpuAggregate(
+        [col("channel"), col("id"), col("gid")],
+        [Sum(col("sales")).alias("sales"),
+         Sum(col("returns")).alias("returns"),
+         Sum(col("profit")).alias("profit")], ex)
+    out = CpuProject(
+        [col("channel"), col("id"), col("sales"), col("returns"),
+         col("profit")], agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col("channel")), asc(col("id"))], out))
+
+
+def q5(t, run):
+    """Reference q5: 14-day sales/returns/profit per channel entity
+    over ROLLUP(channel, id)."""
+    d_lo, d_hi = _date(2000, 8, 23), _date(2000, 9, 6)
+    dd = CpuFilter(_between(col("d_date"), d_lo, d_hi), t["date_dim"])
+
+    def union_sr(sales_proj, returns_proj):
+        return CpuUnion(sales_proj, returns_proj)
+
+    # store channel
+    ss = CpuProject(
+        [col("ss_store_sk").alias("entity_sk"),
+         col("ss_sold_date_sk").alias("date_sk"),
+         col("ss_ext_sales_price").alias("sales_price"),
+         col("ss_net_profit").alias("profit"),
+         lit(0.0).alias("return_amt"), lit(0.0).alias("net_loss")],
+        t["store_sales"])
+    sr = CpuProject(
+        [col("sr_store_sk").alias("entity_sk"),
+         col("sr_returned_date_sk").alias("date_sk"),
+         lit(0.0).alias("sales_price"), lit(0.0).alias("profit"),
+         col("sr_return_amt").alias("return_amt"),
+         col("sr_net_loss").alias("net_loss")], t["store_returns"])
+    ssr = _join(_join(union_sr(ss, sr), dd, ["date_sk"],
+                      ["d_date_sk"]),
+                t["store"], ["entity_sk"], ["s_store_sk"])
+    ssr = CpuAggregate(
+        [col("s_store_id")],
+        [Sum(col("sales_price")).alias("sales"),
+         Sum(col("profit")).alias("profit"),
+         Sum(col("return_amt")).alias("returns"),
+         Sum(col("net_loss")).alias("profit_loss")], ssr)
+    store_rows = CpuProject(
+        [lit("store channel").alias("channel"),
+         ConcatStrings((lit("store"), col("s_store_id"))).alias("id"),
+         col("sales"), col("returns"),
+         (col("profit") - col("profit_loss")).alias("profit")], ssr)
+
+    # catalog channel
+    cs = CpuProject(
+        [col("cs_catalog_page_sk").alias("page_sk"),
+         col("cs_sold_date_sk").alias("date_sk"),
+         col("cs_ext_sales_price").alias("sales_price"),
+         col("cs_net_profit").alias("profit"),
+         lit(0.0).alias("return_amt"), lit(0.0).alias("net_loss")],
+        t["catalog_sales"])
+    cr = CpuProject(
+        [col("cr_catalog_page_sk").alias("page_sk"),
+         col("cr_returned_date_sk").alias("date_sk"),
+         lit(0.0).alias("sales_price"), lit(0.0).alias("profit"),
+         col("cr_return_amount").alias("return_amt"),
+         col("cr_net_loss").alias("net_loss")], t["catalog_returns"])
+    csr = _join(_join(union_sr(cs, cr), dd, ["date_sk"],
+                      ["d_date_sk"]),
+                t["catalog_page"], ["page_sk"],
+                ["cp_catalog_page_sk"])
+    csr = CpuAggregate(
+        [col("cp_catalog_page_id")],
+        [Sum(col("sales_price")).alias("sales"),
+         Sum(col("profit")).alias("profit"),
+         Sum(col("return_amt")).alias("returns"),
+         Sum(col("net_loss")).alias("profit_loss")], csr)
+    catalog_rows = CpuProject(
+        [lit("catalog channel").alias("channel"),
+         ConcatStrings((lit("catalog_page"),
+                        col("cp_catalog_page_id"))).alias("id"),
+         col("sales"), col("returns"),
+         (col("profit") - col("profit_loss")).alias("profit")], csr)
+
+    # web channel (returns joined back to sales for the site key)
+    ws = CpuProject(
+        [col("ws_web_site_sk").alias("site_sk"),
+         col("ws_sold_date_sk").alias("date_sk"),
+         col("ws_ext_sales_price").alias("sales_price"),
+         col("ws_net_profit").alias("profit"),
+         lit(0.0).alias("return_amt"), lit(0.0).alias("net_loss")],
+        t["web_sales"])
+    wr_join = _join(t["web_returns"],
+                    CpuProject([col("ws_item_sk").alias("wi"),
+                                col("ws_order_number").alias("wo"),
+                                col("ws_web_site_sk")],
+                               t["web_sales"]),
+                    ["wr_item_sk", "wr_order_number"], ["wi", "wo"],
+                    jt=J.LEFT_OUTER)
+    wr = CpuProject(
+        [col("ws_web_site_sk").alias("site_sk"),
+         col("wr_returned_date_sk").alias("date_sk"),
+         lit(0.0).alias("sales_price"), lit(0.0).alias("profit"),
+         col("wr_return_amt").alias("return_amt"),
+         col("wr_net_loss").alias("net_loss")], wr_join)
+    wsr = _join(_join(union_sr(ws, wr), dd, ["date_sk"],
+                      ["d_date_sk"]),
+                t["web_site"], ["site_sk"], ["web_site_sk"])
+    wsr = CpuAggregate(
+        [col("web_site_id")],
+        [Sum(col("sales_price")).alias("sales"),
+         Sum(col("profit")).alias("profit"),
+         Sum(col("return_amt")).alias("returns"),
+         Sum(col("net_loss")).alias("profit_loss")], wsr)
+    web_rows = CpuProject(
+        [lit("web channel").alias("channel"),
+         ConcatStrings((lit("web_site"),
+                        col("web_site_id"))).alias("id"),
+         col("sales"), col("returns"),
+         (col("profit") - col("profit_loss")).alias("profit")], wsr)
+
+    return _rollup_channel_tail(
+        CpuUnion(store_rows, catalog_rows, web_rows))
+
+
+def q77(t, run):
+    """Reference q77: 30-day per-entity sales vs returns per channel,
+    rollup-totaled (catalog side cross-joined)."""
+    d_lo, d_hi = _date(2000, 8, 23), _date(2000, 9, 22)
+    dd = CpuFilter(_between(col("d_date"), d_lo, d_hi), t["date_dim"])
+
+    def agg_side(child, date_key, ent, sums):
+        j = _join(dd, child, ["d_date_sk"], [date_key])
+        return CpuAggregate(
+            [col(ent)],
+            [Sum(col(c)).alias(a) for c, a in sums], j)
+
+    ss = agg_side(t["store_sales"], "ss_sold_date_sk", "ss_store_sk",
+                  [("ss_ext_sales_price", "sales"),
+                   ("ss_net_profit", "profit")])
+    sr = CpuProject(
+        [col("sr_store_sk").alias("r_sk"), col("returns"),
+         col("profit_loss")],
+        agg_side(t["store_returns"], "sr_returned_date_sk",
+                 "sr_store_sk",
+                 [("sr_return_amt", "returns"),
+                  ("sr_net_loss", "profit_loss")]))
+    store_rows = CpuProject(
+        [lit("store channel").alias("channel"),
+         col("ss_store_sk").alias("id"), col("sales"),
+         Coalesce((col("returns"), lit(0.0))).alias("returns"),
+         (col("profit") - Coalesce((col("profit_loss"), lit(0.0)))
+          ).alias("profit")],
+        _join(ss, sr, ["ss_store_sk"], ["r_sk"], jt=J.LEFT_OUTER))
+
+    cs = CpuProject(
+        [col("cs_call_center_sk"), col("sales"), col("profit"),
+         lit(1).alias("_ck")],
+        agg_side(t["catalog_sales"], "cs_sold_date_sk",
+                 "cs_call_center_sk",
+                 [("cs_ext_sales_price", "sales"),
+                  ("cs_net_profit", "profit")]))
+    cr = CpuProject(
+        [col("returns"), col("profit_loss"), lit(1).alias("_rk")],
+        CpuAggregate(
+            [], [Sum(col("cr_return_amount")).alias("returns"),
+                 Sum(col("cr_net_loss")).alias("profit_loss")],
+            _join(dd, t["catalog_returns"], ["d_date_sk"],
+                  ["cr_returned_date_sk"])))
+    catalog_rows = CpuProject(
+        [lit("catalog channel").alias("channel"),
+         col("cs_call_center_sk").alias("id"), col("sales"),
+         col("returns"),
+         (col("profit") - col("profit_loss")).alias("profit")],
+        _join(cs, cr, ["_ck"], ["_rk"]))
+
+    ws = agg_side(
+        _join(t["web_sales"], t["web_page"], ["ws_web_page_sk"],
+              ["wp_web_page_sk"]),
+        "ws_sold_date_sk", "wp_web_page_sk",
+        [("ws_ext_sales_price", "sales"), ("ws_net_profit", "profit")])
+    wr = CpuProject(
+        [col("wp_web_page_sk").alias("r_sk"), col("returns"),
+         col("profit_loss")],
+        agg_side(
+            _join(t["web_returns"], t["web_page"], ["wr_web_page_sk"],
+                  ["wp_web_page_sk"]),
+            "wr_returned_date_sk", "wp_web_page_sk",
+            [("wr_return_amt", "returns"),
+             ("wr_net_loss", "profit_loss")]))
+    web_rows = CpuProject(
+        [lit("web channel").alias("channel"),
+         col("wp_web_page_sk").alias("id"), col("sales"),
+         Coalesce((col("returns"), lit(0.0))).alias("returns"),
+         (col("profit") - Coalesce((col("profit_loss"), lit(0.0)))
+          ).alias("profit")],
+        _join(ws, wr, ["wp_web_page_sk"], ["r_sk"], jt=J.LEFT_OUTER))
+
+    # ids are entity sks (ints): normalize to strings for the shared
+    # rollup tail like the reference's concat'd ids
+    def str_id(rows):
+        from spark_rapids_tpu.exprs.cast import Cast
+        return CpuProject(
+            [col("channel"),
+             Cast(col("id"), _T.INT64).alias("id_int"), col("sales"),
+             col("returns"), col("profit")], rows)
+    u = CpuUnion(str_id(store_rows), str_id(catalog_rows),
+                 str_id(web_rows))
+    ex = _rollup_expand(u, ["channel", "id_int"],
+                        ["sales", "returns", "profit"])
+    agg = CpuAggregate(
+        [col("channel"), col("id_int"), col("gid")],
+        [Sum(col("sales")).alias("sales"),
+         Sum(col("returns")).alias("returns"),
+         Sum(col("profit")).alias("profit")], ex)
+    out = CpuProject(
+        [col("channel"), col("id_int").alias("id"), col("sales"),
+         col("returns"), col("profit")], agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col("channel")), asc(col("id"))], out))
+
+
+def q80(t, run):
+    """Reference q80: 30-day promo'd high-price item sales net of
+    returns per channel entity, rollup-totaled."""
+    d_lo, d_hi = _date(2000, 8, 23), _date(2000, 9, 22)
+    dd = CpuFilter(_between(col("d_date"), d_lo, d_hi), t["date_dim"])
+    it = CpuFilter(col("i_current_price") > lit(50.0), t["item"])
+    pr = CpuFilter(col("p_channel_tv") == lit("N"), t["promotion"])
+
+    def channel(sales, returns, skeys, rkeys, date_key, item_key,
+                promo_key, ent_join, ent_id, price, profit, ramt,
+                rloss, chan, prefix):
+        j = _join(t[sales], t[returns], skeys, rkeys, jt=J.LEFT_OUTER)
+        j = _join(j, dd, [date_key], ["d_date_sk"])
+        j = _join(j, it, [item_key], ["i_item_sk"])
+        j = _join(j, pr, [promo_key], ["p_promo_sk"])
+        right, lk, rk = ent_join
+        j = _join(j, right, lk, rk)
+        agg = CpuAggregate(
+            [col(ent_id)],
+            [Sum(col(price)).alias("sales"),
+             Sum(Coalesce((col(ramt), lit(0.0)))).alias("returns"),
+             Sum(col(profit) - Coalesce((col(rloss), lit(0.0)))
+                 ).alias("profit")], j)
+        return CpuProject(
+            [lit(chan).alias("channel"),
+             ConcatStrings((lit(prefix), col(ent_id))).alias("id"),
+             col("sales"), col("returns"), col("profit")], agg)
+
+    store_rows = channel(
+        "store_sales", "store_returns",
+        ["ss_item_sk", "ss_ticket_number"],
+        ["sr_item_sk", "sr_ticket_number"],
+        "ss_sold_date_sk", "ss_item_sk", "ss_promo_sk",
+        (t["store"], ["ss_store_sk"], ["s_store_sk"]), "s_store_id",
+        "ss_ext_sales_price", "ss_net_profit", "sr_return_amt",
+        "sr_net_loss", "store channel", "store")
+    catalog_rows = channel(
+        "catalog_sales", "catalog_returns",
+        ["cs_item_sk", "cs_order_number"],
+        ["cr_item_sk", "cr_order_number"],
+        "cs_sold_date_sk", "cs_item_sk", "cs_promo_sk",
+        (t["catalog_page"], ["cs_catalog_page_sk"],
+         ["cp_catalog_page_sk"]), "cp_catalog_page_id",
+        "cs_ext_sales_price", "cs_net_profit", "cr_return_amount",
+        "cr_net_loss", "catalog channel", "catalog_page")
+    web_rows = channel(
+        "web_sales", "web_returns",
+        ["ws_item_sk", "ws_order_number"],
+        ["wr_item_sk", "wr_order_number"],
+        "ws_sold_date_sk", "ws_item_sk", "ws_promo_sk",
+        (t["web_site"], ["ws_web_site_sk"], ["web_site_sk"]),
+        "web_site_id",
+        "ws_ext_sales_price", "ws_net_profit", "wr_return_amt",
+        "wr_net_loss", "web channel", "web_site")
+    return _rollup_channel_tail(
+        CpuUnion(store_rows, catalog_rows, web_rows))
+
+
+QUERIES.update({"q5": q5, "q77": q77, "q80": q80})
+
+
+def _q56_60_channel(t, sales, date_key, addr_key, item_key, val,
+                    item_pred, year, moy):
+    """q56/q60 channel CTE: per-item-id revenue for one month/offset,
+    item-id semi-joined against a predicate item set."""
+    ids = CpuProject(
+        [col("sel_id")],
+        CpuAggregate([col("i_item_id").alias("sel_id")],
+                     [Count(None).alias("_c")],
+                     CpuFilter(item_pred, t["item"])))
+    it = _join(t["item"], ids, ["i_item_id"], ["sel_id"],
+               jt=J.LEFT_SEMI)
+    dd = CpuFilter((col("d_year") == lit(year)) &
+                   (col("d_moy") == lit(moy)), t["date_dim"])
+    ca = CpuFilter(col("ca_gmt_offset") == lit(-5.0),
+                   t["customer_address"])
+    j = _join(_join(_join(dd, sales, ["d_date_sk"], [date_key]),
+                    ca, [addr_key], ["ca_address_sk"]),
+              it, [item_key], ["i_item_sk"])
+    return CpuAggregate([col("i_item_id")],
+                        [Sum(col(val)).alias("total_sales")], j)
+
+
+def q56(t, run):
+    """Reference q56: revenue of chosen-color items by channel for one
+    month, union re-aggregated."""
+    pred = InSet(col("i_color"), ("slate", "powder", "khaki"))
+    ss = _q56_60_channel(t, t["store_sales"], "ss_sold_date_sk",
+                         "ss_addr_sk", "ss_item_sk",
+                         "ss_ext_sales_price", pred, 2001, 2)
+    cs = _q56_60_channel(t, t["catalog_sales"], "cs_sold_date_sk",
+                         "cs_bill_addr_sk", "cs_item_sk",
+                         "cs_ext_sales_price", pred, 2001, 2)
+    ws = _q56_60_channel(t, t["web_sales"], "ws_sold_date_sk",
+                         "ws_bill_addr_sk", "ws_item_sk",
+                         "ws_ext_sales_price", pred, 2001, 2)
+    agg = CpuAggregate([col("i_item_id")],
+                       [Sum(col("total_sales")).alias("total_sales")],
+                       CpuUnion(ss, cs, ws))
+    return CpuLimit(100, CpuSort([asc(col("total_sales"))], agg))
+
+
+def q60(t, run):
+    """Reference q60: q56's shape for one category in 1998-09."""
+    pred = InSet(col("i_category"), ("Music",))
+    ss = _q56_60_channel(t, t["store_sales"], "ss_sold_date_sk",
+                         "ss_addr_sk", "ss_item_sk",
+                         "ss_ext_sales_price", pred, 1998, 9)
+    cs = _q56_60_channel(t, t["catalog_sales"], "cs_sold_date_sk",
+                         "cs_bill_addr_sk", "cs_item_sk",
+                         "cs_ext_sales_price", pred, 1998, 9)
+    ws = _q56_60_channel(t, t["web_sales"], "ws_sold_date_sk",
+                         "ws_bill_addr_sk", "ws_item_sk",
+                         "ws_ext_sales_price", pred, 1998, 9)
+    agg = CpuAggregate([col("i_item_id")],
+                       [Sum(col("total_sales")).alias("total_sales")],
+                       CpuUnion(ss, cs, ws))
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_item_id")), asc(col("total_sales"))], agg))
+
+
+def q58(t, run):
+    """Reference q58: items with near-equal revenue in all three
+    channels for one chosen week."""
+    wk = CpuProject(
+        [col("d_week_seq").alias("sel_wk")],
+        CpuAggregate(
+            [col("d_week_seq")], [Count(None).alias("_c")],
+            CpuFilter(col("d_date") == _date(2000, 6, 30),
+                      t["date_dim"])))
+    dates = CpuProject(
+        [col("d_date_sk")],
+        _join(t["date_dim"], wk, ["d_week_seq"], ["sel_wk"],
+              jt=J.LEFT_SEMI))
+
+    def rev(sales, date_key, item_key, val, alias):
+        j = _join(_join(dates, sales, ["d_date_sk"], [date_key]),
+                  t["item"], [item_key], ["i_item_sk"])
+        return CpuAggregate([col("i_item_id")],
+                            [Sum(col(val)).alias(alias)], j)
+
+    ss = rev(t["store_sales"], "ss_sold_date_sk", "ss_item_sk",
+             "ss_ext_sales_price", "ss_item_rev")
+    cs = CpuProject(
+        [col("i_item_id").alias("cs_id"), col("cs_item_rev")],
+        rev(t["catalog_sales"], "cs_sold_date_sk", "cs_item_sk",
+            "cs_ext_sales_price", "cs_item_rev"))
+    ws = CpuProject(
+        [col("i_item_id").alias("ws_id"), col("ws_item_rev")],
+        rev(t["web_sales"], "ws_sold_date_sk", "ws_item_sk",
+            "ws_ext_sales_price", "ws_item_rev"))
+    j = _join(_join(ss, cs, ["i_item_id"], ["cs_id"]),
+              ws, ["i_item_id"], ["ws_id"])
+    # reference band is 0.9..1.1; the synthetic weekly sums (and the
+    # 2:1:0.5 channel volume ratio) need a wider stand-in band
+    lo, hi = lit(0.02), lit(50.0)
+
+    def near(a, b):
+        return _between(col(a), lo * col(b), hi * col(b))
+    f = CpuFilter(
+        near("ss_item_rev", "cs_item_rev") &
+        near("ss_item_rev", "ws_item_rev") &
+        near("cs_item_rev", "ss_item_rev") &
+        near("cs_item_rev", "ws_item_rev") &
+        near("ws_item_rev", "ss_item_rev") &
+        near("ws_item_rev", "cs_item_rev"), j)
+    total = (col("ss_item_rev") + col("cs_item_rev") +
+             col("ws_item_rev"))
+    out = CpuProject(
+        [col("i_item_id").alias("item_id"), col("ss_item_rev"),
+         (col("ss_item_rev") / total / lit(3.0) *
+          lit(100.0)).alias("ss_dev"),
+         col("cs_item_rev"),
+         (col("cs_item_rev") / total / lit(3.0) *
+          lit(100.0)).alias("cs_dev"),
+         col("ws_item_rev"),
+         (col("ws_item_rev") / total / lit(3.0) *
+          lit(100.0)).alias("ws_dev"),
+         (total / lit(3.0)).alias("average")], f)
+    return CpuLimit(100, CpuSort(
+        [asc(col("item_id")), asc(col("ss_item_rev"))], out))
+
+
+def q54(t, run):
+    """Reference q54: revenue segments of customers who bought the
+    target class cross-channel, then shopped locally next quarter."""
+    from spark_rapids_tpu.exprs.cast import Cast
+    u = CpuUnion(
+        CpuProject([col("cs_sold_date_sk").alias("sold_date_sk"),
+                    col("cs_bill_customer_sk").alias("customer_sk"),
+                    col("cs_item_sk").alias("item_sk")],
+                   t["catalog_sales"]),
+        CpuProject([col("ws_sold_date_sk").alias("sold_date_sk"),
+                    col("ws_bill_customer_sk").alias("customer_sk"),
+                    col("ws_item_sk").alias("item_sk")],
+                   t["web_sales"]))
+    # reference Women/maternity stands in as the generator class pool
+    it = CpuFilter((col("i_category") == lit("Women")) &
+                   InSet(col("i_class"), ("class00", "class01",
+                                          "class02", "class03")),
+                   t["item"])
+    dd = CpuFilter((col("d_moy") == lit(12)) &
+                   (col("d_year") == lit(1998)), t["date_dim"])
+    j = _join(_join(_join(u, dd, ["sold_date_sk"], ["d_date_sk"]),
+                    it, ["item_sk"], ["i_item_sk"]),
+              t["customer"], ["customer_sk"], ["c_customer_sk"])
+    my_customers = CpuProject(
+        [col("c_customer_sk"), col("c_current_addr_sk")],
+        CpuAggregate([col("c_customer_sk"),
+                      col("c_current_addr_sk")],
+                     [Count(None).alias("_n")], j))
+    # month-seq window (1998-12 month_seq = 11): +1 .. +3
+    seq = CpuProject(
+        [(col("d_month_seq") + lit(1)).alias("lo_seq"),
+         (col("d_month_seq") + lit(3)).alias("hi_seq"),
+         lit(1).alias("_sk")],
+        CpuAggregate(
+            [col("d_month_seq")], [Count(None).alias("_c")],
+            CpuFilter((col("d_year") == lit(1998)) &
+                      (col("d_moy") == lit(12)), t["date_dim"])))
+    dd2 = CpuFilter(
+        _between(col("d_month_seq"), col("lo_seq"), col("hi_seq")),
+        _join(CpuProject([col("d_date_sk"), col("d_month_seq"),
+                          lit(1).alias("_dk")], t["date_dim"]),
+              seq, ["_dk"], ["_sk"]))
+    dd2 = CpuProject([col("d_date_sk")], dd2)
+    j2 = _join(my_customers, t["store_sales"],
+               ["c_customer_sk"], ["ss_customer_sk"])
+    j2 = _join(j2, t["customer_address"], ["c_current_addr_sk"],
+               ["ca_address_sk"])
+    j2 = _join(j2, t["store"], ["ca_county", "ca_state"],
+               ["s_county", "s_state"])
+    j2 = _join(j2, dd2, ["ss_sold_date_sk"], ["d_date_sk"],
+               jt=J.LEFT_SEMI)
+    my_revenue = CpuAggregate(
+        [col("c_customer_sk")],
+        [Sum(col("ss_ext_sales_price")).alias("revenue")], j2)
+    segments = CpuProject(
+        [Cast(col("revenue") / lit(50.0), _T.INT64).alias("segment")],
+        my_revenue)
+    agg = CpuAggregate([col("segment")],
+                       [Count(None).alias("num_customers")], segments)
+    out = CpuProject(
+        [col("segment"), col("num_customers"),
+         (col("segment") * lit(50)).alias("segment_base")], agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col("segment")), asc(col("num_customers"))], out))
+
+
+QUERIES.update({"q56": q56, "q60": q60, "q58": q58, "q54": q54})
+
+
+from spark_rapids_tpu.exprs.aggregates import StddevSamp as _Stddev
+
+
+def _q17_29_chain(t, d1_pred, d2_pred, d3_pred):
+    """q17/q29 shared join chain: store sale -> its return -> the same
+    customer's catalog purchase, each against its own date window."""
+    d1 = CpuFilter(d1_pred, t["date_dim"])
+    d2 = CpuProject([col("d_date_sk").alias("d2_sk")],
+                    CpuFilter(d2_pred, t["date_dim"]))
+    d3 = CpuProject([col("d_date_sk").alias("d3_sk")],
+                    CpuFilter(d3_pred, t["date_dim"]))
+    ss = _join(d1, t["store_sales"], ["d_date_sk"], ["ss_sold_date_sk"])
+    sr = _join(d2, t["store_returns"], ["d2_sk"],
+               ["sr_returned_date_sk"])
+    cs = _join(d3, t["catalog_sales"], ["d3_sk"], ["cs_sold_date_sk"])
+    j = _join(ss, sr,
+              ["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
+              ["sr_customer_sk", "sr_item_sk", "sr_ticket_number"])
+    j = _join(j, cs, ["sr_customer_sk", "sr_item_sk"],
+              ["cs_bill_customer_sk", "cs_item_sk"])
+    j = _join(j, t["store"], ["ss_store_sk"], ["s_store_sk"])
+    return _join(j, t["item"], ["ss_item_sk"], ["i_item_sk"])
+
+
+def q17(t, run):
+    """Reference q17: quantity count/mean/stddev/cov per item+state for
+    sale -> return -> repurchase chains inside one quarter window."""
+    # reference sale window is 2001Q1; the full year stands in for
+    # the sparse synthetic sale->return->repurchase chains
+    j = _q17_29_chain(
+        t,
+        col("d_year") == lit(2001),
+        col("d_year") == lit(2001),
+        col("d_year") == lit(2001))
+    agg = CpuAggregate(
+        [col("i_item_id"), col("i_item_desc"), col("s_state")],
+        [Count(col("ss_quantity")).alias("store_sales_quantitycount"),
+         Average(col("ss_quantity")).alias("store_sales_quantityave"),
+         _Stddev(col("ss_quantity")).alias("store_sales_quantitystdev"),
+         Count(col("sr_return_quantity")
+               ).alias("store_returns_quantitycount"),
+         Average(col("sr_return_quantity")
+                 ).alias("store_returns_quantityave"),
+         _Stddev(col("sr_return_quantity")
+                 ).alias("store_returns_quantitystdev"),
+         Count(col("cs_quantity")).alias("catalog_sales_quantitycount"),
+         Average(col("cs_quantity")).alias("catalog_sales_quantityave"),
+         _Stddev(col("cs_quantity")
+                 ).alias("catalog_sales_quantitystdev")], j)
+    out = CpuProject(
+        [col("i_item_id"), col("i_item_desc"), col("s_state"),
+         col("store_sales_quantitycount"),
+         col("store_sales_quantityave"),
+         col("store_sales_quantitystdev"),
+         (col("store_sales_quantitystdev") /
+          col("store_sales_quantityave")).alias("store_sales_cov"),
+         col("store_returns_quantitycount"),
+         col("store_returns_quantityave"),
+         col("store_returns_quantitystdev"),
+         (col("store_returns_quantitystdev") /
+          col("store_returns_quantityave")).alias("store_returns_cov"),
+         col("catalog_sales_quantitycount"),
+         col("catalog_sales_quantityave"),
+         (col("catalog_sales_quantitystdev") /
+          col("catalog_sales_quantityave")).alias("catalog_sales_cov")],
+        agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_item_id")), asc(col("i_item_desc")),
+         asc(col("s_state"))], out))
+
+
+def q29(t, run):
+    """Reference q29: quantity totals for sale -> return -> repurchase
+    chains across widening date windows."""
+    # reference sale window is 1999-09; the full year stands in for
+    # the sparse synthetic sale->return->repurchase chains
+    j = _q17_29_chain(
+        t,
+        col("d_year") == lit(1999),
+        _between(col("d_moy"), lit(1), lit(12)) &
+        (col("d_year") == lit(1999)),
+        InSet(col("d_year"), (1999, 2000, 2001)))
+    agg = CpuAggregate(
+        [col("i_item_id"), col("i_item_desc"), col("s_store_id"),
+         col("s_store_name")],
+        [Sum(col("ss_quantity")).alias("store_sales_quantity"),
+         Sum(col("sr_return_quantity")).alias("store_returns_quantity"),
+         Sum(col("cs_quantity")).alias("catalog_sales_quantity")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("i_item_id")), asc(col("i_item_desc")),
+         asc(col("s_store_id")), asc(col("s_store_name"))], agg))
+
+
+def _q39_inv(t):
+    """q39 inv CTE: per warehouse/item/month inventory stdev & mean,
+    kept when cov exceeds the (stand-in) threshold."""
+    dd = CpuFilter(col("d_year") == lit(2001), t["date_dim"])
+    j = _join(_join(_join(
+        dd, t["inventory"], ["d_date_sk"], ["inv_date_sk"]),
+        t["item"], ["inv_item_sk"], ["i_item_sk"]),
+        t["warehouse"], ["inv_warehouse_sk"], ["w_warehouse_sk"])
+    agg = CpuAggregate(
+        [col("w_warehouse_name"), col("w_warehouse_sk"),
+         col("i_item_sk"), col("d_moy")],
+        [_Stddev(col("inv_quantity_on_hand")).alias("stdev"),
+         Average(col("inv_quantity_on_hand")).alias("mean")], j)
+    cov = If(col("mean") == lit(0.0), _Lit(None, _T.FLOAT64),
+             col("stdev") / col("mean"))
+    # reference cov > 1 can't fire on uniform synthetic quantities
+    # (cov ~= 0.58); 0.5 stands in
+    keep = If(col("mean") == lit(0.0), lit(0.0),
+              col("stdev") / col("mean")) > lit(0.5)
+    return CpuProject(
+        [col("w_warehouse_sk"), col("i_item_sk"), col("d_moy"),
+         col("mean"), cov.alias("cov")],
+        CpuFilter(keep, agg))
+
+
+def _q39_tail(t, extra_cov):
+    inv = _q39_inv(t)
+    inv1 = CpuFilter(col("d_moy") == lit(1), inv)
+    if extra_cov:
+        inv1 = CpuFilter(col("cov") > lit(0.52), inv1)
+    inv1 = CpuProject(
+        [col("w_warehouse_sk").alias("inv1_w"),
+         col("i_item_sk").alias("inv1_i"),
+         col("d_moy").alias("inv1_d_moy"),
+         col("mean").alias("inv1_mean"), col("cov").alias("inv1_cov")],
+        inv1)
+    inv2 = CpuFilter(col("d_moy") == lit(2), inv)
+    if extra_cov:
+        inv2 = CpuFilter(col("cov") > lit(0.52), inv2)
+    inv2 = CpuProject(
+        [col("w_warehouse_sk").alias("inv2_w"),
+         col("i_item_sk").alias("inv2_i"),
+         col("d_moy").alias("inv2_d_moy"),
+         col("mean").alias("inv2_mean"), col("cov").alias("inv2_cov")],
+        inv2)
+    j = _join(inv1, inv2, ["inv1_i", "inv1_w"], ["inv2_i", "inv2_w"])
+    return CpuSort(
+        [asc(col("inv1_w")), asc(col("inv1_i")),
+         asc(col("inv1_d_moy")), asc(col("inv1_mean")),
+         asc(col("inv1_cov")), asc(col("inv2_d_moy")),
+         asc(col("inv2_mean")), asc(col("inv2_cov"))], j)
+
+
+def q39(t, run):
+    """Reference q39a: volatile-inventory item/warehouse pairs across
+    consecutive months."""
+    return _q39_tail(t, extra_cov=False)
+
+
+def q39b(t, run):
+    """Reference q39b: q39a restricted to the higher-cov slice."""
+    return _q39_tail(t, extra_cov=True)
+
+
+QUERIES.update({"q17": q17, "q29": q29, "q39": q39, "q39b": q39b})
+
+
+def q95(t, run):
+    """Reference q95: shipped web orders split across warehouses AND
+    returned, with count-distinct order stats."""
+    ws1k = CpuProject(
+        [col("ws_order_number").alias("o1"),
+         col("ws_warehouse_sk").alias("w1")], t["web_sales"])
+    ws2k = CpuProject(
+        [col("ws_order_number").alias("o2"),
+         col("ws_warehouse_sk").alias("w2")], t["web_sales"])
+    ws_wh = CpuFilter(col("w1") != col("w2"),
+                      _join(ws1k, ws2k, ["o1"], ["o2"]))
+    ws_wh = CpuProject(
+        [col("o1")],
+        CpuAggregate([col("o1")], [Count(None).alias("_n")], ws_wh))
+    ret = _join(CpuProject([col("wr_order_number").alias("ro")],
+                           t["web_returns"]),
+                ws_wh, ["ro"], ["o1"], jt=J.LEFT_SEMI)
+    ret = CpuProject(
+        [col("ro")],
+        CpuAggregate([col("ro")], [Count(None).alias("_n")], ret))
+    dd = CpuFilter(_between(col("d_date"), _date(1999, 2, 1),
+                            _date(1999, 4, 2)), t["date_dim"])
+    ca = CpuFilter(col("ca_state") == lit("IL"),
+                   t["customer_address"])
+    web = CpuFilter(col("web_company_name") == lit("pri"),
+                    t["web_site"])
+    j = _join(dd, t["web_sales"], ["d_date_sk"], ["ws_ship_date_sk"])
+    j = _join(j, ca, ["ws_ship_addr_sk"], ["ca_address_sk"])
+    j = _join(j, web, ["ws_web_site_sk"], ["web_site_sk"])
+    j = _join(j, ws_wh, ["ws_order_number"], ["o1"], jt=J.LEFT_SEMI)
+    j = _join(j, ret, ["ws_order_number"], ["ro"], jt=J.LEFT_SEMI)
+    sums = CpuAggregate(
+        [], [Sum(col("ws_ext_ship_cost")).alias("total_ship_cost"),
+             Sum(col("ws_net_profit")).alias("total_net_profit")],
+        j)
+    dist = CpuAggregate(
+        [], [Count(None).alias("order_count")],
+        CpuAggregate([col("ws_order_number")],
+                     [Count(None).alias("_d")], j))
+    both = _join(CpuProject([lit(1).alias("_ka"), col("order_count")],
+                            dist),
+                 CpuProject([lit(1).alias("_kb"),
+                             col("total_ship_cost"),
+                             col("total_net_profit")], sums),
+                 ["_ka"], ["_kb"])
+    return CpuLimit(100, CpuProject(
+        [col("order_count"), col("total_ship_cost"),
+         col("total_net_profit")], both))
+
+
+def q72(t, run):
+    """Reference q72: catalog orders that outstripped same-week
+    inventory for one demographic, promo vs no-promo."""
+    d1 = CpuProject([col("d_date_sk").alias("d1_sk"),
+                     col("d_week_seq").alias("d1_wk"),
+                     col("d_date").alias("d1_date")],
+                    CpuFilter(col("d_year") == lit(1999),
+                              t["date_dim"]))
+    d2 = CpuProject([col("d_date_sk").alias("d2_sk"),
+                     col("d_week_seq").alias("d2_wk")], t["date_dim"])
+    d3 = CpuProject([col("d_date_sk").alias("d3_sk"),
+                     col("d_date").alias("d3_date")], t["date_dim"])
+    cd = CpuFilter(col("cd_marital_status") == lit("D"),
+                   t["customer_demographics"])
+    hd = CpuFilter(col("hd_buy_potential") == lit(">10000"),
+                   t["household_demographics"])
+    j = _join(t["catalog_sales"], t["inventory"],
+              ["cs_item_sk"], ["inv_item_sk"])
+    j = _join(j, t["warehouse"], ["inv_warehouse_sk"],
+              ["w_warehouse_sk"])
+    j = _join(j, t["item"], ["cs_item_sk"], ["i_item_sk"])
+    j = _join(j, cd, ["cs_bill_cdemo_sk"], ["cd_demo_sk"])
+    j = _join(j, hd, ["cs_bill_hdemo_sk"], ["hd_demo_sk"])
+    j = _join(j, d1, ["cs_sold_date_sk"], ["d1_sk"])
+    j = _join(j, d2, ["inv_date_sk"], ["d2_sk"])
+    j = _join(j, d3, ["cs_ship_date_sk"], ["d3_sk"])
+    pr = CpuProject([col("p_promo_sk")], t["promotion"])
+    j = _join(j, pr, ["cs_promo_sk"], ["p_promo_sk"], jt=J.LEFT_OUTER)
+    cr = CpuProject([col("cr_item_sk").alias("cri"),
+                     col("cr_order_number").alias("cro")],
+                    t["catalog_returns"])
+    j = _join(j, cr, ["cs_item_sk", "cs_order_number"],
+              ["cri", "cro"], jt=J.LEFT_OUTER)
+    from spark_rapids_tpu.exprs.cast import Cast as _Cast
+    f = CpuFilter(
+        (col("d1_wk") == col("d2_wk")) &
+        (col("inv_quantity_on_hand") < col("cs_quantity")) &
+        (_Cast(col("d3_date"), _T.INT32) >
+         _Cast(col("d1_date"), _T.INT32) + lit(5)), j)
+    agg = CpuAggregate(
+        [col("i_item_desc"), col("w_warehouse_name"), col("d1_wk")],
+        [Sum(If(IsNull(col("p_promo_sk")), lit(1),
+                lit(0))).alias("no_promo"),
+         Sum(If(IsNotNull(col("p_promo_sk")), lit(1),
+                lit(0))).alias("promo"),
+         Count(None).alias("total_cnt")], f)
+    out = CpuProject(
+        [col("i_item_desc"), col("w_warehouse_name"),
+         col("d1_wk").alias("d_week_seq"), col("no_promo"),
+         col("promo"), col("total_cnt")], agg)
+    return CpuLimit(100, CpuSort(
+        [desc(col("total_cnt")), asc(col("i_item_desc")),
+         asc(col("w_warehouse_name")), asc(col("d_week_seq"))], out))
+
+
+def _q66_channel(t, sales, date_key, time_key, ship_key, wh_key,
+                 price, net):
+    dd = CpuFilter(col("d_year") == lit(2001), t["date_dim"])
+    td = CpuFilter(_between(col("t_time"), lit(30838),
+                            lit(30838 + 28800)), t["time_dim"])
+    # reference carriers DHL/BARIAN stand in as DHL/USPS
+    sm = CpuFilter(InSet(col("sm_carrier"), ("DHL", "USPS")),
+                   t["ship_mode"])
+    j = _join(_join(_join(_join(
+        dd, sales, ["d_date_sk"], [date_key]),
+        td, [time_key], ["t_time_sk"]),
+        sm, [ship_key], ["sm_ship_mode_sk"]),
+        t["warehouse"], [wh_key], ["w_warehouse_sk"])
+
+    months = ["jan", "feb", "mar", "apr", "may", "jun", "jul", "aug",
+              "sep", "oct", "nov", "dec"]
+    aggs = []
+    qty = {"ws_ext_sales_price": "ws_quantity",
+           "ws_net_paid": "ws_quantity",
+           "cs_ext_sales_price": "cs_quantity",
+           "cs_net_paid": "cs_quantity"}
+    for i, m in enumerate(months, start=1):
+        aggs.append(Sum(If(col("d_moy") == lit(i),
+                           col(price) * col(qty[price]),
+                           lit(0.0))).alias(f"{m}_sales"))
+    for i, m in enumerate(months, start=1):
+        aggs.append(Sum(If(col("d_moy") == lit(i),
+                           col(net) * col(qty[net]),
+                           lit(0.0))).alias(f"{m}_net"))
+    agg = CpuAggregate(
+        [col("w_warehouse_name"), col("w_warehouse_sq_ft"),
+         col("w_city"), col("w_county"), col("w_state"),
+         col("w_country"), col("d_year")], aggs, j)
+    return CpuProject(
+        [col("w_warehouse_name"), col("w_warehouse_sq_ft"),
+         col("w_city"), col("w_county"), col("w_state"),
+         col("w_country"),
+         lit("DHL,USPS").alias("ship_carriers"),
+         col("d_year").alias("year")] +
+        [col(f"{m}_sales") for m in months] +
+        [col(f"{m}_net") for m in months], agg)
+
+
+def q66(t, run):
+    """Reference q66: warehouse monthly sales/net pivot across web and
+    catalog channels for chosen carriers and a time-of-day band."""
+    ws = _q66_channel(t, t["web_sales"], "ws_sold_date_sk",
+                      "ws_sold_time_sk", "ws_ship_mode_sk",
+                      "ws_warehouse_sk", "ws_ext_sales_price",
+                      "ws_net_paid")
+    cs = _q66_channel(t, t["catalog_sales"], "cs_sold_date_sk",
+                      "cs_sold_time_sk", "cs_ship_mode_sk",
+                      "cs_warehouse_sk", "cs_ext_sales_price",
+                      "cs_net_paid")
+    u = CpuUnion(ws, cs)
+    months = ["jan", "feb", "mar", "apr", "may", "jun", "jul", "aug",
+              "sep", "oct", "nov", "dec"]
+    aggs = ([Sum(col(f"{m}_sales")).alias(f"{m}_sales")
+             for m in months] +
+            [Sum(col(f"{m}_sales") /
+                 col("w_warehouse_sq_ft")).alias(f"{m}_sales_per_sqft")
+             for m in months] +
+            [Sum(col(f"{m}_net")).alias(f"{m}_net") for m in months])
+    agg = CpuAggregate(
+        [col("w_warehouse_name"), col("w_warehouse_sq_ft"),
+         col("w_city"), col("w_county"), col("w_state"),
+         col("w_country"), col("ship_carriers"), col("year")],
+        aggs, u)
+    return CpuLimit(100, CpuSort([asc(col("w_warehouse_name"))], agg))
+
+
+QUERIES.update({"q95": q95, "q72": q72, "q66": q66})
+
+
+def q75(t, run):
+    """Reference q75: net-of-returns category sales by item attrs,
+    year-over-year decline (UNION distinct across channels)."""
+    def detail(sales, returns, item_key, date_key, skeys, rkeys, qty,
+               rqty, amt, ramt):
+        it = CpuFilter(col("i_category") == lit("Books"), t["item"])
+        j = _join(sales, it, [item_key], ["i_item_sk"])
+        j = _join(j, t["date_dim"], [date_key], ["d_date_sk"])
+        j = _join(j, returns, skeys, rkeys, jt=J.LEFT_OUTER)
+        return CpuProject(
+            [col("d_year"), col("i_brand_id"), col("i_class_id"),
+             col("i_category_id"), col("i_manufact_id"),
+             (col(qty) - Coalesce((col(rqty), lit(0)))
+              ).alias("sales_cnt"),
+             (col(amt) - Coalesce((col(ramt), lit(0.0)))
+              ).alias("sales_amt")], j)
+
+    u = CpuUnion(
+        detail(t["catalog_sales"], t["catalog_returns"], "cs_item_sk",
+               "cs_sold_date_sk",
+               ["cs_order_number", "cs_item_sk"],
+               ["cr_order_number", "cr_item_sk"],
+               "cs_quantity", "cr_return_quantity",
+               "cs_ext_sales_price", "cr_return_amount"),
+        detail(t["store_sales"], t["store_returns"], "ss_item_sk",
+               "ss_sold_date_sk",
+               ["ss_ticket_number", "ss_item_sk"],
+               ["sr_ticket_number", "sr_item_sk"],
+               "ss_quantity", "sr_return_quantity",
+               "ss_ext_sales_price", "sr_return_amt"),
+        detail(t["web_sales"], t["web_returns"], "ws_item_sk",
+               "ws_sold_date_sk",
+               ["ws_order_number", "ws_item_sk"],
+               ["wr_order_number", "wr_item_sk"],
+               "ws_quantity", "wr_return_quantity",
+               "ws_ext_sales_price", "wr_return_amt"))
+    # UNION distinct: dedup the detail rows before re-aggregation
+    cols = ["d_year", "i_brand_id", "i_class_id", "i_category_id",
+            "i_manufact_id", "sales_cnt", "sales_amt"]
+    dedup = CpuProject(
+        [col(c) for c in cols],
+        CpuAggregate([col(c) for c in cols],
+                     [Count(None).alias("_n")], u))
+    all_sales = CpuAggregate(
+        [col("d_year"), col("i_brand_id"), col("i_class_id"),
+         col("i_category_id"), col("i_manufact_id")],
+        [Sum(col("sales_cnt")).alias("sales_cnt"),
+         Sum(col("sales_amt")).alias("sales_amt")], dedup)
+    curr = CpuFilter(col("d_year") == lit(2002), all_sales)
+    prev = CpuProject(
+        [col("d_year").alias("prev_year"),
+         col("i_brand_id").alias("pb"), col("i_class_id").alias("pc"),
+         col("i_category_id").alias("pg"),
+         col("i_manufact_id").alias("pm"),
+         col("sales_cnt").alias("prev_yr_cnt"),
+         col("sales_amt").alias("prev_amt")],
+        CpuFilter(col("d_year") == lit(2001), all_sales))
+    j = _join(curr, prev,
+              ["i_brand_id", "i_class_id", "i_category_id",
+               "i_manufact_id"], ["pb", "pc", "pg", "pm"])
+    from spark_rapids_tpu.exprs.cast import Cast as _Cast
+    f = CpuFilter(
+        _Cast(col("sales_cnt"), _T.FLOAT64) /
+        _Cast(col("prev_yr_cnt"), _T.FLOAT64) < lit(0.9), j)
+    out = CpuProject(
+        [col("prev_year"), col("d_year").alias("year"),
+         col("i_brand_id"), col("i_class_id"), col("i_category_id"),
+         col("i_manufact_id"), col("prev_yr_cnt"),
+         col("sales_cnt").alias("curr_yr_cnt"),
+         (col("sales_cnt") - col("prev_yr_cnt")
+          ).alias("sales_cnt_diff"),
+         (col("sales_amt") - col("prev_amt")).alias("sales_amt_diff")],
+        f)
+    return CpuLimit(100, CpuSort([asc(col("sales_cnt_diff"))], out))
+
+
+def q78(t, run):
+    """Reference q78: store-loyal purchases (never returned) vs the
+    other channels per customer/item/year."""
+    def chan(sales, returns, date_key, item_key, cust_key, skeys,
+             rkeys, rnull, qty, wc, sp, tag):
+        j = _join(t[sales], CpuProject(
+            [col(rkeys[0]).alias("_r0"), col(rkeys[1]).alias("_r1")],
+            t[returns]), skeys, ["_r0", "_r1"], jt=J.LEFT_OUTER)
+        j = CpuFilter(IsNull(col("_r0")), j)
+        j = _join(j, t["date_dim"], [date_key], ["d_date_sk"])
+        return CpuAggregate(
+            [col("d_year").alias(f"{tag}_sold_year"),
+             col(item_key).alias(f"{tag}_item"),
+             col(cust_key).alias(f"{tag}_cust")],
+            [Sum(col(qty)).alias(f"{tag}_qty"),
+             Sum(col(wc)).alias(f"{tag}_wc"),
+             Sum(col(sp)).alias(f"{tag}_sp")], j)
+
+    ws = chan("web_sales", "web_returns", "ws_sold_date_sk",
+              "ws_item_sk", "ws_bill_customer_sk",
+              ["ws_order_number", "ws_item_sk"],
+              ["wr_order_number", "wr_item_sk"], "wr_order_number",
+              "ws_quantity", "ws_wholesale_cost", "ws_sales_price",
+              "ws")
+    cs = chan("catalog_sales", "catalog_returns", "cs_sold_date_sk",
+              "cs_item_sk", "cs_bill_customer_sk",
+              ["cs_order_number", "cs_item_sk"],
+              ["cr_order_number", "cr_item_sk"], "cr_order_number",
+              "cs_quantity", "cs_wholesale_cost", "cs_sales_price",
+              "cs")
+    ss = chan("store_sales", "store_returns", "ss_sold_date_sk",
+              "ss_item_sk", "ss_customer_sk",
+              ["ss_ticket_number", "ss_item_sk"],
+              ["sr_ticket_number", "sr_item_sk"], "sr_ticket_number",
+              "ss_quantity", "ss_wholesale_cost", "ss_sales_price",
+              "ss")
+    j = _join(ss, ws, ["ss_sold_year", "ss_item", "ss_cust"],
+              ["ws_sold_year", "ws_item", "ws_cust"], jt=J.LEFT_OUTER)
+    j = _join(j, cs, ["ss_sold_year", "ss_item", "ss_cust"],
+              ["cs_sold_year", "cs_item", "cs_cust"], jt=J.LEFT_OUTER)
+    other_qty = (Coalesce((col("ws_qty"), lit(0))) +
+                 Coalesce((col("cs_qty"), lit(0))))
+    f = CpuFilter(
+        ((Coalesce((col("ws_qty"), lit(0))) > lit(0)) |
+         (Coalesce((col("cs_qty"), lit(0))) > lit(0))) &
+        (col("ss_sold_year") == lit(2000)), j)
+    from spark_rapids_tpu.exprs.cast import Cast as _Cast
+    out = CpuProject(
+        [col("ss_sold_year"), col("ss_item").alias("ss_item_sk"),
+         col("ss_cust").alias("ss_customer_sk"),
+         Round(_Cast(col("ss_qty"), _T.FLOAT64) /
+               _Cast(other_qty, _T.FLOAT64), 2).alias("ratio"),
+         col("ss_qty").alias("store_qty"),
+         col("ss_wc").alias("store_wholesale_cost"),
+         col("ss_sp").alias("store_sales_price"),
+         other_qty.alias("other_chan_qty"),
+         (Coalesce((col("ws_wc"), lit(0.0))) +
+          Coalesce((col("cs_wc"), lit(0.0)))
+          ).alias("other_chan_wholesale_cost"),
+         (Coalesce((col("ws_sp"), lit(0.0))) +
+          Coalesce((col("cs_sp"), lit(0.0)))
+          ).alias("other_chan_sales_price")], f)
+    return CpuLimit(100, CpuSort(
+        [asc(col("ss_sold_year")), asc(col("ss_item_sk")),
+         asc(col("ss_customer_sk")), desc(col("store_qty")),
+         desc(col("store_wholesale_cost")),
+         desc(col("store_sales_price")), asc(col("other_chan_qty")),
+         asc(col("other_chan_wholesale_cost")),
+         asc(col("other_chan_sales_price"))], out))
+
+
+QUERIES.update({"q75": q75, "q78": q78})
+
+
+def _q24_ssales(t):
+    """q24 ssales CTE: returned store purchases where the customer's
+    birth country matches the (upper-cased) address country and the
+    store shares the address zip."""
+    from spark_rapids_tpu.exprs.string_fns import Upper
+    st = CpuFilter(col("s_market_id") == lit(8), t["store"])
+    j = _join(t["store_sales"], t["store_returns"],
+              ["ss_ticket_number", "ss_item_sk"],
+              ["sr_ticket_number", "sr_item_sk"])
+    j = _join(j, st, ["ss_store_sk"], ["s_store_sk"])
+    j = _join(j, t["item"], ["ss_item_sk"], ["i_item_sk"])
+    j = _join(j, t["customer"], ["ss_customer_sk"], ["c_customer_sk"])
+    ca = CpuProject(
+        [Upper(col("ca_country")).alias("ca_country_up"),
+         col("ca_zip").alias("ca_zip2"),
+         col("ca_state").alias("ca_state")], t["customer_address"])
+    j = _join(j, ca, ["c_birth_country", "s_zip"],
+              ["ca_country_up", "ca_zip2"])
+    return CpuAggregate(
+        [col("c_last_name"), col("c_first_name"), col("s_store_name"),
+         col("ca_state"), col("s_state"), col("i_color"),
+         col("i_current_price"), col("i_manager_id"), col("i_units"),
+         col("i_size")],
+        [Sum(col("ss_net_paid")).alias("netpaid")], j)
+
+
+def _q24_tail(t, color):
+    ssales = _q24_ssales(t)
+    thr = CpuProject(
+        [(col("_a") * lit(0.05)).alias("threshold"),
+         lit(1).alias("_tk")],
+        CpuAggregate([], [Average(col("netpaid")).alias("_a")],
+                     ssales))
+    sel = CpuFilter(col("i_color") == lit(color), ssales)
+    agg = CpuAggregate(
+        [col("c_last_name"), col("c_first_name"), col("s_store_name")],
+        [Sum(col("netpaid")).alias("paid")], sel)
+    keyed = CpuProject(
+        [col("c_last_name"), col("c_first_name"), col("s_store_name"),
+         col("paid"), lit(1).alias("_pk")], agg)
+    f = CpuFilter(col("paid") > col("threshold"),
+                  _join(keyed, thr, ["_pk"], ["_tk"]))
+    return CpuProject(
+        [col("c_last_name"), col("c_first_name"), col("s_store_name"),
+         col("paid")], f)
+
+
+def q24(t, run):
+    """Reference q24a: big spenders on one color at market-8 stores
+    co-located with their address."""
+    return _q24_tail(t, "snow")
+
+
+def q24b(t, run):
+    """Reference q24b: q24a for a second color."""
+    return _q24_tail(t, "powder")
+
+
+def _q23_ctes(t):
+    """q23 CTEs: frequent same-day items and best store customers."""
+    from spark_rapids_tpu.exprs.aggregates import Max
+    dd4 = CpuFilter(InSet(col("d_year"), (2000, 2001, 2002, 2003)),
+                    t["date_dim"])
+    j = _join(_join(dd4, t["store_sales"],
+                    ["d_date_sk"], ["ss_sold_date_sk"]),
+              t["item"], ["ss_item_sk"], ["i_item_sk"])
+    freq = CpuAggregate(
+        [_Substring(col("i_item_desc"), lit(1),
+                    lit(30)).alias("itemdesc"),
+         col("i_item_sk").alias("item_sk"),
+         col("d_date").alias("solddate")],
+        [Count(None).alias("cnt")], j)
+    # reference keeps count > 4 per item-day; > 1 stands in at
+    # synthetic scale
+    freq = CpuProject(
+        [col("item_sk")],
+        CpuAggregate([col("item_sk")], [Count(None).alias("_n")],
+                     CpuFilter(col("cnt") > lit(1), freq)))
+    per_cust = CpuAggregate(
+        [col("ss_customer_sk")],
+        [Sum(col("ss_quantity") * col("ss_list_price")).alias("csales")],
+        _join(dd4, t["store_sales"], ["d_date_sk"],
+              ["ss_sold_date_sk"]))
+    cmax = CpuProject(
+        [(col("_m") * lit(0.5)).alias("cut"), lit(1).alias("_mk")],
+        CpuAggregate(
+            [], [Max(col("csales")).alias("_m")], per_cust))
+    all_cust = CpuAggregate(
+        [col("ss_customer_sk")],
+        [Sum(col("ss_quantity") * col("ss_list_price")
+             ).alias("ssales")], t["store_sales"])
+    keyed = CpuProject(
+        [col("ss_customer_sk"), col("ssales"), lit(1).alias("_ck")],
+        all_cust)
+    # reference threshold is 95% of the max customer's spend; 50%
+    # stands in to keep a non-degenerate best-customer set
+    best = CpuProject(
+        [col("ss_customer_sk").alias("best_sk")],
+        CpuFilter(col("ssales") > col("cut"),
+                  _join(keyed, cmax, ["_ck"], ["_mk"])))
+    return freq, best
+
+
+def q23(t, run):
+    """Reference q23a: Feb-2000 catalog+web revenue from the best store
+    customers on frequently-sold items."""
+    freq, best = _q23_ctes(t)
+    dd = CpuFilter((col("d_year") == lit(2000)) &
+                   (col("d_moy") == lit(2)), t["date_dim"])
+
+    def chan(sales, date_key, item_key, cust_key, qty, lp):
+        j = _join(dd, sales, ["d_date_sk"], [date_key])
+        j = _join(j, freq, [item_key], ["item_sk"], jt=J.LEFT_SEMI)
+        j = _join(j, best, [cust_key], ["best_sk"], jt=J.LEFT_SEMI)
+        return CpuProject(
+            [(col(qty) * col(lp)).alias("sales")], j)
+
+    u = CpuUnion(
+        chan(t["catalog_sales"], "cs_sold_date_sk", "cs_item_sk",
+             "cs_bill_customer_sk", "cs_quantity", "cs_list_price"),
+        chan(t["web_sales"], "ws_sold_date_sk", "ws_item_sk",
+             "ws_bill_customer_sk", "ws_quantity", "ws_list_price"))
+    return CpuLimit(100, CpuAggregate(
+        [], [Sum(col("sales")).alias("total_sales")], u))
+
+
+def q23b(t, run):
+    """Reference q23b: q23a broken out by best customer."""
+    freq, best = _q23_ctes(t)
+    dd = CpuFilter((col("d_year") == lit(2000)) &
+                   (col("d_moy") == lit(2)), t["date_dim"])
+
+    def chan(sales, date_key, item_key, cust_key, qty, lp):
+        j = _join(dd, sales, ["d_date_sk"], [date_key])
+        j = _join(j, freq, [item_key], ["item_sk"], jt=J.LEFT_SEMI)
+        j = _join(j, best, [cust_key], ["best_sk"], jt=J.LEFT_SEMI)
+        j = _join(j, t["customer"], [cust_key], ["c_customer_sk"])
+        return CpuProject(
+            [col("c_last_name"), col("c_first_name"),
+             (col(qty) * col(lp)).alias("sales")], j)
+
+    u = CpuUnion(
+        chan(t["catalog_sales"], "cs_sold_date_sk", "cs_item_sk",
+             "cs_bill_customer_sk", "cs_quantity", "cs_list_price"),
+        chan(t["web_sales"], "ws_sold_date_sk", "ws_item_sk",
+             "ws_bill_customer_sk", "ws_quantity", "ws_list_price"))
+    agg = CpuAggregate(
+        [col("c_last_name"), col("c_first_name")],
+        [Sum(col("sales")).alias("sales")], u)
+    return CpuLimit(100, CpuSort(
+        [asc(col("c_last_name")), asc(col("c_first_name")),
+         asc(col("sales"))], agg))
+
+
+QUERIES.update({"q24": q24, "q24b": q24b, "q23": q23, "q23b": q23b})
+
+
+def _q14_cross_items(t):
+    """q14 cross_items: items whose (brand, class, category) triple
+    sold in ALL three channels in the 3-year window (INTERSECT as
+    successive semi joins over the distinct triples)."""
+    dd = CpuFilter(InSet(col("d_year"), (1999, 2000, 2001)),
+                   t["date_dim"])
+
+    def triples(sales, date_key, item_key, tag):
+        j = _join(_join(dd, sales, ["d_date_sk"], [date_key]),
+                  t["item"], [item_key], ["i_item_sk"])
+        return CpuProject(
+            [col("i_brand_id").alias(f"{tag}b"),
+             col("i_class_id").alias(f"{tag}c"),
+             col("i_category_id").alias(f"{tag}g")],
+            CpuAggregate(
+                [col("i_brand_id"), col("i_class_id"),
+                 col("i_category_id")], [Count(None).alias("_n")], j))
+
+    ss = triples(t["store_sales"], "ss_sold_date_sk", "ss_item_sk",
+                 "s")
+    cs = triples(t["catalog_sales"], "cs_sold_date_sk", "cs_item_sk",
+                 "c")
+    ws = triples(t["web_sales"], "ws_sold_date_sk", "ws_item_sk", "w")
+    both = _join(ss, cs, ["sb", "sc", "sg"], ["cb", "cc", "cg"],
+                 jt=J.LEFT_SEMI)
+    x = _join(both, ws, ["sb", "sc", "sg"], ["wb", "wc", "wg"],
+              jt=J.LEFT_SEMI)
+    items = _join(t["item"], x,
+                  ["i_brand_id", "i_class_id", "i_category_id"],
+                  ["sb", "sc", "sg"], jt=J.LEFT_SEMI)
+    return CpuProject([col("i_item_sk").alias("cross_sk")], items)
+
+
+def _q14_avg_sales(t):
+    """q14 avg_sales scalar: mean quantity*list_price across the three
+    channels over the window, keyed for a cross join."""
+    dd = CpuFilter(InSet(col("d_year"), (1999, 2000, 2001)),
+                   t["date_dim"])
+
+    def chan(sales, date_key, qty, lp):
+        j = _join(dd, sales, ["d_date_sk"], [date_key])
+        return CpuProject(
+            [(col(qty) * col(lp)).alias("qlp")], j)
+
+    u = CpuUnion(
+        chan(t["store_sales"], "ss_sold_date_sk", "ss_quantity",
+             "ss_list_price"),
+        chan(t["catalog_sales"], "cs_sold_date_sk", "cs_quantity",
+             "cs_list_price"),
+        chan(t["web_sales"], "ws_sold_date_sk", "ws_quantity",
+             "ws_list_price"))
+    return CpuProject(
+        [col("average_sales"), lit(1).alias("_ak")],
+        CpuAggregate([], [Average(col("qlp")).alias("average_sales")],
+                     u))
+
+
+def _q14_channel_sales(t, cross, avg, sales, date_key, item_key, qty,
+                       lp, chan, date_pred):
+    dd = CpuFilter(date_pred, t["date_dim"])
+    j = _join(dd, t[sales], ["d_date_sk"], [date_key])
+    j = _join(j, cross, [item_key], ["cross_sk"], jt=J.LEFT_SEMI)
+    j = _join(j, t["item"], [item_key], ["i_item_sk"])
+    agg = CpuAggregate(
+        [col("i_brand_id"), col("i_class_id"), col("i_category_id")],
+        [Sum(col(qty) * col(lp)).alias("sales"),
+         Count(None).alias("number_sales")], j)
+    keyed = CpuProject(
+        [col("i_brand_id"), col("i_class_id"), col("i_category_id"),
+         col("sales"), col("number_sales"), lit(1).alias("_hk")], agg)
+    f = CpuFilter(col("sales") > col("average_sales"),
+                  _join(keyed, avg, ["_hk"], ["_ak"]))
+    return CpuProject(
+        [lit(chan).alias("channel"), col("i_brand_id"),
+         col("i_class_id"), col("i_category_id"), col("sales"),
+         col("number_sales")], f)
+
+
+def q14(t, run):
+    """Reference q14a: above-average cross-channel triples for one
+    month, totaled over ROLLUP(channel, brand, class, category)."""
+    cross = _q14_cross_items(t)
+    avg = _q14_avg_sales(t)
+    pred = (col("d_year") == lit(2001)) & (col("d_moy") == lit(11))
+    u = CpuUnion(
+        _q14_channel_sales(t, cross, avg, "store_sales",
+                           "ss_sold_date_sk", "ss_item_sk",
+                           "ss_quantity", "ss_list_price", "store",
+                           pred),
+        _q14_channel_sales(t, cross, avg, "catalog_sales",
+                           "cs_sold_date_sk", "cs_item_sk",
+                           "cs_quantity", "cs_list_price", "catalog",
+                           pred),
+        _q14_channel_sales(t, cross, avg, "web_sales",
+                           "ws_sold_date_sk", "ws_item_sk",
+                           "ws_quantity", "ws_list_price", "web",
+                           pred))
+    keys = ["channel", "i_brand_id", "i_class_id", "i_category_id"]
+    ex = _rollup_expand(u, keys, ["sales", "number_sales"])
+    agg = CpuAggregate(
+        [col(k) for k in keys] + [col("gid")],
+        [Sum(col("sales")).alias("sum_sales"),
+         Sum(col("number_sales")).alias("sum_number_sales")], ex)
+    out = CpuProject(
+        [col(k) for k in keys] +
+        [col("sum_sales"), col("sum_number_sales")], agg)
+    return CpuLimit(100, CpuSort(
+        [asc(col(k)) for k in keys], out))
+
+
+def q14b(t, run):
+    """Reference q14b: this-week vs same-week-last-year store sales of
+    cross-channel triples."""
+    cross = _q14_cross_items(t)
+    avg = _q14_avg_sales(t)
+
+    def week_pred(year):
+        wk = CpuProject(
+            [col("d_week_seq").alias("sel_wk")],
+            CpuAggregate(
+                [col("d_week_seq")], [Count(None).alias("_c")],
+                CpuFilter((col("d_year") == lit(year)) &
+                          (col("d_moy") == lit(12)) &
+                          (col("d_dom") == lit(11)), t["date_dim"])))
+        return CpuProject(
+            [col("d_date_sk")],
+            _join(t["date_dim"], wk, ["d_week_seq"], ["sel_wk"],
+                  jt=J.LEFT_SEMI))
+
+    def store_week(dates, tag):
+        j = _join(dates, t["store_sales"], ["d_date_sk"],
+                  ["ss_sold_date_sk"])
+        j = _join(j, cross, ["ss_item_sk"], ["cross_sk"],
+                  jt=J.LEFT_SEMI)
+        j = _join(j, t["item"], ["ss_item_sk"], ["i_item_sk"])
+        agg = CpuAggregate(
+            [col("i_brand_id"), col("i_class_id"),
+             col("i_category_id")],
+            [Sum(col("ss_quantity") * col("ss_list_price")
+                 ).alias("sales"),
+             Count(None).alias("number_sales")], j)
+        keyed = CpuProject(
+            [col("i_brand_id"), col("i_class_id"),
+             col("i_category_id"), col("sales"), col("number_sales"),
+             lit(1).alias("_hk")], agg)
+        f = CpuFilter(col("sales") > col("average_sales"),
+                      _join(keyed, avg, ["_hk"], ["_ak"]))
+        return CpuProject(
+            [col("i_brand_id").alias(f"{tag}_brand"),
+             col("i_class_id").alias(f"{tag}_class"),
+             col("i_category_id").alias(f"{tag}_cat"),
+             col("sales").alias(f"{tag}_sales"),
+             col("number_sales").alias(f"{tag}_number_sales")], f)
+
+    this_year = store_week(week_pred(2000), "ty")
+    last_year = store_week(week_pred(1999), "ly")
+    j = _join(this_year, last_year,
+              ["ty_brand", "ty_class", "ty_cat"],
+              ["ly_brand", "ly_class", "ly_cat"])
+    out = CpuProject(
+        [lit("store").alias("ty_channel"),
+         lit("store").alias("ly_channel"),
+         col("ty_brand"), col("ly_brand").alias("ly_brand_id"),
+         col("ty_cat"), col("ly_cat").alias("ly_cat_id"),
+         col("ty_class"), col("ly_class").alias("ly_class_id"),
+         col("ty_number_sales"), col("ly_number_sales"),
+         col("ty_sales"), col("ly_sales")], j)
+    return CpuLimit(100, CpuSort(
+        [asc(col("ty_brand")), asc(col("ty_class")),
+         asc(col("ty_cat"))], out))
+
+
+QUERIES.update({"q14": q14, "q14b": q14b})
+
+
+def q64(t, run):
+    """Reference q64: repeat same-store purchases of profitable
+    catalog-returned items, year over year, with buyer's sale-time and
+    current demographics/addresses."""
+    cs_ui = CpuFilter(
+        col("sale") > lit(2.0) * col("refund"),
+        CpuAggregate(
+            [col("cs_item_sk")],
+            [Sum(col("cs_ext_list_price")).alias("sale"),
+             Sum(col("cr_refunded_cash") + col("cr_reversed_charge") +
+                 col("cr_store_credit")).alias("refund")],
+            _join(t["catalog_sales"], t["catalog_returns"],
+                  ["cs_item_sk", "cs_order_number"],
+                  ["cr_item_sk", "cr_order_number"])))
+    cs_ui = CpuProject([col("cs_item_sk").alias("ui_sk")], cs_ui)
+
+    it = CpuFilter(
+        InSet(col("i_color"), ("floral", "deep", "light",
+                               "cornflower", "midnight", "snow")) &
+        _between(col("i_current_price"), lit(64.0), lit(74.0)) &
+        _between(col("i_current_price"), lit(65.0), lit(79.0)),
+        t["item"])
+    cd1 = CpuProject([col("cd_demo_sk").alias("cd1_sk"),
+                      col("cd_marital_status").alias("cd1_ms")],
+                     t["customer_demographics"])
+    cd2 = CpuProject([col("cd_demo_sk").alias("cd2_sk"),
+                      col("cd_marital_status").alias("cd2_ms")],
+                     t["customer_demographics"])
+    hd1 = CpuProject([col("hd_demo_sk").alias("hd1_sk"),
+                      col("hd_income_band_sk").alias("hd1_ib")],
+                     t["household_demographics"])
+    hd2 = CpuProject([col("hd_demo_sk").alias("hd2_sk"),
+                      col("hd_income_band_sk").alias("hd2_ib")],
+                     t["household_demographics"])
+    ib1 = CpuProject([col("ib_income_band_sk").alias("ib1_sk")],
+                     t["income_band"])
+    ib2 = CpuProject([col("ib_income_band_sk").alias("ib2_sk")],
+                     t["income_band"])
+    ad1 = CpuProject(
+        [col("ca_address_sk").alias("ad1_sk"),
+         col("ca_street_number").alias("b_street_number"),
+         col("ca_street_name").alias("b_street_name"),
+         col("ca_city").alias("b_city"), col("ca_zip").alias("b_zip")],
+        t["customer_address"])
+    ad2 = CpuProject(
+        [col("ca_address_sk").alias("ad2_sk"),
+         col("ca_street_number").alias("c_street_number"),
+         col("ca_street_name").alias("c_street_name"),
+         col("ca_city").alias("c_city"), col("ca_zip").alias("c_zip")],
+        t["customer_address"])
+    d1 = CpuProject([col("d_date_sk").alias("d1_sk"),
+                     col("d_year").alias("syear")], t["date_dim"])
+    d2 = CpuProject([col("d_date_sk").alias("d2_sk"),
+                     col("d_year").alias("fsyear")], t["date_dim"])
+    d3 = CpuProject([col("d_date_sk").alias("d3_sk"),
+                     col("d_year").alias("s2year")], t["date_dim"])
+
+    j = _join(t["store_sales"], t["store_returns"],
+              ["ss_item_sk", "ss_ticket_number"],
+              ["sr_item_sk", "sr_ticket_number"])
+    j = _join(j, cs_ui, ["ss_item_sk"], ["ui_sk"], jt=J.LEFT_SEMI)
+    j = _join(j, t["store"], ["ss_store_sk"], ["s_store_sk"])
+    j = _join(j, d1, ["ss_sold_date_sk"], ["d1_sk"])
+    j = _join(j, t["customer"], ["ss_customer_sk"], ["c_customer_sk"])
+    j = _join(j, cd1, ["ss_cdemo_sk"], ["cd1_sk"])
+    j = _join(j, hd1, ["ss_hdemo_sk"], ["hd1_sk"])
+    j = _join(j, ad1, ["ss_addr_sk"], ["ad1_sk"])
+    j = _join(j, it, ["ss_item_sk"], ["i_item_sk"])
+    j = _join(j, cd2, ["c_current_cdemo_sk"], ["cd2_sk"])
+    j = _join(j, hd2, ["c_current_hdemo_sk"], ["hd2_sk"])
+    j = _join(j, ad2, ["c_current_addr_sk"], ["ad2_sk"])
+    j = _join(j, d2, ["c_first_sales_date_sk"], ["d2_sk"])
+    j = _join(j, d3, ["c_first_shipto_date_sk"], ["d3_sk"])
+    j = _join(j, CpuProject([col("p_promo_sk").alias("pk")],
+                            t["promotion"]),
+              ["ss_promo_sk"], ["pk"], jt=J.LEFT_SEMI)
+    j = _join(j, ib1, ["hd1_ib"], ["ib1_sk"], jt=J.LEFT_SEMI)
+    j = _join(j, ib2, ["hd2_ib"], ["ib2_sk"], jt=J.LEFT_SEMI)
+    f = CpuFilter(col("cd1_ms") != col("cd2_ms"), j)
+    groups = ["i_product_name", "i_item_sk", "s_store_name", "s_zip",
+              "b_street_number", "b_street_name", "b_city", "b_zip",
+              "c_street_number", "c_street_name", "c_city", "c_zip",
+              "syear", "fsyear", "s2year"]
+    cross_sales = CpuAggregate(
+        [col(g) for g in groups],
+        [Count(None).alias("cnt"),
+         Sum(col("ss_wholesale_cost")).alias("s1"),
+         Sum(col("ss_list_price")).alias("s2"),
+         Sum(col("ss_coupon_amt")).alias("s3")], f)
+    cs1 = CpuFilter(col("syear") == lit(1999), cross_sales)
+    cs2 = CpuProject(
+        [col("i_item_sk").alias("k_item"),
+         col("s_store_name").alias("k_store"),
+         col("s_zip").alias("k_zip"),
+         col("cnt").alias("cs2_cnt"), col("s1").alias("cs2_s1"),
+         col("s2").alias("cs2_s2"), col("s3").alias("cs2_s3"),
+         col("syear").alias("cs2_syear")],
+        CpuFilter(col("syear") == lit(2000), cross_sales))
+    j2 = _join(cs1, cs2, ["i_item_sk", "s_store_name", "s_zip"],
+               ["k_item", "k_store", "k_zip"])
+    j2 = CpuFilter(col("cs2_cnt") <= col("cnt"), j2)
+    out = CpuProject(
+        [col("i_product_name").alias("product_name"),
+         col("s_store_name").alias("store_name"),
+         col("s_zip").alias("store_zip"), col("b_street_number"),
+         col("b_street_name"), col("b_city"), col("b_zip"),
+         col("c_street_number"), col("c_street_name"), col("c_city"),
+         col("c_zip"), col("syear").alias("cs1_syear"),
+         col("cnt").alias("cs1_cnt"), col("s1").alias("cs1_s1"),
+         col("s2").alias("cs1_s2"), col("s3").alias("cs1_s3"),
+         col("cs2_s1"), col("cs2_s2"), col("cs2_s3"),
+         col("cs2_syear"), col("cs2_cnt")], j2)
+    return CpuSort(
+        [asc(col("product_name")), asc(col("store_name")),
+         asc(col("cs2_cnt"))], out)
+
+
+QUERIES.update({"q64": q64})

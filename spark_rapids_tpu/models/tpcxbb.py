@@ -702,10 +702,13 @@ def q18(t, run):
     """q18-like: sentiment of reviews for items sold by DECLINING
     stores (first vs second half-year sales), via the compiled
     sentiment UDF."""
-    dd1 = CpuFilter((col("d_year") == lit(2001)) &
+    # Q1 vs Q2 (not half-years: the generator's December holiday
+    # concentration would make every store "grow" in H2)
+    dd1 = CpuFilter((col("d_year") == lit(1999)) &
+                    (col("d_moy") <= lit(3)), t["date_dim"])
+    dd2 = CpuFilter((col("d_year") == lit(1999)) &
+                    (col("d_moy") >= lit(4)) &
                     (col("d_moy") <= lit(6)), t["date_dim"])
-    dd2 = CpuFilter((col("d_year") == lit(2001)) &
-                    (col("d_moy") > lit(6)), t["date_dim"])
 
     def half(dd, alias, key):
         j = _join(CpuProject([col("d_date_sk").alias(key)], dd),

@@ -186,7 +186,9 @@ def _cmp(op):
 
 
 def op_str(a, b, op):
-    if a is None or b is None or a is pd.NA or b is pd.NA:
+    if a is None or b is None or a is pd.NA or b is pd.NA or \
+            (isinstance(a, float) and np.isnan(a)) or \
+            (isinstance(b, float) and np.isnan(b)):
         return None
     return {"eq": a == b, "lt": a < b, "le": a <= b,
             "gt": a > b, "ge": a >= b}[op]

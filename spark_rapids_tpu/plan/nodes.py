@@ -362,6 +362,7 @@ class CpuSort(CpuNode):
 _AGG_PANDAS = {
     "Sum": "sum", "Min": "min", "Max": "max", "Average": "mean",
     "Count": "count", "First": "first", "Last": "last",
+    "StddevSamp": "std", "VarianceSamp": "var",
 }
 
 
@@ -456,7 +457,8 @@ def _reduce(s: pd.Series, func):
         return None
     return {"Sum": s2.sum, "Min": s2.min, "Max": s2.max,
             "Average": s2.mean, "First": lambda: s2.iloc[0],
-            "Last": lambda: s2.iloc[-1]}[fname]()
+            "Last": lambda: s2.iloc[-1],
+            "StddevSamp": s2.std, "VarianceSamp": s2.var}[fname]()
 
 
 class CpuHashJoin(CpuNode):

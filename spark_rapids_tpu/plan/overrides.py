@@ -272,7 +272,8 @@ def _tag_aggregate(meta) -> None:
             meta.will_not_work_on_tpu(
                 f"aggregate function {fname} has no TPU implementation")
             continue
-        if fname in ("Average", "Sum") and not meta.conf[
+        if fname in ("Average", "Sum", "StddevSamp",
+                     "VarianceSamp") and not meta.conf[
                 C.VARIABLE_FLOAT_AGG] and a.func.child is not None:
             try:
                 dt = a.func.child.data_type(child_schema)

@@ -58,16 +58,30 @@ def register(check: BatchCheck) -> BatchCheck:
 
 
 def verify(checks) -> None:
-    """Resolve the given checks now (syncs); raise on any failure."""
+    """Resolve the given checks now (syncs); raise on any failure.
+
+    All device flags are stacked into ONE tiny device array and pulled
+    in a single D2H transfer — per-array readbacks cost a full tunnel
+    round-trip each (~25ms), which dominated collect() when a query
+    carried dozens of checks."""
     checks = list(checks)
     if not checks:
         return
-    for c in checks:
-        try:
-            c.flag.copy_to_host_async()
-        except Exception:
-            pass
-    bad = [c for c in checks if bool(np.asarray(c.flag))]
+    device_idx, device_flags, host_bad = [], [], []
+    for i, c in enumerate(checks):
+        f = c.flag
+        if hasattr(f, "devices") or hasattr(f, "sharding"):
+            device_idx.append(i)
+            device_flags.append(f)
+        elif bool(np.asarray(f)):
+            host_bad.append(i)
+    bad_set = set(host_bad)
+    if device_flags:
+        import jax.numpy as jnp
+        stacked = np.asarray(jnp.stack(
+            [jnp.asarray(f, bool).reshape(()) for f in device_flags]))
+        bad_set.update(i for i, b in zip(device_idx, stacked) if b)
+    bad = [c for i, c in enumerate(checks) if i in bad_set]
     with _LOCK:
         for c in checks:
             try:
