@@ -164,6 +164,21 @@ SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.shuffle.compression.codec", "none",
     "Codec for serialized shuffle payloads on the transport wire: "
     "none, copy (testing), lz4, zstd.")
+SHUFFLE_FAULT_DROP_RATE = conf(
+    "spark.rapids.shuffle.transport.faultInjection.dropRate", 0.0,
+    "TEST ONLY: probability that the transport server aborts a "
+    "transfer mid-stream (connection-loss injection; the reference "
+    "builds UCX with --enable-fault-injection for the same class of "
+    "soak testing). The client's bounded-retry path must recover.",
+    internal=True)
+SHUFFLE_FAULT_CORRUPT_RATE = conf(
+    "spark.rapids.shuffle.transport.faultInjection.corruptRate", 0.0,
+    "TEST ONLY: probability that a DATA chunk payload is corrupted on "
+    "the wire; the receiver's deserialization/CRC checks must detect "
+    "it and the fetch must retry.", internal=True)
+SHUFFLE_FAULT_SEED = conf(
+    "spark.rapids.shuffle.transport.faultInjection.seed", 0,
+    "Deterministic seed for fault injection.", internal=True)
 MESH_EXCHANGE_ENABLED = conf(
     "spark.rapids.shuffle.meshExchange.enabled", True,
     "Route hash shuffle exchanges through the device-mesh ICI all-to-all "

@@ -700,8 +700,7 @@ def mentions_aspect(content):
 
 def q18(t, run):
     """q18-like: sentiment of reviews for items sold by DECLINING
-    stores (first vs second half-year sales), via the compiled
-    sentiment UDF."""
+    stores (Q1 vs Q2 sales), via the compiled sentiment UDF."""
     # Q1 vs Q2 (not half-years: the generator's December holiday
     # concentration would make every store "grow" in H2)
     dd1 = CpuFilter((col("d_year") == lit(1999)) &

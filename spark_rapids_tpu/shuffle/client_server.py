@@ -176,6 +176,19 @@ class ShuffleClient:
                         f"{txn.error}")
                 log.warning("shuffle fetch retry %d from %s: %s", attempt,
                             self.address, txn.error)
+                # a mid-stream abort leaves the socket dead on the
+                # server side: reconnect before retrying (the reference
+                # re-registers the UCX endpoint on a failed Transaction)
+                try:
+                    fresh = self.transport.make_client(self.address)
+                except Exception:
+                    fresh = None
+                if fresh is not None:
+                    try:
+                        self.connection.close()
+                    except Exception:
+                        pass
+                    self.connection = fresh
                 continue
             pending = [m for m in pending
                        if m.table_id not in state.completed]

@@ -32,7 +32,8 @@ from typing import Callable, Optional, Sequence
 from spark_rapids_tpu import config as C
 from spark_rapids_tpu.shuffle.transport import (
     Connection, MsgKind, ShuffleTransport, Transaction, TransactionStatus,
-    decode_frame, encode_data, meta_response, transfer_request)
+    WireCorruption, decode_frame, encode_data, meta_response,
+    transfer_request)
 
 _LOOP_REGISTRY_LOCK = threading.Lock()
 _LOOP_REGISTRY: dict[str, "object"] = {}  # executor_id -> request handler
@@ -62,11 +63,63 @@ class LoopbackConnection(Connection):
         return self.server.send_state(table_ids, on_chunk, wire=False)
 
 
+class FaultInjector:
+    """Deterministic wire-fault injection for soak tests (the reference
+    builds UCX with --enable-fault-injection for the same purpose):
+    `drop` aborts the transfer mid-stream (the server stops sending and
+    the transaction fails, so the client must drop partials, reconnect
+    and retry), `corrupt` flips a byte in a DATA chunk (the frame crc32
+    must catch it). Rates come from the faultInjection.* confs; rate 0
+    (the default) injects nothing."""
+
+    def __init__(self, drop_rate: float, corrupt_rate: float,
+                 seed: int):
+        import random
+        self.drop_rate = float(drop_rate)
+        self.corrupt_rate = float(corrupt_rate)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.injected_drops = 0
+        self.injected_corruptions = 0
+
+    @property
+    def active(self) -> bool:
+        return self.drop_rate > 0 or self.corrupt_rate > 0
+
+    def maybe_drop(self) -> bool:
+        with self._lock:
+            if self._rng.random() < self.drop_rate:
+                self.injected_drops += 1
+                return True
+        return False
+
+    def maybe_corrupt_frame(self, frame: bytes,
+                            payload_off: int) -> bytes:
+        """Flip a byte in the PAYLOAD of an already-encoded frame —
+        after the header's crc32 was computed, like real wire damage
+        (corrupting before encoding would be re-checksummed and sail
+        through undetected)."""
+        with self._lock:
+            if len(frame) > payload_off and \
+                    self._rng.random() < self.corrupt_rate:
+                self.injected_corruptions += 1
+                i = self._rng.randrange(payload_off, len(frame))
+                return frame[:i] + bytes([frame[i] ^ 0xFF]) \
+                    + frame[i + 1:]
+        return frame
+
+
+class _InjectedDrop(Exception):
+    pass
+
+
 class TcpServer:
     """Accept loop + per-connection handler threads (the reference's UCX
     progress thread / management-port pair collapsed into one socket)."""
 
-    def __init__(self, server, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0,
+                 faults: Optional[FaultInjector] = None):
+        self.faults = faults
         self.server = server
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -101,11 +154,24 @@ class TcpServer:
                     metas = self.server.handle_metadata_request(blocks)
                     _send_all(conn, meta_response(metas))
                 elif kind == MsgKind.TRANSFER_REQUEST:
+                    faults = self.faults
+
                     def emit(tid, seq, chunk, is_last, codec_id=-1,
                              raw_len=0):
-                        _send_all(conn, encode_data(
+                        frame = encode_data(
                             tid, (seq << 1) | int(is_last), chunk,
-                            codec_id, raw_len))
+                            codec_id, raw_len)
+                        if faults is not None and faults.active:
+                            if faults.maybe_drop():
+                                # simulated connection loss: kill the
+                                # socket so the peer sees a dead wire,
+                                # not a polite error frame
+                                conn.close()
+                                raise _InjectedDrop()
+                            # frame payload starts after the 4-byte
+                            # length prefix + 26-byte DATA header
+                            frame = faults.maybe_corrupt_frame(frame, 30)
+                        _send_all(conn, frame)
                     txn = self.server.send_state(payload["table_ids"], emit)
                     _send_all(conn, _txn_frame(txn))
                 else:
@@ -188,6 +254,8 @@ class TcpConnection(Connection):
                     else:
                         return Transaction(TransactionStatus.ERROR,
                                            f"unexpected frame {kind}")
+            except WireCorruption as e:
+                return Transaction(TransactionStatus.ERROR, str(e))
             except OSError as e:
                 return Transaction(TransactionStatus.ERROR, str(e))
 
@@ -205,12 +273,18 @@ class IciShuffleTransport(ShuffleTransport):
         super().__init__(conf)
         self._servers: list[TcpServer] = []
         self._executor_ids: list[str] = []
+        self.faults = FaultInjector(
+            conf[C.SHUFFLE_FAULT_DROP_RATE],
+            conf[C.SHUFFLE_FAULT_CORRUPT_RATE],
+            conf[C.SHUFFLE_FAULT_SEED])
 
     def make_server(self, executor_id: str, request_handler):
         with _LOOP_REGISTRY_LOCK:
             _LOOP_REGISTRY[executor_id] = request_handler
         self._executor_ids.append(executor_id)
-        tcp = TcpServer(request_handler)
+        tcp = TcpServer(request_handler,
+                        faults=self.faults if self.faults.active
+                        else None)
         self._servers.append(tcp)
         # peers prefer loopback when they share the process
         return type("ServerHandle", (), {

@@ -275,9 +275,15 @@ class CachingShuffleReader:
                         conn, self.manager.transport,
                         self.manager.received_catalog,
                         self.manager.env.host_store, address)
-                    client.fetch_blocks(blocks, self.task_attempt_id,
-                                        handler)
-                    conn.close()
+                    try:
+                        client.fetch_blocks(blocks,
+                                            self.task_attempt_id,
+                                            handler)
+                    finally:
+                        # the client may have swapped in a fresh
+                        # connection on a retry: close whatever it
+                        # currently holds, not the original handle
+                        client.connection.close()
             except BaseException as e:  # noqa: BLE001
                 errors.append(e)
                 q.put(("fatal", str(e)))

@@ -24,6 +24,7 @@ import enum
 import importlib
 import json
 import struct
+import zlib
 import threading
 from typing import Callable, Optional, Sequence
 
@@ -88,21 +89,35 @@ def encode_control(kind: MsgKind, payload: dict) -> bytes:
     return struct.pack("<IB", len(body) + 1, int(kind)) + body
 
 
+class WireCorruption(Exception):
+    """A DATA frame failed its payload CRC — the chunk was damaged in
+    flight; the fetch transaction fails and the bounded-retry path
+    re-requests it."""
+
+
 def encode_data(table_id: int, seq: int, chunk: bytes,
                 codec_id: int = -1, raw_len: int = 0) -> bytes:
     """DATA frame; codec_id/raw_len play the reference's
     CodecBufferDescriptor role (ShuffleCommon.fbs): -1 = uncompressed,
-    else the payload is `codec_id`-compressed and inflates to raw_len."""
-    return struct.pack("<IBQIBQ", len(chunk) + 22, int(MsgKind.DATA),
-                       table_id, seq, codec_id + 1, raw_len) + chunk
+    else the payload is `codec_id`-compressed and inflates to raw_len.
+    A crc32 of the payload rides in the header so wire damage is
+    detected at the receiver (the spill files carry the same framing)."""
+    return struct.pack("<IBQIBQI", len(chunk) + 26, int(MsgKind.DATA),
+                       table_id, seq, codec_id + 1, raw_len,
+                       zlib.crc32(chunk) & 0xFFFFFFFF) + chunk
 
 
 def decode_frame(frame: bytes) -> tuple[MsgKind, object]:
     kind = MsgKind(frame[0])
     if kind == MsgKind.DATA:
-        table_id, seq, codec_byte, raw_len = struct.unpack_from(
-            "<QIBQ", frame, 1)
-        return kind, (table_id, seq, frame[22:], codec_byte - 1, raw_len)
+        table_id, seq, codec_byte, raw_len, crc = struct.unpack_from(
+            "<QIBQI", frame, 1)
+        chunk = frame[26:]
+        if zlib.crc32(chunk) & 0xFFFFFFFF != crc:
+            raise WireCorruption(
+                f"DATA frame for table {table_id} seq {seq >> 1} failed "
+                f"crc32")
+        return kind, (table_id, seq, chunk, codec_byte - 1, raw_len)
     return kind, json.loads(frame[1:].decode())
 
 

@@ -1,0 +1,102 @@
+"""Transport fault-injection soak tests (the reference builds UCX with
+--enable-fault-injection for the same purpose; its mocked-transport
+suites exercise the FetchRetry paths).  The injector lives server-side
+(`ici_transport.FaultInjector`): `drop` aborts a transfer mid-stream
+(connection loss), `corrupt` flips a wire byte — which the DATA-frame
+crc32 must catch — and the client's bounded-retry + reconnect path must
+recover bit-exact data."""
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.memory.env import ResourceEnv
+from spark_rapids_tpu.shuffle.client_server import FetchFailedError
+from spark_rapids_tpu.shuffle.manager import (
+    MapOutputRegistry, TpuShuffleManager)
+
+
+@pytest.fixture(autouse=True)
+def clean_world():
+    MapOutputRegistry.clear()
+    yield
+    MapOutputRegistry.clear()
+    for eid in list(TpuShuffleManager._managers):
+        TpuShuffleManager._managers[eid].close()
+    ResourceEnv.shutdown()
+
+
+def _conf(**kv):
+    c = C.RapidsConf({k.replace("__", "."): v for k, v in kv.items()})
+    C.set_active_conf(c)
+    return c
+
+
+def _batch(lo, n):
+    return ColumnarBatch.from_numpy({
+        "k": np.arange(lo, lo + n, dtype=np.int64),
+        "s": np.array([f"v{i}" for i in range(lo, lo + n)], object)})
+
+
+def _faulty_fetch(shuffle_id, drop=0.0, corrupt=0.0, seed=7,
+                  rows=4000):
+    conf = _conf(**{
+        "spark.rapids.shuffle.transport.faultInjection.dropRate": drop,
+        "spark.rapids.shuffle.transport.faultInjection.corruptRate":
+            corrupt,
+        "spark.rapids.shuffle.transport.faultInjection.seed": seed,
+        # tiny bounce buffers -> many wire chunks per transfer, so the
+        # per-chunk injector has real trials to fire on
+        "spark.rapids.shuffle.bounceBuffers.size": 2048,
+    })
+    env = ResourceEnv.init(conf)
+    m0 = TpuShuffleManager("flt-a", env, conf)
+    m1 = TpuShuffleManager("flt-b", env, conf)
+    for m in (m0, m1):
+        m.register_shuffle(shuffle_id)
+    w = m0.get_writer(shuffle_id, 0)
+    w.write_partition(0, _batch(0, rows))
+    status = w.commit(1)
+    status.address = m0.tcp_address  # force the wire path
+    MapOutputRegistry.register(shuffle_id, 0, status)
+    got = list(m1.get_reader(shuffle_id, 0))
+    return got, m0.transport.faults
+
+
+def _assert_bit_exact(got, rows):
+    assert sum(b.num_rows for b in got) == rows
+    ks = sorted(v for b in got
+                for v in b.column("k").to_pylist(b.num_rows))
+    assert ks == list(range(rows))
+    ss = sorted(v for b in got
+                for v in b.column("s").to_pylist(b.num_rows))
+    assert ss == sorted(f"v{i}" for i in range(rows))
+
+
+def test_injected_drops_recover_bit_exact():
+    got, faults = _faulty_fetch(31, drop=0.015, seed=3)
+    assert faults.injected_drops > 0, "injector never fired"
+    _assert_bit_exact(got, 4000)
+
+
+def test_injected_corruption_detected_by_crc_and_recovered():
+    got, faults = _faulty_fetch(32, corrupt=0.015, seed=1)
+    assert faults.injected_corruptions > 0, "injector never fired"
+    _assert_bit_exact(got, 4000)
+
+
+def test_total_loss_exhausts_retries_with_fetch_failed():
+    with pytest.raises(FetchFailedError):
+        _faulty_fetch(33, drop=1.0)
+
+
+def test_data_frame_crc_detects_bitflip():
+    from spark_rapids_tpu.shuffle.transport import (
+        MsgKind, WireCorruption, decode_frame, encode_data)
+    frame = encode_data(5, 2, b"payload-bytes", -1, 0)
+    kind, (tid, seq, chunk, codec, raw) = decode_frame(frame[4:])
+    assert kind == MsgKind.DATA and chunk == b"payload-bytes"
+    flipped = bytearray(frame[4:])
+    flipped[-3] ^= 0x10
+    with pytest.raises(WireCorruption):
+        decode_frame(bytes(flipped))
