@@ -108,9 +108,10 @@ class HashAggregateExec(UnaryExecBase):
         # once (None = never applicable for this exec)
         self._dict_qual = self._dict_plan()
         self._dict_range_misses = 0
-        # padded dictionary width, sized from a one-time first-batch
-        # range probe (None until probed)
-        self._dict_gpad: Optional[int] = None
+        # padded dictionary width (int for a single key; tuple of
+        # per-key pads for the composite multi-key path), sized from a
+        # one-time first-batch range probe (None until probed)
+        self._dict_gpad: Optional[object] = None
 
     def output_schema(self) -> T.Schema:
         return self._schema
@@ -214,12 +215,13 @@ class HashAggregateExec(UnaryExecBase):
     # -- dictionary fast path (conf-gated) -----------------------------------
     def _dict_plan(self):
         """Static qualification for the sort-free dictionary path:
-        single integral key, Sum/Count/Average over float inputs.
+        1..3 integral keys (multi-key folds into one composite slot id),
+        Sum/Count/Average over float inputs.
         Returns (plan, measures) or None."""
-        if self.mode == AggMode.FINAL or len(self._bound_groups) != 1:
+        if self.mode == AggMode.FINAL or \
+                not 1 <= len(self._bound_groups) <= 3:
             return None
-        kdt = self._group_fields[0].dtype
-        if not kdt.is_integral:
+        if not all(f.dtype.is_integral for f in self._group_fields):
             return None
         plan, measures = [], []
         self._dict_float = False
@@ -246,8 +248,9 @@ class HashAggregateExec(UnaryExecBase):
     def _dict_groupby_batch(self, batch: ColumnarBatch):
         """Sort-free grouped aggregation (reference: the role cuDF's hash
         groupby plays under `aggregate.scala:312` vs the sort-based
-        fallback): when the single integral key's RUNTIME range fits the
-        dictionary budget, the whole batch goes through ONE fused
+        fallback): when the integral key ranges (a single key, or the
+        composite product of up to three keys) fit the dictionary
+        budget at RUNTIME, the whole batch goes through ONE fused
         dispatch — key-window slots, Pallas one-hot grouped-sum
         (ops/pallas_kernels.grouped_sum_pallas), and the partial-batch
         finalize, all inside one jit.  A one-time first-batch probe
@@ -276,27 +279,53 @@ class HashAggregateExec(UnaryExecBase):
             # trying (and stop paying discarded fast dispatches)
             return None
 
+        nk = len(self._bound_groups)
         if self._dict_gpad is None:
             probe = self.kernels.get_or_build(
-                ("dict-probe", batch_signature(batch)),
+                ("dict-probe", nk, batch_signature(batch)),
                 lambda: jax.jit(self._build_dict_probe(batch.capacity)))
             if batch.sparse is not None:
-                kmin, kmax = probe(batch.columns, batch.num_rows_i32,
-                                   batch.sparse)
+                kmins, kmaxs = probe(batch.columns, batch.num_rows_i32,
+                                     batch.sparse)
             else:
-                kmin, kmax = probe(batch.columns, batch.num_rows_i32)
-            kmin, kmax = int(kmin), int(kmax)
-            span = kmax - kmin + 1 if kmax >= kmin else 0
-            if span > int(conf[C.DICT_GROUPBY_MAX_GROUPS]):
-                self._dict_range_misses += 1
-                return None
-            # bucket the padded width so compiles amortize across batches
-            self._dict_gpad = max(8, int(bucket_capacity(max(span, 1))))
+                kmins, kmaxs = probe(batch.columns, batch.num_rows_i32)
+            import numpy as _np
+            kmins = _np.asarray(kmins).reshape(-1)
+            kmaxs = _np.asarray(kmaxs).reshape(-1)
+            spans = [max(int(hi) - int(lo) + 1, 1) if hi >= lo else 1
+                     for lo, hi in zip(kmins, kmaxs)]
+            budget = int(conf[C.DICT_GROUPBY_MAX_GROUPS])
+            if nk == 1:
+                if spans[0] > budget:
+                    self._dict_range_misses += 1
+                    return None
+                # bucket the padded width so compiles amortize
+                self._dict_gpad = max(8, int(bucket_capacity(spans[0])))
+            else:
+                # per-key ~12.5% headroom (later batches drift), width
+                # includes a null slot per key; composite product must
+                # fit the budget
+                pads = [max(4, -(-(s + s // 8) // 4) * 4)
+                        for s in spans]
+                total = 1
+                for p in pads:
+                    total *= p + 1
+                if total > budget:
+                    self._dict_range_misses += 1
+                    return None
+                self._dict_gpad = tuple(pads)
         g_pad = self._dict_gpad
 
-        fused = self.kernels.get_or_build(
-            ("dict-fused", g_pad, batch_signature(batch)),
-            lambda: jax.jit(self._build_dict_fused(batch.capacity, g_pad)))
+        if nk == 1:
+            fused = self.kernels.get_or_build(
+                ("dict-fused", g_pad, batch_signature(batch)),
+                lambda: jax.jit(
+                    self._build_dict_fused(batch.capacity, g_pad)))
+        else:
+            fused = self.kernels.get_or_build(
+                ("dict-fused-multi", g_pad, batch_signature(batch)),
+                lambda: jax.jit(self._build_dict_fused_multi(
+                    batch.capacity, list(g_pad))))
         if batch.sparse is not None:
             cols, n, excess = fused(batch.columns, batch.num_rows_i32,
                                     batch.sparse)
@@ -318,6 +347,82 @@ class HashAggregateExec(UnaryExecBase):
     #: when a batch overflows past this does the deferred excess check
     #: fire and deopt the query.
     DICT_OVERFLOW_BUDGET = 1024
+
+    @staticmethod
+    def _eval_dict_measures(ctx, measures, rows):
+        """Shared by both fused dict kernels: evaluate measures into
+        (f32 kernel inputs, raw (value, valid) pairs for overflow
+        rows).  Raw values stay UN-masked and UN-cast: full-width f64
+        selects/casts are slow emulated ops; mask+cast happen after the
+        (tiny) overflow gather."""
+        vals, raw = [], []
+        for kind, e in measures:
+            v = e.eval(ctx)
+            good = v.validity & rows
+            if kind == "val":
+                v32 = (v.narrow if v.narrow is not None
+                       else v.data.astype(jnp.float32))
+                vals.append(jnp.where(good, v32, jnp.float32(0)))
+                raw.append((v.data, good))
+            else:
+                vals.append(good.astype(jnp.float32))
+                raw.append((good, good))
+        return vals, raw
+
+    @staticmethod
+    def _compact_dict_overflow(ovf_mask, ovf_cnt, cap, ovf_budget):
+        """Shared overflow-row compaction (first ovf_budget overflow
+        rows).  The compaction (a top_k over the full capacity, ~67ms
+        at 2M) is gated behind lax.cond: the common case — zero
+        overflow — pays only the (fused) mask/count it needed anyway."""
+        def _compact():
+            iota = jnp.arange(cap, dtype=jnp.int32)
+            keyv = jnp.where(ovf_mask, iota, jnp.iinfo(jnp.int32).max)
+            neg, _ = jax.lax.top_k(-keyv, ovf_budget)
+            return jnp.clip(-neg, 0, cap - 1)
+
+        return jax.lax.cond(
+            ovf_cnt > 0, _compact,
+            lambda: jnp.full(ovf_budget, cap - 1, jnp.int32))
+
+    @staticmethod
+    def _emit_dict_partials(plan, raw, sums_at, cnt_mixed, wi, oi,
+                            from_win, valid_out):
+        """Shared finalize: window groups + inline overflow singletons
+        -> partial agg columns.  `sums_at(mi)` yields the compacted
+        window column for kernel measure mi.  Invalid cells are masked
+        AFTER the tiny overflow gather so they read as 0, not garbage
+        (downstream merges may touch masked data)."""
+        out = []
+        for kind, mi in plan:
+            if kind == "count_star":
+                out.append(ColumnVector(T.INT64, cnt_mixed, valid_out))
+                continue
+            if kind == "count_expr":
+                win_c = jnp.round(sums_at(mi)).astype(jnp.int64)
+                _, good_o = raw[mi]
+                ovf_c = jnp.take(good_o, oi).astype(jnp.int64)
+                out.append(ColumnVector(
+                    T.INT64, jnp.where(from_win, jnp.take(win_c, wi),
+                                       ovf_c), valid_out))
+                continue
+            s_w = sums_at(mi)
+            f_w = jnp.round(sums_at(mi + 1)).astype(jnp.int64)
+            val_o, good_o = raw[mi]
+            some = jnp.where(from_win, jnp.take(f_w > 0, wi),
+                             jnp.take(good_o, oi)) & valid_out
+            s = jnp.where(
+                some,
+                jnp.where(from_win, jnp.take(s_w, wi),
+                          jnp.take(val_o, oi).astype(jnp.float64)),
+                jnp.float64(0))
+            out.append(ColumnVector(T.FLOAT64, s, some))
+            if kind == "average":
+                cnt_col = jnp.where(
+                    from_win, jnp.take(f_w, wi),
+                    jnp.take(good_o, oi).astype(jnp.int64))
+                out.append(ColumnVector(T.INT64, cnt_col, valid_out))
+        return out
 
     def _build_dict_fused(self, cap: int, g_pad: int):
         """Sync-free fused dict kernel: ONE dispatch computes the key
@@ -366,22 +471,8 @@ class HashAggregateExec(UnaryExecBase):
                           g_pad + 1)).astype(jnp.int32)
             ovf_mask = ok & ~in_win
             ovf_cnt = ovf_mask.sum().astype(jnp.int32)
-            vals = []
-            raw = []  # (f64 value, valid) per measure for overflow rows
-            for kind, e in measures:
-                v = e.eval(ctx)
-                good = v.validity & ctx.row_mask
-                if kind == "val":
-                    v32 = (v.narrow if v.narrow is not None
-                           else v.data.astype(jnp.float32))
-                    vals.append(jnp.where(good, v32, jnp.float32(0)))
-                    # raw values stay UN-masked and UN-cast here: full-
-                    # width f64 selects/casts are slow emulated ops;
-                    # mask+cast happen after the (tiny) overflow gather
-                    raw.append((v.data, good))
-                else:
-                    vals.append(good.astype(jnp.float32))
-                    raw.append((good, good))
+            vals, raw = HashAggregateExec._eval_dict_measures(
+                ctx, measures, ctx.row_mask)
             # row masking rides the SLOT sentinel (padding/filtered rows
             # -> g_pad+1, never counted), so the kernel's prefix bound is
             # the full capacity — mandatory for SPARSE inputs, whose live
@@ -400,20 +491,8 @@ class HashAggregateExec(UnaryExecBase):
             (nz,) = jnp.nonzero(occupied, size=w_cap, fill_value=0)
             slot_w = jnp.take(order, nz)
             cnt_w = jnp.take(cnt_o, nz)
-            # overflow rows, compacted (first ovf_budget of them).  The
-            # compaction (a top_k over the full capacity, ~67ms at 2M) is
-            # gated behind lax.cond: the common case — zero overflow —
-            # pays only the (fused) mask/count it needed anyway.
-            def _compact_ovf():
-                iota = jnp.arange(cap, dtype=jnp.int32)
-                keyv = jnp.where(ovf_mask, iota,
-                                 jnp.iinfo(jnp.int32).max)
-                neg, _ = jax.lax.top_k(-keyv, ovf_budget)
-                return jnp.clip(-neg, 0, cap - 1)
-
-            oidx = jax.lax.cond(
-                ovf_cnt > 0, _compact_ovf,
-                lambda: jnp.full(ovf_budget, cap - 1, jnp.int32))
+            oidx = HashAggregateExec._compact_dict_overflow(
+                ovf_mask, ovf_cnt, cap, ovf_budget)
             n_out = n_win + jnp.minimum(ovf_cnt, ovf_budget)
             excess = ovf_cnt > ovf_budget
 
@@ -434,54 +513,135 @@ class HashAggregateExec(UnaryExecBase):
             cnt_mixed = jnp.where(from_win,
                                   jnp.take(cnt_w.astype(jnp.int64), wi),
                                   jnp.int64(1))
-            for kind, mi in plan:
-                if kind == "count_star":
-                    out.append(ColumnVector(T.INT64, cnt_mixed, valid_out))
-                    continue
-                if kind == "count_expr":
-                    win_c = jnp.round(jnp.take(sums_o[:, mi], nz)
-                                      ).astype(jnp.int64)
-                    _, good_o = raw[mi]
-                    ovf_c = jnp.take(good_o, oi).astype(jnp.int64)
-                    out.append(ColumnVector(
-                        T.INT64, jnp.where(from_win, jnp.take(win_c, wi),
-                                           ovf_c), valid_out))
-                    continue
-                s_w = jnp.take(sums_o[:, mi], nz)
-                f_w = jnp.round(jnp.take(sums_o[:, mi + 1], nz)
-                                ).astype(jnp.int64)
-                val_o, good_o = raw[mi]
-                some = jnp.where(from_win, jnp.take(f_w > 0, wi),
-                                 jnp.take(good_o, oi)) & valid_out
-                # mask AFTER the tiny gather: invalid cells read as 0, not
-                # garbage (downstream merges may touch masked data)
-                s = jnp.where(
-                    some,
-                    jnp.where(from_win, jnp.take(s_w, wi),
-                              jnp.take(val_o, oi).astype(jnp.float64)),
-                    jnp.float64(0))
-                out.append(ColumnVector(T.FLOAT64, s, some))
-                if kind == "average":
-                    cnt_col = jnp.where(
-                        from_win, jnp.take(f_w, wi),
-                        jnp.take(good_o, oi).astype(jnp.int64))
-                    out.append(ColumnVector(T.INT64, cnt_col, valid_out))
+            out.extend(HashAggregateExec._emit_dict_partials(
+                plan, raw, lambda mi: jnp.take(sums_o[:, mi], nz),
+                cnt_mixed, wi, oi, from_win, valid_out))
             return out, n_out, excess
         return fused
 
     def _build_dict_probe(self, cap: int):
-        key_expr = self._bound_groups[0]
+        key_exprs = list(self._bound_groups)
 
         def probe(columns, num_rows, mask=None):
             ctx = make_eval_context(columns, cap, num_rows, mask)
-            k = key_expr.eval(ctx)
-            ok = k.validity & ctx.row_mask
-            kd = k.data.astype(jnp.int64)
             i64 = jnp.iinfo(jnp.int64)
-            kmin = jnp.min(jnp.where(ok, kd, i64.max))
-            kmax = jnp.max(jnp.where(ok, kd, i64.min))
-            return kmin, kmax
+            mins, maxs = [], []
+            for e in key_exprs:
+                k = e.eval(ctx)
+                ok = k.validity & ctx.row_mask
+                kd = k.data.astype(jnp.int64)
+                mins.append(jnp.min(jnp.where(ok, kd, i64.max)))
+                maxs.append(jnp.max(jnp.where(ok, kd, i64.min)))
+            return jnp.stack(mins), jnp.stack(maxs)
         return probe
+
+    def _build_dict_fused_multi(self, cap: int, pads: list):
+        """Composite-key variant of `_build_dict_fused`: each integral
+        key gets a dense window of `pads[i]` value slots + 1 null slot,
+        anchored at the batch's own device-side per-key minimum; the
+        per-key slots fold into ONE composite id (row-major strides)
+        that feeds the same Pallas one-hot grouped sum.  Rows outside
+        ANY key's window become inline singleton partial groups exactly
+        like the single-key path."""
+        from spark_rapids_tpu.ops.pallas_kernels import (_on_tpu,
+                                                         grouped_sum_pallas)
+        key_exprs = list(self._bound_groups)
+        kdts = [f.dtype for f in self._group_fields]
+        plan, measures = self._dict_qual
+        nk = len(key_exprs)
+        widths = [p + 1 for p in pads]  # value slots + null slot
+        strides = [1] * nk
+        for i in range(nk - 2, -1, -1):
+            strides[i] = strides[i + 1] * widths[i + 1]
+        G = strides[0] * widths[0]
+        ovf_budget = min(self.DICT_OVERFLOW_BUDGET, cap)
+        w_cap = G
+        out_cap = int(bucket_capacity(G + ovf_budget))
+        interp = not _on_tpu()
+
+        def fused(columns, num_rows, mask=None):
+            ctx = make_eval_context(columns, cap, num_rows, mask)
+            rows = ctx.row_mask
+            combined = jnp.zeros(cap, jnp.int32)
+            in_win = rows
+            kmins = []
+            ks = []
+            for e, span, stride in zip(key_exprs, pads, strides):
+                k = e.eval(ctx)
+                ks.append(k)
+                okk = k.validity & rows
+                if k.narrow is not None:
+                    k32 = k.narrow
+                    kmin32 = jnp.min(jnp.where(
+                        okk, k32, jnp.iinfo(jnp.int32).max))
+                    offu = (k32 - kmin32).astype(jnp.uint32)
+                    within = offu < jnp.uint32(span)
+                    off = offu.astype(jnp.int32)
+                    kmin = kmin32.astype(jnp.int64)
+                else:
+                    kd64 = k.data.astype(jnp.int64)
+                    kmin = jnp.min(jnp.where(
+                        okk, kd64, jnp.iinfo(jnp.int64).max))
+                    off64 = kd64 - kmin
+                    within = (off64 >= 0) & (off64 < span)
+                    off = jnp.clip(off64, 0, span - 1
+                                   ).astype(jnp.int32)
+                # per-key slot: dense value slot, or the null slot
+                slot_i = jnp.where(k.validity,
+                                   jnp.where(within, off, 0),
+                                   jnp.int32(span))
+                key_ok = jnp.where(k.validity, within, True)
+                in_win = in_win & key_ok
+                combined = combined + slot_i * jnp.int32(stride)
+                kmins.append(kmin)
+            ovf_mask = rows & ~in_win
+            ovf_cnt = ovf_mask.sum().astype(jnp.int32)
+            slots = jnp.where(in_win, combined, G).astype(jnp.int32)
+            vals, raw = HashAggregateExec._eval_dict_measures(
+                ctx, measures, rows)
+            sums, counts = grouped_sum_pallas(
+                slots, tuple(vals), jnp.int32(cap), n_groups=G + 1,
+                capacity=cap, interpret=interp)
+            occupied = counts[:G] > 0
+            n_win = occupied.sum().astype(jnp.int32)
+            (nz,) = jnp.nonzero(occupied, size=w_cap, fill_value=0)
+            slot_w = nz.astype(jnp.int32)
+            cnt_w = jnp.take(counts[:G], nz)
+            oidx = HashAggregateExec._compact_dict_overflow(
+                ovf_mask, ovf_cnt, cap, ovf_budget)
+            n_out = n_win + jnp.minimum(ovf_cnt, ovf_budget)
+            excess = ovf_cnt > ovf_budget
+
+            i = jnp.arange(out_cap)
+            valid_out = i < n_out
+            from_win = i < n_win
+            wi = jnp.clip(i, 0, w_cap - 1)
+            oi = jnp.take(oidx, jnp.clip(i - n_win, 0, ovf_budget - 1))
+
+            out = []
+            for ki in range(nk):
+                comp = (slot_w // jnp.int32(strides[ki])) \
+                    % jnp.int32(widths[ki])
+                k = ks[ki]
+                is_null_w = comp == pads[ki]
+                kd_w = (kmins[ki] + comp.astype(jnp.int64)
+                        ).astype(kdts[ki].storage_dtype)
+                key_data = jnp.where(
+                    from_win, jnp.take(kd_w, wi),
+                    jnp.take(k.data, oi).astype(
+                        kdts[ki].storage_dtype))
+                key_valid = jnp.where(
+                    from_win, jnp.take(~is_null_w, wi),
+                    jnp.take(k.validity, oi)) & valid_out
+                out.append(ColumnVector(kdts[ki], key_data, key_valid))
+            cnt_mixed = jnp.where(from_win,
+                                  jnp.take(cnt_w.astype(jnp.int64), wi),
+                                  jnp.int64(1))
+            out.extend(HashAggregateExec._emit_dict_partials(
+                plan, raw, lambda mi: jnp.take(sums[:G, mi], nz),
+                cnt_mixed, wi, oi, from_win, valid_out))
+            return out, n_out, excess
+        return fused
 
     # -- execution ----------------------------------------------------------
     def process_partition(self, batches) -> Iterator[ColumnarBatch]:

@@ -386,3 +386,64 @@ class TestDictFastPathDeopt:
                                    exp["s"].to_numpy(), rtol=2e-3)
         # the deopt disabled the fast path on this exec
         assert agg._dict_range_misses >= 3
+
+
+# -- multi-key dictionary fast path ------------------------------------------
+def _multi_key_frame(rng, n=20000, null_frac=0.01):
+    import pandas as pd
+    df = pd.DataFrame({
+        "a": rng.integers(100, 137, n).astype(np.int64),
+        "b": rng.integers(-5, 9, n).astype(np.int64),
+        "c": rng.integers(0, 4, n).astype(np.int64),
+        "v": rng.uniform(0, 10, n),
+    })
+    for col_ in ("a", "b"):
+        idx = rng.choice(n, max(int(n * null_frac), 1), replace=False)
+        df[col_] = df[col_].astype("Int64")
+        df.loc[idx, col_] = pd.NA
+    return df
+
+
+def _run_agg_pair(df, keys, conf_extra=None):
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exprs.aggregates import Average, Count, Sum
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.plan import (CpuAggregate, CpuSource,
+                                       accelerate, collect)
+    src = CpuSource.from_pandas(df, num_partitions=2)
+    plan = CpuAggregate(
+        [col(k) for k in keys],
+        [Sum(col("v")).alias("sv"), Count(col("v")).alias("cnt"),
+         Average(col("v")).alias("av")], src)
+    conf = C.RapidsConf(dict(
+        {"spark.rapids.sql.variableFloatAgg.enabled": True},
+        **(conf_extra or {})))
+    got = collect(accelerate(plan, conf), conf)
+    exp = plan.collect()
+    from parity import compare_frames
+    compare_frames(exp, got, f"multikey-{keys}", rtol=5e-3)
+
+
+def test_dict_groupby_two_integral_keys_with_nulls():
+    rng = np.random.default_rng(31)
+    _run_agg_pair(_multi_key_frame(rng), ["a", "b"])
+
+
+def test_dict_groupby_three_integral_keys():
+    rng = np.random.default_rng(32)
+    _run_agg_pair(_multi_key_frame(rng, null_frac=0.0),
+                  ["a", "b", "c"])
+
+
+def test_dict_groupby_multi_key_budget_overflow_falls_back():
+    # product of spans blows the budget: the plan must fall back to the
+    # sort lane and still be correct
+    rng = np.random.default_rng(33)
+    import pandas as pd
+    n = 8000
+    df = pd.DataFrame({
+        "a": rng.integers(0, 100000, n).astype(np.int64),
+        "b": rng.integers(0, 100000, n).astype(np.int64),
+        "v": rng.uniform(0, 10, n),
+    })
+    _run_agg_pair(df, ["a", "b"])
