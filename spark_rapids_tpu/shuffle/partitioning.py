@@ -117,7 +117,12 @@ class HashPartitioning(TpuPartitioning):
                                 self.num_partitions))
         return b
 
-    def partition_batch(self, batch):
+    def split_device(self, batch):
+        """Phase 1 of the two-phase split: run the device kernel and
+        return (cols, device counts, src batch) WITHOUT syncing.  The
+        exchange runs this for every input batch back-to-back, then
+        overlaps all the count readbacks — one effective round trip for
+        the whole map side instead of one per batch."""
         cache = getattr(self, "_cache", None)
         if cache is None:
             cache = self._cache = KernelCache()
@@ -131,8 +136,19 @@ class HashPartitioning(TpuPartitioning):
         kern = _split_kernel_for(cache, batch, pid_fn, n, "hash")
         cols, counts = kern(batch.columns, batch.num_rows_i32,
                             jnp.int32(0), (), batch.sparse)
+        return cols, counts, batch
+
+    @staticmethod
+    def finish_split(cols, counts, batch):
+        """Phase 2: cut slices with the (prefetched) counts."""
+        if batch.capacity > LAZY_SLICE_MAX_CAP:
+            counts = np.asarray(counts)
         return _slice_partitions(cols, counts, batch.schema,
                                  batch.capacity, batch.checks)
+
+    def partition_batch(self, batch):
+        cols, counts, src = self.split_device(batch)
+        return self.finish_split(cols, counts, src)
 
 
 @dataclasses.dataclass
