@@ -44,6 +44,56 @@ def test_dryrun_multichip_after_backend_init():
     assert "8-device mesh, groupby-sum OK" in r.stdout
 
 
+@pytest.mark.slowish
+def test_dryrun_multichip_host_count_set_but_default_backend_not_cpu():
+    # The MULTICHIP_r03 crash shape: the driver sets
+    # --xla_force_host_platform_device_count=8 but NOT JAX_PLATFORMS, and
+    # initializes backends first.  CPU can seat the mesh, but the DEFAULT
+    # backend is the (possibly broken, libtpu-skewed) accelerator plugin:
+    # any eager op on an uncommitted array would dispatch there and crash.
+    # The gate must route to the hermetic CPU subprocess instead.
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax\n"
+         "try: jax.devices()\n"
+         "except Exception: pass\n"
+         "import __graft_entry__ as g; g.dryrun_multichip(8)\n"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "8-device mesh, groupby-sum OK" in r.stdout
+    # when an accelerator plugin is present (default backend != cpu),
+    # the hermetic-subprocess route must have been taken; on cpu-only
+    # machines the in-process branch is correct and the marker absent.
+    if "hermetic CPU subprocess" not in r.stderr:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+        assert probe.stdout.strip() == "cpu", (
+            "accelerator default backend but in-process path taken:\n"
+            + r.stderr[-1000:])
+
+
+def test_dryrun_routes_to_subprocess_when_default_backend_not_cpu(
+        monkeypatch):
+    # unit-level: with backends initialized and a non-cpu default
+    # backend reported, the in-process path must NOT be taken even
+    # though CPU seats the mesh.
+    import jax
+
+    import __graft_entry__ as g
+    assert len(jax.devices("cpu")) >= 8
+    calls = []
+    monkeypatch.setattr(g, "_dryrun_subprocess",
+                        lambda n: calls.append(n))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    g.dryrun_multichip(8)
+    assert calls == [8]
+
+
 def test_dryrun_multichip_in_suite():
     # pin the initialized-backend in-process branch: force backend init
     # (conftest provisioned 8 CPU devices) before calling the gate
