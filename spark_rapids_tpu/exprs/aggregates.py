@@ -409,17 +409,20 @@ def CountStar() -> Count:
 
 @dataclasses.dataclass
 class VarianceSamp(AggregateFunction):
-    """Spark var_samp -> double; intermediates (sum, sum_sq, count).
-    Null for groups with fewer than two non-null inputs (pandas ddof=1
-    semantics; reference registers GpuStddevSamp-family aggregates over
-    cuDF VARIANCE/STD)."""
+    """Spark var_samp -> double; intermediates (count, mean, m2) with a
+    Welford/Chan-style merge — the same buffer layout as Spark's
+    CentralMomentAgg, and numerically stable where raw (sum, sum_sq)
+    intermediates cancel catastrophically (large-magnitude low-variance
+    data, e.g. values ~1e8).  Null for groups with fewer than two
+    non-null inputs (pandas ddof=1 semantics; reference registers
+    GpuStddevSamp-family aggregates over cuDF VARIANCE/STD)."""
     child: Expression
 
     def result_type(self, schema):
         return T.FLOAT64
 
     def intermediate_types(self, schema):
-        return (T.FLOAT64, T.FLOAT64, T.INT64)
+        return (T.INT64, T.FLOAT64, T.FLOAT64)
 
     num_intermediates = 3
 
@@ -430,37 +433,41 @@ class VarianceSamp(AggregateFunction):
         (v,) = inputs
         ok = v.validity & ctx.row_valid
         x = jnp.where(ok, v.data.astype(jnp.float64), 0.0)
-        s = _sorted_seg_sum(x, ctx.seg_ids, ctx.capacity)
-        s2 = _sorted_seg_sum(x * x, ctx.seg_ids, ctx.capacity)
         c = _sorted_seg_sum(ok.astype(jnp.int64), ctx.seg_ids,
                             ctx.capacity)
+        s = _sorted_seg_sum(x, ctx.seg_ids, ctx.capacity)
+        mean = s / jnp.maximum(c, 1).astype(jnp.float64)
+        # second pass against the group mean: m2 = sum((x - mean)^2)
+        d = jnp.where(ok, x - jnp.take(mean, ctx.seg_ids), 0.0)
+        m2 = _sorted_seg_sum(d * d, ctx.seg_ids, ctx.capacity)
         always = jnp.ones(ctx.capacity, bool)
-        return (ColumnVector(T.FLOAT64, s, always),
-                ColumnVector(T.FLOAT64, s2, always),
-                ColumnVector(T.INT64, c, always))
+        return (ColumnVector(T.INT64, c, always),
+                ColumnVector(T.FLOAT64, mean, always),
+                ColumnVector(T.FLOAT64, m2, always))
 
     def merge(self, ctx, partials):
-        s_p, s2_p, c_p = partials
+        c_p, mean_p, m2_p = partials
         ok = ctx.row_valid
-        s = _sorted_seg_sum(jnp.where(ok, s_p.data, 0.0), ctx.seg_ids,
-                            ctx.capacity)
-        s2 = _sorted_seg_sum(jnp.where(ok, s2_p.data, 0.0),
-                             ctx.seg_ids, ctx.capacity)
-        c = _sorted_seg_sum(jnp.where(ok, c_p.data, 0), ctx.seg_ids,
-                            ctx.capacity)
+        cr = jnp.where(ok, c_p.data, 0)
+        crf = cr.astype(jnp.float64)
+        c = _sorted_seg_sum(cr, ctx.seg_ids, ctx.capacity)
+        s = _sorted_seg_sum(jnp.where(ok, mean_p.data * crf, 0.0),
+                            ctx.seg_ids, ctx.capacity)
+        mean = s / jnp.maximum(c, 1).astype(jnp.float64)
+        # Chan's parallel merge: m2 = sum_i(m2_i + c_i*(mean_i - mean)^2)
+        delta = mean_p.data - jnp.take(mean, ctx.seg_ids)
+        contrib = jnp.where(ok, m2_p.data + crf * delta * delta, 0.0)
+        m2 = _sorted_seg_sum(contrib, ctx.seg_ids, ctx.capacity)
         always = jnp.ones(ctx.capacity, bool)
-        return (ColumnVector(T.FLOAT64, s, always),
-                ColumnVector(T.FLOAT64, s2, always),
-                ColumnVector(T.INT64, c, always))
+        return (ColumnVector(T.INT64, c, always),
+                ColumnVector(T.FLOAT64, mean, always),
+                ColumnVector(T.FLOAT64, m2, always))
 
     def _var(self, partials):
-        s, s2, c = partials
-        n = c.data.astype(jnp.float64)
+        c, _mean, m2 = partials
         ok = c.data > 1
-        denom = jnp.where(ok, n - 1.0, 1.0)
-        m2 = s2.data - (s.data * s.data) / jnp.where(c.data > 0, n, 1.0)
-        # clamp tiny negative residue from cancellation
-        return jnp.maximum(m2, 0.0) / denom, ok
+        denom = jnp.where(ok, c.data.astype(jnp.float64) - 1.0, 1.0)
+        return m2.data / denom, ok
 
     def evaluate(self, partials, schema):
         var, ok = self._var(partials)

@@ -47,6 +47,12 @@ class ShuffleExchangeExec(UnaryExecBase):
     #: several device round trips (AQE-style small-input coalescing)
     SMALL_RANGE_INPUT_ROWS = 1 << 15
 
+    #: max map-side batches whose split outputs may be device-resident
+    #: at once in the two-phase split pipeline (see _materialize); deep
+    #: enough that count readbacks fully overlap, shallow enough that an
+    #: arbitrarily large map side can't OOM the device
+    SPLIT_PIPELINE_DEPTH = 8
+
     def _range_inputs(self):
         """Range partitioning needs two passes over the child (sample
         bounds, then split), so its inputs are materialized once here.
@@ -78,19 +84,33 @@ class ShuffleExchangeExec(UnaryExecBase):
                           for b in it if b.maybe_nonempty())
         buckets: list[list[ColumnarBatch]] = [[] for _ in range(n)]
         if hasattr(part, "split_device"):
-            # two-phase: queue every batch's split kernel back-to-back,
-            # overlap all the count readbacks, then slice — ONE
-            # effective host round trip for the whole map side instead
-            # of one ~120ms sync per batch
+            # two-phase pipeline: queue split kernels back-to-back and
+            # overlap the count readbacks, finishing the oldest batch
+            # once SPLIT_PIPELINE_DEPTH are in flight.  By the time a
+            # batch becomes the oldest its async count readback has
+            # landed, so the whole map side still pays ~one effective
+            # host round trip — but peak device memory is bounded at
+            # SPLIT_PIPELINE_DEPTH full-capacity split outputs instead
+            # of the entire map side.
             with self.metrics.timed(M.TOTAL_TIME):
-                pending = [part.split_device(b) for b in batch_iter]
-                for _, counts, _b in pending:
+                pending: list = []
+                slice_lists = []
+
+                def finish_oldest():
+                    c, k, b = pending.pop(0)
+                    slice_lists.append(part.finish_split(c, k, b))
+
+                for batch in batch_iter:
+                    t = part.split_device(batch)
                     try:
-                        counts.copy_to_host_async()
+                        t[1].copy_to_host_async()
                     except Exception:
                         pass
-                slice_lists = [part.finish_split(c, k, b)
-                               for c, k, b in pending]
+                    pending.append(t)
+                    if len(pending) >= self.SPLIT_PIPELINE_DEPTH:
+                        finish_oldest()
+                while pending:
+                    finish_oldest()
         else:
             slice_lists = []
             for batch in batch_iter:

@@ -60,10 +60,12 @@ def register(check: BatchCheck) -> BatchCheck:
 def verify(checks) -> None:
     """Resolve the given checks now (syncs); raise on any failure.
 
-    All device flags are stacked into ONE tiny device array and pulled
-    in a single D2H transfer — per-array readbacks cost a full tunnel
-    round-trip each (~25ms), which dominated collect() when a query
-    carried dozens of checks."""
+    Device flags are stacked into one tiny array PER DEVICE GROUP and
+    pulled in one D2H transfer per group (single-chip: exactly one) —
+    per-array readbacks cost a full tunnel round-trip each (~25ms),
+    which dominated collect() when a query carried dozens of checks.
+    Flags with no identifiable single device (e.g. sharded across a
+    mesh) fall back to per-flag readback."""
     checks = list(checks)
     if not checks:
         return
@@ -78,9 +80,29 @@ def verify(checks) -> None:
     bad_set = set(host_bad)
     if device_flags:
         import jax.numpy as jnp
-        stacked = np.asarray(jnp.stack(
-            [jnp.asarray(f, bool).reshape(()) for f in device_flags]))
-        bad_set.update(i for i, b in zip(device_idx, stacked) if b)
+
+        def _dev_key(f):
+            try:
+                return frozenset(f.devices())
+            except Exception:
+                return None
+
+        # stack per device: jnp.stack raises on mixed-device operands
+        # (multichip runs commit flags to different mesh devices)
+        groups: dict = {}
+        for i, f in zip(device_idx, device_flags):
+            groups.setdefault(_dev_key(f), []).append((i, f))
+        for items in groups.values():
+            try:
+                stacked = np.asarray(jnp.stack(
+                    [jnp.asarray(f, bool).reshape(()) for _, f in items]))
+                bad_set.update(i for (i, _), b in zip(items, stacked) if b)
+            except Exception:
+                # arbitrary placement (e.g. flags sharded across devices):
+                # per-flag readback still resolves correctly
+                for i, f in items:
+                    if bool(np.asarray(f)):
+                        bad_set.add(i)
     bad = [c for i, c in enumerate(checks) if i in bad_set]
     with _LOCK:
         for c in checks:

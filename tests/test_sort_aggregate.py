@@ -198,6 +198,72 @@ def test_partial_final_split(rng):
     np.testing.assert_allclose(out["a"], exp["a"], rtol=1e-12)
 
 
+def test_verify_handles_flags_on_mixed_devices():
+    """ADVICE r3: flags committed to different mesh devices must not
+    break the single-stack readback (jnp.stack raises on mixed-device
+    operands)."""
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.utils.checks import (
+        BatchCheck, FastPathInvalid, verify)
+    devs = jax.devices("cpu")
+    assert len(devs) >= 2
+    flags = [jax.device_put(jnp.asarray(i == 2), devs[i % 2])
+             for i in range(4)]
+    checks = [BatchCheck(f, origin=f"c{i}") for i, f in enumerate(flags)]
+    with pytest.raises(FastPathInvalid) as ei:
+        verify(checks)
+    assert [c.origin for c in ei.value.checks] == ["c2"]
+    # all-clean across devices resolves silently
+    verify([BatchCheck(jax.device_put(jnp.asarray(False), devs[i % 2]),
+                       origin=f"ok{i}") for i in range(3)])
+
+
+def test_variance_welford_large_magnitude(rng):
+    """ADVICE r3: (sum, sum_sq) intermediates cancel catastrophically on
+    large-magnitude low-variance data; the Welford (count, mean, m2)
+    buffer must match pandas ddof=1 through BOTH the single-phase and
+    the partial/final (merge) paths."""
+    from spark_rapids_tpu.exprs.aggregates import StddevSamp, VarianceSamp
+    df = pd.DataFrame({
+        "g": rng.integers(0, 5, 400).astype(np.int64),
+        # values ~1e8 with variance ~1: sum_sq ~1e16 per row, so the
+        # old s2 - s^2/n path lost every significant digit
+        "x": 1e8 + rng.normal(size=400),
+    })
+    exp = (df.groupby("g")["x"].agg(v="var", s="std").reset_index()
+           .sort_values("g").reset_index(drop=True))
+    single = HashAggregateExec(
+        [col("g")],
+        [VarianceSamp(col("x")).alias("v"), StddevSamp(col("x")).alias("s")],
+        CoalescePartitionsExec(
+            1, LocalBatchSource.from_pandas(df, num_partitions=3)))
+    out = single.to_pandas().sort_values("g").reset_index(drop=True)
+    np.testing.assert_allclose(out["v"], exp["v"], rtol=1e-6)
+    np.testing.assert_allclose(out["s"], exp["s"], rtol=1e-6)
+    partial = HashAggregateExec(
+        [col("g")],
+        [VarianceSamp(col("x")).alias("v"), StddevSamp(col("x")).alias("s")],
+        LocalBatchSource.from_pandas(df, num_partitions=4),
+        mode=AggMode.PARTIAL)
+    final = HashAggregateExec(
+        [col("g")],
+        [VarianceSamp(col("x")).alias("v"), StddevSamp(col("x")).alias("s")],
+        CoalescePartitionsExec(1, partial), mode=AggMode.FINAL)
+    out2 = final.to_pandas().sort_values("g").reset_index(drop=True)
+    np.testing.assert_allclose(out2["v"], exp["v"], rtol=1e-6)
+    np.testing.assert_allclose(out2["s"], exp["s"], rtol=1e-6)
+    # n<2 groups are null
+    tiny = pd.DataFrame({"g": np.array([0, 1, 1], np.int64),
+                         "x": np.array([5.0, 2.0, 4.0])})
+    out3 = HashAggregateExec(
+        [col("g")], [VarianceSamp(col("x")).alias("v")],
+        CoalescePartitionsExec(
+            1, LocalBatchSource.from_pandas(tiny))).to_pandas()
+    out3 = out3.sort_values("g").reset_index(drop=True)
+    assert pd.isna(out3["v"][0]) and abs(out3["v"][1] - 2.0) < 1e-12
+
+
 def test_first_last(rng):
     b = ColumnarBatch.from_numpy(
         {"k": np.array([1, 1, 1, 2], np.int64),
