@@ -22,14 +22,40 @@ _jax.config.update("jax_enable_x64", True)
 # processes (verified through the axon tunnel), so cold starts are paid
 # once per machine.  Opt out with SPARK_RAPIDS_TPU_NO_COMPILE_CACHE=1 or
 # override the standard JAX_COMPILATION_CACHE_DIR.
+def _host_cache_key() -> str:
+    """Fingerprint the host's CPU feature set: XLA:CPU AOT artifacts
+    compiled on one machine type SIGILL on another (observed when a
+    cache dir written under avx512 'prefer-no-gather' hosts was loaded
+    on a different host), so each machine type gets its own cache dir."""
+    import hashlib
+    import platform
+    feat = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feat += line
+                    break
+    except OSError:
+        pass
+    return hashlib.sha1(feat.encode()).hexdigest()[:12]
+
+
 if not _os.environ.get("SPARK_RAPIDS_TPU_NO_COMPILE_CACHE"):
     _cache_dir = _os.environ.get(
         "JAX_COMPILATION_CACHE_DIR",
-        _os.path.expanduser("~/.cache/spark_rapids_tpu/xla"))
+        _os.path.expanduser("~/.cache/spark_rapids_tpu/xla-"
+                            + _host_cache_key()))
     try:
         _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        # the workload suites compile hundreds of small kernels per
+        # query (~70ms each on XLA:CPU, 68 for TPC-DS q1 alone); at the
+        # default threshold NONE of them persist and every suite run
+        # re-pays the full compile bill — persist everything
         _jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                           2.0)
+                           0.0)
+        _jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                           0)
     except Exception:  # older jax without the knobs: in-memory only
         pass
 

@@ -32,6 +32,7 @@ from spark_rapids_tpu.exprs.aggregates import (
 from spark_rapids_tpu.exprs.base import Expression, output_name
 from spark_rapids_tpu.ops.sort_encode import (
     multi_key_argsort, segment_boundaries)
+from spark_rapids_tpu.utils import checks as CK
 from spark_rapids_tpu.utils import metrics as M
 
 
@@ -152,12 +153,22 @@ class HashAggregateExec(UnaryExecBase):
                 seg_ids = jnp.cumsum(bounds.astype(jnp.int32)) - 1
                 num_groups = bounds.sum().astype(jnp.int32)
                 sorted_valid = jnp.take(ctx.row_mask, perm)
-                actx = AggContext(seg_ids, cap, sorted_valid)
-
-                out_cols = []
                 # group key representatives: first row of each segment
                 (first_idx,) = jnp.nonzero(bounds, size=cap,
                                            fill_value=cap - 1)
+                # per-segment LAST sorted row: one before the next
+                # segment's start; the last real segment (which also
+                # absorbs trailing invalid rows' segment ids) ends at
+                # cap-1 — aggregates fill invalid rows with identities
+                nxt = jnp.concatenate(
+                    [first_idx[1:],
+                     jnp.full((1,), cap, first_idx.dtype)])
+                ends = jnp.where(jnp.arange(cap) >= num_groups - 1,
+                                 cap - 1, nxt - 1).astype(jnp.int32)
+                actx = AggContext(seg_ids, cap, sorted_valid, bounds,
+                                  ends)
+
+                out_cols = []
                 grp_valid = jnp.arange(cap) < num_groups
                 for k in sorted_keys:
                     out_cols.append(k.gather(first_idx, grp_valid))
@@ -644,6 +655,31 @@ class HashAggregateExec(UnaryExecBase):
         return fused
 
     # -- execution ----------------------------------------------------------
+    #: optimistic capacity for compacted group batches: a sort-lane
+    #: partial otherwise stays at INPUT capacity (the group count is a
+    #: device scalar — syncing it costs ~150ms through the tunnel), so
+    #: every downstream op (exchange split, concat, merge re-sort) pays
+    #: multi-M-capacity kernels for a few thousand groups.  Group rows
+    #: are prefix-compacted by the kernel, so the compaction is a cheap
+    #: head slice + a deferred overflow check (deopt-and-retry).
+    COMPACT_GROUPS_CAP = 1 << 14
+
+    def _disable_compact(self) -> None:
+        self._compact_disabled = True
+
+    def _compact_groups(self, b: ColumnarBatch) -> ColumnarBatch:
+        tc = self.COMPACT_GROUPS_CAP
+        if getattr(self, "_compact_disabled", False) or b.capacity <= tc \
+                or b.sparse is not None:
+            return b
+        flag = b.num_rows_i32 > jnp.int32(tc)
+        chk = CK.register(CK.BatchCheck(
+            flag, origin="aggCompactGroups",
+            recover=self._disable_compact))
+        hb = b.take_head(tc)
+        return ColumnarBatch(hb.schema, list(hb.columns), hb._rows,
+                             hb.checks + (chk,))
+
     def process_partition(self, batches) -> Iterator[ColumnarBatch]:
         if not self.group_exprs:
             yield from self._reduction_path(batches)
@@ -666,9 +702,9 @@ class HashAggregateExec(UnaryExecBase):
                                    batch.sparse)
                 else:
                     cols, n = kern(batch.columns, batch.num_rows_i32)
-                partials.append(
+                partials.append(self._compact_groups(
                     ColumnarBatch(inter_fields, list(cols), n,
-                                  batch.checks))
+                                  batch.checks)))
 
         if not partials:
             return
@@ -709,7 +745,8 @@ class HashAggregateExec(UnaryExecBase):
         with self.metrics.timed(M.TOTAL_TIME):
             kern = merge_exec._groupby_kernel(merged, "merge")
             cols, n = kern(merged.columns, merged.num_rows_i32)
-        return ColumnarBatch(inter_schema, list(cols), n, merged.checks)
+        return self._compact_groups(
+            ColumnarBatch(inter_schema, list(cols), n, merged.checks))
 
     def _partial_schema(self) -> T.Schema:
         if self.mode == AggMode.FINAL:
@@ -761,7 +798,9 @@ class HashAggregateExec(UnaryExecBase):
             def kernel(columns, num_rows, mask=None):
                 ctx = make_eval_context(columns, cap, num_rows, mask)
                 seg_ids = jnp.zeros(cap, jnp.int32)
-                actx = AggContext(seg_ids, cap, ctx.row_mask)
+                actx = AggContext(seg_ids, cap, ctx.row_mask,
+                                  bounds=jnp.arange(cap) == 0,
+                                  ends=jnp.full(cap, cap - 1, jnp.int32))
                 out_cols = []
                 if phase == "update":
                     for f, bins in zip(funcs, self._bound_inputs):

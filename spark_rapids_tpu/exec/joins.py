@@ -42,6 +42,11 @@ from spark_rapids_tpu.ops.sort_encode import (
 from spark_rapids_tpu.utils import metrics as M
 
 
+from spark_rapids_tpu.columnar.vector import (gather_narrowest,
+                                              pack_validity_bits,
+                                              validity_bit_assignment)
+
+
 class JoinType(enum.Enum):
     INNER = "inner"
     LEFT_OUTER = "left_outer"
@@ -316,13 +321,13 @@ class HashJoinExec(TpuExec):
         if span <= int(conf[C.DENSE_JOIN_MAX_SPAN]):
             g = int(bucket_capacity(max(span, 1)))
             tab_kern = self._join_cache.get_or_build(
-                ("dense-table", g, batch_signature(build)),
+                ("dense-table2", g, batch_signature(build)),
                 lambda: jax.jit(self._build_dense_table_kernel(
                     build.capacity, g)))
-            bidx_tab, cnt_tab, max_cnt = tab_kern(
+            bidx1_tab, vmask_tab, max_cnt = tab_kern(
                 build.columns, build.num_rows_i32, jnp.int64(kmin))
             if int(max_cnt) <= 1:  # unique build keys required
-                entry = (kmin, g, bidx_tab, cnt_tab)
+                entry = (kmin, g, bidx1_tab, vmask_tab)
         # single-entry cache (repeated collects rebuild the build batch
         # each execute — keeping every old one would pin device memory);
         # the strong ref to the build batch keeps id() valid
@@ -373,25 +378,50 @@ class HashJoinExec(TpuExec):
             slots = jnp.where(in_t, off, g).astype(jnp.int32)
             cnt_tab = jnp.zeros(g + 1, jnp.int32).at[slots].add(
                 in_t.astype(jnp.int32))
-            bidx1 = jnp.zeros(g + 1, jnp.int32).at[slots].add(
+            # unique keys are required downstream, so one i32 table
+            # carries both the row index AND the occupancy test:
+            # bidx1[slot] = build row + 1, 0 = empty slot
+            bidx1_tab = jnp.zeros(g + 1, jnp.int32).at[slots].add(
                 jnp.where(in_t, jnp.arange(cap, dtype=jnp.int32) + 1, 0))
-            bidx_tab = bidx1 - 1
-            return bidx_tab, cnt_tab, cnt_tab[:g].max()
+            # pack every non-string build column's validity into one
+            # i32 bitmask per slot: the probe side then resolves ALL
+            # column validities with a single gather instead of one
+            # bool gather per column (random-access passes dominate
+            # probe cost on this chip, ~70ns/row each)
+            _, packed = pack_validity_bits(columns)
+            if packed is None:
+                packed = jnp.zeros(cap, jnp.int32)
+            vmask_tab = jnp.zeros(g + 1, jnp.int32).at[slots].add(
+                jnp.where(in_t, packed, 0))
+            return bidx1_tab, vmask_tab, cnt_tab[:g].max()
         return kernel
+
+    def _dense_key_remat_ordinal(self) -> Optional[int]:
+        """Ordinal of the build column the (single) build key reads
+        directly, or None.  For an equi-join, that column's matched-row
+        values EQUAL the probe key values, so the probe side can
+        rematerialize it from the probe key instead of paying a gather
+        stream (storage dtypes must agree for bit-exact remat)."""
+        from spark_rapids_tpu.exprs.base import BoundReference
+        bk = self._build_keys[0]
+        if isinstance(bk, BoundReference):
+            return bk.ordinal
+        return None
 
     def _dense_probe_kernel(self, build: ColumnarBatch,
                             probe: ColumnarBatch, g: int,
                             narrow_ok: bool):
-        key = ("dense-join", g, narrow_ok, batch_signature(build),
+        key = ("dense-join2", g, narrow_ok, batch_signature(build),
                batch_signature(probe))
         jt = self.join_type
 
         def build_fn():
             pcap = probe.capacity
             probe_key = self._probe_keys[0]
+            remat_ord = self._dense_key_remat_ordinal()
 
             @jax.jit
-            def kernel(pcols, pnum, bcols, bidx_tab, cnt_tab, kmin,
+            def kernel(pcols, pnum, bcols, bidx1_tab, vmask_tab, kmin,
                        pmask=None):
                 ctx = make_eval_context(pcols, pcap, pnum, pmask)
                 pk = probe_key.eval(ctx)
@@ -410,23 +440,45 @@ class HashJoinExec(TpuExec):
                     in_t = ok & (off64 >= 0) & (off64 < g)
                     off = off64.astype(jnp.int32)
                 slot = jnp.where(in_t, off, g)
-                cnt = jnp.take(cnt_tab, slot, mode="clip")
-                matched = in_t & (cnt > 0)
+                bsel1 = jnp.take(bidx1_tab, slot, mode="clip")
+                matched = in_t & (bsel1 > 0)
                 if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
                     keep = (ctx.row_mask & ~matched
                             if jt == JoinType.LEFT_ANTI
                             else matched)
                     return keep
-                bsel = jnp.where(matched,
-                                 jnp.take(bidx_tab, slot, mode="clip"), 0)
-                bout = [c.gather(bsel, matched) for c in bcols]
+                bsel = jnp.where(matched, bsel1 - 1, 0)
+                # random-access passes dominate here (~70ns/row each):
+                # one bidx1 lookup + one packed-validity lookup + the
+                # narrowest possible per-column payload gather, with
+                # the build KEY column rematerialized from the probe
+                # key (equi-join: matched-row values are equal)
+                vm = jnp.take(vmask_tab, slot, mode="clip")
+                vbits = validity_bit_assignment(bcols)
+                bout = []
+                for ci, c in enumerate(bcols):
+                    if ci in vbits:
+                        valid = matched & (((vm >> vbits[ci]) & 1) != 0)
+                    else:
+                        valid = matched & jnp.take(c.validity, bsel,
+                                                   mode="clip")
+                    if (remat_ord == ci
+                            and pk.data.dtype == c.data.dtype
+                            and not c.dtype.is_string):
+                        # matched implies the build key is non-null
+                        bout.append(ColumnVector(
+                            c.dtype, pk.data, matched, None, pk.narrow))
+                    elif c.dtype.is_string:
+                        bout.append(c.gather(bsel, matched))
+                    else:
+                        bout.append(gather_narrowest(c, bsel, valid))
                 return bout, matched
             return kernel
 
         return self._join_cache.get_or_build(key, build_fn)
 
     def _execute_dense(self, build, tab) -> Iterator[ColumnarBatch]:
-        kmin, g, bidx_tab, cnt_tab = tab
+        kmin, g, bidx1_tab, vmask_tab = tab
         jt = self.join_type
         kmin_op = jnp.int64(kmin)
         i32 = np.iinfo(np.int32)
@@ -439,7 +491,7 @@ class HashJoinExec(TpuExec):
                     kern = self._dense_probe_kernel(build, pb, g,
                                                     narrow_ok)
                     args = (pb.columns, pb.num_rows_i32, build.columns,
-                            bidx_tab, cnt_tab, kmin_op)
+                            bidx1_tab, vmask_tab, kmin_op)
                     if pb.sparse is not None:
                         args = args + (pb.sparse,)
                     if jt in _PROBE_ONLY:

@@ -63,6 +63,13 @@ class GlobalLimitExec(UnaryExecBase):
         return f"GlobalLimitExec({self.n})"
 
     def execute_columnar(self):
+        from spark_rapids_tpu.exec.sort import SortExec
+        if (isinstance(self.child, SortExec) and self.child.global_sort):
+            # fuse the limit into the sort's gather (the sort kernel
+            # then never materializes full-capacity payload columns)
+            yield from _limited(self.child.execute_head(self.n), self.n,
+                                self.update_output_metrics)
+            return
         def chain():
             for part in self.child.execute_partitions():
                 yield from part

@@ -73,14 +73,18 @@ class SortExec(UnaryExecBase):
                 tuple((o.ascending, o.resolved_nulls_first)
                       for o in self.order))
 
-    def _kernel(self, batch: ColumnarBatch):
-        key = ("sort", batch_signature(batch))
+    def _kernel(self, batch: ColumnarBatch, head: Optional[int] = None):
+        key = ("sort", head, batch_signature(batch))
 
         def build():
             bound = self._bound
             specs = [(o.ascending, o.resolved_nulls_first)
                      for o in self.order]
             cap = batch.capacity
+            out_cap = cap
+            if head is not None and head < cap:
+                from spark_rapids_tpu.columnar.vector import bucket_capacity
+                out_cap = bucket_capacity(head)
 
             @jax.jit
             def kernel(columns, num_rows, mask=None):
@@ -91,7 +95,15 @@ class SortExec(UnaryExecBase):
                     ctx.row_mask)
                 # selected rows sort FIRST (row_mask is the most
                 # significant key), so a sparse input compacts for free
-                valid = jnp.arange(cap) < num_rows
+                count = num_rows
+                if out_cap < cap:
+                    # fused limit: gather only the head — skipping the
+                    # full-capacity payload gathers is the whole win
+                    # (each costs ~30ms at 4M rows on this chip)
+                    perm = perm[:out_cap]
+                    count = jnp.minimum(num_rows,
+                                        jnp.int32(min(head, out_cap)))
+                valid = jnp.arange(out_cap) < count
                 return [c.gather(perm, valid) for c in columns]
 
             return kernel
@@ -116,23 +128,40 @@ class SortExec(UnaryExecBase):
                 yield from it
         return [self.process_partition(chain())]
 
-    def process_partition(self, batches) -> Iterator[ColumnarBatch]:
+    def process_partition(self, batches,
+                          head: Optional[int] = None
+                          ) -> Iterator[ColumnarBatch]:
         if self.global_sort:
             from spark_rapids_tpu.exec.coalesce import coalesce_iterator
             batches = coalesce_iterator(
                 batches, RequireSingleBatch(), self._schema, self.metrics)
         for batch in batches:
             with self.metrics.timed(M.TOTAL_TIME):
-                kernel = self._kernel(batch)
+                kernel = self._kernel(batch, head)
                 if batch.sparse is not None:
                     cols = kernel(batch.columns, batch.num_rows_i32,
                                   batch.sparse)
                 else:
                     cols = kernel(batch.columns, batch.num_rows_i32)
-                out = ColumnarBatch(self._schema, list(cols), batch._rows,
+                rows = batch._rows
+                if head is not None:
+                    rows = (min(rows, head) if batch.num_rows_known
+                            else jnp.minimum(batch.num_rows_i32,
+                                             jnp.int32(head)))
+                out = ColumnarBatch(self._schema, list(cols), rows,
                                     batch.checks)
                 self.update_output_metrics(out)
             yield out
+
+    def execute_head(self, n: int) -> Iterator[ColumnarBatch]:
+        """Global sort fused with a LIMIT n: the sort kernel gathers only
+        the head rows at bucket(n) capacity (a GlobalLimitExec parent
+        dispatches here; Spark's planner does the same fusion by
+        rewriting to TakeOrderedAndProject)."""
+        def chain():
+            for it in self.child.execute_partitions():
+                yield from it
+        return self.process_partition(chain(), head=n)
 
 
 class SortedTopNExec(UnaryExecBase):
@@ -209,16 +238,46 @@ class SortedTopNExec(UnaryExecBase):
                 special = jnp.any(valid &
                                   (jnp.abs(d) >= jnp.float64(2**53)))
 
-            def topk_branch():
-                sv = d if not o.ascending else -d
-                if dt.is_floating:
-                    nan_score = NBIG if not o.ascending else -NBIG
-                    sv = jnp.where(jnp.isnan(d), nan_score, sv)
-                null_score = BIG if o.resolved_nulls_first else -BIG
-                score = jnp.where(k.validity, sv, null_score)
-                score = jnp.where(ctx.row_mask, score, -jnp.inf)
-                _, idx = jax.lax.top_k(score, kk)
-                return idx.astype(jnp.int32)
+            sv = d if not o.ascending else -d
+            if dt.is_floating:
+                nan_score = NBIG if not o.ascending else -NBIG
+                sv = jnp.where(jnp.isnan(d), nan_score, sv)
+            null_score = BIG if o.resolved_nulls_first else -BIG
+            score = jnp.where(k.validity, sv, null_score)
+            score = jnp.where(ctx.row_mask, score, -jnp.inf)
+
+            # 64-bit top_k is ~8x slower than 32-bit on this chip: prune
+            # candidates with a MONOTONE f32 downcast of the score, then
+            # re-rank just the candidates exactly in f64.  Sound unless
+            # the f32 tie bucket at the candidate boundary could hide a
+            # true top-k row — detected on device and routed (with the
+            # NaN/magnitude specials) to the exact 64-bit sort branch.
+            kkp = min(cap, max(4 * kk, kk + 118))
+            # clip BEFORE the downcast so the +/-BIG null sentinels stay
+            # FINITE in f32 (a raw downcast overflows them to +/-inf,
+            # conflating nulls-last rows with row-mask-excluded rows);
+            # masked rows are re-pinned to -inf afterwards.  clip is
+            # monotone non-strict, so collapsed extremes are exactly the
+            # tie case the boundary guard already routes to the exact
+            # branch.
+            score32 = jnp.where(
+                ctx.row_mask,
+                jnp.clip(score, -3.0e38, 3.0e38).astype(jnp.float32),
+                -jnp.inf)
+            vals32, cand = jax.lax.top_k(score32, kkp)
+            cand_exact = jnp.take(score, cand)
+            order = jnp.argsort(-cand_exact)
+            topk_idx = jnp.take(cand, order[:kk]).astype(jnp.int32)
+            # boundary tie: the K'-th kept f32 key equals the k-th —
+            # rows beyond K' with the same f32 key may beat kept ones
+            # in f64.  A -inf boundary means fewer than k real rows, so
+            # every real row is already a candidate; kkp == cap means
+            # EVERY row is a candidate (statically sound).
+            if kkp >= cap:
+                unsound = jnp.bool_(False)
+            else:
+                unsound = ((vals32[kkp - 1] == vals32[kk - 1])
+                           & (vals32[kk - 1] != -jnp.inf))
 
             def sort_branch():
                 perm = multi_key_argsort(
@@ -226,7 +285,8 @@ class SortedTopNExec(UnaryExecBase):
                     ctx.row_mask)
                 return perm[:kk].astype(jnp.int32)
 
-            idx = jax.lax.cond(special, sort_branch, topk_branch)
+            idx = jax.lax.cond(special | unsound, sort_branch,
+                               lambda: topk_idx)
             count = jnp.minimum(jnp.asarray(num_rows, jnp.int32), kk)
             pad_idx = jnp.zeros(out_cap, jnp.int32).at[:kk].set(idx)
             valid_out = jnp.arange(out_cap) < count

@@ -41,44 +41,49 @@ _INT_MAX = {
 }
 
 
-def _seg_sum(vals, seg, n):
-    return jax.ops.segment_sum(vals, seg, num_segments=n)
+def _segscan(combine_vals, bounds, *vals):
+    """Segmented inclusive scan over rows SORTED by group (Blelchian
+    flag-reset operator): the carry resets at each segment start, so
+    per-group running reductions cost O(log n) elementwise passes and
+    no scatter — XLA:TPU serializes scatters, and the binary-search
+    (searchsorted) alternative measured ~300ms/call at 2M rows where
+    scans measure noise-level.  `combine_vals(a_vals, b_vals)` combines
+    two ADJACENT spans' value tuples (left, right)."""
+    from jax import lax
+
+    def comb(a, b):
+        fa, a_vals = a[0], a[1:]
+        fb, b_vals = b[0], b[1:]
+        merged = combine_vals(a_vals, b_vals)
+        return (fa | fb,) + tuple(
+            jnp.where(fb, bv, mv) for bv, mv in zip(b_vals, merged))
+
+    out = lax.associative_scan(comb, (bounds,) + vals)
+    return out[1:]
 
 
-def _sorted_seg_sum(vals, seg, n):
-    """Segment sum for NON-DECREASING `seg` (the exec feeds rows sorted
-    by group key): cumsum + vectorized binary-search gathers instead of
-    a scatter, which serializes on TPU.  Invalid rows must already be
-    value-zeroed (they may share the last group's id).  Integer sums
-    stay exact even if the running cumsum wraps (two's-complement
-    wraparound cancels in the difference).  Floats take the scatter
-    path: a global cumsum difference cancels catastrophically when group
-    magnitudes differ (a ~1e16 group steals every smaller group's
-    precision), which is beyond the reordering the variableFloatAgg gate
-    licenses."""
-    if jnp.issubdtype(vals.dtype, jnp.floating):
-        return _seg_sum(vals, seg, n)
-    c = jnp.cumsum(vals)
-    idx = jnp.arange(n)
-    hi = jnp.searchsorted(seg, idx, side="right")
-    lo = jnp.searchsorted(seg, idx, side="left")
-    last = vals.shape[0] - 1
-    chi = jnp.where(hi > 0, jnp.take(c, jnp.clip(hi - 1, 0, last)), 0)
-    clo = jnp.where(lo > 0, jnp.take(c, jnp.clip(lo - 1, 0, last)), 0)
-    return chi - clo
+def _sorted_seg_sums(ctx: "AggContext", *vals):
+    """Per-group sums of several arrays in ONE segmented scan + gathers
+    at segment ends.  Additions happen in row order WITHIN each group
+    only (no cross-group mixing), so float results are at least as
+    deterministic as a hash groupby's, and integer wraparound matches
+    Spark's non-ANSI sum.  Invalid rows must already be value-zeroed
+    (they share the last group's segment id)."""
+    runs = _segscan(lambda a, b: tuple(x + y for x, y in zip(a, b)),
+                    ctx.bounds, *vals)
+    return tuple(jnp.take(r, ctx.ends) for r in runs)
 
 
-def _seg_min(vals, seg, n):
-    return jax.ops.segment_min(vals, seg, num_segments=n)
+def _sorted_seg_sum(vals, ctx: "AggContext"):
+    return _sorted_seg_sums(ctx, vals)[0]
 
 
-def _seg_max(vals, seg, n):
-    return jax.ops.segment_max(vals, seg, num_segments=n)
-
-
-def _drop_invalid(seg_ids, valid, capacity):
-    """Invalid rows -> segment id == capacity (out of range => dropped)."""
-    return jnp.where(valid, seg_ids, capacity)
+def _sorted_seg_minmax(vals, ctx: "AggContext", is_min: bool):
+    """Per-group min/max via segmented scan; invalid rows must already
+    be filled with the reduction identity."""
+    op = jnp.minimum if is_min else jnp.maximum
+    (run,) = _segscan(lambda a, b: (op(a[0], b[0]),), ctx.bounds, vals)
+    return jnp.take(run, ctx.ends)
 
 
 @dataclasses.dataclass
@@ -86,6 +91,12 @@ class AggContext:
     seg_ids: jnp.ndarray     # per sorted row
     capacity: int            # == num_segments
     row_valid: jnp.ndarray   # sorted row mask
+    #: True at each sorted row that STARTS a group (invalid rows never
+    #: start one — they ride the last group's segment id)
+    bounds: jnp.ndarray
+    #: per-SEGMENT index of its last sorted row (cap-length; entries at
+    #: or past the group count are arbitrary and must be masked)
+    ends: jnp.ndarray
 
 
 class AggregateFunction:
@@ -155,19 +166,15 @@ class Sum(AggregateFunction):
         dt = _sum_type(v.dtype)
         acc = v.data.astype(dt.storage_dtype)
         ok = v.validity & ctx.row_valid
-        s = _sorted_seg_sum(jnp.where(ok, acc, 0), ctx.seg_ids,
-                            ctx.capacity)
-        cnt = _sorted_seg_sum(ok.astype(jnp.int64), ctx.seg_ids,
-                              ctx.capacity)
+        s, cnt = _sorted_seg_sums(ctx, jnp.where(ok, acc, 0),
+                                  ok.astype(jnp.int64))
         return (ColumnVector(dt, s, cnt > 0),)
 
     def merge(self, ctx, partials):
         (p,) = partials
         ok = p.validity & ctx.row_valid
-        s = _sorted_seg_sum(jnp.where(ok, p.data, 0), ctx.seg_ids,
-                            ctx.capacity)
-        cnt = _sorted_seg_sum(ok.astype(jnp.int64), ctx.seg_ids,
-                              ctx.capacity)
+        s, cnt = _sorted_seg_sums(ctx, jnp.where(ok, p.data, 0),
+                                  ok.astype(jnp.int64))
         return (ColumnVector(p.dtype, s, cnt > 0),)
 
     def evaluate(self, partials, schema):
@@ -190,15 +197,13 @@ class Count(AggregateFunction):
             ok = ctx.row_valid
         else:
             ok = inputs[0].validity & ctx.row_valid
-        c = _sorted_seg_sum(ok.astype(jnp.int64), ctx.seg_ids,
-                            ctx.capacity)
+        c = _sorted_seg_sum(ok.astype(jnp.int64), ctx)
         return (ColumnVector(T.INT64, c, jnp.ones(ctx.capacity, bool)),)
 
     def merge(self, ctx, partials):
         (p,) = partials
         ok = p.validity & ctx.row_valid
-        c = _sorted_seg_sum(jnp.where(ok, p.data, 0), ctx.seg_ids,
-                            ctx.capacity)
+        c = _sorted_seg_sum(jnp.where(ok, p.data, 0), ctx)
         return (ColumnVector(T.INT64, c, jnp.ones(ctx.capacity, bool)),)
 
     def evaluate(self, partials, schema):
@@ -212,34 +217,29 @@ def _minmax_numeric(v: ColumnVector, ctx: AggContext, is_min: bool):
     floats: max — NaN wins whenever present (map NaN -> +inf and track);
             min — NaN loses unless the whole group is NaN.
     """
-    cap = ctx.capacity
     ok = v.validity & ctx.row_valid
-    seg = _drop_invalid(ctx.seg_ids, ok, cap)
-    cnt = _seg_sum(ok.astype(jnp.int64), seg, cap)
-    has = cnt > 0
     if v.dtype.is_floating:
         nan = jnp.isnan(v.data) & ok
         non_nan = ok & ~nan
-        seg_nn = _drop_invalid(ctx.seg_ids, non_nan, cap)
-        n_non_nan = _seg_sum(non_nan.astype(jnp.int64), seg_nn, cap)
-        any_nan = _seg_sum(nan.astype(jnp.int64), seg, cap) > 0
         fill = jnp.inf if is_min else -jnp.inf
         masked = jnp.where(non_nan, v.data, fill)
-        red = _seg_min(masked, seg_nn, cap) if is_min else \
-            _seg_max(masked, seg_nn, cap)
+        red = _sorted_seg_minmax(masked, ctx, is_min)
+        cnt, n_non_nan = _sorted_seg_sums(
+            ctx, ok.astype(jnp.int64), non_nan.astype(jnp.int64))
+        has = cnt > 0
         if is_min:
             # all-NaN group -> NaN
             red = jnp.where(has & (n_non_nan == 0), jnp.nan, red)
         else:
             # any NaN -> NaN is the max
-            red = jnp.where(any_nan, jnp.nan, red)
+            red = jnp.where(cnt > n_non_nan, jnp.nan, red)
         return red.astype(v.dtype.storage_dtype), has
+    has = _sorted_seg_sum(ok.astype(jnp.int64), ctx) > 0
     lo = _INT_MIN[v.dtype.id]
     hi = _INT_MAX[v.dtype.id]
     fill = hi if is_min else lo
     masked = jnp.where(ok, v.data.astype(jnp.int64), fill)
-    red = _seg_min(masked, seg, cap) if is_min else \
-        _seg_max(masked, seg, cap)
+    red = _sorted_seg_minmax(masked, ctx, is_min)
     return red.astype(v.dtype.storage_dtype), has
 
 
@@ -271,30 +271,30 @@ class _MinMax(AggregateFunction):
         return partials[0]
 
     def _update_string(self, ctx, v: ColumnVector):
-        """Strings: argmin/argmax by byte-lexicographic rank.  Rank rows
-        with a per-segment sorted pass: reuse encode keys to lexsort and
-        take the first row per segment."""
+        """Strings: argmin/argmax by byte-lexicographic rank.  Lexsort
+        rows by (segment, ok-last, value); each segment keeps ALL its
+        rows, so the s-th distinct run in the sorted order IS segment s
+        and a positional nonzero over run starts yields every segment's
+        winner with no scatter (XLA:TPU serializes scatters)."""
         from spark_rapids_tpu.ops.sort_encode import (encode_key_bits,
                                                       packed_lexsort)
         cap = ctx.capacity
         ok = v.validity & ctx.row_valid
-        # lexsort by (segment, value) -> first row of each segment wins
         keys = encode_key_bits(v, ascending=self._is_min,
                                nulls_first=False)
-        seg_key = _drop_invalid(ctx.seg_ids, ok, cap)
-        # segment ids are < 2*cap, well inside 32 bits -> packable
-        order = packed_lexsort([(seg_key.astype(jnp.uint64), 32)] + keys)
-        seg_sorted = jnp.take(seg_key, order)
+        order = packed_lexsort(
+            [(ctx.seg_ids.astype(jnp.uint32), 32),
+             ((~ok).astype(jnp.uint8), 1)] + keys)
+        seg_sorted = jnp.take(ctx.seg_ids, order)
         isfirst = jnp.concatenate(
             [jnp.ones(1, bool), seg_sorted[1:] != seg_sorted[:-1]])
-        isfirst = isfirst & (seg_sorted < cap)
-        # scatter winner row index to its segment slot
-        win_per_seg = _seg_min(
-            jnp.where(isfirst, order, jnp.iinfo(jnp.int64).max),
-            jnp.where(isfirst, seg_sorted, cap), cap)
-        has = _seg_sum(ok.astype(jnp.int64),
-                       _drop_invalid(ctx.seg_ids, ok, cap), cap) > 0
-        idx = jnp.where(has, win_per_seg, 0).astype(jnp.int32)
+        # position of each segment's first (= winning) sorted row, in
+        # segment order — every segment has >= 1 row, so run index == id
+        (pos,) = jnp.nonzero(isfirst, size=cap, fill_value=cap - 1)
+        idx = jnp.take(order, pos).astype(jnp.int32)
+        has = _sorted_seg_sum(ok.astype(jnp.int64), ctx) > 0
+        # a group whose rows are all null/invalid sorted them first
+        # anyway — mask it out via `has`
         out = v.gather(idx, has)
         return (out,)
 
@@ -326,11 +326,9 @@ class Average(AggregateFunction):
     def update(self, ctx, inputs):
         (v,) = inputs
         ok = v.validity & ctx.row_valid
-        s = _sorted_seg_sum(
-            jnp.where(ok, v.data.astype(jnp.float64), 0.0),
-            ctx.seg_ids, ctx.capacity)
-        c = _sorted_seg_sum(ok.astype(jnp.int64), ctx.seg_ids,
-                            ctx.capacity)
+        s, c = _sorted_seg_sums(
+            ctx, jnp.where(ok, v.data.astype(jnp.float64), 0.0),
+            ok.astype(jnp.int64))
         always = jnp.ones(ctx.capacity, bool)
         return (ColumnVector(T.FLOAT64, s, always),
                 ColumnVector(T.INT64, c, always))
@@ -338,10 +336,8 @@ class Average(AggregateFunction):
     def merge(self, ctx, partials):
         s_p, c_p = partials
         ok = ctx.row_valid
-        s = _sorted_seg_sum(jnp.where(ok, s_p.data, 0.0), ctx.seg_ids,
-                            ctx.capacity)
-        c = _sorted_seg_sum(jnp.where(ok, c_p.data, 0), ctx.seg_ids,
-                            ctx.capacity)
+        s, c = _sorted_seg_sums(ctx, jnp.where(ok, s_p.data, 0.0),
+                                jnp.where(ok, c_p.data, 0))
         always = jnp.ones(ctx.capacity, bool)
         return (ColumnVector(T.FLOAT64, s, always),
                 ColumnVector(T.INT64, c, always))
@@ -373,14 +369,14 @@ class _FirstLast(AggregateFunction):
         cap = ctx.capacity
         ok = ctx.row_valid & (v.validity if self.ignore_nulls
                               else jnp.ones(cap, bool))
-        seg = _drop_invalid(ctx.seg_ids, ok, cap)
         rows = jnp.arange(cap, dtype=jnp.int64)
         if self._is_first:
-            pick = _seg_min(jnp.where(ok, rows, jnp.iinfo(jnp.int64).max),
-                            seg, cap)
+            pick = _sorted_seg_minmax(jnp.where(ok, rows, cap), ctx,
+                                      is_min=True)
         else:
-            pick = _seg_max(jnp.where(ok, rows, -1), seg, cap)
-        has = _seg_sum(ok.astype(jnp.int64), seg, cap) > 0
+            pick = _sorted_seg_minmax(jnp.where(ok, rows, -1), ctx,
+                                      is_min=False)
+        has = _sorted_seg_sum(ok.astype(jnp.int64), ctx) > 0
         idx = jnp.where(has, pick, 0).astype(jnp.int32)
         return (v.gather(idx, has),)
 
@@ -433,13 +429,11 @@ class VarianceSamp(AggregateFunction):
         (v,) = inputs
         ok = v.validity & ctx.row_valid
         x = jnp.where(ok, v.data.astype(jnp.float64), 0.0)
-        c = _sorted_seg_sum(ok.astype(jnp.int64), ctx.seg_ids,
-                            ctx.capacity)
-        s = _sorted_seg_sum(x, ctx.seg_ids, ctx.capacity)
+        s, c = _sorted_seg_sums(ctx, x, ok.astype(jnp.int64))
         mean = s / jnp.maximum(c, 1).astype(jnp.float64)
         # second pass against the group mean: m2 = sum((x - mean)^2)
         d = jnp.where(ok, x - jnp.take(mean, ctx.seg_ids), 0.0)
-        m2 = _sorted_seg_sum(d * d, ctx.seg_ids, ctx.capacity)
+        m2 = _sorted_seg_sum(d * d, ctx)
         always = jnp.ones(ctx.capacity, bool)
         return (ColumnVector(T.INT64, c, always),
                 ColumnVector(T.FLOAT64, mean, always),
@@ -450,14 +444,13 @@ class VarianceSamp(AggregateFunction):
         ok = ctx.row_valid
         cr = jnp.where(ok, c_p.data, 0)
         crf = cr.astype(jnp.float64)
-        c = _sorted_seg_sum(cr, ctx.seg_ids, ctx.capacity)
-        s = _sorted_seg_sum(jnp.where(ok, mean_p.data * crf, 0.0),
-                            ctx.seg_ids, ctx.capacity)
+        c, s = _sorted_seg_sums(
+            ctx, cr, jnp.where(ok, mean_p.data * crf, 0.0))
         mean = s / jnp.maximum(c, 1).astype(jnp.float64)
         # Chan's parallel merge: m2 = sum_i(m2_i + c_i*(mean_i - mean)^2)
         delta = mean_p.data - jnp.take(mean, ctx.seg_ids)
         contrib = jnp.where(ok, m2_p.data + crf * delta * delta, 0.0)
-        m2 = _sorted_seg_sum(contrib, ctx.seg_ids, ctx.capacity)
+        m2 = _sorted_seg_sum(contrib, ctx)
         always = jnp.ones(ctx.capacity, bool)
         return (ColumnVector(T.INT64, c, always),
                 ColumnVector(T.FLOAT64, mean, always),

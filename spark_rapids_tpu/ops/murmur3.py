@@ -125,9 +125,16 @@ def hash_column(col: ColumnVector, seed_u32: jnp.ndarray) -> jnp.ndarray:
                    T.TypeId.DATE32):
         h = hash_int(col.data.astype(jnp.int32).astype(jnp.uint32), seed_u32)
     elif dt.id in (T.TypeId.INT64, T.TypeId.TIMESTAMP_US):
-        v = col.data.astype(jnp.int64)
-        lo = (v & 0xFFFFFFFF).astype(jnp.uint32)
-        hi = ((v >> 32) & 0xFFFFFFFF).astype(jnp.uint32)
+        if col.narrow is not None:
+            # values fit int32 (narrow shadow): lo is the i32 bits,
+            # hi is the sign extension — pure 32-bit arithmetic,
+            # ~4x faster than the 64-bit word split on this chip
+            lo = col.narrow.astype(jnp.uint32)
+            hi = (col.narrow >> 31).astype(jnp.uint32)
+        else:
+            v = col.data.astype(jnp.int64)
+            lo = (v & 0xFFFFFFFF).astype(jnp.uint32)
+            hi = ((v >> 32) & 0xFFFFFFFF).astype(jnp.uint32)
         h = hash_long(lo, hi, seed_u32)
     elif dt.id == T.TypeId.FLOAT32:
         f = col.data

@@ -103,13 +103,33 @@ def encode_key_bits(col: ColumnVector, ascending: bool = True,
     elif dt.id == T.TypeId.INT16:
         keys.append(width_int(col.data, 16, 1 << 15))
     elif dt.id in (T.TypeId.INT32, T.TypeId.DATE32):
-        keys.append(width_int(col.data, 32, 1 << 31))
+        keys.append(_enc32(col.data.astype(jnp.int32), ascending))
+    elif col.narrow is not None:
+        # int64/timestamp whose values fit int32 (narrow shadow): a
+        # 32-bit encode halves the packed sort-word width — 64-bit
+        # compare-exchange is the dominant cost of bitonic sorts on
+        # this chip
+        keys.append(_enc32(col.narrow, ascending))
     else:  # int64 / timestamp
         enc = col.data.astype(jnp.int64).astype(jnp.uint64) ^ _SIGN64
         if not ascending:
             enc = ~enc
         keys.append((enc, 64))
     return keys
+
+
+def _enc32(x_i32, ascending: bool):
+    """int32 -> uint32 sort key in pure 32-bit ops (no int64 bias)."""
+    enc = lax.bitcast_convert_type(x_i32, jnp.uint32) ^ jnp.uint32(1 << 31)
+    if not ascending:
+        enc = ~enc
+    return (enc, 32)
+
+
+#: at or below this many packed words, one variadic sort replaces the
+#: per-word LSD chain (fewer networks, no re-gathers); above it the
+#: chain keeps XLA:TPU variadic-sort compile time bounded
+VARIADIC_MAX_WORDS = 3
 
 
 def packed_lexsort(keys_msf: list[tuple[jnp.ndarray, int]]) -> jnp.ndarray:
@@ -121,31 +141,53 @@ def packed_lexsort(keys_msf: list[tuple[jnp.ndarray, int]]) -> jnp.ndarray:
     runs as a chain of cheap 1-key stable sorts from the least significant
     word up — the classic LSD radix composition."""
     cap = keys_msf[0][0].shape[0]
-    words: list = []
+    words: list = []          # (array, used_bits or None)
     acc, used = None, 0
 
     def flush():
         nonlocal acc, used
         if acc is not None:
-            words.append(acc)
+            words.append((acc, used))
             acc, used = None, 0
 
     for arr, bits in keys_msf:
         if bits is None:
             flush()
-            words.append(arr)
+            words.append((arr, None))
             continue
-        a = arr.astype(jnp.uint64)
-        if acc is not None and used + bits <= 64:
-            acc = (acc << jnp.uint64(bits)) | a
+        if acc is not None and used + bits <= 32:
+            # stay in 32-bit arithmetic while the word fits: 64-bit
+            # shifts/ors are several times slower on this chip
+            acc = ((acc.astype(jnp.uint32) << jnp.uint32(bits))
+                   | arr.astype(jnp.uint32))
+            used += bits
+        elif acc is not None and used + bits <= 64:
+            acc = ((acc.astype(jnp.uint64) << jnp.uint64(bits))
+                   | arr.astype(jnp.uint64))
             used += bits
         else:
             flush()
-            acc, used = a, bits
+            acc, used = arr, bits
     flush()
     perm = jnp.arange(cap, dtype=jnp.int32)
-    for w in reversed(words):
-        kw = jnp.take(w, perm)
+
+    def narrowed(w, wbits):
+        if wbits is not None:
+            # sort at the narrowest width that holds the word
+            return w.astype(jnp.uint32 if wbits <= 32 else jnp.uint64)
+        return w
+
+    if len(words) <= VARIADIC_MAX_WORDS:
+        # one variadic sort network beats the per-word chain ~2x at
+        # multi-M rows (measured: 3 words 93ms vs 186ms at 4M) AND
+        # skips the per-pass key re-gathers; kept to few operands
+        # because XLA:TPU variadic-sort compile time grows steeply
+        # with operand count
+        ops = tuple(narrowed(w, b) for w, b in words) + (perm,)
+        out = lax.sort(ops, num_keys=len(words), is_stable=True)
+        return out[-1]
+    for w, wbits in reversed(words):
+        kw = jnp.take(narrowed(w, wbits), perm)
         _, perm = lax.sort((kw, perm), num_keys=1, is_stable=True)
     return perm
 

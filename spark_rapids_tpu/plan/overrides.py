@@ -389,9 +389,11 @@ def _conv_aggregate(meta, kids) -> TpuExec:
         from spark_rapids_tpu.exprs.base import col
         keys = [col(f.name) for f in
                 partial.output_schema().fields[:len(node.group_exprs)]]
+        # coalesce_small: a final aggregation needs key clustering only,
+        # so a small partial output skips the split kernels entirely
         ex = ShuffleExchangeExec(
             HashPartitioning(keys, _exchange_partitions(nparts, meta.conf)),
-            partial)
+            partial, coalesce_small=True)
     else:
         ex = ShuffleExchangeExec(SinglePartitioning(), partial)
     return HashAggregateExec(
@@ -684,9 +686,10 @@ def _register_window_rule() -> None:
         nparts = _num_partitions_of(child)
         if nparts > 1:
             if meta.node.spec.partition_by:
+                # window eval needs partition-key clustering only
                 child = ShuffleExchangeExec(
                     HashPartitioning(list(meta.node.spec.partition_by),
-                                     nparts), child)
+                                     nparts), child, coalesce_small=True)
             else:
                 child = ShuffleExchangeExec(SinglePartitioning(), child)
         return WindowExec(meta.node.window_exprs, meta.node.spec, child)

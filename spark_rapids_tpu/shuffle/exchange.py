@@ -26,10 +26,18 @@ from spark_rapids_tpu.utils import metrics as M
 
 
 class ShuffleExchangeExec(UnaryExecBase):
-    def __init__(self, partitioning: TpuPartitioning, child: TpuExec):
+    def __init__(self, partitioning: TpuPartitioning, child: TpuExec,
+                 coalesce_small: bool = False):
         super().__init__(child)
         self._schema = child.output_schema()
         self.partitioning = partitioning.bind(self._schema)
+        #: planner-set: the consumer only needs key CLUSTERING (e.g. a
+        #: final aggregation), not index-aligned co-partitioning with a
+        #: sibling exchange, so a small input may skip the split kernels
+        #: entirely and land in one partition (AQE-style coalescing;
+        #: reference analog: AQE coalesced shuffle reader,
+        #: GpuCustomShuffleReaderExec).  NEVER set for join inputs.
+        self.coalesce_small = coalesce_small
 
     def output_schema(self) -> T.Schema:
         return self._schema
@@ -46,6 +54,13 @@ class ShuffleExchangeExec(UnaryExecBase):
     #: ordered, and skipping bounds sampling + the split kernel saves
     #: several device round trips (AQE-style small-input coalescing)
     SMALL_RANGE_INPUT_ROWS = 1 << 15
+
+    #: a coalesce_small exchange whose total input CAPACITY (static —
+    #: no sync needed, unlike lazy row counts) stays at or below this
+    #: emits one partition and skips the split kernels: dozens of tiny
+    #: slice/concat dispatches through the tunnel cost far more than
+    #: single-partition consumption of a few thousand rows
+    SMALL_COALESCE_INPUT_CAP = 1 << 16
 
     #: max map-side batches whose split outputs may be device-resident
     #: at once in the two-phase split pipeline (see _materialize); deep
@@ -82,6 +97,22 @@ class ShuffleExchangeExec(UnaryExecBase):
         else:
             batch_iter = (b for it in self.child.execute_partitions()
                           for b in it if b.maybe_nonempty())
+            if self.coalesce_small and n > 1:
+                with self.metrics.timed(M.TOTAL_TIME):
+                    head, cap_seen = [], 0
+                    exhausted = True
+                    for b in batch_iter:
+                        head.append(b)
+                        cap_seen += b.capacity
+                        if cap_seen > self.SMALL_COALESCE_INPUT_CAP:
+                            exhausted = False
+                            break
+                if exhausted:
+                    for b in head:
+                        self.metrics.add("dataSize", b.device_size_bytes())
+                    return [head] + [[] for _ in range(n - 1)]
+                import itertools
+                batch_iter = itertools.chain(head, batch_iter)
         buckets: list[list[ColumnarBatch]] = [[] for _ in range(n)]
         if hasattr(part, "split_device"):
             # two-phase pipeline: queue split kernels back-to-back and

@@ -48,23 +48,72 @@ def _split_kernel_for(cache: KernelCache, batch: ColumnarBatch,
     def build():
         cap = batch.capacity
 
+        def order_counts(pids):
+            """Stable partition reorder WITHOUT a general argsort (a
+            4M-row stable argsort costs ~770ms on this chip).  Small
+            partition counts: counting sort — one-hot cumsum ranks +
+            a unique-index inversion scatter (~5x faster).  Larger
+            counts: a single PACKED 32-bit sort (pid in the high bits,
+            row index in the low bits — half the cost of the 64-bit
+            (pid, idx) pair sort argsort degenerates to)."""
+            npart = num_partitions  # sentinel partition = npart
+            if npart + 1 <= 16:
+                oh = (pids[:, None] ==
+                      jnp.arange(npart + 1, dtype=pids.dtype)[None, :]
+                      ).astype(jnp.int32)
+                cum = jnp.cumsum(oh, axis=0)
+                rank = jnp.take_along_axis(
+                    cum, pids[:, None].astype(jnp.int32), axis=1)[:, 0] - 1
+                counts_all = cum[-1]
+                offs = jnp.cumsum(counts_all) - counts_all
+                pos = jnp.take(offs, pids) + rank
+                order = jnp.zeros(cap, jnp.int32).at[pos].set(
+                    jnp.arange(cap, dtype=jnp.int32), unique_indices=True)
+                return order, counts_all[:npart]
+            idx_bits = max((cap - 1).bit_length(), 1)
+            if ((npart + 1) << idx_bits) <= np.iinfo(np.int32).max:
+                packed = ((pids.astype(jnp.int32) << idx_bits)
+                          | jnp.arange(cap, dtype=jnp.int32))
+                order = jnp.sort(packed) & ((1 << idx_bits) - 1)
+            else:
+                order = jnp.argsort(pids, stable=True)
+            counts = jnp.bincount(pids, length=npart + 1)[:npart]
+            return order, counts
+
         @jax.jit
         def kernel(columns, num_rows, salt, extra, mask=None):
             ctx = make_eval_context(columns, cap, num_rows, mask)
             pids = pid_fn(ctx, salt, extra)
             pids = jnp.where(ctx.row_mask, pids, num_partitions)
-            # stable sort by pid: lexsort with row index implicit
-            order = jnp.argsort(pids, stable=True)
-            counts = jnp.bincount(
-                jnp.where(ctx.row_mask, pids, num_partitions),
-                length=num_partitions + 1)[:num_partitions]
+            order, counts = order_counts(pids)
             valid = jnp.take(ctx.row_mask, order)
-            cols = [c.gather(order, valid) for c in columns]
+            cols = _gather_reordered(columns, order, valid)
             return cols, counts
 
         return kernel
 
     return cache.get_or_build(key, build)
+
+
+def _gather_reordered(columns, order, valid):
+    """Row reorder with the fewest random-access streams (each costs
+    ~70ns/row on this chip, dwarfing bandwidth): validities of ALL
+    numeric columns pack into one i32 bitmask gathered once, and value
+    streams go through gather_narrowest (i32-shadow-only for in-range
+    int64).  Strings keep the general ColumnVector.gather (char
+    tensors need their own streams anyway)."""
+    from spark_rapids_tpu.columnar.vector import (gather_narrowest,
+                                                  pack_validity_bits)
+    bits, packed = pack_validity_bits(columns)
+    vm = None if packed is None else jnp.take(packed, order, mode="clip")
+    out = []
+    for ci, c in enumerate(columns):
+        if ci not in bits:
+            out.append(c.gather(order, valid))
+            continue
+        v = valid & (((vm >> bits[ci]) & 1) != 0)
+        out.append(gather_narrowest(c, order, v))
+    return out
 
 
 #: lazy slicing keeps slices at the INPUT batch's capacity (the count is

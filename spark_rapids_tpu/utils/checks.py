@@ -33,6 +33,16 @@ class BatchCheck:
     #: instead of the deopt-and-retry FastPathInvalid
     error: Optional[Callable] = None
 
+    #: memoized verify outcome (class attr, not a dataclass field, so
+    #: eq/hash semantics are untouched): a check rides on both the
+    #: pending registry AND batch tuples, so without memoization the
+    #: same flag is read back at every verify boundary it reaches —
+    #: each a full tunnel round trip
+    _resolved = None
+
+    def _memoize(self, bad: bool) -> None:
+        object.__setattr__(self, "_resolved", bool(bad))
+
 
 class FastPathInvalid(Exception):
     def __init__(self, checks):
@@ -71,12 +81,18 @@ def verify(checks) -> None:
         return
     device_idx, device_flags, host_bad = [], [], []
     for i, c in enumerate(checks):
+        if c._resolved is not None:
+            if c._resolved:
+                host_bad.append(i)
+            continue
         f = c.flag
         if hasattr(f, "devices") or hasattr(f, "sharding"):
             device_idx.append(i)
             device_flags.append(f)
-        elif bool(np.asarray(f)):
-            host_bad.append(i)
+        else:
+            c._memoize(bool(np.asarray(f)))
+            if c._resolved:
+                host_bad.append(i)
     bad_set = set(host_bad)
     if device_flags:
         import jax.numpy as jnp
@@ -96,12 +112,16 @@ def verify(checks) -> None:
             try:
                 stacked = np.asarray(jnp.stack(
                     [jnp.asarray(f, bool).reshape(()) for _, f in items]))
-                bad_set.update(i for (i, _), b in zip(items, stacked) if b)
+                for (i, _), b in zip(items, stacked):
+                    checks[i]._memoize(bool(b))
+                    if b:
+                        bad_set.add(i)
             except Exception:
                 # arbitrary placement (e.g. flags sharded across devices):
                 # per-flag readback still resolves correctly
                 for i, f in items:
-                    if bool(np.asarray(f)):
+                    checks[i]._memoize(bool(np.asarray(f)))
+                    if checks[i]._resolved:
                         bad_set.add(i)
     bad = [c for i, c in enumerate(checks) if i in bad_set]
     with _LOCK:

@@ -34,6 +34,44 @@ def rng():
     return np.random.default_rng(42)
 
 
+def _release_caches():
+    """Drop every clearable executable/trace cache and return freed
+    pages to the OS.  Compiled kernels + their jax-internal lowering
+    artifacts measure ~5-10MB each on XLA:CPU; a full-suite run that
+    never clears them was observed at 119GB RSS (thrashing the box)."""
+    import ctypes
+    import gc
+    from spark_rapids_tpu.exec.base import clear_kernel_cache
+    clear_kernel_cache()
+    jax.clear_caches()
+    gc.collect()
+    try:
+        ctypes.CDLL("libc.so.6").malloc_trim(0)
+    except Exception:
+        pass
+
+
+def _rss_mb() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE") \
+                // (1 << 20)
+    except OSError:
+        return 0
+
+
+#: per-test RSS ceiling before caches are force-dropped mid-module (the
+#: workload modules alone would otherwise grow past RAM)
+RSS_CLEAR_MB = 6 << 10
+
+
+@pytest.fixture(autouse=True)
+def _bound_process_rss():
+    yield
+    if _rss_mb() > RSS_CLEAR_MB:
+        _release_caches()
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bound_kernel_cache():
     """The process-global executable cache is sized for one workload's
@@ -42,5 +80,4 @@ def _bound_kernel_cache():
     live loaded executables).  Clearing per module keeps each module's
     hot-run reuse while bounding the live set."""
     yield
-    from spark_rapids_tpu.exec.base import clear_kernel_cache
-    clear_kernel_cache()
+    _release_caches()

@@ -198,6 +198,28 @@ def test_partial_final_split(rng):
     np.testing.assert_allclose(out["a"], exp["a"], rtol=1e-12)
 
 
+def test_topn_nulls_last_with_sparse_mask_and_fewer_valid_than_k():
+    """Regression (f32 prune): the nulls-last sentinel must not collapse
+    into the masked-row -inf in the f32 candidate space — filtered-out
+    rows at low indices must never displace null-key rows from top-N."""
+    from spark_rapids_tpu.exec.basic import FilterExec
+    # low-index rows all FILTERED OUT; 3 valid non-null rows < k=5;
+    # null-key rows at high indices must fill the remaining slots.
+    # 500 rows so capacity exceeds the K' candidate budget (~123) and
+    # the pruned path actually engages.
+    df = pd.DataFrame({
+        "keep": [0] * 494 + [1] * 6,
+        "x": [float(i) for i in range(494)] + [7.0, None, 3.0, None, 9.0,
+                                               None],
+    })
+    plan = SortedTopNExec(
+        5, [desc(col("x"))],
+        FilterExec(col("keep") > lit(0), LocalBatchSource.from_pandas(df)))
+    out = plan.to_pandas()
+    vals = [None if pd.isna(v) else float(v) for v in out["x"]]
+    assert vals == [9.0, 7.0, 3.0, None, None], vals
+
+
 def test_verify_handles_flags_on_mixed_devices():
     """ADVICE r3: flags committed to different mesh devices must not
     break the single-stack readback (jnp.stack raises on mixed-device
@@ -513,3 +535,53 @@ def test_dict_groupby_multi_key_budget_overflow_falls_back():
         "v": rng.uniform(0, 10, n),
     })
     _run_agg_pair(df, ["a", "b"])
+
+
+def test_sort_lane_compaction_deopt_on_many_groups(rng):
+    """Checked group-batch compaction: a sort-lane partial compacts to
+    COMPACT_GROUPS_CAP optimistically; when the true group count
+    overflows it, the deferred check must deopt (disable + retry) and
+    the final result must still be exact."""
+    from spark_rapids_tpu import config as C
+    n = 1 << 16
+    n_groups = (1 << 14) + 500     # overflows the 16K compaction target
+    df = pd.DataFrame({
+        "k": rng.permutation(np.arange(n, dtype=np.int64) % n_groups),
+        "v": rng.uniform(0, 10, n),
+    })
+    conf = C.RapidsConf({"spark.rapids.tpu.dictGroupby.enabled": False})
+    with C.session(conf):
+        plan = HashAggregateExec(
+            [col("k")], [Sum(col("v")).alias("s"),
+                         Count(col("v")).alias("c")],
+            LocalBatchSource.from_pandas(df))
+        assert not getattr(plan, "_compact_disabled", False)
+        out = plan.to_pandas().sort_values("k", ignore_index=True)
+        # the deopt must have fired (groups > target) and been recovered
+        assert getattr(plan, "_compact_disabled", False)
+    exp = (df.groupby("k").agg(s=("v", "sum"), c=("v", "size"))
+           .reset_index())
+    assert len(out) == n_groups
+    np.testing.assert_allclose(out["s"].astype(float), exp["s"],
+                               rtol=1e-9)
+    assert (out["c"].astype(int).to_numpy() == exp["c"].to_numpy()).all()
+
+
+def test_sort_lane_compaction_keeps_small_group_counts_exact(rng):
+    """Compaction fast path (group count under the target): results must
+    be exact and the fast path must stay enabled."""
+    from spark_rapids_tpu import config as C
+    n = 1 << 16
+    df = pd.DataFrame({
+        "k": rng.integers(0, 300, n).astype(np.int64),
+        "v": rng.uniform(0, 10, n),
+    })
+    conf = C.RapidsConf({"spark.rapids.tpu.dictGroupby.enabled": False})
+    with C.session(conf):
+        plan = HashAggregateExec(
+            [col("k")], [Sum(col("v")).alias("s")],
+            LocalBatchSource.from_pandas(df))
+        out = plan.to_pandas().sort_values("k", ignore_index=True)
+        assert not getattr(plan, "_compact_disabled", False)
+    exp = df.groupby("k").agg(s=("v", "sum")).reset_index()
+    np.testing.assert_allclose(out["s"].astype(float), exp["s"], rtol=1e-9)
