@@ -30,8 +30,7 @@ from spark_rapids_tpu.exec.base import (
 from spark_rapids_tpu.exprs.aggregates import (
     AggAlias, AggContext, AggregateFunction)
 from spark_rapids_tpu.exprs.base import Expression, output_name
-from spark_rapids_tpu.ops.sort_encode import (
-    multi_key_argsort, segment_boundaries)
+from spark_rapids_tpu.ops.sort_encode import sort_with_bounds
 from spark_rapids_tpu.utils import checks as CK
 from spark_rapids_tpu.utils import metrics as M
 
@@ -145,14 +144,12 @@ class HashAggregateExec(UnaryExecBase):
             def kernel(columns, num_rows, mask=None):
                 ctx = make_eval_context(columns, cap, num_rows, mask)
                 keys = [e.eval(ctx) for e in bound_groups]
-                perm = multi_key_argsort(
+                perm, sorted_valid, bounds, _ = sort_with_bounds(
                     [(k, True, True) for k in keys], ctx.row_mask)
-                sorted_keys = [k.gather(perm, jnp.take(ctx.row_mask, perm))
+                sorted_keys = [k.gather(perm, sorted_valid)
                                for k in keys]
-                bounds = segment_boundaries(keys, perm, ctx.row_mask)
                 seg_ids = jnp.cumsum(bounds.astype(jnp.int32)) - 1
                 num_groups = bounds.sum().astype(jnp.int32)
-                sorted_valid = jnp.take(ctx.row_mask, perm)
                 # group key representatives: first row of each segment
                 (first_idx,) = jnp.nonzero(bounds, size=cap,
                                            fill_value=cap - 1)
@@ -661,21 +658,35 @@ class HashAggregateExec(UnaryExecBase):
     #: every downstream op (exchange split, concat, merge re-sort) pays
     #: multi-M-capacity kernels for a few thousand groups.  Group rows
     #: are prefix-compacted by the kernel, so the compaction is a cheap
-    #: head slice + a deferred overflow check (deopt-and-retry).
+    #: head slice + a deferred overflow check.  On overflow the cap
+    #: ESCALATES (x4 per deopt-and-retry round, learned per exec
+    #: instance) rather than disabling — e.g. TPCx-BB q27's ~26K groups
+    #: settle on the 64K tier, still far under review capacities.
     COMPACT_GROUPS_CAP = 1 << 14
+    COMPACT_GROUPS_MAX = 1 << 20
 
-    def _disable_compact(self) -> None:
-        self._compact_disabled = True
+    def _escalate_compact(self, failed_cap: int) -> None:
+        # one escalation per retry round: several batches' checks may
+        # fail together, and each invokes recover
+        if getattr(self, "_compact_cap", self.COMPACT_GROUPS_CAP) \
+                == failed_cap:
+            self._compact_cap = failed_cap * 4
 
     def _compact_groups(self, b: ColumnarBatch) -> ColumnarBatch:
-        tc = self.COMPACT_GROUPS_CAP
-        if getattr(self, "_compact_disabled", False) or b.capacity <= tc \
+        if CK.is_retrying():
+            # the deopt retry is the last chance — compacting at the
+            # escalated cap could overflow AGAIN with no retry left, so
+            # the retry always runs uncompacted; the escalated cap
+            # applies to future collects of this (reused) plan
+            return b
+        tc = getattr(self, "_compact_cap", self.COMPACT_GROUPS_CAP)
+        if tc > self.COMPACT_GROUPS_MAX or b.capacity <= tc \
                 or b.sparse is not None:
             return b
         flag = b.num_rows_i32 > jnp.int32(tc)
         chk = CK.register(CK.BatchCheck(
             flag, origin="aggCompactGroups",
-            recover=self._disable_compact))
+            recover=lambda cap=tc: self._escalate_compact(cap)))
         hb = b.take_head(tc)
         return ColumnarBatch(hb.schema, list(hb.columns), hb._rows,
                              hb.checks + (chk,))

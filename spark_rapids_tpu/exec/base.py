@@ -233,10 +233,14 @@ class TpuExec:
         except CK.FastPathInvalid as e:
             e.recover_all()
             CK.drain_since(mark)  # discard THIS query's leftovers only
-            out = self._collect_once().dense()
-            out.prefetch()
-            CK.verify(out.checks)
-            CK.verify(CK.drain_since(mark))
+            CK.set_retrying(True)
+            try:
+                out = self._collect_once().dense()
+                out.prefetch()
+                CK.verify(out.checks)
+                CK.verify(CK.drain_since(mark))
+            finally:
+                CK.set_retrying(False)
             return out
 
     def _collect_once(self) -> ColumnarBatch:

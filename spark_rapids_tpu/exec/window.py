@@ -38,7 +38,7 @@ from spark_rapids_tpu.exec.base import (
 from spark_rapids_tpu.exec.sort import SortOrder
 from spark_rapids_tpu.exprs.base import Expression, output_name
 from spark_rapids_tpu.ops.sort_encode import (
-    multi_key_argsort, segment_boundaries)
+    sort_with_bounds)
 from spark_rapids_tpu.utils import metrics as M
 
 UNBOUNDED = None
@@ -204,11 +204,12 @@ class WindowExec(UnaryExecBase):
                 keyspec = ([(p, True, True) for p in parts]
                            + [(o, so.ascending, so.resolved_nulls_first)
                               for o, so in zip(orders, self._bound_order)])
-                perm = multi_key_argsort(keyspec, ctx.row_mask)
-                sorted_mask = jnp.take(ctx.row_mask, perm)
+                perm, sorted_mask, pbounds, obounds_all = \
+                    sort_with_bounds(keyspec, ctx.row_mask,
+                                     prefix=len(parts))
                 # partition segments (partition keys only)
                 if parts:
-                    bounds = segment_boundaries(parts, perm, ctx.row_mask)
+                    bounds = pbounds
                 else:
                     bounds = (jnp.arange(cap) == 0) & sorted_mask
                 seg = jnp.cumsum(bounds.astype(jnp.int32)) - 1
@@ -218,17 +219,22 @@ class WindowExec(UnaryExecBase):
                                                fill_value=cap - 1)
                 seg_start = jnp.take(seg_start_idx,
                                      jnp.clip(seg, 0, cap - 1))
-                seg_len = jax.ops.segment_sum(
-                    sorted_mask.astype(jnp.int32), seg, num_segments=cap)
-                my_len = jnp.take(seg_len, jnp.clip(seg, 0, cap - 1))
-                seg_end = seg_start + my_len  # exclusive
+                # per-segment exclusive end WITHOUT a scatter (XLA:TPU
+                # serializes segment_sum): rows are partition-sorted
+                # with invalid rows last, so segment s ends where s+1
+                # starts, and the LAST segment ends at num_rows
+                num_segs = bounds.sum().astype(jnp.int32)
+                nxt = jnp.concatenate(
+                    [seg_start_idx[1:],
+                     jnp.full((1,), cap, seg_start_idx.dtype)])
+                seg_end_by_id = jnp.where(
+                    jnp.arange(cap) >= num_segs - 1,
+                    jnp.asarray(num_rows, jnp.int32), nxt.astype(jnp.int32))
+                seg_end = jnp.take(seg_end_by_id,
+                                   jnp.clip(seg, 0, cap - 1))  # exclusive
 
                 # order-key change flags (for rank/dense_rank)
-                if orders:
-                    obounds = segment_boundaries(parts + orders, perm,
-                                                 ctx.row_mask)
-                else:
-                    obounds = bounds
+                obounds = obounds_all if orders else bounds
 
                 # frame bounds [lo, hi) per row, shared by all functions
                 if frame.is_rows:

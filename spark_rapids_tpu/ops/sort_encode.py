@@ -53,16 +53,24 @@ def encode_key_bits(col: ColumnVector, ascending: bool = True,
     """Sort keys for one column, each with its bit width so
     `packed_lexsort` can pack many keys into few uint64 sort words.
     A width of None marks an unpackable key (float64 values) that must be
-    its own sort operand."""
+    its own sort operand.
+
+    Value bits are NORMALIZED under null (and NaN-payload) rows: the
+    null-rank / nan-flag key already places those rows, and zeroing the
+    garbage value bits makes encoded-word equality coincide with SQL
+    group equality — which lets `sort_with_bounds` derive segment
+    boundaries from the packed words with no extra per-key gathers."""
     keys: list = []
     null_rank = jnp.where(col.validity,
                           jnp.uint8(1 if nulls_first else 0),
                           jnp.uint8(0 if nulls_first else 1))
     keys.append((null_rank, 1))
     dt = col.dtype
+    valid = col.validity
 
     def width_int(x, bits, bias):
-        enc = (x.astype(jnp.int64) + bias).astype(jnp.uint64)
+        x = jnp.where(valid, x.astype(jnp.int64), 0)
+        enc = (x + bias).astype(jnp.uint64)
         if not ascending:
             enc = jnp.uint64((1 << bits) - 1) - enc
         return (enc, bits)
@@ -70,16 +78,21 @@ def encode_key_bits(col: ColumnVector, ascending: bool = True,
     if dt.is_string:
         cc = col.char_cap
         pos = jnp.arange(cc)[None, :]
-        b = jnp.where(pos < col.lengths[:, None],
+        b = jnp.where(valid[:, None] & (pos < col.lengths[:, None]),
                       col.data.astype(jnp.int16) + 1, 0)
         if not ascending:
             b = jnp.int16(256) - b
         for j in range(cc):
             keys.append((b[:, j].astype(jnp.uint64), 9))
     elif dt.id == T.TypeId.FLOAT32:
-        nan = jnp.isnan(col.data)
+        nan = jnp.isnan(col.data) & valid
         keys.append(((nan if ascending else ~nan).astype(jnp.uint8), 1))
-        val = jnp.where(nan, jnp.zeros_like(col.data), col.data)
+        val = jnp.where(valid & ~nan, col.data,
+                        jnp.zeros_like(col.data))
+        # -0.0 -> 0.0: SQL groups them together (murmur3 normalizes the
+        # same way), and the IEEE bit encode would otherwise separate
+        # them — both in sort order and in word-equality boundaries
+        val = jnp.where(val == 0.0, jnp.zeros_like(val), val)
         bits = lax.bitcast_convert_type(val, jnp.uint32)
         sign = bits >> jnp.uint32(31)
         # IEEE total-order: negative floats reverse, positives offset
@@ -89,12 +102,13 @@ def encode_key_bits(col: ColumnVector, ascending: bool = True,
             enc = jnp.uint64((1 << 32) - 1) - enc
         keys.append((enc, 32))
     elif dt.is_floating:  # float64: 64-bit bitcast is unavailable on TPU
-        nan = jnp.isnan(col.data)
+        nan = jnp.isnan(col.data) & valid
         keys.append(((nan if ascending else ~nan).astype(jnp.uint8), 1))
-        val = jnp.where(nan, jnp.zeros_like(col.data), col.data)
+        val = jnp.where(valid & ~nan, col.data,
+                        jnp.zeros_like(col.data))
         keys.append((val if ascending else -val, None))
     elif dt.id == T.TypeId.BOOL:
-        enc = col.data.astype(jnp.uint64)
+        enc = jnp.where(valid, col.data, False).astype(jnp.uint64)
         if not ascending:
             enc = jnp.uint64(1) - enc
         keys.append((enc, 1))
@@ -103,15 +117,17 @@ def encode_key_bits(col: ColumnVector, ascending: bool = True,
     elif dt.id == T.TypeId.INT16:
         keys.append(width_int(col.data, 16, 1 << 15))
     elif dt.id in (T.TypeId.INT32, T.TypeId.DATE32):
-        keys.append(_enc32(col.data.astype(jnp.int32), ascending))
+        keys.append(_enc32(jnp.where(valid, col.data, 0)
+                           .astype(jnp.int32), ascending))
     elif col.narrow is not None:
         # int64/timestamp whose values fit int32 (narrow shadow): a
         # 32-bit encode halves the packed sort-word width — 64-bit
         # compare-exchange is the dominant cost of bitonic sorts on
         # this chip
-        keys.append(_enc32(col.narrow, ascending))
+        keys.append(_enc32(jnp.where(valid, col.narrow, 0), ascending))
     else:  # int64 / timestamp
-        enc = col.data.astype(jnp.int64).astype(jnp.uint64) ^ _SIGN64
+        x = jnp.where(valid, col.data.astype(jnp.int64), 0)
+        enc = x.astype(jnp.uint64) ^ _SIGN64
         if not ascending:
             enc = ~enc
         keys.append((enc, 64))
@@ -132,15 +148,9 @@ def _enc32(x_i32, ascending: bool):
 VARIADIC_MAX_WORDS = 3
 
 
-def packed_lexsort(keys_msf: list[tuple[jnp.ndarray, int]]) -> jnp.ndarray:
-    """Stable multi-key argsort, most-significant key first.
-
-    XLA:TPU sort compile time grows steeply with operand count and row
-    count (a 10-operand variadic sort at 64K rows compiles for minutes),
-    so keys are greedily packed MSF->LSF into uint64 words and the sort
-    runs as a chain of cheap 1-key stable sorts from the least significant
-    word up — the classic LSD radix composition."""
-    cap = keys_msf[0][0].shape[0]
+def _pack_words(keys_msf: list) -> list:
+    """Greedily pack (array, bits) keys MSF->LSF into few sort words;
+    returns [(array, used_bits-or-None), ...]."""
     words: list = []          # (array, used_bits or None)
     acc, used = None, 0
 
@@ -169,27 +179,87 @@ def packed_lexsort(keys_msf: list[tuple[jnp.ndarray, int]]) -> jnp.ndarray:
             flush()
             acc, used = arr, bits
     flush()
+    return words
+
+
+def _narrowed(w, wbits):
+    if wbits is not None:
+        # sort at the narrowest width that holds the word
+        return w.astype(jnp.uint32 if wbits <= 32 else jnp.uint64)
+    return w
+
+
+def _sort_words(words: list, cap: int) -> jnp.ndarray:
+    """Stable argsort by packed words, most significant first."""
     perm = jnp.arange(cap, dtype=jnp.int32)
-
-    def narrowed(w, wbits):
-        if wbits is not None:
-            # sort at the narrowest width that holds the word
-            return w.astype(jnp.uint32 if wbits <= 32 else jnp.uint64)
-        return w
-
     if len(words) <= VARIADIC_MAX_WORDS:
         # one variadic sort network beats the per-word chain ~2x at
         # multi-M rows (measured: 3 words 93ms vs 186ms at 4M) AND
         # skips the per-pass key re-gathers; kept to few operands
         # because XLA:TPU variadic-sort compile time grows steeply
         # with operand count
-        ops = tuple(narrowed(w, b) for w, b in words) + (perm,)
+        ops = tuple(_narrowed(w, b) for w, b in words) + (perm,)
         out = lax.sort(ops, num_keys=len(words), is_stable=True)
         return out[-1]
     for w, wbits in reversed(words):
-        kw = jnp.take(narrowed(w, wbits), perm)
+        kw = jnp.take(_narrowed(w, wbits), perm)
         _, perm = lax.sort((kw, perm), num_keys=1, is_stable=True)
     return perm
+
+
+def packed_lexsort(keys_msf: list[tuple[jnp.ndarray, int]]) -> jnp.ndarray:
+    """Stable multi-key argsort, most-significant key first.
+
+    XLA:TPU sort compile time grows steeply with operand count and row
+    count (a 10-operand variadic sort at 64K rows compiles for minutes),
+    so keys are greedily packed MSF->LSF into uint64 words and the sort
+    runs as one variadic network (few words) or a chain of 1-key stable
+    sorts from the least significant word up (the LSD composition)."""
+    cap = keys_msf[0][0].shape[0]
+    return _sort_words(_pack_words(keys_msf), cap)
+
+
+def sort_with_bounds(key_cols: list, row_mask: jnp.ndarray,
+                     prefix: int = None):
+    """Argsort by (column, ascending, nulls_first) keys AND derive
+    segment boundaries from the PACKED SORT WORDS — encoded value bits
+    are null/NaN-normalized, so word equality == SQL group equality and
+    no per-key-column boundary gathers are needed (each costs ~30ms at
+    2M rows on this chip; the words are gathered once for small counts).
+
+    `prefix` (default: all keys) marks how many leading key columns
+    form the GROUPING; packing never shares a word across the prefix
+    border.  Returns (perm, sorted_valid, prefix_bounds, all_bounds);
+    invalid rows sort last and never start a segment."""
+    cap = row_mask.shape[0]
+    if prefix is None:
+        prefix = len(key_cols)
+    lead = [((~row_mask).astype(jnp.uint8), 1)]
+    for col, asc, nf in key_cols[:prefix]:
+        lead.extend(encode_key_bits(col, asc, nf))
+    pwords = _pack_words(lead)
+    rest: list = []
+    for col, asc, nf in key_cols[prefix:]:
+        rest.extend(encode_key_bits(col, asc, nf))
+    rwords = _pack_words(rest)
+    perm = _sort_words(pwords + rwords, cap)
+    sorted_valid = jnp.take(row_mask, perm)
+
+    def neq_over(words):
+        acc = jnp.zeros(cap, bool)
+        for w, bits in words:
+            s = jnp.take(_narrowed(w, bits), perm)
+            acc = acc | (s != jnp.roll(s, 1))
+        return acc
+
+    first = jnp.arange(cap) == 0
+    pneq = neq_over(pwords)
+    prefix_bounds = sorted_valid & (pneq | first)
+    if rwords:
+        all_bounds = sorted_valid & (pneq | neq_over(rwords) | first)
+    else:
+        all_bounds = prefix_bounds
+    return perm, sorted_valid, prefix_bounds, all_bounds
 
 
 def multi_key_argsort(key_cols: list[tuple[ColumnVector, bool, bool]],

@@ -916,7 +916,11 @@ def _collect(plan, conf: C.RapidsConf) -> "object":
     except CK.FastPathInvalid as e:
         e.recover_all()
         CK.drain_since(mark)
-        return _collect_inner(plan, conf)
+        CK.set_retrying(True)
+        try:
+            return _collect_inner(plan, conf)
+        finally:
+            CK.set_retrying(False)
 
 
 def _collect_inner(plan, conf: C.RapidsConf) -> "object":

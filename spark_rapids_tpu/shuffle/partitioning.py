@@ -129,7 +129,10 @@ def _slice_partitions(batch_cols, counts, schema: T.Schema,
     """Cut the pid-sorted batch into per-partition batches.  `counts`
     may be a DEVICE vector: small batches slice sync-free (device
     offsets, full-capacity slices, lazy row counts); large ones sync
-    once and cut tight host-side slices."""
+    once and cut tight host-side slices.  (Lazy slicing at ANY capacity
+    for clustering-only consumers was tried and measured SLOWER — the
+    full-capacity slices make every downstream per-slice kernel pay the
+    input capacity, which costs more than the count sync saves.)"""
     n_parts = counts.shape[0]
     if not isinstance(counts, np.ndarray) and total_cap <= LAZY_SLICE_MAX_CAP:
         offs = jnp.cumsum(counts) - counts

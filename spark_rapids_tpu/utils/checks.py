@@ -60,6 +60,21 @@ class FastPathInvalid(Exception):
 _LOCK = threading.Lock()
 _PENDING: list[BatchCheck] = []
 
+_RETRY = threading.local()
+
+
+def set_retrying(flag: bool) -> None:
+    """Marks the deopt RE-EXECUTION (collect catches FastPathInvalid,
+    recovers, and re-runs once).  Optimistic fast paths whose recovery
+    is 'escalate a learned parameter' must produce guaranteed-valid
+    results during the retry — there is no second retry — and consult
+    this to bypass themselves for that one execution."""
+    _RETRY.flag = flag
+
+
+def is_retrying() -> bool:
+    return getattr(_RETRY, "flag", False)
+
 
 def register(check: BatchCheck) -> BatchCheck:
     with _LOCK:

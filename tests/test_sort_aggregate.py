@@ -540,8 +540,8 @@ def test_dict_groupby_multi_key_budget_overflow_falls_back():
 def test_sort_lane_compaction_deopt_on_many_groups(rng):
     """Checked group-batch compaction: a sort-lane partial compacts to
     COMPACT_GROUPS_CAP optimistically; when the true group count
-    overflows it, the deferred check must deopt (disable + retry) and
-    the final result must still be exact."""
+    overflows it, the deferred check must deopt (escalate the cap +
+    retry) and the final result must still be exact."""
     from spark_rapids_tpu import config as C
     n = 1 << 16
     n_groups = (1 << 14) + 500     # overflows the 16K compaction target
@@ -555,10 +555,11 @@ def test_sort_lane_compaction_deopt_on_many_groups(rng):
             [col("k")], [Sum(col("v")).alias("s"),
                          Count(col("v")).alias("c")],
             LocalBatchSource.from_pandas(df))
-        assert not getattr(plan, "_compact_disabled", False)
+        assert getattr(plan, "_compact_cap", None) is None
         out = plan.to_pandas().sort_values("k", ignore_index=True)
-        # the deopt must have fired (groups > target) and been recovered
-        assert getattr(plan, "_compact_disabled", False)
+        # the deopt must have fired (groups > 16K target) and escalated
+        # the learned cap exactly one tier
+        assert plan._compact_cap == HashAggregateExec.COMPACT_GROUPS_CAP * 4
     exp = (df.groupby("k").agg(s=("v", "sum"), c=("v", "size"))
            .reset_index())
     assert len(out) == n_groups
@@ -585,3 +586,47 @@ def test_sort_lane_compaction_keeps_small_group_counts_exact(rng):
         assert not getattr(plan, "_compact_disabled", False)
     exp = df.groupby("k").agg(s=("v", "sum")).reset_index()
     np.testing.assert_allclose(out["s"].astype(float), exp["s"], rtol=1e-9)
+
+
+def test_groupby_negative_zero_f32_one_group():
+    """-0.0 and 0.0 form ONE SQL group (word-equality boundaries must
+    normalize the f32 bit encode like murmur3 does)."""
+    b = ColumnarBatch.from_numpy(
+        {"k": np.array([-0.0, 0.0, 1.0, -0.0], np.float32),
+         "v": np.array([1, 2, 4, 8], np.int64)})
+    out = HashAggregateExec(
+        [col("k")], [Sum(col("v")).alias("s")],
+        LocalBatchSource([[b]])).to_pandas()
+    got = {float(k): int(s) for k, s in zip(out["k"], out["s"])}
+    assert got == {0.0: 11, 1.0: 4}
+
+
+def test_compaction_retry_bypasses_and_future_collects_use_ladder(rng):
+    """A group count past 4x the compaction cap must still complete on
+    the single retry (the retry runs uncompacted), and later collects
+    of the SAME plan must use the escalated cap."""
+    from spark_rapids_tpu import config as C
+    n = 1 << 17
+    n_groups = (1 << 16) + 123     # > 4x the 16K target
+    df = pd.DataFrame({
+        "k": rng.permutation(np.arange(n, dtype=np.int64) % n_groups),
+        "v": rng.uniform(0, 10, n),
+    })
+    conf = C.RapidsConf({"spark.rapids.tpu.dictGroupby.enabled": False})
+    with C.session(conf):
+        plan = HashAggregateExec(
+            [col("k")], [Sum(col("v")).alias("s")],
+            LocalBatchSource.from_pandas(df))
+        out = plan.to_pandas()
+        assert len(out) == n_groups
+        assert plan._compact_cap == HashAggregateExec.COMPACT_GROUPS_CAP * 4
+        # second collect: one more deopt (cap still too small), another
+        # escalation, still exact
+        out2 = plan.to_pandas()
+        assert len(out2) == n_groups
+        assert plan._compact_cap == \
+            HashAggregateExec.COMPACT_GROUPS_CAP * 16
+    exp = df.groupby("k")["v"].sum().reset_index().sort_values(
+        "k", ignore_index=True)
+    got = out.sort_values("k", ignore_index=True)
+    np.testing.assert_allclose(got["s"].astype(float), exp["v"], rtol=1e-9)
