@@ -227,8 +227,10 @@ class TpuExec:
         try:
             out = self._collect_once().dense()
             out.prefetch()
-            CK.verify(out.checks)
-            CK.verify(CK.drain_since(mark))
+            # ONE verify over batch checks + the query's registered
+            # checks = one stacked flag readback (a second verify call
+            # would pay its own tunnel round trip)
+            CK.verify(list(out.checks) + CK.drain_since(mark))
             return out
         except CK.FastPathInvalid as e:
             e.recover_all()
@@ -237,8 +239,7 @@ class TpuExec:
             try:
                 out = self._collect_once().dense()
                 out.prefetch()
-                CK.verify(out.checks)
-                CK.verify(CK.drain_since(mark))
+                CK.verify(list(out.checks) + CK.drain_since(mark))
             finally:
                 CK.set_retrying(False)
             return out

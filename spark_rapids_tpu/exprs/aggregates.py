@@ -166,15 +166,18 @@ class Sum(AggregateFunction):
         dt = _sum_type(v.dtype)
         acc = v.data.astype(dt.storage_dtype)
         ok = v.validity & ctx.row_valid
+        # count companion scans i32: it only feeds the null flag, and
+        # counts are bounded by capacity < 2^31 (64-bit elementwise is
+        # 50-100x slower on this chip)
         s, cnt = _sorted_seg_sums(ctx, jnp.where(ok, acc, 0),
-                                  ok.astype(jnp.int64))
+                                  ok.astype(jnp.int32))
         return (ColumnVector(dt, s, cnt > 0),)
 
     def merge(self, ctx, partials):
         (p,) = partials
         ok = p.validity & ctx.row_valid
         s, cnt = _sorted_seg_sums(ctx, jnp.where(ok, p.data, 0),
-                                  ok.astype(jnp.int64))
+                                  ok.astype(jnp.int32))
         return (ColumnVector(p.dtype, s, cnt > 0),)
 
     def evaluate(self, partials, schema):
@@ -197,7 +200,8 @@ class Count(AggregateFunction):
             ok = ctx.row_valid
         else:
             ok = inputs[0].validity & ctx.row_valid
-        c = _sorted_seg_sum(ok.astype(jnp.int64), ctx)
+        # i32 scan (counts bounded by capacity), widened at the output
+        c = _sorted_seg_sum(ok.astype(jnp.int32), ctx).astype(jnp.int64)
         return (ColumnVector(T.INT64, c, jnp.ones(ctx.capacity, bool)),)
 
     def merge(self, ctx, partials):
@@ -328,10 +332,10 @@ class Average(AggregateFunction):
         ok = v.validity & ctx.row_valid
         s, c = _sorted_seg_sums(
             ctx, jnp.where(ok, v.data.astype(jnp.float64), 0.0),
-            ok.astype(jnp.int64))
+            ok.astype(jnp.int32))
         always = jnp.ones(ctx.capacity, bool)
         return (ColumnVector(T.FLOAT64, s, always),
-                ColumnVector(T.INT64, c, always))
+                ColumnVector(T.INT64, c.astype(jnp.int64), always))
 
     def merge(self, ctx, partials):
         s_p, c_p = partials
@@ -369,14 +373,14 @@ class _FirstLast(AggregateFunction):
         cap = ctx.capacity
         ok = ctx.row_valid & (v.validity if self.ignore_nulls
                               else jnp.ones(cap, bool))
-        rows = jnp.arange(cap, dtype=jnp.int64)
+        rows = jnp.arange(cap, dtype=jnp.int32)
         if self._is_first:
             pick = _sorted_seg_minmax(jnp.where(ok, rows, cap), ctx,
                                       is_min=True)
         else:
             pick = _sorted_seg_minmax(jnp.where(ok, rows, -1), ctx,
                                       is_min=False)
-        has = _sorted_seg_sum(ok.astype(jnp.int64), ctx) > 0
+        has = _sorted_seg_sum(ok.astype(jnp.int32), ctx) > 0
         idx = jnp.where(has, pick, 0).astype(jnp.int32)
         return (v.gather(idx, has),)
 
@@ -429,7 +433,8 @@ class VarianceSamp(AggregateFunction):
         (v,) = inputs
         ok = v.validity & ctx.row_valid
         x = jnp.where(ok, v.data.astype(jnp.float64), 0.0)
-        s, c = _sorted_seg_sums(ctx, x, ok.astype(jnp.int64))
+        s, c = _sorted_seg_sums(ctx, x, ok.astype(jnp.int32))
+        c = c.astype(jnp.int64)
         mean = s / jnp.maximum(c, 1).astype(jnp.float64)
         # second pass against the group mean: m2 = sum((x - mean)^2)
         d = jnp.where(ok, x - jnp.take(mean, ctx.seg_ids), 0.0)

@@ -191,6 +191,15 @@ def _narrowed(w, wbits):
 
 def _sort_words(words: list, cap: int) -> jnp.ndarray:
     """Stable argsort by packed words, most significant first."""
+    return _sort_words_full(words, cap)[0]
+
+
+def _sort_words_full(words: list, cap: int):
+    """Stable argsort by packed words, most significant first.
+    Returns (perm, sorted_words-or-None): the variadic network emits
+    the SORTED key operands as a byproduct — callers that need
+    word-equality boundaries use them directly instead of paying one
+    random-access gather per word (~70ns/row on this chip)."""
     perm = jnp.arange(cap, dtype=jnp.int32)
     if len(words) <= VARIADIC_MAX_WORDS:
         # one variadic sort network beats the per-word chain ~2x at
@@ -200,11 +209,11 @@ def _sort_words(words: list, cap: int) -> jnp.ndarray:
         # with operand count
         ops = tuple(_narrowed(w, b) for w, b in words) + (perm,)
         out = lax.sort(ops, num_keys=len(words), is_stable=True)
-        return out[-1]
+        return out[-1], list(out[:-1])
     for w, wbits in reversed(words):
         kw = jnp.take(_narrowed(w, wbits), perm)
         _, perm = lax.sort((kw, perm), num_keys=1, is_stable=True)
-    return perm
+    return perm, None
 
 
 def packed_lexsort(keys_msf: list[tuple[jnp.ndarray, int]]) -> jnp.ndarray:
@@ -242,21 +251,26 @@ def sort_with_bounds(key_cols: list, row_mask: jnp.ndarray,
     for col, asc, nf in key_cols[prefix:]:
         rest.extend(encode_key_bits(col, asc, nf))
     rwords = _pack_words(rest)
-    perm = _sort_words(pwords + rwords, cap)
-    sorted_valid = jnp.take(row_mask, perm)
+    perm, swords = _sort_words_full(pwords + rwords, cap)
+    # invalid rows sort LAST (the lead word's MSB is the invalid flag),
+    # so the sorted mask is a plain prefix — no gather needed
+    sorted_valid = jnp.arange(cap) < row_mask.sum()
 
-    def neq_over(words):
+    def neq_over(sorted_ws):
         acc = jnp.zeros(cap, bool)
-        for w, bits in words:
-            s = jnp.take(_narrowed(w, bits), perm)
+        for s in sorted_ws:
             acc = acc | (s != jnp.roll(s, 1))
         return acc
 
+    if swords is None:
+        swords = [jnp.take(_narrowed(w, b), perm)
+                  for w, b in pwords + rwords]
     first = jnp.arange(cap) == 0
-    pneq = neq_over(pwords)
+    pneq = neq_over(swords[:len(pwords)])
     prefix_bounds = sorted_valid & (pneq | first)
     if rwords:
-        all_bounds = sorted_valid & (pneq | neq_over(rwords) | first)
+        all_bounds = sorted_valid & \
+            (pneq | neq_over(swords[len(pwords):]) | first)
     else:
         all_bounds = prefix_bounds
     return perm, sorted_valid, prefix_bounds, all_bounds

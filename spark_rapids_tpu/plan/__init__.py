@@ -4,8 +4,8 @@ from spark_rapids_tpu.plan.nodes import (  # noqa: F401
     CpuAggregate, CpuBroadcastExchange, CpuCachedColumnar, CpuExpand,
     CpuFilter, CpuGenerate,
     CpuHashJoin, CpuLimit, CpuNode, CpuProject, CpuRange,
-    CpuShuffleExchange, CpuSort, CpuSortMergeJoin, CpuSource, CpuUnion,
-    PartitioningSpec)
+    CpuShuffleExchange, CpuSort, CpuSortAggregate, CpuSortMergeJoin,
+    CpuSource, CpuUnion, PartitioningSpec)
 from spark_rapids_tpu.plan.overrides import (  # noqa: F401
     ExecutionPlanCapture, accelerate, collect)
 from spark_rapids_tpu.plan.transitions import (  # noqa: F401

@@ -442,6 +442,32 @@ class CpuAggregate(CpuNode):
         return [iter([normalize_df(out, self._schema)])]
 
 
+class CpuSortAggregate(CpuAggregate):
+    """Sort-based aggregation — Spark plans SortAggregateExec for
+    aggregate shapes hash aggregation can't buffer (e.g. non-mutable
+    agg buffers).  The reference replaces it with the SAME hash
+    aggregate (`GpuOverrides.scala` exec[SortAggregateExec] ->
+    GpuHashAggregateExec); mirrored here: the CPU eval is the grouped
+    pandas path with sorted group order, the TPU conversion is
+    HashAggregateExec (its sort-based segment lane already emits
+    key-sorted output)."""
+
+    def describe(self):
+        return (f"CpuSortAggregate(keys={len(self.group_exprs)}, "
+                f"aggs={[a.name for a in self.aggregates]})")
+
+    def execute(self):
+        parts = super().execute()
+        key_names = [output_name(e, i)
+                     for i, e in enumerate(self.group_exprs)]
+        if not key_names:
+            return parts
+        out = pd.concat(list(parts[0]), ignore_index=True)
+        out = out.sort_values(key_names, ignore_index=True,
+                              kind="stable")
+        return [iter([out])]
+
+
 def _reduce(s: pd.Series, func):
     fname = type(func).__name__
     if fname == "Count":

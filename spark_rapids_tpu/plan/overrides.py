@@ -514,6 +514,15 @@ register_exec(
     exprs_of=lambda n: list(n.group_exprs) + [
         a.func.child for a in n.aggregates if a.func.child is not None],
     tag_extra=_tag_aggregate)
+# sort-based aggregation converts to the SAME hash aggregate, matching
+# the reference's exec[SortAggregateExec] -> GpuHashAggregateExec rule
+# (GpuOverrides.scala: "the Gpu version always uses hash aggregation")
+register_exec(
+    N.CpuSortAggregate, "sort aggregation (replaced with hash agg)",
+    _conv_aggregate,
+    exprs_of=lambda n: list(n.group_exprs) + [
+        a.func.child for a in n.aggregates if a.func.child is not None],
+    tag_extra=_tag_aggregate)
 register_exec(
     N.CpuHashJoin, "hash join", _conv_hash_join,
     exprs_of=lambda n: list(n.left_keys) + list(n.right_keys) +

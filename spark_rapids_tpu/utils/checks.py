@@ -24,8 +24,12 @@ from typing import Callable, Optional
 import numpy as np
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)
 class BatchCheck:
+    # eq=False: identity equality/hash.  The generated field-tuple
+    # __eq__ would compare `flag` — a device array — so any list
+    # membership test (e.g. _PENDING.remove) would dispatch an eq
+    # kernel and BLOCK on a D2H sync (~100ms/tunnel round trip).
     flag: object                      # device bool scalar; True = invalid
     origin: str                       # human-readable fast-path name
     recover: Optional[Callable] = None  # disables the fast path

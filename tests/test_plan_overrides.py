@@ -81,6 +81,19 @@ def test_aggregate_distributed_parity():
     assert "ShuffleExchangeExec" in names
 
 
+def test_sort_aggregate_replaced_with_hash_agg():
+    # reference rule: exec[SortAggregateExec] -> GpuHashAggregateExec
+    from spark_rapids_tpu.plan import CpuSortAggregate
+    src = CpuSource.from_pandas(_df(), num_partitions=3)
+    plan = CpuSortAggregate([(col("a") % 3).alias("k")],
+                            [Sum(col("a")).alias("sa"),
+                             Count(col("s")).alias("cs")], src)
+    tpu = compare(plan, sort_by=["k"])
+    names = _tpu_names(tpu)
+    assert names.count("HashAggregateExec") == 2
+    assert "SortAggregate" not in " ".join(names)
+
+
 def test_reduction_parity():
     src = CpuSource.from_pandas(_df(), num_partitions=2)
     plan = CpuAggregate([], [Sum(col("a")).alias("s"),
