@@ -317,23 +317,6 @@ class ColumnarBatch:
         rows = min(self._rows, n) if self.num_rows_known else count
         return ColumnarBatch(self.schema, cols, rows, self.checks)
 
-    def slice_lazy(self, start, length) -> "ColumnarBatch":
-        """Device-side row slice: `start`/`length` may be device scalars.
-        Output capacity stays the full batch capacity (it cannot be
-        bucketed without knowing `length`), so this suits small batches
-        and sync-free pipelines; use `slice` when the count is known."""
-        if self.sparse is not None:
-            return self.dense().slice_lazy(start, length)
-        cap = self.capacity
-        idx = jnp.arange(cap) + jnp.asarray(start, jnp.int32)
-        valid = jnp.arange(cap) < jnp.asarray(length, jnp.int32)
-        cols = [c.gather(jnp.where(valid, idx, 0), valid)
-                for c in self.columns]
-        return ColumnarBatch(self.schema, cols,
-                             length if isinstance(length, int)
-                             else jnp.asarray(length, jnp.int32),
-                             self.checks)
-
     def device_size_bytes(self) -> int:
         total = 0
         for c in self.columns:

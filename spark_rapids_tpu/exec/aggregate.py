@@ -32,9 +32,20 @@ from spark_rapids_tpu.exec.base import (
 from spark_rapids_tpu.exprs.aggregates import (
     AggAlias, AggContext, AggregateFunction)
 from spark_rapids_tpu.exprs.base import Expression, output_name
-from spark_rapids_tpu.ops.sort_encode import sort_with_bounds
+from spark_rapids_tpu.ops.sort_encode import (estimate_packed_words,
+                                              hash_sort_bounds,
+                                              sort_with_bounds)
 from spark_rapids_tpu.utils import checks as CK
 from spark_rapids_tpu.utils import metrics as M
+
+
+class _WidthOnly:
+    """Dtype/width stand-in for `estimate_packed_words` when a group
+    key is a computed expression (no backing column to inspect)."""
+    __slots__ = ("dtype", "narrow", "char_cap")
+
+    def __init__(self, dtype, narrow=None):
+        self.dtype, self.narrow, self.char_cap = dtype, narrow, 0
 
 
 class AggMode(enum.Enum):
@@ -133,9 +144,42 @@ class HashAggregateExec(UnaryExecBase):
                 fingerprint(self._child_schema))
 
     # -- kernels ------------------------------------------------------------
+    #: past this many estimated packed sort words the grouping sort
+    #: routes through the 2-word murmur3 hash lane — wide key sets
+    #: (string groupers emit one 9-bit key per char position) would
+    #: otherwise trace a sort chain whose XLA compile time and memory
+    #: scale with total key WIDTH (TPC-DS q64's 15-key string grouper
+    #: is ~100 words: minutes of compile, GBs of arena, per schema)
+    HASH_GROUP_MIN_WORDS = 4
+
+    def _use_hash_grouping(self, batch: ColumnarBatch) -> bool:
+        # the deopt retry must produce guaranteed-valid results (there
+        # is no second retry — see utils/checks.py), so it always takes
+        # the lexicographic lane, like _compact_groups
+        if getattr(self, "_hash_group_disabled", False) or CK.is_retrying():
+            return False
+        pseudo = []
+        for e in self._bound_groups:
+            ordinal = getattr(e, "ordinal", None)
+            if ordinal is not None:
+                pseudo.append((batch.columns[ordinal], True, True))
+                continue
+            dt = e.data_type(self._child_schema)
+            if dt.is_string:
+                return True  # computed string key: always wide
+            pseudo.append((_WidthOnly(dt, None), True, True))
+        return estimate_packed_words(pseudo) > self.HASH_GROUP_MIN_WORDS
+
+    def _disable_hash_grouping(self) -> None:
+        # a 64-bit murmur3 collision between two distinct key tuples
+        # (detected exactly by the in-kernel boundary/hash cross-check)
+        # deopts this exec to the lexicographic lane for good
+        self._hash_group_disabled = True
+
     def _groupby_kernel(self, batch: ColumnarBatch, phase: str):
         """phase: 'update' (raw inputs) or 'merge' (intermediates)."""
-        key = ("agg", phase, batch_signature(batch))
+        use_hash = self._use_hash_grouping(batch)
+        key = ("agg", phase, use_hash, batch_signature(batch))
 
         def build():
             cap = batch.capacity
@@ -146,8 +190,14 @@ class HashAggregateExec(UnaryExecBase):
             def kernel(columns, num_rows, mask=None):
                 ctx = make_eval_context(columns, cap, num_rows, mask)
                 keys = [e.eval(ctx) for e in bound_groups]
-                perm, sorted_valid, bounds, _ = sort_with_bounds(
-                    [(k, True, True) for k in keys], ctx.row_mask)
+                if use_hash:
+                    perm, sorted_valid, bounds, collision = \
+                        hash_sort_bounds([(k, True, True) for k in keys],
+                                         ctx.row_mask)
+                else:
+                    perm, sorted_valid, bounds, _ = sort_with_bounds(
+                        [(k, True, True) for k in keys], ctx.row_mask)
+                    collision = None
                 seg_ids = jnp.cumsum(bounds.astype(jnp.int32)) - 1
                 num_groups = bounds.sum().astype(jnp.int32)
                 # group key representatives: first row of each segment
@@ -211,11 +261,20 @@ class HashAggregateExec(UnaryExecBase):
                         ColumnVector(o.dtype, o.data,
                                      o.validity & grp_valid,
                                      o.lengths) for o in outs)
-                return out_cols, num_groups
+                return out_cols, num_groups, collision
 
             return kernel
 
         return self.kernels.get_or_build(key, build)
+
+    def _register_collision_check(self, collision, checks: tuple) -> tuple:
+        """Deferred 64-bit-collision deopt for the hash-grouping lane
+        (None = lexicographic lane, nothing to check)."""
+        if collision is None:
+            return checks
+        return checks + (CK.register(CK.BatchCheck(
+            collision, f"hashGroupby[exec {self.exec_id}]",
+            self._disable_hash_grouping)),)
 
     def _evaluate_kernel(self, batch: ColumnarBatch):
         """Final projection: intermediates -> results (no regrouping)."""
@@ -730,13 +789,14 @@ class HashAggregateExec(UnaryExecBase):
                     continue
                 kern = self._groupby_kernel(batch, phase)
                 if batch.sparse is not None:
-                    cols, n = kern(batch.columns, batch.num_rows_i32,
-                                   batch.sparse)
+                    cols, n, coll = kern(batch.columns, batch.num_rows_i32,
+                                         batch.sparse)
                 else:
-                    cols, n = kern(batch.columns, batch.num_rows_i32)
+                    cols, n, coll = kern(batch.columns, batch.num_rows_i32)
                 partials.append(self._compact_groups(
                     ColumnarBatch(inter_fields, list(cols), n,
-                                  batch.checks)))
+                                  self._register_collision_check(
+                                      coll, batch.checks))))
 
         if not partials:
             return
@@ -776,9 +836,11 @@ class HashAggregateExec(UnaryExecBase):
         merge_exec = self._get_merge_exec(inter_schema)
         with self.metrics.timed(M.TOTAL_TIME):
             kern = merge_exec._groupby_kernel(merged, "merge")
-            cols, n = kern(merged.columns, merged.num_rows_i32)
+            cols, n, coll = kern(merged.columns, merged.num_rows_i32)
         return self._compact_groups(
-            ColumnarBatch(inter_schema, list(cols), n, merged.checks))
+            ColumnarBatch(inter_schema, list(cols), n,
+                          merge_exec._register_collision_check(
+                              coll, merged.checks)))
 
     def _partial_schema(self) -> T.Schema:
         if self.mode == AggMode.FINAL:

@@ -52,16 +52,21 @@ def max_goal(a: Optional[CoalesceGoal], b: Optional[CoalesceGoal]
 
 
 # ---------------------------------------------------------------------------
+def columns_signature(fields, cols) -> tuple:
+    """Per-column shape signature entries for the compile cache:
+    (dtype, char_cap, narrowed?)."""
+    return tuple((f.dtype.id.value,
+                  c.char_cap if f.dtype.is_string else 0,
+                  c.narrow is not None)
+                 for f, c in zip(fields, cols))
+
+
 def batch_signature(batch: ColumnarBatch) -> tuple:
     """Shape signature for the compile cache: capacity + per-column
     (dtype, char_cap)."""
-    sig = [batch.capacity]
-    for f, c in zip(batch.schema.fields, batch.columns):
-        sig.append((f.dtype.id.value,
-                    c.char_cap if f.dtype.is_string else 0,
-                    c.narrow is not None))
-    sig.append(batch.sparse is not None)
-    return tuple(sig)
+    return ((batch.capacity,)
+            + columns_signature(batch.schema.fields, batch.columns)
+            + (batch.sparse is not None,))
 
 
 #: process-global executable store (bounded LRU): compiled kernels outlive
@@ -224,28 +229,44 @@ class TpuExec:
         offending fast path and re-execute once (plans are pure)."""
         from spark_rapids_tpu.utils import checks as CK
         mark = CK.snapshot()
+        _COLLECT_DEPTH[0] += 1
         try:
-            out = self._collect_once().dense()
-            out.prefetch()
-            # ONE verify over batch checks + the query's registered
-            # checks = one stacked flag readback (a second verify call
-            # would pay its own tunnel round trip)
-            CK.verify(list(out.checks) + CK.drain_since(mark))
-            return out
-        except CK.FastPathInvalid as e:
-            e.recover_all()
-            CK.drain_since(mark)  # discard THIS query's leftovers only
-            CK.set_retrying(True)
             try:
                 out = self._collect_once().dense()
                 out.prefetch()
+                # ONE verify over batch checks + the query's registered
+                # checks = one stacked flag readback (a second verify
+                # call would pay its own tunnel round trip)
                 CK.verify(list(out.checks) + CK.drain_since(mark))
-            finally:
-                CK.set_retrying(False)
-            return out
+                return out
+            except CK.FastPathInvalid as e:
+                e.recover_all()
+                CK.drain_since(mark)  # discard THIS query's leftovers
+                CK.set_retrying(True)
+                try:
+                    out = self._collect_once().dense()
+                    out.prefetch()
+                    CK.verify(list(out.checks) + CK.drain_since(mark))
+                finally:
+                    CK.set_retrying(False)
+                return out
+        finally:
+            _COLLECT_DEPTH[0] -= 1
+            if _COLLECT_DEPTH[0] == 0:
+                # only the OUTERMOST collect tears down shared-subtree
+                # caches: a nested collect (CpuBroadcastExchange
+                # materializing its child mid-plan) must not clear the
+                # enclosing query's CommonSubplanExec results
+                self.release_execution_state()
 
     def _collect_once(self) -> ColumnarBatch:
         from spark_rapids_tpu.columnar.batch import concat_batches, empty_batch
+        if _COLLECT_DEPTH[0] <= 1:
+            # new top-level execution attempt: shared subtrees re-run.
+            # Nested collects (broadcast materialization inside a plan)
+            # must NOT bump the epoch — that would silently invalidate
+            # the outer query's CommonSubplanExec caches mid-execution
+            _EXECUTION_EPOCH[0] += 1
         batches = list(self.execute_columnar())
         if not batches:
             return empty_batch(self.output_schema())
@@ -267,11 +288,73 @@ class TpuExec:
             s += "\n" + c.tree_string(indent + 1)
         return s
 
+    def release_execution_state(self) -> None:
+        """Drop per-execution materialized state (CommonSubplanExec
+        caches) after a collect completes, so a finished query doesn't
+        pin its shared subtrees' device batches."""
+        for c in self._children:
+            c.release_execution_state()
+
     def describe(self) -> str:
         return self.name()
 
     def __repr__(self):
         return self.tree_string()
+
+
+#: bumped once per TOP-LEVEL plan execution attempt (collect and its
+#: deopt retry); CommonSubplanExec uses it to scope its materialized
+#: results to a single execution, so retries re-run the subtree with
+#: fast paths disabled and results don't outlive the query
+_EXECUTION_EPOCH = [0]
+#: collect() nesting depth — broadcast exchanges collect their child
+#: mid-plan; those inner collects must neither bump the epoch nor
+#: release the outer query's shared-subtree caches
+_COLLECT_DEPTH = [0]
+
+
+class CommonSubplanExec(TpuExec):
+    """Execute-once wrapper for a subtree shared by several parents
+    (plan DAGs with reused CTEs: TPC-DS q64's cross_sales, q23's
+    frequent-items subquery).  The role Spark's ReusedExchangeExec
+    plays for the reference: without it every consumer re-executes the
+    whole shared subtree."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__(child)
+        self._epoch = -1
+        self._cached = None
+
+    def output_schema(self):
+        return self.child.output_schema()
+
+    def output_partition_count(self):
+        return self.child.output_partition_count()
+
+    @property
+    def coalesce_after(self) -> bool:
+        # transparent for coalesce insertion: a shared subtree rooted
+        # at a batch-shrinking exec still wants coalesce above it
+        return self.child.coalesce_after
+
+    def describe(self):
+        return "CommonSubplanExec"
+
+    def execute_partitions(self):
+        if self._epoch != _EXECUTION_EPOCH[0]:
+            self._cached = [list(it)
+                            for it in self.child.execute_partitions()]
+            self._epoch = _EXECUTION_EPOCH[0]
+        return [iter(p) for p in self._cached]
+
+    def execute_columnar(self):
+        for it in self.execute_partitions():
+            yield from it
+
+    def release_execution_state(self):
+        self._cached = None
+        self._epoch = -1
+        super().release_execution_state()
 
 
 class SchemaOnlyExec(TpuExec):

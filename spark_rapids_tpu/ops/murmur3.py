@@ -79,10 +79,28 @@ def _double_to_words(x):
     multiplies (each fits 32 bits).  Specials (0, inf, nan, subnormal)
     handled explicitly; NaN canonicalized like Java's doubleToLongBits."""
     x = x.astype(jnp.float64)
-    neg = jnp.signbit(x)
+    # jnp.signbit lowers through a 64-bit bitcast XLA:TPU's x64
+    # rewriter rejects; IEEE division distinguishes -0.0 instead
+    neg = (x < 0) | ((x == 0) & (1.0 / x < 0))
     ax = jnp.abs(x)
-    m, e = jnp.frexp(ax)                      # ax = m * 2^e, m in [0.5, 1)
-    biased = (e + 1022).astype(jnp.int64)     # IEEE exponent field
+    # frexp equivalent in pure f64 arithmetic: jnp.frexp lowers through
+    # a 64-bit bitcast that XLA:TPU's x64 rewriter rejects.  Normalize
+    # ax into [1, 2) by exact power-of-two multiplies selected with
+    # comparisons (11 + 11 where-steps), accumulating the exponent.
+    m = ax
+    e = jnp.zeros(ax.shape, jnp.int32)
+    for k in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        big = m >= 2.0 ** k
+        m = jnp.where(big, m * (2.0 ** -k), m)
+        e = e + jnp.where(big, k, 0)
+    for k in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        small = m < 2.0 ** (1 - k)
+        m = jnp.where(small, m * (2.0 ** k), m)
+        e = e - jnp.where(small, k, 0)
+    # ax = m * 2^e with m in [1, 2); shift to frexp's m in [0.5, 1)
+    m = m * 0.5
+    e = e + 1
+    biased = (e + 1022).astype(jnp.int32)     # IEEE exponent field
     is_sub = biased <= 0                      # subnormal range
     # normal: mantissa field = (m*2 - 1) * 2^52, split hi 20 / lo 32
     frac = m * 2.0 - 1.0                      # [0, 1)

@@ -276,6 +276,117 @@ def sort_with_bounds(key_cols: list, row_mask: jnp.ndarray,
     return perm, sorted_valid, prefix_bounds, all_bounds
 
 
+def _key_bit_widths(col) -> list:
+    """Per-key bit widths `encode_key_bits` would emit for one column
+    (None = unpackable float64 value word).  Kept adjacent to
+    `encode_key_bits`' dtype dispatch — the two tables must agree for
+    the routing estimate to match the real encode."""
+    dt = col.dtype
+    out = [1]  # null rank
+    if dt.is_string:
+        out += [9] * col.char_cap
+    elif dt.id == T.TypeId.FLOAT32:
+        out += [1, 32]
+    elif dt.is_floating:
+        out += [1, None]
+    elif dt.id == T.TypeId.BOOL:
+        out += [1]
+    elif dt.id == T.TypeId.INT8:
+        out += [8]
+    elif dt.id == T.TypeId.INT16:
+        out += [16]
+    elif dt.id in (T.TypeId.INT32, T.TypeId.DATE32):
+        out += [32]
+    elif col.narrow is not None:
+        out += [32]
+    else:
+        out += [64]
+    return out
+
+
+def estimate_packed_words(key_cols) -> int:
+    """STATIC count of the packed sort words `sort_with_bounds` would
+    need for (column, asc, nulls_first) keys — usable at kernel-build
+    time to route wide key sets (string groupers explode into one
+    9-bit key per char position) to the hash-grouping lane before
+    paying the encode.  Simulates `_pack_words`' greedy rule exactly
+    (keys never split across words; unpackable float64 flushes), so
+    the estimate can't drift low and strand wide keys on the slow
+    lane."""
+    widths = [1]  # invalid-rows lead flag
+    for col, _asc, _nf in key_cols:
+        widths.extend(_key_bit_widths(col))
+    words, used = 0, 0
+    for bits in widths:
+        if bits is None:           # unpackable: own word, flush first
+            words += 1 if used else 0
+            words += 1
+            used = 0
+        elif used and used + bits <= 64:
+            used += bits
+        else:
+            words += 1 if used else 0
+            used = bits
+    return words + (1 if used else 0)
+
+
+def _grouping_hash(cols, seed: int) -> jnp.ndarray:
+    """Row hash for the hash-grouping lane.  NOT Spark's Murmur3Hash:
+    Spark chains a null as the unchanged seed, which makes shifted
+    null patterns — (NULL, x) vs (x, NULL) — collide DETERMINISTICALLY
+    on every seed and would fire the collision deopt on ordinary
+    nullable multi-key data.  Here a null mixes a per-column marker
+    into the chain instead, so only genuine 64-bit accidents collide."""
+    from spark_rapids_tpu.ops.murmur3 import hash_column, hash_int
+    cap = cols[0].capacity
+    h = jnp.full(cap, seed, jnp.uint32)
+    for i, c in enumerate(cols):
+        hc = hash_column(c, h)
+        null_mark = jnp.full(cap, (0x9E3779B9 * (i + 1)) & 0xFFFFFFFF,
+                             jnp.uint32)
+        h = jnp.where(c.validity, hc, hash_int(null_mark, h))
+    return h
+
+
+def hash_sort_bounds(key_cols: list, row_mask: jnp.ndarray):
+    """Equality-only grouping: sort rows by TWO murmur3 words instead
+    of the full lexicographic key encode, then read exact segment
+    boundaries off the ACTUAL key values of adjacent sorted rows
+    (`segment_boundaries` — one vectorized compare per key column).
+
+    Group-by needs grouping, not ordering, so this replaces the
+    word-chain sort whose width scales with key content (a 15-column
+    string grouper is ~100 packed words ⇒ a 100-pass sort chain whose
+    XLA compile alone runs minutes and allocates GBs; TPC-DS q64).
+    The murmur3 lane is 2 words for ANY key set.
+
+    SQL-equal keys always hash equal (`ops/murmur3.hash_column`
+    canonicalizes NaN / -0.0 and chains nulls as the unchanged seed),
+    so a group can only fragment when two DIFFERENT key tuples collide
+    on both 32-bit hashes.  That case is detected exactly — a key
+    boundary with no hash change — and returned as a deferred flag the
+    caller turns into a deopt check (reference analog: cuDF hash
+    groupby under `aggregate.scala:312`, which also trades order for
+    equality).
+
+    Returns (perm, sorted_valid, bounds, collision_flag)."""
+    cols = [c for c, _asc, _nf in key_cols]
+    cap = row_mask.shape[0]
+    h1 = _grouping_hash(cols, 42)
+    h2 = _grouping_hash(cols, 0x3C6EF372)
+    # invalid rows sort last: flag above the first hash word
+    w1 = ((~row_mask).astype(jnp.uint64) << jnp.uint64(32)) \
+        | h1.astype(jnp.uint64)
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    sw1, sw2, perm = lax.sort((w1, h2, perm), num_keys=2, is_stable=True)
+    sorted_valid = jnp.arange(cap) < row_mask.sum()
+    bounds = segment_boundaries(cols, perm, row_mask)
+    first = jnp.arange(cap) == 0
+    hash_change = (sw1 != jnp.roll(sw1, 1)) | (sw2 != jnp.roll(sw2, 1))
+    collision = jnp.any(bounds & ~hash_change & ~first)
+    return perm, sorted_valid, bounds, collision
+
+
 def multi_key_argsort(key_cols: list[tuple[ColumnVector, bool, bool]],
                       row_mask: jnp.ndarray) -> jnp.ndarray:
     """Stable argsort by multiple (column, ascending, nulls_first) keys;

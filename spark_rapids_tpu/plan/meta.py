@@ -108,17 +108,31 @@ class PlanMeta(BaseMeta):
     """Wraps one CpuNode (reference SparkPlanMeta)."""
 
     def __init__(self, node: CpuNode, conf: C.RapidsConf,
-                 parent: Optional[BaseMeta], rule):
+                 parent: Optional[BaseMeta], rule,
+                 memo: Optional[dict] = None):
         super().__init__(conf, parent)
         self.node = node
         self.rule = rule
-        self.child_plans = [wrap_plan(c, conf, self)
+        #: >1 when this CpuNode object appears at several DAG positions
+        #: (CTE reuse: q64's cross_sales, q23's frequent-items subquery);
+        #: conversion then wraps the exec in CommonSubplanExec so the
+        #: subtree executes once per query, not once per consumer —
+        #: the ReusedExchangeExec role in the reference's Spark planner
+        self.ref_count = 1
+        self._converted = _UNCONVERTED
+        self.child_plans = [wrap_plan(c, conf, self, memo)
                             for c in node.children]
         exprs = rule.exprs_of(node) if rule is not None else []
         self.child_exprs = [wrap_expr(e, conf, self) for e in exprs]
 
     # -- tagging -------------------------------------------------------------
     def tag_for_tpu(self) -> None:
+        # visit-once over the meta DAG: a shared meta (ref_count > 1)
+        # is reached from every parent; re-tagging would re-run
+        # tag_extra probes and duplicate reasons
+        if getattr(self, "_tagged", False):
+            return
+        self._tagged = True
         for c in self.child_plans:
             c.tag_for_tpu()
         for e in self.child_exprs:
@@ -170,15 +184,29 @@ class PlanMeta(BaseMeta):
     def convert_if_needed(self):
         """Returns TpuExec when this node goes on the TPU, else a CpuNode
         with converted children bridged through transitions
-        (reference convertIfNeeded RapidsMeta.scala:578-593)."""
+        (reference convertIfNeeded RapidsMeta.scala:578-593).
+
+        A meta shared by several parents (ref_count > 1: the plan is a
+        DAG with a reused CTE subtree) converts ONCE and returns the
+        same exec to every parent, wrapped in CommonSubplanExec so the
+        subtree's results materialize once per execution."""
+        if self._converted is not _UNCONVERTED:
+            return self._converted
+        self._converted = self._convert_once()
+        return self._converted
+
+    def _convert_once(self):
         from spark_rapids_tpu.plan.transitions import RowToColumnarExec
         from spark_rapids_tpu.shims import current_shims
         kids = [c.convert_if_needed() for c in self.child_plans]
-        from spark_rapids_tpu.exec.base import TpuExec
+        from spark_rapids_tpu.exec.base import CommonSubplanExec, TpuExec
         if self.can_this_be_replaced:
             tpu_kids = [k if isinstance(k, TpuExec) else RowToColumnarExec(k)
                         for k in kids]
-            return self.rule.convert(self, tpu_kids)
+            out = self.rule.convert(self, tpu_kids)
+            if self.ref_count > 1 and isinstance(out, TpuExec):
+                out = CommonSubplanExec(out)
+            return out
         shims = current_shims(self.conf)
         cpu_kids = [k if isinstance(k, CpuNode)
                     else shims.columnar_to_row_transition(k)
@@ -189,20 +217,28 @@ class PlanMeta(BaseMeta):
         return node
 
     # -- explain -------------------------------------------------------------
-    def explain(self, all_nodes: bool = False, indent: int = 0) -> str:
+    def explain(self, all_nodes: bool = False, indent: int = 0,
+                _seen: Optional[set] = None) -> str:
+        if _seen is None:
+            _seen = set()
         lines = []
         pad = "  " * indent
+        reused = id(self) in _seen
+        _seen.add(id(self))
         if self.can_this_be_replaced:
             if all_nodes:
-                lines.append(f"{pad}*{self.node.name()} will run on TPU")
+                tag = " (reused subtree)" if reused else ""
+                lines.append(f"{pad}*{self.node.name()} will run on "
+                             f"TPU{tag}")
         else:
             why = "; ".join(sorted(self._reasons))
             lines.append(f"{pad}!{self.node.name()} cannot run on TPU "
                          f"because {why}")
-        for c in self.child_plans:
-            s = c.explain(all_nodes, indent + 1)
-            if s:
-                lines.append(s)
+        if not reused:
+            for c in self.child_plans:
+                s = c.explain(all_nodes, indent + 1, _seen)
+                if s:
+                    lines.append(s)
         return "\n".join(l for l in lines if l)
 
 
@@ -212,10 +248,24 @@ def wrap_expr(expr: Expression, conf: C.RapidsConf,
     return ExprMeta(expr, conf, parent, expr_rule_for(expr))
 
 
+#: sentinel: PlanMeta not converted yet (None is a valid conversion
+#: result in principle, so a dedicated marker)
+_UNCONVERTED = object()
+
+
 def wrap_plan(node: CpuNode, conf: C.RapidsConf,
-              parent: Optional[BaseMeta] = None) -> PlanMeta:
+              parent: Optional[BaseMeta] = None,
+              memo: Optional[dict] = None) -> PlanMeta:
     from spark_rapids_tpu.plan.overrides import exec_rule_for
-    return PlanMeta(node, conf, parent, exec_rule_for(node))
+    if memo is None:
+        memo = {}
+    hit = memo.get(id(node))
+    if hit is not None:
+        hit.ref_count += 1
+        return hit
+    m = PlanMeta(node, conf, parent, exec_rule_for(node), memo)
+    memo[id(node)] = m
+    return m
 
 
 def fix_up_exchange_overhead(meta: PlanMeta) -> None:
@@ -225,6 +275,8 @@ def fix_up_exchange_overhead(meta: PlanMeta) -> None:
     from spark_rapids_tpu.plan.nodes import (
         CpuBroadcastExchange, CpuShuffleExchange)
 
+    seen: set = set()
+
     def walk(m: PlanMeta, parent_on_tpu: Optional[bool]) -> None:
         is_exchange = isinstance(
             m.node, (CpuShuffleExchange, CpuBroadcastExchange))
@@ -233,6 +285,11 @@ def fix_up_exchange_overhead(meta: PlanMeta) -> None:
             if not child_ok and parent_on_tpu is not True:
                 m.will_not_work_on_tpu(
                     "columnar exchange without columnar neighbors")
+        # shared metas (DAG reuse) descend once; a revisit could only
+        # re-append the same reasons and multiplies walk cost per parent
+        if id(m) in seen:
+            return
+        seen.add(id(m))
         for c in m.child_plans:
             walk(c, m.can_this_be_replaced)
 

@@ -61,8 +61,82 @@ def prune_columns(node: N.CpuNode, required: Optional[set] = None
                   ) -> N.CpuNode:
     """Returns an equivalent tree whose leaves produce only `required`
     columns (None = all).  Never mutates the input.  Node-attached state
-    (AQE `_tpu_tag` pins) survives the rebuild."""
-    new = _prune(node, required)
+    (AQE `_tpu_tag` pins) survives the rebuild.
+
+    DAG-aware: a node object referenced by several parents (reused CTE
+    subtree — q64's cross_sales, q23's frequent-items subquery) is
+    pruned ONCE with the UNION of its parents' requirements and the
+    same pruned object is returned to every parent, so the sharing
+    survives into plan rewrite where `wrap_plan`/CommonSubplanExec turn
+    it into execute-once reuse (Spark's ReusedExchangeExec role)."""
+    # -- pass 1: reference counts over the DAG
+    refs: dict = {}
+    nodes_by_id: dict = {}
+
+    def count(n):
+        refs[id(n)] = refs.get(id(n), 0) + 1
+        if refs[id(n)] == 1:
+            nodes_by_id[id(n)] = n
+            for c in n.children:
+                count(c)
+    count(node)
+    shared = {i for i, c in refs.items() if c > 1}
+
+    if not shared:
+        return _rec_plain(node, required)
+
+    # -- pass 2: fixpoint of required-column unions at shared nodes
+    # (None = all columns, absorbing)
+    req_u: dict = {}
+
+    def merge(i, req):
+        if i not in req_u:
+            req_u[i] = None if req is None else set(req)
+            return True
+        old = req_u[i]
+        if old is None:
+            return False
+        if req is None:
+            req_u[i] = None
+            return True
+        if req - old:
+            req_u[i] = old | req
+            return True
+        return False
+
+    def analyze(child, req):
+        if id(child) in shared:
+            merge(id(child), req)
+            return child  # defer: analyzed from its own union below
+        return _prune(child, req, analyze, build=False)
+
+    _prune(node, required, analyze, build=False)
+    for _ in range(len(shared) + 1):
+        snap = {i: (None if v is None else frozenset(v))
+                for i, v in req_u.items()}
+        for i in list(req_u):
+            _prune(nodes_by_id[i], req_u[i], analyze, build=False)
+        if snap == {i: (None if v is None else frozenset(v))
+                    for i, v in req_u.items()}:
+            break
+
+    # -- pass 3: memoized rebuild
+    memo: dict = {}
+
+    def build(child, req):
+        i = id(child)
+        if i in shared:
+            hit = memo.get(i)
+            if hit is None:
+                hit = _with_pin(child, _prune(child, req_u.get(i), build))
+                memo[i] = hit
+            return hit
+        return _with_pin(child, _prune(child, req, build))
+
+    return _with_pin(node, _prune(node, required, build))
+
+
+def _with_pin(node, new):
     if new is not node and "_tpu_tag" in node.__dict__:
         # MOVE the pin (consume-once semantics): the pruned tree is what
         # this planning session tags, and a pin must not survive on the
@@ -71,10 +145,22 @@ def prune_columns(node: N.CpuNode, required: Optional[set] = None
     return new
 
 
-def _prune(node: N.CpuNode, required: Optional[set]) -> N.CpuNode:
+def _rec_plain(node, required):
+    def rec(c, r):
+        return _with_pin(c, _prune(c, r, rec))
+    return _with_pin(node, _prune(node, required, rec))
+
+
+def _prune(node: N.CpuNode, required: Optional[set],
+           prune_columns, build: bool = True) -> N.CpuNode:
+    """One pruning step; recursion goes through the `prune_columns`
+    callback (shadowing the module function on purpose) so the
+    DAG-aware driver can intercept shared nodes.  `build=False` runs
+    the same traversal for requirement ANALYSIS only: leaf narrowing
+    (which copies real source data) is skipped."""
     if isinstance(node, N.CpuSource):
         schema = node.output_schema()
-        if required is None or required >= set(schema.names):
+        if not build or required is None or required >= set(schema.names):
             return node
         keep = [f.name for f in schema.fields if f.name in required]
         if not keep:  # count(*)-style: keep one narrow column for rows
@@ -84,7 +170,8 @@ def _prune(node: N.CpuNode, required: Optional[set]) -> N.CpuNode:
 
     if type(node).__name__ == "CpuFileScan":
         schema = node.output_schema()
-        if required is None or required >= set(schema.names) \
+        if not build or required is None \
+                or required >= set(schema.names) \
                 or node.scan.file_format == "csv":
             return node  # csv readers key off the full file column list
         keep = set(required)
@@ -121,8 +208,22 @@ def _prune(node: N.CpuNode, required: Optional[set]) -> N.CpuNode:
                           node.global_limit)
 
     if isinstance(node, N.CpuUnion):
-        return N.CpuUnion(*[prune_columns(c, required)
-                            for c in node.children])
+        kids = [prune_columns(c, required) for c in node.children]
+        if build:
+            # union children must agree positionally; a SHARED child is
+            # pruned to the union of all its parents' requirements and
+            # can come back wider than its siblings — project it down
+            # to the set this union actually asked for
+            schema0 = node.children[0].output_schema()
+            want = [f.name for f in schema0.fields
+                    if required is None or f.name in required]
+            if not want:
+                want = [schema0.fields[0].name]
+            kids = [k if list(k.output_schema().names) == want
+                    else N.CpuProject(
+                        [AttributeReference(n) for n in want], k)
+                    for k in kids]
+        return N.CpuUnion(*kids)
 
     if isinstance(node, N.CpuShuffleExchange):
         need = None if required is None else \

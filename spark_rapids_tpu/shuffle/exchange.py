@@ -191,13 +191,57 @@ class ShuffleExchangeExec(UnaryExecBase):
         if C.get_active_conf()[C.RAPIDS_SHUFFLE_ENABLED]:
             return self._execute_via_manager()
         buckets = self._materialize()
+        return [self._merged_reader(bs) for bs in buckets]
 
-        def reader(bs: list[ColumnarBatch]):
-            for b in bs:
-                self.metrics.add(M.NUM_OUTPUT_ROWS, b._rows)
-                self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
-                yield b
-        return [reader(bs) for bs in buckets]
+    #: reduce-side consolidation target (the role GpuCoalesceBatches
+    #: plays after GPU shuffles, `GpuCoalesceBatches.scala:53`): a
+    #: partition's split slices merge device-side up to this capacity
+    #: before flowing downstream.  Without it every map-side batch
+    #: contributes one slice per partition PER HOP, so a deep
+    #: exchange chain multiplies batch count exponentially — TPC-DS
+    #: q64 (19 exchanges) reached tens of thousands of live 1K-cap
+    #: batches and tens of GB of device arrays.
+    MERGE_TARGET_CAP = 1 << 16
+
+    def _merged_reader(self, bs: list[ColumnarBatch]):
+        group: list[ColumnarBatch] = []
+        cap_sum = 0
+
+        def flush():
+            if len(group) == 1:
+                m = group[0]
+            else:
+                # sync the slices' row counts (ONE stacked readback)
+                # and concat TIGHT: the sync-free lazy concat keeps
+                # the summed worst-case capacity, and across a deep
+                # exchange chain that re-inflates every hop to the
+                # merge target no matter how few real rows flow
+                import jax.numpy as jnp
+                import numpy as np
+                dense = [b.dense() for b in group]
+                unknown = [b for b in dense if not b.num_rows_known]
+                if unknown:
+                    vals = np.asarray(jnp.stack(
+                        [b.num_rows_i32 for b in unknown])).tolist()
+                    it = iter(vals)
+                    dense = [b if b.num_rows_known else
+                             ColumnarBatch(b.schema, list(b.columns),
+                                           int(next(it)), b.checks)
+                             for b in dense]
+                m = concat_batches([b for b in dense if b.num_rows > 0]
+                                   or dense[:1])
+            self.metrics.add(M.NUM_OUTPUT_ROWS, m._rows)
+            self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+            return m
+
+        for b in bs:
+            if group and cap_sum + b.capacity > self.MERGE_TARGET_CAP:
+                yield flush()
+                group, cap_sum = [], 0
+            group.append(b)
+            cap_sum += b.capacity
+        if group:
+            yield flush()
 
     def _mesh_routable(self):
         """The accelerated ICI lane applies when: the conf enables it, a

@@ -20,7 +20,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.vector import bucket_capacity
 from spark_rapids_tpu.exec.base import KernelCache, batch_signature, \
-    make_eval_context
+    columns_signature, make_eval_context
 from spark_rapids_tpu.exprs.base import Expression
 from spark_rapids_tpu.ops.murmur3 import partition_ids
 from spark_rapids_tpu.ops.sort_encode import multi_key_argsort
@@ -95,16 +95,19 @@ def _split_kernel_for(cache: KernelCache, batch: ColumnarBatch,
     return cache.get_or_build(key, build)
 
 
-def _gather_reordered(columns, order, valid):
+def _gather_reordered(columns, order, valid, packed_bits=None):
     """Row reorder with the fewest random-access streams (each costs
     ~70ns/row on this chip, dwarfing bandwidth): validities of ALL
     numeric columns pack into one i32 bitmask gathered once, and value
     streams go through gather_narrowest (i32-shadow-only for in-range
     int64).  Strings keep the general ColumnVector.gather (char
-    tensors need their own streams anyway)."""
+    tensors need their own streams anyway).  `packed_bits` lets a
+    caller that gathers the same columns repeatedly (the partition cut
+    kernel) pack the validity mask once."""
     from spark_rapids_tpu.columnar.vector import (gather_narrowest,
                                                   pack_validity_bits)
-    bits, packed = pack_validity_bits(columns)
+    bits, packed = (pack_validity_bits(columns) if packed_bits is None
+                    else packed_bits)
     vm = None if packed is None else jnp.take(packed, order, mode="clip")
     out = []
     for ci, c in enumerate(columns):
@@ -123,23 +126,55 @@ def _gather_reordered(columns, order, valid):
 LAZY_SLICE_MAX_CAP = 1 << 16
 
 
+_CUT_CACHE = KernelCache(("partition_cut",))
+
+
+def _cut_kernel_for(schema: T.Schema, cols, total_cap: int, n_parts: int):
+    """ONE jitted dispatch that cuts the pid-sorted batch into all
+    n_parts full-capacity slices (plus their lazy row counts).  The
+    per-partition lazy-slice loop this replaces paid ~6 eager
+    dispatches per COLUMN per partition — on a deep plan (TPC-DS q64:
+    18 joins, ~30 exchanges) that dominated wall-clock; here XLA fuses
+    the whole cut and the engine pays one dispatch per input batch."""
+    key = (total_cap, n_parts) + columns_signature(schema.fields, cols)
+
+    def build():
+        from spark_rapids_tpu.columnar.vector import pack_validity_bits
+        base = jnp.arange(total_cap)
+
+        @jax.jit
+        def kernel(columns, counts):
+            offs = jnp.cumsum(counts) - counts
+            packed_bits = pack_validity_bits(columns)
+            outs = []
+            for p in range(n_parts):
+                valid = base < counts[p]
+                idx = jnp.where(valid, base + offs[p], 0)
+                outs.append((_gather_reordered(columns, idx, valid,
+                                               packed_bits),
+                             counts[p].astype(jnp.int32)))
+            return outs
+
+        return kernel
+
+    return _CUT_CACHE.get_or_build(key, build)
+
+
 def _slice_partitions(batch_cols, counts, schema: T.Schema,
                       total_cap: int, checks: tuple = ()
                       ) -> list[ColumnarBatch]:
     """Cut the pid-sorted batch into per-partition batches.  `counts`
-    may be a DEVICE vector: small batches slice sync-free (device
-    offsets, full-capacity slices, lazy row counts); large ones sync
-    once and cut tight host-side slices.  (Lazy slicing at ANY capacity
-    for clustering-only consumers was tried and measured SLOWER — the
+    may be a DEVICE vector: small batches slice sync-free (one fused
+    cut kernel, lazy row counts); large ones sync once and cut tight
+    host-side slices.  (Lazy slicing at ANY capacity for
+    clustering-only consumers was tried and measured SLOWER — the
     full-capacity slices make every downstream per-slice kernel pay the
     input capacity, which costs more than the count sync saves.)"""
     n_parts = counts.shape[0]
     if not isinstance(counts, np.ndarray) and total_cap <= LAZY_SLICE_MAX_CAP:
-        offs = jnp.cumsum(counts) - counts
-        total = jnp.sum(counts)
-        reordered = ColumnarBatch(schema, list(batch_cols), total, checks)
-        return [reordered.slice_lazy(offs[p], counts[p])
-                for p in range(n_parts)]
+        kern = _cut_kernel_for(schema, batch_cols, total_cap, n_parts)
+        return [ColumnarBatch(schema, cols, n, checks)
+                for cols, n in kern(list(batch_cols), counts)]
     counts = np.asarray(counts)
     out = []
     offsets = np.concatenate([[0], np.cumsum(counts)])

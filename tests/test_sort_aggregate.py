@@ -630,3 +630,74 @@ def test_compaction_retry_bypasses_and_future_collects_use_ladder(rng):
         "k", ignore_index=True)
     got = out.sort_values("k", ignore_index=True)
     np.testing.assert_allclose(got["s"].astype(float), exp["v"], rtol=1e-9)
+
+
+# -- hash-grouping lane (wide key sets route via murmur3 grouping) ----------
+
+def _wide_key_df(rng, n=400):
+    """5 group keys incl. strings: estimate_packed_words > 4 so the
+    hash-grouping lane engages."""
+    return pd.DataFrame({
+        "city": rng.choice(["springfield", "shelbyville", "ogdenville",
+                            "capital city"], n),
+        "street": rng.choice(["elm st", "oak ave", "main st"], n),
+        "zip": rng.choice(["12345", "67890"], n),
+        "yr": rng.integers(1999, 2002, n).astype(np.int64),
+        "sku": rng.integers(0, 5, n).astype(np.int64),
+        "v": rng.uniform(0, 10, n),
+    })
+
+
+def test_hash_grouping_lane_parity(rng):
+    df = _wide_key_df(rng)
+    keys = ["city", "street", "zip", "yr", "sku"]
+    plan = HashAggregateExec(
+        [col(k) for k in keys],
+        [Sum(col("v")).alias("s"), Count(col("v")).alias("c")],
+        LocalBatchSource.from_pandas(df))
+    assert plan._use_hash_grouping(
+        ColumnarBatch.from_pandas(df)), "lane must engage for wide keys"
+    got = plan.to_pandas().sort_values(keys, ignore_index=True)
+    exp = (df.groupby(keys).agg(s=("v", "sum"), c=("v", "size"))
+           .reset_index().sort_values(keys, ignore_index=True))
+    np.testing.assert_allclose(got["s"].astype(float), exp["s"], rtol=1e-9)
+    np.testing.assert_array_equal(got["c"].astype(int), exp["c"])
+
+
+def test_hash_grouping_shifted_null_patterns(rng):
+    """(NULL, x, ...) vs (x, NULL, ...) keys: Spark's null-keeps-seed
+    murmur3 chaining hashes these EQUAL on every seed, which would
+    fire the collision deopt systematically; the grouping hash mixes a
+    per-column null marker so these group correctly on the fast lane."""
+    n = 64
+    a = np.arange(n).astype(np.float64)
+    b = np.arange(n).astype(np.float64)
+    a[::2] = np.nan   # -> nulls via from_pandas
+    b[1::2] = np.nan
+    df = pd.DataFrame({
+        "a": a, "b": b,
+        "s1": ["x"] * n, "s2": ["y"] * n, "s3": ["z"] * n,
+        "v": np.ones(n),
+    })
+    keys = ["a", "b", "s1", "s2", "s3"]
+    plan = HashAggregateExec(
+        [col(k) for k in keys], [Sum(col("v")).alias("s")],
+        LocalBatchSource.from_pandas(df))
+    assert plan._use_hash_grouping(ColumnarBatch.from_pandas(df))
+    got = plan.to_pandas()
+    exp = (df.groupby(keys, dropna=False).agg(s=("v", "sum"))
+           .reset_index())
+    assert len(got) == len(exp)
+    # the lane must NOT have deopted (no collision on ordinary nulls)
+    assert not getattr(plan, "_hash_group_disabled", False)
+    np.testing.assert_allclose(
+        got.sort_values(keys, ignore_index=True)["s"].astype(float),
+        exp.sort_values(keys, ignore_index=True)["s"], rtol=1e-9)
+
+
+def test_hash_grouping_narrow_keys_stay_lexicographic(rng):
+    df = _sales_df(rng)
+    plan = HashAggregateExec(
+        [col("sku")], [Sum(col("qty")).alias("s")],
+        LocalBatchSource.from_pandas(df))
+    assert not plan._use_hash_grouping(ColumnarBatch.from_pandas(df))
