@@ -237,6 +237,17 @@ DICT_GROUPBY_MAX_GROUPS = conf(
     "spark.rapids.tpu.dictGroupby.maxGroups", 4096,
     "Max runtime key range for the dictionary group-by fast path (the "
     "one-hot table must fit VMEM).")
+HASH_GROUPING_ENABLED = conf(
+    "spark.rapids.tpu.hashGrouping.enabled", True,
+    "Wide grouping key sets (aggregate GROUP BY, window PARTITION BY) "
+    "sort by two murmur3-derived words instead of the lexicographic "
+    "key encode, whose width scales with key content (string keys "
+    "emit one 9-bit sort word slice PER CHARACTER; a 15-column string "
+    "grouper is ~100 packed words and its XLA compile alone runs "
+    "minutes). Exact: segment boundaries come from the actual "
+    "adjacent key values, and a detected 64-bit hash collision deopts "
+    "the query to the lexicographic lane via the deferred-check "
+    "retry.")
 DENSE_JOIN_ENABLED = conf(
     "spark.rapids.tpu.denseJoin.enabled", True,
     "Direct-address equi-join fast path: when a single integral build "

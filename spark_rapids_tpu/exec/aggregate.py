@@ -32,20 +32,11 @@ from spark_rapids_tpu.exec.base import (
 from spark_rapids_tpu.exprs.aggregates import (
     AggAlias, AggContext, AggregateFunction)
 from spark_rapids_tpu.exprs.base import Expression, output_name
-from spark_rapids_tpu.ops.sort_encode import (estimate_packed_words,
-                                              hash_sort_bounds,
-                                              sort_with_bounds)
+from spark_rapids_tpu.ops.sort_encode import (hash_sort_bounds,
+                                              sort_with_bounds,
+                                              wide_key_set)
 from spark_rapids_tpu.utils import checks as CK
 from spark_rapids_tpu.utils import metrics as M
-
-
-class _WidthOnly:
-    """Dtype/width stand-in for `estimate_packed_words` when a group
-    key is a computed expression (no backing column to inspect)."""
-    __slots__ = ("dtype", "narrow", "char_cap")
-
-    def __init__(self, dtype, narrow=None):
-        self.dtype, self.narrow, self.char_cap = dtype, narrow, 0
 
 
 class AggMode(enum.Enum):
@@ -150,7 +141,10 @@ class HashAggregateExec(UnaryExecBase):
     #: otherwise trace a sort chain whose XLA compile time and memory
     #: scale with total key WIDTH (TPC-DS q64's 15-key string grouper
     #: is ~100 words: minutes of compile, GBs of arena, per schema)
-    HASH_GROUP_MIN_WORDS = 4
+    #: alias of the shared routing threshold so both grouping
+    #: lanes (aggregate group-by, window partition-by) tune together
+    from spark_rapids_tpu.ops.sort_encode import \
+        HASH_GROUP_MIN_WORDS as HASH_GROUP_MIN_WORDS
 
     def _use_hash_grouping(self, batch: ColumnarBatch) -> bool:
         # the deopt retry must produce guaranteed-valid results (there
@@ -158,17 +152,11 @@ class HashAggregateExec(UnaryExecBase):
         # the lexicographic lane, like _compact_groups
         if getattr(self, "_hash_group_disabled", False) or CK.is_retrying():
             return False
-        pseudo = []
-        for e in self._bound_groups:
-            ordinal = getattr(e, "ordinal", None)
-            if ordinal is not None:
-                pseudo.append((batch.columns[ordinal], True, True))
-                continue
-            dt = e.data_type(self._child_schema)
-            if dt.is_string:
-                return True  # computed string key: always wide
-            pseudo.append((_WidthOnly(dt, None), True, True))
-        return estimate_packed_words(pseudo) > self.HASH_GROUP_MIN_WORDS
+        from spark_rapids_tpu import config as C
+        if not C.get_active_conf()[C.HASH_GROUPING_ENABLED]:
+            return False
+        return wide_key_set(self._bound_groups, batch, self._child_schema,
+                            self.HASH_GROUP_MIN_WORDS)
 
     def _disable_hash_grouping(self) -> None:
         # a 64-bit murmur3 collision between two distinct key tuples
@@ -270,11 +258,9 @@ class HashAggregateExec(UnaryExecBase):
     def _register_collision_check(self, collision, checks: tuple) -> tuple:
         """Deferred 64-bit-collision deopt for the hash-grouping lane
         (None = lexicographic lane, nothing to check)."""
-        if collision is None:
-            return checks
-        return checks + (CK.register(CK.BatchCheck(
-            collision, f"hashGroupby[exec {self.exec_id}]",
-            self._disable_hash_grouping)),)
+        return CK.register_deopt(collision,
+                                 f"hashGroupby[exec {self.exec_id}]",
+                                 self._disable_hash_grouping, checks)
 
     def _evaluate_kernel(self, batch: ColumnarBatch):
         """Final projection: intermediates -> results (no regrouping)."""

@@ -38,7 +38,8 @@ from spark_rapids_tpu.exec.base import (
 from spark_rapids_tpu.exec.sort import SortOrder
 from spark_rapids_tpu.exprs.base import Expression, output_name
 from spark_rapids_tpu.ops.sort_encode import (
-    sort_with_bounds)
+    hash_prefix_sort_bounds, sort_with_bounds, wide_key_set)
+from spark_rapids_tpu.utils import checks as CK
 from spark_rapids_tpu.utils import metrics as M
 
 UNBOUNDED = None
@@ -189,8 +190,26 @@ class WindowExec(UnaryExecBase):
                 fingerprint(self._bound_inputs), fingerprint(self.fns))
 
     # ------------------------------------------------------------------
+    def _use_hash_partitions(self, batch: ColumnarBatch) -> bool:
+        """Wide PARTITION BY key sets (string partitions explode into
+        one 9-bit sort key per char position) sort by two murmur3
+        words instead — partition order is unobservable in window
+        results, only the grouping and the ORDER BY within it matter.
+        Same retry/deopt contract as the aggregate's hash lane."""
+        if not self._bound_parts or CK.is_retrying() or \
+                getattr(self, "_hash_parts_disabled", False):
+            return False
+        from spark_rapids_tpu import config as C
+        if not C.get_active_conf()[C.HASH_GROUPING_ENABLED]:
+            return False
+        return wide_key_set(self._bound_parts, batch, self._child_schema)
+
+    def _disable_hash_partitions(self) -> None:
+        self._hash_parts_disabled = True
+
     def _kernel(self, batch: ColumnarBatch):
-        key = ("window", batch_signature(batch))
+        use_hash = self._use_hash_partitions(batch)
+        key = ("window", use_hash, batch_signature(batch))
 
         def build():
             cap = batch.capacity
@@ -201,12 +220,18 @@ class WindowExec(UnaryExecBase):
                 ctx = make_eval_context(columns, cap, num_rows)
                 parts = [e.eval(ctx) for e in self._bound_parts]
                 orders = [o.expr.eval(ctx) for o in self._bound_order]
-                keyspec = ([(p, True, True) for p in parts]
-                           + [(o, so.ascending, so.resolved_nulls_first)
-                              for o, so in zip(orders, self._bound_order)])
-                perm, sorted_mask, pbounds, obounds_all = \
-                    sort_with_bounds(keyspec, ctx.row_mask,
-                                     prefix=len(parts))
+                okeys = [(o, so.ascending, so.resolved_nulls_first)
+                         for o, so in zip(orders, self._bound_order)]
+                if use_hash:
+                    perm, sorted_mask, pbounds, obounds_all, collision = \
+                        hash_prefix_sort_bounds(parts, okeys,
+                                                ctx.row_mask)
+                else:
+                    keyspec = [(p, True, True) for p in parts] + okeys
+                    perm, sorted_mask, pbounds, obounds_all = \
+                        sort_with_bounds(keyspec, ctx.row_mask,
+                                         prefix=len(parts))
+                    collision = None
                 # partition segments (partition keys only)
                 if parts:
                     bounds = pbounds
@@ -283,7 +308,7 @@ class WindowExec(UnaryExecBase):
                 out = []
                 for r in results:
                     out.append(r.gather(inv, ctx.row_mask))
-                return list(columns) + out
+                return list(columns) + out, collision
 
             return kernel
 
@@ -405,9 +430,12 @@ class WindowExec(UnaryExecBase):
             batch = batch.dense()
             with self.metrics.timed(M.TOTAL_TIME):
                 kern = self._kernel(batch)
-                cols = kern(batch.columns, batch.num_rows_i32)
+                cols, coll = kern(batch.columns, batch.num_rows_i32)
+                checks = CK.register_deopt(
+                    coll, f"hashWindowParts[exec {self.exec_id}]",
+                    self._disable_hash_partitions, batch.checks)
                 out = ColumnarBatch(self._schema, list(cols),
-                                    batch._rows, batch.checks)
+                                    batch._rows, checks)
                 self.update_output_metrics(out)
             yield out
 

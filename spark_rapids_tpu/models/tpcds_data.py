@@ -217,8 +217,20 @@ BUY_POTENTIAL = [">10000", "5001-10000", "1001-5000", "501-1000",
                  "0-500", "Unknown"]
 
 
+def _q4(x):
+    """Quantize money values to quarters (exact dyadic f64).  TPC-DS
+    money columns are DECIMAL(7,2) in the reference, whose sums are
+    exact; modeled as f64, cent-quantized values accumulate
+    summation-order ulp drift, which silently splits float-sum ties in
+    rank windows (q67/q70) between the engine's partial/merge order and
+    the golden's sequential order.  Quarter-quantized values make every
+    sum EXACT in f64 at test scale, restoring decimal-like
+    order-independence."""
+    return np.round(np.asarray(x) * 4.0) / 4.0
+
+
 def _money(rng, lo, hi, n):
-    return np.round(rng.uniform(lo, hi, n), 2)
+    return _q4(rng.uniform(lo, hi, n))
 
 
 def _holiday_respike(rng, sold: np.ndarray, n_dates: int
@@ -291,9 +303,9 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
                          ).astype(np.int32),
         # prices sweep the range deterministically so every price band
         # contains hot items (q37/q40/q64 band filters)
-        "i_current_price": np.round(
+        "i_current_price": _q4(
             (np.arange(n_items) * 7.3) % 99 + 1.0 +
-            rng.uniform(0, 0.99, n_items), 2),
+            rng.uniform(0, 0.99, n_items)),
         "i_item_desc": np.array(
             [f"Item description {i % 251}" for i in range(n_items)],
             dtype=object),
@@ -464,7 +476,7 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
     ticket_cust = ((tickets * 7919) % n_cust).astype(np.int64)
     qty = rng.integers(1, 101, n).astype(np.int32)
     list_price = _money(rng, 1.0, 200.0, n)
-    sales_price = np.round(list_price * rng.uniform(0.2, 1.0, n), 2)
+    sales_price = _q4(list_price * rng.uniform(0.2, 1.0, n))
     store_sales = pd.DataFrame({
         "ss_sold_date_sk": _holiday_respike(
             rng, rng.integers(0, n_dates, n), n_dates
@@ -482,14 +494,14 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "ss_quantity": qty,
         "ss_list_price": list_price,
         "ss_sales_price": sales_price,
-        "ss_ext_sales_price": np.round(sales_price * qty, 2),
+        "ss_ext_sales_price": _q4(sales_price * qty),
         "ss_ext_discount_amt": _money(rng, 0.0, 100.0, n),
-        "ss_ext_list_price": np.round(list_price * qty, 2),
+        "ss_ext_list_price": _q4(list_price * qty),
         "ss_coupon_amt": np.where(rng.random(n) < 0.2,
                                   _money(rng, 0.0, 50.0, n), 0.0),
         "ss_net_profit": _money(rng, -500.0, 500.0, n),
         "ss_ext_wholesale_cost": _money(rng, 1.0, 100.0, n),
-        "ss_net_paid": np.round(sales_price * qty, 2),
+        "ss_net_paid": _q4(sales_price * qty),
         "ss_wholesale_cost": _money(rng, 1.0, 100.0, n),
     })
 
@@ -554,7 +566,7 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         cust = ((orders * 6271) % n_cust).astype(np.int64)
         q = rng.integers(1, 101, n_rows).astype(np.int32)
         lp = _money(rng, 1.0, 250.0, n_rows)
-        sp = np.round(lp * rng.uniform(0.2, 1.0, n_rows), 2)
+        sp = _q4(lp * rng.uniform(0.2, 1.0, n_rows))
         sold = _holiday_respike(
             rng, rng.integers(0, n_dates, n_rows), n_dates
         ).astype(np.int64)
@@ -586,12 +598,12 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "cs_quantity": c_qty,
         "cs_list_price": c_lp,
         "cs_sales_price": c_sp,
-        "cs_ext_sales_price": np.round(c_sp * c_qty, 2),
+        "cs_ext_sales_price": _q4(c_sp * c_qty),
         "cs_ext_discount_amt": _money(rng, 0.0, 100.0, nc),
-        "cs_ext_list_price": np.round(c_lp * c_qty, 2),
+        "cs_ext_list_price": _q4(c_lp * c_qty),
         "cs_ext_ship_cost": _money(rng, 0.0, 40.0, nc),
         "cs_net_profit": _money(rng, -500.0, 500.0, nc),
-        "cs_net_paid": np.round(c_sp * c_qty, 2),
+        "cs_net_paid": _q4(c_sp * c_qty),
         "cs_ship_addr_sk": rng.integers(0, n_addr, nc).astype(np.int64),
         "cs_bill_addr_sk": rng.integers(0, n_addr, nc).astype(np.int64),
         "cs_ship_customer_sk": cs_cust,
@@ -624,12 +636,12 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "ws_quantity": w_qty,
         "ws_list_price": w_lp,
         "ws_sales_price": w_sp,
-        "ws_ext_sales_price": np.round(w_sp * w_qty, 2),
+        "ws_ext_sales_price": _q4(w_sp * w_qty),
         "ws_ext_discount_amt": _money(rng, 0.0, 100.0, nw),
-        "ws_ext_list_price": np.round(w_lp * w_qty, 2),
+        "ws_ext_list_price": _q4(w_lp * w_qty),
         "ws_ext_ship_cost": _money(rng, 0.0, 40.0, nw),
         "ws_net_profit": _money(rng, -500.0, 500.0, nw),
-        "ws_net_paid": np.round(w_sp * w_qty, 2),
+        "ws_net_paid": _q4(w_sp * w_qty),
         "ws_wholesale_cost": _money(rng, 1.0, 100.0, nw),
         "ws_ship_addr_sk": rng.integers(0, n_addr, nw).astype(np.int64),
         "ws_bill_addr_sk": rng.integers(0, n_addr, nw).astype(np.int64),
@@ -653,8 +665,8 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
             store_sales["ss_ticket_number"].to_numpy()[ridx],
         "sr_store_sk": store_sales["ss_store_sk"].to_numpy()[ridx],
         "sr_return_quantity": rq,
-        "sr_return_amt": np.round(
-            store_sales["ss_sales_price"].to_numpy()[ridx] * rq, 2),
+        "sr_return_amt": _q4(
+            store_sales["ss_sales_price"].to_numpy()[ridx] * rq),
         "sr_net_loss": _money(rng, 0.0, 200.0, len(ridx)),
         "sr_reason_sk": rng.integers(0, 10, len(ridx)).astype(np.int64),
         "sr_cdemo_sk": store_sales["ss_cdemo_sk"].to_numpy()[ridx],
@@ -715,11 +727,11 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "cr_returning_addr_sk":
             catalog_sales["cs_bill_addr_sk"].to_numpy()[cidx],
         "cr_return_quantity": crq,
-        "cr_return_amount": np.round(c_sp[cidx] * crq, 2),
-        "cr_return_amt_inc_tax": np.round(
-            c_sp[cidx] * crq * 1.08, 2),
-        "cr_refunded_cash": np.round(
-            c_sp[cidx] * crq * rng.uniform(0.5, 1.0, len(cidx)), 2),
+        "cr_return_amount": _q4(c_sp[cidx] * crq),
+        "cr_return_amt_inc_tax": _q4(
+            c_sp[cidx] * crq * 1.08),
+        "cr_refunded_cash": _q4(
+            c_sp[cidx] * crq * rng.uniform(0.5, 1.0, len(cidx))),
         "cr_reversed_charge": _money(rng, 0.0, 30.0, len(cidx)),
         "cr_store_credit": _money(rng, 0.0, 30.0, len(cidx)),
         "cr_call_center_sk": rng.integers(0, 4,
@@ -755,10 +767,10 @@ def gen_tables(rng: np.random.Generator, scale: int = 10_000
         "wr_net_loss": _money(rng, 0.0, 200.0, len(widx)),
         "wr_web_page_sk":
             web_sales["ws_web_page_sk"].to_numpy()[widx],
-        "wr_refunded_cash": np.round(
-            w_sp[widx] * wrq * rng.uniform(0.5, 1.0, len(widx)), 2),
+        "wr_refunded_cash": _q4(
+            w_sp[widx] * wrq * rng.uniform(0.5, 1.0, len(widx))),
         "wr_return_quantity": wrq,
-        "wr_return_amt": np.round(w_sp[widx] * wrq, 2),
+        "wr_return_amt": _q4(w_sp[widx] * wrq),
     })
 
     # cluster ~30% of returns into three "returns spike" weeks (the

@@ -189,6 +189,22 @@ def _narrowed(w, wbits):
     return w
 
 
+def _neq_prev(sorted_words, cap: int) -> jnp.ndarray:
+    """True where any sorted word differs from its predecessor (the
+    word-equality boundary primitive shared by both bounds
+    derivations)."""
+    acc = jnp.zeros(cap, bool)
+    for s in sorted_words:
+        acc = acc | (s != jnp.roll(s, 1))
+    return acc
+
+
+def _gather_sorted_words(words, perm):
+    """Fallback when the sort didn't emit its sorted operands (LSD
+    chain path): gather each packed word through the permutation."""
+    return [jnp.take(_narrowed(w, b), perm) for w, b in words]
+
+
 def _sort_words(words: list, cap: int) -> jnp.ndarray:
     """Stable argsort by packed words, most significant first."""
     return _sort_words_full(words, cap)[0]
@@ -256,21 +272,14 @@ def sort_with_bounds(key_cols: list, row_mask: jnp.ndarray,
     # so the sorted mask is a plain prefix — no gather needed
     sorted_valid = jnp.arange(cap) < row_mask.sum()
 
-    def neq_over(sorted_ws):
-        acc = jnp.zeros(cap, bool)
-        for s in sorted_ws:
-            acc = acc | (s != jnp.roll(s, 1))
-        return acc
-
     if swords is None:
-        swords = [jnp.take(_narrowed(w, b), perm)
-                  for w, b in pwords + rwords]
+        swords = _gather_sorted_words(pwords + rwords, perm)
     first = jnp.arange(cap) == 0
-    pneq = neq_over(swords[:len(pwords)])
+    pneq = _neq_prev(swords[:len(pwords)], cap)
     prefix_bounds = sorted_valid & (pneq | first)
     if rwords:
         all_bounds = sorted_valid & \
-            (pneq | neq_over(swords[len(pwords):]) | first)
+            (pneq | _neq_prev(swords[len(pwords):], cap) | first)
     else:
         all_bounds = prefix_bounds
     return perm, sorted_valid, prefix_bounds, all_bounds
@@ -371,20 +380,79 @@ def hash_sort_bounds(key_cols: list, row_mask: jnp.ndarray):
 
     Returns (perm, sorted_valid, bounds, collision_flag)."""
     cols = [c for c, _asc, _nf in key_cols]
+    perm, sorted_valid, bounds, _all, collision = \
+        hash_prefix_sort_bounds(cols, [], row_mask)
+    return perm, sorted_valid, bounds, collision
+
+
+class _WidthOnly:
+    """Dtype/width stand-in for `estimate_packed_words` when a key is
+    a computed expression (no backing column to inspect)."""
+    __slots__ = ("dtype", "narrow", "char_cap")
+
+    def __init__(self, dtype, narrow=None):
+        self.dtype, self.narrow, self.char_cap = dtype, narrow, 0
+
+
+#: past this many estimated packed sort words a GROUPING key set
+#: routes through the 2-word murmur3 hash lane (see hash_sort_bounds)
+HASH_GROUP_MIN_WORDS = 4
+
+
+def wide_key_set(bound_exprs, batch, schema,
+                 threshold: int = HASH_GROUP_MIN_WORDS) -> bool:
+    """Shared lane routing for grouping sorts (aggregate group-by,
+    window partition-by): True when the lexicographic encode of these
+    bound key expressions would exceed `threshold` packed words."""
+    pseudo = []
+    for e in bound_exprs:
+        ordinal = getattr(e, "ordinal", None)
+        if ordinal is not None:
+            pseudo.append((batch.columns[ordinal], True, True))
+            continue
+        dt = e.data_type(schema)
+        if dt.is_string:
+            return True  # computed string key: always wide
+        pseudo.append((_WidthOnly(dt), True, True))
+    return estimate_packed_words(pseudo) > threshold
+
+
+def hash_prefix_sort_bounds(part_cols: list, order_keys: list,
+                            row_mask: jnp.ndarray):
+    """`sort_with_bounds` variant for window-style keys: the PARTITION
+    prefix needs grouping only (partitions' relative order is
+    unobservable), so it sorts as two murmur3 words regardless of key
+    width, while the ORDER keys keep the exact lexicographic encode
+    (their order IS the window semantics).  Partition boundaries come
+    from the actual adjacent key values; a key boundary without a hash
+    change is a genuine 64-bit collision, returned as a deferred deopt
+    flag (same contract as hash_sort_bounds).
+
+    Returns (perm, sorted_valid, prefix_bounds, all_bounds,
+    collision_flag)."""
     cap = row_mask.shape[0]
-    h1 = _grouping_hash(cols, 42)
-    h2 = _grouping_hash(cols, 0x3C6EF372)
-    # invalid rows sort last: flag above the first hash word
+    h1 = _grouping_hash(part_cols, 42)
+    h2 = _grouping_hash(part_cols, 0x3C6EF372)
     w1 = ((~row_mask).astype(jnp.uint64) << jnp.uint64(32)) \
         | h1.astype(jnp.uint64)
-    perm = jnp.arange(cap, dtype=jnp.int32)
-    sw1, sw2, perm = lax.sort((w1, h2, perm), num_keys=2, is_stable=True)
+    rest: list = []
+    for col, asc, nf in order_keys:
+        rest.extend(encode_key_bits(col, asc, nf))
+    rwords = _pack_words(rest)
+    perm, swords = _sort_words_full([(w1, 33), (h2, 32)] + rwords, cap)
     sorted_valid = jnp.arange(cap) < row_mask.sum()
-    bounds = segment_boundaries(cols, perm, row_mask)
     first = jnp.arange(cap) == 0
-    hash_change = (sw1 != jnp.roll(sw1, 1)) | (sw2 != jnp.roll(sw2, 1))
-    collision = jnp.any(bounds & ~hash_change & ~first)
-    return perm, sorted_valid, bounds, collision
+    prefix_bounds = segment_boundaries(part_cols, perm, row_mask)
+    if swords is None:
+        swords = _gather_sorted_words([(w1, 33), (h2, 32)] + rwords, perm)
+    hash_change = _neq_prev(swords[:2], cap)
+    collision = jnp.any(prefix_bounds & ~hash_change & ~first)
+    if rwords:
+        all_bounds = sorted_valid & \
+            (prefix_bounds | _neq_prev(swords[2:], cap) | first)
+    else:
+        all_bounds = prefix_bounds
+    return perm, sorted_valid, prefix_bounds, all_bounds, collision
 
 
 def multi_key_argsort(key_cols: list[tuple[ColumnVector, bool, bool]],

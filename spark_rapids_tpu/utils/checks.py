@@ -80,6 +80,15 @@ def is_retrying() -> bool:
     return getattr(_RETRY, "flag", False)
 
 
+def register_deopt(flag, origin: str, recover, checks: tuple) -> tuple:
+    """Append a deferred deopt check to a batch's check tuple (shared
+    by the aggregate and window hash-grouping lanes).  `flag` None
+    means the fast lane was not taken — nothing to check."""
+    if flag is None:
+        return checks
+    return checks + (register(BatchCheck(flag, origin, recover)),)
+
+
 def register(check: BatchCheck) -> BatchCheck:
     with _LOCK:
         _PENDING.append(check)

@@ -334,3 +334,36 @@ def test_float_range_frame_falls_back():
     assert isinstance(acc, CpuNode)
     out = _collect(acc).sort_values("o", ignore_index=True)
     assert out["rs"].tolist() == [10.0, 30.0, 70.0]
+
+
+def test_window_wide_string_partitions_hash_lane(rng):
+    """PARTITION BY five keys incl. strings routes the partition
+    prefix through the murmur3 hash words (order within partitions
+    must still follow the ORDER BY exactly)."""
+    n = 300
+    df = pd.DataFrame({
+        "city": rng.choice(["springfield", "shelbyville", "ogdenville"], n),
+        "street": rng.choice(["elm st", "oak ave"], n),
+        "zip": rng.choice(["12345", "67890"], n),
+        "yr": rng.integers(1999, 2002, n).astype(np.int64),
+        "sku": rng.integers(0, 4, n).astype(np.int64),
+        "v": rng.uniform(0, 10, n),
+        "ts": rng.permutation(np.arange(n)).astype(np.int64),
+    })
+    keys = ["city", "street", "zip", "yr", "sku"]
+    spec = WindowSpec([col(k) for k in keys], [asc(col("ts"))],
+                      WindowFrame(is_rows=True, lower=None, upper=0))
+    plan = WindowExec([RowNumber().alias("rn"), WinSum(col("v")).alias("s")],
+                      spec, LocalBatchSource.from_pandas(df))
+    assert plan._use_hash_partitions(ColumnarBatch.from_pandas(df))
+    got = plan.to_pandas()
+    g = df.sort_values("ts", kind="stable").groupby(keys, sort=False)
+    exp_rn = g.cumcount() + 1
+    exp_sum = g["v"].cumsum()
+    np.testing.assert_array_equal(
+        got["rn"].astype(int).to_numpy(),
+        exp_rn.reindex(df.index).to_numpy())
+    np.testing.assert_allclose(
+        got["s"].astype(float).to_numpy(),
+        exp_sum.reindex(df.index).to_numpy(), rtol=1e-9)
+    assert not getattr(plan, "_hash_parts_disabled", False)
