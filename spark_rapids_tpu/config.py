@@ -234,9 +234,12 @@ DICT_GROUPBY_ENABLED = conf(
     "variableFloatAgg.enabled: sums accumulate in f32, a "
     "variableFloatAgg-class tolerance. Count-only plans are exact.")
 DICT_GROUPBY_MAX_GROUPS = conf(
-    "spark.rapids.tpu.dictGroupby.maxGroups", 4096,
-    "Max runtime key range for the dictionary group-by fast path (the "
-    "one-hot table must fit VMEM).")
+    "spark.rapids.tpu.dictGroupby.maxGroups", 32768,
+    "Max runtime key range for the dictionary group-by fast path. The "
+    "one-hot kernel tiles its VMEM block by group count, so cost grows "
+    "mildly with range (measured: 4K groups 100ms, 16K 118ms, 64K "
+    "332ms at 2M rows); 32K covers e.g. TPCx-BB q27's ~26K items "
+    "while staying ~2x the 4K floor.")
 HASH_GROUPING_ENABLED = conf(
     "spark.rapids.tpu.hashGrouping.enabled", True,
     "Wide grouping key sets (aggregate GROUP BY, window PARTITION BY) "

@@ -290,7 +290,9 @@ class HashAggregateExec(UnaryExecBase):
     def _dict_plan(self):
         """Static qualification for the sort-free dictionary path:
         1..3 integral keys (multi-key folds into one composite slot id),
-        Sum/Count/Average over float inputs.
+        Sum/Count/Average over float inputs (variableFloatAgg-gated f32
+        accumulation) or INTEGRAL inputs (exact-or-deopt: an in-kernel
+        f32-exactness certificate, no conf gate).
         Returns (plan, measures) or None."""
         if self.mode == AggMode.FINAL or \
                 not 1 <= len(self._bound_groups) <= 3:
@@ -309,12 +311,26 @@ class HashAggregateExec(UnaryExecBase):
                     plan.append(("count_star", None))
             elif name in ("Sum", "Average"):
                 dt = bins[0].data_type(self._child_schema)
-                if not dt.is_floating:
+                if dt.is_floating:
+                    self._dict_float = True
+                    plan.append((name.lower(), len(measures)))
+                    measures.append(("val", bins[0]))
+                    measures.append(("flag", bins[0]))
+                elif dt.is_integral:
+                    # exact-or-deopt: f32 accumulation of integers is
+                    # EXACT while every intermediate fits 2^24, which
+                    # the kernel certifies per group by accumulating
+                    # sum(|v|) alongside (inexactness cannot hide:
+                    # f32 adds of nonnegative ints round monotonically,
+                    # so a true sum >= 2^23 reads >= ~2^23).  No
+                    # variableFloatAgg gate — results are bit-exact or
+                    # the deferred check deopts to the sort lane.
+                    plan.append((name.lower() + "_int", len(measures)))
+                    measures.append(("val", bins[0]))
+                    measures.append(("flag", bins[0]))
+                    measures.append(("absval", bins[0]))
+                else:
                     return None
-                self._dict_float = True
-                plan.append((name.lower(), len(measures)))
-                measures.append(("val", bins[0]))
-                measures.append(("flag", bins[0]))
             else:
                 return None
         return plan, measures
@@ -433,11 +449,18 @@ class HashAggregateExec(UnaryExecBase):
         for kind, e in measures:
             v = e.eval(ctx)
             good = v.validity & rows
-            if kind == "val":
+            if kind in ("val", "absval"):
                 v32 = (v.narrow if v.narrow is not None
                        else v.data.astype(jnp.float32))
+                v32 = jnp.asarray(v32, jnp.float32)
+                if kind == "absval":
+                    # certificate input only — overflow singletons read
+                    # the paired "val" measure's raw entry, so this
+                    # raw slot is a placeholder keeping mi alignment
+                    v32 = jnp.abs(v32)
                 vals.append(jnp.where(good, v32, jnp.float32(0)))
-                raw.append((v.data, good))
+                raw.append((None, good) if kind == "absval"
+                           else (v.data, good))
             else:
                 vals.append(good.astype(jnp.float32))
                 raw.append((good, good))
@@ -468,6 +491,7 @@ class HashAggregateExec(UnaryExecBase):
         AFTER the tiny overflow gather so they read as 0, not garbage
         (downstream merges may touch masked data)."""
         out = []
+        inexact = jnp.bool_(False)
         for kind, mi in plan:
             if kind == "count_star":
                 out.append(ColumnVector(T.INT64, cnt_mixed, valid_out))
@@ -485,6 +509,28 @@ class HashAggregateExec(UnaryExecBase):
             val_o, good_o = raw[mi]
             some = jnp.where(from_win, jnp.take(f_w > 0, wi),
                              jnp.take(good_o, oi)) & valid_out
+            if kind in ("sum_int", "average_int"):
+                # exactness certificate: every f32 add was exact iff
+                # the group's sum(|v|) stayed under 2^24 (threshold
+                # 2^23 leaves margin for the certificate's own
+                # rounding); past it the deferred check deopts
+                inexact = inexact | jnp.any(
+                    sums_at(mi + 2) >= jnp.float32(1 << 23))
+                win_s = jnp.round(s_w).astype(jnp.int64)
+                ovf_s = jnp.take(val_o, oi).astype(jnp.int64)
+                si = jnp.where(some,
+                               jnp.where(from_win, jnp.take(win_s, wi),
+                                         ovf_s), jnp.int64(0))
+                if kind == "sum_int":
+                    out.append(ColumnVector(T.INT64, si, some))
+                else:  # average over ints: (f64 sum, i64 count)
+                    out.append(ColumnVector(
+                        T.FLOAT64, si.astype(jnp.float64), some))
+                    cnt_col = jnp.where(
+                        from_win, jnp.take(f_w, wi),
+                        jnp.take(good_o, oi).astype(jnp.int64))
+                    out.append(ColumnVector(T.INT64, cnt_col, valid_out))
+                continue
             s = jnp.where(
                 some,
                 jnp.where(from_win, jnp.take(s_w, wi),
@@ -496,7 +542,7 @@ class HashAggregateExec(UnaryExecBase):
                     from_win, jnp.take(f_w, wi),
                     jnp.take(good_o, oi).astype(jnp.int64))
                 out.append(ColumnVector(T.INT64, cnt_col, valid_out))
-        return out
+        return out, inexact
 
     def _build_dict_fused(self, cap: int, g_pad: int):
         """Sync-free fused dict kernel: ONE dispatch computes the key
@@ -587,10 +633,11 @@ class HashAggregateExec(UnaryExecBase):
             cnt_mixed = jnp.where(from_win,
                                   jnp.take(cnt_w.astype(jnp.int64), wi),
                                   jnp.int64(1))
-            out.extend(HashAggregateExec._emit_dict_partials(
+            cols_m, inexact = HashAggregateExec._emit_dict_partials(
                 plan, raw, lambda mi: jnp.take(sums_o[:, mi], nz),
-                cnt_mixed, wi, oi, from_win, valid_out))
-            return out, n_out, excess
+                cnt_mixed, wi, oi, from_win, valid_out)
+            out.extend(cols_m)
+            return out, n_out, excess | inexact
         return fused
 
     def _build_dict_probe(self, cap: int):
@@ -711,10 +758,11 @@ class HashAggregateExec(UnaryExecBase):
             cnt_mixed = jnp.where(from_win,
                                   jnp.take(cnt_w.astype(jnp.int64), wi),
                                   jnp.int64(1))
-            out.extend(HashAggregateExec._emit_dict_partials(
+            cols_m, inexact = HashAggregateExec._emit_dict_partials(
                 plan, raw, lambda mi: jnp.take(sums[:G, mi], nz),
-                cnt_mixed, wi, oi, from_win, valid_out))
-            return out, n_out, excess
+                cnt_mixed, wi, oi, from_win, valid_out)
+            out.extend(cols_m)
+            return out, n_out, excess | inexact
         return fused
 
     # -- execution ----------------------------------------------------------

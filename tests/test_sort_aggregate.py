@@ -701,3 +701,46 @@ def test_hash_grouping_narrow_keys_stay_lexicographic(rng):
         [col("sku")], [Sum(col("qty")).alias("s")],
         LocalBatchSource.from_pandas(df))
     assert not plan._use_hash_grouping(ColumnarBatch.from_pandas(df))
+
+
+def test_dict_groupby_integral_sum_exact(rng):
+    """Sum over INT columns rides the dict lane with the f32-exactness
+    certificate (no variableFloatAgg needed) and matches pandas
+    bit-exactly."""
+    from spark_rapids_tpu import config as C
+    n = 1 << 14
+    df = pd.DataFrame({
+        "k": rng.integers(0, 200, n).astype(np.int64),
+        "v": rng.integers(-100, 100, n).astype(np.int64),
+    })
+    with C.session(C.RapidsConf({})):
+        plan = HashAggregateExec(
+            [col("k")], [Sum(col("v")).alias("s"),
+                         Count(col("v")).alias("c")],
+            LocalBatchSource.from_pandas(df))
+        assert plan._dict_qual is not None, "int Sum must qualify"
+        out = plan.to_pandas().sort_values("k", ignore_index=True)
+    exp = (df.groupby("k").agg(s=("v", "sum"), c=("v", "size"))
+           .reset_index())
+    np.testing.assert_array_equal(out["s"].astype(np.int64), exp["s"])
+    np.testing.assert_array_equal(out["c"].astype(np.int64), exp["c"])
+
+
+def test_dict_groupby_integral_sum_overflow_deopts(rng):
+    """Group sums past the f32-exact range must deopt to the sort lane
+    and still return exact results."""
+    from spark_rapids_tpu import config as C
+    n = 1 << 13
+    df = pd.DataFrame({
+        "k": rng.integers(0, 4, n).astype(np.int64),
+        "v": rng.integers(1 << 22, 1 << 26, n).astype(np.int64),
+    })
+    with C.session(C.RapidsConf({})):
+        plan = HashAggregateExec(
+            [col("k")], [Sum(col("v")).alias("s")],
+            LocalBatchSource.from_pandas(df))
+        out = plan.to_pandas().sort_values("k", ignore_index=True)
+        # the inexactness certificate must have fired
+        assert plan._dict_range_misses >= 1 << 20, "expected deopt"
+    exp = df.groupby("k").agg(s=("v", "sum")).reset_index()
+    np.testing.assert_array_equal(out["s"].astype(np.int64), exp["s"])
