@@ -97,26 +97,15 @@ def _split_kernel_for(cache: KernelCache, batch: ColumnarBatch,
 
 def _gather_reordered(columns, order, valid, packed_bits=None):
     """Row reorder with the fewest random-access streams (each costs
-    ~70ns/row on this chip, dwarfing bandwidth): validities of ALL
-    numeric columns pack into one i32 bitmask gathered once, and value
-    streams go through gather_narrowest (i32-shadow-only for in-range
-    int64).  Strings keep the general ColumnVector.gather (char
-    tensors need their own streams anyway).  `packed_bits` lets a
-    caller that gathers the same columns repeatedly (the partition cut
-    kernel) pack the validity mask once."""
-    from spark_rapids_tpu.columnar.vector import (gather_narrowest,
-                                                  pack_validity_bits)
-    bits, packed = (pack_validity_bits(columns) if packed_bits is None
-                    else packed_bits)
-    vm = None if packed is None else jnp.take(packed, order, mode="clip")
-    out = []
-    for ci, c in enumerate(columns):
-        if ci not in bits:
-            out.append(c.gather(order, valid))
-            continue
-        v = valid & (((vm >> bits[ci]) & 1) != 0)
-        out.append(gather_narrowest(c, order, v))
-    return out
+    ~70ns/row on this chip, dwarfing bandwidth): all 4-byte value
+    streams AND the packed validity word ride ONE stacked gather, f64
+    streams another (`gather_columns_grouped`).  Strings keep the
+    general ColumnVector.gather (char tensors need their own streams
+    anyway).  `packed_bits` lets a caller that gathers the same
+    columns repeatedly (the partition cut kernel) pack the validity
+    mask once."""
+    from spark_rapids_tpu.columnar.vector import gather_columns_grouped
+    return gather_columns_grouped(columns, order, valid, packed_bits)
 
 
 #: lazy slicing keeps slices at the INPUT batch's capacity (the count is
