@@ -286,16 +286,42 @@ def _grouped_sum_kernel(nrows_ref, keys_ref, *val_and_out,
 
 
 @functools.partial(jax.jit, static_argnames=("n_groups", "capacity",
-                                             "interpret"))
+                                             "interpret",
+                                             "interpret_kernel"))
 def grouped_sum_pallas(keys, vals, num_rows, *, n_groups: int,
-                       capacity: int, interpret: bool = False):
+                       capacity: int, interpret: bool = False,
+                       interpret_kernel: bool = False):
     """sums/counts per dictionary key id: keys int32 in [0, n_groups),
     vals a tuple of f32 arrays.  Returns ([n_groups, n_measures] f64
     sums, [n_groups] int32 counts).  Rows with out-of-range keys are
-    COUNTED INVALID (masked) — callers guarantee the dictionary."""
+    COUNTED INVALID (masked) — callers guarantee the dictionary.
+
+    `interpret=True` (any non-TPU backend) computes the same masked
+    f32-accumulated result with plain segment sums instead of running
+    the Mosaic kernel under the Pallas INTERPRETER — interpretation
+    executes the block loop in Python and made the virtual-CPU test
+    suite minutes slower per workload query once integral sums
+    started qualifying for this lane.  `interpret_kernel=True` still
+    runs the Mosaic kernel under the interpreter;
+    `tests/test_pallas.py` compares it against this fallback so the
+    two lanes cannot silently diverge."""
     import math
-    n_measures = len(vals)
     assert capacity % _LANES == 0
+    if interpret and not interpret_kernel:
+        rows_ok = jnp.arange(capacity) < jnp.asarray(num_rows, jnp.int32)
+        k = jnp.where(rows_ok, keys, n_groups)
+        in_range = (k >= 0) & (k < n_groups)
+        seg = jnp.where(in_range, k, n_groups)
+        counts = jnp.bincount(seg, length=n_groups + 1)[:n_groups] \
+            .astype(jnp.int32)
+        sums = jnp.stack(
+            [jax.ops.segment_sum(
+                jnp.where(in_range, v.astype(jnp.float32), 0), seg,
+                num_segments=n_groups + 1)[:n_groups]
+             for v in vals], axis=1) if vals else \
+            jnp.zeros((n_groups, 0), jnp.float32)
+        return sums.astype(jnp.float64), counts
+    n_measures = len(vals)
     g_budget_rows = (48 * 1024 * 1024 // (4 * max(n_groups, 1))
                      ) // _LANES * _LANES
     block_rows = max(_LANES, min(_GROUP_BLOCK_ROWS, capacity,
@@ -327,10 +353,11 @@ def grouped_sum_pallas(keys, vals, num_rows, *, n_groups: int,
             out_shape=[
                 jax.ShapeDtypeStruct((g_pad, m_pad), jnp.float32),
                 jax.ShapeDtypeStruct((g_pad, _LANES), jnp.int32)],
-            compiler_params=None if interpret else pltpu.CompilerParams(
+            compiler_params=None if (interpret or interpret_kernel)
+            else pltpu.CompilerParams(
                 dimension_semantics=("arbitrary",),
                 vmem_limit_bytes=96 * 1024 * 1024),
-            interpret=interpret,
+            interpret=interpret or interpret_kernel,
         )(nrows, *ins)
     sums = table[:n_groups, :n_measures].astype(jnp.float64)
     counts = cnt_tab[:n_groups, 0]

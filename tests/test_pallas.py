@@ -133,7 +133,8 @@ def test_grouped_sum_dictionary_keys(rng):
     v = rng.uniform(0, 100, N).astype(np.float32)
     w = rng.uniform(0, 10, N).astype(np.float32)
     sums, counts = grouped_sum_pallas(
-        keys, (v, w), N - 5, n_groups=G, capacity=N, interpret=True)
+        keys, (v, w), N - 5, n_groups=G, capacity=N,
+        interpret_kernel=True)
     sums, counts = np.asarray(sums), np.asarray(counts)
     df = pd.DataFrame({"k": keys[:N - 5], "v": v[:N - 5].astype(float),
                        "w": w[:N - 5].astype(float)})
@@ -145,3 +146,23 @@ def test_grouped_sum_dictionary_keys(rng):
                                rtol=2e-3, atol=1e-6)
     np.testing.assert_allclose(sums[:, 1], exp["sw"].to_numpy(),
                                rtol=2e-3, atol=1e-6)
+
+
+def test_grouped_sum_kernel_matches_segment_sum_fallback(rng):
+    """The interpreted Mosaic kernel and the off-TPU segment-sum
+    fallback must agree bit-for-bit on counts and to f32-accumulation
+    tolerance on sums (they accumulate in different orders)."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.pallas_kernels import grouped_sum_pallas
+    N, G = 1 << 11, 37
+    keys = jnp.asarray(
+        rng.integers(-2, G + 3, N).astype(np.int32))  # incl. out-of-range
+    v = jnp.asarray(rng.random(N).astype(np.float32))
+    w = jnp.asarray(rng.integers(0, 50, N).astype(np.float32))
+    nrows = N - 17
+    sk, ck = grouped_sum_pallas(keys, (v, w), nrows, n_groups=G,
+                                capacity=N, interpret_kernel=True)
+    sf, cf = grouped_sum_pallas(keys, (v, w), nrows, n_groups=G,
+                                capacity=N, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ck), np.asarray(cf))
+    np.testing.assert_allclose(np.asarray(sk), np.asarray(sf), rtol=1e-5)
