@@ -27,7 +27,7 @@ def test_dryrun_multichip_self_provisions_fresh_process():
     r = _run("import __graft_entry__ as g; g.dryrun_multichip(8)",
              strip_env=("JAX_PLATFORMS", "XLA_FLAGS", "JAX_ENABLE_X64"))
     assert r.returncode == 0, r.stderr[-2000:]
-    assert "8-device mesh, groupby-sum OK" in r.stdout
+    assert "8-device mesh, groupby-sums exact" in r.stdout
 
 
 @pytest.mark.slowish
@@ -41,7 +41,7 @@ def test_dryrun_multichip_after_backend_init():
         "import __graft_entry__ as g; g.dryrun_multichip(8)\n",
         strip_env=("JAX_PLATFORMS", "XLA_FLAGS", "JAX_ENABLE_X64"))
     assert r.returncode == 0, r.stderr[-2000:]
-    assert "8-device mesh, groupby-sum OK" in r.stdout
+    assert "8-device mesh, groupby-sums exact" in r.stdout
 
 
 @pytest.mark.slowish
@@ -63,7 +63,7 @@ def test_dryrun_multichip_host_count_set_but_default_backend_not_cpu():
          "import __graft_entry__ as g; g.dryrun_multichip(8)\n"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
-    assert "8-device mesh, groupby-sum OK" in r.stdout
+    assert "8-device mesh, groupby-sums exact" in r.stdout
     # when an accelerator plugin is present (default backend != cpu),
     # the hermetic-subprocess route must have been taken; on cpu-only
     # machines the in-process branch is correct and the marker absent.
