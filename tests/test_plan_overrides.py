@@ -454,3 +454,103 @@ def test_cached_columnar_uploads_without_row_pivot():
     names = _tpu_names(tpu)
     assert "HostColumnarToDeviceExec" in names
     assert "RowToColumnarExec" not in names
+
+
+# -- reused-CTE subtree execute-once (ReusedExchangeExec role) --------------
+
+def test_shared_subplan_converts_once_and_executes_once():
+    """A CpuNode referenced by two parents must convert to ONE exec
+    wrapped in CommonSubplanExec, and its subtree must run once per
+    collect (q64's cross_sales pattern)."""
+    from spark_rapids_tpu.exec.base import CommonSubplanExec
+    from spark_rapids_tpu.plan.nodes import (CpuAggregate, CpuFilter,
+                                             CpuHashJoin, CpuProject,
+                                             CpuSource)
+    from spark_rapids_tpu.plan.overrides import accelerate, collect
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 20, 400).astype(np.int64),
+        "v": rng.random(400),
+    })
+    src = CpuSource.from_pandas(df, num_partitions=1)
+    shared = CpuAggregate([col("k")], [Sum(col("v")).alias("s")], src)
+    left = CpuFilter(col("s") > lit(5.0), shared)
+    right = CpuProject([col("k").alias("k2"), col("s").alias("s2")],
+                       shared)
+    plan = CpuHashJoin(JoinType.INNER, [col("k")], [col("k2")],
+                       left, right)
+    conf = C.RapidsConf(
+        {"spark.rapids.sql.variableFloatAgg.enabled": True})
+    acc = accelerate(plan, conf)
+    wrappers = []
+
+    def walk(e, seen):
+        if id(e) in seen:
+            return
+        seen.add(id(e))
+        if isinstance(e, CommonSubplanExec):
+            wrappers.append(e)
+        for c in e._children:
+            walk(c, seen)
+    walk(acc, set())
+    assert len(wrappers) == 1, "shared aggregate must wrap exactly once"
+    w = wrappers[0]
+    runs = [0]
+    orig = type(w.child).execute_partitions
+    inner = w.child
+
+    def counting(self):
+        if self is inner:
+            runs[0] += 1
+        return orig(self)
+    type(w.child).execute_partitions = counting
+    try:
+        got = collect(acc, conf)
+    finally:
+        type(w.child).execute_partitions = orig
+    assert runs[0] == 1, f"shared subtree executed {runs[0]} times"
+    exp = df.groupby("k").agg(s=("v", "sum")).reset_index()
+    exp = exp[exp["s"] > 5.0]
+    assert len(got) == len(exp)
+    # a SECOND collect must re-execute (epoch moved on), results equal
+    runs[0] = 0
+    type(w.child).execute_partitions = counting
+    try:
+        got2 = collect(acc, conf)
+    finally:
+        type(w.child).execute_partitions = orig
+    assert runs[0] == 1
+    assert len(got2) == len(exp)
+
+
+def test_shared_subplan_under_union_reprojects_positionally():
+    """A shared subtree pruned to the UNION of its parents' columns
+    must be projected back down for a CpuUnion parent, whose children
+    align positionally."""
+    from spark_rapids_tpu.plan.nodes import (CpuAggregate, CpuProject,
+                                             CpuSource, CpuUnion)
+    from spark_rapids_tpu.plan.overrides import accelerate, collect
+    rng = np.random.default_rng(1)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 10, 200).astype(np.int64),
+        "v": rng.random(200),
+        "w": rng.random(200),
+    })
+    src = CpuSource.from_pandas(df, num_partitions=1)
+    shared = CpuAggregate([col("k")], [Sum(col("v")).alias("s"),
+                                       Sum(col("w")).alias("t")], src)
+    # union arm needs only (k, s); the other parent needs (k, s, t)
+    arm1 = CpuProject([col("k"), col("s")], shared)
+    arm2 = CpuProject([col("k"), col("t").alias("s")], shared)
+    u = CpuUnion(arm1, arm2)
+    conf = C.RapidsConf(
+        {"spark.rapids.sql.variableFloatAgg.enabled": True})
+    got = collect(accelerate(u, conf), conf)
+    g = df.groupby("k").agg(s=("v", "sum"), t=("w", "sum")).reset_index()
+    exp = pd.concat([g[["k", "s"]],
+                     g[["k", "t"]].rename(columns={"t": "s"})],
+                    ignore_index=True)
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(
+        np.sort(got["s"].astype(float).to_numpy()),
+        np.sort(exp["s"].to_numpy()), rtol=1e-5)

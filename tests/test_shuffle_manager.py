@@ -549,3 +549,37 @@ def test_two_executor_shuffle_tcp_compressed(codec):
     vals = sorted(v for b in got
                   for v in b.column("k").to_pylist(b.num_rows))
     assert vals == list(range(64))
+
+
+def test_exchange_reduce_side_consolidation(rng):
+    """Many small map-side batches must come out of the exchange as few
+    consolidated, TIGHT batches (the reduce-side GpuCoalesceBatches
+    role) — without it a deep exchange chain multiplies live batch
+    count per hop (the TPC-DS q64 blowup)."""
+    import pandas as pd
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exec.basic import LocalBatchSource
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+    dfs = [pd.DataFrame({
+        "k": rng.integers(0, 1000, 500).astype(np.int64),
+        "v": rng.random(500)}) for _ in range(40)]
+    src = LocalBatchSource([[ColumnarBatch.from_pandas(d) for d in dfs]])
+    with C.session(C.RapidsConf({})):
+        ex = ShuffleExchangeExec(HashPartitioning([col("k")], 2), src)
+        parts = [list(it) for it in ex.execute_partitions()]
+    total = sum(b.num_rows for p in parts for b in p)
+    assert total == 40 * 500
+    for p in parts:
+        # 40 input batches -> a handful of merged outputs, each tight
+        assert len(p) <= 4, f"{len(p)} batches survived consolidation"
+        for b in p:
+            assert b.capacity <= ShuffleExchangeExec.MERGE_TARGET_CAP * 2
+    # row content parity
+    import numpy as np_
+    allk = np.sort(np.concatenate(
+        [np.asarray(b.columns[0].data)[:b.num_rows] for p in parts
+         for b in p]))
+    expk = np.sort(np.concatenate([d["k"].to_numpy() for d in dfs]))
+    np.testing.assert_array_equal(allk, expk)
