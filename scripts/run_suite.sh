@@ -9,7 +9,13 @@ cd "$(dirname "$0")/.."
 TIER="${1:-fast}"
 PYTEST=(python -m pytest -q -p no:randomly)
 
+run_gate() {
+  echo "== multichip gate (driver-shape invocation -> MULTICHIP_LOCAL.json) =="
+  python scripts/multichip_check.py 8
+}
+
 run_fast() {
+  run_gate
   echo "== fast tier (unit + integration, virtual 8-device CPU mesh) =="
   "${PYTEST[@]}" tests/ -m "not slow" --ignore=tests/test_workloads.py
   echo "== workload parity (TPC-H / TPC-DS / TPCx-BB / Mortgage) =="
@@ -36,10 +42,11 @@ run_bench() {
 }
 
 case "$TIER" in
+  gate)  run_gate ;;
   fast)  run_fast ;;
   slow)  run_slow ;;
   shims) run_shims ;;
   bench) run_bench ;;
   all)   run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [fast|slow|shims|bench|all]" >&2; exit 2 ;;
+  *) echo "usage: $0 [gate|fast|slow|shims|bench|all]" >&2; exit 2 ;;
 esac
