@@ -42,6 +42,25 @@ def bucket_char_cap(n: int) -> int:
     return bucket_capacity(max(n, 1), MIN_CHAR_CAP)
 
 
+def _f32_shadow(x_f64: np.ndarray) -> np.ndarray:
+    """FLOAT64 -> f32 narrow shadow with EXPLICIT overflow semantics
+    (VERDICT r4: the bare astype overflowed finite values to ±inf with
+    a silent RuntimeWarning — exactly where a parity bug would hide).
+    Invariants consumers rely on:
+      - monotone: x <= y  =>  shadow(x) <= shadow(y)  (top-k pruning)
+      - finiteness preserved: finite f64 -> finite f32 (clamped to
+        ±f32max past the f32 range), ±inf -> ±inf, NaN -> NaN
+      - sign preserved (incl. -0.0)."""
+    with np.errstate(over="ignore"):
+        n32 = x_f64.astype(np.float32)
+    over = np.isinf(n32) & np.isfinite(x_f64)
+    if over.any():
+        fmax = np.finfo(np.float32).max
+        n32 = np.where(over, np.copysign(fmax, x_f64).astype(np.float32),
+                       n32)
+    return n32
+
+
 def _pad_to(arr: np.ndarray, capacity: int, axis: int = 0) -> np.ndarray:
     n = arr.shape[axis]
     if n == capacity:
@@ -133,7 +152,7 @@ class ColumnVector:
             if np.iinfo(np.int32).min <= lo and hi <= np.iinfo(np.int32).max:
                 narrow = jnp.asarray(safe.astype(np.int32))
         elif dtype.id == T.TypeId.FLOAT64:
-            narrow = jnp.asarray(safe.astype(np.float32))
+            narrow = jnp.asarray(_f32_shadow(safe))
         return ColumnVector(dtype, jnp.asarray(safe), jnp.asarray(validity),
                             None, narrow)
 

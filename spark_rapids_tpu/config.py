@@ -289,6 +289,16 @@ AUTO_BROADCAST_THRESHOLD = conf(
     "spark.sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024,
     "Max build-side bytes for the AQE shuffled-hash-join to "
     "broadcast-join demotion (-1 disables).")
+BROADCAST_TIMEOUT = conf(
+    "spark.sql.broadcastTimeout", 300,
+    "Seconds allowed for materializing a broadcast build side before "
+    "the exchange fails (reference GpuBroadcastExchangeExec timeout "
+    "on the build-side collect future).")
+MAX_BROADCAST_TABLE_BYTES = conf(
+    "spark.rapids.tpu.maxBroadcastTableBytes", 8 << 30,
+    "Hard cap on a broadcast build side's device bytes; exceeding it "
+    "fails the query with a clear error instead of exhausting HBM "
+    "(Spark's 8GB broadcast-table limit).")
 
 
 def op_enable_key(kind: str, name: str) -> str:

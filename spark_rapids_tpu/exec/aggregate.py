@@ -163,13 +163,21 @@ class HashAggregateExec(UnaryExecBase):
         # deopts this exec to the lexicographic lane for good
         self._hash_group_disabled = True
 
-    def _groupby_kernel(self, batch: ColumnarBatch, phase: str):
-        """phase: 'update' (raw inputs) or 'merge' (intermediates)."""
+    def _groupby_kernel(self, batch: ColumnarBatch, phase: str,
+                        wcap: Optional[int] = None):
+        """phase: 'update' (raw inputs) or 'merge' (intermediates).
+        `wcap`: compact GROUP width — when set, every per-group gather
+        and output column runs at wcap instead of full row capacity
+        (a 2M-row batch with 1K groups spent ~1/3 of its kernel on
+        full-capacity group materialization), and the kernel reports
+        `num_groups > wcap` as a deferred excess flag (same
+        escalate-and-retry contract as _compact_groups)."""
         use_hash = self._use_hash_grouping(batch)
-        key = ("agg", phase, use_hash, batch_signature(batch))
+        key = ("agg", phase, use_hash, wcap, batch_signature(batch))
 
         def build():
             cap = batch.capacity
+            out_cap = wcap if wcap is not None else cap
             bound_groups = self._bound_groups
             funcs = self._funcs
 
@@ -187,9 +195,13 @@ class HashAggregateExec(UnaryExecBase):
                     collision = None
                 seg_ids = jnp.cumsum(bounds.astype(jnp.int32)) - 1
                 num_groups = bounds.sum().astype(jnp.int32)
+                excess = (num_groups > out_cap) if wcap is not None \
+                    else None
                 # group key representatives: first row of each segment
-                (first_idx,) = jnp.nonzero(bounds, size=cap,
-                                           fill_value=cap - 1)
+                from spark_rapids_tpu.ops.sort_encode import \
+                    masked_positions
+                first_idx = masked_positions(bounds, out_cap,
+                                             fill_value=cap - 1)
                 # per-segment LAST sorted row: one before the next
                 # segment's start; the last real segment (which also
                 # absorbs trailing invalid rows' segment ids) ends at
@@ -197,13 +209,13 @@ class HashAggregateExec(UnaryExecBase):
                 nxt = jnp.concatenate(
                     [first_idx[1:],
                      jnp.full((1,), cap, first_idx.dtype)])
-                ends = jnp.where(jnp.arange(cap) >= num_groups - 1,
+                ends = jnp.where(jnp.arange(out_cap) >= num_groups - 1,
                                  cap - 1, nxt - 1).astype(jnp.int32)
                 actx = AggContext(seg_ids, cap, sorted_valid, bounds,
-                                  ends)
+                                  ends, out_capacity=out_cap)
 
                 out_cols = []
-                grp_valid = jnp.arange(cap) < num_groups
+                grp_valid = jnp.arange(out_cap) < num_groups
                 # representatives via index COMPOSITION: one i32 gather
                 # (perm at first_idx) + one gather per key column — the
                 # sorted_keys detour re-gathered every key column at
@@ -242,11 +254,32 @@ class HashAggregateExec(UnaryExecBase):
                         ColumnVector(o.dtype, o.data,
                                      o.validity & grp_valid,
                                      o.lengths) for o in outs)
-                return out_cols, num_groups, collision
+                return out_cols, num_groups, collision, excess
 
             return kernel
 
         return self.kernels.get_or_build(key, build)
+
+    def _kernel_compact_cap(self, batch: ColumnarBatch) -> Optional[int]:
+        """Compact group width for the kernel, or None (full-width
+        output).  Mirrors _compact_groups' policy: the deopt retry is
+        the last chance and must be guaranteed-valid, so it always runs
+        uncompacted; escalation is learned per exec instance."""
+        if CK.is_retrying():
+            return None
+        tc = getattr(self, "_compact_cap", self.COMPACT_GROUPS_CAP)
+        if tc > self.COMPACT_GROUPS_MAX or batch.capacity <= tc:
+            return None
+        return tc
+
+    def _register_excess_check(self, excess, wcap: Optional[int],
+                               checks: tuple) -> tuple:
+        if excess is None:
+            return checks
+        chk = CK.register(CK.BatchCheck(
+            excess, origin=f"aggCompactGroups[exec {self.exec_id}]",
+            recover=lambda cap=wcap: self._escalate_compact(cap)))
+        return checks + (chk,)
 
     def _register_collision_check(self, collision, checks: tuple) -> tuple:
         """Deferred 64-bit-collision deopt for the hash-grouping lane
@@ -779,25 +812,6 @@ class HashAggregateExec(UnaryExecBase):
                 == failed_cap:
             self._compact_cap = failed_cap * 4
 
-    def _compact_groups(self, b: ColumnarBatch) -> ColumnarBatch:
-        if CK.is_retrying():
-            # the deopt retry is the last chance — compacting at the
-            # escalated cap could overflow AGAIN with no retry left, so
-            # the retry always runs uncompacted; the escalated cap
-            # applies to future collects of this (reused) plan
-            return b
-        tc = getattr(self, "_compact_cap", self.COMPACT_GROUPS_CAP)
-        if tc > self.COMPACT_GROUPS_MAX or b.capacity <= tc \
-                or b.sparse is not None:
-            return b
-        flag = b.num_rows_i32 > jnp.int32(tc)
-        chk = CK.register(CK.BatchCheck(
-            flag, origin="aggCompactGroups",
-            recover=lambda cap=tc: self._escalate_compact(cap)))
-        hb = b.take_head(tc)
-        return ColumnarBatch(hb.schema, list(hb.columns), hb._rows,
-                             hb.checks + (chk,))
-
     def process_partition(self, batches) -> Iterator[ColumnarBatch]:
         if not self.group_exprs:
             yield from self._reduction_path(batches)
@@ -814,16 +828,19 @@ class HashAggregateExec(UnaryExecBase):
                 if fast is not None:
                     partials.append(fast)
                     continue
-                kern = self._groupby_kernel(batch, phase)
+                wcap = self._kernel_compact_cap(batch)
+                kern = self._groupby_kernel(batch, phase, wcap)
                 if batch.sparse is not None:
-                    cols, n, coll = kern(batch.columns, batch.num_rows_i32,
-                                         batch.sparse)
+                    cols, n, coll, excess = kern(
+                        batch.columns, batch.num_rows_i32, batch.sparse)
                 else:
-                    cols, n, coll = kern(batch.columns, batch.num_rows_i32)
-                partials.append(self._compact_groups(
-                    ColumnarBatch(inter_fields, list(cols), n,
-                                  self._register_collision_check(
-                                      coll, batch.checks))))
+                    cols, n, coll, excess = kern(
+                        batch.columns, batch.num_rows_i32)
+                checks = self._register_collision_check(
+                    coll, batch.checks)
+                checks = self._register_excess_check(excess, wcap, checks)
+                partials.append(
+                    ColumnarBatch(inter_fields, list(cols), n, checks))
 
         if not partials:
             return
@@ -861,13 +878,20 @@ class HashAggregateExec(UnaryExecBase):
     def _merge_partials(self, partials, inter_schema) -> ColumnarBatch:
         merged = concat_batches(partials)
         merge_exec = self._get_merge_exec(inter_schema)
+        wcap = self._kernel_compact_cap(merged)
         with self.metrics.timed(M.TOTAL_TIME):
-            kern = merge_exec._groupby_kernel(merged, "merge")
-            cols, n, coll = kern(merged.columns, merged.num_rows_i32)
-        return self._compact_groups(
-            ColumnarBatch(inter_schema, list(cols), n,
-                          merge_exec._register_collision_check(
-                              coll, merged.checks)))
+            kern = merge_exec._groupby_kernel(merged, "merge", wcap)
+            if merged.sparse is not None:
+                cols, n, coll, excess = kern(
+                    merged.columns, merged.num_rows_i32, merged.sparse)
+            else:
+                cols, n, coll, excess = kern(
+                    merged.columns, merged.num_rows_i32)
+        checks = merge_exec._register_collision_check(coll, merged.checks)
+        # escalation is learned on the OUTER exec (the merge exec is a
+        # cached internal helper; the compact policy lives with self)
+        checks = self._register_excess_check(excess, wcap, checks)
+        return ColumnarBatch(inter_schema, list(cols), n, checks)
 
     def _partial_schema(self) -> T.Schema:
         if self.mode == AggMode.FINAL:

@@ -228,8 +228,22 @@ class TpuExec:
         fast-path checks resolve.  On FastPathInvalid: disable the
         offending fast path and re-execute once (plans are pure)."""
         from spark_rapids_tpu.utils import checks as CK
+        me = threading.get_ident()
+        with _COLLECT_LOCK:
+            # atomic claim: without the lock two threads entering at
+            # depth 0 simultaneously would both pass and race the
+            # epoch bump / release_execution_state
+            if _COLLECT_DEPTH[0] == 0:
+                _COLLECT_OWNER[0] = me
+            elif _COLLECT_OWNER[0] != me:
+                raise RuntimeError(
+                    "concurrent top-level collect() from a second "
+                    "thread: the engine executes one query at a time "
+                    "(see _EXECUTION_EPOCH thread model); materialize "
+                    "on the driver thread and hand batches to workers "
+                    "instead")
+            _COLLECT_DEPTH[0] += 1
         mark = CK.snapshot()
-        _COLLECT_DEPTH[0] += 1
         try:
             try:
                 out = self._collect_once().dense()
@@ -251,8 +265,12 @@ class TpuExec:
                     CK.set_retrying(False)
                 return out
         finally:
-            _COLLECT_DEPTH[0] -= 1
-            if _COLLECT_DEPTH[0] == 0:
+            with _COLLECT_LOCK:
+                _COLLECT_DEPTH[0] -= 1
+                outermost = _COLLECT_DEPTH[0] == 0
+                if outermost:
+                    _COLLECT_OWNER[0] = None
+            if outermost:
                 # only the OUTERMOST collect tears down shared-subtree
                 # caches: a nested collect (CpuBroadcastExchange
                 # materializing its child mid-plan) must not clear the
@@ -305,12 +323,27 @@ class TpuExec:
 #: bumped once per TOP-LEVEL plan execution attempt (collect and its
 #: deopt retry); CommonSubplanExec uses it to scope its materialized
 #: results to a single execution, so retries re-run the subtree with
-#: fast paths disabled and results don't outlive the query
+#: fast paths disabled and results don't outlive the query.
+#:
+#: THREAD MODEL (ADVICE r4): these are process-global on purpose — the
+#: engine runs ONE top-level query at a time on the driver thread,
+#: like a Spark driver submitting one job per action.  Worker threads
+#: (shuffle manager, pyudf pool, partitioning's shared sorter) never
+#: call collect(); they receive already-materialized batches.  A
+#: second CONCURRENT top-level collect() on another thread would race
+#: the epoch bump and could release_execution_state() mid-query,
+#: clearing or staling CommonSubplanExec caches — guarded below.
 _EXECUTION_EPOCH = [0]
 #: collect() nesting depth — broadcast exchanges collect their child
 #: mid-plan; those inner collects must neither bump the epoch nor
 #: release the outer query's shared-subtree caches
 _COLLECT_DEPTH = [0]
+#: owner of the in-flight top-level collect; a concurrent top-level
+#: collect from a different thread raises instead of corrupting the
+#: shared execution state (one-query-at-a-time discipline, see above)
+_COLLECT_OWNER = [None]
+#: guards depth/owner updates so simultaneous ENTRY is caught too
+_COLLECT_LOCK = threading.Lock()
 
 
 class CommonSubplanExec(TpuExec):

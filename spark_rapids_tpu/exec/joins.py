@@ -704,6 +704,13 @@ class NestedLoopJoinExec(TpuExec):
                          self.children[1].execute_partitions()
                          for b in it if b.maybe_nonempty()]
         right_batches = [b for b in right_batches if b.num_rows > 0]
+        # pair-expansion budget: the kernel materializes lcap*rcap
+        # output rows, so the LEFT side is sharded until one pair
+        # block's bytes fit target_size_bytes (the knob the planner
+        # threads from spark.rapids.sql.batchSizeBytes — reference
+        # GpuBroadcastNestedLoopJoinExec's targetSizeBytes)
+        tsb = int(getattr(self, "target_size_bytes", 0)) or (1 << 30)
+        row_bytes = max(8 * len(self._schema.fields), 1)
         for it in self.children[0].execute_partitions():
             for lb in it:
                 if not lb.maybe_nonempty():
@@ -712,18 +719,25 @@ class NestedLoopJoinExec(TpuExec):
                 if lb.num_rows == 0:
                     continue
                 for rb in right_batches:
-                    with self.metrics.timed(M.TOTAL_TIME):
-                        kern = self._pair_kernel(lb, rb)
-                        lout, rout, n = kern(
-                            lb.columns, jnp.int32(lb.num_rows),
-                            rb.columns, jnp.int32(rb.num_rows))
-                        out = ColumnarBatch(self._schema,
-                                            list(lout) + list(rout), int(n))
-                        if self.condition is not None:
-                            out = self._apply_condition(out)
-                    if out.num_rows:
-                        self.update_output_metrics(out)
-                        yield out
+                    max_left = max(1, tsb // (row_bytes * rb.capacity))
+                    pieces = ([lb] if lb.capacity <= max_left else
+                              [lb.slice(lo, min(max_left,
+                                                lb.num_rows - lo))
+                               for lo in range(0, lb.num_rows, max_left)])
+                    for piece in pieces:
+                        with self.metrics.timed(M.TOTAL_TIME):
+                            kern = self._pair_kernel(piece, rb)
+                            lout, rout, n = kern(
+                                piece.columns, jnp.int32(piece.num_rows),
+                                rb.columns, jnp.int32(rb.num_rows))
+                            out = ColumnarBatch(
+                                self._schema, list(lout) + list(rout),
+                                int(n))
+                            if self.condition is not None:
+                                out = self._apply_condition(out)
+                        if out.num_rows:
+                            self.update_output_metrics(out)
+                            yield out
 
     def _apply_condition(self, batch):
         from spark_rapids_tpu.exec.basic import FilterExec, LocalBatchSource

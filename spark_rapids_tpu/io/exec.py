@@ -243,10 +243,14 @@ class CpuWriteFiles(CpuNode):
         try:
             for task_id, it in enumerate(self.child.execute()):
                 writer = job.task_writer(task_id)
-                for df in it:
-                    writer.write(ColumnarBatch.from_numpy(
-                        _df_data(df, schema), schema,
-                        _df_validity(df, schema)))
+                try:
+                    for df in it:
+                        writer.write(ColumnarBatch.from_numpy(
+                            _df_data(df, schema), schema,
+                            _df_validity(df, schema)))
+                except BaseException:
+                    writer.abort()  # this attempt only
+                    raise
                 stats_list.append(writer.commit())
         except BaseException:
             job.abort()
@@ -308,9 +312,13 @@ class TpuWriteFilesExec(UnaryExecBase):
         try:
             for task_id, it in enumerate(self.child.execute_partitions()):
                 writer = job.task_writer(task_id)
-                with self.metrics.timed():
-                    for batch in it:
-                        writer.write(batch)
+                try:
+                    with self.metrics.timed():
+                        for batch in it:
+                            writer.write(batch)
+                except BaseException:
+                    writer.abort()  # this attempt only
+                    raise
                 stats_list.append(writer.commit())
         except BaseException:
             job.abort()

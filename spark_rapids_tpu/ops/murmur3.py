@@ -77,7 +77,19 @@ def _double_to_words(x):
     Exact IEEE754 reconstruction: frexp gives mantissa in [0.5, 1) and
     exponent; the 52-bit mantissa field is recovered with two exact f64
     multiplies (each fits 32 bits).  Specials (0, inf, nan, subnormal)
-    handled explicitly; NaN canonicalized like Java's doubleToLongBits."""
+    handled explicitly; NaN canonicalized like Java's doubleToLongBits.
+
+    KNOWN DIVERGENCE — accelerator-emulated f64 (ADVICE r4): on CPU
+    this is Spark-exact for all normal values (verified 0/20009
+    mismatches; subnormals flush to zero).  On the TPU backend f64
+    arithmetic is float-float EMULATED and the decomposition inherits
+    that precision: measured on-chip, 1e308 encodes as infinity's bit
+    pattern and pi loses its 3 low mantissa bits.  Engine-internal
+    partitioning stays self-consistent (every row hashes through the
+    same path), but FLOAT64 keys must not mix CPU- and TPU-computed
+    partition ids in one shuffle — identical f64 keys could route to
+    different partitions.  Integral/string/f32 hashing is exact on
+    both backends; only f64 carries this caveat."""
     x = x.astype(jnp.float64)
     # jnp.signbit lowers through a 64-bit bitcast XLA:TPU's x64
     # rewriter rejects; IEEE division distinguishes -0.0 instead

@@ -148,9 +148,10 @@ def _enc32(x_i32, ascending: bool):
 VARIADIC_MAX_WORDS = 3
 
 
-def _pack_words(keys_msf: list) -> list:
-    """Greedily pack (array, bits) keys MSF->LSF into few sort words;
-    returns [(array, used_bits-or-None), ...]."""
+def _pack_words_width(keys_msf: list, max_bits: int) -> list:
+    """Greedily pack (array, bits) keys MSF->LSF into sort words of at
+    most `max_bits`; returns [(array, used_bits-or-None), ...].  A key
+    wider than max_bits still gets its own full-width word."""
     words: list = []          # (array, used_bits or None)
     acc, used = None, 0
 
@@ -171,7 +172,7 @@ def _pack_words(keys_msf: list) -> list:
             acc = ((acc.astype(jnp.uint32) << jnp.uint32(bits))
                    | arr.astype(jnp.uint32))
             used += bits
-        elif acc is not None and used + bits <= 64:
+        elif acc is not None and used + bits <= max_bits:
             acc = ((acc.astype(jnp.uint64) << jnp.uint64(bits))
                    | arr.astype(jnp.uint64))
             used += bits
@@ -180,6 +181,20 @@ def _pack_words(keys_msf: list) -> list:
             acc, used = arr, bits
     flush()
     return words
+
+
+def _pack_words(keys_msf: list) -> list:
+    """Pack keys into sort words, PREFERRING 32-bit words: a variadic
+    sort over two u32 operands runs ~40% faster than over one u64 word
+    on this chip (measured 85ms vs 118-142ms at 2M rows — 64-bit
+    compare-exchange is the bitonic network's dominant cost).  The
+    32-bit split only applies while the total word count stays within
+    the variadic-network budget; past it, wide 64-bit words keep the
+    word count (and the LSD chain length) down."""
+    w32 = _pack_words_width(keys_msf, 32)
+    if len(w32) <= VARIADIC_MAX_WORDS:
+        return w32
+    return _pack_words_width(keys_msf, 64)
 
 
 def _narrowed(w, wbits):
@@ -262,11 +277,16 @@ def sort_with_bounds(key_cols: list, row_mask: jnp.ndarray,
     lead = [((~row_mask).astype(jnp.uint8), 1)]
     for col, asc, nf in key_cols[:prefix]:
         lead.extend(encode_key_bits(col, asc, nf))
-    pwords = _pack_words(lead)
     rest: list = []
     for col, asc, nf in key_cols[prefix:]:
         rest.extend(encode_key_bits(col, asc, nf))
-    rwords = _pack_words(rest)
+    # the 32-bit word preference (see _pack_words) must be decided over
+    # the COMBINED word count — prefix and rest ride one sort network
+    pwords = _pack_words_width(lead, 32)
+    rwords = _pack_words_width(rest, 32)
+    if len(pwords) + len(rwords) > VARIADIC_MAX_WORDS:
+        pwords = _pack_words_width(lead, 64)
+        rwords = _pack_words_width(rest, 64)
     perm, swords = _sort_words_full(pwords + rwords, cap)
     # invalid rows sort LAST (the lead word's MSB is the invalid flag),
     # so the sorted mask is a plain prefix — no gather needed
@@ -463,6 +483,25 @@ def multi_key_argsort(key_cols: list[tuple[ColumnVector, bool, bool]],
     for col, asc, nf in key_cols:
         keys_msf.extend(encode_key_bits(col, asc, nf))
     return packed_lexsort(keys_msf)
+
+
+def masked_positions(mask: jnp.ndarray, size: int,
+                     fill_value: int) -> jnp.ndarray:
+    """First `size` indices where mask is set, ascending; `fill_value`
+    past the set count.  top_k-based: `jnp.nonzero(size=...)` lowers to
+    a serialized scatter-add on XLA:TPU (~107ms fused at 2M rows, the
+    single largest op in the group-by kernel), while a 32-bit top_k
+    over the masked iota measures ~62ms standalone and fuses better.
+    Falls back to nonzero when size covers the whole array (top_k at
+    k == n is a full sort)."""
+    cap = mask.shape[0]
+    if size * 2 > cap:
+        return jnp.nonzero(mask, size=size, fill_value=fill_value)[0]
+    iota = lax.iota(jnp.int32, cap)
+    keyv = jnp.where(mask, iota, jnp.iinfo(jnp.int32).max)
+    neg, _ = lax.top_k(-keyv, size)
+    pos = -neg
+    return jnp.where(pos >= cap, fill_value, pos)
 
 
 def segment_boundaries(key_cols: list[ColumnVector],

@@ -449,8 +449,13 @@ class CpuSortAggregate(CpuAggregate):
     aggregate (`GpuOverrides.scala` exec[SortAggregateExec] ->
     GpuHashAggregateExec); mirrored here: the CPU eval is the grouped
     pandas path with sorted group order, the TPU conversion is
-    HashAggregateExec (its sort-based segment lane already emits
-    key-sorted output)."""
+    HashAggregateExec.  NOTE: like GpuHashAggregateExec, the converted
+    exec provides NO output-ordering guarantee — the hash-grouping and
+    dictionary lanes emit hash-/slot-ordered groups (only the
+    lexicographic lane happens to sort by key).  Any consumer that
+    needs sorted groups must keep its own SortExec; a future
+    sort-elimination rule must NOT assume child ordering here
+    (ADVICE r4)."""
 
     def describe(self):
         return (f"CpuSortAggregate(keys={len(self.group_exprs)}, "
@@ -767,6 +772,77 @@ class CpuSortMergeJoin(CpuHashJoin):
 
     def describe(self):
         return f"CpuSortMergeJoin({self.join_type.value})"
+
+
+class CpuNestedLoopJoin(CpuNode):
+    """Brute-force join with NO equi keys (Spark
+    BroadcastNestedLoopJoinExec).  Reference registers its rule
+    disabled by default — 'large joins can cause out of memory errors'
+    (`GpuOverrides.scala:1770-1774`) — and v0.2 supports inner-like
+    join types only (`GpuBroadcastNestedLoopJoinExec.scala:49-53`);
+    both mirrored here.  This is the planner fallback for non-equi
+    join conditions, which `CpuHashJoin` cannot express."""
+
+    def __init__(self, join_type: JoinType, left: CpuNode, right: CpuNode,
+                 condition: Optional[Expression] = None):
+        super().__init__(left, right)
+        if join_type not in (JoinType.INNER, JoinType.CROSS):
+            # rejected at CONSTRUCTION: the CPU eval below computes
+            # inner/cross semantics, so accepting e.g. LEFT_OUTER here
+            # would silently return inner results on the fallback path
+            raise ValueError(
+                f"nested loop join supports inner/cross only, "
+                f"got {join_type}")
+        self.join_type = join_type
+        self.condition = condition
+        ls, rs = left.output_schema(), right.output_schema()
+        self._schema = T.Schema(tuple(ls.fields) + tuple(rs.fields))
+
+    def output_schema(self):
+        return self._schema
+
+    def output_partition_count(self) -> int:
+        return 1
+
+    def describe(self):
+        cond = "" if self.condition is None else ", condition"
+        return f"{type(self).__name__}({self.join_type.value}{cond})"
+
+    def execute(self):
+        ls = self.children[0].output_schema()
+        rs = self.children[1].output_schema()
+        lparts = [df for it in self.children[0].execute() for df in it]
+        rparts = [df for it in self.children[1].execute() for df in it]
+        ldf = (pd.concat(lparts, ignore_index=True) if lparts
+               else empty_df(ls))
+        rdf = (pd.concat(rparts, ignore_index=True) if rparts
+               else empty_df(rs))
+
+        def reassemble(frame):
+            return pd.concat([
+                frame[[c for c in ldf.columns]].reset_index(drop=True),
+                frame[[f"__r_{c}" for c in rdf.columns]]
+                .rename(columns=lambda c: c[4:]).reset_index(drop=True)],
+                axis=1)
+
+        merged = ldf.merge(rdf.add_prefix("__r_"), how="cross")
+        if self.condition is not None and len(merged):
+            m = cpu_eval(self.condition, reassemble(merged), self._schema)
+            merged = merged[m.astype("boolean").fillna(False)
+                            .astype(bool).to_numpy()]
+        return [iter([normalize_df(reassemble(merged), self._schema)])]
+
+
+class CpuCartesianProduct(CpuNestedLoopJoin):
+    """Spark CartesianProductExec: a CROSS join of two unbroadcast
+    sides, optionally with a condition.  Separate node class so its
+    auto-derived per-op enable key matches the reference's separate
+    `exec[CartesianProductExec]` rule (`GpuOverrides.scala:1774-1789`,
+    also disabled by default)."""
+
+    def __init__(self, left: CpuNode, right: CpuNode,
+                 condition: Optional[Expression] = None):
+        super().__init__(JoinType.CROSS, left, right, condition)
 
 
 @dataclasses.dataclass(frozen=True)

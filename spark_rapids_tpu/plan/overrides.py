@@ -428,6 +428,33 @@ def _conv_hash_join(meta, kids) -> TpuExec:
                         left, right, node.condition)
 
 
+def _conv_nested_loop_join(meta, kids) -> TpuExec:
+    node: N.CpuNestedLoopJoin = meta.node
+    from spark_rapids_tpu.shims import current_shims
+    shims = current_shims(meta.conf)
+    return shims.make_nested_loop_join(
+        node.join_type, kids[0], kids[1], node.condition,
+        target_size_bytes=int(meta.conf[C.BATCH_SIZE_BYTES]))
+
+
+def _tag_nested_loop_join(meta) -> None:
+    """Reference `GpuOverrides.scala:1770-1789`: both brute-force join
+    rules are disabled by default ('large joins can cause out of
+    memory errors'); `GpuBroadcastNestedLoopJoinExec.scala:49-53`
+    supports inner-like types only in v0.2."""
+    node: N.CpuNestedLoopJoin = meta.node
+    name = type(node).__name__
+    if not meta.conf.is_op_enabled("exec", name, default=False):
+        meta.will_not_work_on_tpu(
+            f"{name} is disabled by default (large joins can cause out "
+            f"of memory errors); enable with "
+            f"{C.op_enable_key('exec', name)}")
+    if node.join_type not in (JoinType.INNER, JoinType.CROSS):
+        meta.will_not_work_on_tpu(
+            f"nested loop join type {node.join_type} is not supported "
+            f"on TPU (inner-like only)")
+
+
 def _tag_join(meta) -> None:
     node: N.CpuHashJoin = meta.node
     supported = {JoinType.INNER, JoinType.LEFT_OUTER, JoinType.RIGHT_OUTER,
@@ -528,6 +555,19 @@ register_exec(
     exprs_of=lambda n: list(n.left_keys) + list(n.right_keys) +
     ([n.condition] if n.condition is not None else []),
     tag_extra=_tag_join)
+# brute-force joins: registered like the reference's
+# exec[BroadcastNestedLoopJoinExec] / exec[CartesianProductExec]
+# pair (GpuOverrides.scala:1770-1789), both disabled by default
+register_exec(
+    N.CpuNestedLoopJoin, "join using brute force",
+    _conv_nested_loop_join,
+    exprs_of=lambda n: [n.condition] if n.condition is not None else [],
+    tag_extra=_tag_nested_loop_join)
+register_exec(
+    N.CpuCartesianProduct, "cartesian product using brute force",
+    _conv_nested_loop_join,
+    exprs_of=lambda n: [n.condition] if n.condition is not None else [],
+    tag_extra=_tag_nested_loop_join)
 def _conv_cached_columnar(meta, kids) -> TpuExec:
     from spark_rapids_tpu.plan.transitions import HostColumnarToDeviceExec
     return HostColumnarToDeviceExec(meta.node)

@@ -210,6 +210,16 @@ class ShuffleExchangeExec(UnaryExecBase):
         def flush():
             if len(group) == 1:
                 m = group[0]
+            elif self.coalesce_small:
+                # consumer is a final aggregation / window that compacts
+                # its groups right away, so the lazy concat's worst-case
+                # capacity (bounded by MERGE_TARGET_CAP per flush group)
+                # never propagates — and skipping the count sync keeps
+                # the whole collect down to ONE readback wave (the
+                # count sync below was measured at ~130ms through the
+                # tunnel on the milestone-2 groupby: it must WAIT for
+                # every queued partial-agg kernel before reading)
+                m = concat_batches(list(group))
             else:
                 # sync the slices' row counts (ONE stacked readback)
                 # and concat TIGHT: the sync-free lazy concat keeps
@@ -432,11 +442,32 @@ class ShuffleExchangeExec(UnaryExecBase):
             yield from it
 
 
+class BroadcastTimeoutError(RuntimeError):
+    """Build-side materialization exceeded spark.sql.broadcastTimeout
+    (reference GpuBroadcastExchangeExec: 'Could not execute broadcast
+    in N secs' from the collect future's timeout)."""
+
+
+class BroadcastTooLargeError(RuntimeError):
+    """Build side exceeded spark.rapids.tpu.maxBroadcastTableBytes
+    (Spark's 8GB broadcast-table limit analog)."""
+
+
 class BroadcastExchangeExec(UnaryExecBase):
     """Collect the (small) build side once; every consumer gets the same
     single batch (reference GpuBroadcastExchangeExec +
     SerializeConcatHostBuffersDeserializeBatch semantics, minus the
-    torrent wire format)."""
+    torrent wire format).
+
+    Guards (reference GpuBroadcastExchangeExec.scala:238): the build
+    collect is bounded by spark.sql.broadcastTimeout and the total
+    device bytes by spark.rapids.tpu.maxBroadcastTableBytes, so a
+    runaway build side fails with a clear error instead of hanging the
+    query or exhausting HBM.  Design shift: the reference runs the
+    collect on a dedicated thread pool and times out the future; this
+    engine executes one query at a time on the driver thread, so the
+    timeout is COOPERATIVE — checked between build-side batches (a
+    single wedged batch kernel is the driver's watchdog's job)."""
 
     def __init__(self, child: TpuExec):
         super().__init__(child)
@@ -451,9 +482,30 @@ class BroadcastExchangeExec(UnaryExecBase):
 
     def broadcast_batch(self) -> ColumnarBatch:
         if self._cached is None:
+            import time
+            from spark_rapids_tpu import config as C
+            conf = C.get_active_conf()
+            timeout_s = conf[C.BROADCAST_TIMEOUT]
+            max_bytes = conf[C.MAX_BROADCAST_TABLE_BYTES]
             with self.metrics.timed("broadcastTime"):
-                batches = [b for it in self.child.execute_partitions()
-                           for b in it if b.maybe_nonempty()]
+                t0 = time.monotonic()
+                batches, total = [], 0
+                for it in self.child.execute_partitions():
+                    for b in it:
+                        if not b.maybe_nonempty():
+                            continue
+                        batches.append(b)
+                        total += b.device_size_bytes()
+                        if total > max_bytes:
+                            raise BroadcastTooLargeError(
+                                f"broadcast build side reached {total} "
+                                f"bytes > spark.rapids.tpu."
+                                f"maxBroadcastTableBytes={max_bytes}")
+                        if time.monotonic() - t0 > timeout_s:
+                            raise BroadcastTimeoutError(
+                                f"could not execute broadcast in "
+                                f"{timeout_s} secs "
+                                f"(spark.sql.broadcastTimeout)")
                 if batches:
                     self._cached = concat_batches(batches).dense()
                 else:

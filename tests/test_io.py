@@ -520,3 +520,81 @@ def test_exception_mode_accepts_1582_to_1900_timestamps():
     micros_1500 = -14830986000000000  # ~1500 CE, pre-cutover
     tbl2 = pa.table({"t": pa.array([micros_1500], pa.timestamp("us"))})
     assert RB.arrow_table_needs_rebase(tbl2)
+
+
+# -- task-commit protocol (VERDICT r4: GpuFileFormatWriter.scala:338 /
+# -- GpuInsertIntoHadoopFsRelationCommand semantics) -------------------------
+def _wb(df):
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    return ColumnarBatch.from_pandas(df)
+
+
+def test_write_abort_mid_task_leaves_no_partial_files(tmp_path):
+    """A task that dies mid-write must leave NO files in the output:
+    its attempt dir is private and abort removes it."""
+    from spark_rapids_tpu.io.writer import WriteJob
+    df = _sample_df(20)
+    out = str(tmp_path / "o")
+    b = _wb(df)
+    job = WriteJob(out, "parquet", b.schema)
+    job.setup()
+    w0 = job.task_writer(0)
+    w0.write(b)
+    stats0 = w0.commit()          # task 0 commits fine
+    w1 = job.task_writer(1)
+    w1.write(b)                   # task 1 dies before commit
+    w1.abort()
+    total = job.commit([stats0])
+    assert total.num_rows == 20   # only task 0's rows
+    files = [n for n in os.listdir(out) if n.endswith(".parquet")]
+    assert len(files) == 1 and files[0].startswith("part-00000-")
+    assert not os.path.exists(os.path.join(out, "_temporary"))
+
+
+def test_write_speculative_duplicate_task_commits_once(tmp_path):
+    """Two attempts of the SAME task id (speculation): exactly one
+    commit wins; the loser's files and stats are discarded."""
+    from spark_rapids_tpu.io.writer import WriteJob
+    df = _sample_df(10)
+    out = str(tmp_path / "o")
+    b = _wb(df)
+    job = WriteJob(out, "parquet", b.schema)
+    job.setup()
+    a1 = job.task_writer(0)
+    a2 = job.task_writer(0)       # speculative duplicate
+    a1.write(b)
+    a2.write(b)
+    s1 = a1.commit()
+    s2 = a2.commit()              # loses the rename race
+    assert s1.num_rows == 10 and s2.num_rows == 0
+    total = job.commit([s1, s2])
+    assert total.num_rows == 10
+    files = [n for n in os.listdir(out) if n.endswith(".parquet")]
+    assert len(files) == 1
+
+
+def test_dynamic_partition_overwrite(tmp_path):
+    """mode=dynamic_overwrite replaces ONLY the partitions present in
+    the new data (Spark partitionOverwriteMode=dynamic; reference
+    GpuInsertIntoHadoopFsRelationCommand dynamicPartitionOverwrite)."""
+    out = str(tmp_path / "parted")
+    df1 = pd.DataFrame({"k": ["a", "b"], "v": np.array([1, 2], np.int64)})
+    write_batches(iter([_wb(df1)]), out, "parquet", _wb(df1).schema,
+                  partition_by=["k"])
+    # overwrite only partition a with new data; b must survive
+    df2 = pd.DataFrame({"k": ["a", "a"], "v": np.array([7, 8], np.int64)})
+    write_batches(iter([_wb(df2)]), out, "parquet", _wb(df2).schema,
+                  partition_by=["k"], mode="dynamic_overwrite")
+    back = collect(accelerate(tio.read_parquet(out), conf()))
+    got = {(r["k"], int(r["v"])) for _, r in back.iterrows()}
+    assert got == {("a", 7), ("a", 8), ("b", 2)}
+
+
+def test_dynamic_overwrite_requires_partitioning(tmp_path):
+    from spark_rapids_tpu.io.writer import WriteJob
+    df = _sample_df(5)
+    b = _wb(df)
+    import pytest
+    with pytest.raises(ValueError):
+        WriteJob(str(tmp_path / "x"), "parquet", b.schema,
+                 mode="dynamic_overwrite")

@@ -554,3 +554,72 @@ def test_shared_subplan_under_union_reprojects_positionally():
     np.testing.assert_allclose(
         np.sort(got["s"].astype(float).to_numpy()),
         np.sort(exp["s"].to_numpy()), rtol=1e-5)
+
+
+def test_nested_loop_join_disabled_by_default():
+    """Brute-force joins fall back unless explicitly enabled — the
+    reference's disabledByDefault('large joins can cause out of memory
+    errors'), GpuOverrides.scala:1770-1789."""
+    from spark_rapids_tpu.plan.nodes import CpuNestedLoopJoin
+    left = CpuSource.from_pandas(pd.DataFrame(
+        {"x": np.arange(5, dtype=np.int64)}))
+    right = CpuSource.from_pandas(pd.DataFrame(
+        {"y": np.arange(3, dtype=np.int64)}))
+    node = CpuNestedLoopJoin(JoinType.INNER, left, right,
+                             col("x") > col("y"))
+    plan = accelerate(node, conf())
+    ExecutionPlanCapture.assert_did_fall_back("CpuNestedLoopJoin")
+    got = collect(plan).sort_values(["x", "y"], ignore_index=True)
+    assert len(got) == sum(1 for x in range(5) for y in range(3) if x > y)
+
+
+def test_nested_loop_join_planned_on_tpu():
+    """Enabled, a non-equi inner join plans through accelerate() onto
+    NestedLoopJoinExec with CPU-golden parity."""
+    from spark_rapids_tpu.exec.joins import NestedLoopJoinExec
+    from spark_rapids_tpu.plan.nodes import CpuNestedLoopJoin
+    rng = np.random.default_rng(7)
+    ldf = pd.DataFrame({"x": rng.integers(0, 20, 40).astype(np.int64),
+                        "lv": rng.uniform(0, 1, 40)})
+    rdf = pd.DataFrame({"y": rng.integers(0, 20, 15).astype(np.int64),
+                        "rv": rng.uniform(0, 1, 15)})
+    node = CpuNestedLoopJoin(
+        JoinType.INNER, CpuSource.from_pandas(ldf),
+        CpuSource.from_pandas(rdf), col("x") > col("y"))
+    c = conf(spark__rapids__sql__exec__CpuNestedLoopJoin=True)
+    expected = node.collect().sort_values(
+        ["x", "y", "lv", "rv"], ignore_index=True)
+    plan = accelerate(node, c)
+    assert isinstance(plan, TpuExec)
+    found = [False]
+
+    def walk(p):
+        if isinstance(p, NestedLoopJoinExec):
+            found[0] = True
+        for ch in p.children:
+            walk(ch)
+    walk(plan)
+    assert found[0], f"no NestedLoopJoinExec in:\n{plan}"
+    got = collect(plan, c).sort_values(
+        ["x", "y", "lv", "rv"], ignore_index=True)
+    pd.testing.assert_frame_equal(got, expected, check_dtype=False)
+
+
+def test_cartesian_product_planned_on_tpu():
+    """CartesianProductExec analog: CROSS with no condition, enabled
+    via its own per-op key (separate rule like the reference's
+    exec[CartesianProductExec])."""
+    from spark_rapids_tpu.exec.joins import NestedLoopJoinExec
+    from spark_rapids_tpu.plan.nodes import CpuCartesianProduct
+    ldf = pd.DataFrame({"x": np.arange(4, dtype=np.int64)})
+    rdf = pd.DataFrame({"y": np.arange(3, dtype=np.int64)})
+    node = CpuCartesianProduct(CpuSource.from_pandas(ldf),
+                               CpuSource.from_pandas(rdf))
+    # disabled by default
+    accelerate(node, conf())
+    ExecutionPlanCapture.assert_did_fall_back("CpuCartesianProduct")
+    c = conf(spark__rapids__sql__exec__CpuCartesianProduct=True)
+    plan = accelerate(node, c)
+    assert isinstance(plan, TpuExec)
+    got = collect(plan, c).sort_values(["x", "y"], ignore_index=True)
+    assert len(got) == 12

@@ -370,6 +370,35 @@ def test_broadcast_hash_join(rng):
     assert len(got) == len(exp)
 
 
+def test_broadcast_size_guard(rng):
+    """Build side past maxBroadcastTableBytes fails with a clear error
+    (Spark's 8GB broadcast-table limit; reference
+    GpuBroadcastExchangeExec guards the build-side collect)."""
+    import pytest
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.shuffle.exchange import BroadcastTooLargeError
+    _, right = _join_dfs(rng)
+    bc = BroadcastExchangeExec(LocalBatchSource.from_pandas(right))
+    conf = C.RapidsConf({"spark.rapids.tpu.maxBroadcastTableBytes": 64})
+    with C.session(conf):
+        with pytest.raises(BroadcastTooLargeError):
+            bc.broadcast_batch()
+
+
+def test_broadcast_timeout_guard(rng):
+    """spark.sql.broadcastTimeout bounds build-side materialization
+    (cooperative, checked between build batches)."""
+    import pytest
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.shuffle.exchange import BroadcastTimeoutError
+    _, right = _join_dfs(rng)
+    bc = BroadcastExchangeExec(LocalBatchSource.from_pandas(right))
+    conf = C.RapidsConf({"spark.sql.broadcastTimeout": 0})
+    with C.session(conf):
+        with pytest.raises(BroadcastTimeoutError):
+            bc.broadcast_batch()
+
+
 def test_cartesian_product():
     a = LocalBatchSource.from_pandas(
         pd.DataFrame({"x": np.array([1, 2, 3], np.int64)}))
@@ -395,3 +424,23 @@ def test_shuffled_join_pipeline(rng):
     got = plan.to_pandas()
     exp = left.merge(right, left_on="k", right_on="k2")
     assert len(got) == len(exp)
+
+
+def test_nested_loop_join_target_size_sharding(rng):
+    """target_size_bytes bounds the pair expansion: the left side is
+    sharded so one pair block fits the budget, results unchanged
+    (reference GpuBroadcastNestedLoopJoinExec targetSizeBytes)."""
+    from spark_rapids_tpu.exec.joins import NestedLoopJoinExec
+    ldf = pd.DataFrame({"x": np.arange(200, dtype=np.int64)})
+    rdf = pd.DataFrame({"y": np.arange(7, dtype=np.int64)})
+    j = NestedLoopJoinExec(
+        LocalBatchSource.from_pandas(ldf),
+        LocalBatchSource.from_pandas(rdf),
+        condition=col("x") % lit(11) > col("y"),
+        join_type=JoinType.INNER)
+    j.target_size_bytes = 2048  # forces several left shards
+    got = j.to_pandas().sort_values(["x", "y"], ignore_index=True)
+    exp = ldf.merge(rdf, how="cross")
+    exp = exp[exp["x"] % 11 > exp["y"]].sort_values(
+        ["x", "y"], ignore_index=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
