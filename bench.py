@@ -247,25 +247,39 @@ def bench_q1_fused(pandas_time, batches):
 
 
 def probe_hbm_bandwidth() -> float:
-    """HBM-RESIDENT device bandwidth ceiling (VERDICT r4 #6): a fused
-    elementwise pass over a 256MB device-resident f32 array, pipelined
-    and fenced once — measures what the CHIP's memory system sustains,
-    distinct from the tunnel-attached dispatch ceiling the fused-Q1
-    probe sees.  Utilization below is reported against BOTH this and
-    nominal v5e HBM (819 GB/s)."""
+    """HBM-RESIDENT device READ bandwidth ceiling (VERDICT r4 #6): a
+    fused sum over a 1GB device-resident f32 array, pipelined and
+    fenced once — measures what the CHIP's memory system sustains for
+    the read-dominated passes these workloads are, distinct from the
+    tunnel-attached dispatch ceiling.  (A write-heavy elementwise
+    probe measured only ~2 GB/s — fresh 256MB output allocations are
+    pathologically slow through this attachment — so writes would
+    understate the chip; reads are the honest ceiling here.)
+    Utilization below is reported against BOTH this and nominal v5e
+    HBM (819 GB/s)."""
     import jax
     import jax.numpy as jnp
-    n = 64 << 20  # 256MB f32
-    x = jnp.arange(n, dtype=jnp.float32)
-    f = jax.jit(lambda v, s: v * 2.0 + s)
-    o = f(x, jnp.float32(1))
+    # 4 x 1GB f32: per-dispatch fixed cost through the tunnel is
+    # ~35-45ms, so the read must be GBs to amortize (measured 91.3
+    # GB/s at this shape; a single 1GB 1-D reduce trips a pathological
+    # XLA:TPU memory assignment, and smaller multi-operand shapes read
+    # 4-15 GB/s purely from fixed overhead)
+    n = 256 << 20
+    xs = [jnp.ones((n,), jnp.float32) * (i + 1) for i in range(4)]
+
+    def probe(s, *cs):
+        return jnp.stack([(c + s).sum() for c in cs])
+    f = jax.jit(probe)
+    o = f(jnp.float32(1), *xs)
     jax.block_until_ready(o)
     t0 = time.perf_counter()
-    outs = [f(x, jnp.float32(i + 2)) for i in range(6)]
+    outs = [f(jnp.float32(i + 2), *xs) for i in range(6)]
     jax.block_until_ready(outs)
-    np.asarray(outs[-1][:1])
+    np.asarray(outs[-1])
     dt = (time.perf_counter() - t0) / 6
-    return 2 * x.nbytes / dt / 1e9  # read + write
+    total = sum(x.nbytes for x in xs)
+    del xs
+    return total / dt / 1e9
 
 
 def _best_of(fn, n: int) -> float:
@@ -649,8 +663,10 @@ def bench_udf_q27():
     }
 
 
-SCALE_LI_BATCH = 1 << 23
-SCALE_LI_BATCHES = 13          # 104,857,600 rows
+SCALE_LI_BATCH = 1 << 22       # 4M caps: shares kernel signatures with
+                               # the other benches (8M-cap bitonic
+                               # sorts compile for ~10 minutes each)
+SCALE_LI_BATCHES = 25          # 104,857,600 rows
 
 
 def bench_scale_join_groupby():
@@ -698,29 +714,52 @@ def bench_scale_join_groupby():
     conf = C.RapidsConf({"spark.rapids.shuffle.enabled": True,
                          "spark.rapids.tpu.batchMaxRows": SCALE_LI_BATCH})
 
-    def build_plan():
-        lex = ShuffleExchangeExec(
-            HashPartitioning([col("l_orderkey")], n_parts),
-            LocalBatchSource(li_parts, li_schema))
-        oex = ShuffleExchangeExec(
-            HashPartitioning([col("o_orderkey")], n_parts),
-            LocalBatchSource(o_parts, ord_schema))
-        join = HashJoinExec(JoinType.INNER, [col("l_orderkey")],
-                            [col("o_orderkey")], lex, oex, None)
-        return HashAggregateExec(
-            [col("o_custkey")],
-            [Sum(col("l_revenue")).alias("rev"),
-             Count(col("l_revenue")).alias("n")], join)
+    from spark_rapids_tpu.exec.base import UnaryExecBase
 
-    # asserted-spill pass: force the catalog to host AFTER the map
-    # stage; reducers must read back spilled buffers and stay exact
+    class SpillTap(UnaryExecBase):
+        """Pass-through on the PROBE-side exchange output: fires when
+        the join pulls its first reduce batch — the map stage for both
+        exchanges has run, their outputs sit in the spillable catalog —
+        and forces everything device->host.  Inert (enabled=False)
+        during the untampered timing passes.  (Tapping between join
+        and agg was too late: the join drains its readers eagerly, so
+        the catalog was already empty.)"""
+        enabled = False
+        spilled = 0
+
+        def output_schema(self):
+            return self.child.output_schema()
+
+        def process_partition(self, batches):
+            if SpillTap.enabled:
+                SpillTap.spilled = max(
+                    SpillTap.spilled,
+                    ResourceEnv.get().device_store.synchronous_spill(0))
+            yield from batches
+
+    lex = ShuffleExchangeExec(
+        HashPartitioning([col("l_orderkey")], n_parts),
+        LocalBatchSource(li_parts, li_schema))
+    oex = ShuffleExchangeExec(
+        HashPartitioning([col("o_orderkey")], n_parts),
+        LocalBatchSource(o_parts, ord_schema))
+    join = HashJoinExec(JoinType.INNER, [col("l_orderkey")],
+                        [col("o_orderkey")], SpillTap(lex), oex, None)
+    # ONE plan instance for every pass: collect() owns the deferred-
+    # check retry protocol (the 131K-group agg escalates its compact
+    # width through it), and the learned width persists on the exec
+    agg = HashAggregateExec(
+        [col("o_custkey")],
+        [Sum(col("l_revenue")).alias("rev"),
+         Count(col("l_revenue")).alias("n")], join)
+
+    # asserted-spill pass: reducers must read host-tier buffers and
+    # stay exact
+    SpillTap.enabled = True
     with C.session(conf):
-        env = ResourceEnv.get()
-        agg = build_plan()
-        parts = agg.execute_partitions()   # map side ran eagerly
-        spilled = env.device_store.synchronous_spill(0)
-        out = [b for it in parts for b in it]
-        got = pd.concat([b.to_pandas() for b in out], ignore_index=True)
+        got = agg.collect().to_pandas()
+    SpillTap.enabled = False
+    spilled = SpillTap.spilled
     assert spilled > 0, "no device->host spill occurred"
     cust_sums = np.zeros(n_cust)
     np.add.at(cust_sums, oc[lk], lv)
@@ -734,10 +773,7 @@ def bench_scale_join_groupby():
 
     def engine_run():
         with C.session(conf):
-            p = build_plan()
-            for it in p.execute_partitions():
-                for b in it:
-                    b.to_pandas()
+            agg.collect().to_pandas()
     best = _best_of(engine_run, 2)
 
     ldf = pd.DataFrame({"l_orderkey": lk, "l_revenue": lv})

@@ -223,10 +223,19 @@ class TpuExec:
         raise NotImplementedError(
             f"{type(self).__name__} does not support partitioned execution")
 
+    #: bounded deopt attempts: intermediate retries may take optimistic
+    #: fast paths with ESCALATED parameters (e.g. a ×4'd group-compact
+    #: width) and fail again; only the LAST runs with every fast path
+    #: forced off (is_retrying) for a guaranteed-valid result.  The old
+    #: single-retry scheme jumped straight to full-width kernels, whose
+    #: compile-time buffer assignment OOMed HBM at 8M-row caps.
+    MAX_DEOPT_RETRIES = 3
+
     def collect(self) -> ColumnarBatch:
         """Materialize to one batch; the sync boundary where deferred
-        fast-path checks resolve.  On FastPathInvalid: disable the
-        offending fast path and re-execute once (plans are pure)."""
+        fast-path checks resolve.  On FastPathInvalid: disable/escalate
+        the offending fast path and re-execute (plans are pure), up to
+        MAX_DEOPT_RETRIES times."""
         from spark_rapids_tpu.utils import checks as CK
         me = threading.get_ident()
         with _COLLECT_LOCK:
@@ -245,25 +254,26 @@ class TpuExec:
             _COLLECT_DEPTH[0] += 1
         mark = CK.snapshot()
         try:
-            try:
-                out = self._collect_once().dense()
-                out.prefetch()
-                # ONE verify over batch checks + the query's registered
-                # checks = one stacked flag readback (a second verify
-                # call would pay its own tunnel round trip)
-                CK.verify(list(out.checks) + CK.drain_since(mark))
-                return out
-            except CK.FastPathInvalid as e:
-                e.recover_all()
-                CK.drain_since(mark)  # discard THIS query's leftovers
-                CK.set_retrying(True)
+            for attempt in range(self.MAX_DEOPT_RETRIES + 1):
+                final = attempt == self.MAX_DEOPT_RETRIES
+                if attempt:
+                    CK.set_retrying(final)
                 try:
                     out = self._collect_once().dense()
                     out.prefetch()
+                    # ONE verify over batch checks + the query's
+                    # registered checks = one stacked flag readback (a
+                    # second verify call would pay its own round trip)
                     CK.verify(list(out.checks) + CK.drain_since(mark))
+                    return out
+                except CK.FastPathInvalid as e:
+                    if final:
+                        raise
+                    e.recover_all()
+                    CK.drain_since(mark)  # discard this attempt's rest
                 finally:
-                    CK.set_retrying(False)
-                return out
+                    if attempt:
+                        CK.set_retrying(False)
         finally:
             with _COLLECT_LOCK:
                 _COLLECT_DEPTH[0] -= 1

@@ -485,23 +485,36 @@ def multi_key_argsort(key_cols: list[tuple[ColumnVector, bool, bool]],
     return packed_lexsort(keys_msf)
 
 
+#: above this requested size the top_k lane hands over to a payload
+#: sort: top_k cost grows with k (k=256K over 1M rows is close to a
+#: full sort), while the 1-bit-key payload sort is flat in k
+MASKED_POSITIONS_TOPK_MAX = 1 << 15
+
+
 def masked_positions(mask: jnp.ndarray, size: int,
                      fill_value: int) -> jnp.ndarray:
     """First `size` indices where mask is set, ascending; `fill_value`
-    past the set count.  top_k-based: `jnp.nonzero(size=...)` lowers to
-    a serialized scatter-add on XLA:TPU (~107ms fused at 2M rows, the
-    single largest op in the group-by kernel), while a 32-bit top_k
-    over the masked iota measures ~62ms standalone and fuses better.
-    Falls back to nonzero when size covers the whole array (top_k at
-    k == n is a full sort)."""
+    past the set count.  `jnp.nonzero(size=...)` lowers to a serialized
+    scatter-add on XLA:TPU (~107ms fused at 2M rows — it was the
+    single largest op in the group-by kernel), so:
+      - small size: 32-bit top_k over the masked iota (~62ms at 2M)
+      - large size: ONE stable 1-bit-key sort carrying the iota as a
+        payload operand (payload moves are ~free in the sort network;
+        cost is flat in `size` where top_k grows with k)
+      - size covering the array: nonzero fallback."""
     cap = mask.shape[0]
     if size * 2 > cap:
         return jnp.nonzero(mask, size=size, fill_value=fill_value)[0]
     iota = lax.iota(jnp.int32, cap)
-    keyv = jnp.where(mask, iota, jnp.iinfo(jnp.int32).max)
-    neg, _ = lax.top_k(-keyv, size)
-    pos = -neg
-    return jnp.where(pos >= cap, fill_value, pos)
+    if size <= MASKED_POSITIONS_TOPK_MAX:
+        keyv = jnp.where(mask, iota, jnp.iinfo(jnp.int32).max)
+        neg, _ = lax.top_k(-keyv, size)
+        pos = -neg
+        return jnp.where(pos >= cap, fill_value, pos)
+    _, sorted_iota = lax.sort([~mask, iota], num_keys=1, is_stable=True)
+    count = mask.sum()
+    head = sorted_iota[:size]
+    return jnp.where(jnp.arange(size) < count, head, fill_value)
 
 
 def segment_boundaries(key_cols: list[ColumnVector],

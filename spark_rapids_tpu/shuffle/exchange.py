@@ -204,6 +204,13 @@ class ShuffleExchangeExec(UnaryExecBase):
     MERGE_TARGET_CAP = 1 << 16
 
     def _merged_reader(self, bs: list[ColumnarBatch]):
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.columnar.vector import bucket_capacity
+        # scale the consolidation target with the session's batch-row
+        # budget: a 26M-row reduce partition under the 64K floor came
+        # out as ~400 tiny batches — 400 probe/agg dispatches downstream
+        target_cap = max(self.MERGE_TARGET_CAP, bucket_capacity(
+            int(C.get_active_conf()[C.MAX_BATCH_ROWS])))
         group: list[ColumnarBatch] = []
         cap_sum = 0
 
@@ -245,7 +252,7 @@ class ShuffleExchangeExec(UnaryExecBase):
             return m
 
         for b in bs:
-            if group and cap_sum + b.capacity > self.MERGE_TARGET_CAP:
+            if group and cap_sum + b.capacity > target_cap:
                 yield flush()
                 group, cap_sum = [], 0
             group.append(b)

@@ -83,6 +83,29 @@ def test_masked_positions(n_set):
     np.testing.assert_array_equal(got, exp)
 
 
+def test_masked_positions_payload_sort_lane():
+    """size past MASKED_POSITIONS_TOPK_MAX takes the 1-bit payload
+    sort; identical contract."""
+    from spark_rapids_tpu.ops.sort_encode import \
+        MASKED_POSITIONS_TOPK_MAX
+    cap = MASKED_POSITIONS_TOPK_MAX * 8
+    size = MASKED_POSITIONS_TOPK_MAX * 2
+    rng = np.random.default_rng(11)
+    idx = np.sort(rng.choice(cap, size + 100, replace=False))
+    mask = np.zeros(cap, bool)
+    mask[idx] = True
+    got = np.asarray(masked_positions(jnp.asarray(mask), size,
+                                      fill_value=cap - 1))
+    np.testing.assert_array_equal(got, idx[:size])
+    # and with fewer set bits than size: fill past the count
+    mask2 = np.zeros(cap, bool)
+    mask2[idx[:50]] = True
+    got2 = np.asarray(masked_positions(jnp.asarray(mask2), size,
+                                       fill_value=cap - 1))
+    np.testing.assert_array_equal(got2[:50], idx[:50])
+    assert (got2[50:] == cap - 1).all()
+
+
 def test_masked_positions_full_width_path():
     """size*2 > cap takes the nonzero fallback; same contract."""
     cap = 64

@@ -601,10 +601,13 @@ def test_groupby_negative_zero_f32_one_group():
     assert got == {0.0: 11, 1.0: 4}
 
 
-def test_compaction_retry_bypasses_and_future_collects_use_ladder(rng):
-    """A group count past 4x the compaction cap must still complete on
-    the single retry (the retry runs uncompacted), and later collects
-    of the SAME plan must use the escalated cap."""
+def test_compaction_escalation_ladder_resolves_in_one_collect(rng):
+    """A group count past 4x the compaction cap resolves WITHIN one
+    collect: bounded deopt retries climb the x4 escalation ladder
+    (16K -> 64K -> 256K) instead of jumping to full-width kernels
+    (whose compile-time buffer assignment OOMed HBM at 8M-row caps),
+    and later collects of the SAME plan start at the learned cap with
+    no further deopts."""
     from spark_rapids_tpu import config as C
     n = 1 << 17
     n_groups = (1 << 16) + 123     # > 4x the 16K target
@@ -619,9 +622,10 @@ def test_compaction_retry_bypasses_and_future_collects_use_ladder(rng):
             LocalBatchSource.from_pandas(df))
         out = plan.to_pandas()
         assert len(out) == n_groups
-        assert plan._compact_cap == HashAggregateExec.COMPACT_GROUPS_CAP * 4
-        # second collect: one more deopt (cap still too small), another
-        # escalation, still exact
+        # the ladder climbed twice within the first collect
+        assert plan._compact_cap == \
+            HashAggregateExec.COMPACT_GROUPS_CAP * 16
+        # second collect: the learned cap fits, no further escalation
         out2 = plan.to_pandas()
         assert len(out2) == n_groups
         assert plan._compact_cap == \
