@@ -659,7 +659,15 @@ def bench_udf_q27():
         "effective_gbps": round(ubytes / best / 1e9, 2),
         "note": "TPCx-BB q27 via the udf-compiler (compiled Python "
                 "sentiment/extraction UDF on TPU; reference Q27Like "
-                "throws 'uses UDF')",
+                "throws 'uses UDF'). Where the time goes (profiled): "
+                "at the old 262K-row point the query was FIXED-COST "
+                "bound — ~150ms of device work spread over ~250 small "
+                "dispatches plus one ~146ms sync wave; at this 2M/200K"
+                "-item point it is bound by the 200K-group partial "
+                "aggregation: the grouping sort plus group-compaction, "
+                "whose top_k at k=256K degenerated toward a full sort "
+                "until masked_positions switched to a flat-cost "
+                "payload-sort lane past 32K groups.",
     }
 
 
