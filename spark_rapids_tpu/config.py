@@ -79,6 +79,11 @@ EXPORT_COLUMNAR_RDD = conf("spark.rapids.sql.exportColumnarRdd", False,
 SPARK_VERSION = conf("spark.rapids.tpu.sparkVersion", "3.0.1",
                      "Spark version the session emulates; selects the "
                      "shim set (reference ShimLoader.scala:26-61).")
+ALLOW_UNKNOWN_SPARK_VERSION = conf(
+    "spark.rapids.tpu.allowUnknownSparkVersion", False,
+    "When no shim matches the Spark version exactly, fall back to the "
+    "nearest same-minor shim with a warning instead of failing "
+    "(default: fail, like the reference ShimLoader).")
 MAX_BATCH_ROWS = conf("spark.rapids.tpu.batchMaxRows", 65536,
                       "Row cap per device batch at upload/scan/coalesce "
                       "boundaries.  Bounds the set of compiled kernel "
