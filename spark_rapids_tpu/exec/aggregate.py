@@ -245,11 +245,16 @@ class HashAggregateExec(UnaryExecBase):
                 sorted_flat = gather_columns_grouped(flat, perm,
                                                      sorted_valid)
                 it = iter(sorted_flat)
-                for f, ins in zip(funcs, inputs_per_f):
-                    sorted_inputs = [next(it) for _ in ins]
-                    outs = (f.update(actx, sorted_inputs)
-                            if phase == "update"
-                            else f.merge(actx, sorted_inputs))
+                sorted_per_f = [[next(it) for _ in ins]
+                                for ins in inputs_per_f]
+                # ONE cross-function segmented scan per round (each
+                # function's operands batch into a shared _segscan —
+                # a q1-shaped aggregate ran 8 separate 2M-row scan
+                # dispatches at ~100ms each before)
+                from spark_rapids_tpu.exprs.aggregates import \
+                    run_agg_phase
+                for outs in run_agg_phase(actx, funcs, sorted_per_f,
+                                          phase):
                     out_cols.extend(
                         ColumnVector(o.dtype, o.data,
                                      o.validity & grp_valid,
@@ -946,19 +951,22 @@ class HashAggregateExec(UnaryExecBase):
                 actx = AggContext(seg_ids, cap, ctx.row_mask,
                                   bounds=jnp.arange(cap) == 0,
                                   ends=jnp.full(cap, cap - 1, jnp.int32))
-                out_cols = []
                 if phase == "update":
-                    for f, bins in zip(funcs, self._bound_inputs):
-                        inputs = [e.eval(ctx) for e in bins]
-                        outs = f.update(actx, inputs)
-                        out_cols.extend(outs)
+                    inputs_per_f = [[e.eval(ctx) for e in bins]
+                                    for bins in self._bound_inputs]
                 else:
+                    inputs_per_f = []
                     off = len(self._group_fields)
                     for f in funcs:
                         n = f.num_intermediates
-                        outs = f.merge(actx, columns[off: off + n])
+                        inputs_per_f.append(columns[off: off + n])
                         off += n
-                        out_cols.extend(outs)
+                from spark_rapids_tpu.exprs.aggregates import \
+                    run_agg_phase
+                out_cols = []
+                for outs in run_agg_phase(actx, funcs, inputs_per_f,
+                                          phase):
+                    out_cols.extend(outs)
                 return out_cols
 
             return kernel

@@ -93,6 +93,121 @@ def _segscan(combine_vals, bounds, *vals):
     return rec(bounds, vals)
 
 
+_SCAN_OPS = {
+    "add": lambda a, b: a + b,
+    "min": jnp.minimum,
+    "max": jnp.maximum,
+}
+
+
+class ScanBatch:
+    """Cross-function segmented-scan batcher.
+
+    Aggregate functions register per-row operands (`seg(op, arr)`) and
+    the kernel runs ONE `_segscan` per round over every registered
+    operand, each combined with its own op — one pass over the sorted
+    rows instead of one `_segscan` PER FUNCTION (measured r4: each
+    2M-row scan dispatch costs ~100ms while a stacked multi-operand
+    scan runs in roughly one scan's time; a q1-shaped aggregate ran 8
+    separate scans over 15 operands before this existed).
+
+    Handles returned by `seg` resolve to per-GROUP results (gathered at
+    segment ends) after `run_round()`.  Operands registered by resumed
+    generators go into the next round, so a two-stage function (e.g.
+    Welford m2 against the group mean) costs the whole kernel two scan
+    dispatches, not two per function."""
+
+    def __init__(self, ctx: "AggContext"):
+        self._ctx = ctx
+        self._ops: list = []        # combine-op name per handle
+        self._pend: list = []       # (handle, row array) this round
+        self._results: dict = {}    # handle -> per-group result
+        # (op, id(arr)) -> (handle, arr).  The array is HELD in the
+        # entry: a dedup key must not outlive its object, or a freed
+        # round-1 operand's reused id() could alias a later round's
+        # operand and hand it another operand's scan result.
+        self._dedup: dict = {}
+
+    def seg(self, op: str, arr) -> int:
+        key = (op, id(arr))
+        hit = self._dedup.get(key)
+        if hit is not None:
+            return hit[0]
+        h = len(self._ops)
+        self._ops.append(op)
+        self._pend.append((h, arr))
+        self._dedup[key] = (h, arr)
+        return h
+
+    def run_round(self) -> None:
+        if not self._pend:
+            return
+        idxs = [h for h, _ in self._pend]
+        arrs = [a for _, a in self._pend]
+        ops = [_SCAN_OPS[self._ops[h]] for h in idxs]
+
+        def combine(a, b):
+            return tuple(op(x, y) for op, x, y in zip(ops, a, b))
+
+        runs = _segscan(combine, self._ctx.bounds, *arrs)
+        ends = self._ctx.ends
+        for h, r in zip(idxs, runs):
+            self._results[h] = jnp.take(r, ends)
+        self._pend = []
+
+    def result(self, h: int):
+        return self._results[h]
+
+
+def _drive_eager(make_gen, ctx: "AggContext"):
+    scans = ScanBatch(ctx)
+    gen = make_gen(scans)
+    if gen is None:
+        raise NotImplementedError
+    next(gen)
+    while True:
+        scans.run_round()
+        try:
+            next(gen)
+        except StopIteration as e:
+            return e.value
+
+
+def run_agg_phase(actx: "AggContext", funcs, inputs_per_f, phase: str):
+    """Drive every aggregate function's update/merge with cross-function
+    scan batching; returns the per-function output tuples in order.
+
+    Functions exposing the generator protocol (`update_scans` /
+    `merge_scans` returning a generator) register their scan operands,
+    yield, and resume with results once the shared round has run;
+    functions without it fall back to their eager `update`/`merge`."""
+    scans = ScanBatch(actx)
+    slots: list = []
+    live: list = []
+    for f, ins in zip(funcs, inputs_per_f):
+        gen = (f.update_scans(actx, scans, ins) if phase == "update"
+               else f.merge_scans(actx, scans, ins))
+        if gen is None:
+            outs = (f.update(actx, ins) if phase == "update"
+                    else f.merge(actx, ins))
+            slots.append(outs)
+        else:
+            next(gen)
+            slots.append(None)
+            live.append((len(slots) - 1, gen))
+    while live:
+        scans.run_round()
+        nxt = []
+        for i, gen in live:
+            try:
+                next(gen)
+                nxt.append((i, gen))
+            except StopIteration as e:
+                slots[i] = e.value
+        live = nxt
+    return slots
+
+
 def _sorted_seg_sums(ctx: "AggContext", *vals):
     """Per-group sums of several arrays in ONE segmented scan + gathers
     at segment ends.  Additions happen in row order WITHIN each group
@@ -107,14 +222,6 @@ def _sorted_seg_sums(ctx: "AggContext", *vals):
 
 def _sorted_seg_sum(vals, ctx: "AggContext"):
     return _sorted_seg_sums(ctx, vals)[0]
-
-
-def _sorted_seg_minmax(vals, ctx: "AggContext", is_min: bool):
-    """Per-group min/max via segmented scan; invalid rows must already
-    be filled with the reduction identity."""
-    op = jnp.minimum if is_min else jnp.maximum
-    (run,) = _segscan(lambda a, b: (op(a[0], b[0]),), ctx.bounds, vals)
-    return jnp.take(run, ctx.ends)
 
 
 @dataclasses.dataclass
@@ -166,11 +273,28 @@ class AggregateFunction:
 
     def update(self, ctx: AggContext, inputs: Sequence[ColumnVector]
                ) -> Sequence[ColumnVector]:
-        raise NotImplementedError
+        """Eager fallback: drives this function's scan generator with a
+        private ScanBatch (single-function callers; the group-by kernel
+        batches across functions via run_agg_phase)."""
+        return _drive_eager(
+            lambda s: self.update_scans(ctx, s, inputs), ctx)
 
     def merge(self, ctx: AggContext, partials: Sequence[ColumnVector]
               ) -> Sequence[ColumnVector]:
-        raise NotImplementedError
+        return _drive_eager(
+            lambda s: self.merge_scans(ctx, s, partials), ctx)
+
+    # batched-scan protocol (run_agg_phase): return a GENERATOR that
+    # registers operands on the shared ScanBatch, yields once per scan
+    # round, and `return`s the output tuple — or None to have the
+    # kernel fall back to the eager update/merge above.
+    def update_scans(self, ctx: AggContext, scans: "ScanBatch",
+                     inputs: Sequence[ColumnVector]):
+        return None
+
+    def merge_scans(self, ctx: AggContext, scans: "ScanBatch",
+                    partials: Sequence[ColumnVector]):
+        return None
 
     def evaluate(self, partials: Sequence[ColumnVector],
                  schema: T.Schema) -> ColumnVector:
@@ -202,27 +326,37 @@ class Sum(AggregateFunction):
     def intermediate_types(self, schema):
         return (self.result_type(schema),)
 
-    def update(self, ctx, inputs):
-        (v,) = inputs
-        dt = _sum_type(v.dtype)
-        acc = v.data.astype(dt.storage_dtype)
-        ok = v.validity & ctx.row_valid
-        # count companion scans i32: it only feeds the null flag, and
-        # counts are bounded by capacity < 2^31 (64-bit elementwise is
-        # 50-100x slower on this chip)
-        s, cnt = _sorted_seg_sums(ctx, jnp.where(ok, acc, 0),
-                                  ok.astype(jnp.int32))
-        return (ColumnVector(dt, s, cnt > 0),)
-
-    def merge(self, ctx, partials):
-        (p,) = partials
-        ok = p.validity & ctx.row_valid
-        s, cnt = _sorted_seg_sums(ctx, jnp.where(ok, p.data, 0),
-                                  ok.astype(jnp.int32))
-        return (ColumnVector(p.dtype, s, cnt > 0),)
-
     def evaluate(self, partials, schema):
         return partials[0]
+
+    def update_scans(self, ctx, scans, inputs):
+        (v,) = inputs
+        dt = _sum_type(v.dtype)
+
+        def gen():
+            acc = v.data.astype(dt.storage_dtype)
+            ok = v.validity & ctx.row_valid
+            hs = scans.seg("add", jnp.where(ok, acc, 0))
+            # count companion scans i32: it only feeds the null flag,
+            # and counts are bounded by capacity < 2^31 (64-bit
+            # elementwise is 50-100x slower on this chip)
+            hc = scans.seg("add", ok.astype(jnp.int32))
+            yield
+            return (ColumnVector(dt, scans.result(hs),
+                                 scans.result(hc) > 0),)
+        return gen()
+
+    def merge_scans(self, ctx, scans, partials):
+        (p,) = partials
+
+        def gen():
+            ok = p.validity & ctx.row_valid
+            hs = scans.seg("add", jnp.where(ok, p.data, 0))
+            hc = scans.seg("add", ok.astype(jnp.int32))
+            yield
+            return (ColumnVector(p.dtype, scans.result(hs),
+                                 scans.result(hc) > 0),)
+        return gen()
 
 
 @dataclasses.dataclass
@@ -236,41 +370,59 @@ class Count(AggregateFunction):
     def intermediate_types(self, schema):
         return (T.INT64,)
 
-    def update(self, ctx, inputs):
-        if self.child is None:
-            ok = ctx.row_valid
-        else:
-            ok = inputs[0].validity & ctx.row_valid
-        # i32 scan (counts bounded by capacity), widened at the output
-        c = _sorted_seg_sum(ok.astype(jnp.int32), ctx).astype(jnp.int64)
-        return (ColumnVector(T.INT64, c, jnp.ones(ctx.out_capacity, bool)),)
-
-    def merge(self, ctx, partials):
-        (p,) = partials
-        ok = p.validity & ctx.row_valid
-        c = _sorted_seg_sum(jnp.where(ok, p.data, 0), ctx)
-        return (ColumnVector(T.INT64, c, jnp.ones(ctx.out_capacity, bool)),)
-
     def evaluate(self, partials, schema):
         return partials[0]
 
+    def update_scans(self, ctx, scans, inputs):
+        def gen():
+            if self.child is None:
+                ok = ctx.row_valid
+            else:
+                ok = inputs[0].validity & ctx.row_valid
+            # i32 scan (counts bounded by capacity), widened at output
+            h = scans.seg("add", ok.astype(jnp.int32))
+            yield
+            c = scans.result(h).astype(jnp.int64)
+            return (ColumnVector(T.INT64, c,
+                                 jnp.ones(ctx.out_capacity, bool)),)
+        return gen()
 
-def _minmax_numeric(v: ColumnVector, ctx: AggContext, is_min: bool):
+    def merge_scans(self, ctx, scans, partials):
+        (p,) = partials
+
+        def gen():
+            ok = p.validity & ctx.row_valid
+            h = scans.seg("add", jnp.where(ok, p.data, 0))
+            yield
+            return (ColumnVector(T.INT64, scans.result(h),
+                                 jnp.ones(ctx.out_capacity, bool)),)
+        return gen()
+
+
+def _minmax_numeric_gen(v: ColumnVector, ctx: AggContext,
+                        scans: ScanBatch, is_min: bool):
     """Direct segment min/max with Spark NaN semantics (NaN is the largest
     value).  No bit-encode: 64-bit bitcasts don't lower on TPU.
 
     floats: max — NaN wins whenever present (map NaN -> +inf and track);
             min — NaN loses unless the whole group is NaN.
-    """
+
+    Generator (ScanBatch protocol); yields once, returns (red, has).
+    Scans run at the column's NATIVE storage width — the old int path
+    widened every operand to i64, and 64-bit elementwise ops are
+    50-100x slower on this chip."""
+    op = "min" if is_min else "max"
     ok = v.validity & ctx.row_valid
     if v.dtype.is_floating:
         nan = jnp.isnan(v.data) & ok
         non_nan = ok & ~nan
         fill = jnp.inf if is_min else -jnp.inf
-        masked = jnp.where(non_nan, v.data, fill)
-        red = _sorted_seg_minmax(masked, ctx, is_min)
-        cnt, n_non_nan = _sorted_seg_sums(
-            ctx, ok.astype(jnp.int64), non_nan.astype(jnp.int64))
+        hr = scans.seg(op, jnp.where(non_nan, v.data, fill))
+        hc, hn = (scans.seg("add", x.astype(jnp.int32))
+                  for x in (ok, non_nan))
+        yield
+        red = scans.result(hr)
+        cnt, n_non_nan = scans.result(hc), scans.result(hn)
         has = cnt > 0
         if is_min:
             # all-NaN group -> NaN
@@ -279,13 +431,14 @@ def _minmax_numeric(v: ColumnVector, ctx: AggContext, is_min: bool):
             # any NaN -> NaN is the max
             red = jnp.where(cnt > n_non_nan, jnp.nan, red)
         return red.astype(v.dtype.storage_dtype), has
-    has = _sorted_seg_sum(ok.astype(jnp.int64), ctx) > 0
-    lo = _INT_MIN[v.dtype.id]
-    hi = _INT_MAX[v.dtype.id]
-    fill = hi if is_min else lo
-    masked = jnp.where(ok, v.data.astype(jnp.int64), fill)
-    red = _sorted_seg_minmax(masked, ctx, is_min)
-    return red.astype(v.dtype.storage_dtype), has
+    fill = (_INT_MAX if is_min else _INT_MIN)[v.dtype.id]
+    masked = jnp.where(ok, v.data,
+                       jnp.asarray(fill, v.data.dtype))
+    hr = scans.seg(op, masked)
+    hh = scans.seg("add", ok.astype(jnp.int32))
+    yield
+    return (scans.result(hr).astype(v.dtype.storage_dtype),
+            scans.result(hh) > 0)
 
 
 @dataclasses.dataclass
@@ -306,11 +459,24 @@ class _MinMax(AggregateFunction):
         (v,) = inputs
         if v.dtype.is_string:
             return self._update_string(ctx, v)
-        red, has = _minmax_numeric(v, ctx, self._is_min)
-        return (ColumnVector(v.dtype, red, has),)
+        return super().update(ctx, inputs)
 
     def merge(self, ctx, partials):
         return self.update(ctx, partials)
+
+    def update_scans(self, ctx, scans, inputs):
+        (v,) = inputs
+        if v.dtype.is_string:
+            return None
+
+        def gen():
+            red, has = yield from _minmax_numeric_gen(
+                v, ctx, scans, self._is_min)
+            return (ColumnVector(v.dtype, red, has),)
+        return gen()
+
+    def merge_scans(self, ctx, scans, partials):
+        return self.update_scans(ctx, scans, partials)
 
     def evaluate(self, partials, schema):
         return partials[0]
@@ -340,7 +506,7 @@ class _MinMax(AggregateFunction):
         pos = masked_positions(isfirst, ctx.out_capacity,
                                fill_value=cap - 1)
         idx = jnp.take(order, pos).astype(jnp.int32)
-        has = _sorted_seg_sum(ok.astype(jnp.int64), ctx) > 0
+        has = _sorted_seg_sum(ok.astype(jnp.int32), ctx) > 0
         # a group whose rows are all null/invalid sorted them first
         # anyway — mask it out via `has`
         out = v.gather(idx, has)
@@ -371,24 +537,34 @@ class Average(AggregateFunction):
     def result_from_intermediates(self, inter):
         return T.FLOAT64
 
-    def update(self, ctx, inputs):
+    def update_scans(self, ctx, scans, inputs):
         (v,) = inputs
-        ok = v.validity & ctx.row_valid
-        s, c = _sorted_seg_sums(
-            ctx, jnp.where(ok, v.data.astype(jnp.float64), 0.0),
-            ok.astype(jnp.int32))
-        always = jnp.ones(ctx.out_capacity, bool)
-        return (ColumnVector(T.FLOAT64, s, always),
-                ColumnVector(T.INT64, c.astype(jnp.int64), always))
 
-    def merge(self, ctx, partials):
+        def gen():
+            ok = v.validity & ctx.row_valid
+            hs = scans.seg(
+                "add", jnp.where(ok, v.data.astype(jnp.float64), 0.0))
+            hc = scans.seg("add", ok.astype(jnp.int32))
+            yield
+            always = jnp.ones(ctx.out_capacity, bool)
+            return (ColumnVector(T.FLOAT64, scans.result(hs), always),
+                    ColumnVector(T.INT64,
+                                 scans.result(hc).astype(jnp.int64),
+                                 always))
+        return gen()
+
+    def merge_scans(self, ctx, scans, partials):
         s_p, c_p = partials
-        ok = ctx.row_valid
-        s, c = _sorted_seg_sums(ctx, jnp.where(ok, s_p.data, 0.0),
-                                jnp.where(ok, c_p.data, 0))
-        always = jnp.ones(ctx.out_capacity, bool)
-        return (ColumnVector(T.FLOAT64, s, always),
-                ColumnVector(T.INT64, c, always))
+
+        def gen():
+            ok = ctx.row_valid
+            hs = scans.seg("add", jnp.where(ok, s_p.data, 0.0))
+            hc = scans.seg("add", jnp.where(ok, c_p.data, 0))
+            yield
+            always = jnp.ones(ctx.out_capacity, bool)
+            return (ColumnVector(T.FLOAT64, scans.result(hs), always),
+                    ColumnVector(T.INT64, scans.result(hc), always))
+        return gen()
 
     def evaluate(self, partials, schema):
         s, c = partials
@@ -412,24 +588,27 @@ class _FirstLast(AggregateFunction):
     def intermediate_types(self, schema):
         return (self.child.data_type(schema),)
 
-    def update(self, ctx, inputs):
+    def update_scans(self, ctx, scans, inputs):
         (v,) = inputs
-        cap = ctx.capacity
-        ok = ctx.row_valid & (v.validity if self.ignore_nulls
-                              else jnp.ones(cap, bool))
-        rows = jnp.arange(cap, dtype=jnp.int32)
-        if self._is_first:
-            pick = _sorted_seg_minmax(jnp.where(ok, rows, cap), ctx,
-                                      is_min=True)
-        else:
-            pick = _sorted_seg_minmax(jnp.where(ok, rows, -1), ctx,
-                                      is_min=False)
-        has = _sorted_seg_sum(ok.astype(jnp.int32), ctx) > 0
-        idx = jnp.where(has, pick, 0).astype(jnp.int32)
-        return (v.gather(idx, has),)
 
-    def merge(self, ctx, partials):
-        return self.update(ctx, partials)
+        def gen():
+            cap = ctx.capacity
+            ok = ctx.row_valid & (v.validity if self.ignore_nulls
+                                  else jnp.ones(cap, bool))
+            rows = jnp.arange(cap, dtype=jnp.int32)
+            if self._is_first:
+                hp = scans.seg("min", jnp.where(ok, rows, cap))
+            else:
+                hp = scans.seg("max", jnp.where(ok, rows, -1))
+            hh = scans.seg("add", ok.astype(jnp.int32))
+            yield
+            has = scans.result(hh) > 0
+            idx = jnp.where(has, scans.result(hp), 0).astype(jnp.int32)
+            return (v.gather(idx, has),)
+        return gen()
+
+    def merge_scans(self, ctx, scans, partials):
+        return self.update_scans(ctx, scans, partials)
 
     def evaluate(self, partials, schema):
         return partials[0]
@@ -473,37 +652,51 @@ class VarianceSamp(AggregateFunction):
     def result_from_intermediates(self, inter):
         return T.FLOAT64
 
-    def update(self, ctx, inputs):
+    def update_scans(self, ctx, scans, inputs):
         (v,) = inputs
-        ok = v.validity & ctx.row_valid
-        x = jnp.where(ok, v.data.astype(jnp.float64), 0.0)
-        s, c = _sorted_seg_sums(ctx, x, ok.astype(jnp.int32))
-        c = c.astype(jnp.int64)
-        mean = s / jnp.maximum(c, 1).astype(jnp.float64)
-        # second pass against the group mean: m2 = sum((x - mean)^2)
-        d = jnp.where(ok, x - jnp.take(mean, ctx.seg_ids), 0.0)
-        m2 = _sorted_seg_sum(d * d, ctx)
-        always = jnp.ones(ctx.out_capacity, bool)
-        return (ColumnVector(T.INT64, c, always),
-                ColumnVector(T.FLOAT64, mean, always),
-                ColumnVector(T.FLOAT64, m2, always))
 
-    def merge(self, ctx, partials):
+        def gen():
+            ok = v.validity & ctx.row_valid
+            x = jnp.where(ok, v.data.astype(jnp.float64), 0.0)
+            hs = scans.seg("add", x)
+            hc = scans.seg("add", ok.astype(jnp.int32))
+            yield
+            c = scans.result(hc).astype(jnp.int64)
+            mean = scans.result(hs) / \
+                jnp.maximum(c, 1).astype(jnp.float64)
+            # second round against the group mean: m2 = sum((x-mean)^2)
+            d = jnp.where(ok, x - jnp.take(mean, ctx.seg_ids), 0.0)
+            hm = scans.seg("add", d * d)
+            yield
+            always = jnp.ones(ctx.out_capacity, bool)
+            return (ColumnVector(T.INT64, c, always),
+                    ColumnVector(T.FLOAT64, mean, always),
+                    ColumnVector(T.FLOAT64, scans.result(hm), always))
+        return gen()
+
+    def merge_scans(self, ctx, scans, partials):
         c_p, mean_p, m2_p = partials
-        ok = ctx.row_valid
-        cr = jnp.where(ok, c_p.data, 0)
-        crf = cr.astype(jnp.float64)
-        c, s = _sorted_seg_sums(
-            ctx, cr, jnp.where(ok, mean_p.data * crf, 0.0))
-        mean = s / jnp.maximum(c, 1).astype(jnp.float64)
-        # Chan's parallel merge: m2 = sum_i(m2_i + c_i*(mean_i - mean)^2)
-        delta = mean_p.data - jnp.take(mean, ctx.seg_ids)
-        contrib = jnp.where(ok, m2_p.data + crf * delta * delta, 0.0)
-        m2 = _sorted_seg_sum(contrib, ctx)
-        always = jnp.ones(ctx.out_capacity, bool)
-        return (ColumnVector(T.INT64, c, always),
-                ColumnVector(T.FLOAT64, mean, always),
-                ColumnVector(T.FLOAT64, m2, always))
+
+        def gen():
+            ok = ctx.row_valid
+            cr = jnp.where(ok, c_p.data, 0)
+            crf = cr.astype(jnp.float64)
+            hc = scans.seg("add", cr)
+            hs = scans.seg("add", jnp.where(ok, mean_p.data * crf, 0.0))
+            yield
+            c = scans.result(hc)
+            mean = scans.result(hs) / \
+                jnp.maximum(c, 1).astype(jnp.float64)
+            # Chan's merge: m2 = sum_i(m2_i + c_i*(mean_i - mean)^2)
+            delta = mean_p.data - jnp.take(mean, ctx.seg_ids)
+            contrib = jnp.where(ok, m2_p.data + crf * delta * delta, 0.0)
+            hm = scans.seg("add", contrib)
+            yield
+            always = jnp.ones(ctx.out_capacity, bool)
+            return (ColumnVector(T.INT64, c, always),
+                    ColumnVector(T.FLOAT64, mean, always),
+                    ColumnVector(T.FLOAT64, scans.result(hm), always))
+        return gen()
 
     def _var(self, partials):
         c, _mean, m2 = partials

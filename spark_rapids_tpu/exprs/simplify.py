@@ -1,0 +1,105 @@
+"""Peephole expression simplification.
+
+The udf-compiler lowers `s.find(sub) >= 0` to
+`Subtract(StringLocate(sub, s, 1), 1) >= 0` (compiler.py "find"), which
+evaluates the POSITION machinery — UTF-8 char-start detection, a
+[rows, char_cap] cumsum, argmax — only to test presence.  `Contains`
+answers the same question with the match matrix alone; at q27's
+2M-review scale the difference is most of the UDF's runtime.  Spark's
+own optimizer normalizes the equivalent Catalyst shapes; the reference
+compiles `Contains` directly when the source uses it
+(udf-compiler/.../CatalystExpressionBuilder.scala analog).
+
+Rules (F = 0-based find result with -1 for absent, L = 1-based locate
+with 0 for absent; both share null semantics with Contains — null input
+propagates through the comparison and through Contains identically):
+
+  F >= 0, F > -1, F != -1   ->  Contains(s, sub)
+  F < 0, F <= -1, F == -1   ->  Not(Contains(s, sub))
+  F == 0                    ->  StartsWith(s, sub)
+  L >= 1, L > 0             ->  Contains(s, sub)
+  L < 1, L <= 0, L == 0     ->  Not(Contains(s, sub))
+  L == 1                    ->  StartsWith(s, sub)
+"""
+from __future__ import annotations
+
+from spark_rapids_tpu.exprs import arithmetic as A
+from spark_rapids_tpu.exprs import predicates as P
+from spark_rapids_tpu.exprs import string_fns as S
+from spark_rapids_tpu.exprs.base import Expression, Literal
+
+
+def _int_literal(e) -> int | None:
+    if isinstance(e, Literal) and isinstance(e.value, int) \
+            and not isinstance(e.value, bool):
+        return e.value
+    return None
+
+
+def _as_find(e):
+    """Match F (0-based find, -1 absent) or L (1-based locate, 0
+    absent) over a literal pattern with start=1; return
+    (string, pattern, absent_value)."""
+    if isinstance(e, A.Subtract) and _int_literal(e.right) == 1 \
+            and isinstance(e.left, S.StringLocate):
+        loc = e.left
+        absent = -1
+    elif isinstance(e, S.StringLocate):
+        loc = e
+        absent = 0
+    else:
+        return None
+    if not isinstance(loc.substr, Literal) or loc.substr.value is None:
+        return None
+    if loc.start is not None and _int_literal(loc.start) != 1:
+        return None
+    return loc.child, loc.substr, absent
+
+
+_FLIP = {P.GreaterThan: P.LessThan, P.GreaterThanOrEqual: P.LessThanOrEqual,
+         P.LessThan: P.GreaterThan, P.LessThanOrEqual: P.GreaterThanOrEqual,
+         P.EqualTo: P.EqualTo}
+
+
+def _simplify_one(e: Expression) -> Expression:
+    cls = type(e)
+    if cls is P.Not and isinstance(e.child, P.Not):
+        # `find(x) != -1` compiles to Not(EqualTo) and the inner rewrite
+        # yields Not(Contains); collapse the double negation
+        return e.child.child
+    if cls not in _FLIP:
+        return e
+    lhs, rhs = e.left, e.right
+    k = _int_literal(rhs)
+    if k is None:
+        # literal-on-the-left form: flip into find CMP k
+        k = _int_literal(lhs)
+        if k is None:
+            return e
+        lhs, cls = rhs, _FLIP[cls]
+    m = _as_find(lhs)
+    if m is None:
+        return e
+    s, sub, absent = m
+    contains = S.Contains(s, sub)
+    # positions are >= absent+1 when present, == absent when missing
+    if cls in (P.GreaterThan, P.GreaterThanOrEqual):
+        thr = k if cls is P.GreaterThanOrEqual else k + 1  # pos >= thr
+        if thr == absent + 1:
+            return contains
+    elif cls in (P.LessThan, P.LessThanOrEqual):
+        thr = k if cls is P.LessThanOrEqual else k - 1     # pos <= thr
+        if thr == absent:
+            return P.Not(contains)
+    elif cls is P.EqualTo:
+        if k == absent:
+            return P.Not(contains)
+        if k == absent + 1:
+            return S.StartsWith(s, sub)
+    return e
+
+
+def simplify(e: Expression) -> Expression:
+    """Bottom-up peephole pass; identity-preserving on no-ops
+    (map_children returns self when nothing changes)."""
+    return _simplify_one(e.map_children(simplify))

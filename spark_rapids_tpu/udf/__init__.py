@@ -73,7 +73,11 @@ def compile_expression(e: Expression) -> Expression:
     if isinstance(e, PythonUDF):
         compiled = compile_udf(e.fn, list(e.args))
         if compiled is not None:
-            return Cast(compiled, e.return_type)
+            # peephole the compiled body: bytecode `find(x) >= 0`
+            # shapes collapse to Contains/StartsWith (presence tests
+            # don't pay the locate position machinery)
+            from spark_rapids_tpu.exprs.simplify import simplify
+            return Cast(simplify(compiled), e.return_type)
     return e
 
 

@@ -688,3 +688,70 @@ def test_tpcxbb_q27_runs_compiled_not_fallback():
         for c in p.children:
             no_cpu_bridge(c)
     no_cpu_bridge(plan)
+
+
+def test_compiled_find_simplifies_to_contains():
+    """The peephole pass (exprs/simplify.py) collapses the compiler's
+    `find(x) CMP k` arithmetic into Contains/StartsWith — presence
+    tests must not pay StringLocate's char-position machinery
+    (UTF-8 starts + [rows, char_cap] cumsum + argmax)."""
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.exprs import string_fns as S
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.udf import compile_expression, tpu_udf
+
+    @tpu_udf(T.INT64)
+    def has_sub(s):
+        if s is None:
+            return 0
+        if s.find("needle") >= 0:
+            return 1
+        return 0
+
+    @tpu_udf(T.BOOL)
+    def not_found(s):
+        return s.find("x") == -1
+
+    @tpu_udf(T.BOOL)
+    def prefixed(s):
+        return s.find("pre") == 0
+
+    def exprs_in(e):
+        yield e
+        for c in e.children():
+            yield from exprs_in(c)
+
+    for build, want in ((has_sub, S.Contains), (not_found, S.Contains),
+                        (prefixed, S.StartsWith)):
+        compiled = compile_expression(build(col("s")))
+        kinds = [type(x) for x in exprs_in(compiled)]
+        assert want in kinds, (build.__name__, compiled)
+        assert S.StringLocate not in kinds, (build.__name__, compiled)
+
+
+def test_simplified_find_parity():
+    """Row-level parity of the simplified Contains shapes against the
+    original python UDFs, nulls included."""
+    import pandas as pd
+    from spark_rapids_tpu import types as T
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.plan import accelerate, collect
+    from spark_rapids_tpu.plan.nodes import CpuProject, CpuSource
+    from spark_rapids_tpu.udf import tpu_udf
+
+    @tpu_udf(T.INT64)
+    def flag(s):
+        if s is None:
+            return -7
+        if s.find("qu") >= 0 or s.find("val") >= 0:
+            return 1
+        return 0
+
+    vals = ["quality", "evaluate", "plain", None, "", "qval", "vaqul"]
+    df = pd.DataFrame({"s": vals})
+    plan = CpuProject([col("s"), flag(col("s")).alias("f")],
+                      CpuSource.from_pandas(df))
+    got = collect(accelerate(plan))
+    exp = [(-7 if v is None else
+            (1 if ("qu" in v or "val" in v) else 0)) for v in vals]
+    assert got["f"].astype("int64").tolist() == exp
