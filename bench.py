@@ -355,12 +355,14 @@ def bench_groupby():
         times.append(time.perf_counter() - t0)
     best = min(times)
 
-    # same plan with the fast path disabled: the general sort-based
-    # lane every non-dictionary-shaped aggregation takes
+    # same plan with the fast paths disabled: the general sort-based
+    # lane every non-Sum/Count/Average-shaped aggregation takes
+    # (bandedGroupby off too — it would otherwise take this plan)
     sconf = C.RapidsConf(
         {"spark.rapids.sql.variableFloatAgg.enabled": True,
          "spark.rapids.tpu.batchMaxRows": 1 << 22,
-         "spark.rapids.tpu.dictGroupby.enabled": False})
+         "spark.rapids.tpu.dictGroupby.enabled": False,
+         "spark.rapids.tpu.bandedGroupby.enabled": False})
     splan = accelerate(cpu_plan, sconf)
     sgot = collect(splan, sconf)
     sgot = sgot.sort_values("k", ignore_index=True)
@@ -373,6 +375,25 @@ def bench_groupby():
         collect(splan, sconf)
         stimes.append(time.perf_counter() - t0)
     sbest = min(stimes)
+
+    # banded windowed-MXU lane (dict off): the unbounded-cardinality
+    # grouper the engine takes when the key range exceeds the dict
+    # budget — variableFloatAgg-class tolerance on the f64 sums
+    bconf = C.RapidsConf(
+        {"spark.rapids.sql.variableFloatAgg.enabled": True,
+         "spark.rapids.tpu.batchMaxRows": 1 << 22,
+         "spark.rapids.tpu.dictGroupby.enabled": False})
+    bplan = accelerate(cpu_plan, bconf)
+    bgot = collect(bplan, bconf).sort_values("k", ignore_index=True)
+    assert len(bgot) == len(exp) and \
+        np.allclose(bgot["sv"].astype(float), exp["sv"], rtol=2e-3) and \
+        (bgot["c"].astype(int).to_numpy() == exp["c"].to_numpy()).all()
+    btimes = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        collect(bplan, bconf)
+        btimes.append(time.perf_counter() - t0)
+    bbest = min(btimes)
     io_bytes = rows * 24  # k i64 + v f64 + w f64
     return [{
         "metric": "groupby_sf1_rows_per_sec", "mode": "engine",
@@ -391,8 +412,17 @@ def bench_groupby():
         "value": round(rows / sbest, 1), "unit": "rows/s",
         "vs_baseline": round(pandas_time / sbest, 2),
         "effective_gbps": round(io_bytes / sbest / 1e9, 2),
-        "note": "dictGroupby disabled: the general sort-based lane "
-                "(bitonic multi-key argsort)",
+        "note": "dict+banded disabled: the general sort-based lane "
+                "(bitonic multi-key argsort + batched segmented scans)",
+    }, {
+        "metric": "groupby_sf1_banded_rows_per_sec", "mode": "engine",
+        "value": round(rows / bbest, 1), "unit": "rows/s",
+        "vs_baseline": round(pandas_time / bbest, 2),
+        "effective_gbps": round(io_bytes / bbest / 1e9, 2),
+        "note": "banded windowed-MXU lane (dict off): sort + per-block "
+                "one-hot local tables + one merge matmul; unbounded "
+                "group cardinality, exact-or-deopt ints via the "
+                "sum(|v|) certificate",
     }]
 
 

@@ -245,6 +245,17 @@ DICT_GROUPBY_MAX_GROUPS = conf(
     "mildly with range (measured: 4K groups 100ms, 16K 118ms, 64K "
     "332ms at 2M rows); 32K covers e.g. TPCx-BB q27's ~26K items "
     "while staying ~2x the 4K floor.")
+BANDED_GROUPBY_ENABLED = conf(
+    "spark.rapids.tpu.bandedGroupby.enabled", True,
+    "Sum/Count/Average group-bys aggregate through the banded windowed "
+    "MXU kernel (ops/grouped_window.py) after the grouping sort: "
+    "per-block one-hot local tables merged by one small matmul, no "
+    "serialized scatters, no positions/segmented-scan machinery — and "
+    "group count is UNBOUNDED (no dictGroupby range budget). "
+    "Accumulation is f32: integral measures are exact-or-deopt via the "
+    "sum(|v|) certificate, float measures additionally require "
+    "variableFloatAgg.enabled. Group keys of any sortable type are "
+    "recovered through first-row-index limb measures + one gather.")
 HASH_GROUPING_ENABLED = conf(
     "spark.rapids.tpu.hashGrouping.enabled", True,
     "Wide grouping key sets (aggregate GROUP BY, window PARTITION BY) "

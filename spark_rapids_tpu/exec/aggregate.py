@@ -111,6 +111,12 @@ class HashAggregateExec(UnaryExecBase):
         # once (None = never applicable for this exec)
         self._dict_qual = self._dict_plan()
         self._dict_range_misses = 0
+        # banded windowed-MXU lane: every aggregate must be expressible
+        # as per-group f32 sums (keys are unrestricted — reps travel as
+        # first-row-index limbs)
+        self._banded_qual = all(
+            type(f).__name__ in ("Sum", "Count", "Average")
+            for f in self._funcs)
         # padded dictionary width (int for a single key; tuple of
         # per-key pads for the composite multi-key path), sized from a
         # one-time first-batch range probe (None until probed)
@@ -157,6 +163,55 @@ class HashAggregateExec(UnaryExecBase):
         return wide_key_set(self._bound_groups, batch, self._child_schema,
                             self.HASH_GROUP_MIN_WORDS)
 
+    #: cap bound for the banded lane: first-row indices travel as two
+    #: 11-bit f32 limbs (exact one-hot sums), covering rows < 2^22;
+    #: f32-exact group counts need < 2^24 anyway
+    BANDED_MAX_CAP = 1 << 22
+
+    def _banded_float_measures(self, phase: str) -> bool:
+        """True when this exec+phase would put FLOATING values through
+        the f32 banded accumulator (needs the variableFloatAgg
+        tolerance; integral measures ride the exact-or-deopt
+        certificate instead)."""
+        if phase == "merge":
+            return any(t.is_floating for ts in self._inter_types
+                       for t in ts)
+        return any(e.data_type(self._child_schema).is_floating
+                   for bins in self._bound_inputs for e in bins)
+
+    def _use_banded(self, batch: ColumnarBatch, phase: str) -> bool:
+        if not self._banded_qual or \
+                getattr(self, "_banded_disabled", False):
+            return False
+        if CK.is_retrying():
+            # the deopt retry must be guaranteed-valid; certificate
+            # lanes cannot be the last resort
+            return False
+        from spark_rapids_tpu import config as C
+        conf = C.get_active_conf()
+        if not conf[C.BANDED_GROUPBY_ENABLED]:
+            return False
+        cap = batch.capacity
+        if cap % 128 or cap > self.BANDED_MAX_CAP:
+            return False
+        if self._banded_float_measures(phase) and \
+                not conf[C.VARIABLE_FLOAT_AGG]:
+            return False
+        return True
+
+    def _disable_banded(self) -> None:
+        self._banded_disabled = True
+        me = getattr(self, "_merge_exec", None)
+        if me is not None:
+            me._banded_disabled = True
+
+    def _register_banded_check(self, cert, checks: tuple) -> tuple:
+        """Deferred exactness deopt for the banded lane (None = lane
+        not taken, nothing to check)."""
+        return CK.register_deopt(cert,
+                                 f"bandedGroupby[exec {self.exec_id}]",
+                                 self._disable_banded, checks)
+
     def _disable_hash_grouping(self) -> None:
         # a 64-bit murmur3 collision between two distinct key tuples
         # (detected exactly by the in-kernel boundary/hash cross-check)
@@ -173,7 +228,9 @@ class HashAggregateExec(UnaryExecBase):
         `num_groups > wcap` as a deferred excess flag (same
         escalate-and-retry contract as _compact_groups)."""
         use_hash = self._use_hash_grouping(batch)
-        key = ("agg", phase, use_hash, wcap, batch_signature(batch))
+        use_banded = self._use_banded(batch, phase)
+        key = ("agg", phase, use_hash, use_banded, wcap,
+               batch_signature(batch))
 
         def build():
             cap = batch.capacity
@@ -197,33 +254,7 @@ class HashAggregateExec(UnaryExecBase):
                 num_groups = bounds.sum().astype(jnp.int32)
                 excess = (num_groups > out_cap) if wcap is not None \
                     else None
-                # group key representatives: first row of each segment
-                from spark_rapids_tpu.ops.sort_encode import \
-                    masked_positions
-                first_idx = masked_positions(bounds, out_cap,
-                                             fill_value=cap - 1)
-                # per-segment LAST sorted row: one before the next
-                # segment's start; the last real segment (which also
-                # absorbs trailing invalid rows' segment ids) ends at
-                # cap-1 — aggregates fill invalid rows with identities
-                nxt = jnp.concatenate(
-                    [first_idx[1:],
-                     jnp.full((1,), cap, first_idx.dtype)])
-                ends = jnp.where(jnp.arange(out_cap) >= num_groups - 1,
-                                 cap - 1, nxt - 1).astype(jnp.int32)
-                actx = AggContext(seg_ids, cap, sorted_valid, bounds,
-                                  ends, out_capacity=out_cap)
-
-                out_cols = []
                 grp_valid = jnp.arange(out_cap) < num_groups
-                # representatives via index COMPOSITION: one i32 gather
-                # (perm at first_idx) + one gather per key column — the
-                # sorted_keys detour re-gathered every key column at
-                # full cap twice (random-access streams are the
-                # dominant kernel cost at ~70ns/row on this chip)
-                rep_idx = jnp.take(perm, first_idx, mode="clip")
-                for k in keys:
-                    out_cols.append(k.gather(rep_idx, grp_valid))
 
                 if phase == "update":
                     inputs_per_f = [
@@ -247,6 +278,44 @@ class HashAggregateExec(UnaryExecBase):
                 it = iter(sorted_flat)
                 sorted_per_f = [[next(it) for _ in ins]
                                 for ins in inputs_per_f]
+
+                if use_banded:
+                    out_cols, first_idx, cert = self._banded_aggregate(
+                        phase, sorted_per_f, sorted_valid, bounds,
+                        seg_ids, grp_valid, cap, out_cap)
+                    rep_idx = jnp.take(perm, first_idx, mode="clip")
+                    key_cols = [k.gather(rep_idx, grp_valid)
+                                for k in keys]
+                    return (key_cols + out_cols, num_groups, collision,
+                            excess, cert)
+
+                # group key representatives: first row of each segment
+                from spark_rapids_tpu.ops.sort_encode import \
+                    masked_positions
+                first_idx = masked_positions(bounds, out_cap,
+                                             fill_value=cap - 1)
+                # per-segment LAST sorted row: one before the next
+                # segment's start; the last real segment (which also
+                # absorbs trailing invalid rows' segment ids) ends at
+                # cap-1 — aggregates fill invalid rows with identities
+                nxt = jnp.concatenate(
+                    [first_idx[1:],
+                     jnp.full((1,), cap, first_idx.dtype)])
+                ends = jnp.where(jnp.arange(out_cap) >= num_groups - 1,
+                                 cap - 1, nxt - 1).astype(jnp.int32)
+                actx = AggContext(seg_ids, cap, sorted_valid, bounds,
+                                  ends, out_capacity=out_cap)
+
+                out_cols = []
+                # representatives via index COMPOSITION: one i32 gather
+                # (perm at first_idx) + one gather per key column — the
+                # sorted_keys detour re-gathered every key column at
+                # full cap twice (random-access streams are the
+                # dominant kernel cost at ~70ns/row on this chip)
+                rep_idx = jnp.take(perm, first_idx, mode="clip")
+                for k in keys:
+                    out_cols.append(k.gather(rep_idx, grp_valid))
+
                 # ONE cross-function segmented scan per round (each
                 # function's operands batch into a shared _segscan —
                 # a q1-shaped aggregate ran 8 separate 2M-row scan
@@ -259,11 +328,133 @@ class HashAggregateExec(UnaryExecBase):
                         ColumnVector(o.dtype, o.data,
                                      o.validity & grp_valid,
                                      o.lengths) for o in outs)
-                return out_cols, num_groups, collision, excess
+                return out_cols, num_groups, collision, excess, None
 
             return kernel
 
         return self.kernels.get_or_build(key, build)
+
+    def _banded_aggregate(self, phase, sorted_per_f, sorted_valid,
+                          bounds, seg_ids, grp_valid, cap, out_cap):
+        """Banded windowed-MXU aggregation over the sorted rows (see
+        ops/grouped_window.py): every Sum/Count/Average measure —
+        plus two 11-bit first-row-index limbs for key recovery —
+        accumulates per group in ONE windowed kernel + merge matmul.
+        Replaces masked_positions (a second full sort at high group
+        counts), the segmented scans, and the full-width ends
+        machinery.  Returns (agg columns, first_idx, cert_flag):
+        cert_flag (device bool or None) reports an integral measure
+        whose f32 accumulation may have rounded — the caller registers
+        it as a deferred deopt (reference parity: cuDF hash groupby is
+        exact; this lane is exact-or-retry)."""
+        from spark_rapids_tpu.ops.grouped_window import window_group_sums
+        from spark_rapids_tpu.ops.pallas_kernels import _on_tpu
+
+        measures: list = []
+        specs: list = []
+        cert_ids: list = []
+
+        def add(arr) -> int:
+            measures.append(arr.astype(jnp.float32))
+            return len(measures) - 1
+
+        def value_measure(p: ColumnVector, ok):
+            """f32 measure of a column's values, zeroed where not ok;
+            prefers the i32 narrow shadow (64-bit elementwise is
+            50-100x slower on this chip)."""
+            if p.narrow is not None and not p.dtype.is_floating:
+                raw = p.narrow
+            else:
+                raw = p.data
+            v32 = raw.astype(jnp.float32)
+            return jnp.where(ok, v32, jnp.float32(0))
+
+        rv = sorted_valid
+        for f, ins, its in zip(self._funcs, sorted_per_f,
+                               self._inter_types):
+            nm = type(f).__name__
+            if nm == "Count":
+                if phase == "merge":
+                    (p,) = ins
+                    ok = p.validity & rv
+                    mi = add(value_measure(p, ok))
+                    cert_ids.append(mi)  # counts are nonnegative
+                    specs.append(("count", mi, None))
+                else:
+                    ok = rv if f.child is None \
+                        else (ins[0].validity & rv)
+                    specs.append(("count", add(ok), None))
+            elif nm == "Sum":
+                (p,) = ins
+                ok = p.validity & rv
+                mi = add(value_measure(p, ok))
+                fi = add(ok)
+                if not its[0].is_floating:
+                    cert_ids.append(add(jnp.abs(measures[mi])))
+                specs.append(("sum", mi, fi))
+            else:  # Average: intermediates (f64 sum, i64 count)
+                if phase == "merge":
+                    s_p, c_p = ins
+                    ok = rv
+                    ms = add(value_measure(s_p, ok))
+                    mc = add(value_measure(c_p, ok))
+                    cert_ids.append(mc)
+                    specs.append(("avg", ms, mc))
+                else:
+                    (p,) = ins
+                    ok = p.validity & rv
+                    mi = add(value_measure(p, ok))
+                    fi = add(ok)
+                    if not p.dtype.is_floating:
+                        cert_ids.append(add(jnp.abs(measures[mi])))
+                    specs.append(("avg", mi, fi))
+
+        isf32 = bounds.astype(jnp.float32)
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        li = add((iota & 2047).astype(jnp.float32) * isf32)
+        hi = add((iota >> 11).astype(jnp.float32) * isf32)
+
+        sums = window_group_sums(seg_ids, tuple(measures),
+                                 out_cap=out_cap, capacity=cap,
+                                 interpret=not _on_tpu())
+
+        def col(i):
+            return sums[:, i]
+
+        # exactly one first-row hit per group -> limb sums are the limb
+        # values themselves, exact in f32
+        first_idx = jnp.clip(
+            (col(li) + col(hi) * jnp.float32(2048)).astype(jnp.int32),
+            0, cap - 1)
+        cert = None
+        if cert_ids:
+            bad = jnp.zeros((), bool)
+            thresh = jnp.float32(1 << 23)
+            for ci in cert_ids:
+                bad = bad | jnp.any(
+                    jnp.where(grp_valid, col(ci), 0.0) >= thresh)
+            cert = bad
+
+        out_cols: list = []
+        for (kind, mi, fi), its in zip(specs, self._inter_types):
+            if kind == "count":
+                c = jnp.round(col(mi)).astype(jnp.int64)
+                out_cols.append(ColumnVector(T.INT64, c, grp_valid))
+            elif kind == "sum":
+                has = (col(fi) > 0) & grp_valid
+                dt = its[0]
+                if dt.is_floating:
+                    data = col(mi).astype(jnp.float64)
+                else:
+                    data = jnp.round(col(mi)).astype(jnp.int64)
+                out_cols.append(ColumnVector(dt, data, has))
+            else:  # avg: (f64 sum, i64 count)
+                out_cols.append(ColumnVector(
+                    T.FLOAT64, col(mi).astype(jnp.float64), grp_valid))
+                out_cols.append(ColumnVector(
+                    T.INT64, jnp.round(col(fi)).astype(jnp.int64),
+                    grp_valid))
+        return out_cols, first_idx, cert
 
     def _kernel_compact_cap(self, batch: ColumnarBatch) -> Optional[int]:
         """Compact group width for the kernel, or None (full-width
@@ -836,14 +1027,15 @@ class HashAggregateExec(UnaryExecBase):
                 wcap = self._kernel_compact_cap(batch)
                 kern = self._groupby_kernel(batch, phase, wcap)
                 if batch.sparse is not None:
-                    cols, n, coll, excess = kern(
+                    cols, n, coll, excess, cert = kern(
                         batch.columns, batch.num_rows_i32, batch.sparse)
                 else:
-                    cols, n, coll, excess = kern(
+                    cols, n, coll, excess, cert = kern(
                         batch.columns, batch.num_rows_i32)
                 checks = self._register_collision_check(
                     coll, batch.checks)
                 checks = self._register_excess_check(excess, wcap, checks)
+                checks = self._register_banded_check(cert, checks)
                 partials.append(
                     ColumnarBatch(inter_fields, list(cols), n, checks))
 
@@ -887,15 +1079,16 @@ class HashAggregateExec(UnaryExecBase):
         with self.metrics.timed(M.TOTAL_TIME):
             kern = merge_exec._groupby_kernel(merged, "merge", wcap)
             if merged.sparse is not None:
-                cols, n, coll, excess = kern(
+                cols, n, coll, excess, cert = kern(
                     merged.columns, merged.num_rows_i32, merged.sparse)
             else:
-                cols, n, coll, excess = kern(
+                cols, n, coll, excess, cert = kern(
                     merged.columns, merged.num_rows_i32)
         checks = merge_exec._register_collision_check(coll, merged.checks)
         # escalation is learned on the OUTER exec (the merge exec is a
         # cached internal helper; the compact policy lives with self)
         checks = self._register_excess_check(excess, wcap, checks)
+        checks = self._register_banded_check(cert, checks)
         return ColumnarBatch(inter_schema, list(cols), n, checks)
 
     def _partial_schema(self) -> T.Schema:
