@@ -23,6 +23,51 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
 
 
+def _diagnose_driver_artifact():
+    """Compare the newest driver-written MULTICHIP_r*.json against HEAD
+    so a failing driver record is attributable on its face: a record
+    with no gate fingerprint was produced by a build that predates the
+    stamped gate (r1-era code), not by HEAD."""
+    import glob
+    import re
+
+    def _round_no(p):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+    arts = sorted(glob.glob(os.path.join(ROOT, "MULTICHIP_r*.json")),
+                  key=_round_no)
+    if not arts:
+        return None
+    path = arts[-1]
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except Exception as e:
+        return {"path": os.path.basename(path), "ok": None,
+                "has_gate_fingerprint": False,
+                "verdict": f"unreadable driver record: {e}"}
+    # a stamped run carries a parsed top-level fingerprint; the tail
+    # substring is only a fallback (the 2000-char tail window can cut
+    # the fingerprint line when a long traceback follows it)
+    stamped = bool(rec.get("fingerprint")) or \
+        "gate_fingerprint" in (rec.get("tail", "") or "")
+    try:
+        head = subprocess.run(["git", "-C", ROOT, "rev-parse", "HEAD"],
+                              capture_output=True, text=True,
+                              timeout=10).stdout.strip()
+    except Exception:
+        head = ""
+    return {
+        "path": os.path.basename(path),
+        "ok": rec.get("ok"),
+        "has_gate_fingerprint": stamped,
+        "verdict": ("driver record carries no gate fingerprint -> "
+                    "produced by a pre-stamp build, predates HEAD "
+                    f"{head[:12]}" if not stamped else
+                    "driver record is fingerprint-stamped"),
+    }
+
+
 def main() -> int:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
     code = (
@@ -61,6 +106,7 @@ def main() -> int:
         "skipped": False,
         "tail": out[-2000:],
         "fingerprint": fingerprint,
+        "driver_artifact": _diagnose_driver_artifact(),
     }
     path = os.path.join(ROOT, "MULTICHIP_LOCAL.json")
     with open(path, "w") as f:
@@ -69,6 +115,11 @@ def main() -> int:
     print(f"multichip_check: ok={record['ok']} rc={rc} -> {path}")
     if fingerprint:
         print(f"multichip_check: fingerprint {fingerprint}")
+    if record["driver_artifact"]:
+        print(f"multichip_check: driver artifact "
+              f"{record['driver_artifact']['path']}: "
+              f"ok={record['driver_artifact']['ok']} — "
+              f"{record['driver_artifact']['verdict']}")
     return 0 if record["ok"] else 1
 
 
