@@ -849,30 +849,32 @@ def main():
     q1, pandas_time, batches = bench_q1_stream()
     print(json.dumps(q1), flush=True)
     subs = [q1]
-    fused = bench_q1_fused(pandas_time, batches)
-    print(json.dumps(fused), flush=True)
-    subs.append(fused)
-    del batches, fused
-    for fn in (bench_groupby, bench_groupby_dict_kernel,
-               bench_join_sort, bench_exchange_manager,
-               bench_udf_q27, bench_scale_join_groupby):
-        ms = fn()
-        for m in (ms if isinstance(ms, list) else [ms]):
-            print(json.dumps(m), flush=True)
-            subs.append(m)
+    try:
+        fused = bench_q1_fused(pandas_time, batches)
+        print(json.dumps(fused), flush=True)
+        subs.append(fused)
+    except Exception as e:
+        err = {"metric": "tpch_q1_fused_rows_per_sec", "value": 0,
+               "vs_baseline": 0,
+               "error": f"{type(e).__name__}: {e}"[:400]}
+        print(json.dumps(err), flush=True)
+        subs.append(err)
+    del batches
+
     # roofline per metric (VERDICT r4 #6): effective input-pass GB/s
     # against the measured HBM probe and nominal v5e HBM
-    for m in subs:
+    def add_roofline(m):
         g = m.get("effective_gbps")
         if g is not None:
             m["ceiling_utilization"] = round(g / hbm_probe, 4)
             m["nominal_hbm_utilization"] = round(g / V5E_HBM_GBPS, 4)
-    # driver-facing summary LAST.  The driver keeps only a 2000-char
-    # tail and parses the final line (BENCH_r03 recorded parsed:null
-    # because this line outgrew the window) — so submetrics carry the
-    # driver fields + the roofline triple (short keys: gbps /
-    # hbm_util = fraction of hbm_probe_gbps / nom_util = fraction of
-    # nominal 819 GB/s) and the line length is stepwise-shrunk.
+
+    # driver-facing summary: the driver keeps only a 2000-char tail and
+    # parses the FINAL line (BENCH_r03 recorded parsed:null because this
+    # line outgrew the window) — so submetrics carry the driver fields +
+    # the roofline triple (short keys: gbps / hbm_util = fraction of
+    # hbm_probe_gbps / nom_util = fraction of nominal 819 GB/s) and the
+    # line length is stepwise-shrunk.
     def compact_at(level: int):
         out = []
         for m in subs:
@@ -887,22 +889,51 @@ def main():
             out.append(e)
         return out
 
-    summary = {
-        "metric": q1["metric"],
-        "value": q1["value"],
-        "unit": q1["unit"],
-        "vs_baseline": q1["vs_baseline"],
-        "hbm_probe_gbps": round(hbm_probe, 1),
-    }
-    for level in (1, 2, 3):
-        summary["submetrics"] = compact_at(level)
-        line = json.dumps(summary)
-        if len(line) <= 1800:
-            break
-    if len(line) > 1800:
-        summary.pop("submetrics")
-        line = json.dumps(summary)
-    print(line)
+    def summary_line():
+        summary = {
+            "metric": q1["metric"],
+            "value": q1["value"],
+            "unit": q1["unit"],
+            "vs_baseline": q1["vs_baseline"],
+            "hbm_probe_gbps": round(hbm_probe, 1),
+        }
+        for level in (1, 2, 3):
+            summary["submetrics"] = compact_at(level)
+            line = json.dumps(summary)
+            if len(line) <= 1800:
+                break
+        if len(line) > 1800:
+            summary.pop("submetrics")
+            line = json.dumps(summary)
+        return line
+
+    for m in subs:
+        add_roofline(m)
+    # one failing bench must not zero the whole round artifact (record
+    # the failure as a metric-shaped error line and keep going), and a
+    # DRIVER-side kill mid-bench must not either: re-print the rolling
+    # summary after every bench so the final stdout line is always a
+    # complete, parseable summary of everything measured so far
+    print(summary_line(), flush=True)
+    for fn in (bench_groupby, bench_groupby_dict_kernel,
+               bench_join_sort, bench_exchange_manager,
+               bench_udf_q27, bench_scale_join_groupby):
+        try:
+            ms = fn()
+        except Exception as e:
+            import traceback
+            traceback.print_exc()
+            err = {"metric": fn.__name__, "value": 0, "vs_baseline": 0,
+                   "error": f"{type(e).__name__}: {e}"[:400]}
+            print(json.dumps(err), flush=True)
+            subs.append(err)
+            print(summary_line(), flush=True)
+            continue
+        for m in (ms if isinstance(ms, list) else [ms]):
+            add_roofline(m)
+            print(json.dumps(m), flush=True)
+            subs.append(m)
+        print(summary_line(), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,91 @@
+"""Stage-level timing of the 200K-key group-by through the engine:
+what fills the gap between raw kernel cost (~160ms) and engine collect
+(~390ms)?  Times each jitted kernel invocation with a hard sync, then
+the full collect, then collect with a patched no-op dense()/prefetch to
+isolate host-exit costs."""
+import time
+
+import numpy as np
+
+
+def sync(x):
+    import jax
+    jax.block_until_ready(x)
+    return x
+
+
+def t(label, fn, n=3):
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label:52s} {best*1e3:9.1f} ms")
+
+
+def main():
+    import pandas as pd
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exprs.aggregates import Average, Count, Sum
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.plan import CpuAggregate, CpuSource, accelerate, collect
+
+    rng = np.random.default_rng(7)
+    rows, n_keys = 1 << 21, 200_000
+    df = pd.DataFrame({
+        "k": rng.integers(0, n_keys, rows).astype(np.int64),
+        "v": rng.uniform(0, 100, rows),
+    })
+    src = CpuSource.from_pandas(df, num_partitions=1)
+    cpu_plan = CpuAggregate(
+        [col("k")],
+        [Sum(col("v")).alias("sv"), Count(col("v")).alias("c"),
+         Average(col("v")).alias("av")], src)
+    conf = C.RapidsConf(
+        {"spark.rapids.sql.variableFloatAgg.enabled": True})
+    plan = accelerate(cpu_plan, conf)
+    with C.session(conf):
+        out = plan.collect()
+    print("plan:", plan.describe() if hasattr(plan, "describe") else plan)
+
+    def full():
+        with C.session(conf):
+            plan.collect()
+    t("engine collect -> batch (no to_pandas)", full)
+
+    def full_pd():
+        with C.session(conf):
+            plan.collect().to_pandas()
+    t("engine collect + to_pandas", full_pd)
+
+    # walk the plan: time each exec's process_partition output with sync
+    execs = []
+    p = plan
+    while p is not None:
+        execs.append(p)
+        ch = getattr(p, "children", None) or []
+        p = ch[0] if ch else None
+    print("exec chain:", [type(e).__name__ for e in execs])
+
+    # cumulative: sync after each stage boundary from the source up
+    from spark_rapids_tpu.exec.base import TpuExec
+    for i in range(len(execs) - 1, -1, -1):
+        e = execs[i]
+        if not isinstance(e, TpuExec):
+            continue
+
+        def run_to(e=e):
+            with C.session(conf):
+                outs = []
+                for it in e.execute_partitions():
+                    for b in it:
+                        outs.append(b.columns[0].data)
+                sync(outs)
+        try:
+            t(f"cumulative through {type(e).__name__}", run_to)
+        except Exception as ex:
+            print(f"  {type(e).__name__}: {type(ex).__name__}: {ex}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""Diagnostic: where does q27's engine time go at 2M rows / 200K items?
+
+Times each stage separately (forcing a device sync between stages via a
+tiny readback) and an isolated 200K-key group-by through each aggregate
+lane.  Not a recorded bench — a profiling aid for the round-5 udf_q27
+work (VERDICT r4 #4).
+"""
+import time
+
+import numpy as np
+
+
+def sync(x):
+    import jax
+    jax.block_until_ready(x)
+    return x
+
+
+def t(label, fn, n=3):
+    best = float("inf")
+    out = None
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    print(f"{label:48s} {best*1e3:9.1f} ms")
+    return out
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exec.base import TpuExec
+    from spark_rapids_tpu.models import tpcxbb
+    from spark_rapids_tpu.models.data_util import make_sources
+    from spark_rapids_tpu.plan import accelerate, collect
+
+    rng = np.random.default_rng(21)
+    n_reviews = 1 << 21
+    rv = tpcxbb.gen_reviews(rng, n_reviews, n_reviews // 10,
+                            n_reviews // 4)
+    t0 = time.perf_counter()
+    srcs = make_sources({"product_reviews": rv},
+                        {"product_reviews": tpcxbb.REVIEWS_SCHEMA}, 2)
+    print(f"make_sources (host->device upload): "
+          f"{(time.perf_counter()-t0)*1e3:.0f} ms")
+    conf = C.RapidsConf(
+        {"spark.rapids.sql.variableFloatAgg.enabled": True})
+    plan = accelerate(tpcxbb.QUERIES["q27"](srcs, lambda p: None), conf)
+    assert isinstance(plan, TpuExec)
+    collect(plan, conf)  # warm
+    t("q27 end-to-end (engine collect)", lambda: collect(plan, conf))
+
+    # per-exec metric breakdown from the last run
+    def walk(p, depth=0):
+        ms = p.metrics.as_dict() if hasattr(p, "metrics") else {}
+        tot = ms.get("total time", 0)
+        print(f"  {'  '*depth}{type(p).__name__:36s} "
+              f"{tot*1e3 if tot else 0:8.1f} ms  {ms}")
+        for c in getattr(p, "children", []) or []:
+            walk(c, depth + 1)
+    walk(plan)
+
+    # ---- isolated 200K-key group-by at 2M rows, per lane ----
+    from spark_rapids_tpu.exprs.aggregates import Average, Count, Sum
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.plan import CpuAggregate, CpuSource
+    import pandas as pd
+
+    rows, n_keys = 1 << 21, 200_000
+    df = pd.DataFrame({
+        "k": rng.integers(0, n_keys, rows).astype(np.int64),
+        "v": rng.uniform(0, 100, rows),
+    })
+    src = CpuSource.from_pandas(df, num_partitions=1)
+    cpu_plan = CpuAggregate(
+        [col("k")],
+        [Sum(col("v")).alias("sv"), Count(col("v")).alias("c"),
+         Average(col("v")).alias("av")], src)
+    for name, extra in (
+            ("agg 200K keys: default lanes", {}),
+            ("agg 200K keys: sort lane",
+             {"spark.rapids.tpu.dictGroupby.enabled": False,
+              "spark.rapids.tpu.bandedGroupby.enabled": False}),
+            ("agg 200K keys: banded lane",
+             {"spark.rapids.tpu.dictGroupby.enabled": False}),
+    ):
+        lconf = C.RapidsConf(
+            {"spark.rapids.sql.variableFloatAgg.enabled": True, **extra})
+        lplan = accelerate(cpu_plan, lconf)
+        collect(lplan, lconf)  # warm + compile
+        t(name, lambda p=lplan, c=lconf: collect(p, c))
+
+    tp = t("pandas same groupby",
+           lambda: df.groupby("k").agg(sv=("v", "sum"), c=("v", "size"),
+                                       av=("v", "mean")))
+
+    # ---- raw kernel costs at this shape ----
+    from spark_rapids_tpu.ops.sort_encode import sort_with_bounds
+    from spark_rapids_tpu.columnar.vector import ColumnVector
+    from spark_rapids_tpu import types as T
+
+    k64 = jnp.asarray(df["k"].to_numpy())
+    k32 = k64.astype(jnp.int32)
+    v32 = jnp.asarray(df["v"].to_numpy(), jnp.float32)
+    mask = jnp.ones((rows,), bool)
+
+    @jax.jit
+    def just_sort(kk, m):
+        kc = ColumnVector(T.INT64, kk.astype(jnp.int64), m,
+                          narrow=kk.astype(jnp.int32))
+        perm, sv, bounds, _ = sort_with_bounds([(kc, True, True)], m)
+        return perm, sv, bounds
+
+    sync(just_sort(k32, mask))
+    t("sort_with_bounds 2M i64(narrow i32) keys",
+      lambda: sync(just_sort(k32, mask)))
+
+    from jax import lax
+
+    @jax.jit
+    def payload_sort(kk, v, m):
+        return lax.sort([kk.astype(jnp.uint32), v, m], num_keys=1,
+                        is_stable=True)
+
+    sync(payload_sort(k32, v32, mask))
+    t("bare u32 payload sort (1 f32 + mask payload)",
+      lambda: sync(payload_sort(k32, v32, mask)))
+
+    from spark_rapids_tpu.ops.grouped_window import window_group_sums
+
+    @jax.jit
+    def banded_window(kk, v, m):
+        # pretend sorted: seg ids from adjacent-diff boundaries
+        bounds = jnp.concatenate(
+            [jnp.ones((1,), bool), kk[1:] != kk[:-1]])
+        seg = jnp.cumsum(bounds.astype(jnp.int32)) - 1
+        return window_group_sums(seg, (v, m.astype(jnp.float32)),
+                                 out_cap=1 << 18, capacity=rows)
+
+    ks = jnp.sort(k32)
+    sync(banded_window(ks, v32, mask))
+    t("window_group_sums (2 measures, 256K out cap)",
+      lambda: sync(banded_window(ks, v32, mask)))
+
+    print(f"\npandas reference: {tp if tp is not None else ''}")
+
+
+if __name__ == "__main__":
+    main()

@@ -344,7 +344,8 @@ def empty_batch(schema: T.Schema) -> ColumnarBatch:
     return ColumnarBatch(schema, cols, 0)
 
 
-def concat_batches(batches: list[ColumnarBatch]) -> ColumnarBatch:
+def concat_batches(batches: list[ColumnarBatch],
+                   sparse_ok: bool = False) -> ColumnarBatch:
     """Device-side concat (reference `Table.concatenate`,
     `GpuCoalesceBatches.scala:53`): stack padded columns then gather the
     valid rows of each input into a fresh bucketed batch.
@@ -352,10 +353,20 @@ def concat_batches(batches: list[ColumnarBatch]) -> ColumnarBatch:
     When any input's row count is still a device scalar, the gather
     indices are computed DEVICE-SIDE (no sync): output capacity is then
     the bucketed sum of input CAPACITIES (the static worst case) and the
-    output row count stays lazy."""
+    output row count stays lazy.
+
+    `sparse_ok=True` (callers whose consumer takes deferred-selection
+    batches — the aggregate merge kernel, collect's final dense):
+    sparse inputs skip their per-input dense() gathers entirely — padded
+    columns and selection masks are stacked as-is and the result stays
+    sparse, so the whole concat is sequential copies (bandwidth-bound)
+    instead of two random-access gather rounds (~70ns/row each on this
+    chip)."""
     assert batches
     if len(batches) == 1:
         return batches[0]
+    if sparse_ok and any(b.sparse is not None for b in batches):
+        return _concat_sparse(batches)
     batches = [b.dense() for b in batches]
     schema = batches[0].schema
     checks = tuple(c for b in batches for c in b.checks)
@@ -401,6 +412,42 @@ def _stack_columns(batches, schema):
                   if all(v.narrow is not None for v in vecs) else None)
         out_cols.append((data, validity, lengths, narrow))
     return out_cols
+
+
+def _concat_sparse(batches) -> ColumnarBatch:
+    """Gather-free concat: stack each input's padded columns and its
+    selection mask; the output batch keeps capacity = bucketed sum of
+    input capacities with selection still deferred.  Compaction, if a
+    consumer needs it, costs the same single gather round dense() always
+    costs — so this path strictly saves the per-input dense gathers."""
+    schema = batches[0].schema
+    checks = tuple(c for b in batches for c in b.checks)
+    scap = sum(b.capacity for b in batches)
+    cap = bucket_capacity(scap)
+    pad = cap - scap
+    masks = [b.sparse if b.sparse is not None else b.row_mask()
+             for b in batches]
+    if pad:
+        masks.append(jnp.zeros((pad,), bool))
+    mask = jnp.concatenate(masks)
+    total = sum(b.num_rows for b in batches) \
+        if all(b.num_rows_known for b in batches) else \
+        jnp.sum(jnp.stack([b.num_rows_i32 for b in batches]))
+
+    def pad_tail(arr, fill=0):
+        if not pad or arr is None:
+            return arr
+        tail_shape = (pad,) + arr.shape[1:]
+        return jnp.concatenate(
+            [arr, jnp.full(tail_shape, fill, arr.dtype)])
+
+    out_cols = []
+    for (data, validity, lengths, narrow), f in zip(
+            _stack_columns(batches, schema), schema.fields):
+        out_cols.append(ColumnVector(
+            f.dtype, pad_tail(data), pad_tail(validity, False),
+            pad_tail(lengths), pad_tail(narrow)))
+    return ColumnarBatch(schema, out_cols, total, checks, sparse=mask)
 
 
 def _concat_lazy(batches, schema, checks):

@@ -1073,7 +1073,9 @@ class HashAggregateExec(UnaryExecBase):
         return me
 
     def _merge_partials(self, partials, inter_schema) -> ColumnarBatch:
-        merged = concat_batches(partials)
+        # sparse_ok: the merge kernel takes a deferred-selection mask,
+        # so the concat can stay gather-free
+        merged = concat_batches(partials, sparse_ok=True)
         merge_exec = self._get_merge_exec(inter_schema)
         wcap = self._kernel_compact_cap(merged)
         with self.metrics.timed(M.TOTAL_TIME):

@@ -298,7 +298,9 @@ class TpuExec:
         batches = list(self.execute_columnar())
         if not batches:
             return empty_batch(self.output_schema())
-        return concat_batches(batches)
+        # sparse_ok: collect() densifies right after, so the concat can
+        # skip per-input compaction gathers — one gather round total
+        return concat_batches(batches, sparse_ok=True)
 
     def to_pandas(self):
         return self.collect().to_pandas()

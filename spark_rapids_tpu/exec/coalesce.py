@@ -15,6 +15,13 @@ from spark_rapids_tpu.exec.base import (
 from spark_rapids_tpu.utils import metrics as M
 
 
+#: a lazy (deferred-selection) batch passes through coalesce un-sliced
+#: while its capacity is within this multiple of the row cap — bounded
+#: so row-exploding join/expand outputs still slice (their downstream
+#: compile cost is what the split pipeline contains)
+LAZY_PASS_MULT = 8
+
+
 def coalesce_iterator(batches: Iterator[ColumnarBatch],
                       goal: CoalesceGoal,
                       schema: T.Schema,
@@ -53,11 +60,23 @@ def coalesce_iterator(batches: Iterator[ColumnarBatch],
         # lazy slicing: materializing every slice up front would hold a
         # second full copy of an oversized batch on device at once
         # lazy batches are sized by CAPACITY (a safe upper bound on
-        # rows) so accumulation stays sync-free; only a lazy batch whose
-        # capacity exceeds the row cap forces a count sync to slice
-        big_rows = (big.num_rows if big.num_rows_known or
-                    big.capacity > max_rows else big.capacity)
-        pieces = ((big,) if big_rows <= max_rows else
+        # rows) so accumulation stays sync-free.  A lazy batch whose
+        # capacity moderately exceeds the row cap passes through WHOLE:
+        # its memory is already allocated (slicing duplicates, not
+        # frees), every exec consumes deferred-selection batches, and
+        # the sync (~150ms tunnel round trip) + two gather rounds per
+        # batch dominated post-filter pipelines (q27 paid 13 syncs +
+        # ~450ms here).  Only a cap past LAZY_PASS_MULT x the row cap —
+        # the row-exploding join/expand shapes whose downstream compile
+        # cost the bounded split pipeline exists to contain — pays the
+        # count sync and slices.
+        lazy_bounded = (not big.num_rows_known and
+                        big.capacity <= LAZY_PASS_MULT * max_rows)
+        # reading num_rows on a lazy batch is a count SYNC — only the
+        # must-slice shape (lazy + cap past the pass-through bound) pays
+        # it; per-piece accounting below recomputes its own size
+        big_rows = big.num_rows if not lazy_bounded else None
+        pieces = ((big,) if lazy_bounded or big_rows <= max_rows else
                   (big.slice(lo, min(max_rows, big.num_rows - lo))
                    for lo in range(0, big.num_rows, max_rows)))
         for b in pieces:

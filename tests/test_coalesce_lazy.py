@@ -1,0 +1,92 @@
+"""Coalesce lazy pass-through + sparse concat (round-5 perf work).
+
+A deferred-selection batch whose capacity is within LAZY_PASS_MULT x the
+row cap must flow through coalesce untouched — no count sync, no slice
+gathers (q27 paid 13 syncs + ~450ms here).  Oversized lazy batches (the
+row-exploding join shapes) must still slice.  concat_batches(sparse_ok)
+must skip per-input compaction and keep selection deferred.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch, concat_batches
+from spark_rapids_tpu.exec.coalesce import (
+    LAZY_PASS_MULT, coalesce_iterator)
+from spark_rapids_tpu.exec.base import TargetSize
+from spark_rapids_tpu.utils.metrics import MetricSet
+
+
+def _sparse_batch(n, cap, keep_mod=3, seed=0):
+    rng = np.random.default_rng(seed)
+    data = {"k": rng.integers(0, 50, n).astype(np.int64),
+            "v": rng.uniform(0, 1, n)}
+    b = ColumnarBatch.from_numpy(data, capacity=cap)
+    mask = (jnp.arange(cap) < n) & (jnp.arange(cap) % keep_mod == 0)
+    return ColumnarBatch(b.schema, b.columns, None, (), sparse=mask), data
+
+
+def test_lazy_bounded_batch_passes_through_unsliced():
+    cap, max_rows = 256, 64
+    assert cap <= LAZY_PASS_MULT * max_rows
+    b, _ = _sparse_batch(200, cap)
+    out = list(coalesce_iterator(iter([b]), TargetSize(1 << 30),
+                                 b.schema, MetricSet(),
+                                 max_rows=max_rows))
+    assert len(out) == 1
+    # identity pass-through: same object, selection still deferred,
+    # row count never synced
+    assert out[0] is b
+    assert out[0].sparse is not None
+    assert not out[0].num_rows_known
+
+
+def test_lazy_oversized_batch_still_slices():
+    cap, max_rows = 4096, 16
+    assert cap > LAZY_PASS_MULT * max_rows
+    b, data = _sparse_batch(3000, cap, keep_mod=2, seed=1)
+    out = list(coalesce_iterator(iter([b]), TargetSize(1),
+                                 b.schema, MetricSet(),
+                                 max_rows=max_rows))
+    assert len(out) > 1
+    got = pd.concat([o.to_pandas() for o in out], ignore_index=True)
+    exp_keep = np.arange(3000) % 2 == 0
+    np.testing.assert_array_equal(got["k"].to_numpy(),
+                                  data["k"][exp_keep])
+
+
+def test_concat_sparse_skips_compaction_and_matches_dense():
+    b1, d1 = _sparse_batch(100, 128, keep_mod=2, seed=2)
+    # second input DENSE with known rows
+    b2 = ColumnarBatch.from_numpy(
+        {"k": np.arange(40, dtype=np.int64),
+         "v": np.linspace(0, 1, 40)})
+    merged = concat_batches([b1, b2], sparse_ok=True)
+    assert merged.sparse is not None        # selection still deferred
+    got = merged.to_pandas()
+    exp_k = np.concatenate([d1["k"][(np.arange(100) % 2) == 0],
+                            np.arange(40)])
+    np.testing.assert_array_equal(got["k"].to_numpy(), exp_k)
+    # plain concat (sparse_ok=False) agrees
+    ref = concat_batches([b1, b2]).to_pandas()
+    pd.testing.assert_frame_equal(got, ref)
+
+
+def test_concat_sparse_with_strings():
+    schema = T.Schema.of(("s", T.STRING), ("x", T.INT64))
+    b1 = ColumnarBatch.from_numpy(
+        {"s": np.array(["aa", "bb", "cc", "dd"], object),
+         "x": np.arange(4, dtype=np.int64)}, schema)
+    mask = jnp.asarray([True, False, True, False] +
+                       [False] * (b1.capacity - 4))
+    b1 = ColumnarBatch(b1.schema, b1.columns, None, (), sparse=mask)
+    b2 = ColumnarBatch.from_numpy(
+        {"s": np.array(["long-string-value", "e"], object),
+         "x": np.array([7, 8], np.int64)}, schema)
+    merged = concat_batches([b1, b2], sparse_ok=True)
+    got = merged.to_pandas()
+    assert got["s"].tolist() == ["aa", "cc", "long-string-value", "e"]
+    assert got["x"].tolist() == [0, 2, 7, 8]
