@@ -572,15 +572,13 @@ def bench_exchange_manager():
         "value": round(rows / best, 1), "unit": "rows/s",
         "vs_baseline": round(pandas_time / best, 2),
         "effective_gbps": round(rows * 16 / best / 1e9, 2),
-        "note": "round 4: counting-sort partition reorder (one-hot "
-                "cumsum + unique-index inversion scatter, ~5x over the "
-                "stable argsort), i32 murmur3 over the narrow shadow, "
-                "grouped-stream reorder gathers (ONE stacked [cap,k] "
-                "gather per width class — random access costs per ROW, "
-                "not per byte). Remaining cost split at 4M rows: "
-                "murmur3 ~114ms + counting order ~202ms + 2 gather "
-                "streams ~250ms; pure data movement is random-access "
-                "latency-bound on this tunnel-attached chip.",
+        "note": "round 5: ONE payload-carrying sort network "
+                "(partitioning._payload_sort_reorder) — every column "
+                "stream rides the u32 pid sort as a payload operand, "
+                "replacing the round-4 counting-sort-ranks + per-stream "
+                "gather waves (random access costs ~70ns/row on this "
+                "chip; sort-network payload operands are near-free). "
+                "i32 murmur3 over the narrow shadow unchanged.",
     }
 
 
@@ -689,15 +687,15 @@ def bench_udf_q27():
         "effective_gbps": round(ubytes / best / 1e9, 2),
         "note": "TPCx-BB q27 via the udf-compiler (compiled Python "
                 "sentiment/extraction UDF on TPU; reference Q27Like "
-                "throws 'uses UDF'). Where the time goes (profiled): "
-                "at the old 262K-row point the query was FIXED-COST "
-                "bound — ~150ms of device work spread over ~250 small "
-                "dispatches plus one ~146ms sync wave; at this 2M/200K"
-                "-item point it is bound by the 200K-group partial "
-                "aggregation: the grouping sort plus group-compaction, "
-                "whose top_k at k=256K degenerated toward a full sort "
-                "until masked_positions switched to a flat-cost "
-                "payload-sort lane past 32K groups.",
+                "throws 'uses UDF'). Where the time goes (profiled per "
+                "plan subtree, round 5): the post-HAVING "
+                "CoalesceBatchesExec used to pay 13 count syncs + two "
+                "gather rounds (~450ms of the old 945ms) dense-slicing "
+                "deferred-selection batches; lazy pass-through removed "
+                "it entirely. Remaining ~550ms: compiled-UDF string "
+                "kernels ~105ms, 200K-group partial agg ~85ms, "
+                "exchange ~100ms, final agg ~130ms, filter+top100 "
+                "~70ms, collect boundary ~60ms.",
     }
 
 
@@ -729,6 +727,18 @@ def bench_scale_join_groupby():
     from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
     from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
 
+    import os
+    import sys
+
+    def phase(label, _t=[time.perf_counter()]):
+        """Env-gated phase timing (SPARK_RAPIDS_TPU_BENCH_PHASES=1) —
+        stderr so the driver-parsed stdout stays clean."""
+        now = time.perf_counter()
+        if os.environ.get("SPARK_RAPIDS_TPU_BENCH_PHASES"):
+            print(f"[scale-phase] {label}: +{now - _t[0]:.1f}s",
+                  file=sys.stderr, flush=True)
+        _t[0] = now
+
     n_li = SCALE_LI_BATCH * SCALE_LI_BATCHES
     n_ord, n_cust, n_parts = 1 << 22, 1 << 17, 4
     rng = np.random.default_rng(77)
@@ -737,6 +747,7 @@ def bench_scale_join_groupby():
     # host-generated once, uploaded batch-wise (the q1 pattern)
     lk = rng.integers(0, n_ord, n_li).astype(np.int64)
     lv = rng.uniform(1.0, 2.0, n_li)
+    phase("datagen")
     li_parts = []
     for i in range(SCALE_LI_BATCHES):
         s = slice(i * SCALE_LI_BATCH, (i + 1) * SCALE_LI_BATCH)
@@ -751,6 +762,7 @@ def bench_scale_join_groupby():
 
     conf = C.RapidsConf({"spark.rapids.shuffle.enabled": True,
                          "spark.rapids.tpu.batchMaxRows": SCALE_LI_BATCH})
+    phase("upload (from_numpy x%d)" % (SCALE_LI_BATCHES + 1))
 
     from spark_rapids_tpu.exec.base import UnaryExecBase
 
@@ -793,10 +805,20 @@ def bench_scale_join_groupby():
 
     # asserted-spill pass: reducers must read host-tier buffers and
     # stay exact
+    phase("plan build")
+    # warm pass FIRST (untimed, no spill): compiles + the deopt-retry
+    # ladder's learned compact widths happen here.  Without it the
+    # asserted-spill pass is the exec's first collect and pays 2-3 full
+    # re-executions (each re-spilling the map outputs through the
+    # ~30MB/s tunnel D2H path) — measured 338s vs 8s at 16.8M rows.
+    with C.session(conf):
+        agg.collect()
+    phase("warm pass (compiles + learned widths)")
     SpillTap.enabled = True
     with C.session(conf):
         got = agg.collect().to_pandas()
     SpillTap.enabled = False
+    phase("asserted-spill pass")
     spilled = SpillTap.spilled
     assert spilled > 0, "no device->host spill occurred"
     cust_sums = np.zeros(n_cust)
@@ -808,11 +830,13 @@ def bench_scale_join_groupby():
                                cust_sums, rtol=1e-9)
     np.testing.assert_array_equal(
         got["n"].to_numpy(dtype=np.int64), exp_n)
+    phase("correctness checks")
 
     def engine_run():
         with C.session(conf):
             agg.collect().to_pandas()
     best = _best_of(engine_run, 2)
+    phase("engine timed passes x2")
 
     ldf = pd.DataFrame({"l_orderkey": lk, "l_revenue": lv})
     odf = pd.DataFrame({"o_orderkey": ok, "o_custkey": oc})
@@ -822,6 +846,7 @@ def bench_scale_join_groupby():
         return m.groupby("o_custkey").agg(rev=("l_revenue", "sum"),
                                          n=("l_revenue", "size"))
     pandas_time = _best_of(pandas_run, 1)
+    phase("pandas pass")
     return {
         "metric": "scale_join_groupby_rows_per_sec", "mode": "engine",
         "value": round(n_li / best, 1), "unit": "rows/s",

@@ -76,9 +76,16 @@ def coalesce_iterator(batches: Iterator[ColumnarBatch],
         # must-slice shape (lazy + cap past the pass-through bound) pays
         # it; per-piece accounting below recomputes its own size
         big_rows = big.num_rows if not lazy_bounded else None
-        pieces = ((big,) if lazy_bounded or big_rows <= max_rows else
-                  (big.slice(lo, min(max_rows, big.num_rows - lo))
-                   for lo in range(0, big.num_rows, max_rows)))
+        if lazy_bounded or big_rows <= max_rows:
+            pieces = (big,)
+        else:
+            # densify ONCE before slicing: ColumnarBatch.slice on a
+            # sparse batch would re-run the full-capacity compaction
+            # gather per slice
+            dense_big = big.dense()
+            pieces = (dense_big.slice(lo, min(max_rows,
+                                              dense_big.num_rows - lo))
+                      for lo in range(0, dense_big.num_rows, max_rows))
         for b in pieces:
             b_rows = (b.num_rows if b.num_rows_known else b.capacity)
             est = _row_bytes(b) * b_rows
@@ -115,8 +122,11 @@ def _rebucket(b: ColumnarBatch) -> ColumnarBatch:
 
 
 def _emit(pending: list[ColumnarBatch], metrics) -> ColumnarBatch:
-    out = concat_batches(pending) if len(pending) > 1 else \
-        _rebucket(pending[0])
+    # sparse_ok: the single-batch pass-through path already hands
+    # deferred-selection batches to the same downstream consumers, so
+    # the merged batch may stay sparse too (no per-input dense gathers)
+    out = concat_batches(pending, sparse_ok=True) if len(pending) > 1 \
+        else _rebucket(pending[0])
     metrics.add(M.NUM_OUTPUT_BATCHES, 1)
     metrics.add(M.NUM_OUTPUT_ROWS, out._rows)
     return out
