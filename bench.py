@@ -845,7 +845,10 @@ def bench_scale_join_groupby():
         m = ldf.merge(odf, left_on="l_orderkey", right_on="o_orderkey")
         return m.groupby("o_custkey").agg(rev=("l_revenue", "sum"),
                                          n=("l_revenue", "size"))
-    pandas_time = _best_of(pandas_run, 1)
+    # best-of-2 like the engine side (same fix q1 got): a single pandas
+    # pass inflates vs_baseline in the favorable direction whenever the
+    # first pass eats a cold page-cache/allocator warmup
+    pandas_time = _best_of(pandas_run, 2)
     phase("pandas pass")
     return {
         "metric": "scale_join_groupby_rows_per_sec", "mode": "engine",

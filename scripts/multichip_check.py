@@ -61,9 +61,16 @@ def _diagnose_driver_artifact():
         "path": os.path.basename(path),
         "ok": rec.get("ok"),
         "has_gate_fingerprint": stamped,
-        "verdict": ("driver record carries no gate fingerprint -> "
-                    "produced by a pre-stamp build, predates HEAD "
-                    f"{head[:12]}" if not stamped else
+        # a missing fingerprint is AMBIGUOUS — do not assert one cause
+        "verdict": ("driver record carries no gate fingerprint; one of: "
+                    "(a) pre-stamp build — the record predates the "
+                    f"stamped gate at HEAD {head[:12]}; (b) the run "
+                    "crashed before reaching the mesh step that prints "
+                    "the fingerprint; (c) the 2000-char tail window "
+                    "truncated the fingerprint line behind a long "
+                    "traceback.  Compare the record's git_sha/utc and "
+                    "whether its tail ends mid-traceback to tell which."
+                    if not stamped else
                     "driver record is fingerprint-stamped"),
     }
 

@@ -20,6 +20,19 @@ run_fast() {
   "${PYTEST[@]}" tests/ -m "not slow" --ignore=tests/test_workloads.py
   echo "== workload parity (TPC-H / TPC-DS / TPCx-BB / Mortgage) =="
   "${PYTEST[@]}" tests/test_workloads.py
+  run_oom_soak
+}
+
+run_oom_soak() {
+  # the retry/split/fallback lattice must run on EVERY suite invocation,
+  # not just when a real TPU OOMs: seeded reservation fault injection +
+  # a tiny accounted HBM budget (conf overrides inside the suite) drive
+  # spill, batch splitting, floor fallback, and the semaphore
+  # release/reacquire path on the CPU mesh.  OOM_SOAK=1 widens the
+  # seed sweep beyond the default single pass.
+  echo "== OOM soak lane (seeded reservation fault injection, tiny HBM budget) =="
+  SPARK_RAPIDS_TPU_OOM_SOAK="${SPARK_RAPIDS_TPU_OOM_SOAK:-1}" \
+    "${PYTEST[@]}" tests/test_oom_retry.py -m "not slow"
 }
 
 run_slow() {
@@ -47,6 +60,7 @@ case "$TIER" in
   slow)  run_slow ;;
   shims) run_shims ;;
   bench) run_bench ;;
+  oom)   run_oom_soak ;;
   all)   run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [gate|fast|slow|shims|bench|all]" >&2; exit 2 ;;
+  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|all]" >&2; exit 2 ;;
 esac

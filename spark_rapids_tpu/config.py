@@ -116,6 +116,34 @@ PINNED_POOL_SIZE = conf("spark.rapids.memory.pinnedPool.size", 0,
                         "Pinned host staging pool bytes (0 = disabled).")
 HBM_DEBUG = conf("spark.rapids.memory.gpu.debug", "NONE",
                  "Arena allocation debug logging: NONE, STDOUT, STDERR.")
+RETRY_MIN_SPLIT_ROWS = conf(
+    "spark.rapids.memory.retry.minSplitRows", 1024,
+    "Floor for OOM split-and-retry: a batch at or below this many rows "
+    "is not subdivided further; reservation failure there takes the "
+    "retry.fallback path instead (memory/retry.py harness; the role of "
+    "the reference's SplitAndRetryOOM minimum split size).")
+RETRY_FALLBACK = conf(
+    "spark.rapids.memory.retry.fallback", "bestEffort",
+    "What happens when a batch at the minimum split size still cannot "
+    "be reserved: bestEffort runs it unreserved (the accounted arena "
+    "is advisory; a true device OOM surfaces as an XLA allocation "
+    "error), error fails the query with an actionable message.  Never "
+    "a silent wrong answer.")
+OOM_INJECT_RATE = conf(
+    "spark.rapids.memory.faultInjection.oomRate", 0.0,
+    "TEST ONLY: probability that a device-memory reservation is forced "
+    "to fail, exercising the spill -> retry -> split-and-retry -> "
+    "floor-fallback lattice on CPU CI without a real HBM-sized "
+    "workload (the memory-layer sibling of the shuffle transport "
+    "fault injector).  0 disables injection.")
+OOM_INJECT_SEED = conf(
+    "spark.rapids.memory.faultInjection.seed", 0,
+    "Deterministic seed for OOM fault injection.")
+OOM_INJECT_MAX = conf(
+    "spark.rapids.memory.faultInjection.maxInjections", 1024,
+    "Hard cap on injected reservation failures per injector lifetime, "
+    "guaranteeing forward progress in soak loops even at oomRate=1.0 "
+    "(0 = unlimited).")
 
 # --- I/O formats (reference RapidsConf.scala format enables + Spark's
 # spark.sql.files.* split planning keys) --------------------------------------

@@ -1013,31 +1013,18 @@ class HashAggregateExec(UnaryExecBase):
             yield from self._reduction_path(batches)
             return
 
-        phase = "merge" if self.mode == AggMode.FINAL else "update"
         inter_fields = self._partial_schema()
         partials: list[ColumnarBatch] = []
         for batch in batches:
             if not batch.maybe_nonempty():
                 continue
             with self.metrics.timed(M.TOTAL_TIME):
-                fast = self._dict_groupby_batch(batch)
-                if fast is not None:
-                    partials.append(fast)
-                    continue
-                wcap = self._kernel_compact_cap(batch)
-                kern = self._groupby_kernel(batch, phase, wcap)
-                if batch.sparse is not None:
-                    cols, n, coll, excess, cert = kern(
-                        batch.columns, batch.num_rows_i32, batch.sparse)
-                else:
-                    cols, n, coll, excess, cert = kern(
-                        batch.columns, batch.num_rows_i32)
-                checks = self._register_collision_check(
-                    coll, batch.checks)
-                checks = self._register_excess_check(excess, wcap, checks)
-                checks = self._register_banded_check(cert, checks)
-                partials.append(
-                    ColumnarBatch(inter_fields, list(cols), n, checks))
+                # per-batch grouping is row-local, so halves from a
+                # split-and-retry simply land as extra partials for the
+                # merge below (this phase is a known OOM hotspot)
+                partials.extend(self.oom_retry_batches(
+                    batch, self._groupby_one,
+                    label=f"{self.name()}.groupBatch"))
 
         if not partials:
             return
@@ -1049,14 +1036,43 @@ class HashAggregateExec(UnaryExecBase):
             out = merged
         else:
             with self.metrics.timed(M.TOTAL_TIME):
-                kern = self._evaluate_kernel(merged)
-                cols = kern(merged.columns, merged.num_rows_i32)
-                out = ColumnarBatch(self._schema, list(cols),
-                                    merged._rows, merged.checks)
+                # the final projection reads one merged group batch —
+                # no input to subdivide, so pressure spills + retries
+                # in place (no-split lane)
+                (out,) = tuple(self.oom_retry_batches(
+                    merged, self._evaluate_one, split=False,
+                    label=f"{self.name()}.evaluate"))
         if out.num_rows_known:
             out = out.with_capacity(bucket_capacity(out.num_rows))
         self.update_output_metrics(out)
         yield out
+
+    def _groupby_one(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """One batch (or split piece) through the grouping kernel ->
+        partial-layout batch.  The OOM harness reserves ahead of this."""
+        phase = "merge" if self.mode == AggMode.FINAL else "update"
+        fast = self._dict_groupby_batch(batch)
+        if fast is not None:
+            return fast
+        wcap = self._kernel_compact_cap(batch)
+        kern = self._groupby_kernel(batch, phase, wcap)
+        if batch.sparse is not None:
+            cols, n, coll, excess, cert = kern(
+                batch.columns, batch.num_rows_i32, batch.sparse)
+        else:
+            cols, n, coll, excess, cert = kern(
+                batch.columns, batch.num_rows_i32)
+        checks = self._register_collision_check(coll, batch.checks)
+        checks = self._register_excess_check(excess, wcap, checks)
+        checks = self._register_banded_check(cert, checks)
+        return ColumnarBatch(self._partial_schema(), list(cols), n,
+                             checks)
+
+    def _evaluate_one(self, merged: ColumnarBatch) -> ColumnarBatch:
+        kern = self._evaluate_kernel(merged)
+        cols = kern(merged.columns, merged.num_rows_i32)
+        return ColumnarBatch(self._schema, list(cols), merged._rows,
+                             merged.checks)
 
     def _get_merge_exec(self, inter_schema) -> "HashAggregateExec":
         """Cached internal FINAL-mode exec so merge kernels are compiled
@@ -1077,6 +1093,22 @@ class HashAggregateExec(UnaryExecBase):
         # so the concat can stay gather-free
         merged = concat_batches(partials, sparse_ok=True)
         merge_exec = self._get_merge_exec(inter_schema)
+        # the merge phase is the aggregate's known OOM hotspot: under
+        # reservation failure the concatenated partials split in half
+        # and each half merges independently — a group key may then
+        # appear in several results, so >1 outputs re-merge (each round
+        # shrinks toward the final group count, and the row floor
+        # bounds the recursion)
+        outs = list(self.oom_retry_batches(
+            merged,
+            lambda b: self._merge_one(merge_exec, b, inter_schema),
+            label=f"{self.name()}.mergePartials"))
+        if len(outs) == 1:
+            return outs[0]
+        return self._merge_partials(outs, inter_schema)
+
+    def _merge_one(self, merge_exec, merged, inter_schema
+                   ) -> ColumnarBatch:
         wcap = self._kernel_compact_cap(merged)
         with self.metrics.timed(M.TOTAL_TIME):
             kern = merge_exec._groupby_kernel(merged, "merge", wcap)
@@ -1107,16 +1139,21 @@ class HashAggregateExec(UnaryExecBase):
         inter_schema = self._partial_schema()
         partials = []
         phase = "merge" if self.mode == AggMode.FINAL else "update"
+
+        def reduce_one(b: ColumnarBatch) -> ColumnarBatch:
+            kern = self._reduce_kernel(b, phase)
+            if b.sparse is not None:
+                cols = kern(b.columns, b.num_rows_i32, b.sparse)
+            else:
+                cols = kern(b.columns, b.num_rows_i32)
+            return ColumnarBatch(inter_schema, list(cols), 1, b.checks)
+
         for batch in batches:
             with self.metrics.timed(M.TOTAL_TIME):
-                kern = self._reduce_kernel(batch, phase)
-                if batch.sparse is not None:
-                    cols = kern(batch.columns, batch.num_rows_i32,
-                                batch.sparse)
-                else:
-                    cols = kern(batch.columns, batch.num_rows_i32)
-                partials.append(ColumnarBatch(inter_schema, list(cols), 1,
-                                              batch.checks))
+                # whole-batch reductions are row-local too: split halves
+                # just add 1-row partials to the merge below
+                partials.extend(self.oom_retry_batches(
+                    batch, reduce_one, label=f"{self.name()}.reduce"))
         if not partials:
             # SQL: aggregate of empty input yields one row (e.g. COUNT=0)
             partials = [self._empty_partial(inter_schema)]

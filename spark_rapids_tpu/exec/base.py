@@ -309,6 +309,33 @@ class TpuExec:
         self.metrics.add(M.NUM_OUTPUT_ROWS, batch._rows)
         self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
 
+    def oom_retry_batches(self, batch: ColumnarBatch, body,
+                          split: bool = True, out_bytes_fn=None,
+                          label: str = None):
+        """Reservation-aware batch processing: route one batch's
+        materialization through the OOM retry harness (memory/retry.py)
+        — reserve HBM for the output, spill under pressure with the
+        semaphore yielded, split the input in half and retry on
+        reservation failure, and past the row floor degrade via the
+        conf'd fallback.  Yields one `body(piece)` result per (possibly
+        split) piece in row order, charging this exec's numRetries /
+        numSplitRetries / spillBytes / retryBlockTime metrics.
+
+        `split=False` is for single-batch contracts that cannot
+        subdivide their input (window frames, RequireSingleBatch
+        consumers): pressure there spills + retries in place and the
+        floor fallback handles the rest."""
+        from spark_rapids_tpu.memory import retry as R
+        label = label or self.name()
+        if split:
+            yield from R.with_split_retry(
+                batch, body, metrics=self.metrics,
+                out_bytes_fn=out_bytes_fn, label=label)
+        else:
+            nbytes = (out_bytes_fn or R.estimate_batch_bytes)(batch)
+            yield R.with_retry(lambda: body(batch), out_bytes=nbytes,
+                               metrics=self.metrics, label=label)
+
     def name(self) -> str:
         return type(self).__name__
 

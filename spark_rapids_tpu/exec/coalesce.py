@@ -29,7 +29,17 @@ def coalesce_iterator(batches: Iterator[ColumnarBatch],
                       max_rows: int = None) -> Iterator[ColumnarBatch]:
     """The AbstractGpuCoalesceIterator analog.  `max_rows` (resolved by
     the caller at plan time — the draining thread may not carry the
-    session conf) caps emitted batch row counts for TargetSize goals."""
+    session conf) caps emitted batch row counts for TargetSize goals.
+
+    Pass-through EXCEPTION to the row cap: a LAZY batch (row count
+    still a device scalar) whose capacity is within `LAZY_PASS_MULT` x
+    `max_rows` is emitted WHOLE — uncounted and un-sliced — because its
+    memory is already allocated (slicing duplicates, not frees) and the
+    count sync (~150ms tunnel round trip) would dominate post-filter
+    pipelines.  Consumers that size work by rows must therefore treat
+    batch CAPACITY as the bound for lazy batches; the exchange's
+    oversized-batch shard guard (shuffle/exchange.py) does exactly
+    that so an up-to-8x lazy batch cannot land whole on one chip."""
     if isinstance(goal, RequireSingleBatch):
         got = [b for b in batches if b.maybe_nonempty()]
         if not got:

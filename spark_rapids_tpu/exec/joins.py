@@ -483,33 +483,40 @@ class HashJoinExec(TpuExec):
         kmin_op = jnp.int64(kmin)
         i32 = np.iinfo(np.int32)
         narrow_ok = i32.min <= kmin and kmin + g <= i32.max
+
+        def probe_one(pb: ColumnarBatch) -> ColumnarBatch:
+            with self.metrics.timed(M.TOTAL_TIME):
+                kern = self._dense_probe_kernel(build, pb, g, narrow_ok)
+                args = (pb.columns, pb.num_rows_i32, build.columns,
+                        bidx1_tab, vmask_tab, kmin_op)
+                if pb.sparse is not None:
+                    args = args + (pb.sparse,)
+                if jt in _PROBE_ONLY:
+                    keep = kern(*args)
+                    return ColumnarBatch(self._schema, pb.columns,
+                                         None, pb.checks, sparse=keep)
+                elif jt == JoinType.INNER:
+                    bout, matched = kern(*args)
+                    return self._assemble_sparse(pb.columns, bout,
+                                                 matched, pb.checks)
+                else:  # LEFT/RIGHT OUTER (probe side preserved)
+                    bout, _ = kern(*args)
+                    return self._assemble_sparse(pb.columns, bout,
+                                                 pb.sparse, pb.checks,
+                                                 rows=pb._rows)
+
         for it in self._probe.execute_partitions():
             for pb in it:
                 if not pb.maybe_nonempty():
                     continue
-                with self.metrics.timed(M.TOTAL_TIME):
-                    kern = self._dense_probe_kernel(build, pb, g,
-                                                    narrow_ok)
-                    args = (pb.columns, pb.num_rows_i32, build.columns,
-                            bidx1_tab, vmask_tab, kmin_op)
-                    if pb.sparse is not None:
-                        args = args + (pb.sparse,)
-                    if jt in _PROBE_ONLY:
-                        keep = kern(*args)
-                        out = ColumnarBatch(self._schema, pb.columns,
-                                            None, pb.checks, sparse=keep)
-                    elif jt == JoinType.INNER:
-                        bout, matched = kern(*args)
-                        out = self._assemble_sparse(pb.columns, bout,
-                                                    matched, pb.checks)
-                    else:  # LEFT/RIGHT OUTER (probe side preserved)
-                        bout, _ = kern(*args)
-                        out = self._assemble_sparse(pb.columns, bout,
-                                                    pb.sparse, pb.checks,
-                                                    rows=pb._rows)
-                if out.maybe_nonempty():
-                    self.update_output_metrics(out)
-                    yield out
+                # probe rows are independent given a fixed build table,
+                # so the probe side is fully split-and-retry-able
+                for out in self.oom_retry_batches(
+                        pb, probe_one,
+                        label=f"{self.name()}.denseProbe"):
+                    if out.maybe_nonempty():
+                        self.update_output_metrics(out)
+                        yield out
 
     def _assemble_sparse(self, pcols, bcols, sparse, checks, rows=None):
         if self._flip:
@@ -533,7 +540,16 @@ class HashJoinExec(TpuExec):
         if not batches:
             from spark_rapids_tpu.columnar.batch import empty_batch
             return empty_batch(self._build.output_schema())
-        return concat_batches(batches)
+        if len(batches) == 1:
+            return batches[0]
+        # the build-side concat is the join's known OOM hotspot, and a
+        # hash join needs the build side WHOLE (single-batch contract),
+        # so pressure here spills + retries in place — no split
+        from spark_rapids_tpu.memory import retry as R
+        nbytes = 2 * sum(b.device_size_bytes() for b in batches)
+        return R.with_retry(lambda: concat_batches(batches),
+                            out_bytes=nbytes, metrics=self.metrics,
+                            label=f"{self.name()}.buildSide")
 
     def _assemble(self, pout, bout, n) -> ColumnarBatch:
         """Order output columns as (left, right) regardless of probe side."""
@@ -554,39 +570,52 @@ class HashJoinExec(TpuExec):
         outer_probe = jt in (JoinType.LEFT_OUTER, JoinType.RIGHT_OUTER,
                              JoinType.FULL_OUTER)
         bmatched_total = np.zeros(build.capacity, bool)
+
+        def probe_one(pb: ColumnarBatch) -> ColumnarBatch:
+            pb = pb.dense()
+            with self.metrics.timed(M.TOTAL_TIME):
+                mk = self._match_kernel(build, pb)
+                counts_p, start_p, perm, bmatched, total_inner = mk(
+                    build.columns, jnp.int32(build.num_rows),
+                    pb.columns, jnp.int32(pb.num_rows))
+                if jt == JoinType.FULL_OUTER:
+                    # in-place OR: the flags accumulate across probe
+                    # batches AND split pieces (build rows matched by
+                    # any piece stay matched)
+                    np.logical_or(bmatched_total,
+                                  np.asarray(bmatched)[:build.capacity],
+                                  out=bmatched_total)
+                if jt in _PROBE_ONLY:
+                    sk = self._semi_kernel(pb, jt == JoinType.LEFT_ANTI)
+                    cols, n = sk(pb.columns, counts_p,
+                                 jnp.int32(pb.num_rows))
+                    return ColumnarBatch(self._schema, list(cols), int(n))
+                total = int(total_inner)
+                if outer_probe:
+                    total = total + pb.num_rows  # upper bound
+                out_cap = bucket_capacity(max(total, 1))
+                ek = self._expand_kernel(build, pb, out_cap, outer_probe)
+                pout, bout, tot = ek(build.columns, pb.columns,
+                                     counts_p, start_p, perm,
+                                     jnp.int32(pb.num_rows))
+                out = self._assemble(pout, bout, int(tot))
+                if self.condition is not None:
+                    out = self._apply_condition(out)
+                return out
+
         for it in self._probe.execute_partitions():
             for pb in it:
                 if not pb.maybe_nonempty():
                     continue
-                pb = pb.dense()
-                with self.metrics.timed(M.TOTAL_TIME):
-                    mk = self._match_kernel(build, pb)
-                    counts_p, start_p, perm, bmatched, total_inner = mk(
-                        build.columns, jnp.int32(build.num_rows),
-                        pb.columns, jnp.int32(pb.num_rows))
-                    if jt == JoinType.FULL_OUTER:
-                        bmatched_total |= np.asarray(bmatched)
-                    if jt in _PROBE_ONLY:
-                        sk = self._semi_kernel(pb, jt == JoinType.LEFT_ANTI)
-                        cols, n = sk(pb.columns, counts_p,
-                                     jnp.int32(pb.num_rows))
-                        out = ColumnarBatch(self._schema, list(cols), int(n))
-                    else:
-                        total = int(total_inner)
-                        if outer_probe:
-                            total = total + pb.num_rows  # upper bound
-                        out_cap = bucket_capacity(max(total, 1))
-                        ek = self._expand_kernel(build, pb, out_cap,
-                                                 outer_probe)
-                        pout, bout, tot = ek(build.columns, pb.columns,
-                                             counts_p, start_p, perm,
-                                             jnp.int32(pb.num_rows))
-                        out = self._assemble(pout, bout, int(tot))
-                        if self.condition is not None:
-                            out = self._apply_condition(out)
-                if out.num_rows > 0:
-                    self.update_output_metrics(out)
-                    yield out
+                # probe rows are independent given the fixed build side
+                # (FULL_OUTER's unmatched-build flags OR across pieces),
+                # so probe batches split-and-retry freely while the pair
+                # expansion's out_cap shrinks with each piece
+                for out in self.oom_retry_batches(
+                        pb, probe_one, label=f"{self.name()}.probe"):
+                    if out.num_rows > 0:
+                        self.update_output_metrics(out)
+                        yield out
         if jt == JoinType.FULL_OUTER:
             un = self._unmatched_build(build, bmatched_total)
             if un is not None and un.num_rows > 0:

@@ -136,22 +136,47 @@ class SortExec(UnaryExecBase):
             batches = coalesce_iterator(
                 batches, RequireSingleBatch(), self._schema, self.metrics)
         for batch in batches:
-            with self.metrics.timed(M.TOTAL_TIME):
-                kernel = self._kernel(batch, head)
-                if batch.sparse is not None:
-                    cols = kernel(batch.columns, batch.num_rows_i32,
-                                  batch.sparse)
-                else:
-                    cols = kernel(batch.columns, batch.num_rows_i32)
-                rows = batch._rows
-                if head is not None:
-                    rows = (min(rows, head) if batch.num_rows_known
-                            else jnp.minimum(batch.num_rows_i32,
-                                             jnp.int32(head)))
-                out = ColumnarBatch(self._schema, list(cols), rows,
-                                    batch.checks)
-                self.update_output_metrics(out)
+            out = self._sort_with_retry(batch, head)
+            self.update_output_metrics(out)
             yield out
+
+    def _sort_one_batch(self, batch: ColumnarBatch,
+                        head: Optional[int]) -> ColumnarBatch:
+        with self.metrics.timed(M.TOTAL_TIME):
+            kernel = self._kernel(batch, head)
+            if batch.sparse is not None:
+                cols = kernel(batch.columns, batch.num_rows_i32,
+                              batch.sparse)
+            else:
+                cols = kernel(batch.columns, batch.num_rows_i32)
+            rows = batch._rows
+            if head is not None:
+                rows = (min(rows, head) if batch.num_rows_known
+                        else jnp.minimum(batch.num_rows_i32,
+                                         jnp.int32(head)))
+            return ColumnarBatch(self._schema, list(cols), rows,
+                                 batch.checks)
+
+    def _sort_with_retry(self, batch: ColumnarBatch,
+                         head: Optional[int]) -> ColumnarBatch:
+        """Materialization point routed through the OOM harness: under
+        reservation failure the input halves, each half sorts at half
+        capacity (a fused `head` keeps only each half's head — sound
+        for top-N), and the sorted runs merge through ONE final
+        no-split sort pass over their concatenation.  Key VALUES are
+        bit-exact vs the unsplit sort; only the order within equal
+        keys can differ (Spark does not promise sort stability)."""
+        pieces = list(self.oom_retry_batches(
+            batch, lambda b: self._sort_one_batch(b, head),
+            label=f"{self.name()}.sortBatch"))
+        if len(pieces) == 1:
+            return pieces[0]
+        from spark_rapids_tpu.columnar.batch import concat_batches
+        merged = concat_batches([p.dense() for p in pieces])
+        (out,) = tuple(self.oom_retry_batches(
+            merged, lambda b: self._sort_one_batch(b, head),
+            split=False, label=f"{self.name()}.mergeRuns"))
+        return out
 
     def execute_head(self, n: int) -> Iterator[ColumnarBatch]:
         """Global sort fused with a LIMIT n: the sort kernel gathers only

@@ -422,21 +422,30 @@ class WindowExec(UnaryExecBase):
         return ColumnVector(sv.dtype, red.astype(sv.dtype.storage_dtype),
                             sorted_mask & has & (cnt > 0))
 
+    def _window_one(self, batch: ColumnarBatch) -> ColumnarBatch:
+        with self.metrics.timed(M.TOTAL_TIME):
+            kern = self._kernel(batch)
+            cols, coll = kern(batch.columns, batch.num_rows_i32)
+            checks = CK.register_deopt(
+                coll, f"hashWindowParts[exec {self.exec_id}]",
+                self._disable_hash_partitions, batch.checks)
+            return ColumnarBatch(self._schema, list(cols),
+                                 batch._rows, checks)
+
     def process_partition(self, batches) -> Iterator[ColumnarBatch]:
         from spark_rapids_tpu.exec.coalesce import coalesce_iterator
         batches = coalesce_iterator(batches, RequireSingleBatch(),
                                     self._child_schema, self.metrics)
         for batch in batches:
             batch = batch.dense()
-            with self.metrics.timed(M.TOTAL_TIME):
-                kern = self._kernel(batch)
-                cols, coll = kern(batch.columns, batch.num_rows_i32)
-                checks = CK.register_deopt(
-                    coll, f"hashWindowParts[exec {self.exec_id}]",
-                    self._disable_hash_partitions, batch.checks)
-                out = ColumnarBatch(self._schema, list(cols),
-                                    batch._rows, checks)
-                self.update_output_metrics(out)
+            # window frames read the WHOLE partition group
+            # (RequireSingleBatch contract) — a row split would cut
+            # partitions mid-frame, so pressure here takes the no-split
+            # lane: spill + retry in place, floor fallback past that
+            (out,) = tuple(self.oom_retry_batches(
+                batch, self._window_one, split=False,
+                label=self.name()))
+            self.update_output_metrics(out)
             yield out
 
 

@@ -11,6 +11,9 @@ from spark_rapids_tpu.memory.catalog import BufferCatalog  # noqa: F401
 from spark_rapids_tpu.memory.device_manager import (  # noqa: F401
     DeviceManager, SpillCallback)
 from spark_rapids_tpu.memory.env import ResourceEnv  # noqa: F401
+from spark_rapids_tpu.memory.retry import (  # noqa: F401
+    TpuOutOfCoreError, TpuRetryOOM, TpuSplitAndRetryOOM, with_retry,
+    with_split_retry)
 from spark_rapids_tpu.memory.semaphore import (  # noqa: F401
     TaskContext, TpuSemaphore)
 from spark_rapids_tpu.memory.stores import (  # noqa: F401

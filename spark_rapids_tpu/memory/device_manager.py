@@ -134,9 +134,25 @@ class DeviceManager:
         with self._acct:
             return self._store_bytes
 
+    @property
+    def reserved_bytes(self) -> int:
+        with self._acct:
+            return self._reserved
+
     def install_spill_handler(self, device_store) -> SpillCallback:
         self.spill_callback = SpillCallback(device_store)
         return self.spill_callback
+
+    def try_reserve(self, nbytes: int) -> bool:
+        """Fast-path reservation: succeeds only when the projection fits
+        the budget WITHOUT spilling (the retry harness brackets the
+        spilling `reserve()` path with a semaphore yield, so the
+        no-pressure case must not pay that release/reacquire)."""
+        with self._acct:
+            if self._store_bytes + self._reserved + nbytes <= self.budget:
+                self._reserved += nbytes
+                return True
+        return False
 
     def reserve(self, nbytes: int) -> bool:
         """Pre-admission check before materializing `nbytes` on device.

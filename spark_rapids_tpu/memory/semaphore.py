@@ -130,3 +130,29 @@ class TpuSemaphore:
             yield
         finally:
             self.release_if_necessary(ctx)
+
+    @contextmanager
+    def yielded(self, ctx: Optional[TaskContext] = None):
+        """Fully release this task's hold for the duration of the body
+        (a synchronous spill / memory wait), restoring the same
+        refcount afterwards — so concurrent tasks can use the
+        accelerator while this task blocks on memory (the reference
+        releases the GPU semaphore around DeviceMemoryEventHandler's
+        synchronous spill for the same reason).  No-op outside a task
+        context or when the task holds nothing."""
+        ctx = ctx or TaskContext.get()
+        if ctx is None:
+            yield
+            return
+        tid = ctx.task_attempt_id
+        with self._lock:
+            n = self._refs.pop(tid, 0)
+        if n > 0:
+            self._sem.release()
+        try:
+            yield
+        finally:
+            if n > 0:
+                self._sem.acquire()
+                with self._lock:
+                    self._refs[tid] = n

@@ -313,7 +313,15 @@ class ShuffleExchangeExec(UnaryExecBase):
             for b in it:
                 if not b.maybe_nonempty():
                     continue
-                if b.num_rows_known and b.num_rows > max_rows:
+                # size LAZY batches by CAPACITY (a safe upper bound on
+                # rows): coalesce's lazy_bounded pass-through emits
+                # batches up to LAZY_PASS_MULT x the row cap whole, and
+                # those must not skip HBM-budget sharding and land
+                # entire on one chip.  Only the must-shard shape pays
+                # the count sync (b.num_rows below).
+                est_rows = (b.num_rows if b.num_rows_known
+                            else b.capacity)
+                if est_rows > max_rows and b.num_rows > max_rows:
                     # SURVEY §5 long-context analog: ONE batch larger
                     # than the per-chip budget is sharded ACROSS the
                     # mesh before the all-to-all (the sp lane), instead

@@ -16,6 +16,14 @@ PEAK_DEVICE_MEMORY = "peakDevMemory"
 BUFFER_TIME = "bufferTime"
 DECODE_TIME = "tpuDecodeTime"
 COMPILE_TIME = "compileTime"
+# OOM retry harness (reference GpuMetric.NUM_RETRIES/NUM_SPLIT_RETRIES/
+# RETRY_BLOCK_TIME on RmmRapidsRetryIterator): memory/retry.py charges
+# these to the exec whose materialization hit pressure
+NUM_RETRIES = "numRetries"
+NUM_SPLIT_RETRIES = "numSplitRetries"
+NUM_OOM_FALLBACKS = "numOomFallbacks"
+SPILL_BYTES = "spillBytes"
+RETRY_BLOCK_TIME = "retryBlockTime"
 
 
 class MetricSet:

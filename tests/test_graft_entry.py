@@ -8,16 +8,73 @@ testing multi-node paths without a cluster (SURVEY.md §4 tier 2).
 import os
 import subprocess
 import sys
+import tempfile
 
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+#: accelerator-plugin knobs scrubbed from every fresh-process child, on
+#: top of the mesh env each test strips deliberately.  With a libtpu
+#: wheel baked into the image but no TPU attached, a bare
+#: `jax.devices()` blocks for MINUTES in the TPU plugin's
+#: wait-for-hardware sleep loop — environment noise that would eat the
+#: tier-1 wall-clock budget, and not what these tests assert (the
+#: broken-plugin ROUTING is pinned separately by
+#: test_dryrun_routes_to_subprocess_when_default_backend_not_cpu via
+#: monkeypatch, without real hardware waits).  Same scrub list as
+#: __graft_entry__._dryrun_subprocess's hermetic child.
+PLUGIN_ENV = ("TPU_LIBRARY_PATH", "LIBTPU_INIT_ARGS", "PJRT_DEVICE",
+              "JAX_PLATFORM_NAME")
+
+
+def _tpu_chips_attached() -> bool:
+    try:
+        from jax._src import hardware_utils
+        return hardware_utils.num_available_tpu_chips_and_device_id()[0] > 0
+    except Exception:
+        return False  # can't tell -> assume none (CPU CI)
+
+
+_LIBTPU_SHIM = None
+
+
+def _no_libtpu_pythonpath() -> str:
+    """Env scrubbing alone cannot stop the TPU hardware wait: jax
+    registers the tpu backend whenever `import libtpu` succeeds, so a
+    chipless machine with the wheel baked in still blocks in
+    make_tpu_client.  Shadow the wheel with an ImportError stub on the
+    child's PYTHONPATH — maybe_import_libtpu then returns None and the
+    child falls back to CPU instantly, exactly like a machine without
+    the wheel."""
+    global _LIBTPU_SHIM
+    if _LIBTPU_SHIM is None:
+        d = tempfile.mkdtemp(prefix="graft-no-libtpu-")
+        pkg = os.path.join(d, "libtpu")
+        os.makedirs(pkg, exist_ok=True)
+        with open(os.path.join(pkg, "__init__.py"), "w") as f:
+            f.write("raise ImportError("
+                    "'libtpu shadowed: no TPU chips attached "
+                    "(test_graft_entry shim)')\n")
+        _LIBTPU_SHIM = d
+    return _LIBTPU_SHIM
+
+
+def _child_env(strip_env=()):
+    strip_env = tuple(strip_env) + PLUGIN_ENV
+    env = {k: v for k, v in os.environ.items() if k not in strip_env}
+    path = [REPO]
+    if not _tpu_chips_attached():
+        path.append(_no_libtpu_pythonpath())
+    if env.get("PYTHONPATH"):
+        path.append(env["PYTHONPATH"])
+    env["PYTHONPATH"] = os.pathsep.join(path)
+    return env
+
 
 def _run(code, strip_env=()):
-    env = {k: v for k, v in os.environ.items() if k not in strip_env}
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    return subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+    return subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          env=_child_env(strip_env),
                           capture_output=True, text=True, timeout=600)
 
 
@@ -52,9 +109,8 @@ def test_dryrun_multichip_host_count_set_but_default_backend_not_cpu():
     # backend is the (possibly broken, libtpu-skewed) accelerator plugin:
     # any eager op on an uncommitted array would dispatch there and crash.
     # The gate must route to the hermetic CPU subprocess instead.
-    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env = _child_env(strip_env=("JAX_PLATFORMS",))
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     r = subprocess.run(
         [sys.executable, "-c",
          "import jax\n"

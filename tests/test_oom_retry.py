@@ -1,0 +1,453 @@
+"""Injected-OOM soak tests for the split-and-retry harness
+(memory/retry.py — reference parallel: spark-rapids'
+RmmRapidsRetryIterator suites over injected GpuRetryOOM /
+GpuSplitAndRetryOOM).
+
+The lattice under test: reservation failure -> SpillCallback spill with
+the semaphore yielded -> retry -> split-in-half -> recurse to the
+minSplitRows floor -> graceful fallback (bestEffort) or actionable error
+— and, above all, BIT-EXACT results vs the uninjected run.  Runs on the
+CPU mesh: failures are synthetic (seeded `spark.rapids.memory
+.faultInjection.*`), spills are real (tiny accounted HBM budgets)."""
+import os
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.aggregate import HashAggregateExec
+from spark_rapids_tpu.exec.basic import LocalBatchSource
+from spark_rapids_tpu.exec.joins import HashJoinExec, JoinType
+from spark_rapids_tpu.exec.sort import SortExec, asc, desc
+from spark_rapids_tpu.exec.window import (WindowExec, WindowSpec,
+                                          WinSum)
+from spark_rapids_tpu.exprs.aggregates import Count, Sum
+from spark_rapids_tpu.exprs.base import col
+from spark_rapids_tpu.memory import ResourceEnv
+from spark_rapids_tpu.memory import retry as R
+from spark_rapids_tpu.memory.semaphore import TaskContext, TpuSemaphore
+from spark_rapids_tpu.utils import metrics as M
+from tests.parity import compare_frames, norm_frame
+
+#: the CI soak lane (scripts/run_suite.sh oom) widens the seed sweep
+SOAK = os.environ.get("SPARK_RAPIDS_TPU_OOM_SOAK", "") not in ("", "0")
+SEEDS = (7, 11, 23) if SOAK else (7,)
+
+#: acceptance-criteria injection shape: rate 0.2, seeded, low split floor
+RATE = 0.2
+FLOOR = 64
+
+
+def _inject(rate=RATE, seed=7, **extra):
+    s = {C.OOM_INJECT_RATE.key: rate,
+         C.OOM_INJECT_SEED.key: seed,
+         C.RETRY_MIN_SPLIT_ROWS.key: FLOOR}
+    s.update(extra)
+    return C.RapidsConf(s)
+
+
+def _run(plan, conf=None):
+    R.reset_oom_injection()
+    with C.session(conf or C.RapidsConf()):
+        return plan.collect().to_pandas()
+
+
+def _tree_metric(exec_, name) -> float:
+    total = exec_.metrics.value(name)
+    for c in exec_.children:
+        total += _tree_metric(c, name)
+    return total
+
+
+def _batches(df, nb):
+    """One partition of `nb` batches (multi-batch update/merge paths)."""
+    n = len(df)
+    step = -(-n // nb)
+    return LocalBatchSource([[
+        ColumnarBatch.from_pandas(df.iloc[i:i + step]
+                                  .reset_index(drop=True))
+        for i in range(0, n, step)]])
+
+
+def _assert_bit_exact(expected, got, label):
+    e, g = norm_frame(expected), norm_frame(got)
+    pd.testing.assert_frame_equal(e, g, check_exact=True,
+                                  obj=f"{label} (bit-exact)")
+
+
+def _soak_until_split(make_plan, base, seed, label, extra_check=None,
+                      sweep=40):
+    """Run the plan under rate-0.2 injection over derived seeds until
+    the split-and-retry lane fires (injection is probabilistic per
+    reservation attempt, so one seed may inject only retries — or
+    nothing — for plans with few attempts).  Parity is asserted on
+    EVERY injected run; the sweep is deterministic, so a passing seed
+    set stays passing."""
+    fired = splits = 0
+    for s in range(seed, seed + sweep):
+        plan = make_plan()
+        got = _run(plan, _inject(seed=s))
+        fired += R.injected_oom_count()
+        splits += _tree_metric(plan, M.NUM_SPLIT_RETRIES)
+        _assert_bit_exact(base, got, f"{label} (seed {s})")
+        if extra_check is not None:
+            extra_check(got)
+        if splits > 0:
+            break
+    assert fired > 0, f"{label}: injector never fired"
+    assert splits > 0, f"{label}: split-and-retry lane never exercised"
+
+
+# -- aggregate ---------------------------------------------------------------
+def _sales(seed, n=4000):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": rng.integers(0, 40, n).astype(np.int64),
+        "v": rng.integers(-1000, 1000, n).astype(np.int64),
+    })
+
+
+def _agg_plan(df, nb=6):
+    return HashAggregateExec(
+        [col("k")],
+        [Sum(col("v")).alias("s"), Count(col("v")).alias("c")],
+        _batches(df, nb))
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_aggregate_parity_under_injection(seed):
+    df = _sales(seed)
+    base = _run(_agg_plan(df))
+    # pandas golden first: the uninjected engine run must be right
+    exp = df.groupby("k", as_index=False).agg(s=("v", "sum"),
+                                              c=("v", "count"))
+    compare_frames(norm_frame(exp), norm_frame(base), "agg golden")
+    _soak_until_split(lambda: _agg_plan(df), base, seed,
+                      "agg under injection")
+
+
+def test_aggregate_no_injection_no_retries():
+    plan = _agg_plan(_sales(0))
+    _run(plan)
+    for name in (M.NUM_RETRIES, M.NUM_SPLIT_RETRIES,
+                 M.NUM_OOM_FALLBACKS, M.SPILL_BYTES):
+        assert _tree_metric(plan, name) == 0, name
+
+
+# -- join --------------------------------------------------------------------
+def _join_frames(seed, dup_build=False):
+    rng = np.random.default_rng(seed)
+    n, m = 4000, 600
+    left = pd.DataFrame({
+        "k": rng.integers(0, m, n).astype(np.int64),
+        "v": rng.integers(0, 10_000, n).astype(np.int64)})
+    if dup_build:
+        # duplicate build keys disqualify the dense table -> sort lane
+        rk = rng.integers(0, m // 2, m).astype(np.int64)
+    else:
+        rk = np.arange(m, dtype=np.int64)
+    right = pd.DataFrame({
+        "rk": rk, "w": rng.integers(0, 100, m).astype(np.int64)})
+    return left, right
+
+
+def _join_plan(left, right, jt=JoinType.INNER, nb=6):
+    return HashJoinExec(jt, [col("k")], [col("rk")],
+                        _batches(left, nb),
+                        LocalBatchSource.from_pandas(right,
+                                                     num_partitions=2))
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize("dup_build", [False, True],
+                         ids=["denseLane", "sortLane"])
+def test_join_parity_under_injection(seed, dup_build):
+    left, right = _join_frames(seed, dup_build)
+    base = _run(_join_plan(left, right))
+    exp = left.merge(right, left_on="k", right_on="rk")
+    compare_frames(norm_frame(exp), norm_frame(base), "join golden")
+    _soak_until_split(lambda: _join_plan(left, right), base, seed,
+                      "join under injection")
+
+
+def test_left_outer_join_parity_under_injection():
+    left, right = _join_frames(5)
+    left.loc[:50, "k"] = 10_000  # unmatched probe rows -> null build side
+    base = _run(_join_plan(left, right, JoinType.LEFT_OUTER))
+    plan = _join_plan(left, right, JoinType.LEFT_OUTER)
+    got = _run(plan, _inject(seed=5))
+    assert R.injected_oom_count() > 0
+    _assert_bit_exact(base, got, "left outer under injection")
+
+
+# -- sort --------------------------------------------------------------------
+def _orders(seed, n=5000):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "x": rng.integers(-500, 500, n).astype(np.int64),
+        "y": rng.integers(0, 1_000_000, n).astype(np.int64)})
+
+
+def _sort_plan(df, nb=4):
+    return SortExec([asc(col("x")), desc(col("y"))], _batches(df, nb))
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_sort_parity_under_injection(seed):
+    df = _orders(seed)
+    base = _run(_sort_plan(df))
+    exp = df.sort_values(["x", "y"], ascending=[True, False],
+                         ignore_index=True)
+    pd.testing.assert_frame_equal(exp, base, obj="sort golden")
+    def ordered(got):
+        # the full key ordering must hold on every injected run (the
+        # sorted ROW SET bit-exactness is the sweep's base check; tie
+        # order within equal keys is not a sort contract)
+        g = got.reset_index(drop=True)
+        pd.testing.assert_frame_equal(
+            g.sort_values(["x", "y"], ascending=[True, False],
+                          ignore_index=True), g, obj="sort order")
+
+    # a GLOBAL sort reserves once per run (one coalesced batch), so the
+    # sweep matters most here
+    _soak_until_split(lambda: _sort_plan(df), base, seed,
+                      "sort under injection", extra_check=ordered)
+
+
+# -- window (no-split lane) --------------------------------------------------
+def test_window_parity_under_forced_fallback():
+    """Window frames need the whole partition batch, so the harness's
+    no-split lane handles pressure: spill+retry then floor fallback.
+    rate=1.0 + a small injection cap forces the fallback
+    deterministically — results must be identical."""
+    rng = np.random.default_rng(3)
+    n = 2000
+    df = pd.DataFrame({
+        "g": rng.integers(0, 20, n).astype(np.int64),
+        "o": rng.permutation(n).astype(np.int64),
+        "v": rng.integers(0, 100, n).astype(np.int64)})
+
+    def plan():
+        return WindowExec(
+            [(WinSum(col("v")), "s")],
+            WindowSpec([col("g")], [asc(col("o"))]),
+            _batches(df, 3))
+
+    base = _run(plan())
+    p = plan()
+    got = _run(p, _inject(rate=1.0, seed=3,
+                          **{C.OOM_INJECT_MAX.key: 8}))
+    assert R.injected_oom_count() > 0
+    assert _tree_metric(p, M.NUM_SPLIT_RETRIES) == 0  # no-split lane
+    assert (_tree_metric(p, M.NUM_RETRIES)
+            + _tree_metric(p, M.NUM_OOM_FALLBACKS)) > 0
+    _assert_bit_exact(base, got, "window under injection")
+
+
+# -- harness unit behavior ---------------------------------------------------
+def _batch_of(n):
+    return ColumnarBatch.from_pandas(
+        pd.DataFrame({"x": np.arange(n, dtype=np.int64)}))
+
+
+def test_split_retry_splits_to_floor_then_falls_back():
+    """rate=1.0: every reservation fails, so the batch must halve down
+    to the floor and each floor piece must still produce its result via
+    the bestEffort fallback — graceful degradation, never a wrong
+    answer."""
+    b = _batch_of(100)
+    ms = M.MetricSet()
+    R.reset_oom_injection()
+    conf = _inject(rate=1.0, seed=1,
+                   **{C.RETRY_MIN_SPLIT_ROWS.key: 25,
+                      C.OOM_INJECT_MAX.key: 10_000})
+    with C.session(conf):
+        outs = list(R.with_split_retry(b, lambda p: p.num_rows,
+                                       metrics=ms, label="t"))
+    # 100 -> 50+50 -> 4x25 (floor): order-preserving, lossless
+    assert outs == [25, 25, 25, 25]
+    assert ms.value(M.NUM_SPLIT_RETRIES) == 3
+    assert ms.value(M.NUM_OOM_FALLBACKS) == 4
+
+
+def test_floor_error_mode_is_actionable():
+    b = _batch_of(100)
+    R.reset_oom_injection()
+    conf = _inject(rate=1.0, seed=2,
+                   **{C.RETRY_MIN_SPLIT_ROWS.key: 1 << 20,
+                      C.RETRY_FALLBACK.key: "error",
+                      C.OOM_INJECT_MAX.key: 10_000})
+    with C.session(conf):
+        with pytest.raises(R.TpuOutOfCoreError) as ei:
+            list(R.with_split_retry(b, lambda p: p.num_rows,
+                                    metrics=M.MetricSet(), label="t"))
+    msg = str(ei.value)
+    assert "minSplitRows" in msg
+    assert "allocFraction" in msg  # actionable: names the knobs
+
+
+def test_injection_cap_guarantees_progress():
+    b = _batch_of(400)
+    ms = M.MetricSet()
+    R.reset_oom_injection()
+    conf = _inject(rate=1.0, seed=4, **{C.OOM_INJECT_MAX.key: 3,
+                                        C.RETRY_MIN_SPLIT_ROWS.key: 8})
+    with C.session(conf):
+        outs = list(R.with_split_retry(b, lambda p: p.num_rows,
+                                       metrics=ms, label="t"))
+    assert sum(outs) == 400
+    assert R.injected_oom_count() == 3
+    assert ms.value(M.NUM_OOM_FALLBACKS) == 0  # cap hit before floor
+
+
+def test_injector_is_deterministic():
+    a = R.OomInjector(0.5, 3, 0)
+    b = R.OomInjector(0.5, 3, 0)
+    assert [a.fire() for _ in range(64)] == [b.fire() for _ in range(64)]
+
+
+def test_reservation_released_after_body_and_on_error():
+    from spark_rapids_tpu.memory.device_manager import DeviceManager
+    dm = DeviceManager.get()
+    base = dm.reserved_bytes
+    R.reset_oom_injection()
+    with C.session(C.RapidsConf()):
+        assert R.with_retry(lambda: 42, out_bytes=12345,
+                            metrics=M.MetricSet(), label="t") == 42
+        assert dm.reserved_bytes == base
+
+        def boom():
+            raise ValueError("body failure")
+        with pytest.raises(ValueError):
+            R.with_retry(boom, out_bytes=12345, metrics=M.MetricSet(),
+                         label="t")
+        assert dm.reserved_bytes == base
+
+
+# -- real pressure against a tiny accounted budget ---------------------------
+@pytest.fixture
+def tiny_env(tmp_path):
+    C.set_active_conf(C.RapidsConf({
+        C.HBM_ALLOC_FRACTION.key: 1.0,
+        C.HBM_RESERVE.key: 0,
+        C.HOST_SPILL_STORAGE.key: 1 << 22,
+        C.CONCURRENT_TPU_TASKS.key: 1,
+    }))
+    env = ResourceEnv.init(hbm_total=1 << 16, spill_dir=str(tmp_path))
+    yield env
+    ResourceEnv.shutdown()
+    C.set_active_conf(C.RapidsConf())
+
+
+def _park_spillable(env, n=1000, seed=0):
+    from spark_rapids_tpu.memory import BufferId
+    rng = np.random.default_rng(seed)
+    bid = BufferId(env.catalog.next_table_id())
+    env.device_store.add_batch(bid, ColumnarBatch.from_numpy({
+        "a": rng.integers(0, 100, n).astype(np.int64),
+        "b": rng.random(n)}))
+    return bid
+
+
+def test_real_pressure_spills_and_reserves(tiny_env):
+    """No injection: a reservation over the tiny accounted budget must
+    spill the parked device buffer down a tier and then succeed."""
+    bid = _park_spillable(tiny_env)
+    assert tiny_env.device_store.current_size > 0
+    ms = M.MetricSet()
+    R.reset_oom_injection()
+    with C.session(C.get_active_conf()):
+        got = R.with_retry(lambda: "ok", out_bytes=60_000, metrics=ms,
+                           label="t")
+    assert got == "ok"
+    assert ms.value(M.SPILL_BYTES) > 0
+    assert ms.value(M.NUM_RETRIES) == 1
+    assert tiny_env.device_store.current_size == 0
+    with tiny_env.catalog.acquired(bid) as buf:
+        assert buf.tier.name in ("HOST", "DISK")  # spilled, not lost
+
+
+def test_semaphore_released_during_spill(tiny_env):
+    """Concurrent-task progress: while task 1 blocks in the synchronous
+    spill, task 2 must be able to take the (max_concurrent=1)
+    semaphore — the harness yields the hold around the spill and
+    reacquires with the refcount restored."""
+    _park_spillable(tiny_env)
+    sem = TpuSemaphore.get()
+    assert sem.max_concurrent == 1
+    store = tiny_env.device_store
+    orig = store.synchronous_spill
+    in_spill = threading.Event()
+    t2_acquired = threading.Event()
+
+    def slow_spill(target):
+        in_spill.set()
+        assert t2_acquired.wait(10), \
+            "task 2 never got the semaphore while task 1 spilled"
+        return orig(target)
+    store.synchronous_spill = slow_spill
+
+    def task2():
+        assert in_spill.wait(10)
+        with TaskContext(2) as c2:
+            sem.acquire_if_necessary(c2)
+            t2_acquired.set()
+            sem.release_if_necessary(c2)
+
+    t = threading.Thread(target=task2)
+    t.start()
+    ms = M.MetricSet()
+    R.reset_oom_injection()
+    with C.session(C.get_active_conf()):
+        with TaskContext(1) as ctx:
+            sem.acquire_if_necessary(ctx)
+            sem.acquire_if_necessary(ctx)  # nested hold: refcount 2
+            got = R.with_retry(lambda: "ok", out_bytes=60_000,
+                               metrics=ms, label="t")
+            assert got == "ok"
+            # reacquired with the full refcount: two releases to drop
+            assert sem.holders() == 1
+            sem.release_if_necessary(ctx)
+            assert sem.holders() == 1
+            sem.release_if_necessary(ctx)
+            assert sem.holders() == 0
+    t.join(10)
+    assert not t.is_alive()
+    assert ms.value(M.SPILL_BYTES) > 0
+
+
+def test_concurrent_tasks_complete_under_injection(tiny_env):
+    """Two tasks hammering the harness under injection on a
+    max_concurrent=1 semaphore must both finish (no deadlock through
+    the yield/reacquire path) with exact results."""
+    results = {}
+    errors = []
+    R.reset_oom_injection()
+    conf = C.get_active_conf().set(C.OOM_INJECT_RATE.key, 0.5) \
+        .set(C.OOM_INJECT_SEED.key, 9) \
+        .set(C.OOM_INJECT_MAX.key, 200) \
+        .set(C.RETRY_MIN_SPLIT_ROWS.key, 16)
+
+    def work(tid):
+        try:
+            with C.session(conf):
+                with TaskContext(tid) as ctx:
+                    TpuSemaphore.get().acquire_if_necessary(ctx)
+                    outs = list(R.with_split_retry(
+                        _batch_of(200), lambda p: p.num_rows,
+                        metrics=M.MetricSet(), label=f"task{tid}"))
+                    results[tid] = sum(outs)
+        except Exception as e:  # surfaced to the main thread below
+            errors.append((tid, e))
+
+    ts = [threading.Thread(target=work, args=(i,)) for i in (1, 2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errors, errors
+    assert results == {1: 200, 2: 200}
+    assert TpuSemaphore.get().holders() == 0
