@@ -21,6 +21,19 @@ run_fast() {
   echo "== workload parity (TPC-H / TPC-DS / TPCx-BB / Mortgage) =="
   "${PYTEST[@]}" tests/test_workloads.py
   run_oom_soak
+  run_pipeline
+}
+
+run_pipeline() {
+  # async-pipeline lane: the parity suites must be bit-identical with
+  # bounded prefetch ON (depth 2) and fully OFF — the overlap layer may
+  # move work across threads but never change a result.  Env overrides
+  # flip the conf defaults suite-wide (config.py PIPELINE_* entries).
+  echo "== pipeline lane (prefetchDepth=2 vs pipelining disabled) =="
+  SPARK_RAPIDS_TPU_PIPELINE=1 SPARK_RAPIDS_TPU_PIPELINE_DEPTH=2 \
+    "${PYTEST[@]}" tests/test_pipeline.py tests/test_tpch.py
+  SPARK_RAPIDS_TPU_PIPELINE=0 \
+    "${PYTEST[@]}" tests/test_pipeline.py tests/test_tpch.py
 }
 
 run_oom_soak() {
@@ -55,12 +68,14 @@ run_bench() {
 }
 
 case "$TIER" in
-  gate)  run_gate ;;
-  fast)  run_fast ;;
-  slow)  run_slow ;;
-  shims) run_shims ;;
-  bench) run_bench ;;
-  oom)   run_oom_soak ;;
-  all)   run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|all]" >&2; exit 2 ;;
+  gate)     run_gate ;;
+  fast)     run_fast ;;
+  slow)     run_slow ;;
+  shims)    run_shims ;;
+  bench)    run_bench ;;
+  oom)      run_oom_soak ;;
+  pipeline) run_pipeline ;;
+  all)      run_fast; run_slow; run_shims; run_bench ;;
+  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|all]" >&2
+     exit 2 ;;
 esac

@@ -82,6 +82,8 @@ class ColumnarBatch:
     def num_rows(self) -> int:
         """Host row count — SYNCS if the count is still a device scalar."""
         if not isinstance(self._rows, int):
+            from spark_rapids_tpu.utils import checks as CK
+            CK.note_host_sync("batch.num_rows")
             self._rows = int(np.asarray(self._rows))
         return self._rows
 

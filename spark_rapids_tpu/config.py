@@ -145,6 +145,30 @@ OOM_INJECT_MAX = conf(
     "guaranteeing forward progress in soak loops even at oomRate=1.0 "
     "(0 = unlimited).")
 
+# --- async pipelined execution (exec/pipeline.py) ----------------------------
+# env-overridable defaults so CI lanes (scripts/run_suite.sh pipeline)
+# can flip the whole suite without threading a conf through every test
+import os as _os
+
+PIPELINE_ENABLED = conf(
+    "spark.rapids.sql.pipeline.enabled",
+    _bool(_os.environ.get("SPARK_RAPIDS_TPU_PIPELINE", "true")),
+    "Overlap pipeline stages with bounded background prefetch: at "
+    "pipeline breaks (scan->compute, both sides of a shuffle exchange, "
+    "coalesce boundaries, AQE stage materialization) a producer thread "
+    "runs the upstream iterator prefetchDepth batches ahead while the "
+    "consumer computes, so host orchestration, H2D transfer, and device "
+    "kernels overlap instead of strictly alternating.  Producers obey "
+    "the TPU semaphore discipline: one blocked on a full queue never "
+    "holds the semaphore.")
+PIPELINE_PREFETCH_DEPTH = conf(
+    "spark.rapids.sql.pipeline.prefetchDepth",
+    int(_os.environ.get("SPARK_RAPIDS_TPU_PIPELINE_DEPTH", "2")),
+    "How many batches a pipeline producer may run ahead of its "
+    "consumer at each pipeline break (the prefetch queue bound).  "
+    "Bounds peak device memory at ~depth extra batches per break; 0 "
+    "disables prefetch at that break like pipeline.enabled=false.")
+
 # --- I/O formats (reference RapidsConf.scala format enables + Spark's
 # spark.sql.files.* split planning keys) --------------------------------------
 PARQUET_ENABLED = conf("spark.rapids.sql.format.parquet.enabled", True,

@@ -81,6 +81,15 @@ _GLOBAL_KERNELS_LOCK = threading.Lock()
 # clients have been observed to segfault with thousands of live loaded
 # executables, so the LRU stays conservatively small
 _GLOBAL_KERNELS_MAX = 512
+#: single-flight registry: keys whose builder is currently tracing /
+#: compiling on some thread (value: Event set when it lands or fails).
+#: XLA compiles run seconds-to-minutes, so they must happen OUTSIDE
+#: _GLOBAL_KERNELS_LOCK — but with pipelined execution two threads
+#: routinely reach the same (exec, signature) miss together, and
+#: compiling the same kernel twice wastes exactly the time pipelining
+#: saves.  Losing a rare race anyway (event timeout, builder failure)
+#: degrades to the benign double-compile, never to a wrong result.
+_GLOBAL_KERNELS_BUILDING: dict = {}
 
 
 def clear_kernel_cache() -> None:
@@ -113,16 +122,36 @@ class KernelCache:
                 self._cache[key] = fn
             return fn
         gk = (self._scope, key)
-        with _GLOBAL_KERNELS_LOCK:
-            fn = _GLOBAL_KERNELS.get(gk)
-            if fn is not None:
-                _GLOBAL_KERNELS.move_to_end(gk)
-                return fn
-        fn = builder()  # trace/compile outside the lock
+        while True:
+            with _GLOBAL_KERNELS_LOCK:
+                fn = _GLOBAL_KERNELS.get(gk)
+                if fn is not None:
+                    _GLOBAL_KERNELS.move_to_end(gk)
+                    return fn
+                ev = _GLOBAL_KERNELS_BUILDING.get(gk)
+                if ev is None:
+                    # claim the build; compile happens OUTSIDE the lock
+                    ev = threading.Event()
+                    _GLOBAL_KERNELS_BUILDING[gk] = ev
+                    break
+            # another thread is tracing/compiling this exact kernel:
+            # wait for it instead of double-compiling.  On wake, either
+            # the entry is cached (loop hits it) or the builder failed
+            # (loop re-claims and this thread builds).
+            ev.wait(timeout=600.0)
+        try:
+            fn = builder()  # trace/compile outside the lock
+        except BaseException:
+            with _GLOBAL_KERNELS_LOCK:
+                _GLOBAL_KERNELS_BUILDING.pop(gk, None)
+            ev.set()
+            raise
         with _GLOBAL_KERNELS_LOCK:
             _GLOBAL_KERNELS[gk] = fn
             while len(_GLOBAL_KERNELS) > _GLOBAL_KERNELS_MAX:
                 _GLOBAL_KERNELS.popitem(last=False)
+            _GLOBAL_KERNELS_BUILDING.pop(gk, None)
+        ev.set()
         return fn
 
     def __len__(self):
@@ -263,8 +292,20 @@ class TpuExec:
                     out.prefetch()
                     # ONE verify over batch checks + the query's
                     # registered checks = one stacked flag readback (a
-                    # second verify call would pay its own round trip)
-                    CK.verify(list(out.checks) + CK.drain_since(mark))
+                    # second verify call would pay its own round trip).
+                    # Under the async pipeline layer the batch's lazy
+                    # row count rides the SAME readback (host-sync
+                    # diet: the to_pandas conversion right after this
+                    # otherwise pays its own round trip for the count).
+                    checks = list(out.checks) + CK.drain_since(mark)
+                    from spark_rapids_tpu import config as C
+                    if (not out.num_rows_known
+                            and C.get_active_conf()[C.PIPELINE_ENABLED]):
+                        (rows,) = CK.verify(checks,
+                                            scalars=[out.num_rows_i32])
+                        out.num_rows = int(rows)
+                    else:
+                        CK.verify(checks)
                     return out
                 except CK.FastPathInvalid as e:
                     if final:

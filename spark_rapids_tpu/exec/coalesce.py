@@ -164,6 +164,16 @@ class CoalesceBatchesExec(UnaryExecBase):
         return f"CoalesceBatchesExec({self.goal})"
 
     def process_partition(self, batches):
-        return coalesce_iterator(batches, self.goal,
-                                 self.output_schema(), self.metrics,
-                                 max_rows=self._max_rows)
+        # coalesce is a pipeline break: its producer side (the child's
+        # batches + the concat/re-bucket dispatches) runs ahead on a
+        # prefetch thread while the downstream consumer computes.  The
+        # conf is resolved HERE (execution time, inside collect()'s
+        # session) — never at plan build, where the session conf is not
+        # installed and a captured default would leak to the producer
+        # thread and flip conf-gated kernel lanes (observed as q15's
+        # f32-vs-f64 aggregation mismatch).
+        from spark_rapids_tpu.exec.pipeline import maybe_prefetch
+        return maybe_prefetch(
+            coalesce_iterator(batches, self.goal, self.output_schema(),
+                              self.metrics, max_rows=self._max_rows),
+            label="coalesce", metrics=self.metrics)

@@ -39,6 +39,7 @@ from spark_rapids_tpu.exec.base import (
 from spark_rapids_tpu.exprs.base import Expression
 from spark_rapids_tpu.ops.sort_encode import (
     encode_key_bits, packed_lexsort, segment_boundaries)
+from spark_rapids_tpu.utils import checks as CK
 from spark_rapids_tpu.utils import metrics as M
 
 
@@ -589,7 +590,11 @@ class HashJoinExec(TpuExec):
                     sk = self._semi_kernel(pb, jt == JoinType.LEFT_ANTI)
                     cols, n = sk(pb.columns, counts_p,
                                  jnp.int32(pb.num_rows))
+                    CK.note_host_sync("join.expand")
                     return ColumnarBatch(self._schema, list(cols), int(n))
+                # per-probe-batch host sync: the expand kernel's output
+                # capacity must be a HOST int (it keys the compile)
+                CK.note_host_sync("join.expand")
                 total = int(total_inner)
                 if outer_probe:
                     total = total + pb.num_rows  # upper bound

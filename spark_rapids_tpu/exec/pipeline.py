@@ -1,0 +1,278 @@
+"""Async pipelined execution: bounded prefetch between pipeline stages.
+
+The engine is host-driven: Python pulls batches through operator
+iterators while all per-batch compute runs in XLA executables.  Fully
+synchronous pulling serializes the three resources a query actually
+uses — host orchestration (decode, split bookkeeping, upload staging),
+the host->device transfer, and device kernels — so the TPU idles while
+Python works and vice versa.  `PrefetchIterator` breaks that lockstep at
+pipeline breaks (scan->compute, both sides of a shuffle exchange,
+coalesce boundaries, AQE stage materialization): a background producer
+thread runs the upstream iterator up to `prefetchDepth` batches ahead of
+the consumer through a bounded queue, the same overlap the reference
+gets from `MultiFileThreadPoolFactory` + the CUDA stream (we only had it
+inside io/scan.py's host buffering).
+
+Discipline (the parts that make this safe rather than just concurrent):
+
+* **Bounded depth** — the queue holds at most `prefetchDepth` batches,
+  so a fast producer cannot flood HBM; backpressure is the queue block.
+* **Semaphore** — a producer blocked on a full queue NEVER holds the TPU
+  semaphore: it yields its task's hold for the duration of the block
+  (`TpuSemaphore.yielded`, the PR 1 spill discipline) so concurrent
+  tasks keep the accelerator busy while this one is parked.
+* **Task identity** — the producer runs under the creating thread's
+  `TaskContext` when one exists (one task, helper thread — the
+  reference's multithreaded reader model), else under a fresh private
+  context that is force-completed (semaphore released) on thread exit.
+* **Conf propagation** — the session conf is thread-local; the producer
+  re-installs the creator's conf so upstream conf reads see the same
+  values the plan was built with.
+* **Error / cancellation propagation** — a producer exception is
+  re-raised at the consumer's pull point (so OOM split-and-retry and
+  deopt recovery fire on the consuming side exactly as they would
+  synchronously), and closing the consumer cancels the producer and
+  closes the source iterator so upstream cleanup (shuffle reader
+  release, file handles) still runs.
+* **Lazy start** — the producer thread starts on the consumer's first
+  pull, not at plan build: `execute_partitions()` constructs every
+  partition's iterator eagerly, and starting all producers there would
+  turn plan construction into unbounded whole-plan concurrency.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Iterable, Iterator, Optional
+
+from spark_rapids_tpu.utils import metrics as M
+
+#: end-of-stream sentinel (errors ride on `self._error`, set before this)
+_DONE = object()
+
+#: task ids for producers created outside any task context; offset far
+#: above real task-attempt ids so the two never collide in the
+#: semaphore's refcount table
+_PRODUCER_TASK_IDS = itertools.count(1 << 40)
+
+#: poll granularity for cancellable blocking queue ops; latency is only
+#: paid on the (rare) full/empty-with-dead-producer edges
+_POLL_S = 0.05
+
+# process-wide stats (bench.py records these alongside wall clock so the
+# perf trajectory captures overlap, not just totals)
+_STATS_LOCK = threading.Lock()
+_STATS = {"producers": 0, "hits": 0, "stalls": 0, "wait_ns": 0,
+          "blocked_puts": 0}
+
+
+def pipeline_stats() -> dict:
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def reset_pipeline_stats() -> None:
+    with _STATS_LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def _bump(name: str, value: int = 1) -> None:
+    with _STATS_LOCK:
+        _STATS[name] += value
+
+
+class PrefetchIterator:
+    """Depth-bounded background prefetch over a batch iterator.
+
+    Iterator protocol on the consumer side; the source runs on a
+    producer thread started at the first pull.  `close()` (also invoked
+    by GC) cancels the producer, drains the queue, and closes the
+    source."""
+
+    def __init__(self, source: Iterable, depth: int,
+                 label: str = "pipeline", metrics=None, conf=None):
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.memory.semaphore import TaskContext
+        assert depth > 0
+        self._source = iter(source)
+        self._q: "queue.Queue" = queue.Queue(maxsize=int(depth))
+        self._label = label
+        self._metrics = metrics
+        self._conf = conf if conf is not None else C.get_active_conf()
+        #: creator's task identity, shared with the producer thread when
+        #: present (same task, helper thread)
+        self._ctx = TaskContext.get()
+        #: thread-local deopt-retry flag, propagated so fast paths the
+        #: producer executes still bypass themselves on the final
+        #: guaranteed-valid attempt (iterators are rebuilt per attempt,
+        #: so construction-time capture is exact)
+        from spark_rapids_tpu.utils import checks as CK
+        self._retrying = CK.is_retrying()
+        self._closed = threading.Event()
+        #: test-facing: set while the producer is parked on a full queue
+        #: (the window in which it must not hold the TPU semaphore)
+        self.blocked = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._done = False
+
+    # -- consumer side ------------------------------------------------------
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        self._ensure_started()
+        try:
+            item = self._q.get_nowait()
+            _bump("hits")
+            if self._metrics is not None:
+                self._metrics.add(M.PREFETCH_HITS, 1)
+        except queue.Empty:
+            item = self._wait_for_item()
+        if item is _DONE:
+            self._done = True
+            self._finish()
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            raise StopIteration
+        return item
+
+    def _wait_for_item(self):
+        t0 = time.perf_counter_ns()
+        try:
+            while True:
+                try:
+                    return self._q.get(timeout=_POLL_S)
+                except queue.Empty:
+                    t = self._thread
+                    if t is None or not t.is_alive():
+                        # producer exited: drain the put/exit race, then
+                        # report end-of-stream (error checked by caller)
+                        try:
+                            return self._q.get_nowait()
+                        except queue.Empty:
+                            return _DONE
+        finally:
+            waited = time.perf_counter_ns() - t0
+            _bump("stalls")
+            _bump("wait_ns", waited)
+            if self._metrics is not None:
+                self._metrics.add(M.PREFETCH_STALLS, 1)
+                self._metrics.add(M.PIPELINE_WAIT_TIME, waited)
+
+    def close(self) -> None:
+        """Cancel the producer and release everything it buffered."""
+        self._done = True
+        self._closed.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        t = self._thread
+        if (t is not None and t.is_alive()
+                and t is not threading.current_thread()):
+            t.join(timeout=10.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _finish(self) -> None:
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10.0)
+
+    # -- producer side ------------------------------------------------------
+    def _ensure_started(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._produce, daemon=True,
+                name=f"tpu-prefetch-{self._label}")
+            _bump("producers")
+            self._thread.start()
+
+    def _produce(self) -> None:
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.memory.semaphore import TaskContext
+        from spark_rapids_tpu.utils import checks as CK
+        if self._retrying:
+            CK.set_retrying(True)
+        own_ctx = None
+        if self._ctx is not None:
+            TaskContext.set_current(self._ctx)
+        else:
+            own_ctx = TaskContext(next(_PRODUCER_TASK_IDS))
+            TaskContext.set_current(own_ctx)
+        try:
+            with C.session(self._conf):
+                try:
+                    for item in self._source:
+                        if not self._put(item):
+                            return  # consumer closed
+                except BaseException as e:  # noqa: BLE001 — re-raised
+                    self._error = e         # at the consumer's pull
+                self._put(_DONE)
+        finally:
+            try:
+                close = getattr(self._source, "close", None)
+                if close is not None:
+                    close()
+            except Exception:
+                pass
+            if own_ctx is not None:
+                # private task identity: force-release any semaphore
+                # hold the source's device work acquired
+                own_ctx.complete()
+            TaskContext.set_current(None)
+
+    def _put(self, item) -> bool:
+        """Enqueue with backpressure.  False = consumer cancelled.  A
+        producer parked on a full queue must not hold the TPU semaphore
+        — its task's hold is yielded for the duration of the block."""
+        try:
+            self._q.put_nowait(item)
+            return True
+        except queue.Full:
+            pass
+        from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+        _bump("blocked_puts")
+        self.blocked.set()
+        try:
+            with TpuSemaphore.get().yielded():
+                while not self._closed.is_set():
+                    try:
+                        self._q.put(item, timeout=_POLL_S)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+        finally:
+            self.blocked.clear()
+
+
+def maybe_prefetch(source: Iterable, label: str = "pipeline",
+                   metrics=None, conf=None,
+                   depth: Optional[int] = None) -> Iterator:
+    """Wrap `source` in a PrefetchIterator when the session conf enables
+    pipelining (and `depth`/prefetchDepth > 0); otherwise return it
+    unwrapped.  Call at iterator-construction time on the thread that
+    carries the session conf (plan build / execute_partitions)."""
+    from spark_rapids_tpu import config as C
+    conf = conf if conf is not None else C.get_active_conf()
+    if not conf[C.PIPELINE_ENABLED]:
+        return iter(source)
+    if depth is None:
+        depth = int(conf[C.PIPELINE_PREFETCH_DEPTH])
+    if depth <= 0:
+        return iter(source)
+    return PrefetchIterator(source, depth, label=label, metrics=metrics,
+                            conf=conf)

@@ -181,9 +181,17 @@ class TpuFileSourceScanExec(LeafExec):
             yield from it
 
     def execute_partitions(self) -> list[Iterator[ColumnarBatch]]:
+        # scan->compute pipeline break: a producer thread decodes and
+        # uploads batch k+1 while the consumer's kernels chew batch k
+        # (lazy-started, so partitions don't all begin at plan build).
+        # Prefetch conf resolves at execution time (active session), not
+        # from the plan-time self.conf snapshot.
+        from spark_rapids_tpu.exec.pipeline import maybe_prefetch
         outs = []
         for p in self.scan.partitions:
-            outs.append(self._partition_iter(p))
+            outs.append(maybe_prefetch(
+                self._partition_iter(p), label="scan",
+                metrics=self.metrics))
         return outs or [iter(())]
 
     def _partition_iter(self, part: FilePartition

@@ -92,8 +92,15 @@ class TpuSemaphore:
                 return
         self._sem.acquire()
         with self._lock:
+            if self._refs.get(tid, 0) > 0:
+                # two threads of ONE task (a pipeline producer + its
+                # consumer) raced the first acquire: a task holds at
+                # most one permit, so give the extra one back
+                self._refs[tid] += 1
+                self._sem.release()
+                return
             first = tid not in self._refs
-            self._refs[tid] = self._refs.get(tid, 0) + 1
+            self._refs[tid] = 1
         if first:
             ctx.on_task_completion(lambda c: self.release_all(c))
 
@@ -122,6 +129,17 @@ class TpuSemaphore:
     def holders(self) -> int:
         with self._lock:
             return len(self._refs)
+
+    def holds(self, ctx: Optional[TaskContext] = None) -> int:
+        """Refcount held by the given (default: current) task — 0 means
+        it does not hold the accelerator.  Test-facing: the pipeline
+        suite asserts a producer parked on a full prefetch queue holds
+        nothing."""
+        ctx = ctx or TaskContext.get()
+        if ctx is None:
+            return 0
+        with self._lock:
+            return self._refs.get(ctx.task_attempt_id, 0)
 
     @contextmanager
     def held(self, ctx: Optional[TaskContext] = None):

@@ -63,26 +63,95 @@ def _pin_tags(meta) -> None:
         _pin_tags(c)
 
 
+#: per-partition end marker on the streaming bucket queues
+_PART_DONE = object()
+
+
 class ShuffleQueryStageExec(LeafExec):
     """A materialized shuffle stage: runs the wrapped exchange's map side
     exactly once, holds the reduce-side buckets, and exposes the runtime
     statistics AQE re-plans from (Spark's `ShuffleQueryStageExec` +
-    `MapOutputStatistics`)."""
+    `MapOutputStatistics`).
+
+    With pipelining enabled the stage materializes ASYNCHRONOUSLY: a
+    fill thread drains the exchange (map-side split + reduce-side merge)
+    into per-partition queues while buckets accumulate, so (a) sibling
+    stages' map sides overlap (`_adapt_join` prestarts both inputs
+    before blocking on stats) and (b) consumers that never need the
+    stage's statistics — pinned partition counts, the probe side of a
+    demoted join, coalescePartitions disabled — stream partition
+    batches as they land instead of waiting for every bucket.  Reading
+    `partition_sizes()`/`buckets` forces completion, so AQE re-planning
+    sees exactly the statistics it saw synchronously."""
 
     def __init__(self, exchange: ShuffleExchangeExec):
         super().__init__()
         self.exchange = exchange
         self._schema = exchange.output_schema()
         self._buckets: Optional[list[list[ColumnarBatch]]] = None
+        self._fill: Optional["object"] = None    # threading.Thread
+        self._fill_error: Optional[BaseException] = None
+        self._queues = None
+        self._acc = None
+        self._consumed: set = set()
 
     def output_schema(self) -> T.Schema:
         return self._schema
 
     def materialize(self) -> "ShuffleQueryStageExec":
-        if self._buckets is None:
+        """Ensure materialization has STARTED (async under pipelining,
+        synchronous otherwise).  Blocking for the result is the stats
+        readers' job (`buckets` / `partition_sizes`)."""
+        if self._buckets is not None or self._fill is not None:
+            return self
+        if not C.get_active_conf()[C.PIPELINE_ENABLED]:
             self._buckets = [list(it)
                              for it in self.exchange.execute_partitions()]
+            return self
+        import queue as _q
+        import threading
+        n = self.exchange.output_partition_count()
+        # unbounded queues: the slices already exist on device (bucket
+        # accumulation is bookkeeping); bounding here would stall the
+        # map side behind the slowest reduce consumer
+        self._queues = [_q.Queue() for _ in range(n)]
+        self._acc = [[] for _ in range(n)]
+        self._consumed = set()
+        self._fill_error = None
+        conf = C.get_active_conf()
+        self._fill = threading.Thread(
+            target=self._fill_run, args=(conf,), daemon=True,
+            name="tpu-aqe-stage-fill")
+        self._fill.start()
         return self
+
+    def _fill_run(self, conf) -> None:
+        try:
+            with C.session(conf):
+                for p, it in enumerate(self.exchange.execute_partitions()):
+                    for b in it:
+                        self._acc[p].append(b)
+                        self._queues[p].put(b)
+                    self._queues[p].put(_PART_DONE)
+        except BaseException as e:  # noqa: BLE001 — re-raised at readers
+            self._fill_error = e
+            for q in self._queues:
+                q.put(_PART_DONE)
+
+    def _finish_fill(self) -> None:
+        """Block until the fill thread completes and promote the
+        accumulated batches to `_buckets` (re-raising a fill error)."""
+        t = self._fill
+        if t is not None:
+            t.join()
+            self._fill = None
+            self._queues = None
+            if self._fill_error is not None:
+                err, self._fill_error = self._fill_error, None
+                self._acc = None
+                raise err
+            self._buckets = self._acc
+            self._acc = None
 
     @property
     def buckets(self) -> list[list[ColumnarBatch]]:
@@ -91,7 +160,29 @@ class ShuffleQueryStageExec(LeafExec):
         # (the same recompute semantics the non-adaptive path has)
         if self._buckets is None:
             self.materialize()
+            self._finish_fill()
+            if self._buckets is None:  # async start raced a release
+                self._buckets = [list(it) for it
+                                 in self.exchange.execute_partitions()]
         return self._buckets
+
+    def iter_partition(self, p: int) -> Iterator[ColumnarBatch]:
+        """One partition's batches.  While the fill is live this STREAMS
+        them as they land (one-shot per partition per materialization);
+        afterwards (or on re-reads) it serves the held bucket."""
+        if self._buckets is None and self._fill is not None \
+                and p not in self._consumed:
+            self._consumed.add(p)
+            q = self._queues[p]
+            while True:
+                b = q.get()
+                if b is _PART_DONE:
+                    break
+                yield b
+            if self._fill_error is not None:
+                self._finish_fill()  # joins + raises the fill error
+            return
+        yield from iter(list(self.buckets[p]))
 
     def partition_sizes(self) -> list[int]:
         return [sum(b.device_size_bytes() for b in p)
@@ -103,12 +194,25 @@ class ShuffleQueryStageExec(LeafExec):
     def output_partition_count(self) -> int:
         return self.exchange.output_partition_count()
 
+    def release_buckets(self) -> None:
+        """Drop held batches after the plan drained (must not interrupt
+        a live fill: join it first so device buffers actually free)."""
+        if self._fill is not None:
+            try:
+                self._finish_fill()
+            except BaseException:
+                pass
+        self._buckets = None
+        self._consumed = set()
+
     def execute_partitions(self):
-        return [iter(list(p)) for p in self.buckets]
+        self.materialize()
+        return [self.iter_partition(p)
+                for p in range(self.output_partition_count())]
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
-        for p in self.buckets:
-            yield from p
+        for it in self.execute_partitions():
+            yield from it
 
     def describe(self):
         n = "?" if self._buckets is None else len(self._buckets)
@@ -137,8 +241,8 @@ class CustomShuffleReaderExec(LeafExec):
 
     def _read_spec(self, start: int, end: int) -> Iterator[ColumnarBatch]:
         for p in range(start, end):
-            for b in self.stage.buckets[p]:
-                self.metrics.add(M.NUM_OUTPUT_ROWS, b.num_rows)
+            for b in self.stage.iter_partition(p):
+                self.metrics.add(M.NUM_OUTPUT_ROWS, b._rows)
                 self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
                 yield b
 
@@ -201,7 +305,13 @@ def _adapt(node: TpuExec, conf: C.RapidsConf) -> TpuExec:
 def _materialize_stage(exchange: ShuffleExchangeExec,
                        conf: C.RapidsConf) -> TpuExec:
     exchange.children[0] = _adapt(exchange.child, conf)
-    stage = ShuffleQueryStageExec(exchange).materialize()
+    # reuse a stage prestarted by _prestart_leaf_stages so its running
+    # map side is consumed, not duplicated
+    stage = getattr(exchange, "_aqe_stage", None)
+    if stage is None:
+        stage = ShuffleQueryStageExec(exchange)
+        exchange._aqe_stage = stage
+    stage.materialize()
     if not conf[C.COALESCE_PARTITIONS_ENABLED]:
         return stage
     # Spark 3.1 ShuffleExchangeLike contract: a user-specified
@@ -227,8 +337,38 @@ def _stage_bytes(node: TpuExec) -> Optional[int]:
     return None
 
 
+def _prestart_leaf_stages(node: TpuExec, conf: C.RapidsConf) -> None:
+    """Kick off async materialization for every LEAF exchange in the
+    subtree — one whose own subtree holds no other exchange or join, so
+    running it early cannot bypass stage-at-a-time re-planning.  Sibling
+    join inputs then run their map sides concurrently instead of
+    back-to-back (pipelining only; a no-op otherwise)."""
+    if not conf[C.PIPELINE_ENABLED]:
+        return
+    if isinstance(node, ShuffleExchangeExec) \
+            and not _subtree_replans(node.child):
+        stage = getattr(node, "_aqe_stage", None)
+        if stage is None:
+            stage = ShuffleQueryStageExec(node)
+            node._aqe_stage = stage
+        stage.materialize()
+        return
+    for c in node.children:
+        _prestart_leaf_stages(c, conf)
+
+
+def _subtree_replans(node: TpuExec) -> bool:
+    """True if the subtree contains a node AQE would rewrite (so its
+    parent exchange must not execute before `_adapt` reaches it)."""
+    if isinstance(node, (ShuffleExchangeExec, HashJoinExec)):
+        return True
+    return any(_subtree_replans(c) for c in node.children)
+
+
 def _adapt_join(join: HashJoinExec, conf: C.RapidsConf) -> TpuExec:
     from spark_rapids_tpu.exec.joins import JoinType
+    _prestart_leaf_stages(join.children[0], conf)
+    _prestart_leaf_stages(join.children[1], conf)
     left = _adapt(join.children[0], conf)
     right = _adapt(join.children[1], conf)
     threshold = conf[C.AUTO_BROADCAST_THRESHOLD]
@@ -266,7 +406,7 @@ def release_stage_buffers(plan: TpuExec) -> None:
     shuffle output in device memory (the reference frees shuffle buffers
     when the last reader finishes, GpuShuffleExchangeExec reader _done)."""
     if isinstance(plan, ShuffleQueryStageExec):
-        plan._buckets = None
+        plan.release_buckets()
         # stages nested below this stage's exchange hold buckets too
         release_stage_buffers(plan.exchange)
         return

@@ -85,18 +85,42 @@ class ShuffleExchangeExec(UnaryExecBase):
                 self.partitioning, inputs)
         return inputs, small
 
+    def _map_input_iter(self):
+        """Map-side input stream (hash/round-robin lanes): child batches
+        across all partitions, prefetched so the child's compute runs
+        ahead of the split kernels (map side of the exchange pipeline
+        break)."""
+        from spark_rapids_tpu.exec.pipeline import maybe_prefetch
+        return maybe_prefetch(
+            (b for it in self.child.execute_partitions()
+             for b in it if b.maybe_nonempty()),
+            label="exchange-map", metrics=self.metrics)
+
     def _materialize(self) -> list[list[ColumnarBatch]]:
         """Run the map side: split every input batch; bucket by target."""
+        buckets: list[list[ColumnarBatch]] = [
+            [] for _ in range(self.partitioning.num_partitions)]
+        for p, s in self._split_slices():
+            buckets[p].append(s)
+        return buckets
+
+    def _split_slices(self):
+        """Map side as an incremental stream of (partition, slice)
+        pairs: each input batch's split lands as soon as its count
+        readback does, so a downstream consumer (AQE's streaming stage
+        materialization) can overlap reduce-side work with the rest of
+        the map side instead of waiting for every bucket."""
         part = self.partitioning
         n = part.num_partitions
         if isinstance(part, RangePartitioning):
             inputs, small = self._range_inputs()
             if small:
-                return [list(inputs)] + [[] for _ in range(n - 1)]
+                for b in inputs:
+                    yield 0, b
+                return
             batch_iter = iter(inputs)
         else:
-            batch_iter = (b for it in self.child.execute_partitions()
-                          for b in it if b.maybe_nonempty())
+            batch_iter = self._map_input_iter()
             if self.coalesce_small and n > 1:
                 with self.metrics.timed(M.TOTAL_TIME):
                     head, cap_seen = [], 0
@@ -110,10 +134,10 @@ class ShuffleExchangeExec(UnaryExecBase):
                 if exhausted:
                     for b in head:
                         self.metrics.add("dataSize", b.device_size_bytes())
-                    return [head] + [[] for _ in range(n - 1)]
+                        yield 0, b
+                    return
                 import itertools
                 batch_iter = itertools.chain(head, batch_iter)
-        buckets: list[list[ColumnarBatch]] = [[] for _ in range(n)]
         if hasattr(part, "split_device"):
             # two-phase pipeline: queue split kernels back-to-back and
             # overlap the count readbacks, finishing the oldest batch
@@ -123,36 +147,40 @@ class ShuffleExchangeExec(UnaryExecBase):
             # host round trip — but peak device memory is bounded at
             # SPLIT_PIPELINE_DEPTH full-capacity split outputs instead
             # of the entire map side.
-            with self.metrics.timed(M.TOTAL_TIME):
-                pending: list = []
-                slice_lists = []
+            pending: list = []
 
-                def finish_oldest():
-                    c, k, b = pending.pop(0)
-                    slice_lists.append(part.finish_split(c, k, b))
+            def finish_oldest():
+                c, k, b = pending.pop(0)
+                return part.finish_split(c, k, b)
 
-                for batch in batch_iter:
+            for batch in batch_iter:
+                with self.metrics.timed(M.TOTAL_TIME):
                     t = part.split_device(batch)
                     try:
                         t[1].copy_to_host_async()
                     except Exception:
                         pass
                     pending.append(t)
-                    if len(pending) >= self.SPLIT_PIPELINE_DEPTH:
-                        finish_oldest()
-                while pending:
-                    finish_oldest()
+                    slices = (finish_oldest()
+                              if len(pending) >= self.SPLIT_PIPELINE_DEPTH
+                              else None)
+                if slices is not None:
+                    yield from self._emit_slices(slices)
+            while pending:
+                with self.metrics.timed(M.TOTAL_TIME):
+                    slices = finish_oldest()
+                yield from self._emit_slices(slices)
         else:
-            slice_lists = []
             for batch in batch_iter:
                 with self.metrics.timed(M.TOTAL_TIME):
-                    slice_lists.append(part.partition_batch(batch))
-        for slices in slice_lists:
-            for p, s in enumerate(slices):
-                if s is not None and s.maybe_nonempty():
-                    buckets[p].append(s)
-                    self.metrics.add("dataSize", s.device_size_bytes())
-        return buckets
+                    slices = part.partition_batch(batch)
+                yield from self._emit_slices(slices)
+
+    def _emit_slices(self, slices):
+        for p, s in enumerate(slices):
+            if s is not None and s.maybe_nonempty():
+                self.metrics.add("dataSize", s.device_size_bytes())
+                yield p, s
 
     def _sample_bounds(self, part: RangePartitioning, inputs):
         """Driver-side reservoir sampling for range bounds (reference
@@ -190,8 +218,14 @@ class ShuffleExchangeExec(UnaryExecBase):
             return self._execute_via_mesh(*mesh_axis)
         if C.get_active_conf()[C.RAPIDS_SHUFFLE_ENABLED]:
             return self._execute_via_manager()
+        from spark_rapids_tpu.exec.pipeline import maybe_prefetch
         buckets = self._materialize()
-        return [self._merged_reader(bs) for bs in buckets]
+        # reduce side of the exchange pipeline break: each partition's
+        # merge/consolidation dispatches run ahead of its consumer
+        return [maybe_prefetch(self._merged_reader(bs),
+                               label="exchange-reduce",
+                               metrics=self.metrics)
+                for bs in buckets]
 
     #: reduce-side consolidation target (the role GpuCoalesceBatches
     #: plays after GPU shuffles, `GpuCoalesceBatches.scala:53`): a
@@ -238,6 +272,8 @@ class ShuffleExchangeExec(UnaryExecBase):
                 dense = [b.dense() for b in group]
                 unknown = [b for b in dense if not b.num_rows_known]
                 if unknown:
+                    from spark_rapids_tpu.utils import checks as CK
+                    CK.note_host_sync("exchange.merge")
                     vals = np.asarray(jnp.stack(
                         [b.num_rows_i32 for b in unknown])).tolist()
                     it = iter(vals)
@@ -363,6 +399,8 @@ class ShuffleExchangeExec(UnaryExecBase):
                 ("count", cap),
                 lambda: build_count_exchange(mesh, axis, schema,
                                              key_idx, cap))
+            from spark_rapids_tpu.utils import checks as CK
+            CK.note_host_sync("exchange.mesh")
             totals = np.asarray(count_fn(arrs, num_rows))
             out_cap = int(bucket_capacity(max(int(totals.max()), 1)))
             step = cache.get_or_build(
@@ -404,7 +442,10 @@ class ShuffleExchangeExec(UnaryExecBase):
                 part, [b for bs in per_map for b in bs])
             map_iters = [iter(bs) for bs in per_map]
         else:
-            map_iters = self.child.execute_partitions()
+            from spark_rapids_tpu.exec.pipeline import maybe_prefetch
+            map_iters = [maybe_prefetch(it, label="exchange-map",
+                                        metrics=self.metrics)
+                         for it in self.child.execute_partitions()]
         n = part.num_partitions
         try:
             for map_id, it in enumerate(map_iters):
@@ -450,7 +491,10 @@ class ShuffleExchangeExec(UnaryExecBase):
                     yield b
             finally:
                 _done()
-        return [reader(p) for p in range(n)]
+        from spark_rapids_tpu.exec.pipeline import maybe_prefetch
+        return [maybe_prefetch(reader(p), label="exchange-reduce",
+                               metrics=self.metrics)
+                for p in range(n)]
 
     def execute_columnar(self):
         for it in self.execute_partitions():

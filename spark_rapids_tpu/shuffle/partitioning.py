@@ -189,6 +189,9 @@ def _slice_partitions(batch_cols, counts, schema: T.Schema,
         kern = _cut_kernel_for(schema, batch_cols, total_cap, n_parts)
         return [ColumnarBatch(schema, cols, n, checks)
                 for cols, n in kern(list(batch_cols), counts)]
+    if not isinstance(counts, np.ndarray):
+        from spark_rapids_tpu.utils import checks as CK
+        CK.note_host_sync("partition.cut")
     counts = np.asarray(counts)
     out = []
     offsets = np.concatenate([[0], np.cumsum(counts)])
@@ -243,6 +246,8 @@ class HashPartitioning(TpuPartitioning):
     def finish_split(cols, counts, batch):
         """Phase 2: cut slices with the (prefetched) counts."""
         if batch.capacity > LAZY_SLICE_MAX_CAP:
+            from spark_rapids_tpu.utils import checks as CK
+            CK.note_host_sync("partition.cut")
             counts = np.asarray(counts)
         return _slice_partitions(cols, counts, batch.schema,
                                  batch.capacity, batch.checks)

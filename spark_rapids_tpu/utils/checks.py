@@ -17,11 +17,46 @@ per-batch tuple still fails safe at the next `verify_pending` boundary.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import threading
 from typing import Callable, Optional
 
 import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# debug host-sync counter (the pipelining PR's audit instrument): every
+# device->host readback on the hot path calls note_host_sync(site), so
+# "how many times per partition does the host block on the device" is a
+# measurable number — bench.py records it and regressions show up as a
+# counter diff, not a mystery slowdown.  Counting is always on: a sync
+# costs a device round trip (~150ms through a tunnel-attached chip), so
+# one guarded dict increment per sync is noise.
+_SYNC_LOCK = threading.Lock()
+_SYNC_SITES: "collections.Counter" = collections.Counter()
+
+
+def note_host_sync(site: str = "?") -> None:
+    """Record one device->host blocking readback attributed to `site`."""
+    with _SYNC_LOCK:
+        _SYNC_SITES[site] += 1
+
+
+def host_sync_count() -> int:
+    with _SYNC_LOCK:
+        return sum(_SYNC_SITES.values())
+
+
+def host_sync_sites() -> dict:
+    """Per-site sync counts (copy) — the audit view."""
+    with _SYNC_LOCK:
+        return dict(_SYNC_SITES)
+
+
+def reset_host_syncs() -> None:
+    with _SYNC_LOCK:
+        _SYNC_SITES.clear()
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -95,7 +130,7 @@ def register(check: BatchCheck) -> BatchCheck:
     return check
 
 
-def verify(checks) -> None:
+def verify(checks, scalars=()) -> list:
     """Resolve the given checks now (syncs); raise on any failure.
 
     Device flags are stacked into one tiny array PER DEVICE GROUP and
@@ -103,11 +138,19 @@ def verify(checks) -> None:
     per-array readbacks cost a full tunnel round-trip each (~25ms),
     which dominated collect() when a query carried dozens of checks.
     Flags with no identifiable single device (e.g. sharded across a
-    mesh) fall back to per-flag readback."""
+    mesh) fall back to per-flag readback.
+
+    `scalars`: extra device int scalars (e.g. a collect's lazy output
+    row count) that ride the SAME stacked readback — the host-sync diet
+    for the collect boundary, which otherwise pays a second full round
+    trip reading the row count right after the flag wave.  Returns
+    their host values (ints), in order."""
     checks = list(checks)
-    if not checks:
-        return
-    device_idx, device_flags, host_bad = [], [], []
+    scalars = list(scalars)
+    scalar_vals: list = [None] * len(scalars)
+    if not checks and not scalars:
+        return scalar_vals
+    device_items, host_bad = [], []
     for i, c in enumerate(checks):
         if c._resolved is not None:
             if c._resolved:
@@ -115,14 +158,18 @@ def verify(checks) -> None:
             continue
         f = c.flag
         if hasattr(f, "devices") or hasattr(f, "sharding"):
-            device_idx.append(i)
-            device_flags.append(f)
+            device_items.append(("check", i, f))
         else:
             c._memoize(bool(np.asarray(f)))
             if c._resolved:
                 host_bad.append(i)
+    for j, s in enumerate(scalars):
+        if hasattr(s, "devices") or hasattr(s, "sharding"):
+            device_items.append(("scalar", j, s))
+        else:
+            scalar_vals[j] = int(np.asarray(s))
     bad_set = set(host_bad)
-    if device_flags:
+    if device_items:
         import jax.numpy as jnp
 
         def _dev_key(f):
@@ -132,22 +179,32 @@ def verify(checks) -> None:
                 return None
 
         # stack per device: jnp.stack raises on mixed-device operands
-        # (multichip runs commit flags to different mesh devices)
+        # (multichip runs commit flags to different mesh devices).
+        # Flags widen to int32 so row-count scalars share the stack.
         groups: dict = {}
-        for i, f in zip(device_idx, device_flags):
-            groups.setdefault(_dev_key(f), []).append((i, f))
+        for kind, i, f in device_items:
+            groups.setdefault(_dev_key(f), []).append((kind, i, f))
         for items in groups.values():
             try:
+                note_host_sync("checks.verify")
                 stacked = np.asarray(jnp.stack(
-                    [jnp.asarray(f, bool).reshape(()) for _, f in items]))
-                for (i, _), b in zip(items, stacked):
-                    checks[i]._memoize(bool(b))
-                    if b:
-                        bad_set.add(i)
+                    [jnp.asarray(f).astype(jnp.int32).reshape(())
+                     for _, _, f in items]))
+                for (kind, i, _), v in zip(items, stacked):
+                    if kind == "scalar":
+                        scalar_vals[i] = int(v)
+                    else:
+                        checks[i]._memoize(bool(v))
+                        if v:
+                            bad_set.add(i)
             except Exception:
                 # arbitrary placement (e.g. flags sharded across devices):
-                # per-flag readback still resolves correctly
-                for i, f in items:
+                # per-item readback still resolves correctly
+                for kind, i, f in items:
+                    note_host_sync("checks.verify")
+                    if kind == "scalar":
+                        scalar_vals[i] = int(np.asarray(f))
+                        continue
                     checks[i]._memoize(bool(np.asarray(f)))
                     if checks[i]._resolved:
                         bad_set.add(i)
@@ -163,6 +220,7 @@ def verify(checks) -> None:
             raise c.error()
     if bad:
         raise FastPathInvalid(bad)
+    return scalar_vals
 
 
 def snapshot() -> int:

@@ -24,6 +24,16 @@ NUM_SPLIT_RETRIES = "numSplitRetries"
 NUM_OOM_FALLBACKS = "numOomFallbacks"
 SPILL_BYTES = "spillBytes"
 RETRY_BLOCK_TIME = "retryBlockTime"
+# async pipeline layer (exec/pipeline.py PrefetchIterator): hostSyncs is
+# the number of blocking device->host readbacks charged to an exec,
+# pipelineWaitTime the ns a consumer spent blocked on an empty prefetch
+# queue, prefetchHits the batches that were already buffered when the
+# consumer asked (overlap actually won), prefetchStalls the gets that
+# had to wait on the producer
+HOST_SYNCS = "hostSyncs"
+PIPELINE_WAIT_TIME = "pipelineWaitTime"
+PREFETCH_HITS = "prefetchHits"
+PREFETCH_STALLS = "prefetchStalls"
 
 
 class MetricSet:
@@ -50,13 +60,34 @@ class MetricSet:
         if not self._pending:
             return
         import numpy as np
+        from spark_rapids_tpu.utils import checks as CK
         pending, self._pending = self._pending, []
-        for _, v in pending:
-            try:
-                v.copy_to_host_async()
-            except Exception:
-                pass
+        # ONE stacked readback per dtype group for the whole pending
+        # wave: per-value np.asarray costs a device round trip each, and
+        # a long-running exec can queue hundreds of lazy row counts
+        # between reads.  Grouping by dtype (instead of upcasting to one
+        # stack dtype) keeps i32 row counts exact on non-x64 platforms.
+        import jax.numpy as jnp
+        groups: dict = {}
+        host: list = []
         for name, v in pending:
+            try:
+                a = jnp.asarray(v).reshape(())
+                groups.setdefault(str(a.dtype), []).append((name, a))
+            except Exception:
+                host.append((name, v))
+        for items in groups.values():
+            try:
+                CK.note_host_sync("metrics.resolve")
+                vals = np.asarray(jnp.stack([a for _, a in items]))
+                for (name, _), val in zip(items, vals):
+                    self._values[name] += float(val)
+            except Exception:
+                # mixed devices (sharded runs): per-value readback
+                for name, a in items:
+                    CK.note_host_sync("metrics.resolve")
+                    self._values[name] += float(np.asarray(a))
+        for name, v in host:
             self._values[name] += float(np.asarray(v))
 
     def value(self, name: str) -> float:
