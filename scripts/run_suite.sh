@@ -22,6 +22,53 @@ run_fast() {
   "${PYTEST[@]}" tests/test_workloads.py
   run_oom_soak
   run_pipeline
+  run_recovery
+}
+
+run_recovery() {
+  # shuffle fault-recovery lane: seeded peer_kill injection (the victim
+  # executor goes dark mid-stream on both transport lanes) must yield
+  # bit-exact results via map recomputation + bounded stage retries —
+  # plus epoch staleness, blacklist decay, and exhaustion (raise, never
+  # hang) coverage.  The summary line reports the recovery metrics of
+  # one injected exchange, like the oom/pipeline/bench summaries.
+  echo "== shuffle recovery lane (seeded peer-kill injection, bounded stage retries) =="
+  "${PYTEST[@]}" tests/test_shuffle_recovery.py
+  python - <<'PYEOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, pandas as pd
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.exec.basic import LocalBatchSource
+from spark_rapids_tpu.exprs.base import col
+from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+
+conf = C.RapidsConf({
+    "spark.rapids.shuffle.enabled": True,
+    "spark.rapids.shuffle.localExecutors": 2,
+    "spark.rapids.shuffle.bounceBuffers.size": 2048,
+    "spark.rapids.shuffle.fetch.maxRetries": 1,
+    "spark.rapids.shuffle.fetch.backoff.baseMs": 1.0,
+    "spark.rapids.shuffle.recovery.blacklist.failureThreshold": 1,
+    "spark.rapids.shuffle.transport.faultInjection.peerKillAfterFrames": 3,
+})
+rng = np.random.default_rng(7)
+df = pd.DataFrame({"k": rng.integers(0, 50, 4000).astype(np.int64),
+                   "v": rng.integers(0, 10**6, 4000).astype(np.int64)})
+with C.session(conf):
+    src = LocalBatchSource.from_pandas(df, num_partitions=4)
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], 3), src)
+    rows = sum(b.num_rows for it in ex.execute_partitions() for b in it)
+assert rows == len(df), f"row loss under injection: {rows}"
+m = ex.metrics.as_dict()
+print("recovery summary: rows=%d fetch_failures=%d map_recomputes=%d "
+      "stage_retries=%d peers_blacklisted=%d recovery_ms=%.1f" % (
+          rows, m.get("numFetchFailures", 0),
+          m.get("numMapRecomputes", 0), m.get("numStageRetries", 0),
+          m.get("numPeersBlacklisted", 0),
+          m.get("recoveryTime", 0) / 1e6))
+PYEOF
 }
 
 run_pipeline() {
@@ -75,7 +122,8 @@ case "$TIER" in
   bench)    run_bench ;;
   oom)      run_oom_soak ;;
   pipeline) run_pipeline ;;
+  recovery) run_recovery ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|all]" >&2
+  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|all]" >&2
      exit 2 ;;
 esac

@@ -236,6 +236,64 @@ SHUFFLE_FAULT_CORRUPT_RATE = conf(
 SHUFFLE_FAULT_SEED = conf(
     "spark.rapids.shuffle.transport.faultInjection.seed", 0,
     "Deterministic seed for fault injection.", internal=True)
+SHUFFLE_FAULT_PEER_KILL_FRAMES = conf(
+    "spark.rapids.shuffle.transport.faultInjection.peerKillAfterFrames", 0,
+    "TEST ONLY: after serving this many DATA frames (across both the "
+    "TCP and loopback lanes) the transport kills its own peer: sockets "
+    "close mid-stream, the accept loop stops, and the loopback "
+    "registration disappears — a hard executor loss, not a polite "
+    "error.  The shuffle fault-recovery subsystem must invalidate the "
+    "peer's map outputs and recompute them.  0 disables.",
+    internal=True)
+SHUFFLE_FETCH_MAX_RETRIES = conf(
+    "spark.rapids.shuffle.fetch.maxRetries", 3,
+    "Transfer-level retry budget per peer fetch: a failed transaction "
+    "(mid-stream abort, wire corruption, dead socket) is retried on a "
+    "fresh connection up to this many times before the fetch surfaces "
+    "a FetchFailedError to the stage-recovery layer (reference "
+    "RapidsShuffleClient FetchRetry).")
+SHUFFLE_FETCH_BACKOFF_BASE_MS = conf(
+    "spark.rapids.shuffle.fetch.backoff.baseMs", 50.0,
+    "Base delay for exponential backoff between fetch retries: attempt "
+    "k sleeps min(capMs, baseMs * 2^(k-1)) with +/-50% deterministic "
+    "jitter (seeded from faultInjection.seed when set), so a flapping "
+    "peer is not hammered with immediate reconnects.")
+SHUFFLE_FETCH_BACKOFF_CAP_MS = conf(
+    "spark.rapids.shuffle.fetch.backoff.capMs", 2000.0,
+    "Upper bound on a single fetch-retry backoff sleep.")
+SHUFFLE_RECOVERY_ENABLED = conf(
+    "spark.rapids.shuffle.recovery.enabled", True,
+    "Recover from shuffle fetch failures instead of failing the query: "
+    "a FetchFailedError at the reduce side invalidates the failed "
+    "peer's map outputs (per-shuffle epoch bump), recomputes only the "
+    "lost map tasks from the exchange's retained lineage, and retries "
+    "the reduce — the role Spark's DAG scheduler plays for the "
+    "reference's FetchFailedException.")
+SHUFFLE_RECOVERY_MAX_STAGE_ATTEMPTS = conf(
+    "spark.rapids.shuffle.recovery.maxStageAttempts", 4,
+    "Bounded stage retries: how many times a reduce partition may be "
+    "attempted (initial try + recoveries) before the query fails with "
+    "a descriptive FetchFailedError — never a hang, never a partial "
+    "result (Spark's spark.stage.maxConsecutiveAttempts analog).")
+SHUFFLE_BLACKLIST_THRESHOLD = conf(
+    "spark.rapids.shuffle.recovery.blacklist.failureThreshold", 3,
+    "Consecutive recovery-attributed failures after which a peer "
+    "address is blacklisted: readers route around it via the "
+    "MapStatus's alternate address and map tasks stop being placed on "
+    "it, instead of waiting out its full timeout every stage.")
+SHUFFLE_BLACKLIST_DECAY_S = conf(
+    "spark.rapids.shuffle.recovery.blacklist.decaySeconds", 30.0,
+    "A blacklist entry expires after this long and the peer gets a "
+    "fresh consecutive-failure budget — a recovered (flapping) "
+    "executor rejoins service instead of being shunned forever.")
+SHUFFLE_LOCAL_EXECUTORS = conf(
+    "spark.rapids.shuffle.localExecutors", 1,
+    "Number of in-process executor environments the manager-lane "
+    "exchange spreads map tasks across (round-robin).  >1 makes map "
+    "outputs genuinely remote to the reducing executor — loopback/TCP "
+    "fetches, fault injection, and recovery all exercise multi-executor "
+    "behavior in one process, like the reference's mocked-transport "
+    "suites.  1 (default) keeps the single local manager.")
 MESH_EXCHANGE_ENABLED = conf(
     "spark.rapids.shuffle.meshExchange.enabled", True,
     "Route hash shuffle exchanges through the device-mesh ICI all-to-all "

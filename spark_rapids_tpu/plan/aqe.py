@@ -159,11 +159,40 @@ class ShuffleQueryStageExec(LeafExec):
         # a collect, and a re-executed plan simply re-runs the exchange
         # (the same recompute semantics the non-adaptive path has)
         if self._buckets is None:
-            self.materialize()
-            self._finish_fill()
-            if self._buckets is None:  # async start raced a release
-                self._buckets = [list(it) for it
-                                 in self.exchange.execute_partitions()]
+            from spark_rapids_tpu.shuffle.client_server import \
+                FetchFailedError
+            conf = C.get_active_conf()
+            allowed = (max(1, int(
+                conf[C.SHUFFLE_RECOVERY_MAX_STAGE_ATTEMPTS]))
+                if conf[C.SHUFFLE_RECOVERY_ENABLED] else 1)
+            attempt = 1
+            while True:
+                try:
+                    self.materialize()
+                    self._finish_fill()
+                    if self._buckets is None:  # async start raced a release
+                        self._buckets = [list(it) for it in
+                                         self.exchange.execute_partitions()]
+                    break
+                except FetchFailedError as e:
+                    # outer stage-retry bound: the exchange-level
+                    # recovery driver already recomputed what it could;
+                    # a FetchFailed surfacing here re-materializes the
+                    # WHOLE stage (Spark's resubmit of a failed result
+                    # stage), bounded so a truly dead topology degrades
+                    # to a descriptive error, never a hang
+                    self._fill = None
+                    self._queues = None
+                    self._acc = None
+                    self._fill_error = None
+                    self._consumed = set()
+                    if attempt >= allowed:
+                        raise
+                    attempt += 1
+                    self.exchange.metrics.add(M.NUM_STAGE_RETRIES, 1)
+                    log.warning(
+                        "AQE stage re-materialization %d/%d after "
+                        "fetch failure: %s", attempt, allowed, e)
         return self._buckets
 
     def iter_partition(self, p: int) -> Iterator[ColumnarBatch]:

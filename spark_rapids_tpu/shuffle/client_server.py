@@ -12,9 +12,12 @@ suites (SURVEY.md §4 tier 2).
 from __future__ import annotations
 
 import logging
+import random
 import threading
+import time
 from typing import Callable, Optional, Sequence
 
+from spark_rapids_tpu import config as C
 from spark_rapids_tpu.memory.buffer import BufferId
 from spark_rapids_tpu.shuffle.catalog import (
     ShuffleBufferCatalog, ShuffleReceivedBufferCatalog)
@@ -27,15 +30,30 @@ log = logging.getLogger("spark_rapids_tpu.shuffle")
 
 
 class FetchFailedError(Exception):
-    """Maps to Spark's FetchFailedException semantics: the scheduler
-    regenerates the map outputs (reference RapidsShuffleIterator error
-    path)."""
+    """Maps to Spark's FetchFailedException semantics: the recovery
+    driver (shuffle/recovery.py) invalidates the failed peer's map
+    outputs and regenerates them (reference RapidsShuffleIterator error
+    path).  `address` is the REAL peer that failed and `block` (when
+    known) pins the shuffle/map ids, so recovery invalidates exactly
+    the right executor's outputs."""
 
     def __init__(self, address: str, block: Optional[BlockIdMsg],
                  message: str):
         super().__init__(f"fetch failed from {address} ({block}): {message}")
         self.address = address
         self.block = block
+
+    @property
+    def shuffle_id(self) -> Optional[int]:
+        return self.block.shuffle_id if self.block is not None else None
+
+    @property
+    def map_id(self) -> Optional[int]:
+        return self.block.map_id if self.block is not None else None
+
+
+#: injectable so soak tests can capture/skip the retry sleeps
+_backoff_sleep = time.sleep
 
 
 class ShuffleReceiveHandler:
@@ -106,18 +124,40 @@ class BufferReceiveState:
 class ShuffleClient:
     """Per-peer fetch driver (reference RapidsShuffleClient).  Two-phase:
     metadata round-trip, then transfer with bounded inflight bytes and
-    bounded retries on transient transport errors (FetchRetry:406)."""
+    bounded retries on transient transport errors (FetchRetry:406),
+    spaced by exponential backoff with jitter so a struggling peer is
+    not hammered with immediate reconnects."""
 
+    #: legacy default; the effective budget comes from
+    #: spark.rapids.shuffle.fetch.maxRetries
     MAX_RETRIES = 3
 
     def __init__(self, connection: Connection, transport: ShuffleTransport,
                  received_catalog: ShuffleReceivedBufferCatalog,
-                 host_store, address: str = "peer"):
+                 host_store, address: str = "peer",
+                 conf: Optional[C.RapidsConf] = None):
         self.connection = connection
         self.transport = transport
         self.received_catalog = received_catalog
         self.host_store = host_store
         self.address = address
+        conf = conf or C.get_active_conf()
+        self.max_retries = int(conf[C.SHUFFLE_FETCH_MAX_RETRIES])
+        self._backoff_base = \
+            float(conf[C.SHUFFLE_FETCH_BACKOFF_BASE_MS]) / 1000.0
+        self._backoff_cap = \
+            float(conf[C.SHUFFLE_FETCH_BACKOFF_CAP_MS]) / 1000.0
+        seed = int(conf[C.SHUFFLE_FAULT_SEED])
+        # seeded jitter -> deterministic retry schedules in soak tests
+        self._rng = random.Random(seed if seed else None)
+
+    def _backoff(self, attempt: int) -> float:
+        delay = min(self._backoff_cap,
+                    self._backoff_base * (2 ** max(0, attempt - 1)))
+        delay *= 0.5 + 0.5 * self._rng.random()
+        if delay > 0:
+            _backoff_sleep(delay)
+        return delay
 
     def fetch_blocks(self, blocks: Sequence[BlockIdMsg],
                      task_attempt_id: int,
@@ -168,14 +208,16 @@ class ShuffleClient:
                 pending = [m for m in pending
                            if m.table_id not in state.completed]
                 attempt += 1
-                if attempt > self.MAX_RETRIES:
+                if attempt > self.max_retries:
                     handler.transfer_error(txn.error or "transfer failed")
                     raise FetchFailedError(
-                        self.address, None,
+                        self.address,
+                        blocks[0] if blocks else None,
                         f"transfer failed after {attempt} attempts: "
                         f"{txn.error}")
                 log.warning("shuffle fetch retry %d from %s: %s", attempt,
                             self.address, txn.error)
+                self._backoff(attempt)
                 # a mid-stream abort leaves the socket dead on the
                 # server side: reconnect before retrying (the reference
                 # re-registers the UCX endpoint on a failed Transaction)

@@ -426,12 +426,31 @@ class ShuffleExchangeExec(UnaryExecBase):
     def _execute_via_manager(self):
         """Accelerated path: map outputs land in the spillable shuffle
         catalog; reducers pull through the caching reader (reference
-        RapidsShuffleManager write/read, SURVEY.md §3.4)."""
-        from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
-        mgr = (TpuShuffleManager.get("local")
-               or TpuShuffleManager("local"))
+        RapidsShuffleManager write/read, SURVEY.md §3.4).
+
+        Fault recovery (shuffle/recovery.py): map tasks spread across
+        spark.rapids.shuffle.localExecutors in-process executors
+        (round-robin over NON-blacklisted peers); the reduce side runs
+        through a ShuffleRecoveryDriver whose recompute closure retains
+        this exchange's map lineage — a lost peer's map tasks re-run
+        from `self.child` and land on the (always-alive) reducing
+        executor."""
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.shuffle.manager import (
+            MapOutputRegistry, TpuShuffleManager)
+        from spark_rapids_tpu.shuffle.recovery import (
+            PeerHealth, ShuffleRecoveryDriver)
+        conf = C.get_active_conf()
+        n_execs = max(1, int(conf[C.SHUFFLE_LOCAL_EXECUTORS]))
+        names = (["local"] if n_execs == 1
+                 else [f"local-{i}" for i in range(n_execs)])
+        mgrs = [TpuShuffleManager.get(nm) or TpuShuffleManager(nm)
+                for nm in names]
+        primary = mgrs[0]
+        health = PeerHealth.get()
         shuffle_id = next(ShuffleExchangeExec._SHUFFLE_IDS)
-        mgr.register_shuffle(shuffle_id)
+        for m in mgrs:
+            m.register_shuffle(shuffle_id)
         part = self.partitioning
         if isinstance(part, RangePartitioning) and part.bounds is None:
             # two passes needed: materialize per-map batches once so the
@@ -447,29 +466,61 @@ class ShuffleExchangeExec(UnaryExecBase):
                                         metrics=self.metrics)
                          for it in self.child.execute_partitions()]
         n = part.num_partitions
+
+        def write_map_task(map_id, batch_iter, mgr, epoch=None):
+            writer = mgr.get_writer(shuffle_id, map_id)
+            try:
+                for batch in batch_iter:
+                    if batch.num_rows == 0:
+                        continue
+                    with self.metrics.timed(M.TOTAL_TIME):
+                        slices = part.partition_batch(batch)
+                    for p, s in enumerate(slices):
+                        if s is not None and s.num_rows > 0:
+                            writer.write_partition(p, s)
+                            self.metrics.add("dataSize",
+                                             s.device_size_bytes())
+            except BaseException:
+                writer.abort()
+                raise
+            writer.commit(n, epoch=epoch)
+
+        def healthy_mgrs():
+            ok = [m for m in mgrs
+                  if not any(health.is_blacklisted(a) for a in
+                             (m.loop_address, m.tcp_address) if a)]
+            return ok or [primary]
+
         try:
+            pool = healthy_mgrs()
             for map_id, it in enumerate(map_iters):
-                writer = mgr.get_writer(shuffle_id, map_id)
-                try:
-                    for batch in it:
-                        if batch.num_rows == 0:
-                            continue
-                        with self.metrics.timed(M.TOTAL_TIME):
-                            slices = part.partition_batch(batch)
-                        for p, s in enumerate(slices):
-                            if s is not None and s.num_rows > 0:
-                                writer.write_partition(p, s)
-                                self.metrics.add("dataSize",
-                                                 s.device_size_bytes())
-                except BaseException:
-                    writer.abort()
-                    raise
-                writer.commit(n)
+                write_map_task(map_id, it, pool[map_id % len(pool)])
+            # arm the partial-read guard: a reduce over fewer outputs
+            # than this must FetchFail, never return partial data
+            MapOutputRegistry.set_expected_maps(shuffle_id,
+                                                len(map_iters))
         except BaseException:
             # failed map stage: free completed tasks' buffers too — no
             # reader will ever run _done()
-            mgr.unregister_shuffle(shuffle_id)
+            for m in mgrs:
+                m.unregister_shuffle(shuffle_id)
             raise
+
+        driver = None
+        if conf[C.SHUFFLE_RECOVERY_ENABLED]:
+            def recompute(lost_map_ids, epoch):
+                # retained map-side lineage: re-run ONLY the lost map
+                # partitions of the child, splitting with the same
+                # bound partitioning (range bounds already sampled),
+                # and land them on the reducing executor — the one
+                # peer recovery can rely on being alive
+                its = self.child.execute_partitions()
+                for map_id in lost_map_ids:
+                    write_map_task(map_id, its[map_id], primary,
+                                   epoch=epoch)
+            driver = ShuffleRecoveryDriver(
+                primary, shuffle_id, recompute, conf=conf,
+                metrics=self.metrics)
 
         # free the shuffle's spillable buffers + map-output entries once
         # every partition reader is exhausted (or closed early)
@@ -481,11 +532,15 @@ class ShuffleExchangeExec(UnaryExecBase):
                 remaining[0] -= 1
                 last = remaining[0] == 0
             if last:
-                mgr.unregister_shuffle(shuffle_id)
+                for m in mgrs:
+                    m.unregister_shuffle(shuffle_id)
 
         def reader(p: int):
             try:
-                for b in mgr.get_reader(shuffle_id, p):
+                batches = (driver.read_partition(p)
+                           if driver is not None
+                           else primary.get_reader(shuffle_id, p))
+                for b in batches:
                     self.metrics.add(M.NUM_OUTPUT_ROWS, b.num_rows)
                     self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
                     yield b

@@ -42,11 +42,25 @@ _LOOP_REGISTRY: dict[str, "object"] = {}  # executor_id -> request handler
 class LoopbackConnection(Connection):
     """In-process peer: drives the server state machine directly."""
 
-    def __init__(self, handler, transport: ShuffleTransport):
+    def __init__(self, handler, transport: ShuffleTransport,
+                 eid: Optional[str] = None):
         self.server = handler
         self.transport = transport
+        self.eid = eid
+
+    def _check_alive(self) -> None:
+        # a peer_kill-ed executor disappears from the loop registry;
+        # the held handler object must not keep serving it (the wire
+        # analog: the socket is dead even if the process isn't)
+        if self.eid is None:
+            return
+        with _LOOP_REGISTRY_LOCK:
+            alive = _LOOP_REGISTRY.get(self.eid) is self.server
+        if not alive:
+            raise ConnectionError(f"loopback peer {self.eid} is gone")
 
     def request(self, frame: bytes):
+        self._check_alive()
         kind, payload = decode_frame(frame[4:])
         if kind == MsgKind.METADATA_REQUEST:
             from spark_rapids_tpu.shuffle.transport import BlockIdMsg
@@ -59,6 +73,24 @@ class LoopbackConnection(Connection):
     def fetch(self, table_ids: Sequence[int],
               on_chunk: Callable[[int, int, bytes, bool], None]
               ) -> Transaction:
+        try:
+            self._check_alive()
+        except ConnectionError as e:
+            return Transaction(TransactionStatus.ERROR, str(e))
+        faults = getattr(self.server.transport, "faults", None)
+        if faults is not None and faults.kill_after_frames > 0:
+            server_transport = self.server.transport
+
+            def counted(tid, seq, chunk, is_last, codec_id=-1,
+                        raw_len=0):
+                if faults.note_frame():
+                    # the serving executor dies mid-stream: both its
+                    # lanes go dark, not just this transfer
+                    server_transport.kill_self()
+                    raise _InjectedDrop()
+                on_chunk(tid, seq, chunk, is_last, codec_id, raw_len)
+
+            return self.server.send_state(table_ids, counted, wire=False)
         # in-process fetch: bytes never hit a wire, skip the codec
         return self.server.send_state(table_ids, on_chunk, wire=False)
 
@@ -69,22 +101,44 @@ class FaultInjector:
     `drop` aborts the transfer mid-stream (the server stops sending and
     the transaction fails, so the client must drop partials, reconnect
     and retry), `corrupt` flips a byte in a DATA chunk (the frame crc32
-    must catch it). Rates come from the faultInjection.* confs; rate 0
-    (the default) injects nothing."""
+    must catch it), `peer_kill` takes the whole peer down after it has
+    served kill_after_frames DATA frames — sockets close mid-stream,
+    the accept loop stops, the loopback registration disappears — so
+    retries CANNOT succeed and the stage-recovery layer must recompute
+    the lost map outputs. Rates come from the faultInjection.* confs;
+    rate 0 (the default) injects nothing."""
 
     def __init__(self, drop_rate: float, corrupt_rate: float,
-                 seed: int):
+                 seed: int, kill_after_frames: int = 0):
         import random
         self.drop_rate = float(drop_rate)
         self.corrupt_rate = float(corrupt_rate)
+        self.kill_after_frames = int(kill_after_frames)
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self.injected_drops = 0
         self.injected_corruptions = 0
+        self.frames_served = 0
+        self.peer_killed = False
 
     @property
     def active(self) -> bool:
-        return self.drop_rate > 0 or self.corrupt_rate > 0
+        return self.drop_rate > 0 or self.corrupt_rate > 0 \
+            or self.kill_after_frames > 0
+
+    def note_frame(self) -> bool:
+        """Count one served DATA frame; True once the peer_kill budget
+        is exhausted (and forever after — a dead peer stays dead)."""
+        with self._lock:
+            if self.kill_after_frames <= 0:
+                return False
+            if self.peer_killed:
+                return True
+            self.frames_served += 1
+            if self.frames_served >= self.kill_after_frames:
+                self.peer_killed = True
+                return True
+        return False
 
     def maybe_drop(self) -> bool:
         with self._lock:
@@ -118,8 +172,10 @@ class TcpServer:
     progress thread / management-port pair collapsed into one socket)."""
 
     def __init__(self, server, host: str = "127.0.0.1", port: int = 0,
-                 faults: Optional[FaultInjector] = None):
+                 faults: Optional[FaultInjector] = None,
+                 on_kill: Optional[Callable[[], None]] = None):
         self.faults = faults
+        self.on_kill = on_kill
         self.server = server
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -148,6 +204,10 @@ class TcpServer:
                 frame = _recv_frame(conn)
                 if frame is None:
                     return
+                if self.faults is not None and self.faults.peer_killed:
+                    # a killed peer stops answering — no polite error
+                    # frame, the client sees a dead wire
+                    return
                 kind, payload = decode_frame(frame)
                 if kind == MsgKind.METADATA_REQUEST:
                     blocks = [BlockIdMsg(*b) for b in payload["blocks"]]
@@ -162,6 +222,13 @@ class TcpServer:
                             tid, (seq << 1) | int(is_last), chunk,
                             codec_id, raw_len)
                         if faults is not None and faults.active:
+                            if faults.note_frame():
+                                # peer_kill: the whole executor goes
+                                # dark mid-stream, permanently
+                                conn.close()
+                                if self.on_kill is not None:
+                                    self.on_kill()
+                                raise _InjectedDrop()
                             if faults.maybe_drop():
                                 # simulated connection loss: kill the
                                 # socket so the peer sees a dead wire,
@@ -276,7 +343,8 @@ class IciShuffleTransport(ShuffleTransport):
         self.faults = FaultInjector(
             conf[C.SHUFFLE_FAULT_DROP_RATE],
             conf[C.SHUFFLE_FAULT_CORRUPT_RATE],
-            conf[C.SHUFFLE_FAULT_SEED])
+            conf[C.SHUFFLE_FAULT_SEED],
+            conf[C.SHUFFLE_FAULT_PEER_KILL_FRAMES])
 
     def make_server(self, executor_id: str, request_handler):
         with _LOOP_REGISTRY_LOCK:
@@ -284,12 +352,24 @@ class IciShuffleTransport(ShuffleTransport):
         self._executor_ids.append(executor_id)
         tcp = TcpServer(request_handler,
                         faults=self.faults if self.faults.active
-                        else None)
+                        else None,
+                        on_kill=self.kill_self)
         self._servers.append(tcp)
         # peers prefer loopback when they share the process
         return type("ServerHandle", (), {
             "loop_address": f"loop://{executor_id}",
             "tcp_address": tcp.address})()
+
+    def kill_self(self) -> None:
+        """peer_kill landing point: this transport's executor(s) go
+        dark on BOTH lanes — TCP listeners close, loopback
+        registrations vanish — so no retry against them can succeed."""
+        self.faults.peer_killed = True
+        for s in self._servers:
+            s.close()
+        with _LOOP_REGISTRY_LOCK:
+            for eid in self._executor_ids:
+                _LOOP_REGISTRY.pop(eid, None)
 
     def can_reach(self, address: str) -> bool:
         # loop:// resolves only inside the process that registered it;
@@ -308,7 +388,7 @@ class IciShuffleTransport(ShuffleTransport):
                 handler = _LOOP_REGISTRY.get(eid)
             if handler is None:
                 raise ConnectionError(f"no loopback peer {eid}")
-            return LoopbackConnection(handler, self)
+            return LoopbackConnection(handler, self, eid=eid)
         if peer_address.startswith("tcp://"):
             host, port = peer_address[len("tcp://"):].rsplit(":", 1)
             return TcpConnection(host, int(port))

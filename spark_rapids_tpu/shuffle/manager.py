@@ -50,25 +50,60 @@ class MapStatus:
         self.address = address
         self.partition_sizes = partition_sizes
         self.tcp_address = tcp_address
+        #: registry epoch this status was registered under (stamped by
+        #: MapOutputRegistry.register; stale re-registrations from a
+        #: superseded map run are rejected)
+        self.epoch = 0
 
-    def reachable_address(self, transport) -> str:
-        if transport.can_reach(self.address):
-            return self.address
-        if self.tcp_address:
-            return self.tcp_address
-        return self.address
+    def addresses(self) -> list[str]:
+        return [a for a in (self.address, self.tcp_address) if a]
+
+    def reachable_address(self, transport, health=None) -> str:
+        """Pick the lane to fetch from: loopback when it resolves in
+        this process, the wire otherwise — and when a PeerHealth
+        tracker is supplied, route around blacklisted addresses before
+        wasting their full timeout (the flapping-peer diet)."""
+        cands = self.addresses()
+        reach = [a for a in cands if transport.can_reach(a)] or cands
+        if health is not None:
+            ok = [a for a in reach if not health.is_blacklisted(a)]
+            if ok:
+                reach = ok
+        return reach[0]
+
+
+class StaleMapStatusError(Exception):
+    """A MapStatus registration carried a superseded epoch: the shuffle's
+    outputs were invalidated (peer loss) after the producing map run
+    started, so its result must not be served to reducers."""
 
 
 class MapOutputRegistry:
-    """Driver-side map output tracker (process-global)."""
+    """Driver-side map output tracker (process-global).  Plays Spark's
+    MapOutputTracker INCLUDING the fault-recovery surface: per-shuffle
+    epochs (bumped on every invalidation, so stale registrations are
+    rejected), executor/address invalidation (the FetchFailed ->
+    unregisterMapOutput path), and an expected-map-count so a reduce
+    read over an incomplete output set fails loudly instead of
+    returning partial data."""
 
     _lock = threading.Lock()
     _outputs: dict[int, dict[int, MapStatus]] = {}
+    _epochs: dict[int, int] = {}
+    _expected: dict[int, int] = {}
 
     @classmethod
     def register(cls, shuffle_id: int, map_id: int,
-                 status: MapStatus) -> None:
+                 status: MapStatus, epoch: Optional[int] = None) -> None:
         with cls._lock:
+            cur = cls._epochs.get(shuffle_id, 0)
+            if epoch is not None and epoch != cur:
+                raise StaleMapStatusError(
+                    f"map output {shuffle_id}/{map_id} registered at "
+                    f"epoch {epoch} but the shuffle is at epoch {cur}: "
+                    f"the producing map run was superseded by a "
+                    f"recovery invalidation")
+            status.epoch = cur
             cls._outputs.setdefault(shuffle_id, {})[map_id] = status
 
     @classmethod
@@ -77,14 +112,77 @@ class MapOutputRegistry:
             return dict(cls._outputs.get(shuffle_id, {}))
 
     @classmethod
+    def epoch(cls, shuffle_id: int) -> int:
+        with cls._lock:
+            return cls._epochs.get(shuffle_id, 0)
+
+    @classmethod
+    def set_expected_maps(cls, shuffle_id: int, num_maps: int) -> None:
+        """Record how many map tasks the shuffle has, arming the
+        missing-output guard in `missing_maps`."""
+        with cls._lock:
+            cls._expected[shuffle_id] = num_maps
+
+    @classmethod
+    def missing_maps(cls, shuffle_id: int) -> list[int]:
+        """Map ids whose outputs are invalidated-and-not-yet-recomputed
+        (empty when the expected count was never declared)."""
+        with cls._lock:
+            n = cls._expected.get(shuffle_id)
+            if n is None:
+                return []
+            outs = cls._outputs.get(shuffle_id, {})
+            return [m for m in range(n) if m not in outs]
+
+    @classmethod
+    def invalidate_address(cls, shuffle_id: int, address: str
+                           ) -> dict[int, MapStatus]:
+        """Drop every map output owned by the executor(s) advertising
+        `address` and bump the shuffle's epoch.  Returns the removed
+        {map_id: MapStatus} so recovery can recompute exactly those."""
+        with cls._lock:
+            outs = cls._outputs.get(shuffle_id, {})
+            execs = {s.executor_id for s in outs.values()
+                     if address in (s.address, s.tcp_address)}
+            lost = {m: s for m, s in outs.items()
+                    if s.executor_id in execs}
+            for m in lost:
+                del outs[m]
+            if lost:
+                cls._epochs[shuffle_id] = \
+                    cls._epochs.get(shuffle_id, 0) + 1
+            return lost
+
+    @classmethod
+    def invalidate_others(cls, shuffle_id: int, keep_executor_id: str
+                          ) -> dict[int, MapStatus]:
+        """Unattributable failure fallback: drop every map output NOT
+        owned by `keep_executor_id` (the reducing executor itself) and
+        bump the epoch — a conservative whole-stage invalidation."""
+        with cls._lock:
+            outs = cls._outputs.get(shuffle_id, {})
+            lost = {m: s for m, s in outs.items()
+                    if s.executor_id != keep_executor_id}
+            for m in lost:
+                del outs[m]
+            if lost:
+                cls._epochs[shuffle_id] = \
+                    cls._epochs.get(shuffle_id, 0) + 1
+            return lost
+
+    @classmethod
     def unregister_shuffle(cls, shuffle_id: int) -> None:
         with cls._lock:
             cls._outputs.pop(shuffle_id, None)
+            cls._epochs.pop(shuffle_id, None)
+            cls._expected.pop(shuffle_id, None)
 
     @classmethod
     def clear(cls) -> None:
         with cls._lock:
             cls._outputs.clear()
+            cls._epochs.clear()
+            cls._expected.clear()
 
 
 class TpuShuffleManager:
@@ -141,13 +239,21 @@ class TpuShuffleManager:
 
     def get_reader(self, shuffle_id: int, partition: int,
                    task_attempt_id: Optional[int] = None,
-                   timeout: float = 30.0) -> Iterator[ColumnarBatch]:
+                   timeout: float = 30.0,
+                   with_map_ids: bool = False) -> Iterator:
+        """Iterate one reduce partition's batches.  `with_map_ids`
+        yields (map_id, batch) tuples instead, so a recovery-aware
+        consumer can re-establish deterministic map order after a
+        recompute moved outputs between executors."""
         if task_attempt_id is None:
             # unique per reader so per-task receive cleanup cannot free a
             # concurrent reader's buffers
             task_attempt_id = next(TpuShuffleManager._attempt_ids)
-        return CachingShuffleReader(
+        it = CachingShuffleReader(
             self, shuffle_id, partition, task_attempt_id, timeout).read()
+        if with_map_ids:
+            return it
+        return (b for _, b in it)
 
 
 class CachingShuffleWriter:
@@ -177,12 +283,23 @@ class CachingShuffleWriter:
         self._sizes[partition] = self._sizes.get(partition, 0) + \
             buf.size_bytes
 
-    def commit(self, num_partitions: int) -> MapStatus:
+    def commit(self, num_partitions: int,
+               epoch: Optional[int] = None) -> MapStatus:
+        """Register the map output.  `epoch` (recovery recomputes only)
+        pins the registration to the registry epoch the recompute was
+        planned under: if another invalidation raced in, the commit is
+        rejected (StaleMapStatusError) and the written buffers freed —
+        a superseded map run must never serve reducers."""
         status = MapStatus(
             self.manager.executor_id, self.manager.loop_address,
             [self._sizes.get(p, 0) for p in range(num_partitions)],
             tcp_address=self.manager.tcp_address)
-        MapOutputRegistry.register(self.shuffle_id, self.map_id, status)
+        try:
+            MapOutputRegistry.register(self.shuffle_id, self.map_id,
+                                       status, epoch=epoch)
+        except StaleMapStatusError:
+            self.abort()
+            raise
         return status
 
     def abort(self) -> None:
@@ -192,8 +309,12 @@ class CachingShuffleWriter:
 
 
 class _IteratorHandler(ShuffleReceiveHandler):
-    def __init__(self, q: "queue.Queue"):
+    def __init__(self, q: "queue.Queue", current: dict):
         self.q = q
+        #: mutable cell the fetch loop updates with the peer address it
+        #: is currently draining, so errors carry the REAL peer (the
+        #: old literal "remote" hid which executor to invalidate)
+        self.current = current
         self.expected = 0
 
     def start(self, expected_batches: int) -> None:
@@ -203,7 +324,7 @@ class _IteratorHandler(ShuffleReceiveHandler):
         self.q.put(("batch", bid))
 
     def transfer_error(self, message: str) -> None:
-        self.q.put(("error", message))
+        self.q.put(("error", (self.current.get("addr"), message)))
 
 
 class CachingShuffleReader:
@@ -218,8 +339,24 @@ class CachingShuffleReader:
         self.partition = partition
         self.task_attempt_id = task_attempt_id
         self.timeout = timeout
+        # captured here (the consuming task's thread, session conf
+        # installed) because the fetch worker is a raw thread with no
+        # conf propagation
+        self.conf = C.get_active_conf()
 
-    def read(self) -> Iterator[ColumnarBatch]:
+    def read(self) -> Iterator[tuple[int, ColumnarBatch]]:
+        from spark_rapids_tpu.shuffle.recovery import PeerHealth
+        health = PeerHealth.get()
+        missing = MapOutputRegistry.missing_maps(self.shuffle_id)
+        if missing:
+            # invalidated-and-not-yet-recomputed outputs: reading the
+            # survivors would return PARTIAL data — surface the
+            # stage-retry signal instead (recovery recomputes, then the
+            # retried read sees a complete set)
+            raise FetchFailedError(
+                "unregistered", None,
+                f"shuffle {self.shuffle_id} is missing map outputs "
+                f"{missing} (superseded by a recovery invalidation)")
         outputs = MapOutputRegistry.outputs_for(self.shuffle_id)
         local_bids: list[BufferId] = []
         remote: dict[str, list[BlockIdMsg]] = {}
@@ -232,7 +369,8 @@ class CachingShuffleReader:
                     self.manager.shuffle_catalog.blocks_for_partition(
                         self.shuffle_id, self.partition, [map_id]))
             else:
-                addr = status.reachable_address(self.manager.transport)
+                addr = status.reachable_address(self.manager.transport,
+                                                health)
                 remote.setdefault(addr, []).append(
                     BlockIdMsg(self.shuffle_id, map_id, self.partition))
         try:
@@ -241,7 +379,7 @@ class CachingShuffleReader:
             for bid in local_bids:
                 with self.manager.env.catalog.acquired(bid) as buf:
                     sem.acquire_if_necessary()
-                    yield buf.get_columnar_batch()
+                    yield bid.map_id, buf.get_columnar_batch()
             # remote: issue fetches per peer, consume as they land
             yield from self._fetch_remote(remote, sem)
         finally:
@@ -262,19 +400,24 @@ class CachingShuffleReader:
                       sem) -> Iterator[ColumnarBatch]:
         if not remote:
             return
+        from spark_rapids_tpu.shuffle.recovery import PeerHealth
+        health = PeerHealth.get()
         q: "queue.Queue" = queue.Queue()
-        handler = _IteratorHandler(q)
+        current = {"addr": next(iter(remote))}
+        handler = _IteratorHandler(q, current)
         errors: list[BaseException] = []
         done = threading.Event()
 
         def fetch_all():
             try:
                 for address, blocks in remote.items():
+                    current["addr"] = address
                     conn = self.manager.transport.make_client(address)
                     client = ShuffleClient(
                         conn, self.manager.transport,
                         self.manager.received_catalog,
-                        self.manager.env.host_store, address)
+                        self.manager.env.host_store, address,
+                        conf=self.conf)
                     try:
                         client.fetch_blocks(blocks,
                                             self.task_attempt_id,
@@ -284,12 +427,17 @@ class CachingShuffleReader:
                         # connection on a retry: close whatever it
                         # currently holds, not the original handle
                         client.connection.close()
+                    health.record_success(address)
             except BaseException as e:  # noqa: BLE001
                 errors.append(e)
-                q.put(("fatal", str(e)))
+                q.put(("fatal", (current.get("addr"), str(e))))
             finally:
                 done.set()
                 q.put(("done", None))
+
+        def _first_block(addr):
+            blocks = remote.get(addr) or []
+            return blocks[0] if blocks else None
 
         t = threading.Thread(target=fetch_all, daemon=True,
                              name="tpu-shuffle-fetch")
@@ -300,29 +448,36 @@ class CachingShuffleReader:
             try:
                 kind, payload = q.get(timeout=self.timeout)
             except queue.Empty:
+                addr = current.get("addr") or "remote"
                 raise FetchFailedError(
-                    "remote", None,
+                    addr, _first_block(addr),
                     f"shuffle fetch timed out after {self.timeout}s") \
                     from None
             if kind == "batch":
                 received += 1
                 with self.manager.env.catalog.acquired(payload) as buf:
                     sem.acquire_if_necessary()
-                    yield buf.get_columnar_batch()
+                    yield payload.map_id, buf.get_columnar_batch()
             elif kind == "error":
-                raise FetchFailedError("remote", None, payload)
+                addr, msg = payload
+                addr = addr or "remote"
+                raise FetchFailedError(addr, _first_block(addr), msg)
             elif kind == "fatal":
+                addr, msg = payload
+                addr = addr or "remote"
                 err = errors[0] if errors else None
+                if isinstance(err, FetchFailedError):
+                    raise err
                 if isinstance(err, (OSError, ConnectionError, EOFError)):
                     # a dead/unreachable server is a FetchFailed (stage
                     # retry), never a raw socket error (reference
                     # RapidsShuffleIterator error path -> Spark
                     # FetchFailedException)
                     raise FetchFailedError(
-                        "remote", None,
+                        addr, _first_block(addr),
                         f"shuffle server unreachable: {err}") from err
                 raise err if err is not None else FetchFailedError(
-                    "remote", None, payload)
+                    addr, _first_block(addr), msg)
             elif kind == "done":
                 finished = True
             if finished and q.empty() and done.is_set():

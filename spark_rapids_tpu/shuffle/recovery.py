@@ -1,0 +1,217 @@
+"""Shuffle fault recovery: FetchFailed-driven map recomputation, peer
+health, and bounded stage retries.
+
+Reference: `RapidsShuffleIterator` converts transport failures into
+Spark `FetchFailedException` precisely so the DAG scheduler can
+invalidate the lost map outputs and re-run the producing stage.  This
+engine is its own scheduler, so the recovery loop lives here:
+
+  * **ShuffleRecoveryDriver** — wraps the reduce side of a manager-lane
+    exchange.  A `FetchFailedError` invalidates the failed peer's
+    entries in `MapOutputRegistry` (bumping the shuffle's epoch so
+    stale registrations are rejected), recomputes ONLY the lost map
+    tasks from the exchange's retained map-side lineage, and retries
+    the reduce — bounded by spark.rapids.shuffle.recovery
+    .maxStageAttempts, after which it degrades to a descriptive
+    `FetchFailedError`.  Never a hang, never a partial result.
+  * **PeerHealth** — process-global consecutive-failure blacklisting
+    with decay: a flapping peer is routed around (reads pick the
+    MapStatus's alternate address, map placement skips it) before we
+    waste its full timeout, and rejoins service once the blacklist
+    entry decays.
+
+Theseus (PAPERS.md) makes the same argument for distributed GPU query
+engines: data movement is its own failure domain and must be
+recoverable without restarting the query.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.shuffle.client_server import FetchFailedError
+from spark_rapids_tpu.shuffle.manager import (
+    MapOutputRegistry, StaleMapStatusError)
+from spark_rapids_tpu.utils import metrics as M
+
+log = logging.getLogger("spark_rapids_tpu.shuffle.recovery")
+
+#: injectable clock (tests advance it to exercise blacklist decay
+#: without sleeping)
+_now = time.monotonic
+
+
+class PeerHealth:
+    """Consecutive-failure peer blacklisting with decay (the role of
+    Spark's executor blacklist/excludeOnFailure for shuffle fetches).
+    Keyed by peer ADDRESS — one executor's loop and TCP lanes are
+    tracked independently, but recovery records failures on both."""
+
+    _GLOBAL: Optional["PeerHealth"] = None
+    _global_lock = threading.Lock()
+
+    @classmethod
+    def get(cls) -> "PeerHealth":
+        with cls._global_lock:
+            if cls._GLOBAL is None:
+                cls._GLOBAL = PeerHealth()
+            return cls._GLOBAL
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # addr -> [consecutive_failures, blacklisted_since | None]
+        self._state: dict[str, list] = {}
+        #: monotonic count of not-blacklisted -> blacklisted transitions
+        self.blacklist_events = 0
+
+    def _conf(self):
+        c = C.get_active_conf()
+        return (max(1, int(c[C.SHUFFLE_BLACKLIST_THRESHOLD])),
+                float(c[C.SHUFFLE_BLACKLIST_DECAY_S]))
+
+    def record_failure(self, address: str) -> bool:
+        """Count a recovery-attributed failure; returns True when this
+        failure newly blacklisted the address."""
+        threshold, _ = self._conf()
+        with self._lock:
+            st = self._state.setdefault(address, [0, None])
+            st[0] += 1
+            if st[1] is None and st[0] >= threshold:
+                st[1] = _now()
+                self.blacklist_events += 1
+                log.warning("shuffle peer %s blacklisted after %d "
+                            "consecutive failures", address, st[0])
+                return True
+            return False
+
+    def record_success(self, address: str) -> None:
+        with self._lock:
+            self._state.pop(address, None)
+
+    def is_blacklisted(self, address: str) -> bool:
+        _, decay = self._conf()
+        with self._lock:
+            st = self._state.get(address)
+            if st is None or st[1] is None:
+                return False
+            if _now() - st[1] > decay:
+                # decayed: the peer gets a fresh failure budget
+                self._state.pop(address, None)
+                return False
+            return True
+
+    def clear(self) -> None:
+        with self._lock:
+            self._state.clear()
+            self.blacklist_events = 0
+
+
+class ShuffleRecoveryDriver:
+    """Reduce-side retry loop for one shuffle of one exchange.
+
+    `recompute(lost_map_ids, epoch)` is the exchange's retained map-side
+    lineage: it re-runs exactly those child partitions, re-splits them,
+    and commits their map outputs at `epoch` (a commit racing a further
+    invalidation is rejected as stale and the next round re-derives
+    what is missing)."""
+
+    def __init__(self, manager, shuffle_id: int,
+                 recompute: Callable[[list[int], int], None],
+                 conf: Optional[C.RapidsConf] = None,
+                 metrics: Optional[M.MetricSet] = None,
+                 read_timeout: float = 30.0):
+        self.manager = manager
+        self.shuffle_id = shuffle_id
+        self.recompute = recompute
+        self.conf = conf or C.get_active_conf()
+        self.metrics = metrics if metrics is not None else M.MetricSet()
+        self.read_timeout = read_timeout
+        self.max_attempts = max(
+            1, int(self.conf[C.SHUFFLE_RECOVERY_MAX_STAGE_ATTEMPTS]))
+        self.health = PeerHealth.get()
+        # one recovery at a time per shuffle: concurrent reduce readers
+        # (prefetch producers) funnel their FetchFailures through here
+        self._lock = threading.Lock()
+
+    def read_partition(self, p: int) -> list:
+        """Fetch one reduce partition, recovering from peer loss.
+        Returns the partition's batches as a LIST: a retried attempt
+        restarts the partition from scratch, so nothing may be yielded
+        downstream until an attempt completes (no double counting)."""
+        attempt = 1
+        while True:
+            epoch0 = MapOutputRegistry.epoch(self.shuffle_id)
+            try:
+                items = list(self.manager.get_reader(
+                    self.shuffle_id, p, timeout=self.read_timeout,
+                    with_map_ids=True))
+                # deterministic map order: a recompute relocates map
+                # outputs between executors, which would otherwise
+                # reorder batches (local-first) vs the failure-free run
+                items.sort(key=lambda t: t[0])
+                return [b for _, b in items]
+            except FetchFailedError as e:
+                self.metrics.add(M.NUM_FETCH_FAILURES, 1)
+                if attempt >= self.max_attempts:
+                    raise FetchFailedError(
+                        e.address, e.block,
+                        f"shuffle {self.shuffle_id} partition {p} "
+                        f"still failing after {attempt} stage "
+                        f"attempt(s) (spark.rapids.shuffle.recovery."
+                        f"maxStageAttempts={self.max_attempts}): "
+                        f"{e}") from e
+                attempt += 1
+                self._recover(e, epoch0)
+
+    def _recover(self, e: FetchFailedError, epoch_seen: int) -> None:
+        with self._lock:
+            t0 = time.perf_counter_ns()
+            try:
+                if MapOutputRegistry.epoch(self.shuffle_id) != epoch_seen \
+                        and not MapOutputRegistry.missing_maps(
+                            self.shuffle_id):
+                    # another reader already recovered this loss while
+                    # we waited on the lock: just retry the read
+                    return
+                lost = MapOutputRegistry.invalidate_address(
+                    self.shuffle_id, e.address)
+                if not lost and not MapOutputRegistry.missing_maps(
+                        self.shuffle_id):
+                    # unattributable failure (no MapStatus advertises
+                    # that address): conservative whole-stage
+                    # invalidation of every remote peer
+                    lost = MapOutputRegistry.invalidate_others(
+                        self.shuffle_id, self.manager.executor_id)
+                by_exec: dict[str, set] = {}
+                for st in lost.values():
+                    by_exec.setdefault(st.executor_id, set()).update(
+                        st.addresses())
+                for eid, addrs in by_exec.items():
+                    flags = [self.health.record_failure(a)
+                             for a in sorted(addrs)]
+                    if any(flags):
+                        self.metrics.add(M.NUM_PEERS_BLACKLISTED, 1)
+                todo = sorted(set(lost) | set(
+                    MapOutputRegistry.missing_maps(self.shuffle_id)))
+                if todo:
+                    epoch = MapOutputRegistry.epoch(self.shuffle_id)
+                    log.warning(
+                        "shuffle %d recovery: recomputing map tasks %s "
+                        "at epoch %d after %s", self.shuffle_id, todo,
+                        epoch, e)
+                    try:
+                        self.recompute(todo, epoch)
+                    except StaleMapStatusError as stale:
+                        # a racing invalidation superseded this
+                        # recompute; the next attempt re-derives the
+                        # missing set at the fresh epoch
+                        log.warning("shuffle %d recompute superseded: "
+                                    "%s", self.shuffle_id, stale)
+                    self.metrics.add(M.NUM_MAP_RECOMPUTES, len(todo))
+                self.metrics.add(M.NUM_STAGE_RETRIES, 1)
+            finally:
+                self.metrics.add(M.RECOVERY_TIME,
+                                 time.perf_counter_ns() - t0)

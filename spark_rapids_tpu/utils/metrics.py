@@ -34,6 +34,15 @@ HOST_SYNCS = "hostSyncs"
 PIPELINE_WAIT_TIME = "pipelineWaitTime"
 PREFETCH_HITS = "prefetchHits"
 PREFETCH_STALLS = "prefetchStalls"
+# shuffle fault recovery (shuffle/recovery.py): fetch failures seen at
+# the reduce side, lost map tasks recomputed from lineage, bounded
+# reduce retries, peers newly blacklisted, and ns spent inside recovery
+# (invalidate + recompute), charged to the owning exchange
+NUM_FETCH_FAILURES = "numFetchFailures"
+NUM_MAP_RECOMPUTES = "numMapRecomputes"
+NUM_STAGE_RETRIES = "numStageRetries"
+NUM_PEERS_BLACKLISTED = "numPeersBlacklisted"
+RECOVERY_TIME = "recoveryTime"
 
 
 class MetricSet:

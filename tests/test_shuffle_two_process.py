@@ -259,6 +259,119 @@ def test_cross_process_two_concurrent_reducers():
         mgr.close()
 
 
+CHILD_SERVER = r"""
+import json, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import pandas as pd
+import spark_rapids_tpu
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.shuffle.manager import TpuShuffleManager
+
+# throttle the data plane so the parent's fetch is reliably IN FLIGHT
+# when it kills this process (a fast local socket would otherwise race
+# the kill): every DATA-sized frame pays a small sleep
+import spark_rapids_tpu.shuffle.ici_transport as ici
+_orig_send = ici._send_all
+def _slow_send(conn, data):
+    _orig_send(conn, data)
+    if len(data) > 512:
+        time.sleep(0.01)
+ici._send_all = _slow_send
+
+spec = json.loads(sys.stdin.readline())
+conf = C.RapidsConf({
+    "spark.rapids.shuffle.enabled": True,
+    "spark.rapids.shuffle.bounceBuffers.size": spec["bounce"]})
+with C.session(conf):
+    mgr = TpuShuffleManager("executor-S")
+    mgr.register_shuffle(spec["shuffle_id"])
+    rng = np.random.default_rng(5)
+    outputs = []
+    for map_id, rows in enumerate(spec["map_rows"]):
+        w = mgr.get_writer(spec["shuffle_id"], map_id)
+        k = rng.integers(0, 1000, rows).astype(np.int64)
+        w.write_partition(0, ColumnarBatch.from_pandas(
+            pd.DataFrame({"k": k})))
+        st = w.commit(1)
+        outputs.append({"map_id": map_id,
+                        "executor_id": st.executor_id,
+                        "tcp_address": st.tcp_address,
+                        "partition_sizes": st.partition_sizes})
+    print("OUTPUTS:" + json.dumps(outputs), flush=True)
+    while True:  # serve until killed
+        time.sleep(0.2)
+"""
+
+
+def test_kill_server_process_mid_fetch_fetch_failed():
+    """The serving executor PROCESS is killed while a transfer is in
+    flight: the reader must drop partials, exhaust its bounded retries
+    against the dead address, and surface FetchFailedError naming the
+    peer — promptly, not after hanging (reference RapidsShuffleIterator
+    error path on a lost UCX endpoint)."""
+    import time as _time
+
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.shuffle.client_server import FetchFailedError
+    from spark_rapids_tpu.shuffle.manager import (
+        MapOutputRegistry, MapStatus, TpuShuffleManager)
+
+    shuffle_id = 4247
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", CHILD_SERVER],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, env=env, cwd=cwd)
+    try:
+        # map 0 is tiny (its batch completes fast -> we know the
+        # stream is live); map 1 is ~200 throttled chunks (~2s), so
+        # the kill below lands mid-transfer deterministically
+        spec = {"shuffle_id": shuffle_id, "bounce": 4096,
+                "map_rows": [64, 100_000]}
+        proc.stdin.write((json.dumps(spec) + "\n").encode())
+        proc.stdin.flush()
+        line = b""
+        deadline = _time.monotonic() + 180
+        while not line.startswith(b"OUTPUTS:"):
+            assert _time.monotonic() < deadline, "server never came up"
+            line = proc.stdout.readline()
+            assert line, proc.stderr.read().decode()[-2000:]
+        outputs = json.loads(line.decode()[len("OUTPUTS:"):])
+
+        conf = C.RapidsConf({
+            "spark.rapids.shuffle.enabled": True,
+            "spark.rapids.shuffle.fetch.maxRetries": 1,
+            "spark.rapids.shuffle.fetch.backoff.baseMs": 1.0})
+        with C.session(conf):
+            mgr = TpuShuffleManager("executor-R")
+            mgr.register_shuffle(shuffle_id)
+            for o in outputs:
+                MapOutputRegistry.register(shuffle_id, o["map_id"], MapStatus(
+                    o["executor_id"], o["tcp_address"],
+                    o["partition_sizes"]))
+            t0 = _time.monotonic()
+            got_rows = 0
+            with pytest.raises(FetchFailedError) as ei:
+                for b in mgr.get_reader(shuffle_id, 0, timeout=20.0):
+                    got_rows += b.num_rows
+                    if got_rows <= 64:  # first (tiny) batch landed
+                        proc.kill()     # SIGKILL mid-stream of map 1
+            elapsed = _time.monotonic() - t0
+            assert elapsed < 30.0, f"FetchFailed took {elapsed:.1f}s"
+            assert "tcp://" in str(ei.value)
+            assert got_rows < 64 + 100_000, "full data despite kill?"
+            mgr.unregister_shuffle(shuffle_id)
+            mgr.close()
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
+
+
 def test_cross_process_dead_server_fetch_failed():
     """Fetching from a server that has gone away must surface the
     FetchFailed semantics (stage-retry signal), not hang (reference
