@@ -23,6 +23,49 @@ run_fast() {
   run_oom_soak
   run_pipeline
   run_recovery
+  run_watchdog
+}
+
+run_watchdog() {
+  # liveness lane: every seeded hang site (producer, collective,
+  # shuffle-server, pyudf, compile) must end in a descriptive
+  # TpuQueryTimeout + diagnostic dump within ~2x its deadline — never
+  # a hang, never leaked permits/threads — and the process must run a
+  # clean bit-exact query afterwards.  The summary line reports the
+  # timeout/cancel metrics of one injected query.
+  echo "== watchdog lane (seeded hang injection, deadlines, cancellation) =="
+  "${PYTEST[@]}" tests/test_watchdog.py
+  python - <<'PYEOF'
+import time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+from spark_rapids_tpu.plan.overrides import ExecutionPlanCapture
+from spark_rapids_tpu.utils import watchdog as W
+
+tables = gen_tables(np.random.default_rng(11), 500)
+conf = C.RapidsConf({**BENCH_CONF,
+    "spark.rapids.memory.faultInjection.hangSite": "producer",
+    "spark.rapids.memory.faultInjection.hangAfterBatches": 1,
+    "spark.rapids.sql.watchdog.taskTimeout": 2.0,
+    "spark.rapids.sql.watchdog.pollInterval": 0.1})
+t0 = time.monotonic()
+try:
+    run_query(1, tables, engine="tpu", conf=conf)
+    raise SystemExit("hang injection did not cancel the query")
+except W.TpuQueryTimeout:
+    pass
+el = time.monotonic() - t0
+m = ExecutionPlanCapture.last_plan.metrics.as_dict()
+print("watchdog summary: cancelled_in=%.1fs timeouts=%d cancels=%d "
+      "dumps=%d slowest_heartbeat_ms=%d" % (
+          el, m.get("numWatchdogTimeouts", 0), m.get("numCancels", 0),
+          m.get("watchdogDumps", 0), m.get("slowestHeartbeatMs", 0)))
+W.reset_hang_injection()
+PYEOF
 }
 
 run_recovery() {
@@ -123,7 +166,8 @@ case "$TIER" in
   oom)      run_oom_soak ;;
   pipeline) run_pipeline ;;
   recovery) run_recovery ;;
+  watchdog) run_watchdog ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|all]" >&2
+  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|all]" >&2
      exit 2 ;;
 esac

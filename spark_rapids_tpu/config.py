@@ -145,6 +145,63 @@ OOM_INJECT_MAX = conf(
     "guaranteeing forward progress in soak loops even at oomRate=1.0 "
     "(0 = unlimited).")
 
+# --- query watchdog (utils/watchdog.py) --------------------------------------
+WATCHDOG_ENABLED = conf(
+    "spark.rapids.sql.watchdog.enabled", True,
+    "Detect hung queries: every long-lived activity (prefetch "
+    "producers, shuffle servers and fetch loops, collective-exchange "
+    "dispatches, AQE stage fills, pyudf workers, XLA compiles) "
+    "registers a progress heartbeat; a scanner thread that sees no "
+    "progress past the activity's deadline class emits one diagnostic "
+    "dump and cancels the query cooperatively, raising a descriptive "
+    "TpuQueryTimeout instead of hanging forever.  The liveness analog "
+    "of Spark's task-level speculation/kill machinery, which a "
+    "standalone engine otherwise lacks.")
+WATCHDOG_POLL_INTERVAL = conf(
+    "spark.rapids.sql.watchdog.pollInterval", 1.0,
+    "Seconds between watchdog scans of registered heartbeats.  Bounds "
+    "detection latency at deadline + pollInterval; lower values only "
+    "matter with sub-second deadlines (tests).")
+WATCHDOG_TASK_TIMEOUT = conf(
+    "spark.rapids.sql.watchdog.taskTimeout", 300.0,
+    "Deadline (seconds) for task-class activities: prefetch producer "
+    "loops, shuffle server/fetch handlers, AQE stage fills, pyudf "
+    "workers.  An activity making no progress for this long is "
+    "declared hung and the query is cancelled with a diagnostic dump.")
+WATCHDOG_COLLECTIVE_TIMEOUT = conf(
+    "spark.rapids.sql.watchdog.collectiveTimeout", 120.0,
+    "Deadline (seconds) for collective-class activities (ICI "
+    "all-to-all exchange dispatches).  Collectives block ALL mesh "
+    "participants when one goes dark, so their deadline is tighter "
+    "than the task class.")
+WATCHDOG_COMPILE_TIMEOUT = conf(
+    "spark.rapids.sql.watchdog.compileTimeout", 600.0,
+    "Deadline (seconds) for XLA kernel compiles (and single-flight "
+    "waiters parked on another thread's compile).  Sort-heavy shapes "
+    "legitimately compile for minutes; raise this before blaming a "
+    "pathological compile.")
+WATCHDOG_DUMP_ON_TIMEOUT = conf(
+    "spark.rapids.sql.watchdog.dumpOnTimeout", True,
+    "Emit one diagnostic dump (all thread stacks, semaphore holders, "
+    "prefetch queue stats, in-flight shuffle fetches, hang-injection "
+    "state) when the watchdog declares a timeout; the dump rides on "
+    "the raised TpuQueryTimeout and is logged at ERROR.")
+HANG_INJECT_SITE = conf(
+    "spark.rapids.memory.faultInjection.hangSite", "",
+    "TEST ONLY: inject a hang at the named site so watchdog "
+    "detection, cancellation, and resource release are testable "
+    "without a real dead peer or wedged compile.  Sites: producer "
+    "(prefetch producer loop), collective (mesh exchange dispatch), "
+    "shuffle-server (chunk emit stall), pyudf (wedged UDF worker), "
+    "compile (KernelCache builder).  The injected hang blocks until "
+    "the query's CancelToken fires — like a Spark task kill, "
+    "cancellation is cooperative.  Empty disables.", internal=True)
+HANG_INJECT_AFTER = conf(
+    "spark.rapids.memory.faultInjection.hangAfterBatches", 0,
+    "TEST ONLY: the injected hang engages after this many units of "
+    "progress (batches produced, chunks served, compiles started) at "
+    "the configured hangSite.", internal=True)
+
 # --- async pipelined execution (exec/pipeline.py) ----------------------------
 # env-overridable defaults so CI lanes (scripts/run_suite.sh pipeline)
 # can flip the whole suite without threading a conf through every test
@@ -439,6 +496,14 @@ class RapidsConf:
 
     def __init__(self, settings: Optional[dict[str, Any]] = None):
         self._settings = dict(settings or {})
+
+    def is_set(self, key: str) -> bool:
+        """True when `key` was EXPLICITLY set on this conf (as opposed
+        to resolving through the registry default) — lets layered
+        defaults (e.g. the test harness's conservative global watchdog
+        deadlines) yield to per-session settings without shadowing
+        them."""
+        return key in self._settings
 
     def get(self, key: str, default: Any = None) -> Any:
         if key in self._settings:

@@ -114,14 +114,26 @@ class KernelCache:
         self._scope = scope
         self._cache: dict = {} if scope is None else None
 
+    @staticmethod
+    def _build_watched(key, builder: Callable[[], Callable]):
+        """Run the (seconds-to-minutes) trace/compile under a
+        compile-class watchdog heartbeat, with the compile hang-
+        injection site in front so a wedged XLA compile is testable."""
+        from spark_rapids_tpu.utils import watchdog as W
+        with W.heartbeat(f"compile:{key!r:.120}", kind="compile"):
+            W.maybe_hang("compile")
+            return builder()
+
     def get_or_build(self, key: tuple, builder: Callable[[], Callable]):
         if self._scope is None:
             fn = self._cache.get(key)
             if fn is None:
-                fn = builder()
+                fn = self._build_watched(key, builder)
                 self._cache[key] = fn
             return fn
+        from spark_rapids_tpu.utils import watchdog as W
         gk = (self._scope, key)
+        claimed: Optional[threading.Event] = None
         while True:
             with _GLOBAL_KERNELS_LOCK:
                 fn = _GLOBAL_KERNELS.get(gk)
@@ -131,27 +143,43 @@ class KernelCache:
                 ev = _GLOBAL_KERNELS_BUILDING.get(gk)
                 if ev is None:
                     # claim the build; compile happens OUTSIDE the lock
-                    ev = threading.Event()
-                    _GLOBAL_KERNELS_BUILDING[gk] = ev
+                    claimed = threading.Event()
+                    _GLOBAL_KERNELS_BUILDING[gk] = claimed
                     break
             # another thread is tracing/compiling this exact kernel:
-            # wait for it instead of double-compiling.  On wake, either
-            # the entry is cached (loop hits it) or the builder failed
-            # (loop re-claims and this thread builds).
-            ev.wait(timeout=600.0)
+            # wait for it instead of double-compiling, bounded by the
+            # watchdog's compile deadline (and cancellable).  On wake,
+            # either the entry is cached (loop hits it) or the builder
+            # failed (loop re-claims and this thread builds).  On
+            # TIMEOUT the builder may be wedged: fall through and
+            # compile in THIS thread — a benign double compile, never
+            # a proceed-with-missing-entry.
+            if not W.cancellable_wait(ev, W.deadline_for("compile")):
+                import logging
+                logging.getLogger("spark_rapids_tpu.exec").warning(
+                    "kernel single-flight wait exceeded the compile "
+                    "deadline for %r; the claiming builder may be "
+                    "wedged — compiling in this thread instead",
+                    gk[1])
+                break
         try:
-            fn = builder()  # trace/compile outside the lock
+            fn = self._build_watched(key, builder)  # outside the lock
         except BaseException:
-            with _GLOBAL_KERNELS_LOCK:
-                _GLOBAL_KERNELS_BUILDING.pop(gk, None)
-            ev.set()
+            if claimed is not None:
+                with _GLOBAL_KERNELS_LOCK:
+                    if _GLOBAL_KERNELS_BUILDING.get(gk) is claimed:
+                        _GLOBAL_KERNELS_BUILDING.pop(gk, None)
+                claimed.set()
             raise
         with _GLOBAL_KERNELS_LOCK:
             _GLOBAL_KERNELS[gk] = fn
             while len(_GLOBAL_KERNELS) > _GLOBAL_KERNELS_MAX:
                 _GLOBAL_KERNELS.popitem(last=False)
-            _GLOBAL_KERNELS_BUILDING.pop(gk, None)
-        ev.set()
+            if claimed is not None and \
+                    _GLOBAL_KERNELS_BUILDING.get(gk) is claimed:
+                _GLOBAL_KERNELS_BUILDING.pop(gk, None)
+        if claimed is not None:
+            claimed.set()
         return fn
 
     def __len__(self):
@@ -266,13 +294,16 @@ class TpuExec:
         the offending fast path and re-execute (plans are pure), up to
         MAX_DEOPT_RETRIES times."""
         from spark_rapids_tpu.utils import checks as CK
+        from spark_rapids_tpu.utils import watchdog as W
         me = threading.get_ident()
+        outermost_entry = False
         with _COLLECT_LOCK:
             # atomic claim: without the lock two threads entering at
             # depth 0 simultaneously would both pass and race the
             # epoch bump / release_execution_state
             if _COLLECT_DEPTH[0] == 0:
                 _COLLECT_OWNER[0] = me
+                outermost_entry = True
             elif _COLLECT_OWNER[0] != me:
                 raise RuntimeError(
                     "concurrent top-level collect() from a second "
@@ -281,6 +312,10 @@ class TpuExec:
                     "on the driver thread and hand batches to workers "
                     "instead")
             _COLLECT_DEPTH[0] += 1
+        if outermost_entry:
+            # fresh per-query CancelToken: a previous query's watchdog
+            # cancellation must not bleed into this one
+            W.begin_query()
         mark = CK.snapshot()
         try:
             for attempt in range(self.MAX_DEOPT_RETRIES + 1):
@@ -327,6 +362,19 @@ class TpuExec:
                 # materializing its child mid-plan) must not clear the
                 # enclosing query's CommonSubplanExec results
                 self.release_execution_state()
+                qs = W.query_stats()
+                if qs["timeouts"] or qs["cancels"]:
+                    # charge watchdog activity to the plan root ONLY on
+                    # a tripped query — a clean collect must not force
+                    # a metric resolve (device readbacks) it would
+                    # otherwise defer
+                    self.metrics.add(M.NUM_WATCHDOG_TIMEOUTS,
+                                     qs["timeouts"])
+                    self.metrics.add(M.NUM_CANCELS, qs["cancels"])
+                    self.metrics.add(M.WATCHDOG_DUMPS, qs["dumps"])
+                    self.metrics.set_max(
+                        M.SLOWEST_HEARTBEAT,
+                        qs["slowest_heartbeat_ms"])
 
     def _collect_once(self) -> ColumnarBatch:
         from spark_rapids_tpu.columnar.batch import concat_batches, empty_batch
@@ -367,11 +415,17 @@ class TpuExec:
         consumers): pressure there spills + retries in place and the
         floor fallback handles the rest."""
         from spark_rapids_tpu.memory import retry as R
+        from spark_rapids_tpu.utils import watchdog as W
         label = label or self.name()
+        # batch boundary = cancellation point: a watchdog-cancelled
+        # query stops dispatching new work here instead of grinding on
+        W.check_cancelled()
         if split:
-            yield from R.with_split_retry(
-                batch, body, metrics=self.metrics,
-                out_bytes_fn=out_bytes_fn, label=label)
+            for out in R.with_split_retry(
+                    batch, body, metrics=self.metrics,
+                    out_bytes_fn=out_bytes_fn, label=label):
+                W.check_cancelled()
+                yield out
         else:
             nbytes = (out_bytes_fn or R.estimate_batch_bytes)(batch)
             yield R.with_retry(lambda: body(batch), out_bytes=nbytes,

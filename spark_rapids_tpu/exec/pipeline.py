@@ -42,12 +42,15 @@ Discipline (the parts that make this safe rather than just concurrent):
 from __future__ import annotations
 
 import itertools
+import logging
 import queue
 import threading
 import time
 from typing import Iterable, Iterator, Optional
 
 from spark_rapids_tpu.utils import metrics as M
+
+log = logging.getLogger("spark_rapids_tpu.pipeline")
 
 #: end-of-stream sentinel (errors ride on `self._error`, set before this)
 _DONE = object()
@@ -61,11 +64,18 @@ _PRODUCER_TASK_IDS = itertools.count(1 << 40)
 #: paid on the (rare) full/empty-with-dead-producer edges
 _POLL_S = 0.05
 
+#: how long close()/_finish wait for a producer thread before declaring
+#: it leaked (module-level so the watchdog suite can shrink it)
+_JOIN_TIMEOUT_S = 10.0
+
 # process-wide stats (bench.py records these alongside wall clock so the
-# perf trajectory captures overlap, not just totals)
+# perf trajectory captures overlap, not just totals; leaked_producers
+# counts threads that survived the close() join — surfaced in the
+# watchdog dump, because a leaked producer is exactly the kind of
+# wedged activity the watchdog exists to name)
 _STATS_LOCK = threading.Lock()
 _STATS = {"producers": 0, "hits": 0, "stalls": 0, "wait_ns": 0,
-          "blocked_puts": 0}
+          "blocked_puts": 0, "leaked_producers": 0}
 
 
 def pipeline_stats() -> dict:
@@ -111,6 +121,12 @@ class PrefetchIterator:
         #: so construction-time capture is exact)
         from spark_rapids_tpu.utils import checks as CK
         self._retrying = CK.is_retrying()
+        #: the creating query's cancel token: producer put polls and
+        #: consumer get polls both check it, so neither side of the
+        #: queue can outlive a watchdog cancellation
+        from spark_rapids_tpu.utils import watchdog as W
+        self._token = W.current_token()
+        self._hb = None
         self._closed = threading.Event()
         #: test-facing: set while the producer is parked on a full queue
         #: (the window in which it must not hold the TPU semaphore)
@@ -150,6 +166,12 @@ class PrefetchIterator:
                 try:
                     return self._q.get(timeout=_POLL_S)
                 except queue.Empty:
+                    if self._token.cancelled:
+                        # watchdog cancellation: release what the
+                        # producer buffered before surfacing, so the
+                        # failed query pins nothing
+                        self.close()
+                        self._token.check()
                     t = self._thread
                     if t is None or not t.is_alive():
                         # producer exited: drain the put/exit race, then
@@ -175,10 +197,7 @@ class PrefetchIterator:
                 self._q.get_nowait()
         except queue.Empty:
             pass
-        t = self._thread
-        if (t is not None and t.is_alive()
-                and t is not threading.current_thread()):
-            t.join(timeout=10.0)
+        self._join_or_leak()
 
     def __del__(self):
         try:
@@ -187,9 +206,28 @@ class PrefetchIterator:
             pass
 
     def _finish(self) -> None:
+        self._join_or_leak()
+
+    def _join_or_leak(self) -> None:
+        """Join the producer; a thread that survives the bounded join
+        is LEAKED, not silently forgotten: it is counted in the
+        process-wide pipeline stats (surfaced in the watchdog dump)
+        and its stack is logged so the wedged frame is attributable."""
         t = self._thread
-        if t is not None and t is not threading.current_thread():
-            t.join(timeout=10.0)
+        if (t is None or not t.is_alive()
+                or t is threading.current_thread()):
+            return
+        t.join(timeout=_JOIN_TIMEOUT_S)
+        if not t.is_alive():
+            return
+        self._thread = None  # joining again later cannot succeed
+        _bump("leaked_producers")
+        from spark_rapids_tpu.utils import watchdog as W
+        stack = W.thread_stack(t.ident)
+        log.warning(
+            "prefetch producer %s survived the %.0fs close() join and "
+            "was leaked (source iterator is wedged); stack:\n%s",
+            t.name, _JOIN_TIMEOUT_S, stack or "<unavailable>")
 
     # -- producer side ------------------------------------------------------
     def _ensure_started(self) -> None:
@@ -204,6 +242,7 @@ class PrefetchIterator:
         from spark_rapids_tpu import config as C
         from spark_rapids_tpu.memory.semaphore import TaskContext
         from spark_rapids_tpu.utils import checks as CK
+        from spark_rapids_tpu.utils import watchdog as W
         if self._retrying:
             CK.set_retrying(True)
         own_ctx = None
@@ -212,16 +251,30 @@ class PrefetchIterator:
         else:
             own_ctx = TaskContext(next(_PRODUCER_TASK_IDS))
             TaskContext.set_current(own_ctx)
+        # thread the query's cancel token through the TaskContext so
+        # downstream checks on this thread reach the right token
+        cur = TaskContext.get()
+        if cur is not None and getattr(cur, "cancel_token", None) is None:
+            cur.cancel_token = self._token
         try:
             with C.session(self._conf):
+                hb = W.heartbeat(f"producer:{self._label}",
+                                 kind="task",
+                                 details=lambda: f"queue depth "
+                                 f"{self._q.qsize()}/{self._q.maxsize}")
+                self._hb = hb
                 try:
-                    for item in self._source:
-                        if not self._put(item):
-                            return  # consumer closed
+                    with hb:
+                        for item in self._source:
+                            hb.beat()
+                            W.maybe_hang("producer")
+                            if not self._put(item):
+                                return  # consumer closed
                 except BaseException as e:  # noqa: BLE001 — re-raised
                     self._error = e         # at the consumer's pull
                 self._put(_DONE)
         finally:
+            self._hb = None
             try:
                 close = getattr(self._source, "close", None)
                 if close is not None:
@@ -244,11 +297,21 @@ class PrefetchIterator:
         except queue.Full:
             pass
         from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+        from contextlib import nullcontext
         _bump("blocked_puts")
         self.blocked.set()
+        hb = self._hb
         try:
-            with TpuSemaphore.get().yielded():
+            # parked on a full queue: this is the CONSUMER's stall, not
+            # ours — pause the producer heartbeat so backpressure is
+            # never mistaken for a hang, and watch the cancel token so
+            # a cancelled query's producer exits instead of parking
+            # forever on a queue nobody will drain
+            with TpuSemaphore.get().yielded(), \
+                    (hb.pause() if hb is not None else nullcontext()):
                 while not self._closed.is_set():
+                    if self._token.cancelled:
+                        return False
                     try:
                         self._q.put(item, timeout=_POLL_S)
                         return True

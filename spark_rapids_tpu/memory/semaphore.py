@@ -130,6 +130,13 @@ class TpuSemaphore:
         with self._lock:
             return len(self._refs)
 
+    def snapshot(self) -> dict[int, int]:
+        """Copy of the per-task refcount table (task_attempt_id ->
+        holds) for the watchdog's diagnostic dump: after a cancelled
+        query releases everything, this must come back empty."""
+        with self._lock:
+            return dict(self._refs)
+
     def holds(self, ctx: Optional[TaskContext] = None) -> int:
         """Refcount held by the given (default: current) task — 0 means
         it does not hold the accelerator.  Test-facing: the pipeline

@@ -37,6 +37,24 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.ops.murmur3 import partition_ids as murmur3_pids
 
 
+def watched_collective(thunk, label: str = "all-to-all"):
+    """Run one collective dispatch (and its blocking host readback)
+    under a collective-class watchdog heartbeat: an ICI all-to-all
+    blocks EVERY mesh participant when one goes dark, so it gets the
+    tighter `spark.rapids.sql.watchdog.collectiveTimeout` deadline and
+    its own hang-injection site.  A real wedged collective cannot be
+    interrupted host-side (the driver is inside the runtime), but the
+    watchdog still emits the diagnostic dump naming this dispatch and
+    cancels the query so every cooperative wait unwinds."""
+    from spark_rapids_tpu.utils import watchdog as W
+    with W.heartbeat(f"collective:{label}", kind="collective") as hb:
+        W.check_cancelled()
+        W.maybe_hang("collective")
+        out = thunk()
+        hb.beat()
+        return out
+
+
 def _local_split(cols, num_rows, key_idx, n_dev, cap):
     """Sort local rows by destination device; return per-dest counts and
     the [n_dev, cap, ...] send buffers."""

@@ -126,13 +126,18 @@ class ShuffleQueryStageExec(LeafExec):
         return self
 
     def _fill_run(self, conf) -> None:
+        from spark_rapids_tpu.utils import watchdog as W
         try:
             with C.session(conf):
-                for p, it in enumerate(self.exchange.execute_partitions()):
-                    for b in it:
-                        self._acc[p].append(b)
-                        self._queues[p].put(b)
-                    self._queues[p].put(_PART_DONE)
+                with W.heartbeat("aqe-stage-fill", kind="task") as hb:
+                    for p, it in enumerate(
+                            self.exchange.execute_partitions()):
+                        for b in it:
+                            W.check_cancelled()
+                            hb.beat()
+                            self._acc[p].append(b)
+                            self._queues[p].put(b)
+                        self._queues[p].put(_PART_DONE)
         except BaseException as e:  # noqa: BLE001 — re-raised at readers
             self._fill_error = e
             for q in self._queues:
@@ -140,10 +145,15 @@ class ShuffleQueryStageExec(LeafExec):
 
     def _finish_fill(self) -> None:
         """Block until the fill thread completes and promote the
-        accumulated batches to `_buckets` (re-raising a fill error)."""
+        accumulated batches to `_buckets` (re-raising a fill error).
+        The join is a bounded poll: a watchdog-cancelled query raises
+        out instead of waiting forever on a wedged fill."""
+        from spark_rapids_tpu.utils import watchdog as W
         t = self._fill
         if t is not None:
-            t.join()
+            while t.is_alive():
+                W.check_cancelled()
+                t.join(timeout=0.25)
             self._fill = None
             self._queues = None
             if self._fill_error is not None:
@@ -201,10 +211,18 @@ class ShuffleQueryStageExec(LeafExec):
         afterwards (or on re-reads) it serves the held bucket."""
         if self._buckets is None and self._fill is not None \
                 and p not in self._consumed:
+            from spark_rapids_tpu.utils import watchdog as W
             self._consumed.add(p)
             q = self._queues[p]
+            import queue as _q
             while True:
-                b = q.get()
+                try:
+                    b = q.get(timeout=0.25)
+                except _q.Empty:
+                    # bounded poll: honor a watchdog cancellation
+                    # instead of parking forever on a wedged fill
+                    W.check_cancelled()
+                    continue
                 if b is _PART_DONE:
                     break
                 yield b

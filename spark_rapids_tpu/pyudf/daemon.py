@@ -171,12 +171,20 @@ class PythonWorkerPool:
 
     def run_udf(self, fn: Callable, df: pd.DataFrame) -> pd.DataFrame:
         import cloudpickle
+        from spark_rapids_tpu.utils import watchdog as W
         fn_blob = cloudpickle.dumps(fn)  # before checkout: a pickling
         # failure must not touch pool state
         w = self._checkout()
         reusable = False
         try:
-            out = w.run(fn_blob, df)
+            # a worker that never answers is the pyudf hang mode: the
+            # heartbeat names it, the injector fakes it, and a
+            # cancelled run closes the worker (not reusable) so the
+            # pool slot comes back clean
+            with W.heartbeat(f"pyudf:worker-pid{w.proc.pid}",
+                             kind="task"):
+                W.maybe_hang("pyudf")
+                out = w.run(fn_blob, df)
             reusable = True
             return out
         except PythonUdfError:

@@ -52,8 +52,32 @@ class FetchFailedError(Exception):
         return self.block.map_id if self.block is not None else None
 
 
+def _cancellable_backoff_sleep(seconds: float) -> None:
+    """Default retry sleep: bounded-poll + cancel-token check, so a
+    backoff never outlives a watchdog-cancelled query."""
+    from spark_rapids_tpu.utils import watchdog as W
+    W.cancellable_sleep(seconds)
+
+
 #: injectable so soak tests can capture/skip the retry sleeps
-_backoff_sleep = time.sleep
+_backoff_sleep = _cancellable_backoff_sleep
+
+#: in-flight fetch registry, surfaced by the watchdog's diagnostic dump
+#: so a timed-out query names the peer + blocks it was waiting on
+_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT: dict[int, dict] = {}
+_INFLIGHT_IDS = iter(range(1, 1 << 62))
+
+
+def inflight_fetches() -> list[dict]:
+    """Snapshot of fetches currently in flight: address, block ids,
+    attempt, and seconds in flight."""
+    now = time.monotonic()
+    with _INFLIGHT_LOCK:
+        snaps = [dict(v) for v in _INFLIGHT.values()]
+    for f in snaps:
+        f["in_flight_s"] = round(now - f.pop("_t0"), 2)
+    return snaps
 
 
 class ShuffleReceiveHandler:
@@ -79,13 +103,15 @@ class BufferReceiveState:
                  received_catalog: ShuffleReceivedBufferCatalog,
                  host_store, task_attempt_id: int,
                  limiter: InflightLimiter,
-                 handler: ShuffleReceiveHandler):
+                 handler: ShuffleReceiveHandler,
+                 progress: Optional[Callable[[], None]] = None):
         self.metas = {m.table_id: m for m in metas}
         self.received_catalog = received_catalog
         self.host_store = host_store
         self.task_attempt_id = task_attempt_id
         self.limiter = limiter
         self.handler = handler
+        self.progress = progress
         self.completed: set[int] = set()
         self._chunks: dict[int, list[bytes]] = {}
         self._lock = threading.Lock()
@@ -93,6 +119,8 @@ class BufferReceiveState:
     def on_chunk(self, table_id: int, seq: int, chunk: bytes,
                  is_last: bool, codec_id: int = -1,
                  raw_len: int = 0) -> None:
+        if self.progress is not None:
+            self.progress()  # chunk landed: the fetch is alive
         with self._lock:
             parts = self._chunks.setdefault(table_id, [])
             assert seq == len(parts), (
@@ -142,6 +170,7 @@ class ShuffleClient:
         self.host_store = host_store
         self.address = address
         conf = conf or C.get_active_conf()
+        self.conf = conf
         self.max_retries = int(conf[C.SHUFFLE_FETCH_MAX_RETRIES])
         self._backoff_base = \
             float(conf[C.SHUFFLE_FETCH_BACKOFF_BASE_MS]) / 1000.0
@@ -162,6 +191,25 @@ class ShuffleClient:
     def fetch_blocks(self, blocks: Sequence[BlockIdMsg],
                      task_attempt_id: int,
                      handler: ShuffleReceiveHandler) -> list[TableMetaMsg]:
+        from spark_rapids_tpu.utils import watchdog as W
+        fid = next(_INFLIGHT_IDS)
+        with _INFLIGHT_LOCK:
+            _INFLIGHT[fid] = {
+                "address": self.address, "attempt": 0,
+                "blocks": [str(b) for b in blocks[:8]],
+                "_t0": time.monotonic()}
+        with W.heartbeat(f"shuffle-fetch:{self.address}",
+                         kind="task", conf=self.conf) as hb:
+            try:
+                return self._fetch_blocks(blocks, task_attempt_id,
+                                          handler, hb, fid)
+            finally:
+                with _INFLIGHT_LOCK:
+                    _INFLIGHT.pop(fid, None)
+
+    def _fetch_blocks(self, blocks, task_attempt_id, handler, hb, fid
+                      ) -> list[TableMetaMsg]:
+        from spark_rapids_tpu.utils import watchdog as W
         kind, payload = self.connection.request(meta_request(blocks))
         if kind != MsgKind.METADATA_RESPONSE:
             raise FetchFailedError(self.address, blocks[0] if blocks else
@@ -185,10 +233,17 @@ class ShuffleClient:
             return metas
         state = BufferReceiveState(real, self.received_catalog,
                                    self.host_store, task_attempt_id,
-                                   self.transport.receive_limiter, handler)
+                                   self.transport.receive_limiter, handler,
+                                   progress=hb.beat)
         pending = list(real)
         attempt = 0
         while pending:
+            # round boundary = cancellation point (a cancelled query
+            # must not issue fresh transfer requests)
+            W.check_cancelled()
+            with _INFLIGHT_LOCK:
+                if fid in _INFLIGHT:
+                    _INFLIGHT[fid]["attempt"] = attempt
             batch_ids = []
             budget_taken = []
             for m in pending:
@@ -282,24 +337,37 @@ class ShuffleServer:
         `wire=False` (loopback fetches) skips the payload codec: the
         bytes never leave the process, so compressing them would be pure
         CPU waste."""
+        from spark_rapids_tpu.utils import watchdog as W
         total = 0
         chunk_size = self.transport.send_bounce.buffer_size
         codec = self.codec if wire else None
+        # server handlers run on transport threads with no session
+        # conf installed; the transport's construction-time conf
+        # carries the watchdog/injection settings
+        wconf = getattr(self.transport, "conf", None)
         try:
-            for tid in table_ids:
-                blob = self.acquire_buffer_bytes(tid)
-                raw_len = len(blob)
-                codec_id = -1
-                if codec is not None:
-                    blob = codec.compress(blob)
-                    codec_id = codec.codec_id
-                n = len(blob)
-                nchunks = max(1, -(-n // chunk_size))
-                for i in range(nchunks):
-                    chunk = blob[i * chunk_size: (i + 1) * chunk_size]
-                    emit(tid, i, chunk, i == nchunks - 1, codec_id,
-                         raw_len)
-                    total += len(chunk)
+            with W.heartbeat("shuffle-server", kind="task",
+                             conf=wconf) as hb:
+                for tid in table_ids:
+                    blob = self.acquire_buffer_bytes(tid)
+                    raw_len = len(blob)
+                    codec_id = -1
+                    if codec is not None:
+                        blob = codec.compress(blob)
+                        codec_id = codec.codec_id
+                    n = len(blob)
+                    nchunks = max(1, -(-n // chunk_size))
+                    for i in range(nchunks):
+                        # a handler wedged between chunks is the
+                        # server-stall failure mode: the heartbeat
+                        # names it and the hang injector fakes it
+                        W.maybe_hang("shuffle-server", conf=wconf)
+                        chunk = blob[i * chunk_size:
+                                     (i + 1) * chunk_size]
+                        emit(tid, i, chunk, i == nchunks - 1,
+                             codec_id, raw_len)
+                        hb.beat()
+                        total += len(chunk)
         except Exception as e:  # noqa: BLE001 — surface as transaction
             return Transaction(TransactionStatus.ERROR, str(e), total)
         return Transaction(TransactionStatus.SUCCESS,

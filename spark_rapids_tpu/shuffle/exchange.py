@@ -339,7 +339,7 @@ class ShuffleExchangeExec(UnaryExecBase):
         from spark_rapids_tpu.columnar.vector import bucket_capacity
         from spark_rapids_tpu.parallel.collective_exchange import (
             build_all_to_all_exchange, build_count_exchange,
-            stack_batches, unstack_batches)
+            stack_batches, unstack_batches, watched_collective)
         n = self.partitioning.num_partitions
         from spark_rapids_tpu import config as C
         max_rows = C.get_active_conf()[C.MAX_BATCH_ROWS]
@@ -401,14 +401,17 @@ class ShuffleExchangeExec(UnaryExecBase):
                                              key_idx, cap))
             from spark_rapids_tpu.utils import checks as CK
             CK.note_host_sync("exchange.mesh")
-            totals = np.asarray(count_fn(arrs, num_rows))
+            totals = watched_collective(
+                lambda: np.asarray(count_fn(arrs, num_rows)),
+                label="mesh-count")
             out_cap = int(bucket_capacity(max(int(totals.max()), 1)))
             step = cache.get_or_build(
                 ("step", cap, out_cap),
                 lambda: build_all_to_all_exchange(
                     mesh, axis, schema, key_idx, cap,
                     out_capacity=out_cap))
-            out_arrs, out_rows = step(arrs, num_rows)
+            out_arrs, out_rows = watched_collective(
+                lambda: step(arrs, num_rows), label="mesh-exchange")
         out = unstack_batches(out_arrs, np.asarray(out_rows),
                               self._schema)
         for b in out:

@@ -288,12 +288,46 @@ def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
 class TcpConnection(Connection):
     def __init__(self, host: str, port: int, timeout: float = 30.0):
         self._sock = socket.create_connection((host, port), timeout=timeout)
+        #: overall per-read inactivity budget; the socket itself polls
+        #: in short slices so a watchdog cancellation interrupts a
+        #: client parked on a dead wire instead of waiting out the
+        #: full timeout (the "bounded-poll + token check" discipline)
+        self._read_timeout = timeout
+        self._sock.settimeout(0.25)
         self._lock = threading.Lock()  # one outstanding exchange per conn
+
+    def _recv_exact(self, n: int) -> Optional[bytes]:
+        from spark_rapids_tpu.utils import watchdog as W
+        import time
+        buf = bytearray()
+        deadline = time.monotonic() + self._read_timeout
+        while len(buf) < n:
+            W.check_cancelled()
+            try:
+                chunk = self._sock.recv(n - len(buf))
+            except socket.timeout:
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"no data from peer for "
+                        f"{self._read_timeout:.0f}s") from None
+                continue
+            if not chunk:
+                return None
+            buf += chunk
+            deadline = time.monotonic() + self._read_timeout
+        return bytes(buf)
+
+    def _recv_frame(self) -> Optional[bytes]:
+        hdr = self._recv_exact(4)
+        if hdr is None:
+            return None
+        (length,) = struct.unpack("<I", hdr)
+        return self._recv_exact(length)
 
     def request(self, frame: bytes):
         with self._lock:
             _send_all(self._sock, frame)
-            resp = _recv_frame(self._sock)
+            resp = self._recv_frame()
             if resp is None:
                 raise ConnectionError("peer closed during request")
             return decode_frame(resp)
@@ -305,7 +339,7 @@ class TcpConnection(Connection):
             try:
                 _send_all(self._sock, transfer_request(table_ids))
                 while True:
-                    frame = _recv_frame(self._sock)
+                    frame = self._recv_frame()
                     if frame is None:
                         return Transaction(TransactionStatus.ERROR,
                                            "peer closed during transfer")

@@ -19,6 +19,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 from typing import Iterator, Optional, Sequence
 
 from spark_rapids_tpu import config as C
@@ -410,24 +411,29 @@ class CachingShuffleReader:
 
         def fetch_all():
             try:
-                for address, blocks in remote.items():
-                    current["addr"] = address
-                    conn = self.manager.transport.make_client(address)
-                    client = ShuffleClient(
-                        conn, self.manager.transport,
-                        self.manager.received_catalog,
-                        self.manager.env.host_store, address,
-                        conf=self.conf)
-                    try:
-                        client.fetch_blocks(blocks,
-                                            self.task_attempt_id,
-                                            handler)
-                    finally:
-                        # the client may have swapped in a fresh
-                        # connection on a retry: close whatever it
-                        # currently holds, not the original handle
-                        client.connection.close()
-                    health.record_success(address)
+                # raw worker thread: install the consuming task's conf
+                # so watchdog deadlines / fault injection resolve to
+                # the session's values, not registry defaults
+                with C.session(self.conf):
+                    for address, blocks in remote.items():
+                        current["addr"] = address
+                        conn = self.manager.transport.make_client(
+                            address)
+                        client = ShuffleClient(
+                            conn, self.manager.transport,
+                            self.manager.received_catalog,
+                            self.manager.env.host_store, address,
+                            conf=self.conf)
+                        try:
+                            client.fetch_blocks(blocks,
+                                                self.task_attempt_id,
+                                                handler)
+                        finally:
+                            # the client may have swapped in a fresh
+                            # connection on a retry: close whatever it
+                            # currently holds, not the original handle
+                            client.connection.close()
+                        health.record_success(address)
             except BaseException as e:  # noqa: BLE001
                 errors.append(e)
                 q.put(("fatal", (current.get("addr"), str(e))))
@@ -442,19 +448,43 @@ class CachingShuffleReader:
         t = threading.Thread(target=fetch_all, daemon=True,
                              name="tpu-shuffle-fetch")
         t.start()
+        from spark_rapids_tpu.utils import watchdog as W
+        hb = W.heartbeat(f"shuffle-read:s{self.shuffle_id}"
+                         f"p{self.partition}", kind="task",
+                         conf=self.conf)
+        try:
+            yield from self._consume(q, current, errors, done,
+                                     _first_block, hb, sem)
+        finally:
+            hb.close()
+
+    def _consume(self, q, current, errors, done, _first_block, hb,
+                 sem) -> Iterator[ColumnarBatch]:
+        from spark_rapids_tpu.utils import watchdog as W
         received = 0
         finished = False
         while True:
-            try:
-                kind, payload = q.get(timeout=self.timeout)
-            except queue.Empty:
-                addr = current.get("addr") or "remote"
-                raise FetchFailedError(
-                    addr, _first_block(addr),
-                    f"shuffle fetch timed out after {self.timeout}s") \
-                    from None
+            # bounded-poll the fetch queue in small slices so a
+            # watchdog cancellation is honored promptly; the overall
+            # per-get timeout still FetchFails like before
+            deadline = time.monotonic() + self.timeout
+            while True:
+                W.check_cancelled()
+                try:
+                    kind, payload = q.get(
+                        timeout=min(0.1, max(0.0, deadline
+                                             - time.monotonic())))
+                    break
+                except queue.Empty:
+                    if time.monotonic() >= deadline:
+                        addr = current.get("addr") or "remote"
+                        raise FetchFailedError(
+                            addr, _first_block(addr),
+                            f"shuffle fetch timed out after "
+                            f"{self.timeout}s") from None
             if kind == "batch":
                 received += 1
+                hb.beat()
                 with self.manager.env.catalog.acquired(payload) as buf:
                     sem.acquire_if_necessary()
                     yield payload.map_id, buf.get_columnar_batch()

@@ -38,6 +38,14 @@ PREFETCH_STALLS = "prefetchStalls"
 # the reduce side, lost map tasks recomputed from lineage, bounded
 # reduce retries, peers newly blacklisted, and ns spent inside recovery
 # (invalidate + recompute), charged to the owning exchange
+# query watchdog (utils/watchdog.py): deadline expirations declared,
+# CancelTokens fired, diagnostic dumps emitted, and the widest observed
+# gap between any heartbeat's beats (ms) — charged to the collected plan
+# root when a query trips the watchdog
+NUM_WATCHDOG_TIMEOUTS = "numWatchdogTimeouts"
+NUM_CANCELS = "numCancels"
+WATCHDOG_DUMPS = "watchdogDumps"
+SLOWEST_HEARTBEAT = "slowestHeartbeatMs"
 NUM_FETCH_FAILURES = "numFetchFailures"
 NUM_MAP_RECOMPUTES = "numMapRecomputes"
 NUM_STAGE_RETRIES = "numStageRetries"

@@ -1,0 +1,569 @@
+"""Query watchdog: hang detection, deadlines, cooperative cancellation.
+
+The reference plugin inherits Spark's task-level liveness machinery
+(speculation, task kill, executor heartbeats); this standalone engine
+has none, so a dead collective participant, a stalled shuffle handler,
+a wedged pyudf worker, or a pathological XLA compile would hang a query
+forever — the one failure mode the OOM retry harness (memory/retry.py)
+and shuffle fault recovery (shuffle/recovery.py) cannot reach, because
+both only trigger on *raised* errors.  Distributed engines (Theseus,
+PAPERS.md) treat bounded-time data movement as a first-class invariant
+for the same reason; on a TPU pod it is worse, since ICI collectives
+block every participant when one goes dark.
+
+Three pieces:
+
+* **Heartbeat** — every long-lived activity (prefetch producer loops,
+  shuffle server handlers and client fetch loops, collective-exchange
+  dispatches, AQE stage fills, pyudf workers, KernelCache compiles)
+  registers a handle with a progress counter and a deadline class
+  (`spark.rapids.sql.watchdog.taskTimeout` / `.collectiveTimeout` /
+  `.compileTimeout`).  `beat()` on every unit of progress; `pause()`
+  around waits attributable to a *different* watched party (a producer
+  parked on a full queue is the consumer's problem, not a hang).
+* **Scanner** — a daemon thread polls registered heartbeats every
+  `watchdog.pollInterval` seconds.  No progress past the deadline
+  emits ONE diagnostic dump (all thread stacks, TpuSemaphore holders,
+  prefetch queue stats, in-flight shuffle fetches, hang-injection
+  state) and fires the query's CancelToken.
+* **CancelToken** — per-query cooperative cancellation, installed by
+  the outermost `TpuExec.collect` and threaded through TaskContext to
+  producer threads.  Every indefinite wait in the engine is a bounded
+  poll + token check (`check_cancelled`), so a cancelled query
+  terminates with a descriptive `TpuQueryTimeout` carrying the dump,
+  releases its resources (semaphore permits, producer threads, open
+  fetches), and leaves the process healthy for the next query.
+
+A seeded hang injector (`spark.rapids.memory.faultInjection.hangSite`
+/ `.hangAfterBatches`) blocks the named site until the token fires —
+cancellation is cooperative, exactly like a Spark task kill — so the
+whole detect -> dump -> cancel -> release lattice is exercised on CPU
+CI without a real dead peer.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+import time
+import traceback
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+from spark_rapids_tpu import config as C
+
+log = logging.getLogger("spark_rapids_tpu.watchdog")
+
+#: deadline class -> conf entry
+_DEADLINE_ENTRIES = {
+    "task": C.WATCHDOG_TASK_TIMEOUT,
+    "collective": C.WATCHDOG_COLLECTIVE_TIMEOUT,
+    "compile": C.WATCHDOG_COMPILE_TIMEOUT,
+}
+
+#: harness-level defaults (tests/conftest.py installs conservative
+#: suite-wide deadlines here); an EXPLICIT session-conf setting wins
+_GLOBAL_DEFAULTS: dict = {}
+
+#: granularity of cancellable waits; latency only paid on cancel edges
+_POLL_S = 0.05
+
+#: hard cap on an injected hang with no watchdog to cancel it — a
+#: misconfigured test must fail loudly, never eat the CI wall clock
+_HANG_HARD_CAP_S = 120.0
+
+
+class TpuQueryTimeout(RuntimeError):
+    """The watchdog declared the query hung and cancelled it.  Carries
+    the diagnostic dump taken at detection time (`.dump`)."""
+
+    def __init__(self, message: str, dump: Optional[str] = None):
+        self.dump = dump
+        super().__init__(message if not dump
+                         else f"{message}\n{dump}")
+
+
+class CancelToken:
+    """Per-query cooperative cancellation.  `cancel()` is one-shot;
+    every bounded poll in the engine calls `check()` which raises
+    `TpuQueryTimeout` once the token has fired."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._lock = threading.Lock()
+        self.reason: Optional[str] = None
+        self.dump: Optional[str] = None
+
+    @property
+    def cancelled(self) -> bool:
+        return self._ev.is_set()
+
+    def cancel(self, reason: str, dump: Optional[str] = None) -> None:
+        with self._lock:
+            if self._ev.is_set():
+                return
+            self.reason = reason
+            self.dump = dump
+            self._ev.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._ev.wait(timeout)
+
+    def check(self) -> None:
+        if self._ev.is_set():
+            raise TpuQueryTimeout(
+                f"query cancelled by watchdog: {self.reason}",
+                dump=self.dump)
+
+
+# ---------------------------------------------------------------------------
+# token management: the engine executes one top-level query at a time
+# (exec/base.py thread model), so one process-global token per query;
+# helper threads additionally find it on their TaskContext
+# (`ctx.cancel_token`), which PrefetchIterator installs.
+_TOKEN_LOCK = threading.Lock()
+_TOKEN = CancelToken()
+
+
+def current_token() -> CancelToken:
+    from spark_rapids_tpu.memory.semaphore import TaskContext
+    ctx = TaskContext.get()
+    tok = getattr(ctx, "cancel_token", None) if ctx is not None else None
+    if tok is not None:
+        return tok
+    with _TOKEN_LOCK:
+        return _TOKEN
+
+
+def begin_query() -> CancelToken:
+    """Install a fresh CancelToken for a new top-level query (called by
+    the outermost collect) and reset the per-query watchdog stats.
+    Returns the token."""
+    global _TOKEN
+    with _TOKEN_LOCK:
+        _TOKEN = CancelToken()
+        tok = _TOKEN
+    with _STATS_LOCK:
+        for k in _QUERY_STATS:
+            _QUERY_STATS[k] = 0
+    return tok
+
+
+def check_cancelled() -> None:
+    """Raise TpuQueryTimeout if the current query has been cancelled.
+    One Event check — cheap enough for batch boundaries and poll
+    loops."""
+    current_token().check()
+
+
+def cancellable_sleep(seconds: float) -> None:
+    """Bounded-poll sleep that raises TpuQueryTimeout the moment the
+    query's token fires (backoff sleeps must not outlive the query)."""
+    tok = current_token()
+    deadline = time.monotonic() + seconds
+    while True:
+        tok.check()
+        left = deadline - time.monotonic()
+        if left <= 0:
+            return
+        if tok.wait(min(left, _POLL_S)):
+            tok.check()
+
+
+def cancellable_wait(ev: threading.Event, timeout: float) -> bool:
+    """Wait on `ev` up to `timeout` seconds in bounded slices, raising
+    TpuQueryTimeout if the query is cancelled meanwhile.  Returns
+    whether the event was set (False = timed out)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        check_cancelled()
+        left = deadline - time.monotonic()
+        if left <= 0:
+            return ev.is_set()
+        if ev.wait(min(left, max(_POLL_S, timeout / 100.0))):
+            return True
+
+
+# ---------------------------------------------------------------------------
+# per-query + process-lifetime stats
+_STATS_LOCK = threading.Lock()
+_QUERY_STATS = {"timeouts": 0, "cancels": 0, "dumps": 0,
+                "slowest_heartbeat_ms": 0}
+_TOTAL_STATS = {"timeouts": 0, "cancels": 0, "dumps": 0}
+
+
+def query_stats() -> dict:
+    """Watchdog counters since the last `begin_query` (the per-query
+    view `TpuExec.collect` charges to the plan's metrics)."""
+    with _STATS_LOCK:
+        return dict(_QUERY_STATS)
+
+
+def watchdog_stats() -> dict:
+    """Process-lifetime counters (CI summary lines)."""
+    with _STATS_LOCK:
+        return dict(_TOTAL_STATS)
+
+
+def _note_gap(ms: float) -> None:
+    with _STATS_LOCK:
+        if ms > _QUERY_STATS["slowest_heartbeat_ms"]:
+            _QUERY_STATS["slowest_heartbeat_ms"] = int(ms)
+
+
+def _note_fire(dumped: bool) -> None:
+    with _STATS_LOCK:
+        for s in (_QUERY_STATS, _TOTAL_STATS):
+            s["timeouts"] += 1
+            s["cancels"] += 1
+            if dumped:
+                s["dumps"] += 1
+
+
+# ---------------------------------------------------------------------------
+def deadline_for(kind: str, conf: Optional[C.RapidsConf] = None) -> float:
+    """Resolve a deadline class to seconds: an explicit session-conf
+    setting wins, then the harness global default (configure_global),
+    then the registry default."""
+    entry = _DEADLINE_ENTRIES[kind]
+    conf = conf if conf is not None else C.get_active_conf()
+    if conf.is_set(entry.key):
+        return float(conf[entry])
+    if kind in _GLOBAL_DEFAULTS:
+        return float(_GLOBAL_DEFAULTS[kind])
+    return float(conf[entry])
+
+
+def configure_global(task_timeout: Optional[float] = None,
+                     collective_timeout: Optional[float] = None,
+                     compile_timeout: Optional[float] = None,
+                     poll_interval: Optional[float] = None) -> None:
+    """Install harness-level default deadlines (tests/conftest.py uses
+    this to arm a conservative suite-wide watchdog so a genuine hang in
+    tier-1 fails fast with a dump instead of burning the wall-clock
+    budget).  Explicit per-session conf settings still win."""
+    for k, v in (("task", task_timeout),
+                 ("collective", collective_timeout),
+                 ("compile", compile_timeout),
+                 ("poll", poll_interval)):
+        if v is None:
+            _GLOBAL_DEFAULTS.pop(k, None)
+        else:
+            _GLOBAL_DEFAULTS[k] = float(v)
+
+
+def _poll_for(conf: Optional[C.RapidsConf] = None) -> float:
+    conf = conf if conf is not None else C.get_active_conf()
+    if conf.is_set(C.WATCHDOG_POLL_INTERVAL.key):
+        return float(conf[C.WATCHDOG_POLL_INTERVAL])
+    if "poll" in _GLOBAL_DEFAULTS:
+        return float(_GLOBAL_DEFAULTS["poll"])
+    return float(conf[C.WATCHDOG_POLL_INTERVAL])
+
+
+# ---------------------------------------------------------------------------
+_HB_LOCK = threading.Lock()
+_HEARTBEATS: dict[int, "Heartbeat"] = {}
+_HB_IDS = iter(range(1, 1 << 62))
+
+
+class Heartbeat:
+    """One watched activity.  `beat()` on every unit of progress;
+    `pause()` around waits attributable to another watched party
+    (backpressure parking is not a hang).  Context manager:
+    registration on entry, removal on exit."""
+
+    def __init__(self, name: str, kind: str, deadline: float,
+                 poll: float, token: CancelToken, dump: bool,
+                 details: Optional[Callable[[], str]] = None):
+        self.name = name
+        self.kind = kind
+        self.deadline = deadline
+        self.poll = poll
+        self.token = token
+        self.dump_on_timeout = dump
+        self.details = details
+        self.thread_name = threading.current_thread().name
+        self.thread_id = threading.get_ident()
+        self.created = time.monotonic()
+        self.last_beat = self.created
+        self.beats = 0
+        self.fired = False
+        self._paused = 0
+        self._id = next(_HB_IDS)
+
+    def beat(self, n: int = 1) -> None:
+        now = time.monotonic()
+        _note_gap((now - self.last_beat) * 1000.0)
+        self.last_beat = now
+        self.beats += n
+
+    @contextmanager
+    def pause(self):
+        self._paused += 1
+        try:
+            yield
+        finally:
+            self._paused -= 1
+            # the wait we sat out is not this activity's staleness
+            self.last_beat = time.monotonic()
+
+    def close(self) -> None:
+        with _HB_LOCK:
+            _HEARTBEATS.pop(self._id, None)
+
+    def __enter__(self) -> "Heartbeat":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def describe(self) -> str:
+        age = time.monotonic() - self.last_beat
+        return (f"{self.name} [{self.kind}] beats={self.beats} "
+                f"last_progress={age:.1f}s ago deadline="
+                f"{self.deadline:.1f}s thread={self.thread_name}")
+
+
+class _NullHeartbeat(Heartbeat):
+    """Watchdog disabled: same surface, no registration, no scanning."""
+
+    def __init__(self):
+        pass
+
+    def beat(self, n: int = 1) -> None:
+        pass
+
+    @contextmanager
+    def pause(self):
+        yield
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+_NULL_HB = _NullHeartbeat()
+
+
+def enabled(conf: Optional[C.RapidsConf] = None) -> bool:
+    conf = conf if conf is not None else C.get_active_conf()
+    return bool(conf[C.WATCHDOG_ENABLED])
+
+
+def heartbeat(name: str, kind: str = "task",
+              details: Optional[Callable[[], str]] = None,
+              conf: Optional[C.RapidsConf] = None) -> Heartbeat:
+    """Register a watched activity under the current query's token.
+    Returns a no-op handle when the watchdog is disabled, so call
+    sites need no conditional."""
+    conf = conf if conf is not None else C.get_active_conf()
+    if not enabled(conf):
+        return _NULL_HB
+    hb = Heartbeat(name, kind, deadline_for(kind, conf),
+                   _poll_for(conf), current_token(),
+                   bool(conf[C.WATCHDOG_DUMP_ON_TIMEOUT]), details)
+    with _HB_LOCK:
+        _HEARTBEATS[hb._id] = hb
+    _ensure_scanner()
+    # wake a mid-sleep scanner so a freshly registered short-deadline
+    # heartbeat is picked up at ITS poll cadence, not the previous one
+    _SCAN_WAKE.set()
+    return hb
+
+
+def active_heartbeats() -> list[Heartbeat]:
+    with _HB_LOCK:
+        return list(_HEARTBEATS.values())
+
+
+# ---------------------------------------------------------------------------
+_SCANNER_LOCK = threading.Lock()
+_SCANNER: Optional[threading.Thread] = None
+_SCAN_WAKE = threading.Event()
+
+
+def _ensure_scanner() -> None:
+    global _SCANNER
+    with _SCANNER_LOCK:
+        if _SCANNER is not None and _SCANNER.is_alive():
+            return
+        _SCANNER = threading.Thread(target=_scan_loop, daemon=True,
+                                    name="tpu-watchdog")
+        _SCANNER.start()
+
+
+def _scan_loop() -> None:
+    while True:
+        hbs = active_heartbeats()
+        sleep_s = min([hb.poll for hb in hbs] or [1.0])
+        if _SCAN_WAKE.wait(max(0.01, min(sleep_s, 5.0))):
+            _SCAN_WAKE.clear()
+        now = time.monotonic()
+        for hb in active_heartbeats():
+            if hb._paused > 0 or hb.fired or hb.token.cancelled:
+                # one dump per cancellation: sibling activities all
+                # stall once their query is cancelled — re-dumping
+                # each would bury the first (causal) dump
+                continue
+            gap = now - hb.last_beat
+            _note_gap(gap * 1000.0)
+            if gap > hb.deadline:
+                hb.fired = True
+                _fire(hb, gap)
+
+
+def _fire(hb: Heartbeat, gap: float) -> None:
+    reason = (f"no progress from {hb.name} for {gap:.1f}s "
+              f"(watchdog {hb.kind} deadline "
+              f"{hb.deadline:.1f}s, "
+              f"{_DEADLINE_ENTRIES[hb.kind].key})")
+    dump = None
+    if hb.dump_on_timeout:
+        try:
+            dump = build_dump(stuck=hb)
+        except Exception as e:  # noqa: BLE001 — the dump must never
+            dump = f"<diagnostic dump failed: {e}>"  # mask the timeout
+    _note_fire(dump is not None)
+    log.error("watchdog timeout: %s%s", reason,
+              "\n" + dump if dump else "")
+    hb.token.cancel(reason, dump)
+
+
+# ---------------------------------------------------------------------------
+def build_dump(stuck: Optional[Heartbeat] = None) -> str:
+    """One diagnostic snapshot: the stuck activity, every registered
+    heartbeat, all thread stacks, TpuSemaphore holders, prefetch
+    pipeline stats, in-flight shuffle fetches, and hang-injection
+    state.  Every section is individually guarded — a dump must never
+    fail."""
+    lines = ["==== TPU query watchdog dump ===="]
+    if stuck is not None:
+        lines.append(f"stuck: {stuck.describe()}")
+        if stuck.details is not None:
+            try:
+                lines.append(f"stuck details: {stuck.details()}")
+            except Exception as e:  # noqa: BLE001
+                lines.append(f"stuck details: <failed: {e}>")
+    lines.append("-- heartbeats --")
+    for hb in active_heartbeats():
+        mark = " (PAUSED)" if hb._paused > 0 else ""
+        lines.append(f"  {hb.describe()}{mark}")
+    lines.append("-- semaphore --")
+    try:
+        from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+        sem = TpuSemaphore.get()
+        refs = sem.snapshot()
+        lines.append(f"  holders={len(refs)} "
+                     f"max_concurrent={sem.max_concurrent} "
+                     f"refs={refs}")
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"  <unavailable: {e}>")
+    lines.append("-- prefetch pipeline --")
+    try:
+        from spark_rapids_tpu.exec.pipeline import pipeline_stats
+        lines.append(f"  {pipeline_stats()}")
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"  <unavailable: {e}>")
+    lines.append("-- in-flight shuffle fetches --")
+    try:
+        from spark_rapids_tpu.shuffle.client_server import inflight_fetches
+        flights = inflight_fetches()
+        if not flights:
+            lines.append("  (none)")
+        for f in flights:
+            lines.append(f"  {f}")
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"  <unavailable: {e}>")
+    lines.append("-- hang injection --")
+    try:
+        with _INJ_LOCK:
+            lines.append(f"  counters={dict(_INJ_COUNTS)} "
+                         f"hanging={sorted(_INJ_HANGING)}")
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"  <unavailable: {e}>")
+    lines.append("-- thread stacks --")
+    try:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            lines.append(f"  thread {names.get(tid, '?')} ({tid}):")
+            for fl in traceback.format_stack(frame):
+                lines.extend("    " + ln
+                             for ln in fl.rstrip().splitlines())
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"  <unavailable: {e}>")
+    lines.append("==== end watchdog dump ====")
+    return "\n".join(lines)
+
+
+def thread_stack(thread_id: Optional[int]) -> str:
+    """Formatted stack of one thread (leak diagnostics); empty string
+    when the thread is gone or frames are unavailable."""
+    try:
+        frame = sys._current_frames().get(thread_id)
+        if frame is None:
+            return ""
+        return "".join(traceback.format_stack(frame))
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# seeded hang injection
+_INJ_LOCK = threading.Lock()
+_INJ_COUNTS: dict[str, int] = {}
+_INJ_HANGING: set[str] = set()
+
+HANG_SITES = ("producer", "collective", "shuffle-server", "pyudf",
+              "compile")
+
+
+def reset_hang_injection() -> None:
+    with _INJ_LOCK:
+        _INJ_COUNTS.clear()
+        _INJ_HANGING.clear()
+
+
+def maybe_hang(site: str, conf: Optional[C.RapidsConf] = None) -> None:
+    """Hang-injection hook, called once per unit of progress at each
+    instrumented site.  When `faultInjection.hangSite` names this site
+    and its progress budget (`hangAfterBatches`) is exhausted, block —
+    the site's heartbeat stops beating, the watchdog detects the
+    stall, dumps, and fires the CancelToken, at which point this
+    raises TpuQueryTimeout (cooperative cancellation, like a Spark
+    task kill reaching a blocked task)."""
+    conf = conf if conf is not None else C.get_active_conf()
+    target = str(conf[C.HANG_INJECT_SITE])
+    if not target or target != site:
+        return
+    after = int(conf[C.HANG_INJECT_AFTER])
+    with _INJ_LOCK:
+        n = _INJ_COUNTS.get(site, 0) + 1
+        _INJ_COUNTS[site] = n
+        if n <= after:
+            return
+        _INJ_HANGING.add(site)
+    tok = current_token()
+    log.warning("hang injection engaged at site '%s' (progress %d > "
+                "hangAfterBatches=%d); blocking until the watchdog "
+                "cancels the query", site, n, after)
+    t0 = time.monotonic()
+    try:
+        while not tok.wait(_POLL_S):
+            if time.monotonic() - t0 > _HANG_HARD_CAP_S:
+                raise RuntimeError(
+                    f"injected hang at '{site}' exceeded the "
+                    f"{_HANG_HARD_CAP_S:.0f}s hard cap without a "
+                    "watchdog cancel — is watchdog.enabled off while "
+                    "hang injection is on?")
+    finally:
+        with _INJ_LOCK:
+            _INJ_HANGING.discard(site)
+    raise TpuQueryTimeout(
+        f"hang-injected site '{site}' cancelled: {tok.reason}",
+        dump=tok.dump)

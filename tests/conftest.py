@@ -21,6 +21,18 @@ jax.config.update("jax_enable_x64", True)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# conservative suite-wide watchdog: a GENUINE hang anywhere in tier-1
+# fails fast with a diagnostic dump (thread stacks, semaphore holders,
+# queue depths) instead of burning the 870s wall-clock budget.  The
+# deadlines sit far above any legitimate no-progress gap on this CPU
+# mesh (longest observed: cold XLA sort compiles, tens of seconds) and
+# yield to EXPLICIT per-test conf settings (watchdog suite uses
+# sub-second deadlines), so passing tests see no behavior change.
+from spark_rapids_tpu.utils import watchdog as _W  # noqa: E402
+
+_W.configure_global(task_timeout=420.0, collective_timeout=420.0,
+                    compile_timeout=600.0, poll_interval=5.0)
+
 
 def pytest_configure(config):
     config.addinivalue_line(
