@@ -1,0 +1,116 @@
+"""Profile one query through the engine and print its QueryProfile.
+
+Folds the old ad-hoc diagnostics (profile_q27.py's per-lane timing,
+profile_agg_stages.py's stage walk) into the first-class observability
+subsystem (utils/profile.py): run the query with
+`spark.rapids.sql.profile.enabled`, then print the
+EXPLAIN-with-metrics plan report, the wall-clock breakdown (compute vs
+pipeline wait vs shuffle vs compile vs retry-block), and the slowest
+spans — and write the Chrome trace-event JSON for Perfetto.
+
+Usage:
+    python scripts/profile_query.py                      # TPC-H q5
+    python scripts/profile_query.py --query 1 --scale 100000
+    python scripts/profile_query.py --suite tpcxbb --query q27
+    python scripts/profile_query.py --chrome /tmp/q5.trace.json \
+        --events /tmp/q5.events.jsonl --runs 2
+"""
+import argparse
+import os
+import sys
+import time
+
+# runnable as `python scripts/profile_query.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _run_tpch(query: int, scale: int, conf, runs: int):
+    import numpy as np
+    from spark_rapids_tpu.models.tpch_bench import run_query
+    from spark_rapids_tpu.models.tpch_data import gen_tables
+    tables = gen_tables(np.random.default_rng(11), scale)
+    out = None
+    for _ in range(max(1, runs)):
+        t0 = time.perf_counter()
+        out = run_query(query, tables, engine="tpu", conf=conf)
+        print(f"collect: {(time.perf_counter() - t0) * 1e3:.1f} ms "
+              f"({len(out)} rows)")
+    return out
+
+
+def _run_tpcxbb(query: str, scale: int, conf, runs: int):
+    import numpy as np
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.models import tpcxbb
+    from spark_rapids_tpu.models.data_util import make_sources
+    from spark_rapids_tpu.plan import accelerate, collect
+    rng = np.random.default_rng(21)
+    n = scale
+    rv = tpcxbb.gen_reviews(rng, n, n // 10, n // 4)
+    srcs = make_sources({"product_reviews": rv},
+                        {"product_reviews": tpcxbb.REVIEWS_SCHEMA}, 2)
+    plan = accelerate(tpcxbb.QUERIES[query](srcs, lambda p: None), conf)
+    out = None
+    for _ in range(max(1, runs)):
+        with C.session(conf):
+            t0 = time.perf_counter()
+            out = collect(plan, conf)
+            print(f"collect: {(time.perf_counter() - t0) * 1e3:.1f} ms "
+                  f"({len(out)} rows)")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--suite", choices=("tpch", "tpcxbb"),
+                    default="tpch")
+    ap.add_argument("--query", default="5",
+                    help="TPC-H query number, or a TPCx-BB key like q27")
+    ap.add_argument("--scale", type=int, default=0,
+                    help="rows (default: 100000 tpch / 2**20 tpcxbb)")
+    ap.add_argument("--runs", type=int, default=2,
+                    help="collects per profile; the LAST run's profile "
+                    "is reported (run 1 pays cold compiles)")
+    ap.add_argument("--chrome", default="",
+                    help="Chrome trace output path (Perfetto-loadable)")
+    ap.add_argument("--events", default="",
+                    help="JSONL event log output path")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest spans to print")
+    args = ap.parse_args()
+
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.utils import profile as P
+    conf = C.RapidsConf({
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.sql.incompatibleOps.enabled": True,
+        "spark.rapids.sql.profile.enabled": True,
+    })
+    if args.suite == "tpch":
+        _run_tpch(int(args.query), args.scale or 100_000, conf,
+                  args.runs)
+    else:
+        _run_tpcxbb(str(args.query), args.scale or (1 << 20), conf,
+                    args.runs)
+
+    prof = P.last_profile()
+    if prof is None:
+        raise SystemExit("no QueryProfile recorded — is "
+                         "spark.rapids.sql.profile.enabled on?")
+    print()
+    print(prof.explain())
+    print(f"\nspan depth: {prof.span_depth()}  spans: "
+          f"{len(prof.spans)}  events: {len(prof.events)}  threads: "
+          f"{len({s.thread_id for s in prof.spans})}")
+    if args.chrome:
+        path = prof.write_chrome_trace(args.chrome)
+        print(f"chrome trace written to {path} "
+              f"(open in Perfetto / chrome://tracing)")
+    if args.events:
+        path = prof.write_event_log(args.events)
+        print(f"event log written to {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,46 @@ run_fast() {
   run_pipeline
   run_recovery
   run_watchdog
+  run_profile
+}
+
+run_profile() {
+  # observability lane: TPC-H q1/q5 with per-query profiling on must
+  # yield a Perfetto-parseable Chrome trace with a deep multi-thread
+  # span tree, an EXPLAIN-with-metrics report where every node carries
+  # resolved counters, and a correlated JSONL event log — then print
+  # the wall-clock breakdown as the lane's summary line.
+  echo "== profile lane (span tracing, Chrome trace, EXPLAIN-with-metrics) =="
+  "${PYTEST[@]}" tests/test_profile.py
+  python - <<'PYEOF'
+import json
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+from spark_rapids_tpu.utils import profile as P
+
+tables = gen_tables(np.random.default_rng(11), 1000)
+conf = C.RapidsConf({**BENCH_CONF,
+                     "spark.rapids.sql.profile.enabled": True})
+for q in (1, 5):
+    run_query(q, tables, engine="tpu", conf=conf)
+    prof = P.last_profile()
+    trace = json.loads(json.dumps(prof.chrome_trace()))  # must parse
+    threads = {e["tid"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert prof.span_depth() >= 4, prof.span_depth()
+    assert len(threads) >= 3, threads
+    assert all(ln.rstrip().endswith("]")
+               for ln in prof.plan_report.splitlines()), "unannotated node"
+    assert {e["query_id"] for e in prof.events} == {prof.query_id}
+    print("profile summary: q%d wall_ms=%.1f spans=%d depth=%d "
+          "threads=%d events=%d breakdown=%s" % (
+              q, prof.wall_s * 1e3, len(prof.spans), prof.span_depth(),
+              len(threads), len(prof.events),
+              json.dumps(prof.breakdown)))
+PYEOF
 }
 
 run_watchdog() {
@@ -167,7 +207,8 @@ case "$TIER" in
   pipeline) run_pipeline ;;
   recovery) run_recovery ;;
   watchdog) run_watchdog ;;
+  profile)  run_profile ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|all]" >&2
+  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|all]" >&2
      exit 2 ;;
 esac

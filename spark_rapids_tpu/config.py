@@ -202,6 +202,41 @@ HANG_INJECT_AFTER = conf(
     "progress (batches produced, chunks served, compiles started) at "
     "the configured hangSite.", internal=True)
 
+# --- query profiles (utils/profile.py) ---------------------------------------
+PROFILE_ENABLED = conf(
+    "spark.rapids.sql.profile.enabled", False,
+    "Record a per-query observability profile: a span tree (query -> "
+    "stage/exchange -> operator -> batch/compile/shuffle-fetch/retry) "
+    "with thread-propagated parenting, dual-emitted to "
+    "jax.profiler.TraceAnnotation (xprof captures still work) and to an "
+    "in-process ring buffer, plus a structured event log (retries, "
+    "fetch failures, blacklists, watchdog dumps, cancellations — all "
+    "carrying the query id).  On collect() the spans, events, an "
+    "EXPLAIN-with-metrics plan report, and a wall-clock breakdown "
+    "(compute vs pipeline wait vs shuffle vs compile vs retry-block) "
+    "assemble into a QueryProfile kept in a bounded history.  Disabled "
+    "(default) the batch hot loop allocates no tracer objects.")
+PROFILE_HISTORY_SIZE = conf(
+    "spark.rapids.sql.profile.historySize", 16,
+    "How many completed QueryProfiles to retain in the in-process "
+    "history (utils.profile.profile_history), queryable from tests and "
+    "bench harnesses.  Oldest profiles are dropped first.")
+PROFILE_EVENT_LOG_PATH = conf(
+    "spark.rapids.sql.profile.eventLog.path", "",
+    "When set, every profiled query appends its structured event "
+    "records (span open/close, retries, fetch failures, blacklists, "
+    "watchdog dumps, cancellations) to this file as JSON lines, each "
+    "carrying the query id.  Empty disables the file sink; the "
+    "in-process QueryProfile.events view is always available.")
+PROFILE_CHROME_TRACE_PATH = conf(
+    "spark.rapids.sql.profile.chromeTrace.path", "",
+    "When set, every profiled query writes its span tree to this path "
+    "as Chrome trace-event JSON (loadable in Perfetto / "
+    "chrome://tracing).  A '{query_id}' placeholder in the path is "
+    "substituted so consecutive queries do not overwrite each other.  "
+    "Empty disables the file sink; QueryProfile.chrome_trace() always "
+    "serves the same payload in-process.")
+
 # --- async pipelined execution (exec/pipeline.py) ----------------------------
 # env-overridable defaults so CI lanes (scripts/run_suite.sh pipeline)
 # can flip the whole suite without threading a conf through every test

@@ -118,9 +118,15 @@ class KernelCache:
     def _build_watched(key, builder: Callable[[], Callable]):
         """Run the (seconds-to-minutes) trace/compile under a
         compile-class watchdog heartbeat, with the compile hang-
-        injection site in front so a wedged XLA compile is testable."""
+        injection site in front so a wedged XLA compile is testable.
+        A profiled query additionally records the compile as a span
+        (cat 'compile'), so cold-start cost is attributable in the
+        wall-clock breakdown."""
+        from spark_rapids_tpu.utils import profile as P
         from spark_rapids_tpu.utils import watchdog as W
-        with W.heartbeat(f"compile:{key!r:.120}", kind="compile"):
+        label = f"compile:{key!r:.120}"
+        with W.heartbeat(label, kind="compile"), \
+                P.span(label, cat=P.CAT_COMPILE):
             W.maybe_hang("compile")
             return builder()
 
@@ -266,11 +272,14 @@ class TpuExec:
     def execute_partitions(self) -> list[Iterator[ColumnarBatch]]:
         """Partitioned execution (RDD analog).  Default: operators that are
         partition-local map themselves over each child partition."""
+        from spark_rapids_tpu.utils import profile as P
         kids = [c.execute_partitions() for c in self._children]
         if not kids:
-            return [self.execute_columnar()]
+            return [P.wrap_operator(self, 0, self.execute_columnar())]
         n = len(kids[0])
-        return [self._execute_partition(i, [k[i] for k in kids])
+        return [P.wrap_operator(
+                    self, i, self._execute_partition(
+                        i, [k[i] for k in kids]))
                 for i in range(n)]
 
     def _execute_partition(self, idx: int, child_iters
@@ -294,6 +303,7 @@ class TpuExec:
         the offending fast path and re-execute (plans are pure), up to
         MAX_DEOPT_RETRIES times."""
         from spark_rapids_tpu.utils import checks as CK
+        from spark_rapids_tpu.utils import profile as P
         from spark_rapids_tpu.utils import watchdog as W
         me = threading.get_ident()
         outermost_entry = False
@@ -312,11 +322,16 @@ class TpuExec:
                     "on the driver thread and hand batches to workers "
                     "instead")
             _COLLECT_DEPTH[0] += 1
+        prof_owner = None
         if outermost_entry:
             # fresh per-query CancelToken: a previous query's watchdog
             # cancellation must not bleed into this one
             W.begin_query()
+            # per-query span tracer (no-op unless profile.enabled; an
+            # AQE driver that began the query upstream keeps ownership)
+            prof_owner = P.begin_query()
         mark = CK.snapshot()
+        prof_error: Optional[BaseException] = None
         try:
             for attempt in range(self.MAX_DEOPT_RETRIES + 1):
                 final = attempt == self.MAX_DEOPT_RETRIES
@@ -344,12 +359,18 @@ class TpuExec:
                     return out
                 except CK.FastPathInvalid as e:
                     if final:
+                        prof_error = e
                         raise
                     e.recover_all()
+                    P.event("deopt_retry", origin=", ".join(
+                        c.origin for c in e.checks))
                     CK.drain_since(mark)  # discard this attempt's rest
                 finally:
                     if attempt:
                         CK.set_retrying(False)
+        except BaseException as e:
+            prof_error = e
+            raise
         finally:
             with _COLLECT_LOCK:
                 _COLLECT_DEPTH[0] -= 1
@@ -375,6 +396,9 @@ class TpuExec:
                     self.metrics.set_max(
                         M.SLOWEST_HEARTBEAT,
                         qs["slowest_heartbeat_ms"])
+                # assemble the QueryProfile LAST so the plan report
+                # sees every metric this query charged
+                P.end_query(prof_owner, self, error=prof_error)
 
     def _collect_once(self) -> ColumnarBatch:
         from spark_rapids_tpu.columnar.batch import concat_batches, empty_batch
@@ -538,7 +562,8 @@ class SchemaOnlyExec(TpuExec):
 
 class LeafExec(TpuExec):
     def execute_partitions(self):
-        return [self.execute_columnar()]
+        from spark_rapids_tpu.utils import profile as P
+        return [P.wrap_operator(self, 0, self.execute_columnar())]
 
 
 class UnaryExecBase(TpuExec):
@@ -556,8 +581,9 @@ class UnaryExecBase(TpuExec):
             yield from it
 
     def execute_partitions(self):
-        return [self.process_partition(it)
-                for it in self.child.execute_partitions()]
+        from spark_rapids_tpu.utils import profile as P
+        return [P.wrap_operator(self, i, self.process_partition(it))
+                for i, it in enumerate(self.child.execute_partitions())]
 
 
 def bind_exprs(exprs: Sequence[Expression], schema: T.Schema

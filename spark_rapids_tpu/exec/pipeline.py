@@ -126,6 +126,11 @@ class PrefetchIterator:
         #: queue can outlive a watchdog cancellation
         from spark_rapids_tpu.utils import watchdog as W
         self._token = W.current_token()
+        #: creator's span context (None unless the query is profiled):
+        #: the producer thread attaches here so its spans parent under
+        #: the pipeline break that spawned it, not a detached root
+        from spark_rapids_tpu.utils import profile as P
+        self._span_ref = P.current_ref()
         self._hb = None
         self._closed = threading.Event()
         #: test-facing: set while the producer is parked on a full queue
@@ -160,26 +165,34 @@ class PrefetchIterator:
         return item
 
     def _wait_for_item(self):
+        from spark_rapids_tpu.utils import profile as P
         t0 = time.perf_counter_ns()
+        # a stalled pull is exactly the overlap loss the profile's
+        # breakdown wants to name; already off the hot path (we only
+        # get here when the queue was empty), and a no-op unprofiled
+        sp = P.span(f"pipeline-wait:{self._label}", cat=P.CAT_WAIT) \
+            if P.tracer() is not None else P._NULL_SPAN
         try:
-            while True:
-                try:
-                    return self._q.get(timeout=_POLL_S)
-                except queue.Empty:
-                    if self._token.cancelled:
-                        # watchdog cancellation: release what the
-                        # producer buffered before surfacing, so the
-                        # failed query pins nothing
-                        self.close()
-                        self._token.check()
-                    t = self._thread
-                    if t is None or not t.is_alive():
-                        # producer exited: drain the put/exit race, then
-                        # report end-of-stream (error checked by caller)
-                        try:
-                            return self._q.get_nowait()
-                        except queue.Empty:
-                            return _DONE
+            with sp:
+                while True:
+                    try:
+                        return self._q.get(timeout=_POLL_S)
+                    except queue.Empty:
+                        if self._token.cancelled:
+                            # watchdog cancellation: release what the
+                            # producer buffered before surfacing, so the
+                            # failed query pins nothing
+                            self.close()
+                            self._token.check()
+                        t = self._thread
+                        if t is None or not t.is_alive():
+                            # producer exited: drain the put/exit race,
+                            # then report end-of-stream (error checked
+                            # by caller)
+                            try:
+                                return self._q.get_nowait()
+                            except queue.Empty:
+                                return _DONE
         finally:
             waited = time.perf_counter_ns() - t0
             _bump("stalls")
@@ -256,8 +269,10 @@ class PrefetchIterator:
         cur = TaskContext.get()
         if cur is not None and getattr(cur, "cancel_token", None) is None:
             cur.cancel_token = self._token
+        from spark_rapids_tpu.utils import profile as P
         try:
-            with C.session(self._conf):
+            with C.session(self._conf), P.attach(self._span_ref), \
+                    P.span(f"producer:{self._label}", cat=P.CAT_PIPELINE):
                 hb = W.heartbeat(f"producer:{self._label}",
                                  kind="task",
                                  details=lambda: f"queue depth "

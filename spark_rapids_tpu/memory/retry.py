@@ -37,6 +37,7 @@ from typing import Callable, Iterator, Optional
 
 from spark_rapids_tpu import config as C
 from spark_rapids_tpu.utils import metrics as M
+from spark_rapids_tpu.utils import profile as P
 
 log = logging.getLogger(__name__)
 
@@ -159,7 +160,7 @@ def _blocked_spill(dm, nbytes: int, metrics) -> None:
     t0 = time.perf_counter_ns()
     cb = dm.spill_callback
     before = cb.bytes_spilled if cb is not None else 0
-    with _sem_yielded():
+    with _sem_yielded(), P.span("retry-block:spill", cat=P.CAT_RETRY):
         if cb is not None:
             cb.on_alloc_pressure(nbytes, dm.budget, dm.reserved_bytes)
     if cb is not None:
@@ -175,7 +176,7 @@ def _blocked_reserve(dm, nbytes: int, metrics) -> bool:
     t0 = time.perf_counter_ns()
     cb = dm.spill_callback
     before = cb.bytes_spilled if cb is not None else 0
-    with _sem_yielded():
+    with _sem_yielded(), P.span("retry-block:reserve", cat=P.CAT_RETRY):
         ok = dm.reserve(nbytes)
     if cb is not None:
         _madd(metrics, M.SPILL_BYTES, cb.bytes_spilled - before)
@@ -232,6 +233,8 @@ def _run_reserved(thunk: Callable[[], object], nbytes: int, metrics,
             raise
         except TpuRetryOOM:
             _madd(metrics, M.NUM_RETRIES, 1)
+            P.event("oom_retry", label=label, bytes=nbytes,
+                    retries=retries + 1)
             retries += 1
             continue
         try:
@@ -257,6 +260,7 @@ def _floor_fallback(thunk: Callable[[], object], metrics, label: str,
             f"{C.RETRY_FALLBACK.key}=bestEffort to run the batch "
             "unreserved (XLA's allocator then has the final word).")
     _madd(metrics, M.NUM_OOM_FALLBACKS, 1)
+    P.event("oom_fallback", label=label, rows=str(rows))
     log.warning(
         "%s: OOM retry floor reached (%s rows); running the batch "
         "unreserved (best effort) — a true device OOM will surface as "
@@ -304,6 +308,8 @@ def with_split_retry(batch, body: Callable[[object], object], *,
                                       rows=b.num_rows)
             else:
                 _madd(metrics, M.NUM_SPLIT_RETRIES, 1)
+                P.event("oom_split_retry", label=label,
+                        rows=b.num_rows)
                 pending[:0] = pieces
 
 

@@ -119,16 +119,22 @@ class ShuffleQueryStageExec(LeafExec):
         self._consumed = set()
         self._fill_error = None
         conf = C.get_active_conf()
+        from spark_rapids_tpu.utils import profile as P
+        # captured on the materializing thread so the fill thread's
+        # spans parent under the stage that spawned it
+        span_ref = P.current_ref()
         self._fill = threading.Thread(
-            target=self._fill_run, args=(conf,), daemon=True,
+            target=self._fill_run, args=(conf, span_ref), daemon=True,
             name="tpu-aqe-stage-fill")
         self._fill.start()
         return self
 
-    def _fill_run(self, conf) -> None:
+    def _fill_run(self, conf, span_ref=None) -> None:
+        from spark_rapids_tpu.utils import profile as P
         from spark_rapids_tpu.utils import watchdog as W
         try:
-            with C.session(conf):
+            with C.session(conf), P.attach(span_ref), \
+                    P.span("aqe-stage-fill", cat=P.CAT_SHUFFLE):
                 with W.heartbeat("aqe-stage-fill", kind="task") as hb:
                     for p, it in enumerate(
                             self.exchange.execute_partitions()):

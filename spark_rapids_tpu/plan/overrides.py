@@ -978,13 +978,26 @@ def _collect_inner(plan, conf: C.RapidsConf) -> "object":
         if conf[C.ADAPTIVE_ENABLED]:
             from spark_rapids_tpu.plan.aqe import (adaptive_execute,
                                                    release_stage_buffers)
-            plan = adaptive_execute(plan, conf)
-            ExecutionPlanCapture.last_plan = plan
+            # the AQE drive materializes stages BEFORE the root
+            # collect: own the query profile here so prestarted map
+            # sides trace too (plan.collect's begin_query then sees an
+            # active tracer and leaves ownership alone)
+            from spark_rapids_tpu.utils import profile as P
+            prof_owner = P.begin_query(conf)
+            prof_error = None
             try:
-                return df_from_batch(plan.collect())
+                plan = adaptive_execute(plan, conf)
+                ExecutionPlanCapture.last_plan = plan
+                try:
+                    return df_from_batch(plan.collect())
+                finally:
+                    # the captured plan must not pin the query's entire
+                    # shuffle output in device memory
+                    release_stage_buffers(plan)
+            except BaseException as e:
+                prof_error = e
+                raise
             finally:
-                # the captured plan must not pin the query's entire
-                # shuffle output in device memory
-                release_stage_buffers(plan)
+                P.end_query(prof_owner, plan, error=prof_error)
         return df_from_batch(plan.collect())
     return plan.collect()

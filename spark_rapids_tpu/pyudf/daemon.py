@@ -171,6 +171,7 @@ class PythonWorkerPool:
 
     def run_udf(self, fn: Callable, df: pd.DataFrame) -> pd.DataFrame:
         import cloudpickle
+        from spark_rapids_tpu.utils import profile as P
         from spark_rapids_tpu.utils import watchdog as W
         fn_blob = cloudpickle.dumps(fn)  # before checkout: a pickling
         # failure must not touch pool state
@@ -182,7 +183,8 @@ class PythonWorkerPool:
             # cancelled run closes the worker (not reusable) so the
             # pool slot comes back clean
             with W.heartbeat(f"pyudf:worker-pid{w.proc.pid}",
-                             kind="task"):
+                             kind="task"), \
+                    P.span(f"pyudf:pid{w.proc.pid}", cat=P.CAT_UDF):
                 W.maybe_hang("pyudf")
                 out = w.run(fn_blob, df)
             reusable = True
@@ -190,6 +192,10 @@ class PythonWorkerPool:
         except PythonUdfError:
             # the UDF raised inside a healthy worker — keep the process
             reusable = True
+            raise
+        except WorkerCrash as e:
+            P.event("udf_worker_crash", pid=w.proc.pid,
+                    error=str(e)[:200])
             raise
         finally:
             self._checkin(w, reusable)

@@ -191,6 +191,7 @@ class ShuffleClient:
     def fetch_blocks(self, blocks: Sequence[BlockIdMsg],
                      task_attempt_id: int,
                      handler: ShuffleReceiveHandler) -> list[TableMetaMsg]:
+        from spark_rapids_tpu.utils import profile as P
         from spark_rapids_tpu.utils import watchdog as W
         fid = next(_INFLIGHT_IDS)
         with _INFLIGHT_LOCK:
@@ -199,7 +200,9 @@ class ShuffleClient:
                 "blocks": [str(b) for b in blocks[:8]],
                 "_t0": time.monotonic()}
         with W.heartbeat(f"shuffle-fetch:{self.address}",
-                         kind="task", conf=self.conf) as hb:
+                         kind="task", conf=self.conf) as hb, \
+                P.span(f"shuffle-fetch:{self.address}",
+                       cat=P.CAT_SHUFFLE):
             try:
                 return self._fetch_blocks(blocks, task_attempt_id,
                                           handler, hb, fid)
@@ -263,8 +266,12 @@ class ShuffleClient:
                 pending = [m for m in pending
                            if m.table_id not in state.completed]
                 attempt += 1
+                from spark_rapids_tpu.utils import profile as P
                 if attempt > self.max_retries:
                     handler.transfer_error(txn.error or "transfer failed")
+                    P.event("fetch_failure", address=self.address,
+                            attempts=attempt,
+                            error=str(txn.error)[:200])
                     raise FetchFailedError(
                         self.address,
                         blocks[0] if blocks else None,
@@ -272,6 +279,8 @@ class ShuffleClient:
                         f"{txn.error}")
                 log.warning("shuffle fetch retry %d from %s: %s", attempt,
                             self.address, txn.error)
+                P.event("fetch_retry", address=self.address,
+                        attempt=attempt, error=str(txn.error)[:200])
                 self._backoff(attempt)
                 # a mid-stream abort leaves the socket dead on the
                 # server side: reconnect before retrying (the reference
@@ -345,9 +354,14 @@ class ShuffleServer:
         # conf installed; the transport's construction-time conf
         # carries the watchdog/injection settings
         wconf = getattr(self.transport, "conf", None)
+        from spark_rapids_tpu.utils import profile as P
         try:
+            # server handlers run on transport threads with no captured
+            # span context: the span parents under the query root, which
+            # still names the thread + timeline in the Chrome trace
             with W.heartbeat("shuffle-server", kind="task",
-                             conf=wconf) as hb:
+                             conf=wconf) as hb, \
+                    P.span("shuffle-server", cat=P.CAT_SHUFFLE):
                 for tid in table_ids:
                     blob = self.acquire_buffer_bytes(tid)
                     raw_len = len(blob)

@@ -23,6 +23,7 @@ from spark_rapids_tpu.exec.base import TpuExec, UnaryExecBase
 from spark_rapids_tpu.shuffle.partitioning import (
     RangePartitioning, TpuPartitioning)
 from spark_rapids_tpu.utils import metrics as M
+from spark_rapids_tpu.utils import profile as P
 
 
 class ShuffleExchangeExec(UnaryExecBase):
@@ -154,7 +155,10 @@ class ShuffleExchangeExec(UnaryExecBase):
                 return part.finish_split(c, k, b)
 
             for batch in batch_iter:
-                with self.metrics.timed(M.TOTAL_TIME):
+                # constant label: the profiled span costs one global
+                # read + a shared null context when profiling is off
+                with self.metrics.timed(M.TOTAL_TIME), \
+                        P.span("exchange-split", cat=P.CAT_SHUFFLE):
                     t = part.split_device(batch)
                     try:
                         t[1].copy_to_host_async()
@@ -167,12 +171,14 @@ class ShuffleExchangeExec(UnaryExecBase):
                 if slices is not None:
                     yield from self._emit_slices(slices)
             while pending:
-                with self.metrics.timed(M.TOTAL_TIME):
+                with self.metrics.timed(M.TOTAL_TIME), \
+                        P.span("exchange-split", cat=P.CAT_SHUFFLE):
                     slices = finish_oldest()
                 yield from self._emit_slices(slices)
         else:
             for batch in batch_iter:
-                with self.metrics.timed(M.TOTAL_TIME):
+                with self.metrics.timed(M.TOTAL_TIME), \
+                        P.span("exchange-split", cat=P.CAT_SHUFFLE):
                     slices = part.partition_batch(batch)
                 yield from self._emit_slices(slices)
 
@@ -390,7 +396,8 @@ class ShuffleExchangeExec(UnaryExecBase):
             key_idx))
         schema = self._schema
         ShuffleExchangeExec._MESH_EXCHANGES_RUN += 1
-        with self.metrics.timed(M.TOTAL_TIME):
+        with self.metrics.timed(M.TOTAL_TIME), \
+                P.span("mesh-exchange", cat=P.CAT_SHUFFLE):
             arrs, num_rows = stack_batches(locals_, cap)
             # two-phase exchange (ADVICE r2): a counts-only all-to-all
             # sizes the data phase's receive buffers from ACTUAL totals
@@ -472,17 +479,21 @@ class ShuffleExchangeExec(UnaryExecBase):
 
         def write_map_task(map_id, batch_iter, mgr, epoch=None):
             writer = mgr.get_writer(shuffle_id, map_id)
+            sp = P.span(f"shuffle-map:s{shuffle_id}m{map_id}",
+                        cat=P.CAT_SHUFFLE) \
+                if P.tracer() is not None else P._NULL_SPAN
             try:
-                for batch in batch_iter:
-                    if batch.num_rows == 0:
-                        continue
-                    with self.metrics.timed(M.TOTAL_TIME):
-                        slices = part.partition_batch(batch)
-                    for p, s in enumerate(slices):
-                        if s is not None and s.num_rows > 0:
-                            writer.write_partition(p, s)
-                            self.metrics.add("dataSize",
-                                             s.device_size_bytes())
+                with sp:
+                    for batch in batch_iter:
+                        if batch.num_rows == 0:
+                            continue
+                        with self.metrics.timed(M.TOTAL_TIME):
+                            slices = part.partition_batch(batch)
+                        for p, s in enumerate(slices):
+                            if s is not None and s.num_rows > 0:
+                                writer.write_partition(p, s)
+                                self.metrics.add("dataSize",
+                                                 s.device_size_bytes())
             except BaseException:
                 writer.abort()
                 raise

@@ -402,19 +402,23 @@ class CachingShuffleReader:
         if not remote:
             return
         from spark_rapids_tpu.shuffle.recovery import PeerHealth
+        from spark_rapids_tpu.utils import profile as P
         health = PeerHealth.get()
         q: "queue.Queue" = queue.Queue()
         current = {"addr": next(iter(remote))}
         handler = _IteratorHandler(q, current)
         errors: list[BaseException] = []
         done = threading.Event()
+        # captured on the consuming thread: the fetch worker's spans
+        # (ShuffleClient fetch ranges) parent under this reader's scope
+        span_ref = P.current_ref()
 
         def fetch_all():
             try:
                 # raw worker thread: install the consuming task's conf
                 # so watchdog deadlines / fault injection resolve to
                 # the session's values, not registry defaults
-                with C.session(self.conf):
+                with C.session(self.conf), P.attach(span_ref):
                     for address, blocks in remote.items():
                         current["addr"] = address
                         conn = self.manager.transport.make_client(

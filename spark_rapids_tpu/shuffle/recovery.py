@@ -36,6 +36,7 @@ from spark_rapids_tpu.shuffle.client_server import FetchFailedError
 from spark_rapids_tpu.shuffle.manager import (
     MapOutputRegistry, StaleMapStatusError)
 from spark_rapids_tpu.utils import metrics as M
+from spark_rapids_tpu.utils import profile as P
 
 log = logging.getLogger("spark_rapids_tpu.shuffle.recovery")
 
@@ -84,6 +85,8 @@ class PeerHealth:
                 self.blacklist_events += 1
                 log.warning("shuffle peer %s blacklisted after %d "
                             "consecutive failures", address, st[0])
+                P.event("peer_blacklisted", address=address,
+                        consecutive_failures=st[0])
                 return True
             return False
 
@@ -155,7 +158,13 @@ class ShuffleRecoveryDriver:
                 return [b for _, b in items]
             except FetchFailedError as e:
                 self.metrics.add(M.NUM_FETCH_FAILURES, 1)
+                P.event("fetch_failure", shuffle_id=self.shuffle_id,
+                        partition=p, address=e.address,
+                        attempt=attempt, error=str(e)[:200])
                 if attempt >= self.max_attempts:
+                    P.event("recovery_exhausted",
+                            shuffle_id=self.shuffle_id, partition=p,
+                            attempts=attempt)
                     raise FetchFailedError(
                         e.address, e.block,
                         f"shuffle {self.shuffle_id} partition {p} "
@@ -202,8 +211,16 @@ class ShuffleRecoveryDriver:
                         "shuffle %d recovery: recomputing map tasks %s "
                         "at epoch %d after %s", self.shuffle_id, todo,
                         epoch, e)
+                    P.event("map_recompute",
+                            shuffle_id=self.shuffle_id,
+                            map_ids=list(todo), epoch=epoch,
+                            address=e.address)
                     try:
-                        self.recompute(todo, epoch)
+                        with P.span(f"map-recompute:s{self.shuffle_id}",
+                                    cat=P.CAT_SHUFFLE) \
+                                if P.tracer() is not None \
+                                else P._NULL_SPAN:
+                            self.recompute(todo, epoch)
                     except StaleMapStatusError as stale:
                         # a racing invalidation superseded this
                         # recompute; the next attempt re-derives the
@@ -212,6 +229,8 @@ class ShuffleRecoveryDriver:
                                     "%s", self.shuffle_id, stale)
                     self.metrics.add(M.NUM_MAP_RECOMPUTES, len(todo))
                 self.metrics.add(M.NUM_STAGE_RETRIES, 1)
+                P.event("stage_retry", shuffle_id=self.shuffle_id,
+                        recomputed=len(todo))
             finally:
                 self.metrics.add(M.RECOVERY_TIME,
                                  time.perf_counter_ns() - t0)

@@ -61,17 +61,24 @@ class MetricSet:
 
     def __init__(self):
         self._values = defaultdict(float)
+        #: queued (name, value, op) updates; op is "add" or "max".
+        #: BOTH ops queue lazily — set_max used to force a full
+        #: _resolve() (a device readback wave) on every call, which put
+        #: a host sync on the hot path of any exec that tracked a peak
         self._pending: list = []
 
     def add(self, name: str, value) -> None:
         if isinstance(value, (int, float)):
             self._values[name] += value
         else:
-            self._pending.append((name, value))
+            self._pending.append((name, value, "add"))
 
-    def set_max(self, name: str, value: float) -> None:
-        self._resolve()
-        self._values[name] = max(self._values[name], value)
+    def set_max(self, name: str, value) -> None:
+        """Raise `name` to at least `value`.  Queues like `add` — host
+        values apply cheaply at resolve time, device scalars ride the
+        same stacked readback wave — so a hot-path peak tracker never
+        forces a device sync."""
+        self._pending.append((name, value, "max"))
 
     def _resolve(self) -> None:
         if not self._pending:
@@ -84,28 +91,38 @@ class MetricSet:
         # a long-running exec can queue hundreds of lazy row counts
         # between reads.  Grouping by dtype (instead of upcasting to one
         # stack dtype) keeps i32 row counts exact on non-x64 platforms.
+        # Host values (ints/floats, common for set_max) resolve with no
+        # readback at all.
         import jax.numpy as jnp
+        resolved: list = [None] * len(pending)
         groups: dict = {}
-        host: list = []
-        for name, v in pending:
+        for i, (name, v, op) in enumerate(pending):
+            if isinstance(v, (int, float)):
+                resolved[i] = float(v)
+                continue
             try:
                 a = jnp.asarray(v).reshape(())
-                groups.setdefault(str(a.dtype), []).append((name, a))
+                groups.setdefault(str(a.dtype), []).append((i, a))
             except Exception:
-                host.append((name, v))
+                resolved[i] = float(np.asarray(v))
         for items in groups.values():
             try:
                 CK.note_host_sync("metrics.resolve")
                 vals = np.asarray(jnp.stack([a for _, a in items]))
-                for (name, _), val in zip(items, vals):
-                    self._values[name] += float(val)
+                for (i, _), val in zip(items, vals):
+                    resolved[i] = float(val)
             except Exception:
                 # mixed devices (sharded runs): per-value readback
-                for name, a in items:
+                for i, a in items:
                     CK.note_host_sync("metrics.resolve")
-                    self._values[name] += float(np.asarray(a))
-        for name, v in host:
-            self._values[name] += float(np.asarray(v))
+                    resolved[i] = float(np.asarray(a))
+        # apply in FIFO order so interleaved add/max sequences see the
+        # same values they would have seen resolving eagerly
+        for (name, _, op), val in zip(pending, resolved):
+            if op == "max":
+                self._values[name] = max(self._values[name], val)
+            else:
+                self._values[name] += val
 
     def value(self, name: str) -> float:
         self._resolve()

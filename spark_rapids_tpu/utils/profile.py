@@ -1,0 +1,590 @@
+"""Per-query observability: span tracing, Chrome-trace export,
+EXPLAIN-with-metrics, and a structured event log.
+
+The reference plugin's operators are observable end-to-end: NVTX ranges
+(`NvtxWithMetrics.scala`) land in Nsight timelines and every `GpuExec`
+surfaces SQLMetrics in the Spark UI plan graph.  This module is the TPU
+engine's equivalent lens, and the one Theseus (PAPERS.md) argues is the
+prerequisite for trusting distributed-engine perf work: per-operator
+timeline attribution plus data-movement accounting.
+
+Three pieces:
+
+* **QueryTracer** — one per profiled query (installed by the outermost
+  collect when `spark.rapids.sql.profile.enabled`).  Records a span
+  tree — query -> stage/exchange -> operator -> batch-loop / compile /
+  shuffle-fetch / retry — into a bounded ring buffer, dual-emitting
+  each span to `jax.profiler.TraceAnnotation` so xprof/Perfetto device
+  captures still line up.  Parenting is THREAD-PROPAGATED: the opening
+  thread's innermost live span is the parent, and helper threads
+  (pipeline producers, shuffle fetch/server threads, AQE stage fills,
+  pyudf workers) attach to the span context their creator captured via
+  `current_ref()` / `attach()`.
+* **Event log** — structured records (span open/close, OOM retries,
+  fetch failures/retries, peer blacklists, watchdog timeouts + dumps,
+  cancellations), every one carrying the query id, exported as JSONL.
+* **QueryProfile** — assembled when the query's collect finishes: the
+  plan `tree_string` annotated per-node with resolved MetricSet values
+  (EXPLAIN-with-metrics, the Spark UI plan-graph analog), a wall-clock
+  breakdown (compute vs pipeline wait vs shuffle vs compile vs
+  retry-block), the top-N slowest spans, the span list (Chrome
+  trace-event JSON export, loadable in Perfetto), and the event
+  records.  A bounded history of the last
+  `spark.rapids.sql.profile.historySize` profiles is queryable from
+  tests and bench harnesses.
+
+Discipline: with profiling DISABLED (default) the batch hot loop must
+allocate no tracer objects — every hook either returns its input
+unchanged (`wrap_operator`), returns a shared null context (`span`), or
+is a single module-global read (`tracer()`); call sites that would
+build a label string guard on `tracer() is not None` first.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+from spark_rapids_tpu import config as C
+
+#: span categories with first-class roles in the wall-clock breakdown
+CAT_QUERY = "query"
+CAT_EXEC = "exec"
+CAT_PIPELINE = "pipeline"
+CAT_WAIT = "wait"          # consumer blocked on an empty prefetch queue
+CAT_SHUFFLE = "shuffle"
+CAT_COMPILE = "compile"
+CAT_RETRY = "retry"        # OOM retry harness blocked (spill/reserve)
+CAT_UDF = "udf"
+
+#: ring-buffer bounds — big enough for a deep TPC-DS plan's batch spans,
+#: small enough that a runaway loop cannot eat the heap
+MAX_SPANS = 1 << 16
+MAX_EVENTS = 1 << 14
+
+
+class Span:
+    """One closed (or still-open) timeline range.  Times are
+    `perf_counter_ns` anchored to the tracer's origin."""
+
+    __slots__ = ("sid", "parent_id", "name", "cat", "t0", "dur_ns",
+                 "thread_id", "thread_name", "args")
+
+    def __init__(self, sid: int, parent_id: Optional[int], name: str,
+                 cat: str, t0: int, args: Optional[dict] = None):
+        self.sid = sid
+        self.parent_id = parent_id
+        self.name = name
+        self.cat = cat
+        self.t0 = t0
+        self.dur_ns = 0
+        t = threading.current_thread()
+        self.thread_id = t.ident or 0
+        self.thread_name = t.name
+        self.args = args or None
+
+    def as_dict(self) -> dict:
+        return {"sid": self.sid, "parent_id": self.parent_id,
+                "name": self.name, "cat": self.cat, "t0_ns": self.t0,
+                "dur_ns": self.dur_ns, "thread": self.thread_name,
+                "tid": self.thread_id,
+                **({"args": self.args} if self.args else {})}
+
+
+# ---------------------------------------------------------------------------
+# thread-local span context: (tracer, innermost live Span).  Stale
+# entries from a finished query are ignored because every read checks
+# the tracer identity against the live global.
+_TLS = threading.local()
+
+_TRACER_LOCK = threading.Lock()
+_TRACER: Optional["QueryTracer"] = None
+
+_QUERY_IDS = iter(range(1, 1 << 62))
+
+
+def tracer() -> Optional["QueryTracer"]:
+    """The live tracer, or None when profiling is off / no query is in
+    flight.  ONE module-global read — cheap enough for hot loops to
+    gate on."""
+    return _TRACER
+
+
+def _tls_ctx(tr: "QueryTracer") -> Optional[Span]:
+    ctx = getattr(_TLS, "ctx", None)
+    if ctx is not None and ctx[0] is tr:
+        return ctx[1]
+    return None
+
+
+class QueryTracer:
+    """Span + event recorder for one query."""
+
+    def __init__(self, conf: C.RapidsConf):
+        self.query_id = f"q{next(_QUERY_IDS):06d}"
+        self.conf = conf
+        self.t_origin = time.perf_counter_ns()
+        self.wall_start = time.time()
+        self._ids = iter(range(1, 1 << 62))
+        self._spans: "collections.deque[Span]" = \
+            collections.deque(maxlen=MAX_SPANS)
+        self._events: "collections.deque[dict]" = \
+            collections.deque(maxlen=MAX_EVENTS)
+        self.root: Optional[Span] = None
+        self.dropped_spans = 0
+
+    # -- spans ---------------------------------------------------------------
+    def open_span(self, name: str, cat: str,
+                  parent: Optional[Span], args: Optional[dict]) -> Span:
+        s = Span(next(self._ids),
+                 parent.sid if parent is not None
+                 else (self.root.sid if self.root is not None else None),
+                 name, cat, time.perf_counter_ns() - self.t_origin, args)
+        self.event("span_open", name=name, cat=cat, sid=s.sid,
+                   parent_id=s.parent_id)
+        return s
+
+    def close_span(self, s: Span) -> None:
+        s.dur_ns = (time.perf_counter_ns() - self.t_origin) - s.t0
+        if len(self._spans) == self._spans.maxlen:
+            self.dropped_spans += 1
+        self._spans.append(s)
+        self.event("span_close", name=s.name, cat=s.cat, sid=s.sid,
+                   dur_ns=s.dur_ns)
+
+    # -- events --------------------------------------------------------------
+    def event(self, kind: str, **fields) -> None:
+        rec = {"ts_ns": time.perf_counter_ns() - self.t_origin,
+               "query_id": self.query_id, "kind": kind,
+               "thread": threading.current_thread().name}
+        rec.update(fields)
+        self._events.append(rec)
+
+    def spans(self) -> list[Span]:
+        return list(self._spans)
+
+    def events(self) -> list[dict]:
+        return list(self._events)
+
+
+# ---------------------------------------------------------------------------
+class _SpanCtx:
+    """Live span scope: installs itself as the thread's innermost span
+    on entry, restores the previous one on exit, and dual-emits to
+    jax.profiler.TraceAnnotation so xprof captures keep working."""
+
+    __slots__ = ("_tr", "_name", "_cat", "_args", "_span", "_prev",
+                 "_ann")
+
+    def __init__(self, tr: QueryTracer, name: str, cat: str,
+                 args: Optional[dict]):
+        self._tr = tr
+        self._name = name
+        self._cat = cat
+        self._args = args
+        self._span = None
+        self._prev = None
+        self._ann = None
+
+    def __enter__(self) -> Span:
+        tr = self._tr
+        self._prev = getattr(_TLS, "ctx", None)
+        parent = _tls_ctx(tr)
+        self._span = tr.open_span(self._name, self._cat, parent,
+                                  self._args)
+        _TLS.ctx = (tr, self._span)
+        from spark_rapids_tpu.utils.tracing import annotation
+        self._ann = annotation(f"{self._cat}:{self._name}")
+        self._ann.__enter__()
+        return self._span
+
+    def __exit__(self, *exc) -> None:
+        try:
+            self._ann.__exit__(*exc)
+        finally:
+            _TLS.ctx = self._prev
+            self._tr.close_span(self._span)
+
+
+class _NullSpanCtx:
+    """Shared no-op scope: the disabled path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpanCtx()
+
+
+def span(name: str, cat: str = CAT_EXEC, **args):
+    """Open a span under the current thread's innermost live span (the
+    query root when none).  Returns a shared null context when no query
+    is being profiled — call sites that would allocate building `name`
+    should gate on `tracer() is not None` instead."""
+    tr = _TRACER
+    if tr is None:
+        return _NULL_SPAN
+    return _SpanCtx(tr, name, cat, args or None)
+
+
+def event(kind: str, **fields) -> None:
+    """Append one structured record to the live query's event log (a
+    no-op when no query is being profiled)."""
+    tr = _TRACER
+    if tr is not None:
+        tr.event(kind, **fields)
+
+
+# ---------------------------------------------------------------------------
+# cross-thread span-context propagation
+def current_ref():
+    """Capture the calling thread's span context for a helper thread
+    (pipeline producer, shuffle fetch thread, AQE fill, pyudf worker).
+    None when no query is being profiled."""
+    tr = _TRACER
+    if tr is None:
+        return None
+    return (tr, _tls_ctx(tr))
+
+
+class _AttachCtx:
+    __slots__ = ("_ref", "_prev")
+
+    def __init__(self, ref):
+        self._ref = ref
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_TLS, "ctx", None)
+        _TLS.ctx = self._ref
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.ctx = self._prev
+        return False
+
+
+def attach(ref):
+    """Install a captured span context as this thread's parent scope,
+    so spans the thread opens land under the creator's span.  A stale
+    ref (its query already ended) or None degrades to a no-op."""
+    if ref is None or ref[0] is not _TRACER:
+        return _NULL_SPAN
+    return _AttachCtx(ref)
+
+
+# ---------------------------------------------------------------------------
+def wrap_operator(exec_, idx: int, it: Iterator) -> Iterator:
+    """Wrap one operator partition iterator so every batch pull records
+    an `op:<Exec>` span on the pulling thread (child pulls nest inside,
+    so the span tree mirrors the plan tree).  Returns `it` UNCHANGED
+    when no query is being profiled — the disabled hot loop keeps its
+    exact iterator object and allocates nothing."""
+    if _TRACER is None:
+        return it
+    return _op_spans(exec_.name(), idx, it)
+
+
+def _op_spans(name: str, idx: int, it: Iterator) -> Iterator:
+    it = iter(it)
+    label = f"{name}[p{idx}]"
+    while True:
+        tr = _TRACER
+        if tr is None:
+            # the profiled query ended (e.g. iterator outlived collect):
+            # stop tracing, keep streaming
+            yield from it
+            return
+        with _SpanCtx(tr, label, CAT_EXEC, None):
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+        yield batch
+
+
+# ---------------------------------------------------------------------------
+def begin_query(conf: Optional[C.RapidsConf] = None
+                ) -> Optional[QueryTracer]:
+    """Install a tracer for a new top-level query if profiling is
+    enabled and none is active.  Returns the tracer iff THIS caller owns
+    it (and must pass it to `end_query`); None otherwise, so nested
+    collects inside a profiled query are free."""
+    global _TRACER
+    conf = conf if conf is not None else C.get_active_conf()
+    if not conf[C.PROFILE_ENABLED]:
+        return None
+    with _TRACER_LOCK:
+        if _TRACER is not None:
+            return None
+        tr = QueryTracer(conf)
+        _TRACER = tr
+    tr.root = tr.open_span("query", CAT_QUERY, None, None)
+    _TLS.ctx = (tr, tr.root)
+    return tr
+
+
+def end_query(owner: Optional[QueryTracer], plan=None,
+              error: Optional[BaseException] = None
+              ) -> Optional["QueryProfile"]:
+    """Close the owned tracer, assemble the QueryProfile, push it into
+    the bounded history, and flush the conf'd file sinks.  No-op when
+    `owner` is None (this caller did not begin the query)."""
+    global _TRACER
+    if owner is None:
+        return None
+    if error is not None:
+        owner.event("query_error", error=f"{type(error).__name__}: "
+                    f"{error}"[:500])
+    owner.close_span(owner.root)
+    with _TRACER_LOCK:
+        if _TRACER is owner:
+            _TRACER = None
+    if getattr(_TLS, "ctx", None) is not None and _TLS.ctx[0] is owner:
+        _TLS.ctx = None
+    profile = QueryProfile.build(owner, plan)
+    hist_size = max(0, int(owner.conf[C.PROFILE_HISTORY_SIZE]))
+    with _HISTORY_LOCK:
+        _HISTORY.append(profile)
+        del _HISTORY[:max(0, len(_HISTORY) - hist_size)]
+    try:
+        profile.flush_sinks(owner.conf)
+    except OSError:
+        import logging
+        logging.getLogger("spark_rapids_tpu.profile").warning(
+            "could not write profile sinks for %s", profile.query_id,
+            exc_info=True)
+    return profile
+
+
+_HISTORY_LOCK = threading.Lock()
+_HISTORY: list["QueryProfile"] = []
+
+
+def profile_history() -> list["QueryProfile"]:
+    """Last `spark.rapids.sql.profile.historySize` profiles, oldest
+    first."""
+    with _HISTORY_LOCK:
+        return list(_HISTORY)
+
+
+def last_profile() -> Optional["QueryProfile"]:
+    with _HISTORY_LOCK:
+        return _HISTORY[-1] if _HISTORY else None
+
+
+def clear_history() -> None:
+    with _HISTORY_LOCK:
+        _HISTORY.clear()
+
+
+# ---------------------------------------------------------------------------
+def explain_with_metrics(plan, indent: int = 0) -> str:
+    """The plan `tree_string` with every node annotated by its resolved
+    MetricSet values — the Spark UI plan-graph analog.  Resolving reads
+    back lazy device counters; acceptable, profiling is on."""
+    lines: list[str] = []
+    _explain_node(plan, indent, lines)
+    return "\n".join(lines)
+
+
+def _explain_node(node, indent: int, lines: list[str]) -> None:
+    desc = node.describe() if hasattr(node, "describe") else \
+        type(node).__name__
+    ms = {}
+    metrics = getattr(node, "metrics", None)
+    if metrics is not None:
+        try:
+            ms = {k: v for k, v in sorted(metrics.as_dict().items())
+                  if v}
+        except Exception:  # noqa: BLE001 — a broken metric must not
+            ms = {"<metrics unavailable>": 1}  # hide the plan report
+    annot = ", ".join(_fmt_metric(k, v) for k, v in ms.items())
+    lines.append("  " * indent + desc
+                 + (f"  [{annot}]" if annot else "  [no metrics]"))
+    for c in getattr(node, "children", []) or []:
+        _explain_node(c, indent + 1, lines)
+    # AQE wrappers hold their plan below non-children attributes
+    for attr in ("exchange", "stage"):
+        inner = getattr(node, attr, None)
+        if inner is not None and inner not in (
+                getattr(node, "children", []) or []):
+            _explain_node(inner, indent + 1, lines)
+
+
+#: metric names holding nanosecond durations (MetricSet.timed and the
+#: retry/pipeline instrumentation all record perf_counter_ns deltas)
+_NS_METRICS = {"totalTime", "retryBlockTime", "pipelineWaitTime",
+               "recoveryTime", "broadcastTime", "bufferTime",
+               "tpuDecodeTime", "compileTime"}
+
+
+def _fmt_metric(k: str, v) -> str:
+    if k in _NS_METRICS:
+        return f"{k}={v / 1e6:.1f}ms"
+    if isinstance(v, float) and v == int(v):
+        return f"{k}={int(v)}"
+    return f"{k}={v}"
+
+
+# ---------------------------------------------------------------------------
+class QueryProfile:
+    """The per-query artifact collect() assembles when profiling is on."""
+
+    def __init__(self, query_id: str, wall_start: float, wall_s: float,
+                 spans: list[Span], events: list[dict],
+                 plan_report: str, breakdown: dict,
+                 dropped_spans: int = 0):
+        self.query_id = query_id
+        self.wall_start = wall_start
+        self.wall_s = wall_s
+        self.spans = spans
+        self.events = events
+        self.plan_report = plan_report
+        self.breakdown = breakdown
+        self.dropped_spans = dropped_spans
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def build(cls, tr: QueryTracer, plan) -> "QueryProfile":
+        spans = tr.spans()
+        report = ""
+        if plan is not None:
+            try:
+                report = explain_with_metrics(plan)
+            except Exception as e:  # noqa: BLE001 — profile assembly
+                report = f"<plan report failed: {e}>"  # must never fail
+        return cls(tr.query_id, tr.wall_start,
+                   (tr.root.dur_ns if tr.root is not None else 0) / 1e9,
+                   spans, tr.events(), report,
+                   cls._breakdown(spans, tr.root),
+                   dropped_spans=tr.dropped_spans)
+
+    @staticmethod
+    def _breakdown(spans: list[Span], root: Optional[Span]) -> dict:
+        """Wall-clock attribution: per-category span time, counting only
+        spans whose parent is in a DIFFERENT category (so nested
+        same-category spans — a shuffle fetch inside a shuffle reader —
+        are not double-counted), with the unattributed remainder of the
+        root span reported as compute.  Category times are CUMULATIVE
+        across threads: several consumers stalling concurrently can
+        push pipeline_wait_s past wall_s (that is real — it measures
+        total starvation, not elapsed time), in which case compute_s
+        clamps at 0."""
+        by_id = {s.sid: s for s in spans}
+        wall_ns = root.dur_ns if root is not None else 0
+        cats = {CAT_WAIT: 0, CAT_SHUFFLE: 0, CAT_COMPILE: 0,
+                CAT_RETRY: 0, CAT_UDF: 0}
+        for s in spans:
+            if s.cat not in cats:
+                continue
+            parent = by_id.get(s.parent_id)
+            if parent is not None and parent.cat == s.cat:
+                continue
+            cats[s.cat] += s.dur_ns
+        attributed = sum(cats.values())
+        return {
+            "wall_s": round(wall_ns / 1e9, 6),
+            "pipeline_wait_s": round(cats[CAT_WAIT] / 1e9, 6),
+            "shuffle_s": round(cats[CAT_SHUFFLE] / 1e9, 6),
+            "compile_s": round(cats[CAT_COMPILE] / 1e9, 6),
+            "retry_block_s": round(cats[CAT_RETRY] / 1e9, 6),
+            "udf_s": round(cats[CAT_UDF] / 1e9, 6),
+            "compute_s": round(max(0, wall_ns - attributed) / 1e9, 6),
+        }
+
+    # -- views ---------------------------------------------------------------
+    def top_spans(self, n: int = 10) -> list[Span]:
+        """Slowest spans, excluding the query root."""
+        return sorted((s for s in self.spans if s.cat != CAT_QUERY),
+                      key=lambda s: s.dur_ns, reverse=True)[:n]
+
+    def span_depth(self) -> int:
+        """Deepest parent-chain length in the recorded span tree (the
+        query root is depth 1)."""
+        by_id = {s.sid: s for s in self.spans}
+        best = 0
+        for s in self.spans:
+            d, cur = 1, s
+            while cur.parent_id is not None:
+                cur = by_id.get(cur.parent_id)
+                if cur is None:
+                    break
+                d += 1
+            best = max(best, d)
+        return best
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON (Perfetto / chrome://tracing): one
+        complete ('X') event per span plus thread-name metadata."""
+        events: list[dict] = []
+        threads: dict[int, str] = {}
+        for s in self.spans:
+            threads.setdefault(s.thread_id, s.thread_name)
+            ev = {"name": s.name, "cat": s.cat, "ph": "X",
+                  "ts": s.t0 / 1e3, "dur": s.dur_ns / 1e3,
+                  "pid": 0, "tid": s.thread_id,
+                  "args": {"span_id": s.sid,
+                           "parent_id": s.parent_id,
+                           "query_id": self.query_id}}
+            if s.args:
+                ev["args"].update(s.args)
+            events.append(ev)
+        for tid, tname in threads.items():
+            events.append({"name": "thread_name", "ph": "M", "pid": 0,
+                           "tid": tid, "args": {"name": tname}})
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"query_id": self.query_id,
+                              "wall_s": self.wall_s,
+                              "dropped_spans": self.dropped_spans}}
+
+    def explain(self) -> str:
+        """The human-facing report: EXPLAIN-with-metrics + wall-clock
+        breakdown + top-N slowest spans."""
+        lines = [f"== Query profile {self.query_id} "
+                 f"({self.wall_s * 1e3:.1f} ms) ==",
+                 "-- plan with metrics --",
+                 self.plan_report or "<no plan captured>",
+                 "-- wall-clock breakdown --"]
+        for k, v in self.breakdown.items():
+            if k == "wall_s":
+                continue
+            lines.append(f"  {k:18s} {v * 1e3:10.1f} ms")
+        lines.append("-- slowest spans --")
+        for s in self.top_spans():
+            lines.append(f"  {s.dur_ns / 1e6:10.1f} ms  [{s.cat}] "
+                         f"{s.name}  ({s.thread_name})")
+        return "\n".join(lines)
+
+    # -- sinks ---------------------------------------------------------------
+    def write_chrome_trace(self, path: str) -> str:
+        path = path.replace("{query_id}", self.query_id)
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+    def write_event_log(self, path: str, append: bool = True) -> str:
+        path = path.replace("{query_id}", self.query_id)
+        with open(path, "a" if append else "w") as f:
+            for rec in self.events:
+                f.write(json.dumps(rec) + "\n")
+        return path
+
+    def flush_sinks(self, conf: C.RapidsConf) -> None:
+        trace_path = str(conf[C.PROFILE_CHROME_TRACE_PATH])
+        if trace_path:
+            self.write_chrome_trace(trace_path)
+        log_path = str(conf[C.PROFILE_EVENT_LOG_PATH])
+        if log_path:
+            self.write_event_log(log_path)
+
+    def __repr__(self):
+        return (f"QueryProfile({self.query_id}, wall={self.wall_s:.3f}s,"
+                f" spans={len(self.spans)}, events={len(self.events)})")

@@ -3,25 +3,32 @@
 On TPU the profiler story is xprof/Perfetto: `jax.profiler.TraceAnnotation`
 marks host-side ranges that show up in `jax.profiler.trace` captures, and
 `trace_with_metrics` simultaneously feeds an operator metric, exactly like
-the reference's NvtxWithMetrics feeds a SQLMetric."""
+the reference's NvtxWithMetrics feeds a SQLMetric.  The per-query span
+tracer (utils/profile.py) dual-emits through `annotation` so its spans
+line up with device activity in xprof captures."""
 from __future__ import annotations
 
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 import time
 
 import jax
+
+
+def annotation(name: str):
+    """A `jax.profiler.TraceAnnotation` context for `name`, degrading
+    to a null context when the profiler cannot construct one (e.g. a
+    backend without host tracing) — never raising into the caller."""
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return nullcontext()
 
 
 @contextmanager
 def trace_range(name: str):
     # Guard only annotation construction — body exceptions must propagate
     # unchanged (a bare except around the yield would swallow/rewrap them).
-    try:
-        cm = jax.profiler.TraceAnnotation(name)
-    except Exception:
-        from contextlib import nullcontext
-        cm = nullcontext()
-    with cm:
+    with annotation(name):
         yield
 
 

@@ -105,6 +105,8 @@ class CancelToken:
             self.reason = reason
             self.dump = dump
             self._ev.set()
+        from spark_rapids_tpu.utils import profile as P
+        P.event("cancel", reason=reason)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._ev.wait(timeout)
@@ -430,6 +432,13 @@ def _fire(hb: Heartbeat, gap: float) -> None:
         except Exception as e:  # noqa: BLE001 — the dump must never
             dump = f"<diagnostic dump failed: {e}>"  # mask the timeout
     _note_fire(dump is not None)
+    # one CORRELATED record (query id + site + full dump) in the
+    # structured event log; dumpOnTimeout keeps the console copy below
+    from spark_rapids_tpu.utils import profile as P
+    P.event("watchdog_timeout", heartbeat=hb.name,
+            deadline_class=hb.kind, gap_s=round(gap, 2),
+            deadline_s=hb.deadline, stuck_thread=hb.thread_name,
+            reason=reason, dump=dump)
     log.error("watchdog timeout: %s%s", reason,
               "\n" + dump if dump else "")
     hb.token.cancel(reason, dump)

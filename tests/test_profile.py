@@ -1,0 +1,337 @@
+"""Query-profile subsystem tests (utils/profile.py): span-tree
+parenting across helper threads, Chrome trace validity, structured
+event-log coverage for seeded OOM-retry / peer-kill / watchdog runs,
+profile-disabled parity (bit-exact, zero tracer objects on the hot
+loop), and the bounded profile history.
+
+Wall-clock discipline: ONE profiled TPC-H q5 run (module fixture) backs
+all the span-tree/trace/parity assertions; the event-log tests ride
+cheap q1 runs.
+"""
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.utils import checks as CK
+from spark_rapids_tpu.utils import metrics as M
+from spark_rapids_tpu.utils import profile as P
+
+SCALE = 300
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiles():
+    P.clear_history()
+    yield
+    P.clear_history()
+
+
+@pytest.fixture(scope="module")
+def tables():
+    from spark_rapids_tpu.models.tpch_data import gen_tables
+    return gen_tables(np.random.default_rng(11), SCALE)
+
+
+def _conf(**extra):
+    kv = {
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.sql.incompatibleOps.enabled": True,
+        "spark.rapids.sql.profile.enabled": True,
+    }
+    kv.update({k.replace("__", "."): v for k, v in extra.items()})
+    return C.RapidsConf(kv)
+
+
+def _run_q(query, tables, **extra):
+    from spark_rapids_tpu.models.tpch_bench import run_query
+    return run_query(query, tables, engine="tpu", conf=_conf(**extra))
+
+
+@pytest.fixture(scope="module")
+def q5_profiled(tables):
+    """One profiled q5 run shared by the span-tree / Chrome-trace /
+    EXPLAIN / parity tests (q5's joins + exchanges give a deep tree
+    with producer threads on every pipeline break)."""
+    P.clear_history()
+    out = _run_q(5, tables)
+    prof = P.last_profile()
+    assert prof is not None
+    return out, prof
+
+
+# ---------------------------------------------------------------------------
+# span tree + thread propagation
+def test_span_tree_parenting_across_threads(q5_profiled):
+    _, prof = q5_profiled
+    by_id = {s.sid: s for s in prof.spans}
+    roots = [s for s in prof.spans if s.cat == P.CAT_QUERY]
+    assert len(roots) == 1
+    root = roots[0]
+    # every span's parent chain must terminate at the query root —
+    # including spans opened on prefetch producer threads
+    for s in prof.spans:
+        cur, hops = s, 0
+        while cur.parent_id is not None:
+            assert cur.parent_id in by_id, (
+                f"span {cur.name} has dangling parent {cur.parent_id}")
+            cur = by_id[cur.parent_id]
+            hops += 1
+            assert hops < 1000
+        assert cur.sid == root.sid, f"span {s.name} detached from root"
+    # thread propagation: spans from the driver AND the pipeline's
+    # producer threads (exchange map/reduce prefetch) in one tree
+    threads = {s.thread_name for s in prof.spans}
+    assert len(threads) >= 3, threads
+    assert any(t.startswith("tpu-prefetch") for t in threads), threads
+    # a producer's operator spans nest under its producer span
+    prod = next(s for s in prof.spans if s.cat == P.CAT_PIPELINE)
+    kids = [s for s in prof.spans if s.parent_id == prod.sid]
+    assert kids, "producer span has no nested operator spans"
+
+
+def test_chrome_trace_valid_and_deep(q5_profiled):
+    _, prof = q5_profiled
+    assert prof.span_depth() >= 4
+    blob = json.dumps(prof.chrome_trace())
+    trace = json.loads(blob)
+    events = trace["traceEvents"]
+    assert events
+    spans = [e for e in events if e["ph"] == "X"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert spans and metas
+    for e in spans:
+        assert isinstance(e["ts"], (int, float))
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert e["args"]["query_id"] == prof.query_id
+    # >= 3 distinct thread lanes, each named by a metadata event
+    tids = {e["tid"] for e in spans}
+    assert len(tids) >= 3
+    assert {e["tid"] for e in metas} >= tids
+
+
+def test_explain_with_metrics_every_node_annotated(q5_profiled):
+    _, prof = q5_profiled
+    report = prof.plan_report
+    assert report
+    for line in report.splitlines():
+        # every plan line carries a metric annotation (or an explicit
+        # no-metrics marker) — the EXPLAIN-with-metrics contract
+        assert line.rstrip().endswith("]"), line
+    assert "numOutputRows=" in report
+    bd = prof.breakdown
+    assert bd["wall_s"] > 0
+    assert set(bd) >= {"wall_s", "compute_s", "pipeline_wait_s",
+                       "shuffle_s", "compile_s", "retry_block_s"}
+    # the human-facing view renders all three sections
+    text = prof.explain()
+    assert "-- plan with metrics --" in text
+    assert "-- wall-clock breakdown --" in text
+    assert "-- slowest spans --" in text
+
+
+def test_attach_and_ref_unit():
+    owner = P.begin_query(C.RapidsConf(
+        {"spark.rapids.sql.profile.enabled": True}))
+    assert owner is not None
+    try:
+        import threading
+        got = {}
+
+        with P.span("outer") as outer:
+            ref = P.current_ref()
+
+            def helper():
+                with P.attach(ref), P.span("inner") as s:
+                    got["parent"] = s.parent_id
+
+            t = threading.Thread(target=helper)
+            t.start()
+            t.join()
+        assert got["parent"] == outer.sid
+    finally:
+        P.end_query(owner)
+    # a stale ref (query over) degrades to a no-op
+    with P.attach(ref):
+        assert P.span("late") is P._NULL_SPAN
+
+
+# ---------------------------------------------------------------------------
+# event log
+def test_event_log_oom_retry_records_and_sinks(tables, tmp_path):
+    log_path = tmp_path / "events.jsonl"
+    trace_path = tmp_path / "trace-{query_id}.json"
+    from spark_rapids_tpu.memory import retry as R
+    R.reset_oom_injection()
+    out = _run_q(1, tables, **{
+        "spark.rapids.memory.faultInjection.oomRate": 0.5,
+        "spark.rapids.memory.faultInjection.seed": 7,
+        "spark.rapids.memory.faultInjection.maxInjections": 16,
+        "spark.rapids.memory.retry.minSplitRows": 64,
+        "spark.rapids.sql.profile.eventLog.path": str(log_path),
+        "spark.rapids.sql.profile.chromeTrace.path": str(trace_path)})
+    R.reset_oom_injection()
+    assert len(out) > 0
+    prof = P.last_profile()
+    kinds = {e["kind"] for e in prof.events}
+    assert kinds & {"oom_retry", "oom_split_retry", "oom_fallback"}, kinds
+    # the JSONL sink holds the same records, every one carrying the
+    # query id
+    recs = [json.loads(ln) for ln in
+            log_path.read_text().splitlines()]
+    assert recs
+    assert {r["query_id"] for r in recs} == {prof.query_id}
+    assert {r["kind"] for r in recs} == kinds
+    # the Chrome trace sink landed too, {query_id} substituted
+    real = tmp_path / f"trace-{prof.query_id}.json"
+    assert real.exists()
+    assert json.loads(real.read_text())["otherData"]["query_id"] \
+        == prof.query_id
+
+
+@pytest.mark.slowish
+def test_event_log_peer_kill_records(tables):
+    from spark_rapids_tpu.memory.env import ResourceEnv
+    from spark_rapids_tpu.shuffle.manager import (
+        MapOutputRegistry, TpuShuffleManager)
+    from spark_rapids_tpu.shuffle.recovery import PeerHealth
+
+    def reset():
+        MapOutputRegistry.clear()
+        PeerHealth.get().clear()
+        for eid in list(TpuShuffleManager._managers):
+            TpuShuffleManager._managers[eid].close()
+
+    reset()
+    try:
+        out = _run_q(1, tables, **{
+            "spark.rapids.shuffle.enabled": True,
+            "spark.rapids.shuffle.localExecutors": 2,
+            "spark.rapids.shuffle.bounceBuffers.size": 2048,
+            "spark.rapids.shuffle.fetch.maxRetries": 1,
+            "spark.rapids.shuffle.fetch.backoff.baseMs": 1.0,
+            "spark.rapids.shuffle.recovery.blacklist.failureThreshold": 1,
+            "spark.rapids.shuffle.transport.faultInjection."
+            "peerKillAfterFrames": 1})
+        assert len(out) > 0
+        prof = P.last_profile()
+        kinds = {e["kind"] for e in prof.events}
+        assert "fetch_failure" in kinds, kinds
+        assert "map_recompute" in kinds, kinds
+        assert "stage_retry" in kinds, kinds
+        assert {e["query_id"] for e in prof.events} == {prof.query_id}
+    finally:
+        reset()
+        ResourceEnv.shutdown()
+
+
+def test_watchdog_timeout_event_correlated(tables):
+    from spark_rapids_tpu.utils import watchdog as W
+    W.reset_hang_injection()
+    try:
+        with pytest.raises(W.TpuQueryTimeout):
+            _run_q(1, tables, **{
+                "spark.rapids.memory.faultInjection.hangSite": "producer",
+                "spark.rapids.memory.faultInjection.hangAfterBatches": 1,
+                "spark.rapids.sql.watchdog.taskTimeout": 2.0,
+                "spark.rapids.sql.watchdog.pollInterval": 0.1})
+    finally:
+        W.reset_hang_injection()
+    prof = P.last_profile()
+    assert prof is not None  # profile assembled even on error
+    timeouts = [e for e in prof.events if e["kind"] == "watchdog_timeout"]
+    assert timeouts, {e["kind"] for e in prof.events}
+    rec = timeouts[0]
+    assert rec["query_id"] == prof.query_id
+    assert "producer" in rec["heartbeat"]
+    assert rec["dump"] and "watchdog dump" in rec["dump"]
+    assert any(e["kind"] == "cancel" for e in prof.events)
+    assert any(e["kind"] == "query_error" for e in prof.events)
+
+
+# ---------------------------------------------------------------------------
+# disabled path: parity + zero tracer objects
+def test_profile_disabled_bit_exact(q5_profiled, tables):
+    from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+    on, _ = q5_profiled
+    P.clear_history()
+    off = run_query(5, tables, engine="tpu",
+                    conf=C.RapidsConf(dict(BENCH_CONF)))
+    assert P.tracer() is None
+    assert P.profile_history() == []  # disabled run recorded nothing
+    # bit-exact: profiling must observe, never perturb
+    pd.testing.assert_frame_equal(
+        off.reset_index(drop=True), on.reset_index(drop=True))
+
+
+def test_disabled_hooks_allocate_nothing():
+    # the three hot-loop hooks must be allocation-free when no query is
+    # profiled: span() returns one shared null context, wrap_operator
+    # returns its input ITERATOR unchanged, event() is a single global
+    # read
+    assert P.tracer() is None
+    assert P.span("a") is P.span("b")
+    assert P.span("a") is P._NULL_SPAN
+
+    class _FakeExec:
+        def name(self):
+            return "Fake"
+
+    it = iter([1, 2, 3])
+    assert P.wrap_operator(_FakeExec(), 0, it) is it
+    P.event("noop", x=1)  # no tracer: must not raise, must not record
+    assert P.profile_history() == []
+    assert P.current_ref() is None
+    with P.attach(None):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# history bound
+def test_history_bound_respected(tables):
+    for _ in range(3):
+        _run_q(1, tables, **{
+            "spark.rapids.sql.profile.historySize": 2})
+    hist = P.profile_history()
+    assert len(hist) == 2
+    # oldest first, distinct query ids, newest == last_profile()
+    ids = [p.query_id for p in hist]
+    assert len(set(ids)) == 2
+    assert P.last_profile() is hist[-1]
+
+
+# ---------------------------------------------------------------------------
+# satellite: MetricSet.set_max must queue lazily (no hot-path resolve)
+def test_set_max_host_value_no_host_sync():
+    import jax.numpy as jnp
+    ms = M.MetricSet()
+    ms.add("lazy", jnp.asarray(5, jnp.int32))  # queue a device value
+    before = CK.host_sync_count()
+    for v in (3.0, 9.0, 4.0):
+        ms.set_max("peak", v)
+    # the regression: set_max used to force a full _resolve (device
+    # readback) per call even for host floats
+    assert CK.host_sync_count() == before
+    assert ms.value("peak") == 9.0
+    assert ms.value("lazy") == 5.0
+
+
+def test_set_max_device_value_resolves_on_read_one_sync():
+    import jax.numpy as jnp
+    ms = M.MetricSet()
+    ms.set_max("peak", jnp.asarray(7, jnp.int32))
+    ms.set_max("peak", jnp.asarray(3, jnp.int32))
+    before = CK.host_sync_count()
+    assert ms.value("peak") == 7.0
+    assert CK.host_sync_count() == before + 1  # one stacked wave
+
+
+def test_set_max_interleaved_with_add_fifo_semantics():
+    ms = M.MetricSet()
+    ms.add("m", 5.0)
+    ms.set_max("m", 3.0)   # max(5,3) = 5
+    ms.add("m", 4.0)       # 9
+    ms.set_max("m", 20.0)  # 20
+    assert ms.value("m") == 20.0
