@@ -866,6 +866,91 @@ SCALE_LI_BATCH = 1 << 22       # 4M caps: shares kernel signatures with
 SCALE_LI_BATCHES = 25          # 104,857,600 rows
 
 
+def bench_concurrent_throughput():
+    """Multi-query serving bench (ISSUE 6): N concurrent sessions fire
+    TPC-H q1/q5 through the admission-controlled scheduler; reports
+    aggregate rows/s and p50/p95 per-query latency at 1, 4, and 8
+    sessions plus the scheduler's admission counters.  The headline
+    value is the 4-session aggregate throughput; vs_baseline is its
+    scaling over 1 session (1.0 = no benefit from concurrency, >1 =
+    the device idle time one session leaves is being resold)."""
+    import threading
+
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exec.scheduler import scheduler_stats
+    from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+    from spark_rapids_tpu.models.tpch_data import gen_tables
+
+    scale = 20_000
+    queries_per_session = 3
+    tables = gen_tables(np.random.default_rng(11), scale)
+    rows_per_query = sum(len(t) for t in tables.values())
+    conf = C.RapidsConf(dict(BENCH_CONF))
+    run_query(1, tables, conf=conf)   # warm compile cache
+    run_query(5, tables, conf=conf)
+
+    def run_level(n_sessions: int) -> dict:
+        latencies: list = []
+        errors: list = []
+        lat_lock = threading.Lock()
+        start = threading.Barrier(n_sessions)
+
+        def session(sid: int):
+            try:
+                start.wait(timeout=60)
+                for k in range(queries_per_session):
+                    q = 1 if (sid + k) % 2 == 0 else 5
+                    t0 = time.perf_counter()
+                    run_query(q, tables, conf=conf)
+                    dt = time.perf_counter() - t0
+                    with lat_lock:
+                        latencies.append(dt)
+            except BaseException as e:  # noqa: BLE001
+                errors.append(f"{type(e).__name__}: {e}"[:200])
+
+        threads = [threading.Thread(target=session, args=(i,))
+                   for i in range(n_sessions)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lat_ms = sorted(x * 1e3 for x in latencies)
+
+        def pct(p):
+            return round(lat_ms[min(len(lat_ms) - 1,
+                                    int(p * len(lat_ms)))], 1) \
+                if lat_ms else 0.0
+        n_q = len(latencies)
+        return {"sessions": n_sessions, "queries": n_q,
+                "errors": errors,
+                "wall_s": round(wall, 3),
+                "agg_queries_per_sec": round(n_q / wall, 3),
+                "agg_rows_per_sec": round(n_q * rows_per_query / wall),
+                "p50_ms": pct(0.50), "p95_ms": pct(0.95)}
+
+    levels = {n: run_level(n) for n in (1, 4, 8)}
+    for lv in levels.values():
+        assert not lv["errors"], lv["errors"]
+    base = levels[1]["agg_rows_per_sec"] or 1
+    return {
+        "metric": "concurrent_throughput_rows_per_sec",
+        "value": levels[4]["agg_rows_per_sec"],
+        "unit": "rows/s",
+        "vs_baseline": round(levels[4]["agg_rows_per_sec"] / base, 3),
+        "scaling_1_to_8": round(levels[8]["agg_rows_per_sec"] / base,
+                                3),
+        "levels": levels,
+        "scheduler": scheduler_stats(),
+        "note": "mixed TPC-H q1/q5 from N concurrent sessions through "
+                "admission control + the fair-share semaphore; "
+                "vs_baseline = 4-session aggregate throughput over "
+                "1-session (device idle time resold to other "
+                "sessions).",
+    }
+
+
 def bench_scale_join_groupby():
     """Scale evidence (VERDICT r4 #9): a ≥100M-row join+group-by through
     the REAL exec path — multi-batch map side, both inputs exchanged
@@ -1119,6 +1204,7 @@ def main():
     for fn in (bench_groupby, bench_groupby_dict_kernel,
                bench_join_sort, bench_exchange_manager,
                bench_pipeline_overlap, bench_profile_overhead,
+               bench_concurrent_throughput,
                bench_udf_q27, bench_scale_join_groupby):
         try:
             ms = fn()

@@ -25,6 +25,63 @@ run_fast() {
   run_recovery
   run_watchdog
   run_profile
+  run_concurrency
+}
+
+run_concurrency() {
+  # multi-query serving lane: the scheduler suite (admission control,
+  # fair-share semaphore, cross-query fault isolation, result cache),
+  # then a 4-thread mixed q1/q5 storm with seeded OOM injection aimed
+  # at ONE victim session — every result bit-exact vs serial, zero
+  # leaked permits/admissions/producers — with a metrics summary line.
+  echo "== concurrency lane (admission control, fair share, fault isolation) =="
+  "${PYTEST[@]}" tests/test_scheduler.py
+  python - <<'PYEOF'
+import threading
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from pandas.testing import assert_frame_equal
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.exec.scheduler import scheduler_stats
+from spark_rapids_tpu.memory.device_manager import DeviceManager
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+
+tables = gen_tables(np.random.default_rng(11), 1000)
+clean = C.RapidsConf(dict(BENCH_CONF))
+victim = C.RapidsConf({**BENCH_CONF,
+    "spark.rapids.memory.faultInjection.oomRate": 1.0,
+    "spark.rapids.memory.faultInjection.seed": 13,
+    "spark.rapids.memory.faultInjection.maxInjections": 16})
+ref = {q: run_query(q, tables, conf=clean) for q in (1, 5)}
+results, errors = {}, []
+def worker(i, q, conf):
+    try:
+        results[i] = (q, run_query(q, tables, conf=conf))
+    except BaseException as e:
+        errors.append((i, q, repr(e)))
+mix = [(1, victim), (5, clean), (1, clean), (5, clean)]
+ts = [threading.Thread(target=worker, args=(i, q, conf))
+      for i, (q, conf) in enumerate(mix)]
+[t.start() for t in ts]; [t.join(300) for t in ts]
+assert not errors, errors
+for i, (q, df) in results.items():
+    assert_frame_equal(df.reset_index(drop=True),
+                       ref[q].reset_index(drop=True))
+snap = TpuSemaphore.get().snapshot()
+assert snap["refs"] == {}, snap
+dm = DeviceManager.get()
+assert dm.admissions() == {} and dm.reserved_bytes == 0
+st = scheduler_stats()
+print("concurrency summary: queries=%d bit_exact=ok admitted=%d "
+      "queued=%d rejected=%d longest_queue_wait_ms=%d "
+      "sem_longest_wait_ms=%d sem_waits=%d" % (
+          len(results), st["admitted"], st["queued"], st["rejected"],
+          st["longest_queue_wait_ms"], snap["longestWaitMs"],
+          snap["waitCount"]))
+PYEOF
 }
 
 run_profile() {
@@ -208,7 +265,8 @@ case "$TIER" in
   recovery) run_recovery ;;
   watchdog) run_watchdog ;;
   profile)  run_profile ;;
+  concurrency) run_concurrency ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|all]" >&2
+  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|concurrency|all]" >&2
      exit 2 ;;
 esac

@@ -237,6 +237,61 @@ PROFILE_CHROME_TRACE_PATH = conf(
     "Empty disables the file sink; QueryProfile.chrome_trace() always "
     "serves the same payload in-process.")
 
+# --- concurrent multi-query serving (exec/scheduler.py) ----------------------
+SCHED_ENABLED = conf(
+    "spark.rapids.sql.scheduler.enabled", True,
+    "Admission-control concurrent queries against the accounted HBM "
+    "budget: each top-level collect declares an HBM budget estimate "
+    "(scheduler.queryBudgetBytes) and is admitted only while the sum "
+    "of admitted budgets fits the device budget and fewer than "
+    "scheduler.maxConcurrentQueries queries are in flight; otherwise "
+    "it waits FIFO in a bounded queue and is shed with a descriptive "
+    "TpuQueryRejected when the queue is full — queueing at the front "
+    "door instead of thrashing the spill/retry lattice once the "
+    "device is saturated.")
+SCHED_MAX_CONCURRENT = conf(
+    "spark.rapids.sql.scheduler.maxConcurrentQueries", 4,
+    "Cap on concurrently ADMITTED queries per process (sessions, not "
+    "tasks — spark.rapids.sql.concurrentGpuTasks still governs "
+    "task-level device holds within each query).  Also the divisor "
+    "for the default per-query budget when queryBudgetBytes is 0.")
+SCHED_QUERY_BUDGET = conf(
+    "spark.rapids.sql.scheduler.queryBudgetBytes", 0,
+    "HBM bytes a query declares at admission (its working-set "
+    "estimate, charged against the DeviceManager admission ledger "
+    "for the query's lifetime).  0 derives an equal share: device "
+    "budget / maxConcurrentQueries.  Declaring honestly matters in "
+    "both directions: too low admits more queries than fit and "
+    "pushes pressure into the OOM spill/retry lattice, too high "
+    "queues queries the device could have served.")
+SCHED_QUEUE_DEPTH = conf(
+    "spark.rapids.sql.scheduler.queueDepth", 32,
+    "Bound on queries waiting in the admission queue.  A query "
+    "arriving at a full queue is rejected immediately with "
+    "TpuQueryRejected (shed load early, keep latency bounded) rather "
+    "than queued indefinitely.")
+SCHED_QUEUE_TIMEOUT = conf(
+    "spark.rapids.sql.scheduler.queueTimeout", 120.0,
+    "Seconds a query may wait in the admission queue before being "
+    "shed with TpuQueryRejected.  The queued wait is additionally "
+    "registered as a task-class watchdog heartbeat that beats only "
+    "as the queue drains, so a wedged queue produces a diagnostic "
+    "dump naming every admitted query.")
+RESULT_CACHE_ENABLED = conf(
+    "spark.rapids.sql.scheduler.resultCache.enabled", False,
+    "Cache collected query results keyed by (plan structural "
+    "fingerprint, source-data identity, session-conf fingerprint) "
+    "for repeated dashboard-style queries: a hit returns the cached "
+    "result bit-exactly without touching the device.  Any conf "
+    "change changes the key (stale-conf hits are impossible); plans "
+    "with unrecognized leaves are simply not cached.  Off by "
+    "default: in-memory sources are keyed by object identity, so "
+    "callers that mutate source data in place must leave this off.")
+RESULT_CACHE_MAX_BYTES = conf(
+    "spark.rapids.sql.scheduler.resultCache.maxBytes", 268435456,
+    "Byte bound on the result cache (LRU eviction; host memory).  A "
+    "single result larger than this is never cached.")
+
 # --- async pipelined execution (exec/pipeline.py) ----------------------------
 # env-overridable defaults so CI lanes (scripts/run_suite.sh pipeline)
 # can flip the whole suite without threading a conf through every test
@@ -568,6 +623,13 @@ class RapidsConf:
         s[key] = value
         return RapidsConf(s)
 
+    def fingerprint(self) -> tuple:
+        """Stable hashable identity of every EXPLICIT setting — the
+        result cache's conf component, so two sessions differing in any
+        setting can never serve each other's cached results."""
+        return tuple(sorted((k, repr(v))
+                            for k, v in self._settings.items()))
+
     @property
     def sql_enabled(self) -> bool:
         return self[SQL_ENABLED]
@@ -579,6 +641,18 @@ _active = threading.local()
 def get_active_conf() -> RapidsConf:
     c = getattr(_active, "conf", None)
     if c is None:
+        # execution-time fallback: a helper thread carrying a query
+        # context (TaskContext.query_ctx / scheduler-scoped) reads ITS
+        # query's conf snapshot, never another session's thread-local
+        # or the registry defaults — the PR 2 captured-default-conf
+        # bug class, closed at the resolver
+        try:
+            from spark_rapids_tpu.exec import scheduler as _S
+            qc = _S.current()
+            if qc is not None:
+                return qc.conf
+        except ImportError:
+            pass
         c = RapidsConf()
         _active.conf = c
     return c

@@ -218,6 +218,11 @@ class TpuExec:
         self._children = list(children)
         self.metrics = M.MetricSet()
         self.exec_id = next(_EXEC_IDS)
+        #: serializes top-level collects over THIS plan instance: its
+        #: CommonSubplanExec caches, metrics, and release hooks are
+        #: instance state, so two sessions sharing one plan object run
+        #: one at a time while distinct plan instances run concurrently
+        self._plan_lock = threading.Lock()
 
     @property
     def kernels(self) -> KernelCache:
@@ -301,35 +306,25 @@ class TpuExec:
         """Materialize to one batch; the sync boundary where deferred
         fast-path checks resolve.  On FastPathInvalid: disable/escalate
         the offending fast path and re-execute (plans are pure), up to
-        MAX_DEOPT_RETRIES times."""
+        MAX_DEOPT_RETRIES times.
+
+        Concurrency: the outermost collect on a thread with no live
+        QueryContext creates one (exec/scheduler.py CollectScope) —
+        its own conf snapshot, CancelToken, deferred-check registry,
+        profile tracer, and an HBM admission slot — so top-level
+        collects from different sessions run CONCURRENTLY, each
+        isolated; a saturated device queues or sheds new queries at
+        admission instead of thrashing the spill/retry lattice."""
+        from spark_rapids_tpu.exec import scheduler as S
         from spark_rapids_tpu.utils import checks as CK
         from spark_rapids_tpu.utils import profile as P
         from spark_rapids_tpu.utils import watchdog as W
-        me = threading.get_ident()
-        outermost_entry = False
-        with _COLLECT_LOCK:
-            # atomic claim: without the lock two threads entering at
-            # depth 0 simultaneously would both pass and race the
-            # epoch bump / release_execution_state
-            if _COLLECT_DEPTH[0] == 0:
-                _COLLECT_OWNER[0] = me
-                outermost_entry = True
-            elif _COLLECT_OWNER[0] != me:
-                raise RuntimeError(
-                    "concurrent top-level collect() from a second "
-                    "thread: the engine executes one query at a time "
-                    "(see _EXECUTION_EPOCH thread model); materialize "
-                    "on the driver thread and hand batches to workers "
-                    "instead")
-            _COLLECT_DEPTH[0] += 1
-        prof_owner = None
-        if outermost_entry:
-            # fresh per-query CancelToken: a previous query's watchdog
-            # cancellation must not bleed into this one
+        if S.current() is None:
+            # reset the legacy process-global fallback token so a
+            # previous query-less cancellation cannot bleed in
             W.begin_query()
-            # per-query span tracer (no-op unless profile.enabled; an
-            # AQE driver that began the query upstream keeps ownership)
-            prof_owner = P.begin_query()
+        scope = S.CollectScope(self)
+        prof_owner = scope.prof_owner if scope.owns_qc else None
         mark = CK.snapshot()
         prof_error: Optional[BaseException] = None
         try:
@@ -372,11 +367,7 @@ class TpuExec:
             prof_error = e
             raise
         finally:
-            with _COLLECT_LOCK:
-                _COLLECT_DEPTH[0] -= 1
-                outermost = _COLLECT_DEPTH[0] == 0
-                if outermost:
-                    _COLLECT_OWNER[0] = None
+            outermost = scope.finish_collect()
             if outermost:
                 # only the OUTERMOST collect tears down shared-subtree
                 # caches: a nested collect (CpuBroadcastExchange
@@ -399,15 +390,22 @@ class TpuExec:
                 # assemble the QueryProfile LAST so the plan report
                 # sees every metric this query charged
                 P.end_query(prof_owner, self, error=prof_error)
+            # plan lock / admission slot / thread-local context release
+            scope.close()
 
     def _collect_once(self) -> ColumnarBatch:
         from spark_rapids_tpu.columnar.batch import concat_batches, empty_batch
-        if _COLLECT_DEPTH[0] <= 1:
+        from spark_rapids_tpu.exec import scheduler as S
+        qc = S.current()
+        if qc is not None and qc.collect_depth <= 1:
             # new top-level execution attempt: shared subtrees re-run.
             # Nested collects (broadcast materialization inside a plan)
             # must NOT bump the epoch — that would silently invalidate
-            # the outer query's CommonSubplanExec caches mid-execution
-            _EXECUTION_EPOCH[0] += 1
+            # the outer query's CommonSubplanExec caches mid-execution.
+            # Epochs are minted from one process-global counter but
+            # scoped to THIS query, so a concurrent query's attempt
+            # never invalidates this query's shared-subtree caches.
+            qc.epoch = S.new_epoch()
         batches = list(self.execute_columnar())
         if not batches:
             return empty_batch(self.output_schema())
@@ -478,30 +476,17 @@ class TpuExec:
         return self.tree_string()
 
 
-#: bumped once per TOP-LEVEL plan execution attempt (collect and its
-#: deopt retry); CommonSubplanExec uses it to scope its materialized
-#: results to a single execution, so retries re-run the subtree with
-#: fast paths disabled and results don't outlive the query.
-#:
-#: THREAD MODEL (ADVICE r4): these are process-global on purpose — the
-#: engine runs ONE top-level query at a time on the driver thread,
-#: like a Spark driver submitting one job per action.  Worker threads
-#: (shuffle manager, pyudf pool, partitioning's shared sorter) never
-#: call collect(); they receive already-materialized batches.  A
-#: second CONCURRENT top-level collect() on another thread would race
-#: the epoch bump and could release_execution_state() mid-query,
-#: clearing or staling CommonSubplanExec caches — guarded below.
-_EXECUTION_EPOCH = [0]
-#: collect() nesting depth — broadcast exchanges collect their child
-#: mid-plan; those inner collects must neither bump the epoch nor
-#: release the outer query's shared-subtree caches
-_COLLECT_DEPTH = [0]
-#: owner of the in-flight top-level collect; a concurrent top-level
-#: collect from a different thread raises instead of corrupting the
-#: shared execution state (one-query-at-a-time discipline, see above)
-_COLLECT_OWNER = [None]
-#: guards depth/owner updates so simultaneous ENTRY is caught too
-_COLLECT_LOCK = threading.Lock()
+#: THREAD MODEL (superseding the ADVICE r4 one-query-at-a-time note):
+#: execution-attempt epochs, collect nesting depth, the CancelToken,
+#: the deferred-check registry, and the profile tracer all live on a
+#: per-query QueryContext (exec/scheduler.py) installed by the
+#: outermost collect and threaded to helper threads via TaskContext —
+#: so top-level collects from DIFFERENT sessions run concurrently,
+#: each against its own conf snapshot, serialized only when they share
+#: one plan INSTANCE (the per-plan `_plan_lock`).  Epochs are minted
+#: from one process-global counter (scheduler.new_epoch) so no two
+#: attempts, in any query, can collide on a CommonSubplanExec cache
+#: tag.
 
 
 class CommonSubplanExec(TpuExec):
@@ -532,10 +517,12 @@ class CommonSubplanExec(TpuExec):
         return "CommonSubplanExec"
 
     def execute_partitions(self):
-        if self._epoch != _EXECUTION_EPOCH[0]:
+        from spark_rapids_tpu.exec import scheduler as S
+        epoch = S.current_epoch()
+        if self._epoch != epoch:
             self._cached = [list(it)
                             for it in self.child.execute_partitions()]
-            self._epoch = _EXECUTION_EPOCH[0]
+            self._epoch = epoch
         return [iter(p) for p in self._cached]
 
     def execute_columnar(self):

@@ -121,10 +121,14 @@ class PrefetchIterator:
         #: so construction-time capture is exact)
         from spark_rapids_tpu.utils import checks as CK
         self._retrying = CK.is_retrying()
-        #: the creating query's cancel token: producer put polls and
-        #: consumer get polls both check it, so neither side of the
-        #: queue can outlive a watchdog cancellation
+        #: the creating query's context AND cancel token: the producer
+        #: thread runs scoped to the creator's query, so its conf
+        #: reads, deferred checks, profile events, semaphore fair-share
+        #: group, and cancellation all belong to the RIGHT query —
+        #: never a concurrent session's
+        from spark_rapids_tpu.exec import scheduler as S
         from spark_rapids_tpu.utils import watchdog as W
+        self._qc = S.current()
         self._token = W.current_token()
         #: creator's span context (None unless the query is profiled):
         #: the producer thread attaches here so its spans parent under
@@ -264,14 +268,19 @@ class PrefetchIterator:
         else:
             own_ctx = TaskContext(next(_PRODUCER_TASK_IDS))
             TaskContext.set_current(own_ctx)
-        # thread the query's cancel token through the TaskContext so
-        # downstream checks on this thread reach the right token
+        # thread the query's cancel token + context through the
+        # TaskContext so downstream checks on this thread (and any
+        # helper threads it spawns) reach the right query
         cur = TaskContext.get()
         if cur is not None and getattr(cur, "cancel_token", None) is None:
             cur.cancel_token = self._token
+        if cur is not None and getattr(cur, "query_ctx", None) is None:
+            cur.query_ctx = self._qc
+        from spark_rapids_tpu.exec import scheduler as S
         from spark_rapids_tpu.utils import profile as P
         try:
-            with C.session(self._conf), P.attach(self._span_ref), \
+            with S.scoped(self._qc), C.session(self._conf), \
+                    P.attach(self._span_ref), \
                     P.span(f"producer:{self._label}", cat=P.CAT_PIPELINE):
                 hb = W.heartbeat(f"producer:{self._label}",
                                  kind="task",

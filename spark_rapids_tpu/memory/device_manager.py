@@ -74,6 +74,11 @@ class DeviceManager:
         self.hbm_total = total
         self._store_bytes = 0
         self._reserved = 0
+        #: admission ledger (exec/scheduler.py): query_id -> declared
+        #: HBM budget.  Coarse, query-lifetime commitments that gate
+        #: ADMISSION of further queries; operator-level reserve() keeps
+        #: doing the fine-grained real-time accounting within them.
+        self._admitted: dict[str, int] = {}
         self._acct = threading.Lock()
         self.spill_callback: Optional[SpillCallback] = None
 
@@ -178,3 +183,31 @@ class DeviceManager:
     def release_reservation(self, nbytes: int) -> None:
         with self._acct:
             self._reserved = max(0, self._reserved - nbytes)
+
+    # -- admission ledger (query-lifetime budget commitments) -----------------
+    def try_admit(self, query_id: str, nbytes: int) -> bool:
+        """Commit `nbytes` of the budget to `query_id` for its
+        lifetime, iff the sum of admitted budgets still fits.  Unlike
+        reserve(), admission never spills: a query that does not fit
+        WAITS at the front door (or is shed) instead of evicting the
+        working sets of queries already running."""
+        with self._acct:
+            if query_id in self._admitted:
+                return True
+            if sum(self._admitted.values()) + nbytes <= self.budget:
+                self._admitted[query_id] = int(nbytes)
+                return True
+        return False
+
+    def release_admission(self, query_id: str) -> None:
+        with self._acct:
+            self._admitted.pop(query_id, None)
+
+    def admissions(self) -> dict[str, int]:
+        """Copy of the admission ledger (query_id -> budget bytes)."""
+        with self._acct:
+            return dict(self._admitted)
+
+    def admitted_bytes(self) -> int:
+        with self._acct:
+            return sum(self._admitted.values())

@@ -87,8 +87,13 @@ class OomInjector:
             return "split" if self._rng.random() < 0.5 else "retry"
 
 
-_injector: Optional[OomInjector] = None
-_injector_key = None
+#: injectors keyed by (rate, seed, max): concurrent queries with
+#: DIFFERENT injection confs (a soak's victim query vs its clean
+#: peers) each drive their own deterministic stream instead of
+#: churning one global injector's state — and a query whose conf
+#: carries rate 0 never touches an injector at all, so targeted
+#: injection is per-query by construction
+_injectors: dict[tuple, OomInjector] = {}
 _inj_lock = threading.Lock()
 
 
@@ -98,26 +103,23 @@ def _get_injector(conf) -> Optional[OomInjector]:
         return None
     key = (rate, int(conf[C.OOM_INJECT_SEED]),
            int(conf[C.OOM_INJECT_MAX]))
-    global _injector, _injector_key
     with _inj_lock:
-        if _injector is None or _injector_key != key:
-            _injector = OomInjector(*key)
-            _injector_key = key
-        return _injector
+        inj = _injectors.get(key)
+        if inj is None:
+            inj = _injectors[key] = OomInjector(*key)
+        return inj
 
 
 def reset_oom_injection() -> None:
-    """Drop the process-global injector so the next run re-seeds (tests
-    call this between runs for determinism)."""
-    global _injector, _injector_key
+    """Drop the process-global injectors so the next run re-seeds
+    (tests call this between runs for determinism)."""
     with _inj_lock:
-        _injector = None
-        _injector_key = None
+        _injectors.clear()
 
 
 def injected_oom_count() -> int:
     with _inj_lock:
-        return _injector.injected if _injector is not None else 0
+        return sum(i.injected for i in _injectors.values())
 
 
 # ---------------------------------------------------------------------------

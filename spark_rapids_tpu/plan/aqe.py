@@ -119,21 +119,25 @@ class ShuffleQueryStageExec(LeafExec):
         self._consumed = set()
         self._fill_error = None
         conf = C.get_active_conf()
+        from spark_rapids_tpu.exec import scheduler as S
         from spark_rapids_tpu.utils import profile as P
         # captured on the materializing thread so the fill thread's
-        # spans parent under the stage that spawned it
+        # spans parent under the stage that spawned it, and its conf
+        # reads / cancellation / events reach the RIGHT query
         span_ref = P.current_ref()
+        qc = S.current()
         self._fill = threading.Thread(
-            target=self._fill_run, args=(conf, span_ref), daemon=True,
-            name="tpu-aqe-stage-fill")
+            target=self._fill_run, args=(conf, span_ref, qc),
+            daemon=True, name="tpu-aqe-stage-fill")
         self._fill.start()
         return self
 
-    def _fill_run(self, conf, span_ref=None) -> None:
+    def _fill_run(self, conf, span_ref=None, qc=None) -> None:
+        from spark_rapids_tpu.exec import scheduler as S
         from spark_rapids_tpu.utils import profile as P
         from spark_rapids_tpu.utils import watchdog as W
         try:
-            with C.session(conf), P.attach(span_ref), \
+            with S.scoped(qc), C.session(conf), P.attach(span_ref), \
                     P.span("aqe-stage-fill", cat=P.CAT_SHUFFLE):
                 with W.heartbeat("aqe-stage-fill", kind="task") as hb:
                     for p, it in enumerate(

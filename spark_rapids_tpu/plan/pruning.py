@@ -165,8 +165,14 @@ def _prune(node: N.CpuNode, required: Optional[set],
         keep = [f.name for f in schema.fields if f.name in required]
         if not keep:  # count(*)-style: keep one narrow column for rows
             keep = [schema.fields[0].name]
-        return N.CpuSource([p[keep] for p in node.partitions],
-                           _narrow_schema(schema, set(keep)))
+        pruned = N.CpuSource([p[keep] for p in node.partitions],
+                             _narrow_schema(schema, set(keep)))
+        # the narrowed copies are rebuilt on every plan; the result
+        # cache keys source identity on the session's ORIGINAL frames
+        # (the kept-column set is determined by the plan structure)
+        pruned.source_identity = getattr(
+            node, "source_identity", None) or tuple(node.partitions)
+        return pruned
 
     if type(node).__name__ == "CpuFileScan":
         schema = node.output_schema()

@@ -411,14 +411,18 @@ class CachingShuffleReader:
         done = threading.Event()
         # captured on the consuming thread: the fetch worker's spans
         # (ShuffleClient fetch ranges) parent under this reader's scope
+        # and its conf / cancellation / events reach the RIGHT query
+        from spark_rapids_tpu.exec import scheduler as S
         span_ref = P.current_ref()
+        qc = S.current()
 
         def fetch_all():
             try:
                 # raw worker thread: install the consuming task's conf
                 # so watchdog deadlines / fault injection resolve to
                 # the session's values, not registry defaults
-                with C.session(self.conf), P.attach(span_ref):
+                with S.scoped(qc), C.session(self.conf), \
+                        P.attach(span_ref):
                     for address, blocks in remote.items():
                         current["addr"] = address
                         conn = self.manager.transport.make_client(

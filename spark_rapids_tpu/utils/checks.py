@@ -102,6 +102,21 @@ _PENDING: list[BatchCheck] = []
 _RETRY = threading.local()
 
 
+def _pending_list() -> list:
+    """The deferred-check registry for the CURRENT query: each
+    QueryContext owns its own list (concurrent queries' checks must
+    not interleave — one query's snapshot/drain would steal another's
+    checks); the process-global list serves query-less legacy paths."""
+    try:
+        from spark_rapids_tpu.exec import scheduler as S
+        qc = S.current()
+        if qc is not None:
+            return qc.pending_checks
+    except ImportError:
+        pass
+    return _PENDING
+
+
 def set_retrying(flag: bool) -> None:
     """Marks the deopt RE-EXECUTION (collect catches FastPathInvalid,
     recovers, and re-runs once).  Optimistic fast paths whose recovery
@@ -126,7 +141,7 @@ def register_deopt(flag, origin: str, recover, checks: tuple) -> tuple:
 
 def register(check: BatchCheck) -> BatchCheck:
     with _LOCK:
-        _PENDING.append(check)
+        _pending_list().append(check)
     return check
 
 
@@ -210,9 +225,10 @@ def verify(checks, scalars=()) -> list:
                         bad_set.add(i)
     bad = [c for i, c in enumerate(checks) if i in bad_set]
     with _LOCK:
+        pending = _pending_list()
         for c in checks:
             try:
-                _PENDING.remove(c)
+                pending.remove(c)
             except ValueError:
                 pass
     for c in bad:
@@ -225,17 +241,22 @@ def verify(checks, scalars=()) -> list:
 
 def snapshot() -> int:
     """Mark the current registry position; checks registered after this
-    belong to the query now starting (the engine executes one query at
-    a time per process — concurrent registrations would interleave)."""
+    belong to the enclosing execution attempt.  The registry is
+    PER-QUERY (each QueryContext owns its list, helper threads reach it
+    through their propagated context), so concurrent queries\'
+    registrations never interleave and one query\'s drain can never
+    steal another\'s checks."""
     with _LOCK:
-        return len(_PENDING)
+        return len(_pending_list())
 
 
 def drain_since(mark: int) -> list:
-    """Remove and return every check registered after `mark`."""
+    """Remove and return every check the current query registered
+    after `mark`."""
     with _LOCK:
-        checks = _PENDING[mark:]
-        del _PENDING[mark:]
+        pending = _pending_list()
+        checks = pending[mark:]
+        del pending[mark:]
     return checks
 
 
@@ -243,10 +264,10 @@ def verify_pending() -> None:
     """Resolve EVERY outstanding registered check (the collect-boundary
     safety net for execs that dropped per-batch check tuples)."""
     with _LOCK:
-        checks = list(_PENDING)
+        checks = list(_pending_list())
     verify(checks)
 
 
 def clear_pending() -> None:
     with _LOCK:
-        _PENDING.clear()
+        del _pending_list()[:]

@@ -58,6 +58,7 @@ CAT_SHUFFLE = "shuffle"
 CAT_COMPILE = "compile"
 CAT_RETRY = "retry"        # OOM retry harness blocked (spill/reserve)
 CAT_UDF = "udf"
+CAT_QUEUE = "queue"        # parked in the scheduler's admission queue
 
 #: ring-buffer bounds — big enough for a deep TPC-DS plan's batch spans,
 #: small enough that a runaway loop cannot eat the heap
@@ -96,19 +97,37 @@ class Span:
 # ---------------------------------------------------------------------------
 # thread-local span context: (tracer, innermost live Span).  Stale
 # entries from a finished query are ignored because every read checks
-# the tracer identity against the live global.
+# the tracer identity against its live query.
 _TLS = threading.local()
 
 _TRACER_LOCK = threading.Lock()
+#: FALLBACK tracer for threads with no query identity at all (shuffle
+#: server handlers, bare tests): the most recently begun still-active
+#: tracer.  Threads carrying a QueryContext always resolve their own
+#: query's tracer instead — a profiled query A never records events
+#: from query B's threads.
 _TRACER: Optional["QueryTracer"] = None
+#: count of live tracers across all concurrent queries — the hot-loop
+#: disabled-path gate stays ONE module-global read
+_ACTIVE = 0
 
 _QUERY_IDS = iter(range(1, 1 << 62))
 
 
 def tracer() -> Optional["QueryTracer"]:
-    """The live tracer, or None when profiling is off / no query is in
-    flight.  ONE module-global read — cheap enough for hot loops to
-    gate on."""
+    """The live tracer for the CALLING thread's query, or None when
+    profiling is off / its query is unprofiled.  With no profiled query
+    anywhere this is ONE module-global read — cheap enough for hot
+    loops to gate on."""
+    if _ACTIVE == 0:
+        return None
+    try:
+        from spark_rapids_tpu.exec import scheduler as S
+        qc = S.current()
+    except ImportError:
+        qc = None
+    if qc is not None:
+        return qc.tracer   # None for an unprofiled query: isolation
     return _TRACER
 
 
@@ -122,9 +141,11 @@ def _tls_ctx(tr: "QueryTracer") -> Optional[Span]:
 class QueryTracer:
     """Span + event recorder for one query."""
 
-    def __init__(self, conf: C.RapidsConf):
-        self.query_id = f"q{next(_QUERY_IDS):06d}"
+    def __init__(self, conf: C.RapidsConf,
+                 query_id: Optional[str] = None):
+        self.query_id = query_id or f"q{next(_QUERY_IDS):06d}"
         self.conf = conf
+        self.ended = False
         self.t_origin = time.perf_counter_ns()
         self.wall_start = time.time()
         self._ids = iter(range(1, 1 << 62))
@@ -225,19 +246,19 @@ _NULL_SPAN = _NullSpanCtx()
 
 def span(name: str, cat: str = CAT_EXEC, **args):
     """Open a span under the current thread's innermost live span (the
-    query root when none).  Returns a shared null context when no query
-    is being profiled — call sites that would allocate building `name`
-    should gate on `tracer() is not None` instead."""
-    tr = _TRACER
+    query root when none).  Returns a shared null context when this
+    thread's query is not being profiled — call sites that would
+    allocate building `name` should gate on `tracer() is not None`."""
+    tr = tracer()
     if tr is None:
         return _NULL_SPAN
     return _SpanCtx(tr, name, cat, args or None)
 
 
 def event(kind: str, **fields) -> None:
-    """Append one structured record to the live query's event log (a
-    no-op when no query is being profiled)."""
-    tr = _TRACER
+    """Append one structured record to the calling thread's query's
+    event log (a no-op when that query is not being profiled)."""
+    tr = tracer()
     if tr is not None:
         tr.event(kind, **fields)
 
@@ -247,8 +268,8 @@ def event(kind: str, **fields) -> None:
 def current_ref():
     """Capture the calling thread's span context for a helper thread
     (pipeline producer, shuffle fetch thread, AQE fill, pyudf worker).
-    None when no query is being profiled."""
-    tr = _TRACER
+    None when this thread's query is not being profiled."""
+    tr = tracer()
     if tr is None:
         return None
     return (tr, _tls_ctx(tr))
@@ -275,7 +296,7 @@ def attach(ref):
     """Install a captured span context as this thread's parent scope,
     so spans the thread opens land under the creator's span.  A stale
     ref (its query already ended) or None degrades to a no-op."""
-    if ref is None or ref[0] is not _TRACER:
+    if ref is None or ref[0].ended:
         return _NULL_SPAN
     return _AttachCtx(ref)
 
@@ -285,9 +306,9 @@ def wrap_operator(exec_, idx: int, it: Iterator) -> Iterator:
     """Wrap one operator partition iterator so every batch pull records
     an `op:<Exec>` span on the pulling thread (child pulls nest inside,
     so the span tree mirrors the plan tree).  Returns `it` UNCHANGED
-    when no query is being profiled — the disabled hot loop keeps its
-    exact iterator object and allocates nothing."""
-    if _TRACER is None:
+    when this thread's query is not being profiled — the disabled hot
+    loop keeps its exact iterator object and allocates nothing."""
+    if tracer() is None:
         return it
     return _op_spans(exec_.name(), idx, it)
 
@@ -296,8 +317,8 @@ def _op_spans(name: str, idx: int, it: Iterator) -> Iterator:
     it = iter(it)
     label = f"{name}[p{idx}]"
     while True:
-        tr = _TRACER
-        if tr is None:
+        tr = tracer()
+        if tr is None or tr.ended:
             # the profiled query ended (e.g. iterator outlived collect):
             # stop tracing, keep streaming
             yield from it
@@ -314,18 +335,35 @@ def _op_spans(name: str, idx: int, it: Iterator) -> Iterator:
 def begin_query(conf: Optional[C.RapidsConf] = None
                 ) -> Optional[QueryTracer]:
     """Install a tracer for a new top-level query if profiling is
-    enabled and none is active.  Returns the tracer iff THIS caller owns
-    it (and must pass it to `end_query`); None otherwise, so nested
-    collects inside a profiled query are free."""
-    global _TRACER
+    enabled and ITS query has none yet.  With a QueryContext in scope
+    (the concurrent-serving path) the tracer lives on the context —
+    several profiled queries record side by side, each into its own
+    tracer; without one (legacy/bare paths) a single process-global
+    tracer preserves the old one-at-a-time behavior.  Returns the
+    tracer iff THIS caller owns it (and must pass it to `end_query`);
+    None otherwise, so nested collects inside a profiled query are
+    free."""
+    global _TRACER, _ACTIVE
     conf = conf if conf is not None else C.get_active_conf()
     if not conf[C.PROFILE_ENABLED]:
         return None
+    try:
+        from spark_rapids_tpu.exec import scheduler as S
+        qc = S.current()
+    except ImportError:
+        qc = None
     with _TRACER_LOCK:
-        if _TRACER is not None:
-            return None
-        tr = QueryTracer(conf)
-        _TRACER = tr
+        if qc is not None:
+            if qc.tracer is not None:
+                return None
+            tr = QueryTracer(conf, query_id=qc.query_id)
+            qc.tracer = tr
+        else:
+            if _TRACER is not None:
+                return None
+            tr = QueryTracer(conf)
+        _TRACER = tr        # fallback for query-less threads
+        _ACTIVE += 1
     tr.root = tr.open_span("query", CAT_QUERY, None, None)
     _TLS.ctx = (tr, tr.root)
     return tr
@@ -337,16 +375,25 @@ def end_query(owner: Optional[QueryTracer], plan=None,
     """Close the owned tracer, assemble the QueryProfile, push it into
     the bounded history, and flush the conf'd file sinks.  No-op when
     `owner` is None (this caller did not begin the query)."""
-    global _TRACER
+    global _TRACER, _ACTIVE
     if owner is None:
         return None
     if error is not None:
         owner.event("query_error", error=f"{type(error).__name__}: "
                     f"{error}"[:500])
     owner.close_span(owner.root)
+    try:
+        from spark_rapids_tpu.exec import scheduler as S
+        qc = S.current()
+    except ImportError:
+        qc = None
     with _TRACER_LOCK:
+        owner.ended = True
+        if qc is not None and qc.tracer is owner:
+            qc.tracer = None
         if _TRACER is owner:
             _TRACER = None
+        _ACTIVE = max(0, _ACTIVE - 1)
     if getattr(_TLS, "ctx", None) is not None and _TLS.ctx[0] is owner:
         _TLS.ctx = None
     profile = QueryProfile.build(owner, plan)
@@ -481,7 +528,7 @@ class QueryProfile:
         by_id = {s.sid: s for s in spans}
         wall_ns = root.dur_ns if root is not None else 0
         cats = {CAT_WAIT: 0, CAT_SHUFFLE: 0, CAT_COMPILE: 0,
-                CAT_RETRY: 0, CAT_UDF: 0}
+                CAT_RETRY: 0, CAT_UDF: 0, CAT_QUEUE: 0}
         for s in spans:
             if s.cat not in cats:
                 continue
@@ -497,6 +544,7 @@ class QueryProfile:
             "compile_s": round(cats[CAT_COMPILE] / 1e9, 6),
             "retry_block_s": round(cats[CAT_RETRY] / 1e9, 6),
             "udf_s": round(cats[CAT_UDF] / 1e9, 6),
+            "queue_wait_s": round(cats[CAT_QUEUE] / 1e9, 6),
             "compute_s": round(max(0, wall_ns - attributed) / 1e9, 6),
         }
 

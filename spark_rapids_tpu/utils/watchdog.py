@@ -119,12 +119,23 @@ class CancelToken:
 
 
 # ---------------------------------------------------------------------------
-# token management: the engine executes one top-level query at a time
-# (exec/base.py thread model), so one process-global token per query;
-# helper threads additionally find it on their TaskContext
-# (`ctx.cancel_token`), which PrefetchIterator installs.
+# token management: every query owns its token on its QueryContext
+# (exec/scheduler.py), installed thread-locally by the outermost
+# collect and threaded to helper threads via `TaskContext.query_ctx` /
+# `ctx.cancel_token` — so cancelling query A can never reach a thread
+# working for query B.  The process-global token remains only as the
+# fallback for threads with no query identity at all (shuffle server
+# accept loops, bare tests).
 _TOKEN_LOCK = threading.Lock()
 _TOKEN = CancelToken()
+
+
+def _current_query_ctx():
+    try:
+        from spark_rapids_tpu.exec import scheduler as S
+        return S.current()
+    except ImportError:
+        return None
 
 
 def current_token() -> CancelToken:
@@ -133,14 +144,17 @@ def current_token() -> CancelToken:
     tok = getattr(ctx, "cancel_token", None) if ctx is not None else None
     if tok is not None:
         return tok
+    qc = _current_query_ctx()
+    if qc is not None:
+        return qc.token
     with _TOKEN_LOCK:
         return _TOKEN
 
 
 def begin_query() -> CancelToken:
-    """Install a fresh CancelToken for a new top-level query (called by
-    the outermost collect) and reset the per-query watchdog stats.
-    Returns the token."""
+    """Reset the process-global FALLBACK token + stats (hygiene for
+    query-less legacy paths and tests; queries proper each carry their
+    own token on their QueryContext).  Returns the fresh token."""
     global _TOKEN
     with _TOKEN_LOCK:
         _TOKEN = CancelToken()
@@ -195,8 +209,14 @@ _TOTAL_STATS = {"timeouts": 0, "cancels": 0, "dumps": 0}
 
 
 def query_stats() -> dict:
-    """Watchdog counters since the last `begin_query` (the per-query
-    view `TpuExec.collect` charges to the plan's metrics)."""
+    """Watchdog counters for the CURRENT query (its QueryContext's
+    stats — the per-query view `TpuExec.collect` charges to the plan's
+    metrics); the process-global legacy stats when no query context is
+    installed."""
+    qc = _current_query_ctx()
+    if qc is not None:
+        with _STATS_LOCK:
+            return dict(qc.stats)
     with _STATS_LOCK:
         return dict(_QUERY_STATS)
 
@@ -207,15 +227,19 @@ def watchdog_stats() -> dict:
         return dict(_TOTAL_STATS)
 
 
-def _note_gap(ms: float) -> None:
+def _note_gap(ms: float, qc=None) -> None:
+    """Charge a heartbeat gap to its OWN query's stats (`qc` captured
+    at heartbeat creation), falling back to the legacy global."""
+    stats = qc.stats if qc is not None else _QUERY_STATS
     with _STATS_LOCK:
-        if ms > _QUERY_STATS["slowest_heartbeat_ms"]:
-            _QUERY_STATS["slowest_heartbeat_ms"] = int(ms)
+        if ms > stats["slowest_heartbeat_ms"]:
+            stats["slowest_heartbeat_ms"] = int(ms)
 
 
-def _note_fire(dumped: bool) -> None:
+def _note_fire(dumped: bool, qc=None) -> None:
+    per_query = qc.stats if qc is not None else _QUERY_STATS
     with _STATS_LOCK:
-        for s in (_QUERY_STATS, _TOTAL_STATS):
+        for s in (per_query, _TOTAL_STATS):
             s["timeouts"] += 1
             s["cancels"] += 1
             if dumped:
@@ -293,10 +317,13 @@ class Heartbeat:
         self.fired = False
         self._paused = 0
         self._id = next(_HB_IDS)
+        #: the owning query (None outside a query): gap stats charge
+        #: HERE and a timeout fires THIS query's token/event log only
+        self.qc = _current_query_ctx()
 
     def beat(self, n: int = 1) -> None:
         now = time.monotonic()
-        _note_gap((now - self.last_beat) * 1000.0)
+        _note_gap((now - self.last_beat) * 1000.0, self.qc)
         self.last_beat = now
         self.beats += n
 
@@ -322,7 +349,8 @@ class Heartbeat:
 
     def describe(self) -> str:
         age = time.monotonic() - self.last_beat
-        return (f"{self.name} [{self.kind}] beats={self.beats} "
+        q = f" query={self.qc.query_id}" if self.qc is not None else ""
+        return (f"{self.name} [{self.kind}]{q} beats={self.beats} "
                 f"last_progress={age:.1f}s ago deadline="
                 f"{self.deadline:.1f}s thread={self.thread_name}")
 
@@ -414,7 +442,7 @@ def _scan_loop() -> None:
                 # each would bury the first (causal) dump
                 continue
             gap = now - hb.last_beat
-            _note_gap(gap * 1000.0)
+            _note_gap(gap * 1000.0, hb.qc)
             if gap > hb.deadline:
                 hb.fired = True
                 _fire(hb, gap)
@@ -431,17 +459,22 @@ def _fire(hb: Heartbeat, gap: float) -> None:
             dump = build_dump(stuck=hb)
         except Exception as e:  # noqa: BLE001 — the dump must never
             dump = f"<diagnostic dump failed: {e}>"  # mask the timeout
-    _note_fire(dump is not None)
+    _note_fire(dump is not None, hb.qc)
     # one CORRELATED record (query id + site + full dump) in the
-    # structured event log; dumpOnTimeout keeps the console copy below
+    # structured event log, attributed to the STUCK query's own event
+    # log (the scanner thread itself belongs to no query); the token
+    # cancel event inside cancel() rides the same scope.  dumpOnTimeout
+    # keeps the console copy below.
+    from spark_rapids_tpu.exec import scheduler as S
     from spark_rapids_tpu.utils import profile as P
-    P.event("watchdog_timeout", heartbeat=hb.name,
-            deadline_class=hb.kind, gap_s=round(gap, 2),
-            deadline_s=hb.deadline, stuck_thread=hb.thread_name,
-            reason=reason, dump=dump)
-    log.error("watchdog timeout: %s%s", reason,
-              "\n" + dump if dump else "")
-    hb.token.cancel(reason, dump)
+    with S.scoped(hb.qc):
+        P.event("watchdog_timeout", heartbeat=hb.name,
+                deadline_class=hb.kind, gap_s=round(gap, 2),
+                deadline_s=hb.deadline, stuck_thread=hb.thread_name,
+                reason=reason, dump=dump)
+        log.error("watchdog timeout: %s%s", reason,
+                  "\n" + dump if dump else "")
+        hb.token.cancel(reason, dump)
 
 
 # ---------------------------------------------------------------------------
@@ -467,10 +500,21 @@ def build_dump(stuck: Optional[Heartbeat] = None) -> str:
     try:
         from spark_rapids_tpu.memory.semaphore import TpuSemaphore
         sem = TpuSemaphore.get()
-        refs = sem.snapshot()
-        lines.append(f"  holders={len(refs)} "
+        snap = sem.snapshot()
+        lines.append(f"  holders={len(snap['refs'])} "
                      f"max_concurrent={sem.max_concurrent} "
-                     f"refs={refs}")
+                     f"refs={snap['refs']} "
+                     f"query_holds={snap['queryHolds']} "
+                     f"longest_wait_ms={snap['longestWaitMs']}")
+        for w in snap["waiters"]:
+            lines.append(f"  waiting: {w}")
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"  <unavailable: {e}>")
+    lines.append("-- query scheduler --")
+    try:
+        from spark_rapids_tpu.exec.scheduler import QueryScheduler
+        lines.append(f"  {QueryScheduler.get().describe()}")
+        lines.append(f"  stats={QueryScheduler.get().stats()}")
     except Exception as e:  # noqa: BLE001
         lines.append(f"  <unavailable: {e}>")
     lines.append("-- prefetch pipeline --")

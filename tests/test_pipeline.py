@@ -151,11 +151,17 @@ def test_producer_blocked_on_full_queue_never_holds_semaphore():
         assert it.blocked.is_set()
         # while the producer is parked, its semaphore hold is yielded:
         # another task can take the single permit immediately
-        with TaskContext(777):
-            acquired = sem._sem.acquire(timeout=2.0)
-            assert acquired, ("producer blocked on a full prefetch "
-                              "queue is holding the TPU semaphore")
-            sem._sem.release()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline \
+                and sem.available_permits() < 1:
+            time.sleep(0.01)
+        assert sem.available_permits() >= 1, (
+            "producer blocked on a full prefetch queue is holding "
+            "the TPU semaphore")
+        with TaskContext(777) as probe:
+            sem.acquire_if_necessary(probe)
+            assert sem.holds(probe) == 1
+            sem.release_if_necessary(probe)
         assert list(it) == list(range(1, 10))
     finally:
         TpuSemaphore.shutdown()
@@ -182,13 +188,11 @@ def test_same_task_concurrent_first_acquire_single_permit():
         for t in ts:
             t.join()
         assert sem.holds(ctx) == 2          # refcount: one per acquire
+        # exactly ONE permit was taken for the task, so one remains
+        assert sem.available_permits() == 1
         sem.release_all(ctx)
-        # exactly ONE permit was taken for the task: after release_all
-        # both permits are free again
-        assert sem._sem.acquire(timeout=1.0)
-        assert sem._sem.acquire(timeout=1.0)
-        sem._sem.release()
-        sem._sem.release()
+        # after release_all both permits are free again
+        assert sem.available_permits() == 2
     finally:
         TpuSemaphore.shutdown()
 

@@ -1,0 +1,674 @@
+"""Concurrent multi-query serving suite: admission control, fair-share
+semaphore scheduling, cross-query fault isolation, and the result cache.
+
+The soak discipline mirrors the OOM/recovery/watchdog suites: seeded
+fault injection (oomRate / peerKillAfterFrames / hangSite) is aimed at
+ONE victim query's session conf while mixed TPC-H / TPC-DS queries run
+concurrently from other threads — the victim alone retries/fails per
+its own policy, every other result is bit-exact vs its serial run, and
+after the storm no semaphore permits, HBM admissions/reservations, or
+producer threads are leaked.
+"""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+from pandas.testing import assert_frame_equal
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.exec import scheduler as S
+from spark_rapids_tpu.exec.base import TpuExec, UnaryExecBase
+from spark_rapids_tpu.exec.basic import LocalBatchSource
+from spark_rapids_tpu.exec.scheduler import (QueryContext, QueryScheduler,
+                                             TpuQueryRejected,
+                                             result_cache)
+from spark_rapids_tpu.memory.device_manager import DeviceManager
+from spark_rapids_tpu.memory.semaphore import TaskContext, TpuSemaphore
+from spark_rapids_tpu.models import tpcds_data, tpcds_queries
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables, sources
+from spark_rapids_tpu.models.tpch_queries import QUERIES
+from spark_rapids_tpu.plan.overrides import accelerate
+from spark_rapids_tpu.plan.overrides import collect as plan_collect
+from spark_rapids_tpu.utils import profile as P
+from spark_rapids_tpu.utils import watchdog as W
+
+SCALE = 400
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return gen_tables(np.random.default_rng(11), SCALE)
+
+
+@pytest.fixture(scope="module")
+def ds_tables():
+    return tpcds_data.gen_tables(np.random.default_rng(3), 4000)
+
+
+def _conf(**extra) -> C.RapidsConf:
+    settings = dict(BENCH_CONF)
+    settings.update({k.replace("__", "."): v for k, v in extra.items()})
+    return C.RapidsConf(settings)
+
+
+def _run_tpch(q, tables, conf):
+    return run_query(q, tables, engine="tpu", conf=conf)
+
+
+def _run_tpcds(name, ds_tables, conf):
+    fn = tpcds_queries.QUERIES[name]
+    from spark_rapids_tpu.plan.overrides import accelerate, collect
+
+    def run(plan):
+        return collect(accelerate(plan, conf), conf)
+    return run(fn(tpcds_data.sources(ds_tables, 2), run))
+
+
+def _assert_no_leaks():
+    snap = TpuSemaphore.get().snapshot()
+    assert snap["refs"] == {}, f"leaked semaphore permits: {snap}"
+    dm = DeviceManager.get()
+    assert dm.admissions() == {}, \
+        f"leaked HBM admissions: {dm.admissions()}"
+    assert dm.reserved_bytes == 0, \
+        f"leaked HBM reservations: {dm.reserved_bytes}"
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        live = [t for t in threading.enumerate()
+                if t.name.startswith("tpu-prefetch")
+                or t.name.startswith("tpu-aqe-stage-fill")]
+        if not live:
+            break
+        time.sleep(0.05)
+    assert not live, f"leaked producer threads: {live}"
+
+
+# ---------------------------------------------------------------------------
+# soak: mixed TPC-H / TPC-DS under concurrency, bit-exact vs serial
+def test_soak_mixed_queries_bit_exact(tables, ds_tables):
+    conf = _conf()
+    mix = [("tpch", 1), ("tpch", 5), ("tpch", 6), ("tpcds", "q3"),
+           ("tpcds", "q42"), ("tpch", 1), ("tpch", 6), ("tpcds", "q3")]
+    serial = {}
+    for kind, q in set(mix):
+        serial[(kind, q)] = (_run_tpch(q, tables, conf) if kind == "tpch"
+                             else _run_tpcds(q, ds_tables, conf))
+    results: dict = {}
+    errors: list = []
+
+    def worker(i, kind, q):
+        try:
+            got = (_run_tpch(q, tables, conf) if kind == "tpch"
+                   else _run_tpcds(q, ds_tables, conf))
+            results[i] = ((kind, q), got)
+        except BaseException as e:  # noqa: BLE001 — asserted below
+            errors.append((i, kind, q, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i, kind, q),
+                                name=f"soak-{i}")
+               for i, (kind, q) in enumerate(mix)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert not errors, errors
+    assert len(results) == len(mix)
+    for i, (key, got) in results.items():
+        assert_frame_equal(got.reset_index(drop=True),
+                           serial[key].reset_index(drop=True))
+    _assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# admission control
+class _GatedExec(UnaryExecBase):
+    """Passes batches through, parking on `gate` first — holds its
+    query in the 'executing' state until the test releases it."""
+
+    def __init__(self, child, gate: threading.Event,
+                 entered: threading.Event):
+        super().__init__(child)
+        self.gate = gate
+        self.entered = entered
+
+    def output_schema(self):
+        return self.child.output_schema()
+
+    def process_partition(self, batches):
+        self.entered.set()
+        deadline = time.monotonic() + 60.0
+        while not self.gate.wait(0.05):
+            W.check_cancelled()
+            assert time.monotonic() < deadline, "test gate never opened"
+        yield from batches
+
+
+def _gated_plan(gate, entered):
+    df = pd.DataFrame({"x": np.arange(32, dtype=np.int64)})
+    return _GatedExec(LocalBatchSource.from_pandas(df), gate, entered)
+
+
+def test_admission_queue_full_rejects():
+    gate, entered = threading.Event(), threading.Event()
+    conf = _conf(**{
+        "spark.rapids.sql.scheduler.maxConcurrentQueries": 1,
+        "spark.rapids.sql.scheduler.queueDepth": 0})
+    plan = _gated_plan(gate, entered)
+    out: list = []
+
+    def holder():
+        with C.session(conf):
+            out.append(plan.collect().to_pandas())
+
+    t = threading.Thread(target=holder)
+    t.start()
+    try:
+        assert entered.wait(30), "holder query never started"
+        with C.session(conf):
+            with pytest.raises(TpuQueryRejected) as ei:
+                _gated_plan(threading.Event(), threading.Event()).collect()
+        msg = str(ei.value)
+        assert "queue is full" in msg and "queueDepth" in msg
+    finally:
+        gate.set()
+        t.join(60)
+    assert len(out) == 1 and len(out[0]) == 32
+    _assert_no_leaks()
+
+
+def test_admission_queue_timeout_rejects():
+    gate, entered = threading.Event(), threading.Event()
+    conf = _conf(**{
+        "spark.rapids.sql.scheduler.maxConcurrentQueries": 1,
+        "spark.rapids.sql.scheduler.queueDepth": 8,
+        "spark.rapids.sql.scheduler.queueTimeout": 0.3})
+    plan = _gated_plan(gate, entered)
+    t = threading.Thread(target=_run_plan_under, args=(conf, plan))
+    t.start()
+    try:
+        assert entered.wait(30)
+        t0 = time.monotonic()
+        with C.session(conf):
+            with pytest.raises(TpuQueryRejected) as ei:
+                _gated_plan(threading.Event(), threading.Event()).collect()
+        assert time.monotonic() - t0 < 10
+        assert "admission queue" in str(ei.value)
+    finally:
+        gate.set()
+        t.join(60)
+    _assert_no_leaks()
+
+
+def _run_plan_under(conf, plan):
+    with C.session(conf):
+        return plan.collect()
+
+
+def test_admission_waits_then_admits():
+    """A queued query is admitted (FIFO) the moment the holder's slot
+    frees — no rejection, result intact."""
+    gate, entered = threading.Event(), threading.Event()
+    conf = _conf(**{
+        "spark.rapids.sql.scheduler.maxConcurrentQueries": 1,
+        "spark.rapids.sql.scheduler.queueDepth": 8})
+    holder_plan = _gated_plan(gate, entered)
+    t = threading.Thread(target=_run_plan_under, args=(conf, holder_plan))
+    t.start()
+    try:
+        assert entered.wait(30)
+        waiter_out: list = []
+
+        def waiter():
+            df = pd.DataFrame({"x": np.arange(8, dtype=np.int64)})
+            with C.session(conf):
+                waiter_out.append(
+                    LocalBatchSource.from_pandas(df).collect()
+                    .to_pandas())
+
+        wt = threading.Thread(target=waiter)
+        wt.start()
+        time.sleep(0.3)
+        assert not waiter_out, "waiter ran while the slot was held"
+        gate.set()
+        wt.join(60)
+        assert waiter_out and waiter_out[0]["x"].sum() == 28
+    finally:
+        gate.set()
+        t.join(60)
+    _assert_no_leaks()
+
+
+def test_admission_budget_gates_concurrency():
+    """Two queries each declaring > half the device budget cannot be
+    admitted together even under a generous query-count cap."""
+    dm = DeviceManager.get()
+    budget = max(1, dm.budget)
+    conf = _conf(**{
+        "spark.rapids.sql.scheduler.maxConcurrentQueries": 8,
+        "spark.rapids.sql.scheduler.queryBudgetBytes":
+            (budget * 2) // 3,
+        "spark.rapids.sql.scheduler.queueDepth": 8})
+    gate, entered = threading.Event(), threading.Event()
+    holder_plan = _gated_plan(gate, entered)
+    t = threading.Thread(target=_run_plan_under, args=(conf, holder_plan))
+    t.start()
+    try:
+        assert entered.wait(30)
+        assert len(dm.admissions()) == 1
+        admitted_during: list = []
+
+        def second():
+            df = pd.DataFrame({"x": np.arange(4, dtype=np.int64)})
+            with C.session(conf):
+                LocalBatchSource.from_pandas(df).collect()
+            admitted_during.append(time.monotonic())
+
+        wt = threading.Thread(target=second)
+        wt.start()
+        time.sleep(0.3)
+        assert not admitted_during, \
+            "second over-budget query was admitted alongside the first"
+        gate.set()
+        wt.join(60)
+        assert admitted_during
+    finally:
+        gate.set()
+        t.join(60)
+    _assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# cross-query conf isolation (the PR 2 captured-default-conf bug class)
+def test_conf_isolation_pipeline_on_off_concurrent(tables):
+    """Two concurrent queries with CONFLICTING pipeline confs must each
+    honor their own setting: the enabled one's profile records producer
+    spans, the disabled one's records none — and both are bit-exact."""
+    ref = _run_tpch(1, tables, _conf())
+    conf_on = _conf(**{"spark.rapids.sql.profile.enabled": True,
+                       "spark.rapids.sql.pipeline.enabled": True})
+    conf_off = _conf(**{"spark.rapids.sql.profile.enabled": True,
+                        "spark.rapids.sql.pipeline.enabled": False})
+    results: dict = {}
+    errors: list = []
+    barrier = threading.Barrier(2)
+
+    def worker(name, conf):
+        try:
+            barrier.wait(timeout=30)
+            results[name] = _run_tpch(1, tables, conf)
+        except BaseException as e:  # noqa: BLE001
+            errors.append((name, repr(e)))
+
+    ts = [threading.Thread(target=worker, args=("on", conf_on)),
+          threading.Thread(target=worker, args=("off", conf_off))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(180)
+    assert not errors, errors
+    for name in ("on", "off"):
+        assert_frame_equal(results[name].reset_index(drop=True),
+                           ref.reset_index(drop=True))
+    # the last two profiles are ours (order unknown): exactly one has
+    # producer spans, and neither references the other's query id
+    last2 = P.profile_history()[-2:]
+    assert len(last2) == 2
+    producer_counts = {
+        prof.query_id: sum(1 for s in prof.spans
+                           if s.name.startswith("producer:"))
+        for prof in last2}
+    counts = sorted(producer_counts.values())
+    assert counts[0] == 0 and counts[-1] > 0, producer_counts
+    for prof in last2:
+        assert {e["query_id"] for e in prof.events} == {prof.query_id}
+    _assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# targeted fault injection: the victim alone is affected
+def test_oom_injection_hits_victim_only(tables):
+    victim_conf = _conf(**{
+        "spark.rapids.sql.profile.enabled": True,
+        "spark.rapids.memory.faultInjection.oomRate": 1.0,
+        "spark.rapids.memory.faultInjection.seed": 7,
+        "spark.rapids.memory.faultInjection.maxInjections": 16})
+    clean_conf = _conf(**{"spark.rapids.sql.profile.enabled": True})
+    ref = {q: _run_tpch(q, tables, _conf()) for q in (1, 5)}
+    results: dict = {}
+    errors: list = []
+
+    def worker(name, q, conf):
+        try:
+            results[name] = _run_tpch(q, tables, conf)
+        except BaseException as e:  # noqa: BLE001
+            errors.append((name, repr(e)))
+
+    ts = [threading.Thread(target=worker, args=("victim", 1,
+                                                victim_conf)),
+          threading.Thread(target=worker, args=("clean-1", 5,
+                                                clean_conf)),
+          threading.Thread(target=worker, args=("clean-2", 1,
+                                                clean_conf))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(300)
+    assert not errors, errors
+    # every query bit-exact (the victim recovers through the retry
+    # lattice; bestEffort fallback keeps it correct)
+    assert_frame_equal(results["victim"].reset_index(drop=True),
+                       ref[1].reset_index(drop=True))
+    assert_frame_equal(results["clean-1"].reset_index(drop=True),
+                       ref[5].reset_index(drop=True))
+    assert_frame_equal(results["clean-2"].reset_index(drop=True),
+                       ref[1].reset_index(drop=True))
+    # retry events landed ONLY in the victim's event log
+    profs = P.profile_history()[-3:]
+    oom_events = {prof.query_id: [e for e in prof.events
+                                  if e["kind"].startswith("oom_")]
+                  for prof in profs}
+    with_oom = [qid for qid, evs in oom_events.items() if evs]
+    assert len(with_oom) == 1, oom_events
+    _assert_no_leaks()
+
+
+def test_hang_injection_cancels_victim_only(tables):
+    victim_conf = _conf(**{
+        "spark.rapids.memory.faultInjection.hangSite": "producer",
+        "spark.rapids.memory.faultInjection.hangAfterBatches": 1,
+        "spark.rapids.sql.watchdog.taskTimeout": 2.0,
+        "spark.rapids.sql.watchdog.pollInterval": 0.1})
+    clean_conf = _conf()
+    ref = _run_tpch(5, tables, _conf())
+    results: dict = {}
+    outcomes: dict = {}
+
+    def victim():
+        try:
+            _run_tpch(1, tables, victim_conf)
+            outcomes["victim"] = "completed"
+        except W.TpuQueryTimeout:
+            outcomes["victim"] = "cancelled"
+        except BaseException as e:  # noqa: BLE001
+            outcomes["victim"] = f"unexpected: {e!r}"
+
+    def clean(name):
+        try:
+            results[name] = _run_tpch(5, tables, clean_conf)
+            outcomes[name] = "completed"
+        except BaseException as e:  # noqa: BLE001
+            outcomes[name] = f"unexpected: {e!r}"
+
+    ts = [threading.Thread(target=victim),
+          threading.Thread(target=clean, args=("clean-1",)),
+          threading.Thread(target=clean, args=("clean-2",))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(300)
+    W.reset_hang_injection()
+    assert outcomes.get("victim") == "cancelled", outcomes
+    for name in ("clean-1", "clean-2"):
+        assert outcomes.get(name) == "completed", outcomes
+        assert_frame_equal(results[name].reset_index(drop=True),
+                           ref.reset_index(drop=True))
+    _assert_no_leaks()
+    # the process stays healthy: the victim's query reruns clean
+    rerun = _run_tpch(1, tables, clean_conf)
+    assert_frame_equal(rerun.reset_index(drop=True),
+                       _run_tpch(1, tables, _conf())
+                       .reset_index(drop=True))
+
+
+@pytest.mark.slowish
+def test_peer_kill_recovery_isolated(tables):
+    """A victim on the manager-lane shuffle with seeded peer-kill
+    recovers bit-exactly while clean queries run concurrently on the
+    default exchange."""
+    victim_conf = _conf(**{
+        "spark.rapids.shuffle.enabled": True,
+        "spark.rapids.shuffle.localExecutors": 2,
+        "spark.rapids.shuffle.fetch.maxRetries": 2,
+        "spark.rapids.shuffle.fetch.backoff.baseMs": 1.0,
+        "spark.rapids.shuffle.transport.faultInjection"
+        ".peerKillAfterFrames": 3})
+    clean_conf = _conf()
+    ref1 = _run_tpch(1, tables, _conf())
+    ref6 = _run_tpch(6, tables, _conf())
+    results: dict = {}
+    errors: list = []
+
+    def worker(name, q, conf):
+        try:
+            results[name] = _run_tpch(q, tables, conf)
+        except BaseException as e:  # noqa: BLE001
+            errors.append((name, repr(e)))
+
+    ts = [threading.Thread(target=worker, args=("victim", 1,
+                                                victim_conf)),
+          threading.Thread(target=worker, args=("clean", 6,
+                                                clean_conf))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(300)
+    assert not errors, errors
+    assert_frame_equal(results["victim"].reset_index(drop=True),
+                       ref1.reset_index(drop=True))
+    assert_frame_equal(results["clean"].reset_index(drop=True),
+                       ref6.reset_index(drop=True))
+    _assert_no_leaks()
+
+
+# ---------------------------------------------------------------------------
+# fair-share semaphore
+def _ctx_for(qc: QueryContext, tid: int) -> TaskContext:
+    ctx = TaskContext(tid)
+    ctx.query_ctx = qc
+    return ctx
+
+
+def test_semaphore_fair_share_across_queries():
+    """A heavy query holding permits with a FIFO backlog must not
+    starve a light query: the waiter from the query with the FEWEST
+    current holds wins the freed permit, even arriving last."""
+    sem = TpuSemaphore(2)
+    heavy, light = QueryContext(C.RapidsConf()), \
+        QueryContext(C.RapidsConf())
+    order: list = []
+    h1, h2 = _ctx_for(heavy, 1), _ctx_for(heavy, 2)
+    sem.acquire_if_necessary(h1)          # heavy holds BOTH permits
+    sem.acquire_if_necessary(h2)
+
+    def waiter(name, ctx):
+        sem.acquire_if_necessary(ctx)
+        order.append(name)
+        sem.release_all(ctx)
+
+    # heavy queues two more tasks FIRST, then light arrives
+    t_h3 = threading.Thread(target=waiter,
+                            args=("heavy-3", _ctx_for(heavy, 3)))
+    t_h4 = threading.Thread(target=waiter,
+                            args=("heavy-4", _ctx_for(heavy, 4)))
+    t_h3.start()
+    t_h4.start()
+    time.sleep(0.2)
+    t_l = threading.Thread(target=waiter,
+                           args=("light-1", _ctx_for(light, 5)))
+    t_l.start()
+    time.sleep(0.2)
+    snap = sem.snapshot()
+    assert len(snap["waiters"]) == 3, snap
+    assert snap["queryHolds"] == {heavy.query_id: 2}, snap
+    sem.release_all(h1)
+    for t in (t_h3, t_h4, t_l):
+        t.join(30)
+    # with heavy still holding one permit (h2), light (0 holds)
+    # outranks heavy's FIFO backlog for the freed one
+    assert order[0] == "light-1", order
+    sem.release_all(h2)
+    assert sem.snapshot()["refs"] == {}
+    assert sem.snapshot()["longestWaitMs"] > 0
+
+
+def test_semaphore_yielded_keeps_queue_position():
+    """A task re-acquiring after yielded() outranks waiters that
+    arrived while it was parked (FIFO position preserved)."""
+    sem = TpuSemaphore(1)
+    qa, qb = QueryContext(C.RapidsConf()), QueryContext(C.RapidsConf())
+    a = _ctx_for(qa, 1)
+    sem.acquire_if_necessary(a)
+    in_yield = threading.Event()
+    release_yield = threading.Event()
+    order: list = []
+
+    def yielder():
+        with sem.yielded(a):
+            in_yield.set()
+            assert release_yield.wait(30)
+        order.append("yielder-back")
+        sem.release_all(a)
+
+    t_y = threading.Thread(target=yielder)
+    t_y.start()
+    assert in_yield.wait(30)
+    # while A is parked in yielded(), B arrives and takes the permit
+    b = _ctx_for(qb, 2)
+    sem.acquire_if_necessary(b)
+    # ... and a LATER B task queues up
+    def late_waiter():
+        ctx = _ctx_for(qb, 3)
+        sem.acquire_if_necessary(ctx)
+        order.append("late-waiter")
+        sem.release_all(ctx)
+
+    t_l = threading.Thread(target=late_waiter)
+    t_l.start()
+    time.sleep(0.2)
+    release_yield.set()      # A wants its permit back
+    time.sleep(0.2)
+    sem.release_all(b)       # the permit frees: A outranks late-waiter
+    t_y.join(30)
+    t_l.join(30)
+    assert order == ["yielder-back", "late-waiter"], order
+    assert sem.snapshot()["refs"] == {}
+
+
+# ---------------------------------------------------------------------------
+# result cache
+def test_result_cache_hit_bit_exact_and_conf_invalidation(tables):
+    cache = result_cache()
+    cache.clear()
+    base = cache.stats()
+    t = sources(tables, 2)
+    conf = _conf(**{
+        "spark.rapids.sql.scheduler.resultCache.enabled": True})
+
+    def run(plan):
+        return plan_collect(accelerate(plan, conf), conf)
+
+    first = run(QUERIES[1](t, run))
+    assert cache.stats()["stores"] == base["stores"] + 1
+    second = run(QUERIES[1](t, run))
+    assert cache.stats()["hits"] == base["hits"] + 1
+    assert_frame_equal(second.reset_index(drop=True),
+                       first.reset_index(drop=True))
+    # a hit is a COPY: mutating it must not poison the cache
+    second.iloc[0, second.columns.get_loc("sum_qty")] = -1
+    third = run(QUERIES[1](t, run))
+    assert_frame_equal(third.reset_index(drop=True),
+                       first.reset_index(drop=True))
+    # ANY conf change invalidates (different fingerprint -> miss)
+    conf2 = conf.set("spark.rapids.sql.pipeline.prefetchDepth", 3)
+
+    def run2(plan):
+        return plan_collect(accelerate(plan, conf2), conf2)
+
+    hits_before = cache.stats()["hits"]
+    fourth = run2(QUERIES[1](t, run2))
+    assert cache.stats()["hits"] == hits_before
+    assert_frame_equal(fourth.reset_index(drop=True),
+                       first.reset_index(drop=True))
+    # NEW source objects (a fresh sources() call) also miss: identity,
+    # not just structure, keys the entry
+    t2 = sources(tables, 2)
+    hits_before = cache.stats()["hits"]
+    fifth = run(QUERIES[1](t2, run))
+    assert cache.stats()["hits"] == hits_before
+    assert_frame_equal(fifth.reset_index(drop=True),
+                       first.reset_index(drop=True))
+    cache.clear()
+
+
+def test_result_cache_byte_bound_evicts():
+    from spark_rapids_tpu.exec.scheduler import ResultCache, _CacheKey
+    rc = ResultCache()
+    big = pd.DataFrame({"x": np.arange(1000, dtype=np.int64)})
+    keys = [_CacheKey(f"k{i}", (), ()) for i in range(4)]
+    nbytes = ResultCache._df_bytes(big)
+    for k in keys:
+        rc.put(k, big, max_bytes=nbytes * 2 + 16)
+    st = rc.stats()
+    assert st["entries"] == 2 and st["evictions"] == 2, st
+    # oldest evicted first
+    assert rc.get(keys[0]) is None
+    assert rc.get(keys[3]) is not None
+    # an over-sized result is never stored
+    rc2 = ResultCache()
+    rc2.put(keys[0], big, max_bytes=nbytes - 1)
+    assert rc2.stats()["stores"] == 0
+
+
+def test_result_cache_disabled_by_default(tables):
+    cache = result_cache()
+    cache.clear()
+    before = cache.stats()
+    _run_tpch(6, tables, _conf())
+    after = cache.stats()
+    assert after["stores"] == before["stores"]
+    assert after["hits"] == before["hits"]
+
+
+# ---------------------------------------------------------------------------
+# scheduler bookkeeping
+def test_scheduler_events_in_profile(tables):
+    conf = _conf(**{"spark.rapids.sql.profile.enabled": True})
+    _run_tpch(6, tables, conf)
+    prof = P.last_profile()
+    kinds = {e["kind"] for e in prof.events}
+    assert "query_admitted" in kinds, kinds
+    assert "queue_wait_s" in prof.breakdown
+
+
+def test_query_context_reuse_nested_collect():
+    """A nested collect (broadcast-style) inside a query reuses the
+    QueryContext: one admission, one query id."""
+    df = pd.DataFrame({"x": np.arange(8, dtype=np.int64)})
+
+    class _NestedCollectExec(UnaryExecBase):
+        def __init__(self, child, inner: TpuExec):
+            super().__init__(child)
+            self.inner = inner
+            self.seen_qids: list = []
+
+        def output_schema(self):
+            return self.child.output_schema()
+
+        def process_partition(self, batches):
+            self.seen_qids.append(S.current().query_id)
+            self.inner.collect()      # nested: must NOT re-admit
+            self.seen_qids.append(S.current().query_id)
+            yield from batches
+
+    inner = LocalBatchSource.from_pandas(df)
+    plan = _NestedCollectExec(LocalBatchSource.from_pandas(df), inner)
+    sched_before = QueryScheduler.get().stats()["admitted"]
+    with C.session(_conf()):
+        out = plan.collect().to_pandas()
+    assert out["x"].sum() == 28
+    assert len(set(plan.seen_qids)) == 1
+    assert QueryScheduler.get().stats()["admitted"] == sched_before + 1
+    _assert_no_leaks()
