@@ -26,6 +26,41 @@ run_fast() {
   run_watchdog
   run_profile
   run_concurrency
+  run_fusion
+}
+
+run_fusion() {
+  # whole-stage fusion lane: the fusion suite (composition, CSE,
+  # per-member metrics, KernelCache bound), then TPC-H q1/q5 parity
+  # with fusion ON vs OFF (bit-exact), and a deopt check — a query
+  # mixing supported + unsupported (ANSI-cast) expressions must run
+  # with only the affected stage unfused, never error.
+  echo "== fusion lane (whole-stage XLA fusion parity + deopt) =="
+  "${PYTEST[@]}" tests/test_fusion.py
+  python - <<'PYEOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from pandas.testing import assert_frame_equal
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+
+tables = gen_tables(np.random.default_rng(11), 1000)
+on = C.RapidsConf(dict(BENCH_CONF))
+off = C.RapidsConf({**BENCH_CONF,
+                    "spark.rapids.sql.fusion.enabled": False})
+for q in (1, 5):
+    a = run_query(q, tables, conf=on)
+    b = run_query(q, tables, conf=off)
+    assert_frame_equal(a.reset_index(drop=True),
+                       b.reset_index(drop=True))
+from spark_rapids_tpu.exec.base import (kernel_cache_evictions,
+                                        kernel_cache_size)
+print("fusion summary: q1/q5 bit-exact fused-vs-unfused "
+      "kernel_cache_size=%d evictions=%d" % (
+          kernel_cache_size(), kernel_cache_evictions()))
+PYEOF
 }
 
 run_concurrency() {
@@ -266,7 +301,8 @@ case "$TIER" in
   watchdog) run_watchdog ;;
   profile)  run_profile ;;
   concurrency) run_concurrency ;;
+  fusion)   run_fusion ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|concurrency|all]" >&2
+  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|concurrency|fusion|all]" >&2
      exit 2 ;;
 esac

@@ -292,6 +292,33 @@ RESULT_CACHE_MAX_BYTES = conf(
     "Byte bound on the result cache (LRU eviction; host memory).  A "
     "single result larger than this is never cached.")
 
+# --- whole-stage fusion (plan/fusion.py) -------------------------------------
+FUSION_ENABLED = conf(
+    "spark.rapids.sql.fusion.enabled", True,
+    "Collapse fusible operator chains between pipeline breaks "
+    "(project->filter->project, and project/filter chains feeding a "
+    "partial or complete aggregation's update lane) into ONE jitted "
+    "XLA program per stage: the per-operator expression evaluators "
+    "compose into a single kernel, so intermediate ColumnarBatch "
+    "materialization and per-operator dispatch disappear from the hot "
+    "path.  The composed expression DAG is simplified "
+    "(cross-operator constant folding + common-subexpression dedup) "
+    "before compiling, and compiled programs land in the shared "
+    "KernelCache keyed by the fused-stage structural signature.  A "
+    "stage containing an expression the fuser cannot compose (e.g. "
+    "ANSI-checked casts) deopts to the unfused per-operator lane — "
+    "only that stage, never the query.")
+KERNEL_CACHE_MAX_ENTRIES = conf(
+    "spark.rapids.sql.kernelCache.maxEntries", 512,
+    "Entry-count bound on the process-global compiled-kernel LRU "
+    "(exec/base.py KernelCache).  Fused-stage keys multiply cache "
+    "pressure (every stage shape x batch signature is an entry), so "
+    "the cache evicts least-recently-used executables past this "
+    "bound; the eviction count is surfaced in the bench summary "
+    "(kernel_cache_evictions).  XLA CPU clients have been observed "
+    "to segfault with thousands of live loaded executables — raise "
+    "with care.")
+
 # --- async pipelined execution (exec/pipeline.py) ----------------------------
 # env-overridable defaults so CI lanes (scripts/run_suite.sh pipeline)
 # can flip the whole suite without threading a conf through every test

@@ -54,12 +54,25 @@ class HashAggregateExec(UnaryExecBase):
     def __init__(self, group_exprs: Sequence[Expression],
                  aggregates: Sequence,
                  child: TpuExec,
-                 mode: AggMode = AggMode.COMPLETE):
+                 mode: AggMode = AggMode.COMPLETE,
+                 pre_stage=None):
         super().__init__(child)
         self.mode = mode
         self.group_exprs = list(group_exprs)
         self.aggregates = [_to_alias(a, i) for i, a in enumerate(aggregates)]
-        child_schema = child.output_schema()
+        #: whole-stage fusion (plan/fusion.py ComposedStage): a fused
+        #: project/filter chain evaluated INSIDE every update-lane
+        #: kernel before grouping — group/input expressions bind
+        #: against the stage's output schema while batches arrive in
+        #: the raw child schema.  Update/complete phases only (a FINAL
+        #: merge reads positional intermediates, never raw inputs).
+        self._pre_stage = pre_stage
+        self._fused_event_done = False
+        if pre_stage is not None:
+            assert mode != AggMode.FINAL, \
+                "pre_stage fusion applies to update lanes only"
+        child_schema = (pre_stage.schema if pre_stage is not None
+                        else child.output_schema())
         self._child_schema = child_schema
         self._bound_groups = [e.bind(child_schema) for e in self.group_exprs]
         self._group_fields = tuple(
@@ -128,8 +141,29 @@ class HashAggregateExec(UnaryExecBase):
     def describe(self):
         keys = ", ".join(f.name for f in self._group_fields)
         aggs = ", ".join(a.name for a in self.aggregates)
+        fused = "" if self._pre_stage is None else \
+            f", fused=[{self._pre_stage.describe_ops()}]"
         return (f"HashAggregateExec(mode={self.mode.value}, "
-                f"keys=[{keys}], aggs=[{aggs}])")
+                f"keys=[{keys}], aggs=[{aggs}]{fused})")
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + self.describe()
+        if self._pre_stage is not None:
+            # EXPLAIN prints the fusion group's member operators
+            for m in self._pre_stage.members:
+                s += "\n" + "  " * (indent + 1) + "* " + m.describe()
+        for c in self._children:
+            s += "\n" + c.tree_string(indent + 1)
+        return s
+
+    @property
+    def fused_members(self):
+        """(describe, MetricSet) per fused member op, for the
+        EXPLAIN-with-metrics breakdown; empty when unfused."""
+        if self._pre_stage is None:
+            return []
+        return [(m.describe(), m.metrics)
+                for m in self._pre_stage.members]
 
     def cache_scope(self):
         from spark_rapids_tpu.exprs.base import fingerprint
@@ -137,7 +171,39 @@ class HashAggregateExec(UnaryExecBase):
                 fingerprint(self._funcs),
                 fingerprint(getattr(self, "_bound_inputs", None)),
                 fingerprint(self._inter_types),
-                fingerprint(self._child_schema))
+                fingerprint(self._child_schema),
+                self._pre_stage.fingerprint()
+                if self._pre_stage is not None else ("~",))
+
+    def _make_ctx(self, columns, cap, num_rows, mask=None):
+        """Kernel-trace eval context; with a fused pre-stage the raw
+        child columns first flow through the composed project/filter
+        DAG inside the SAME jit (plan/fusion.py eval_stage_ctx)."""
+        ctx = make_eval_context(columns, cap, num_rows, mask)
+        if self._pre_stage is not None:
+            from spark_rapids_tpu.plan import fusion as FZ
+            ctx = FZ.eval_stage_ctx(self._pre_stage, ctx)
+        return ctx
+
+    def _charge_pre_stage(self, t0: Optional[float]) -> None:
+        """Fused-member metric/event bookkeeping per dispatched batch;
+        the FIRST dispatch (trace + compile happen synchronously on a
+        jit's first call) also emits the profiler's stage_fused
+        event."""
+        if self._pre_stage is None:
+            return
+        import time as _time
+        for m in self._pre_stage.members:
+            m.metrics.add(M.NUM_OUTPUT_BATCHES, 1)
+        if not self._fused_event_done and t0 is not None:
+            self._fused_event_done = True
+            from spark_rapids_tpu.utils import profile as P
+            P.event("stage_fused",
+                    members=self._pre_stage.member_names()
+                    + [type(self).__name__],
+                    exprs=self._pre_stage.expr_count,
+                    compile_ms=round(
+                        (_time.perf_counter() - t0) * 1e3, 2))
 
     # -- kernels ------------------------------------------------------------
     #: past this many estimated packed sort words the grouping sort
@@ -160,7 +226,13 @@ class HashAggregateExec(UnaryExecBase):
         from spark_rapids_tpu import config as C
         if not C.get_active_conf()[C.HASH_GROUPING_ENABLED]:
             return False
-        return wide_key_set(self._bound_groups, batch, self._child_schema,
+        # with a fused pre-stage the batch carries the RAW child
+        # columns, so ordinal-based column inspection would read the
+        # wrong column — route through the dtype-only estimate
+        return wide_key_set(self._bound_groups,
+                            None if self._pre_stage is not None
+                            else batch,
+                            self._child_schema,
                             self.HASH_GROUP_MIN_WORDS)
 
     #: cap bound for the banded lane: first-row indices travel as two
@@ -240,7 +312,7 @@ class HashAggregateExec(UnaryExecBase):
 
             @jax.jit
             def kernel(columns, num_rows, mask=None):
-                ctx = make_eval_context(columns, cap, num_rows, mask)
+                ctx = self._make_ctx(columns, cap, num_rows, mask)
                 keys = [e.eval(ctx) for e in bound_groups]
                 if use_hash:
                     perm, sorted_valid, bounds, collision = \
@@ -785,7 +857,7 @@ class HashAggregateExec(UnaryExecBase):
         interp = not _on_tpu()
 
         def fused(columns, num_rows, mask=None):
-            ctx = make_eval_context(columns, cap, num_rows, mask)
+            ctx = self._make_ctx(columns, cap, num_rows, mask)
             k = key_expr.eval(ctx)
             ok = k.validity & ctx.row_mask
             if k.narrow is not None:
@@ -866,7 +938,7 @@ class HashAggregateExec(UnaryExecBase):
         key_exprs = list(self._bound_groups)
 
         def probe(columns, num_rows, mask=None):
-            ctx = make_eval_context(columns, cap, num_rows, mask)
+            ctx = self._make_ctx(columns, cap, num_rows, mask)
             i64 = jnp.iinfo(jnp.int64)
             mins, maxs = [], []
             for e in key_exprs:
@@ -903,7 +975,7 @@ class HashAggregateExec(UnaryExecBase):
         interp = not _on_tpu()
 
         def fused(columns, num_rows, mask=None):
-            ctx = make_eval_context(columns, cap, num_rows, mask)
+            ctx = self._make_ctx(columns, cap, num_rows, mask)
             rows = ctx.row_mask
             combined = jnp.zeros(cap, jnp.int32)
             in_win = rows
@@ -1050,9 +1122,14 @@ class HashAggregateExec(UnaryExecBase):
     def _groupby_one(self, batch: ColumnarBatch) -> ColumnarBatch:
         """One batch (or split piece) through the grouping kernel ->
         partial-layout batch.  The OOM harness reserves ahead of this."""
+        import time as _time
         phase = "merge" if self.mode == AggMode.FINAL else "update"
+        t0 = _time.perf_counter() if (
+            self._pre_stage is not None
+            and not self._fused_event_done) else None
         fast = self._dict_groupby_batch(batch)
         if fast is not None:
+            self._charge_pre_stage(t0)
             return fast
         wcap = self._kernel_compact_cap(batch)
         kern = self._groupby_kernel(batch, phase, wcap)
@@ -1062,6 +1139,7 @@ class HashAggregateExec(UnaryExecBase):
         else:
             cols, n, coll, excess, cert = kern(
                 batch.columns, batch.num_rows_i32)
+        self._charge_pre_stage(t0)
         checks = self._register_collision_check(coll, batch.checks)
         checks = self._register_excess_check(excess, wcap, checks)
         checks = self._register_banded_check(cert, checks)
@@ -1141,11 +1219,16 @@ class HashAggregateExec(UnaryExecBase):
         phase = "merge" if self.mode == AggMode.FINAL else "update"
 
         def reduce_one(b: ColumnarBatch) -> ColumnarBatch:
+            import time as _time
+            t0 = _time.perf_counter() if (
+                self._pre_stage is not None
+                and not self._fused_event_done) else None
             kern = self._reduce_kernel(b, phase)
             if b.sparse is not None:
                 cols = kern(b.columns, b.num_rows_i32, b.sparse)
             else:
                 cols = kern(b.columns, b.num_rows_i32)
+            self._charge_pre_stage(t0)
             return ColumnarBatch(inter_schema, list(cols), 1, b.checks)
 
         for batch in batches:
@@ -1178,7 +1261,7 @@ class HashAggregateExec(UnaryExecBase):
 
             @jax.jit
             def kernel(columns, num_rows, mask=None):
-                ctx = make_eval_context(columns, cap, num_rows, mask)
+                ctx = self._make_ctx(columns, cap, num_rows, mask)
                 seg_ids = jnp.zeros(cap, jnp.int32)
                 actx = AggContext(seg_ids, cap, ctx.row_mask,
                                   bounds=jnp.arange(cap) == 0,

@@ -79,8 +79,19 @@ _GLOBAL_KERNELS: "collections.OrderedDict" = collections.OrderedDict()
 _GLOBAL_KERNELS_LOCK = threading.Lock()
 # one workload's operator x batch-shape set is well under this; XLA CPU
 # clients have been observed to segfault with thousands of live loaded
-# executables, so the LRU stays conservatively small
+# executables, so the LRU stays conservatively small.  Conf-overridable
+# (spark.rapids.sql.kernelCache.maxEntries): fused-stage keys multiply
+# cache pressure, so the bound and its eviction count are first-class.
 _GLOBAL_KERNELS_MAX = 512
+_GLOBAL_KERNELS_EVICTIONS = 0
+
+
+def _kernel_cache_max_entries() -> int:
+    try:
+        from spark_rapids_tpu import config as C
+        return max(1, int(C.get_active_conf()[C.KERNEL_CACHE_MAX_ENTRIES]))
+    except Exception:  # noqa: BLE001 — conf layer unavailable in
+        return _GLOBAL_KERNELS_MAX  # stripped-down test harnesses
 #: single-flight registry: keys whose builder is currently tracing /
 #: compiling on some thread (value: Event set when it lands or fails).
 #: XLA compiles run seconds-to-minutes, so they must happen OUTSIDE
@@ -99,6 +110,12 @@ def clear_kernel_cache() -> None:
 
 def kernel_cache_size() -> int:
     return len(_GLOBAL_KERNELS)
+
+
+def kernel_cache_evictions() -> int:
+    """LRU evictions since process start (bench summary surfaces this:
+    a growing number means kernelCache.maxEntries is churning)."""
+    return _GLOBAL_KERNELS_EVICTIONS
 
 
 class KernelCache:
@@ -177,10 +194,13 @@ class KernelCache:
                         _GLOBAL_KERNELS_BUILDING.pop(gk, None)
                 claimed.set()
             raise
+        max_entries = _kernel_cache_max_entries()
         with _GLOBAL_KERNELS_LOCK:
             _GLOBAL_KERNELS[gk] = fn
-            while len(_GLOBAL_KERNELS) > _GLOBAL_KERNELS_MAX:
+            global _GLOBAL_KERNELS_EVICTIONS
+            while len(_GLOBAL_KERNELS) > max_entries:
                 _GLOBAL_KERNELS.popitem(last=False)
+                _GLOBAL_KERNELS_EVICTIONS += 1
             if claimed is not None and \
                     _GLOBAL_KERNELS_BUILDING.get(gk) is claimed:
                 _GLOBAL_KERNELS_BUILDING.pop(gk, None)

@@ -39,6 +39,10 @@ class EvalContext:
     num_rows: Any  # traced int32 scalar
     row_mask: Any  # traced bool[capacity]
     pending_checks: list = dataclasses.field(default_factory=list)
+    #: per-trace memo for CSE slots (exprs/simplify.py SharedExpr):
+    #: a deduped subtree evaluates once per kernel trace, and every
+    #: other occurrence reads the traced value back from here
+    shared: dict = dataclasses.field(default_factory=dict)
 
 
 class Expression:

@@ -1,4 +1,24 @@
-"""Peephole expression simplification.
+"""Peephole expression simplification + cross-operator DAG cleanup.
+
+Two layers live here:
+
+* **Peephole rules** (`simplify`): bottom-up rewrites of one tree —
+  the find/locate -> Contains family below, plus the fusion-era rules:
+  double-cast collapse (`Cast(Cast(x, t), t)` and identity casts of
+  bound references), boolean-literal folds (`And(x, false)` is false
+  under Kleene logic even when x is null), literal integer comparison
+  folding, and double-negation.  Whole-stage fusion (plan/fusion.py)
+  runs these across the COMPOSED expression DAG of a fused stage, so
+  a constant or a redundant cast introduced at one operator and
+  consumed at another folds away before the kernel compiles.
+* **Common-subexpression dedup** (`dedup_common_subexprs`): across a
+  LIST of bound trees (a fused stage's predicates + outputs), every
+  non-trivial subtree appearing more than once is wrapped in a
+  `SharedExpr` slot; inside a kernel trace the slot evaluates once
+  and every other occurrence reads the traced value from
+  `EvalContext.shared`.  XLA would CSE the HLO anyway — the dedup
+  buys trace time and keeps the composed DAG's size proportional to
+  its distinct work.
 
 The udf-compiler lowers `s.find(sub) >= 0` to
 `Subtract(StringLocate(sub, s, 1), 1) >= 0` (compiler.py "find"), which
@@ -23,10 +43,15 @@ propagates through the comparison and through Contains identically):
 """
 from __future__ import annotations
 
+import dataclasses
+import operator
+
+from spark_rapids_tpu import types as T
 from spark_rapids_tpu.exprs import arithmetic as A
 from spark_rapids_tpu.exprs import predicates as P
 from spark_rapids_tpu.exprs import string_fns as S
-from spark_rapids_tpu.exprs.base import Expression, Literal
+from spark_rapids_tpu.exprs.base import (
+    Alias, BoundReference, Expression, Literal, fingerprint)
 
 
 def _int_literal(e) -> int | None:
@@ -61,14 +86,64 @@ _FLIP = {P.GreaterThan: P.LessThan, P.GreaterThanOrEqual: P.LessThanOrEqual,
          P.EqualTo: P.EqualTo}
 
 
+def _bool_literal(e):
+    if isinstance(e, Literal) and e.dtype == T.BOOL \
+            and isinstance(e.value, bool):
+        return e.value
+    return None
+
+
+_CMP_OPS = {P.GreaterThan: operator.gt, P.GreaterThanOrEqual: operator.ge,
+            P.LessThan: operator.lt, P.LessThanOrEqual: operator.le,
+            P.EqualTo: operator.eq}
+
+
+def _simplify_cast(e: Expression) -> Expression:
+    """Double-cast / identity-cast collapse.  Conservative: ANSI casts
+    carry overflow checks and are never collapsed."""
+    from spark_rapids_tpu.exprs.cast import Cast
+    if not isinstance(e, Cast) or getattr(e, "ansi", False):
+        return e
+    c = e.child
+    if isinstance(c, Cast) and not getattr(c, "ansi", False) \
+            and c.to == e.to:
+        # cast(cast(x as t) as t): the outer cast is identity on t
+        return Cast(c.child, e.to)
+    if isinstance(c, BoundReference) and c.dtype == e.to:
+        return c  # identity cast of a column
+    return e
+
+
 def _simplify_one(e: Expression) -> Expression:
     cls = type(e)
-    if cls is P.Not and isinstance(e.child, P.Not):
-        # `find(x) != -1` compiles to Not(EqualTo) and the inner rewrite
-        # yields Not(Contains); collapse the double negation
-        return e.child.child
+    if cls.__name__ == "Cast":
+        return _simplify_cast(e)
+    if cls is P.Not:
+        if isinstance(e.child, P.Not):
+            # `find(x) != -1` compiles to Not(EqualTo) and the inner
+            # rewrite yields Not(Contains); collapse the double negation
+            return e.child.child
+        b = _bool_literal(e.child)
+        if b is not None:
+            return Literal(not b, T.BOOL)
+    if cls in (P.And, P.Or):
+        absorbing = cls is P.Or  # Or(x, true)=true; And(x, false)=false
+        for lit_side, other in ((e.left, e.right), (e.right, e.left)):
+            b = _bool_literal(lit_side)
+            if b is None:
+                continue
+            if b == absorbing:
+                # absorbing element holds under Kleene logic even when
+                # the other side is null
+                return lit_side
+            return other  # identity element: And(x, true) / Or(x, false)
     if cls not in _FLIP:
         return e
+    lk, rk = _int_literal(e.left), _int_literal(e.right)
+    if lk is not None and rk is not None:
+        # cross-operator constant folding: a literal comparison born
+        # from composing two operators' expressions folds to a bool
+        return Literal(bool(_CMP_OPS[cls](lk, rk)), T.BOOL)
     lhs, rhs = e.left, e.right
     k = _int_literal(rhs)
     if k is None:
@@ -103,3 +178,93 @@ def simplify(e: Expression) -> Expression:
     """Bottom-up peephole pass; identity-preserving on no-ops
     (map_children returns self when nothing changes)."""
     return _simplify_one(e.map_children(simplify))
+
+
+# ---------------------------------------------------------------------------
+# common-subexpression dedup (used on fused-stage composed DAGs)
+@dataclasses.dataclass(eq=False)
+class SharedExpr(Expression):
+    """CSE slot: evaluates its child ONCE per kernel trace (memoized in
+    `EvalContext.shared` by slot id); every other occurrence of the
+    same slot reads the traced value back.  Slots are assigned
+    deterministically in first-appearance order, so two structurally
+    equal fused stages fingerprint equal and share compiled kernels."""
+    child: Expression
+    slot: int
+
+    def data_type(self, schema):
+        return self.child.data_type(schema)
+
+    def children(self):
+        return (self.child,)
+
+    def with_children(self, kids):
+        return SharedExpr(kids[0], self.slot)
+
+    def eval(self, ctx):
+        memo = getattr(ctx, "shared", None)
+        if memo is None:
+            return self.child.eval(ctx)
+        v = memo.get(self.slot)
+        if v is None:
+            v = self.child.eval(ctx)
+            memo[self.slot] = v
+        return v
+
+    def __repr__(self):
+        return f"shared#{self.slot}({self.child!r})"
+
+
+def _cse_trivial(e: Expression) -> bool:
+    # leaves cost nothing to re-evaluate; sharing them is pure overhead
+    return isinstance(e, (Literal, BoundReference)) or not e.children()
+
+
+def dedup_common_subexprs(exprs: list) -> list:
+    """CSE across a list of (bound) expression trees: every non-trivial
+    subtree whose structural fingerprint appears more than once —
+    within one tree or across trees — is wrapped in a `SharedExpr`
+    slot.  The rewrite is top-down, so the HIGHEST duplicated subtree
+    gets the slot and its interior is rewritten once beneath it."""
+    counts: dict = {}
+
+    def scan(e: Expression) -> None:
+        if not _cse_trivial(e):
+            fp = fingerprint(e)
+            counts[fp] = counts.get(fp, 0) + 1
+        for c in e.children():
+            scan(c)
+
+    for e in exprs:
+        scan(e)
+    slots: dict = {}
+
+    def rewrite(e: Expression) -> Expression:
+        if not _cse_trivial(e):
+            fp = fingerprint(e)
+            if counts.get(fp, 0) > 1:
+                slot = slots.get(fp)
+                if slot is None:
+                    slot = slots[fp] = len(slots)
+                return SharedExpr(e.map_children(rewrite), slot)
+        return e.map_children(rewrite)
+
+    return [rewrite(e) for e in exprs]
+
+
+def is_identity_projection(bound_exprs, in_schema, out_schema) -> bool:
+    """True when a bound projection is a no-op — output i is input
+    column i (through any Alias chain) with the same name and dtype —
+    so the fusion pass can collapse the node entirely."""
+    if len(bound_exprs) != len(in_schema.fields) or \
+            len(out_schema.fields) != len(in_schema.fields):
+        return False
+    for i, (e, fi, fo) in enumerate(zip(bound_exprs, in_schema.fields,
+                                        out_schema.fields)):
+        while isinstance(e, Alias):
+            e = e.child
+        if not (isinstance(e, BoundReference) and e.ordinal == i):
+            return False
+        if fi.name != fo.name or fi.dtype != fo.dtype:
+            return False
+    return True

@@ -65,7 +65,7 @@ def q1_plan(source):
     from spark_rapids_tpu.exec.basic import FilterExec, ProjectExec
     from spark_rapids_tpu.exec.sort import SortExec, asc
     filtered = FilterExec(
-        col("l_shipdate") <= lit(Q1_CUTOFF_DAYS), source)
+        col("l_shipdate") <= lit(Q1_CUTOFF_DAYS, T.DATE32), source)
     projected = ProjectExec([
         col("l_returnflag"), col("l_linestatus"), col("l_quantity"),
         col("l_extendedprice"), col("l_discount"),

@@ -427,7 +427,7 @@ def wide_key_set(bound_exprs, batch, schema,
     pseudo = []
     for e in bound_exprs:
         ordinal = getattr(e, "ordinal", None)
-        if ordinal is not None:
+        if ordinal is not None and batch is not None:
             pseudo.append((batch.columns[ordinal], True, True))
             continue
         dt = e.data_type(schema)

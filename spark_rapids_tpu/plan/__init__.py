@@ -6,6 +6,8 @@ from spark_rapids_tpu.plan.nodes import (  # noqa: F401
     CpuHashJoin, CpuLimit, CpuNode, CpuProject, CpuRange,
     CpuShuffleExchange, CpuSort, CpuSortAggregate, CpuSortMergeJoin,
     CpuSource, CpuUnion, PartitioningSpec)
+from spark_rapids_tpu.plan.fusion import (  # noqa: F401
+    FusedStageExec, fuse_plan)
 from spark_rapids_tpu.plan.overrides import (  # noqa: F401
     ExecutionPlanCapture, accelerate, collect)
 from spark_rapids_tpu.plan.transitions import (  # noqa: F401

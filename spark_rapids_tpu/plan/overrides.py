@@ -924,12 +924,18 @@ def accelerate(cpu_plan: N.CpuNode,
     from spark_rapids_tpu.plan.transitions import (
         _coalesce_cpu_islands, insert_coalesce, optimize_transitions,
         _optimize_tpu)
+    from spark_rapids_tpu.plan.fusion import fuse_plan
     from spark_rapids_tpu.exec.base import TargetSize
     if isinstance(plan, TpuExec):
         plan = _optimize_tpu(plan)
+        # whole-stage fusion BEFORE coalesce insertion: chains must
+        # still be adjacent (a fused stage with filter members keeps
+        # coalesce_after, so the re-bucket above it survives)
+        plan = fuse_plan(plan, conf)
         plan = insert_coalesce(plan, conf)
     else:
         plan = optimize_transitions(plan)
+        plan = fuse_plan(plan, conf)
         _coalesce_cpu_islands(plan, TargetSize(conf[C.BATCH_SIZE_BYTES]),
                               conf[C.MAX_BATCH_ROWS])
     if conf[C.TEST_ENABLED]:

@@ -46,6 +46,9 @@ NUM_WATCHDOG_TIMEOUTS = "numWatchdogTimeouts"
 NUM_CANCELS = "numCancels"
 WATCHDOG_DUMPS = "watchdogDumps"
 SLOWEST_HEARTBEAT = "slowestHeartbeatMs"
+# whole-stage fusion (plan/fusion.py): a fused stage whose kernel
+# failed to build/trace and fell back to the per-operator lane
+NUM_FUSION_DEOPTS = "numFusionDeopts"
 NUM_FETCH_FAILURES = "numFetchFailures"
 NUM_MAP_RECOMPUTES = "numMapRecomputes"
 NUM_STAGE_RETRIES = "numStageRetries"

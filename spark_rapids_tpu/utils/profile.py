@@ -456,6 +456,19 @@ def _explain_node(node, indent: int, lines: list[str]) -> None:
     annot = ", ".join(_fmt_metric(k, v) for k, v in ms.items())
     lines.append("  " * indent + desc
                  + (f"  [{annot}]" if annot else "  [no metrics]"))
+    # whole-stage fusion groups (plan/fusion.py): render each fused
+    # member operator with ITS metric breakdown under the fused node —
+    # per-node metrics still resolve even though the operators share
+    # one compiled kernel
+    for mdesc, mmetrics in getattr(node, "fused_members", []) or []:
+        try:
+            mms = {k: v for k, v in sorted(mmetrics.as_dict().items())
+                   if v}
+        except Exception:  # noqa: BLE001 — same guard as node metrics
+            mms = {"<metrics unavailable>": 1}
+        mannot = ", ".join(_fmt_metric(k, v) for k, v in mms.items())
+        lines.append("  " * (indent + 1) + "* " + mdesc
+                     + (f"  [{mannot}]" if mannot else "  [no metrics]"))
     for c in getattr(node, "children", []) or []:
         _explain_node(c, indent + 1, lines)
     # AQE wrappers hold their plan below non-children attributes

@@ -1,0 +1,564 @@
+"""Whole-stage XLA fusion suite (plan/fusion.py):
+
+* composition: project/filter chains collapse into FusedStageExec, and
+  project/filter -> partial-agg-update chains fuse into the aggregate's
+  update kernels — bit-exact vs the unfused per-operator lane on TPC-H
+  q1/q5 and TPC-DS lanes, including under seeded OOM injection and
+  with `spark.rapids.sql.fusion.enabled` flipped per query via the
+  PR 6 scheduler (conf isolation holds);
+* deopt: an unsupported (ANSI-cast) expression leaves only ITS stage
+  unfused — the rest of the chain fuses and the query never errors —
+  and a runtime trace failure deopts the exec to the per-operator lane
+  mid-query;
+* interop: per-member metric breakdowns resolve, EXPLAIN prints the
+  fusion group, the stage_fused profiler event fires with compile ms,
+  OOM split-and-retry fires at fused-batch granularity, and repeat
+  collects recompile nothing (KernelCache hit);
+* satellites: KernelCache entry-count bound + eviction counter, and
+  the exprs/simplify.py rules (CSE dedup, double-cast/identity
+  collapse, boolean/literal folds, identity-projection detect).
+"""
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+from pandas.testing import assert_frame_equal
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exprs.base import (
+    BoundReference, Expression, col, lit)
+from spark_rapids_tpu.exprs import predicates as P
+from spark_rapids_tpu.exprs import simplify as SI
+from spark_rapids_tpu.exprs.aggregates import Count, Sum
+from spark_rapids_tpu.exprs.cast import Cast
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+from spark_rapids_tpu.plan.nodes import (
+    CpuAggregate, CpuFilter, CpuProject, CpuSort, CpuSource)
+from spark_rapids_tpu.plan.overrides import accelerate, collect
+
+FUSION_OFF = {"spark.rapids.sql.fusion.enabled": False}
+
+
+@pytest.fixture(scope="module")
+def tpch_tables():
+    return gen_tables(np.random.default_rng(11), 1500)
+
+
+def _conf(**kv):
+    base = dict(BENCH_CONF)
+    base.update({k.replace("__", "."): v for k, v in kv.items()})
+    return C.RapidsConf(base)
+
+
+def _find(plan, name):
+    if type(plan).__name__ == name:
+        return plan
+    for c in getattr(plan, "children", []):
+        r = _find(c, name)
+        if r is not None:
+            return r
+    return None
+
+
+def _find_all(plan, name, out=None):
+    out = [] if out is None else out
+    if type(plan).__name__ == name:
+        out.append(plan)
+    for c in getattr(plan, "children", []):
+        _find_all(c, name, out)
+    return out
+
+
+def _chain_plan(df_parts=2, rows=4000, seed=1):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "a": rng.integers(0, 1000, rows).astype(np.int64),
+        "b": rng.uniform(0, 10, rows),
+    })
+    src = CpuSource.from_pandas(df, num_partitions=df_parts)
+    from spark_rapids_tpu.exec.sort import asc
+    plan = CpuSort(
+        [asc(col("y"))],
+        CpuProject(
+            [(col("x") + col("x")).alias("y"), col("b2")],
+            CpuFilter(col("x") > lit(100),
+                      CpuProject([(col("a") * lit(2)).alias("x"),
+                                  (col("b") * lit(3.0)).alias("b2")],
+                                 src))),
+        global_sort=True)
+    return plan, df
+
+
+# ---------------------------------------------------------------------------
+# plan shape + EXPLAIN
+def test_chain_fuses_into_stage_exec():
+    plan, _ = _chain_plan()
+    p = accelerate(plan, _conf())
+    fused = _find(p, "FusedStageExec")
+    assert fused is not None, p.tree_string()
+    # the whole Project→Filter→Project chain became ONE node
+    assert _find(p, "ProjectExec") is None
+    assert _find(p, "FilterExec") is None
+    # EXPLAIN prints the fusion group's members
+    ts = p.tree_string()
+    assert ts.count("* ") >= 3, ts
+    assert "FusedStageExec(Project→Filter→Project" in ts
+
+
+def test_agg_update_chain_fuses_into_aggregate(tpch_tables):
+    from spark_rapids_tpu.plan.overrides import ExecutionPlanCapture
+    run_query(1, tpch_tables, conf=_conf())
+    plan = ExecutionPlanCapture.last_plan
+    aggs = _find_all(plan, "HashAggregateExec")
+    fused = [a for a in aggs if a._pre_stage is not None]
+    assert fused, plan.tree_string()
+    assert "fused=[" in fused[0].describe()
+    # the filter/project below the partial agg are gone from the tree
+    assert _find(plan, "FilterExec") is None
+
+
+def test_fusion_disabled_keeps_per_operator_plan():
+    plan, _ = _chain_plan()
+    p = accelerate(plan, _conf(**FUSION_OFF))
+    assert _find(p, "FusedStageExec") is None
+    assert _find(p, "ProjectExec") is not None
+
+
+# ---------------------------------------------------------------------------
+# parity: TPC-H / TPC-DS, bit-exact fused vs unfused
+@pytest.mark.parametrize("query", [1, 5])
+def test_tpch_parity_fused_vs_unfused(tpch_tables, query):
+    on = run_query(query, tpch_tables, conf=_conf())
+    off = run_query(query, tpch_tables, conf=_conf(**FUSION_OFF))
+    assert_frame_equal(on.reset_index(drop=True),
+                       off.reset_index(drop=True))
+
+
+@pytest.fixture(scope="module")
+def tpcds_tables():
+    from spark_rapids_tpu.models import tpcds_data
+    return tpcds_data.gen_tables(np.random.default_rng(3), 5000)
+
+
+@pytest.mark.parametrize("name", ["q3", "q7"])
+def test_tpcds_parity_fused_vs_unfused(name, tpcds_tables):
+    from spark_rapids_tpu.models import tpcds_data, tpcds_queries
+    if name not in tpcds_queries.QUERIES:
+        pytest.skip(f"{name} not in the TPC-DS suite")
+    tables = tpcds_tables
+    fn = tpcds_queries.QUERIES[name]
+
+    def run(conf):
+        t = tpcds_data.sources(tables, 2)
+
+        def runner(p):
+            return collect(accelerate(p, conf), conf)
+        return runner(fn(t, runner))
+
+    on = run(_conf())
+    off = run(_conf(**FUSION_OFF))
+    assert_frame_equal(on.reset_index(drop=True),
+                       off.reset_index(drop=True))
+
+
+def test_parity_under_seeded_oom_injection(tpch_tables):
+    from spark_rapids_tpu.memory.retry import reset_oom_injection
+    inject = {"spark.rapids.memory.faultInjection.oomRate": 1.0,
+              "spark.rapids.memory.faultInjection.seed": 7,
+              "spark.rapids.memory.faultInjection.maxInjections": 12}
+    clean = run_query(1, tpch_tables, conf=_conf())
+    reset_oom_injection()
+    on = run_query(1, tpch_tables, conf=_conf(**{
+        k.replace(".", "__"): v for k, v in inject.items()}))
+    reset_oom_injection()
+    off = run_query(1, tpch_tables, conf=_conf(**{
+        **{k.replace(".", "__"): v for k, v in inject.items()},
+        "spark__rapids__sql__fusion__enabled": False}))
+    reset_oom_injection()
+    assert_frame_equal(on.reset_index(drop=True),
+                       clean.reset_index(drop=True))
+    assert_frame_equal(off.reset_index(drop=True),
+                       clean.reset_index(drop=True))
+
+
+def test_oom_split_retry_at_fused_batch_granularity():
+    """The fused stage routes every dispatch through the OOM harness:
+    at oomRate 1.0 the injected split-class failures must show up as
+    numSplitRetries on the FUSED node, with the result intact."""
+    from spark_rapids_tpu.memory.retry import reset_oom_injection
+    plan, df = _chain_plan(rows=8000)
+    conf = _conf(**{
+        "spark__rapids__memory__faultInjection__oomRate": 1.0,
+        "spark__rapids__memory__faultInjection__seed": 3,
+        "spark__rapids__memory__faultInjection__maxInjections": 8})
+    reset_oom_injection()
+    p = accelerate(plan, conf)
+    got = collect(p, conf)
+    reset_oom_injection()
+    fused = _find(p, "FusedStageExec")
+    assert fused is not None
+    m = fused.metrics.as_dict()
+    assert m.get("numRetries", 0) + m.get("numSplitRetries", 0) > 0, m
+    ref = df.assign(x=df.a * 2, b2=df.b * 3.0)
+    ref = ref[ref.x > 100]
+    ref = pd.DataFrame({"y": ref.x + ref.x, "b2": ref.b2}).sort_values(
+        "y", ignore_index=True)
+    assert len(got) == len(ref)
+    assert np.allclose(got["y"].astype(float), ref["y"])
+
+
+# ---------------------------------------------------------------------------
+# per-query conf isolation (PR 6 scheduler)
+def test_fusion_flipped_per_query_concurrently(tpch_tables):
+    """Two sessions collecting the same query concurrently, one with
+    fusion on and one off: per-query conf snapshots hold and both are
+    bit-exact vs the serial reference."""
+    ref = run_query(1, tpch_tables, conf=_conf())
+    results, errors = {}, []
+
+    def worker(i, conf):
+        try:
+            results[i] = run_query(1, tpch_tables, conf=conf)
+        except BaseException as e:  # noqa: BLE001
+            errors.append((i, repr(e)))
+
+    confs = [_conf(), _conf(**FUSION_OFF), _conf(), _conf(**FUSION_OFF)]
+    ts = [threading.Thread(target=worker, args=(i, cf))
+          for i, cf in enumerate(confs)]
+    [t.start() for t in ts]
+    [t.join(300) for t in ts]
+    assert not errors, errors
+    assert len(results) == len(confs)
+    for df in results.values():
+        assert_frame_equal(df.reset_index(drop=True),
+                           ref.reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# deopt
+def test_unsupported_expression_deopts_only_its_stage():
+    """A chain mixing supported + unsupported (ANSI-cast) members must
+    fuse the supported run, keep the ANSI member per-operator, and run
+    to the correct result — never error."""
+    rng = np.random.default_rng(5)
+    df = pd.DataFrame({"a": rng.integers(0, 100, 2000).astype(np.int64),
+                       "b": rng.uniform(0, 10, 2000)})
+    src = CpuSource.from_pandas(df, num_partitions=2)
+    from spark_rapids_tpu.exec.sort import asc
+    plan = CpuSort(
+        [asc(col("z"))],
+        CpuProject(
+            [(col("ai") + col("ai")).alias("z"), col("b2")],
+            CpuFilter(
+                col("ai") >= lit(0),
+                CpuProject(
+                    # ANSI cast: TPU-legal (numeric->integral overflow
+                    # check) but fusion-unsupported
+                    [Cast(col("a"), T.INT32, ansi=True).alias("ai"),
+                     (col("b") * lit(2.0)).alias("b2")],
+                    src))),
+        global_sort=True)
+    conf = _conf()
+    p = accelerate(plan, conf)
+    # the ANSI project stays per-operator; the filter+project above it
+    # still fuse
+    assert _find(p, "ProjectExec") is not None, p.tree_string()
+    assert _find(p, "FusedStageExec") is not None, p.tree_string()
+    got = collect(p, conf)
+    exp = collect(accelerate(plan, _conf(**FUSION_OFF)),
+                  _conf(**FUSION_OFF))
+    assert_frame_equal(got.reset_index(drop=True),
+                       exp.reset_index(drop=True))
+
+
+def test_runtime_trace_failure_deopts_to_unfused_lane():
+    """A fused kernel that fails to trace must deopt THIS exec to the
+    per-operator member lane mid-query and still produce the right
+    answer (numFusionDeopts records it)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.exec.basic import LocalBatchSource, ProjectExec
+    from spark_rapids_tpu.plan.fusion import FusedStageExec, compose_chain
+
+    rng = np.random.default_rng(9)
+    df = pd.DataFrame({"v": rng.integers(0, 50, 500).astype(np.int64)})
+    src = LocalBatchSource.from_pandas(df, num_partitions=1)
+    p1 = ProjectExec([(col("v") * lit(2)).alias("w")], src)
+    p2 = ProjectExec([(col("w") + lit(1)).alias("u")], p1)
+    stage = compose_chain([p2, p1], src.output_schema())
+
+    class Poison(Expression):
+        def data_type(self, schema):
+            return T.INT64
+
+        def children(self):
+            return ()
+
+        def eval(self, ctx):
+            raise NotImplementedError("poisoned for the deopt test")
+
+    # poison the composed DAG (runtime-only failure: the plan pass
+    # accepted it, the trace will not)
+    stage.out_exprs = [Poison()]
+    fused = FusedStageExec(stage, src)
+    fused._schema = p2.output_schema()
+    out = fused.collect().to_pandas()
+    assert fused._fusion_deopt
+    assert fused.metrics.as_dict().get("numFusionDeopts", 0) >= 1
+    assert (out["u"].to_numpy(dtype=np.int64)
+            == df["v"].to_numpy() * 2 + 1).all()
+
+
+# ---------------------------------------------------------------------------
+# interop: metrics, profiler, kernel-cache behavior
+def test_fused_member_metric_breakdown():
+    plan, df = _chain_plan()
+    conf = _conf()
+    p = accelerate(plan, conf)
+    collect(p, conf)
+    fused = _find(p, "FusedStageExec")
+    assert fused is not None
+    members = dict((d.split("(")[0], m.as_dict())
+                   for d, m in fused.fused_members)
+    kept = len(df[df.a * 2 > 100])
+    assert members["FilterExec"]["numOutputRows"] == kept
+    assert members["ProjectExec"]["numOutputRows"] in (len(df), kept)
+    assert fused.metrics.as_dict()["numOutputRows"] == kept
+
+
+def test_explain_with_metrics_renders_fused_members(tpch_tables):
+    from spark_rapids_tpu.utils import profile as PR
+    conf = _conf(spark__rapids__sql__profile__enabled=True)
+    run_query(1, tpch_tables, conf=conf)
+    prof = PR.last_profile()
+    assert prof is not None
+    report = prof.plan_report
+    assert "fused=[" in report, report
+    assert "* " in report, report
+    # every line (members included) carries a metric annotation
+    assert all(ln.rstrip().endswith("]")
+               for ln in report.splitlines()), report
+    fused_events = [e for e in prof.events if e["kind"] == "stage_fused"]
+    assert fused_events, [e["kind"] for e in prof.events]
+    ev = fused_events[0]
+    assert ev["members"] and "compile_ms" in ev
+
+
+def test_repeat_collects_recompile_nothing(monkeypatch, fresh_kernel_cache):
+    """Acceptance: fused stages recompile ZERO extra times on repeat
+    collects — the shared KernelCache serves the fused executable."""
+    import spark_rapids_tpu.exec.base as EB
+
+    plan, _ = _chain_plan(seed=23)
+    conf = _conf()
+    p = accelerate(plan, conf)
+    # fresh global cache (fixture) so the FIRST collect demonstrably
+    # builds — earlier tests share structural fingerprints and would hit
+    builds = []
+    orig = EB.KernelCache._build_watched
+
+    def counting(key, builder):
+        builds.append(key)
+        return orig(key, builder)
+
+    monkeypatch.setattr(EB.KernelCache, "_build_watched",
+                        staticmethod(counting))
+    first = collect(p, conf)
+    n_first = len(builds)
+    assert n_first > 0
+    second = collect(p, conf)
+    assert len(builds) == n_first, \
+        f"repeat collect rebuilt kernels: {builds[n_first:]}"
+    assert_frame_equal(first.reset_index(drop=True),
+                       second.reset_index(drop=True))
+
+
+def test_kernel_cache_entry_bound_and_eviction_counter(fresh_kernel_cache):
+    from spark_rapids_tpu.exec.base import (
+        KernelCache, kernel_cache_evictions, kernel_cache_size)
+    before = kernel_cache_evictions()
+    conf = C.RapidsConf(
+        {"spark.rapids.sql.kernelCache.maxEntries": 2})
+    with C.session(conf):
+        for i in range(5):
+            kc = KernelCache(scope=("evict-test", i))
+            fn = kc.get_or_build(("k",), lambda i=i: (lambda: i))
+            assert fn() == i
+            # a hit must not insert (LRU refresh only)
+            assert kc.get_or_build(("k",), lambda: None) is fn
+    assert kernel_cache_size() <= 2
+    assert kernel_cache_evictions() - before == 3
+    # the still-cached entries keep hitting
+    with C.session(conf):
+        kc = KernelCache(scope=("evict-test", 4))
+        assert kc.get_or_build(("k",), lambda: None)() == 4
+
+
+def test_kernel_cache_bound_holds_through_query(fresh_kernel_cache):
+    from spark_rapids_tpu.exec.base import kernel_cache_size
+    conf = _conf(spark__rapids__sql__kernelCache__maxEntries=2)
+    plan, _ = _chain_plan(seed=31)
+    p = accelerate(plan, conf)
+    collect(p, conf)
+    assert kernel_cache_size() <= 2
+
+
+# ---------------------------------------------------------------------------
+# exprs/simplify.py satellite: CSE + new peephole rules
+def test_simplify_double_cast_collapse():
+    x = BoundReference(0, T.INT32)
+    e = SI.simplify(Cast(Cast(x, T.INT64), T.INT64))
+    assert isinstance(e, Cast) and not isinstance(e.child, Cast)
+    assert e.to == T.INT64
+
+
+def test_simplify_identity_cast_collapse():
+    x = BoundReference(0, T.INT32)
+    assert SI.simplify(Cast(x, T.INT32)) is x
+    # ANSI casts are never collapsed (they carry overflow checks)
+    e = Cast(x, T.INT32, True)
+    assert isinstance(SI.simplify(e), Cast)
+
+
+def test_simplify_boolean_literal_folds():
+    x = BoundReference(0, T.BOOL)
+    from spark_rapids_tpu.exprs.base import Literal
+    assert SI.simplify(P.And(x, lit(True))) is x
+    folded = SI.simplify(P.And(x, lit(False)))
+    assert isinstance(folded, Literal) and folded.value is False
+    assert SI.simplify(P.Or(x, lit(False))) is x
+    folded = SI.simplify(P.Or(lit(True), x))
+    assert isinstance(folded, Literal) and folded.value is True
+    folded = SI.simplify(P.Not(lit(True)))
+    assert isinstance(folded, Literal) and folded.value is False
+
+
+def test_simplify_literal_comparison_fold():
+    from spark_rapids_tpu.exprs.base import Literal
+    folded = SI.simplify(lit(3) > lit(2))
+    assert isinstance(folded, Literal) and folded.value is True
+    folded = SI.simplify(lit(3).eq(2))
+    assert isinstance(folded, Literal) and folded.value is False
+
+
+def test_cse_dedup_assigns_shared_slots():
+    a, b = BoundReference(0, T.INT64), BoundReference(1, T.INT64)
+    common = (a + b)
+    deduped = SI.dedup_common_subexprs([common * lit(2),
+                                        common * lit(3)])
+
+    def find_shared(e, out):
+        if isinstance(e, SI.SharedExpr):
+            out.append(e)
+        for c in e.children():
+            find_shared(c, out)
+        return out
+
+    shared = []
+    for e in deduped:
+        find_shared(e, shared)
+    assert len(shared) == 2
+    assert shared[0].slot == shared[1].slot
+
+
+def test_cse_dedup_bit_exact_through_kernel():
+    from spark_rapids_tpu.exec.basic import LocalBatchSource, ProjectExec
+    rng = np.random.default_rng(17)
+    df = pd.DataFrame({"a": rng.uniform(0, 1, 300),
+                       "b": rng.uniform(0, 1, 300)})
+    src = LocalBatchSource.from_pandas(df)
+    common = col("a") * col("b")
+    exprs = [(common + lit(1.0)).alias("x"),
+             (common + lit(2.0)).alias("y")]
+    plain = ProjectExec(exprs, src).collect().to_pandas()
+    bound = [e.bind(src.output_schema()) for e in exprs]
+    deduped = SI.dedup_common_subexprs(bound)
+    assert any(isinstance(c, SI.SharedExpr)
+               for e in deduped for c in _walk(e))
+    shared = ProjectExec(deduped, src).collect().to_pandas()
+    shared.columns = plain.columns
+    assert_frame_equal(plain, shared)
+
+
+def _walk(e):
+    yield e
+    for c in e.children():
+        yield from _walk(c)
+
+
+@pytest.fixture
+def fresh_kernel_cache():
+    """Empty global kernel cache for the test, RESTORED afterwards so
+    later suites keep their warm executables (a bare clear would force
+    the rest of tier-1 to recompile everything)."""
+    import spark_rapids_tpu.exec.base as EB
+    with EB._GLOBAL_KERNELS_LOCK:
+        saved = dict(EB._GLOBAL_KERNELS)
+    EB.clear_kernel_cache()
+    try:
+        yield
+    finally:
+        with EB._GLOBAL_KERNELS_LOCK:
+            EB._GLOBAL_KERNELS.update(saved)
+
+
+def test_identity_projection_detection():
+    from spark_rapids_tpu import types as TT
+    sch = TT.Schema.of(("a", TT.INT64), ("b", TT.FLOAT64))
+    from spark_rapids_tpu.exprs.base import Alias
+    ident = [Alias(BoundReference(0, TT.INT64), "a"),
+             Alias(BoundReference(1, TT.FLOAT64), "b")]
+    assert SI.is_identity_projection(ident, sch, sch)
+    swapped = [Alias(BoundReference(1, TT.FLOAT64), "b"),
+               Alias(BoundReference(0, TT.INT64), "a")]
+    assert not SI.is_identity_projection(swapped, sch, sch)
+
+
+def test_identity_project_collapses_in_plan():
+    rng = np.random.default_rng(2)
+    df = pd.DataFrame({"a": rng.integers(0, 10, 100).astype(np.int64),
+                       "b": rng.uniform(0, 1, 100)})
+    src = CpuSource.from_pandas(df)
+    plan = CpuAggregate([col("a")], [Sum(col("b")).alias("s"),
+                                     Count(col("b")).alias("c")],
+                        CpuProject([col("a"), col("b")], src))
+    conf = _conf()
+    p = accelerate(plan, conf)
+    # the identity project is gone (collapsed, not fused)
+    assert _find(p, "ProjectExec") is None, p.tree_string()
+    assert _find(p, "FusedStageExec") is None, p.tree_string()
+    got = collect(p, conf).sort_values("a", ignore_index=True)
+    ref = df.groupby("a").agg(s=("b", "sum"),
+                              c=("b", "size")).reset_index()
+    assert np.allclose(got["s"].astype(float), ref["s"], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# deferred selection interop
+def test_fused_stage_over_sparse_input():
+    """A fused pure-project stage must pass a deferred-selection mask
+    through untouched (the FilterExec contract holds through fusion)."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.exec.basic import LocalBatchSource, ProjectExec
+    rng = np.random.default_rng(13)
+    df = pd.DataFrame({"v": rng.integers(0, 100, 256).astype(np.int64)})
+    base = LocalBatchSource.from_pandas(df)
+    (batch,) = base.partitions[0]
+    mask = jnp.asarray(np.arange(batch.capacity) % 2 == 0)
+    n = int(np.asarray(mask).sum())
+    sparse = ColumnarBatch(batch.schema, batch.columns, n,
+                           batch.checks, sparse=mask)
+    src = LocalBatchSource([[sparse]], batch.schema)
+    p1 = ProjectExec([(col("v") * lit(3)).alias("w")], src)
+    p2 = ProjectExec([(col("w") + lit(1)).alias("u")], p1)
+    from spark_rapids_tpu.plan.fusion import fuse_plan
+    fused = fuse_plan(p2, C.RapidsConf({}))
+    assert type(fused).__name__ == "FusedStageExec"
+    got = fused.collect().to_pandas()
+    live = df["v"].to_numpy()[np.asarray(mask)[:len(df)]]
+    assert (got["u"].to_numpy(dtype=np.int64) == live * 3 + 1).all()

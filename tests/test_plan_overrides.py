@@ -359,7 +359,11 @@ def test_session_conf_reaches_plan_and_runtime():
 
     src = CpuSource.from_pandas(pd.DataFrame(
         {"x": pd.array(np.arange(100), dtype="Int64")}), num_partitions=1)
-    c = C.RapidsConf({"spark.rapids.tpu.batchMaxRows": 32})
+    # fusion off: this test asserts the LEGACY project-over-filter
+    # shape (whole-stage fusion would collapse the pair into one node
+    # and hang the coalesce above it instead)
+    c = C.RapidsConf({"spark.rapids.tpu.batchMaxRows": 32,
+                      "spark.rapids.sql.fusion.enabled": False})
     # project-over-filter: the filter's coalesce_after makes the
     # transition pass insert a CoalesceBatchesExec between them
     plan = accelerate(
