@@ -46,6 +46,9 @@ import time
 import numpy as np
 
 V5E_HBM_GBPS = 819.0  # v5e peak HBM bandwidth
+#: probed HBM read ceiling, set by main() so later benches (movement
+#: ledger roofline) can report utilization against measured hardware
+_HBM_PROBE_GBPS = [None]
 
 Q1_ROWS = 1 << 24    # 16.8M rows/batch, 7 x int32/f32 cols = 470MB
 Q1_BATCHES = 6
@@ -778,6 +781,59 @@ def bench_udf_q27():
 #: set by bench_profile_overhead; the driver-facing summary line carries
 #: it so the observability layer's cost is tracked round-to-round
 _PROFILE_OVERHEAD_PCT = [None]
+#: set by bench_movement_ledger: {edge: [MBytes, effective GB/s]} from a
+#: profiled manager-lane q5 — BENCH_r06+ tracks movement trajectory,
+#: not just wall clock
+_MOVEMENT_SUMMARY = [None]
+
+
+def bench_movement_ledger():
+    """Data-movement ledger acceptance bench (ISSUE 8): TPC-H q5
+    through the manager shuffle lane (2 in-process executors, seeded
+    OOM injection against a shrunk budget so spills are real) with the
+    movement ledger on.  Reports per-edge byte totals + effective GB/s
+    and the utilization vs the PROBED HBM ceiling, so the slow-lane
+    rescues (ROADMAP item 5) land with byte evidence."""
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.memory import retry as R
+    from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+    from spark_rapids_tpu.models.tpch_data import gen_tables
+    from spark_rapids_tpu.utils import profile as P
+
+    tables = gen_tables(np.random.default_rng(11), 200_000)
+    conf = C.RapidsConf({**BENCH_CONF,
+        "spark.rapids.sql.profile.enabled": True,
+        "spark.rapids.shuffle.enabled": True,
+        "spark.rapids.shuffle.localExecutors": 2,
+        "spark.rapids.memory.faultInjection.oomRate": 0.25,
+        "spark.rapids.memory.faultInjection.seed": 11,
+        "spark.rapids.memory.faultInjection.maxInjections": 8})
+    R.reset_oom_injection()
+    t0 = time.perf_counter()
+    run_query(5, tables, engine="tpu", conf=conf)
+    wall = time.perf_counter() - t0
+    R.reset_oom_injection()
+    prof = P.last_profile()
+    mv = prof.movement or {"edges": {}, "total_bytes": 0}
+    edges = {}
+    for edge, e in mv["edges"].items():
+        edges[edge] = [round(e["bytes"] / 1e6, 3), e["gbps_avg"]]
+    _MOVEMENT_SUMMARY[0] = edges
+    hbm = _HBM_PROBE_GBPS[0] or V5E_HBM_GBPS
+    total = mv["total_bytes"]
+    gbps = total / wall / 1e9 if wall > 0 else 0.0
+    return {
+        "metric": "movement_total_mb", "value": round(total / 1e6, 3),
+        "unit": "MB",
+        # >= 1.0 means every edge class the lane exercises reported
+        "vs_baseline": round(min(1.0, sum(
+            1 for e in mv["edges"].values() if e["bytes"]) / 4.0), 2),
+        "wall_ms": round(wall * 1e3, 1),
+        "effective_gbps": round(gbps, 4),
+        "hbm_probe_utilization": round(gbps / hbm, 6),
+        "edges": {k: {"mb": v[0], "gbps": v[1]}
+                  for k, v in edges.items()},
+    }
 
 
 def bench_profile_overhead():
@@ -1190,6 +1246,7 @@ def bench_scale_join_groupby():
 
 def main():
     hbm_probe = probe_hbm_bandwidth()
+    _HBM_PROBE_GBPS[0] = hbm_probe
     print(json.dumps({"metric": "hbm_probe_gbps",
                       "value": round(hbm_probe, 1), "unit": "GB/s",
                       "note": "device-resident fused elementwise pass "
@@ -1275,6 +1332,9 @@ def main():
             "pipeline_wait_ms": round(pstats["wait_ns"] / 1e6, 1),
             "prefetch_hits": pstats["hits"],
             "profile_overhead_pct": _PROFILE_OVERHEAD_PCT[0],
+            # per-edge [MB, effective GB/s] from the movement-ledger
+            # bench (ISSUE 8): the data-movement trajectory
+            "movement_edges": _MOVEMENT_SUMMARY[0],
         }
         for level in (1, 2, 3):
             summary["submetrics"] = compact_at(level)
@@ -1297,6 +1357,7 @@ def main():
     for fn in (bench_groupby, bench_groupby_dict_kernel,
                bench_join_sort, bench_exchange_manager,
                bench_pipeline_overlap, bench_profile_overhead,
+               bench_movement_ledger,
                bench_concurrent_throughput,
                bench_udf_q27, bench_scale_join_groupby):
         try:

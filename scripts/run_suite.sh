@@ -25,8 +25,66 @@ run_fast() {
   run_recovery
   run_watchdog
   run_profile
+  run_movement
   run_concurrency
   run_fusion
+}
+
+run_movement() {
+  # data-movement lane: the ledger suite (edge conservation, spill
+  # reconciliation, disabled-path parity, per-query isolation), then
+  # TPC-H q1/q5 movement-report validation — q5 through the manager
+  # shuffle lane (2 in-process executors + seeded OOM injection) must
+  # report upload/readback/spill/wire traffic with wire-conservation
+  # (bytes served == bytes assembled) holding — and a per-edge summary
+  # line with effective GB/s.
+  echo "== movement lane (per-query data-movement ledger, roofline) =="
+  "${PYTEST[@]}" tests/test_movement.py
+  python - <<'PYEOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import json
+import numpy as np
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.memory import retry as R
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+from spark_rapids_tpu.utils import profile as P
+
+tables = gen_tables(np.random.default_rng(11), 1000)
+for q, extra in ((1, {}), (5, {
+        "spark.rapids.shuffle.enabled": True,
+        "spark.rapids.shuffle.localExecutors": 2,
+        "spark.rapids.memory.faultInjection.oomRate": 0.5,
+        "spark.rapids.memory.faultInjection.seed": 7,
+        "spark.rapids.memory.faultInjection.maxInjections": 16})):
+    R.reset_oom_injection()
+    run_query(q, tables, engine="tpu", conf=C.RapidsConf({
+        **BENCH_CONF, "spark.rapids.sql.profile.enabled": True, **extra}))
+    R.reset_oom_injection()
+    prof = P.last_profile()
+    mv = prof.movement
+    assert mv is not None and mv["total_bytes"] > 0, mv
+    edges = mv["edges"]
+    if q == 5:
+        for e in ("upload", "readback", "wire"):
+            assert edges[e]["bytes"] > 0, (e, edges[e])
+        sites = edges["wire"]["sites"]
+        sent = sum(v["bytes"] for s, v in sites.items()
+                   if s.startswith("send"))
+        recv = sum(v["bytes"] for s, v in sites.items()
+                   if s.startswith("recv"))
+        assert sent == recv > 0, (sent, recv)
+    assert "-- data movement --" in prof.explain()
+    counters = [e for e in prof.chrome_trace()["traceEvents"]
+                if e["ph"] == "C"]
+    assert counters, "no Perfetto counter tracks"
+    print("movement summary: q%d total_mb=%.2f %s" % (
+        q, mv["total_bytes"] / 1e6,
+        " ".join("%s=%.2fMB@%.3fGB/s" % (
+            e, d["bytes"] / 1e6, d["gbps_avg"])
+            for e, d in edges.items() if d["bytes"])))
+PYEOF
 }
 
 run_fusion() {
@@ -300,9 +358,10 @@ case "$TIER" in
   recovery) run_recovery ;;
   watchdog) run_watchdog ;;
   profile)  run_profile ;;
+  movement) run_movement ;;
   concurrency) run_concurrency ;;
   fusion)   run_fusion ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|concurrency|fusion|all]" >&2
+  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|all]" >&2
      exit 2 ;;
 esac

@@ -83,7 +83,7 @@ class ColumnarBatch:
         """Host row count — SYNCS if the count is still a device scalar."""
         if not isinstance(self._rows, int):
             from spark_rapids_tpu.utils import checks as CK
-            CK.note_host_sync("batch.num_rows")
+            CK.note_host_sync("batch.num_rows", nbytes=4)
             self._rows = int(np.asarray(self._rows))
         return self._rows
 
@@ -155,6 +155,14 @@ class ColumnarBatch:
             col = ColumnVector.from_numpy(np.asarray(data[name]), dt, v, cap)
             cols.append(col)
             fields.append(T.Field(name, col.dtype))
+        # movement ledger: this is THE host->device construction point
+        # (from_arrow / from_pandas funnel through here) — one upload
+        # record per batch, padded device footprint incl. narrow shadows
+        from spark_rapids_tpu.utils import movement as MV
+        if cols and MV.ledger() is not None:
+            MV.record(MV.EDGE_UPLOAD,
+                      sum(MV.vector_device_bytes(c) for c in cols),
+                      site="batch.from_numpy", rows=n)
         return ColumnarBatch(schema or T.Schema(tuple(fields)), cols, n)
 
     @staticmethod
@@ -209,11 +217,21 @@ class ColumnarBatch:
         return ColumnarBatch.from_numpy(
             data, T.Schema(tuple(fields)), validity)
 
+    def _note_readback(self, site: str) -> None:
+        """Ledger hook for the host-conversion sinks: the full padded
+        device arrays are pulled to the host (to_numpy trims after the
+        transfer), so the moved bytes are the device footprint."""
+        from spark_rapids_tpu.utils import movement as MV
+        if MV.ledger() is not None:
+            MV.record(MV.EDGE_READBACK, self.device_size_bytes(),
+                      site=site)
+
     # -- host conversion ----------------------------------------------------
     def to_pandas(self):
         import pandas as pd
         if self.sparse is not None:
             return self.dense().to_pandas()
+        self._note_readback("collect.to_pandas")
         self.prefetch()
         self.verify_checks()
         out = {}
@@ -236,6 +254,7 @@ class ColumnarBatch:
     def to_pylist(self) -> list[dict]:
         if self.sparse is not None:
             return self.dense().to_pylist()
+        self._note_readback("collect.to_pylist")
         self.prefetch()
         self.verify_checks()
         cols = {f.name: c.to_pylist(self.num_rows)
@@ -247,6 +266,7 @@ class ColumnarBatch:
         import pyarrow as pa
         if self.sparse is not None:
             return self.dense().to_arrow()
+        self._note_readback("collect.to_arrow")
         self.prefetch()
         self.verify_checks()
         arrays = []

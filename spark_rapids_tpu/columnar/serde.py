@@ -37,6 +37,12 @@ def _unpack_bits(data: bytes, n: int) -> np.ndarray:
 
 def serialize_batch(batch: ColumnarBatch) -> bytes:
     batch = batch.dense()
+    # movement ledger: serialization pulls the full padded device
+    # arrays host-side (spill / shuffle-serve readback)
+    from spark_rapids_tpu.utils import movement as MV
+    if MV.ledger() is not None:
+        MV.record(MV.EDGE_READBACK, batch.device_size_bytes(),
+                  site="serde.serialize")
     batch.prefetch()
     batch.verify_checks()
     n = batch.num_rows
@@ -111,7 +117,14 @@ def deserialize_batch(blob: bytes,
                                _dev(_pad_to(validity, cap)))
         cols.append(col)
         fields.append(T.Field(fm["name"], dt))
-    return ColumnarBatch(T.Schema(tuple(fields)), cols, n)
+    out = ColumnarBatch(T.Schema(tuple(fields)), cols, n)
+    # movement ledger: deserialization re-uploads the padded arrays
+    # (spill re-read / shuffle-receive materialization)
+    from spark_rapids_tpu.utils import movement as MV
+    if MV.ledger() is not None:
+        MV.record(MV.EDGE_UPLOAD, out.device_size_bytes(),
+                  site="serde.deserialize")
+    return out
 
 
 def _dev(arr: np.ndarray):

@@ -236,6 +236,33 @@ PROFILE_CHROME_TRACE_PATH = conf(
     "substituted so consecutive queries do not overwrite each other.  "
     "Empty disables the file sink; QueryProfile.chrome_trace() always "
     "serves the same payload in-process.")
+MOVEMENT_ENABLED = conf(
+    "spark.rapids.sql.profile.movement.enabled", True,
+    "When profiling is on, additionally record the per-query "
+    "data-movement ledger (utils/movement.py): bytes + duration on "
+    "every edge where data crosses a boundary — host->device uploads, "
+    "device->host readbacks, spill tier migrations, shuffle wire "
+    "bytes (compressed AND uncompressed), and ICI collective "
+    "payloads.  The QueryProfile then carries a movement report "
+    "(per-edge totals, effective GB/s vs roofline, compression "
+    "ratios), Chrome-trace counter tracks, and data_movement event "
+    "records.  Off: the profiler records time only, as before.")
+MOVEMENT_ROOFLINE_GBPS = conf(
+    "spark.rapids.sql.profile.movement.rooflineGBps", 0.0,
+    "Bandwidth ceiling (GB/s) the movement report computes "
+    "utilization against, for every edge.  0 (default) uses the "
+    "per-edge nominal table in utils/movement.py (host link for "
+    "upload/readback/spill, DCN NIC for wire, ICI for collectives); "
+    "set this to a probed number (e.g. bench.py's "
+    "probe_hbm_bandwidth) to judge all edges against measured "
+    "hardware instead.")
+MOVEMENT_MIN_EVENT_BYTES = conf(
+    "spark.rapids.sql.profile.movement.minEventBytes", 65536,
+    "Movement records at or above this many bytes also land in the "
+    "structured event log as data_movement records (correlatable with "
+    "retries, fetch failures, and watchdog dumps by query id); "
+    "smaller records are aggregated into the ledger only, keeping the "
+    "event ring for interesting transfers.  0 logs every record.")
 
 # --- concurrent multi-query serving (exec/scheduler.py) ----------------------
 SCHED_ENABLED = conf(

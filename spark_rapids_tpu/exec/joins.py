@@ -590,11 +590,11 @@ class HashJoinExec(TpuExec):
                     sk = self._semi_kernel(pb, jt == JoinType.LEFT_ANTI)
                     cols, n = sk(pb.columns, counts_p,
                                  jnp.int32(pb.num_rows))
-                    CK.note_host_sync("join.expand")
+                    CK.note_host_sync("join.expand", nbytes=4)
                     return ColumnarBatch(self._schema, list(cols), int(n))
                 # per-probe-batch host sync: the expand kernel's output
                 # capacity must be a HOST int (it keys the compile)
-                CK.note_host_sync("join.expand")
+                CK.note_host_sync("join.expand", nbytes=4)
                 total = int(total_inner)
                 if outer_probe:
                     total = total + pb.num_rows  # upper bound

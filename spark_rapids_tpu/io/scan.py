@@ -302,6 +302,10 @@ class MultiFileCoalescingReader:
         if self.metrics is not None:
             self.metrics.add(M.BUFFER_TIME, buffer_time)
             self.metrics.add(M.DECODE_TIME, time.monotonic() - t1)
+            # per-node movement attribution: host->HBM bytes this scan
+            # shipped (EXPLAIN-with-metrics renders it; the query-wide
+            # total lives on the ledger's upload edge)
+            self.metrics.add(M.UPLOAD_BYTES, batch.device_size_bytes())
         return batch
 
 

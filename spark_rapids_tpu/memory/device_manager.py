@@ -26,7 +26,15 @@ _DEFAULT_HBM = 16 * 1024**3  # v5p chip-class default when PJRT has no stats
 class SpillCallback:
     """Alloc-pressure callback (DeviceMemoryEventHandler analog): spill the
     device store until `needed` bytes fit, retrying a bounded number of
-    times; gives up when nothing is left to spill."""
+    times; gives up when nothing is left to spill.
+
+    Accounting: `bytes_spilled` is the process-wide total; the bytes a
+    SINGLE pressure call freed accumulate thread-locally so the OOM
+    retry harness charges each exec's `spillBytes` metric with the
+    spills ITS thread triggered — the old `bytes_spilled` before/after
+    delta cross-charged concurrent queries' spills to whichever exec
+    happened to be reading the counter (the movement ledger's
+    device->host spill totals exposed the mismatch)."""
 
     MAX_RETRIES = 3
 
@@ -34,6 +42,15 @@ class SpillCallback:
         self.device_store = device_store
         self.spill_count = 0
         self.bytes_spilled = 0
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+
+    def take_thread_freed(self) -> int:
+        """Bytes freed by pressure calls on THIS thread since the last
+        take (the per-exec spillBytes attribution source)."""
+        freed = getattr(self._tls, "freed", 0)
+        self._tls.freed = 0
+        return freed
 
     def on_alloc_pressure(self, needed: int, budget: int,
                           reserved: int) -> bool:
@@ -43,8 +60,10 @@ class SpillCallback:
         for _ in range(self.MAX_RETRIES):
             target = max(0, budget - needed - reserved)
             freed = self.device_store.synchronous_spill(target)
-            self.spill_count += 1
-            self.bytes_spilled += freed
+            with self._lock:
+                self.spill_count += 1
+                self.bytes_spilled += freed
+            self._tls.freed = getattr(self._tls, "freed", 0) + freed
             if (self.device_store.current_size + reserved + needed
                     <= budget):
                 return True

@@ -161,12 +161,17 @@ def _blocked_spill(dm, nbytes: int, metrics) -> None:
     the semaphore yielded and the wall time charged to retryBlockTime."""
     t0 = time.perf_counter_ns()
     cb = dm.spill_callback
-    before = cb.bytes_spilled if cb is not None else 0
+    if cb is not None:
+        cb.take_thread_freed()  # discard any stale thread residue
     with _sem_yielded(), P.span("retry-block:spill", cat=P.CAT_RETRY):
         if cb is not None:
             cb.on_alloc_pressure(nbytes, dm.budget, dm.reserved_bytes)
     if cb is not None:
-        _madd(metrics, M.SPILL_BYTES, cb.bytes_spilled - before)
+        # thread-local attribution: only spills THIS thread's pressure
+        # call triggered charge this exec (a concurrent query spilling
+        # at the same time no longer cross-charges — the before/after
+        # bytes_spilled delta did)
+        _madd(metrics, M.SPILL_BYTES, cb.take_thread_freed())
     _madd(metrics, M.RETRY_BLOCK_TIME, time.perf_counter_ns() - t0)
 
 
@@ -177,11 +182,12 @@ def _blocked_reserve(dm, nbytes: int, metrics) -> bool:
     rolled back)."""
     t0 = time.perf_counter_ns()
     cb = dm.spill_callback
-    before = cb.bytes_spilled if cb is not None else 0
+    if cb is not None:
+        cb.take_thread_freed()
     with _sem_yielded(), P.span("retry-block:reserve", cat=P.CAT_RETRY):
         ok = dm.reserve(nbytes)
     if cb is not None:
-        _madd(metrics, M.SPILL_BYTES, cb.bytes_spilled - before)
+        _madd(metrics, M.SPILL_BYTES, cb.take_thread_freed())
     _madd(metrics, M.RETRY_BLOCK_TIME, time.perf_counter_ns() - t0)
     if not ok:
         dm.release_reservation(nbytes)

@@ -102,6 +102,9 @@ class BufferStore:
     def synchronous_spill(self, target_size: int) -> int:
         """Spill lowest-priority buffers until `current_size <= target_size`.
         Returns bytes freed (reference RapidsBufferStore.synchronousSpill)."""
+        import time
+
+        from spark_rapids_tpu.utils import movement as MV
         freed = 0
         while True:
             with self._lock:
@@ -117,7 +120,22 @@ class BufferStore:
                 if buf is None or not buf.try_mark_spilling():
                     continue
             if self.spill_store is not None:
-                self.spill_store.copy_buffer(buf)
+                t0 = time.perf_counter_ns()
+                dst = self.spill_store.copy_buffer(buf)
+                # one ledger record PER HOP: a device->host->disk
+                # migration (host pool full, fell through) lands here
+                # as device->disk — the hop that actually happened —
+                # never as two overlapping device->host + host->disk
+                # records for one copy.  src bytes = this tier's
+                # accounted size (what spillBytes/bytes_spilled
+                # count); payload = the serialized blob that landed.
+                if MV.ledger() is not None:
+                    MV.record(
+                        MV.EDGE_SPILL, buf.size_bytes,
+                        site=f"{self.tier.name.lower()}->"
+                             f"{dst.tier.name.lower()}",
+                        raw_bytes=dst.size_bytes,
+                        dur_ns=time.perf_counter_ns() - t0)
             freed += buf.size_bytes
             self.remove_from_tier_only(buf)
         return freed
@@ -304,8 +322,16 @@ class DiskBuffer(SpillableBuffer):
     def get_host_bytes(self) -> bytes:
         # CRC-verified read: corruption surfaces as SpillCorruptionError
         # instead of a poisoned batch (memory/native spill framing)
+        import time
+
         from spark_rapids_tpu.memory.native import spill_read
-        return spill_read(self._path)
+        from spark_rapids_tpu.utils import movement as MV
+        t0 = time.perf_counter_ns()
+        blob = spill_read(self._path)
+        if MV.ledger() is not None:
+            MV.record(MV.EDGE_SPILL, len(blob), site="disk->host",
+                      dur_ns=time.perf_counter_ns() - t0)
+        return blob
 
     def get_columnar_batch(self) -> ColumnarBatch:
         return deserialize_batch(self.get_host_bytes())

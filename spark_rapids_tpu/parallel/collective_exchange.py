@@ -37,7 +37,8 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.ops.murmur3 import partition_ids as murmur3_pids
 
 
-def watched_collective(thunk, label: str = "all-to-all"):
+def watched_collective(thunk, label: str = "all-to-all",
+                       nbytes: int = 0):
     """Run one collective dispatch (and its blocking host readback)
     under a collective-class watchdog heartbeat: an ICI all-to-all
     blocks EVERY mesh participant when one goes dark, so it gets the
@@ -45,12 +46,23 @@ def watched_collective(thunk, label: str = "all-to-all"):
     its own hang-injection site.  A real wedged collective cannot be
     interrupted host-side (the driver is inside the runtime), but the
     watchdog still emits the diagnostic dump naming this dispatch and
-    cancels the query so every cooperative wait unwinds."""
+    cancels the query so every cooperative wait unwinds.
+
+    `nbytes` (the payload the collective moves over the mesh) feeds
+    the query's data-movement ledger — the collective edge of the
+    movement report — timed over the dispatch + fence."""
+    import time
+
+    from spark_rapids_tpu.utils import movement as MV
     from spark_rapids_tpu.utils import watchdog as W
     with W.heartbeat(f"collective:{label}", kind="collective") as hb:
         W.check_cancelled()
         W.maybe_hang("collective")
+        t0 = time.perf_counter_ns()
         out = thunk()
+        if nbytes:
+            MV.record(MV.EDGE_COLLECTIVE, nbytes, site=label,
+                      dur_ns=time.perf_counter_ns() - t0)
         hb.beat()
         return out
 

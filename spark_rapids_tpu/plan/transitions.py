@@ -78,6 +78,12 @@ def df_from_batch(batch: ColumnarBatch) -> pd.DataFrame:
     tunnel-attached chip each blocking readback costs ~150ms, so the
     whole batch must come back in one wave."""
     batch = batch.dense()
+    # movement ledger: the engine's result sink pulls the full padded
+    # device arrays (the collect-boundary readback)
+    from spark_rapids_tpu.utils import movement as MV
+    if MV.ledger() is not None:
+        MV.record(MV.EDGE_READBACK, batch.device_size_bytes(),
+                  site="collect.df_from_batch")
     batch.prefetch()
     batch.verify_checks()
     out = {}

@@ -90,6 +90,12 @@ class ShuffleReceiveHandler:
     def batch_received(self, bid: BufferId) -> None:
         ...
 
+    def buffer_received(self, wire_bytes: int, raw_bytes: int) -> None:
+        """One assembled wire payload landed: its on-the-wire
+        (compressed) and uncompressed sizes, so readers can charge
+        per-exchange compression metrics."""
+        ...
+
     def transfer_error(self, message: str) -> None:
         ...
 
@@ -130,11 +136,19 @@ class BufferReceiveState:
                 return
             blob = b"".join(self._chunks.pop(table_id))
             self.completed.add(table_id)
+        wire_len = len(blob)
         if codec_id != -1:
             # wire payload was codec-compressed by the server
             # (reference GpuCompressedColumnVector decompress-on-receive)
             from spark_rapids_tpu.shuffle.compression import get_codec
             blob = get_codec(codec_id).decompress(blob, raw_len)
+        # movement ledger, receive side: mirrors the sender's record so
+        # in-process conservation (bytes served == bytes assembled) is
+        # checkable; 'recv' sites are excluded from edge totals
+        from spark_rapids_tpu.utils import movement as MV
+        MV.record(MV.EDGE_WIRE, wire_len, site="recv",
+                  raw_bytes=len(blob))
+        self.handler.buffer_received(wire_len, len(blob))
         meta_msg = self.metas[table_id]
         bid = BufferId(self.received_catalog.new_buffer_id().table_id,
                        meta_msg.shuffle_id, meta_msg.map_id,
@@ -362,13 +376,19 @@ class ShuffleServer:
             with W.heartbeat("shuffle-server", kind="task",
                              conf=wconf) as hb, \
                     P.span("shuffle-server", cat=P.CAT_SHUFFLE):
+                from spark_rapids_tpu.shuffle.compression import (
+                    note_compression)
+                from spark_rapids_tpu.utils import movement as MV
+                wire_site = "send:dcn" if wire else "send:loop"
                 for tid in table_ids:
+                    t0 = time.perf_counter_ns()
                     blob = self.acquire_buffer_bytes(tid)
                     raw_len = len(blob)
                     codec_id = -1
                     if codec is not None:
                         blob = codec.compress(blob)
                         codec_id = codec.codec_id
+                        note_compression(codec.name, raw_len, len(blob))
                     n = len(blob)
                     nchunks = max(1, -(-n // chunk_size))
                     for i in range(nchunks):
@@ -382,6 +402,16 @@ class ShuffleServer:
                              codec_id, raw_len)
                         hb.beat()
                         total += len(chunk)
+                    # movement ledger: one wire record per served
+                    # buffer — compressed payload + uncompressed size,
+                    # timed over acquire+compress+emit.  Loopback
+                    # fetches run on the CLIENT's thread, so the
+                    # record lands in the fetching query's ledger;
+                    # TCP handlers fall back to the newest tracer.
+                    MV.record(MV.EDGE_WIRE, n, site=wire_site,
+                              raw_bytes=raw_len,
+                              dur_ns=time.perf_counter_ns() - t0,
+                              codec=codec.name if codec else "none")
         except Exception as e:  # noqa: BLE001 — surface as transaction
             return Transaction(TransactionStatus.ERROR, str(e), total)
         return Transaction(TransactionStatus.SUCCESS,

@@ -279,7 +279,8 @@ class ShuffleExchangeExec(UnaryExecBase):
                 unknown = [b for b in dense if not b.num_rows_known]
                 if unknown:
                     from spark_rapids_tpu.utils import checks as CK
-                    CK.note_host_sync("exchange.merge")
+                    CK.note_host_sync("exchange.merge",
+                                      nbytes=4 * len(unknown))
                     vals = np.asarray(jnp.stack(
                         [b.num_rows_i32 for b in unknown])).tolist()
                     it = iter(vals)
@@ -399,6 +400,15 @@ class ShuffleExchangeExec(UnaryExecBase):
         with self.metrics.timed(M.TOTAL_TIME), \
                 P.span("mesh-exchange", cat=P.CAT_SHUFFLE):
             arrs, num_rows = stack_batches(locals_, cap)
+            # movement ledger: the payload the data-phase all-to-all
+            # ships over ICI — every column's stacked data + validity
+            # (+ lengths) arrays (the count phase is n_dev ints, noise)
+            from spark_rapids_tpu.utils import movement as MV
+            payload = 0
+            if MV.ledger() is not None:
+                payload = sum(a.nbytes for field in arrs
+                              for a in field if a is not None)
+                self.metrics.add(M.COLLECTIVE_BYTES, payload)
             # two-phase exchange (ADVICE r2): a counts-only all-to-all
             # sizes the data phase's receive buffers from ACTUAL totals
             # — the old n_dev*cap worst case OOMs HBM-scale batches
@@ -407,7 +417,7 @@ class ShuffleExchangeExec(UnaryExecBase):
                 lambda: build_count_exchange(mesh, axis, schema,
                                              key_idx, cap))
             from spark_rapids_tpu.utils import checks as CK
-            CK.note_host_sync("exchange.mesh")
+            CK.note_host_sync("exchange.mesh", nbytes=4 * n)
             totals = watched_collective(
                 lambda: np.asarray(count_fn(arrs, num_rows)),
                 label="mesh-count")
@@ -418,7 +428,8 @@ class ShuffleExchangeExec(UnaryExecBase):
                     mesh, axis, schema, key_idx, cap,
                     out_capacity=out_cap))
             out_arrs, out_rows = watched_collective(
-                lambda: step(arrs, num_rows), label="mesh-exchange")
+                lambda: step(arrs, num_rows), label="mesh-exchange",
+                nbytes=payload)
         out = unstack_batches(out_arrs, np.asarray(out_rows),
                               self._schema)
         for b in out:
@@ -553,7 +564,8 @@ class ShuffleExchangeExec(UnaryExecBase):
             try:
                 batches = (driver.read_partition(p)
                            if driver is not None
-                           else primary.get_reader(shuffle_id, p))
+                           else primary.get_reader(shuffle_id, p,
+                                                   metrics=self.metrics))
                 for b in batches:
                     self.metrics.add(M.NUM_OUTPUT_ROWS, b.num_rows)
                     self.metrics.add(M.NUM_OUTPUT_BATCHES, 1)

@@ -241,17 +241,22 @@ class TpuShuffleManager:
     def get_reader(self, shuffle_id: int, partition: int,
                    task_attempt_id: Optional[int] = None,
                    timeout: float = 30.0,
-                   with_map_ids: bool = False) -> Iterator:
+                   with_map_ids: bool = False,
+                   metrics=None) -> Iterator:
         """Iterate one reduce partition's batches.  `with_map_ids`
         yields (map_id, batch) tuples instead, so a recovery-aware
         consumer can re-establish deterministic map order after a
-        recompute moved outputs between executors."""
+        recompute moved outputs between executors.  `metrics` (the
+        owning exchange's MetricSet) is charged the wire
+        compressed/uncompressed byte counters so codec choice shows in
+        EXPLAIN-with-metrics."""
         if task_attempt_id is None:
             # unique per reader so per-task receive cleanup cannot free a
             # concurrent reader's buffers
             task_attempt_id = next(TpuShuffleManager._attempt_ids)
         it = CachingShuffleReader(
-            self, shuffle_id, partition, task_attempt_id, timeout).read()
+            self, shuffle_id, partition, task_attempt_id, timeout,
+            metrics=metrics).read()
         if with_map_ids:
             return it
         return (b for _, b in it)
@@ -310,12 +315,16 @@ class CachingShuffleWriter:
 
 
 class _IteratorHandler(ShuffleReceiveHandler):
-    def __init__(self, q: "queue.Queue", current: dict):
+    def __init__(self, q: "queue.Queue", current: dict,
+                 wire_stats: Optional[dict] = None):
         self.q = q
         #: mutable cell the fetch loop updates with the peer address it
         #: is currently draining, so errors carry the REAL peer (the
         #: old literal "remote" hid which executor to invalidate)
         self.current = current
+        #: {"compressed": n, "raw": n} accumulator the owning reader
+        #: charges to the exchange's compression metrics
+        self.wire_stats = wire_stats
         self.expected = 0
 
     def start(self, expected_batches: int) -> None:
@@ -323,6 +332,11 @@ class _IteratorHandler(ShuffleReceiveHandler):
 
     def batch_received(self, bid: BufferId) -> None:
         self.q.put(("batch", bid))
+
+    def buffer_received(self, wire_bytes: int, raw_bytes: int) -> None:
+        if self.wire_stats is not None:
+            self.wire_stats["compressed"] += wire_bytes
+            self.wire_stats["raw"] += raw_bytes
 
     def transfer_error(self, message: str) -> None:
         self.q.put(("error", (self.current.get("addr"), message)))
@@ -334,12 +348,17 @@ class CachingShuffleReader:
     remote fetches run on a fetch thread while the task consumes."""
 
     def __init__(self, manager: TpuShuffleManager, shuffle_id: int,
-                 partition: int, task_attempt_id: int, timeout: float):
+                 partition: int, task_attempt_id: int, timeout: float,
+                 metrics=None):
         self.manager = manager
         self.shuffle_id = shuffle_id
         self.partition = partition
         self.task_attempt_id = task_attempt_id
         self.timeout = timeout
+        self.metrics = metrics
+        #: wire bytes this reader's remote fetches pulled, compressed
+        #: vs uncompressed — charged to the exchange on read completion
+        self.wire_stats = {"compressed": 0, "raw": 0}
         # captured here (the consuming task's thread, session conf
         # installed) because the fetch worker is a raw thread with no
         # conf propagation
@@ -384,6 +403,13 @@ class CachingShuffleReader:
             # remote: issue fetches per peer, consume as they land
             yield from self._fetch_remote(remote, sem)
         finally:
+            if self.metrics is not None and \
+                    self.wire_stats["compressed"]:
+                from spark_rapids_tpu.utils import metrics as M
+                self.metrics.add(M.SHUFFLE_COMPRESSED_BYTES,
+                                 self.wire_stats["compressed"])
+                self.metrics.add(M.SHUFFLE_RAW_BYTES,
+                                 self.wire_stats["raw"])
             # received buffers live only for this task (reference
             # ShuffleReceivedBufferCatalog per-task cleanup)
             self.manager.received_catalog.release_task(
@@ -406,7 +432,7 @@ class CachingShuffleReader:
         health = PeerHealth.get()
         q: "queue.Queue" = queue.Queue()
         current = {"addr": next(iter(remote))}
-        handler = _IteratorHandler(q, current)
+        handler = _IteratorHandler(q, current, self.wire_stats)
         errors: list[BaseException] = []
         done = threading.Event()
         # captured on the consuming thread: the fetch worker's spans

@@ -191,7 +191,7 @@ def _slice_partitions(batch_cols, counts, schema: T.Schema,
                 for cols, n in kern(list(batch_cols), counts)]
     if not isinstance(counts, np.ndarray):
         from spark_rapids_tpu.utils import checks as CK
-        CK.note_host_sync("partition.cut")
+        CK.note_host_sync("partition.cut", nbytes=4 * n_parts)
     counts = np.asarray(counts)
     out = []
     offsets = np.concatenate([[0], np.cumsum(counts)])
@@ -247,7 +247,8 @@ class HashPartitioning(TpuPartitioning):
         """Phase 2: cut slices with the (prefetched) counts."""
         if batch.capacity > LAZY_SLICE_MAX_CAP:
             from spark_rapids_tpu.utils import checks as CK
-            CK.note_host_sync("partition.cut")
+            CK.note_host_sync("partition.cut",
+                              nbytes=int(counts.size) * 4)
             counts = np.asarray(counts)
         return _slice_partitions(cols, counts, batch.schema,
                                  batch.capacity, batch.checks)

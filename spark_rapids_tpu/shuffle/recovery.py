@@ -150,7 +150,7 @@ class ShuffleRecoveryDriver:
             try:
                 items = list(self.manager.get_reader(
                     self.shuffle_id, p, timeout=self.read_timeout,
-                    with_map_ids=True))
+                    with_map_ids=True, metrics=self.metrics))
                 # deterministic map order: a recompute relocates map
                 # outputs between executors, which would otherwise
                 # reorder batches (local-first) vs the failure-free run

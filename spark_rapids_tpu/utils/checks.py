@@ -35,12 +35,24 @@ import numpy as np
 # one guarded dict increment per sync is noise.
 _SYNC_LOCK = threading.Lock()
 _SYNC_SITES: "collections.Counter" = collections.Counter()
+_SYNC_BYTES: "collections.Counter" = collections.Counter()
 
 
-def note_host_sync(site: str = "?") -> None:
-    """Record one device->host blocking readback attributed to `site`."""
+def note_host_sync(site: str = "?", nbytes: int = 0) -> None:
+    """Record one device->host blocking readback attributed to `site`.
+    `nbytes` (when the site knows it) feeds the per-site byte counter
+    AND the current query's data-movement ledger (readback edge), so
+    control-plane syncs show up in the movement report next to the
+    bulk collect/serde readbacks."""
     with _SYNC_LOCK:
         _SYNC_SITES[site] += 1
+        if nbytes:
+            _SYNC_BYTES[site] += nbytes
+    if nbytes:
+        from spark_rapids_tpu.utils import movement as MV
+        led = MV.ledger()
+        if led is not None:
+            led.record(MV.EDGE_READBACK, nbytes, site=site)
 
 
 def host_sync_count() -> int:
@@ -54,9 +66,17 @@ def host_sync_sites() -> dict:
         return dict(_SYNC_SITES)
 
 
+def host_sync_bytes() -> dict:
+    """Per-site readback byte counts for the sites that report them
+    (copy) — the movement-ledger companion to host_sync_sites."""
+    with _SYNC_LOCK:
+        return dict(_SYNC_BYTES)
+
+
 def reset_host_syncs() -> None:
     with _SYNC_LOCK:
         _SYNC_SITES.clear()
+        _SYNC_BYTES.clear()
 
 
 @dataclasses.dataclass(frozen=True, eq=False)
@@ -201,7 +221,7 @@ def verify(checks, scalars=()) -> list:
             groups.setdefault(_dev_key(f), []).append((kind, i, f))
         for items in groups.values():
             try:
-                note_host_sync("checks.verify")
+                note_host_sync("checks.verify", nbytes=4 * len(items))
                 stacked = np.asarray(jnp.stack(
                     [jnp.asarray(f).astype(jnp.int32).reshape(())
                      for _, _, f in items]))
@@ -216,7 +236,7 @@ def verify(checks, scalars=()) -> list:
                 # arbitrary placement (e.g. flags sharded across devices):
                 # per-item readback still resolves correctly
                 for kind, i, f in items:
-                    note_host_sync("checks.verify")
+                    note_host_sync("checks.verify", nbytes=4)
                     if kind == "scalar":
                         scalar_vals[i] = int(np.asarray(f))
                         continue

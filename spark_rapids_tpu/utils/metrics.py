@@ -54,6 +54,15 @@ NUM_MAP_RECOMPUTES = "numMapRecomputes"
 NUM_STAGE_RETRIES = "numStageRetries"
 NUM_PEERS_BLACKLISTED = "numPeersBlacklisted"
 RECOVERY_TIME = "recoveryTime"
+# data-movement ledger (utils/movement.py) per-node attribution:
+# host->device bytes a scan uploaded, ICI collective payload bytes a
+# mesh exchange moved, and the compressed/uncompressed wire bytes a
+# manager-lane exchange's reducers pulled (compression ratio =
+# compressed / uncompressed; shuffle/compression.py codec choice)
+UPLOAD_BYTES = "uploadBytes"
+COLLECTIVE_BYTES = "collectiveBytes"
+SHUFFLE_COMPRESSED_BYTES = "shuffleCompressedBytes"
+SHUFFLE_RAW_BYTES = "shuffleUncompressedBytes"
 
 
 class MetricSet:
@@ -110,14 +119,15 @@ class MetricSet:
                 resolved[i] = float(np.asarray(v))
         for items in groups.values():
             try:
-                CK.note_host_sync("metrics.resolve")
+                CK.note_host_sync("metrics.resolve",
+                                  nbytes=8 * len(items))
                 vals = np.asarray(jnp.stack([a for _, a in items]))
                 for (i, _), val in zip(items, vals):
                     resolved[i] = float(val)
             except Exception:
                 # mixed devices (sharded runs): per-value readback
                 for i, a in items:
-                    CK.note_host_sync("metrics.resolve")
+                    CK.note_host_sync("metrics.resolve", nbytes=8)
                     resolved[i] = float(np.asarray(a))
         # apply in FIFO order so interleaved add/max sequences see the
         # same values they would have seen resolving eagerly

@@ -155,6 +155,17 @@ class QueryTracer:
             collections.deque(maxlen=MAX_EVENTS)
         self.root: Optional[Span] = None
         self.dropped_spans = 0
+        #: per-query data-movement ledger (utils/movement.py): bytes
+        #: on every edge, resolved by movement.ledger() through this
+        #: tracer so byte accounting inherits the profiler's per-query
+        #: isolation and its allocation-free disabled path
+        self.ledger = None
+        if conf[C.MOVEMENT_ENABLED]:
+            from spark_rapids_tpu.utils import movement as MV
+            self.ledger = MV.DataMovementLedger(
+                self.query_id, self.t_origin,
+                min_event_bytes=int(conf[C.MOVEMENT_MIN_EVENT_BYTES]))
+            self.ledger.tracer = self
 
     # -- spans ---------------------------------------------------------------
     def open_span(self, name: str, cat: str,
@@ -501,7 +512,8 @@ class QueryProfile:
     def __init__(self, query_id: str, wall_start: float, wall_s: float,
                  spans: list[Span], events: list[dict],
                  plan_report: str, breakdown: dict,
-                 dropped_spans: int = 0):
+                 dropped_spans: int = 0, movement: Optional[dict] = None,
+                 movement_samples: Optional[list] = None):
         self.query_id = query_id
         self.wall_start = wall_start
         self.wall_s = wall_s
@@ -510,6 +522,13 @@ class QueryProfile:
         self.plan_report = plan_report
         self.breakdown = breakdown
         self.dropped_spans = dropped_spans
+        #: data-movement report (utils/movement.py): per-edge byte
+        #: totals + effective GB/s vs roofline; None when movement
+        #: accounting was off for this query
+        self.movement = movement
+        #: (ts_ns, edge, cumulative_bytes) samples backing the Chrome
+        #: counter tracks
+        self.movement_samples = movement_samples or []
 
     # -- construction --------------------------------------------------------
     @classmethod
@@ -521,11 +540,21 @@ class QueryProfile:
                 report = explain_with_metrics(plan)
             except Exception as e:  # noqa: BLE001 — profile assembly
                 report = f"<plan report failed: {e}>"  # must never fail
-        return cls(tr.query_id, tr.wall_start,
-                   (tr.root.dur_ns if tr.root is not None else 0) / 1e9,
+        wall_s = (tr.root.dur_ns if tr.root is not None else 0) / 1e9
+        movement = None
+        samples = None
+        if tr.ledger is not None:
+            try:
+                movement = tr.ledger.report(
+                    wall_s, float(tr.conf[C.MOVEMENT_ROOFLINE_GBPS]))
+                samples = tr.ledger.samples()
+            except Exception:  # noqa: BLE001 — same guard as the plan
+                movement = None  # report: assembly must never fail
+        return cls(tr.query_id, tr.wall_start, wall_s,
                    spans, tr.events(), report,
                    cls._breakdown(spans, tr.root),
-                   dropped_spans=tr.dropped_spans)
+                   dropped_spans=tr.dropped_spans,
+                   movement=movement, movement_samples=samples)
 
     @staticmethod
     def _breakdown(spans: list[Span], root: Optional[Span]) -> dict:
@@ -601,6 +630,12 @@ class QueryProfile:
         for tid, tname in threads.items():
             events.append({"name": "thread_name", "ph": "M", "pid": 0,
                            "tid": tid, "args": {"name": tname}})
+        # data-movement counter tracks: one cumulative-bytes counter
+        # per edge, renderable alongside the span lanes in Perfetto
+        for ts, edge, cum in self.movement_samples:
+            events.append({"name": f"movement:{edge}", "ph": "C",
+                           "ts": ts / 1e3, "pid": 0,
+                           "args": {"bytes": cum}})
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": {"query_id": self.query_id,
                               "wall_s": self.wall_s,
@@ -622,6 +657,10 @@ class QueryProfile:
         for s in self.top_spans():
             lines.append(f"  {s.dur_ns / 1e6:10.1f} ms  [{s.cat}] "
                          f"{s.name}  ({s.thread_name})")
+        if self.movement is not None:
+            from spark_rapids_tpu.utils import movement as MV
+            lines.append("-- data movement --")
+            lines.append(MV.format_report(self.movement))
         return "\n".join(lines)
 
     # -- sinks ---------------------------------------------------------------
