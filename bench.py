@@ -836,6 +836,107 @@ def bench_movement_ledger():
     }
 
 
+_TAIL_SUMMARY = [None]
+
+
+def bench_tail_latency():
+    """Tail-tolerance acceptance bench (ISSUE 9): a manager-lane
+    exchange with ONE executor delay-injected 10x slower (seeded
+    map-task straggler), run repeatedly with speculation+hedging+
+    replication OFF vs ON under the same seed.  Reports p50/p95 per
+    mode — the ON p95 must sit measurably below OFF, since the
+    straggler loses every first-wins race instead of serializing the
+    stage — plus the speculation/hedge/replication counters."""
+    import pandas as pd
+
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.exec import speculation as SPEC
+    from spark_rapids_tpu.exec.basic import LocalBatchSource
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.manager import (MapOutputRegistry,
+                                                  TpuShuffleManager)
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+    from spark_rapids_tpu.shuffle.recovery import PeerHealth
+    from spark_rapids_tpu.utils import watchdog as W
+
+    rng = np.random.default_rng(11)
+    df = pd.DataFrame({
+        "k": rng.integers(0, 500, 200_000).astype(np.int64),
+        "v": rng.integers(0, 10**6, 200_000).astype(np.int64)})
+    base = {
+        "spark.rapids.shuffle.enabled": True,
+        "spark.rapids.shuffle.localExecutors": 3,
+        "spark.rapids.sql.watchdog.pollInterval": 0.05,
+        "spark.rapids.memory.faultInjection.slowSite": "map-task",
+        "spark.rapids.memory.faultInjection.slowFactor": 10.0,
+        "spark.rapids.memory.faultInjection.slowUnitMs": 40.0,
+        "spark.rapids.memory.faultInjection.slowVictim": "local-1",
+        "spark.rapids.memory.faultInjection.slowSeed": 11,
+    }
+    tail_on = {
+        "spark.rapids.sql.speculation.enabled": True,
+        "spark.rapids.sql.speculation.minTaskRuntimeMs": 50.0,
+        "spark.rapids.sql.speculation.minCompletedTasks": 1,
+        "spark.rapids.shuffle.replication.factor": 2,
+        "spark.rapids.shuffle.hedge.enabled": True,
+        "spark.rapids.shuffle.hedge.delayMs": 60.0,
+    }
+
+    def reset():
+        MapOutputRegistry.clear()
+        PeerHealth.get().clear()
+        W.reset_slow_injection()
+        for eid in list(TpuShuffleManager._managers):
+            TpuShuffleManager._managers[eid].close()
+
+    def run_once(conf):
+        reset()
+        t0 = time.perf_counter()
+        with C.session(conf):
+            src = LocalBatchSource.from_pandas(df, num_partitions=4)
+            ex = ShuffleExchangeExec(
+                HashPartitioning([col("k")], 3), src)
+            rows = sum(b.num_rows for it in ex.execute_partitions()
+                       for b in it)
+        assert rows == len(df), rows
+        return (time.perf_counter() - t0) * 1e3, ex.metrics.as_dict()
+
+    REPS = 7
+    off_conf = C.RapidsConf(dict(base))
+    on_conf = C.RapidsConf({**base, **tail_on})
+    lat_off = [run_once(off_conf)[0] for _ in range(REPS)]
+    SPEC.reset_speculation_stats()
+    on_runs = [run_once(on_conf) for _ in range(REPS)]
+    lat_on = [t for t, _ in on_runs]
+    reset()
+    counters = {"spec_tasks": 0, "spec_wins": 0, "hedged": 0,
+                "hedged_wins": 0, "replicated_mb": 0.0}
+    for _, m in on_runs:
+        counters["spec_tasks"] += int(m.get("numSpeculativeTasks", 0))
+        counters["spec_wins"] += int(m.get("numSpeculativeWins", 0))
+        counters["hedged"] += int(m.get("numHedgedFetches", 0))
+        counters["hedged_wins"] += int(m.get("numHedgedWins", 0))
+        counters["replicated_mb"] += m.get("replicatedBytes", 0) / 1e6
+    counters["replicated_mb"] = round(counters["replicated_mb"], 2)
+    p50_off, p95_off = np.percentile(lat_off, [50, 95])
+    p50_on, p95_on = np.percentile(lat_on, [50, 95])
+    speedup = p95_off / p95_on if p95_on > 0 else 0.0
+    _TAIL_SUMMARY[0] = {"p95_speedup": round(speedup, 3),
+                        "spec_wins": counters["spec_wins"],
+                        "hedged_wins": counters["hedged_wins"]}
+    return {
+        "metric": "tail_latency_p95_speedup",
+        "value": round(speedup, 3),
+        "unit": "x",
+        # > 1.0 means the tail layer beat the injected straggler
+        "vs_baseline": round(speedup, 3),
+        "p50_off_ms": round(p50_off, 1), "p95_off_ms": round(p95_off, 1),
+        "p50_on_ms": round(p50_on, 1), "p95_on_ms": round(p95_on, 1),
+        **counters,
+    }
+
+
 def bench_profile_overhead():
     """Query-profile acceptance bench (ISSUE 5): TPC-H q1 through the
     engine with spark.rapids.sql.profile.enabled off vs on.  The
@@ -1335,6 +1436,9 @@ def main():
             # per-edge [MB, effective GB/s] from the movement-ledger
             # bench (ISSUE 8): the data-movement trajectory
             "movement_edges": _MOVEMENT_SUMMARY[0],
+            # straggler tolerance (ISSUE 9): p95 with speculation+
+            # hedging on vs off under the same injected slowdown
+            "tail": _TAIL_SUMMARY[0],
         }
         for level in (1, 2, 3):
             summary["submetrics"] = compact_at(level)
@@ -1357,7 +1461,7 @@ def main():
     for fn in (bench_groupby, bench_groupby_dict_kernel,
                bench_join_sort, bench_exchange_manager,
                bench_pipeline_overlap, bench_profile_overhead,
-               bench_movement_ledger,
+               bench_movement_ledger, bench_tail_latency,
                bench_concurrent_throughput,
                bench_udf_q27, bench_scale_join_groupby):
         try:

@@ -28,6 +28,64 @@ run_fast() {
   run_movement
   run_concurrency
   run_fusion
+  run_speculation
+}
+
+run_speculation() {
+  # tail-tolerance lane: the speculation/hedging/replication suite
+  # (first-wins races, loser cancellation, replica promotion, spill
+  # corruption, wire:wasted honesty), then an injected straggler run
+  # whose summary line carries the speculation/hedge/replication
+  # counters — the p95 trajectory's round-to-round evidence.
+  echo "== speculation lane (stragglers, hedged fetches, replication) =="
+  "${PYTEST[@]}" tests/test_speculation.py
+  python - <<'PYEOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, pandas as pd
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.exec.basic import LocalBatchSource
+from spark_rapids_tpu.exec.speculation import speculation_stats
+from spark_rapids_tpu.exprs.base import col
+from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+from spark_rapids_tpu.utils.watchdog import slow_injection_counts
+
+conf = C.RapidsConf({
+    "spark.rapids.shuffle.enabled": True,
+    "spark.rapids.shuffle.localExecutors": 3,
+    "spark.rapids.shuffle.replication.factor": 2,
+    "spark.rapids.shuffle.hedge.enabled": True,
+    "spark.rapids.shuffle.hedge.delayMs": 40.0,
+    "spark.rapids.sql.speculation.enabled": True,
+    "spark.rapids.sql.speculation.minTaskRuntimeMs": 50.0,
+    "spark.rapids.sql.speculation.minCompletedTasks": 1,
+    "spark.rapids.sql.watchdog.pollInterval": 0.05,
+    "spark.rapids.memory.faultInjection.slowSite": "map-task",
+    "spark.rapids.memory.faultInjection.slowFactor": 10.0,
+    "spark.rapids.memory.faultInjection.slowUnitMs": 40.0,
+    "spark.rapids.memory.faultInjection.slowVictim": "local-1",
+    "spark.rapids.memory.faultInjection.slowSeed": 11,
+})
+rng = np.random.default_rng(7)
+df = pd.DataFrame({"k": rng.integers(0, 50, 4000).astype(np.int64),
+                   "v": rng.integers(0, 10**6, 4000).astype(np.int64)})
+with C.session(conf):
+    src = LocalBatchSource.from_pandas(df, num_partitions=4)
+    ex = ShuffleExchangeExec(HashPartitioning([col("k")], 3), src)
+    rows = sum(b.num_rows for it in ex.execute_partitions() for b in it)
+assert rows == len(df), f"row loss under slow injection: {rows}"
+m = ex.metrics.as_dict()
+s = speculation_stats()
+print("speculation summary: rows=%d spec_tasks=%d spec_wins=%d "
+      "losers_cancelled=%d hedged=%d hedged_wins=%d replicated_mb=%.2f "
+      "slow_units=%s" % (
+          rows, m.get("numSpeculativeTasks", 0),
+          m.get("numSpeculativeWins", 0), s["losers_cancelled"],
+          m.get("numHedgedFetches", 0), m.get("numHedgedWins", 0),
+          m.get("replicatedBytes", 0) / 1e6, slow_injection_counts()))
+assert m.get("numSpeculativeWins", 0) > 0, m
+PYEOF
 }
 
 run_movement() {
@@ -361,7 +419,8 @@ case "$TIER" in
   movement) run_movement ;;
   concurrency) run_concurrency ;;
   fusion)   run_fusion ;;
+  speculation) run_speculation ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|all]" >&2
+  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|speculation|all]" >&2
      exit 2 ;;
 esac

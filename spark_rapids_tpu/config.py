@@ -201,6 +201,43 @@ HANG_INJECT_AFTER = conf(
     "TEST ONLY: the injected hang engages after this many units of "
     "progress (batches produced, chunks served, compiles started) at "
     "the configured hangSite.", internal=True)
+SLOW_INJECT_SITE = conf(
+    "spark.rapids.memory.faultInjection.slowSite", "",
+    "TEST ONLY: inject a seeded delay at the named site so the "
+    "tail-tolerance layer (speculation, hedged fetches) is testable "
+    "without a real degraded peer — the *slow* sibling of the "
+    "kill/hang/corrupt injectors.  Sites: map-task (per batch of a "
+    "manager-lane map task), shuffle-server (per served buffer).  The "
+    "delay is cancellable (a losing speculative/hedged attempt parked "
+    "in it wakes immediately on cancellation).  Empty disables.",
+    internal=True)
+SLOW_INJECT_FACTOR = conf(
+    "spark.rapids.memory.faultInjection.slowFactor", 0.0,
+    "TEST ONLY: slowdown multiplier for slowSite — each unit of work "
+    "at the site sleeps (slowFactor - 1) x slowUnitMs, so factor 10 "
+    "models a peer running 10x slower than nominal.  <= 1 disables.",
+    internal=True)
+SLOW_INJECT_SEED = conf(
+    "spark.rapids.memory.faultInjection.slowSeed", 0,
+    "TEST ONLY: seed for the slow injector's +/-25% delay jitter — "
+    "deterministic straggler schedules in soak tests.", internal=True)
+SLOW_INJECT_VICTIM = conf(
+    "spark.rapids.memory.faultInjection.slowVictim", "",
+    "TEST ONLY: executor id the slow injector targets (e.g. "
+    "'local-1'); empty slows every executor that reaches the site.",
+    internal=True)
+SLOW_INJECT_UNIT_MS = conf(
+    "spark.rapids.memory.faultInjection.slowUnitMs", 20.0,
+    "TEST ONLY: nominal per-unit work time (ms) the slowFactor "
+    "multiplies — the injected delay per batch/buffer is "
+    "(slowFactor - 1) x this.", internal=True)
+SPILL_CORRUPT_RATE = conf(
+    "spark.rapids.memory.faultInjection.spillCorruptRate", 0.0,
+    "TEST ONLY: probability that a freshly written spill file has one "
+    "payload byte flipped on disk (after the CRC frame was written), "
+    "proving the disk re-read's integrity check surfaces "
+    "SpillCorruptionError instead of deserializing garbage.  Seeded "
+    "by faultInjection.seed.  0 disables.", internal=True)
 
 # --- query profiles (utils/profile.py) ---------------------------------------
 PROFILE_ENABLED = conf(
@@ -318,6 +355,36 @@ RESULT_CACHE_MAX_BYTES = conf(
     "spark.rapids.sql.scheduler.resultCache.maxBytes", 268435456,
     "Byte bound on the result cache (LRU eviction; host memory).  A "
     "single result larger than this is never cached.")
+
+# --- speculative partition execution (exec/speculation.py) -------------------
+SPECULATION_ENABLED = conf(
+    "spark.rapids.sql.speculation.enabled", False,
+    "Launch duplicate attempts of straggling manager-lane map tasks "
+    "(spark.rapids.shuffle.enabled with localExecutors >= 2): a task "
+    "running far past its stage's completed-task median (a *slow* "
+    "watchdog classification, distinct from *hung*) is re-executed "
+    "from the exchange's retained lineage on another in-process "
+    "executor; whichever attempt commits its map output first wins "
+    "and the loser is cancelled via its per-attempt CancelToken.  "
+    "First-wins commit is epoch-guarded in the MapOutputRegistry, so "
+    "a losing attempt can never publish — results stay bit-exact.  "
+    "The p95/p99 lever for one degraded executor; speculation never "
+    "fires on a healthy stage.")
+SPECULATION_MULTIPLIER = conf(
+    "spark.rapids.sql.speculation.multiplier", 3.0,
+    "How many times slower than the stage's completed-task median a "
+    "running task must be before a speculative duplicate launches "
+    "(spark.speculation.multiplier analog).")
+SPECULATION_MIN_RUNTIME_MS = conf(
+    "spark.rapids.sql.speculation.minTaskRuntimeMs", 100.0,
+    "A task is never speculated before running at least this long — "
+    "guards against duplicating every task of a stage whose median is "
+    "microseconds.")
+SPECULATION_MIN_COMPLETED = conf(
+    "spark.rapids.sql.speculation.minCompletedTasks", 2,
+    "Completed tasks the stage needs before its median is trusted for "
+    "slow classification (spark.speculation.quantile analog: no "
+    "speculation while the baseline is unknown).")
 
 # --- whole-stage fusion (plan/fusion.py) -------------------------------------
 FUSION_ENABLED = conf(
@@ -495,6 +562,41 @@ SHUFFLE_LOCAL_EXECUTORS = conf(
     "fetches, fault injection, and recovery all exercise multi-executor "
     "behavior in one process, like the reference's mocked-transport "
     "suites.  1 (default) keeps the single local manager.")
+SHUFFLE_REPLICATION_FACTOR = conf(
+    "spark.rapids.shuffle.replication.factor", 1,
+    "Copies of each map output across in-process executors (1 = "
+    "primary only, the default).  At 2+ the CachingShuffleWriter "
+    "pushes each partition's serialized payload to factor-1 backup "
+    "executors at write time: hedged fetches "
+    "(spark.rapids.shuffle.hedge.enabled) can race a replica against "
+    "a slow primary, and shuffle recovery promotes a live replica to "
+    "primary on peer loss instead of recomputing from lineage "
+    "(recompute remains the fallback when no replica survives).  "
+    "Costs one extra serialization + host-store copy per replicated "
+    "partition (replicatedBytes on the exchange's metrics and the "
+    "movement ledger's wire:replicate site).")
+SHUFFLE_HEDGE_ENABLED = conf(
+    "spark.rapids.shuffle.hedge.enabled", False,
+    "Hedge slow shuffle fetches: when a remote fetch has not "
+    "completed after the hedge delay (hedge.delayMs floor, or the "
+    "hedge.quantile of recently observed fetch durations once enough "
+    "samples exist), issue the same block request to a replica peer "
+    "(shuffle.replication.factor >= 2) and keep the first complete, "
+    "uncorrupted response — the loser is cancelled and its buffers "
+    "freed, its wire bytes charged to the ledger's wire:wasted site.  "
+    "First-wins is bit-exact: both attempts serve identical "
+    "serialized payloads.")
+SHUFFLE_HEDGE_DELAY_MS = conf(
+    "spark.rapids.shuffle.hedge.delayMs", 1000.0,
+    "Floor (and cold-start fallback) for the hedge trigger delay: a "
+    "fetch outstanding this long fires the hedge even before enough "
+    "latency samples exist to compute the quantile.")
+SHUFFLE_HEDGE_QUANTILE = conf(
+    "spark.rapids.shuffle.hedge.quantile", 0.95,
+    "Latency quantile of recently completed fetches above which an "
+    "outstanding fetch is considered straggling and hedged (once >= 8 "
+    "samples exist; the effective delay is max(quantile latency, "
+    "hedge.delayMs)).")
 MESH_EXCHANGE_ENABLED = conf(
     "spark.rapids.shuffle.meshExchange.enabled", True,
     "Route hash shuffle exchanges through the device-mesh ICI all-to-all "

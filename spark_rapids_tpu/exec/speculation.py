@@ -1,0 +1,322 @@
+"""Speculative partition execution: duplicate attempts for stragglers.
+
+The engine survives hard faults — OOM (memory/retry.py), dead peers
+(shuffle/recovery.py), hangs (utils/watchdog.py) — but none of those
+fire on *slow*: one degraded executor stalls a whole `collect()` and,
+under the query scheduler, holds admission budget hostage for every
+queued query.  This module is the tail-latency answer, modeled on
+Spark's task speculation (spark.speculation.*) and the "Accelerating
+Presto with GPUs" framing of interactive analytics as a p95/p99
+problem:
+
+* Each manager-lane map task registers a watchdog heartbeat with a
+  **slow_check** — the scanner's new *slow* classification, distinct
+  from *hung*: a beating task whose elapsed runtime exceeds
+  `speculation.multiplier` x the stage's completed-task median (once
+  `minCompletedTasks` finished, never before `minTaskRuntimeMs`).
+* A slow task gets a **duplicate attempt** launched from the
+  exchange's retained lineage onto another in-process executor; both
+  attempts run to a **first-wins, epoch-guarded commit**
+  (`MapOutputRegistry.register(first_wins=True)` — the loser's commit
+  raises `StaleMapStatusError` and its buffers are freed, so a losing
+  attempt can never publish).  Results stay bit-exact: both attempts
+  compute identical map output from the same pure lineage.
+* The **loser is cancelled** via its per-attempt `AttemptToken`
+  (watchdog machinery): every cancellation point under the attempt —
+  batch boundaries, injected slow sleeps, backoff waits — wakes
+  immediately, the attempt aborts its writer, and the stage moves on.
+
+Disabled (`spark.rapids.sql.speculation.enabled`, default off) the
+exchange never constructs a SpeculationManager and behavior is
+byte-identical to the pre-speculation engine.
+"""
+from __future__ import annotations
+
+import logging
+import statistics
+import threading
+import time
+from typing import Callable, Optional
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.utils import metrics as M
+
+log = logging.getLogger("spark_rapids_tpu.speculation")
+
+# process-lifetime counters for CI summary lines / leak assertions
+_STATS_LOCK = threading.Lock()
+_STATS = {"launched": 0, "wins": 0, "losers_cancelled": 0}
+
+
+def speculation_stats() -> dict:
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def reset_speculation_stats() -> None:
+    with _STATS_LOCK:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def _note(key: str) -> None:
+    with _STATS_LOCK:
+        _STATS[key] += 1
+
+
+class _Task:
+    """Race state for one map task: the inline original attempt plus
+    at most one speculative duplicate."""
+
+    def __init__(self, map_id: int, t0: float, epoch0: int, mgr):
+        self.map_id = map_id
+        self.t0 = t0
+        self.epoch0 = epoch0
+        self.mgr = mgr
+        self.lock = threading.Lock()
+        self.speculated = False
+        self.settled = False
+        self.orig_token = None       # AttemptToken of the inline run
+        self.spec_token = None       # AttemptToken of the duplicate
+        self.spec_thread: Optional[threading.Thread] = None
+        self.spec_done = threading.Event()
+        self.spec_won = False
+        self.spec_error: Optional[BaseException] = None
+        self.commit_time: Optional[float] = None
+
+    def try_mark_speculated(self) -> bool:
+        with self.lock:
+            if self.speculated or self.settled:
+                return False
+            self.speculated = True
+            return True
+
+
+class SpeculationManager:
+    """Per-stage (one shuffle exchange's map side) speculation driver.
+
+    The exchange supplies three closures:
+      * ``write_fn(map_id, batch_iter, mgr, epoch, first_wins)`` —
+        split + write + COMMIT one map task onto `mgr` (the exchange's
+        write_map_task, replication included).
+      * ``lineage_fn(map_id)`` — a FRESH batch iterator for the map
+        task's input, re-derived from the exchange's retained child
+        lineage (the same closure recovery recomputes from).
+      * ``backup_fn(exclude_mgr)`` — a healthy in-process executor to
+        host the duplicate, or None when there is nowhere to run it.
+    """
+
+    def __init__(self, shuffle_id: int, conf: C.RapidsConf, metrics,
+                 write_fn: Callable, lineage_fn: Callable,
+                 backup_fn: Callable):
+        from spark_rapids_tpu.exec import scheduler as S
+        from spark_rapids_tpu.utils import profile as P
+        from spark_rapids_tpu.utils import watchdog as W
+        self.shuffle_id = shuffle_id
+        self.conf = conf
+        self.metrics = metrics
+        self.write_fn = write_fn
+        self.lineage_fn = lineage_fn
+        self.backup_fn = backup_fn
+        self.multiplier = max(1.0, float(conf[C.SPECULATION_MULTIPLIER]))
+        self.min_runtime_s = \
+            float(conf[C.SPECULATION_MIN_RUNTIME_MS]) / 1e3
+        self.min_completed = max(1, int(conf[C.SPECULATION_MIN_COMPLETED]))
+        # duplicate attempts run with pipelining off: a cancelled
+        # loser must not leave producer threads parked on queues
+        self.spec_conf = conf.set(C.PIPELINE_ENABLED.key, False)
+        self._lock = threading.Lock()
+        self._durations: list[float] = []
+        # captured on the driver thread so speculative threads carry
+        # the query's context (cancellation, conf, profile parenting)
+        self._qc = S.current()
+        self._span_ref = P.current_ref()
+        self._query_token = W.current_token()
+        self._threads: list[threading.Thread] = []
+
+    # -- stage-median bookkeeping -------------------------------------------
+    def _note_completion(self, seconds: float) -> None:
+        with self._lock:
+            self._durations.append(seconds)
+
+    def _median(self) -> Optional[float]:
+        with self._lock:
+            if len(self._durations) < self.min_completed:
+                return None
+            return statistics.median(self._durations)
+
+    # -- slow classification (runs on the watchdog scanner thread) ----------
+    def _slow_check(self, state: _Task) -> Callable:
+        def check(hb, now: float) -> None:
+            if state.settled or state.speculated:
+                return
+            med = self._median()
+            if med is None:
+                return
+            elapsed = now - state.t0
+            threshold = max(self.min_runtime_s, self.multiplier * med)
+            if elapsed < threshold:
+                return
+            if not state.try_mark_speculated():
+                return
+            backup = self.backup_fn(state.mgr)
+            if backup is None:
+                return
+            t = threading.Thread(
+                target=self._run_speculative,
+                args=(state, backup, elapsed, med), daemon=True,
+                name=f"tpu-speculate-s{self.shuffle_id}m{state.map_id}")
+            state.spec_thread = t
+            self._threads.append(t)
+            t.start()
+        return check
+
+    # -- the duplicate attempt ----------------------------------------------
+    def _run_speculative(self, state: _Task, backup, elapsed: float,
+                         median: float) -> None:
+        from spark_rapids_tpu.exec import scheduler as S
+        from spark_rapids_tpu.shuffle.manager import StaleMapStatusError
+        from spark_rapids_tpu.utils import profile as P
+        from spark_rapids_tpu.utils import watchdog as W
+        stok = W.AttemptToken(parent=self._query_token)
+        state.spec_token = stok
+        self.metrics.add(M.NUM_SPECULATIVE_TASKS, 1)
+        _note("launched")
+        P.event("speculation_launched", shuffle_id=self.shuffle_id,
+                map_id=state.map_id, backup=backup.executor_id,
+                elapsed_ms=round(elapsed * 1e3, 1),
+                stage_median_ms=round(median * 1e3, 1))
+        try:
+            with S.scoped(self._qc), C.session(self.spec_conf), \
+                    P.attach(self._span_ref), W.attempt_scope(stok):
+                it = self.lineage_fn(state.map_id)
+                try:
+                    self.write_fn(state.map_id, it, backup,
+                                  state.epoch0, True)
+                finally:
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:  # noqa: BLE001
+                            pass
+            state.spec_won = True
+            state.commit_time = time.monotonic()
+            self.metrics.add(M.NUM_SPECULATIVE_WINS, 1)
+            _note("wins")
+            P.event("speculation_win", shuffle_id=self.shuffle_id,
+                    map_id=state.map_id, backup=backup.executor_id)
+            if state.orig_token is not None:
+                state.orig_token.cancel_race_lost(
+                    f"speculation: duplicate attempt on "
+                    f"{backup.executor_id} committed first")
+        except StaleMapStatusError:
+            # the original committed first: this attempt lost at the
+            # registry and its writer already freed its buffers
+            pass
+        except W.TpuQueryTimeout:
+            if not stok.race_lost:
+                # whole-query cancellation: the original attempt (or
+                # collect) raises it; nothing to add here
+                log.debug("speculative attempt for map %d cancelled "
+                          "with the query", state.map_id)
+        except BaseException as e:  # noqa: BLE001 — the original is
+            state.spec_error = e    # the safety net; never fail the
+            log.warning("speculative attempt for shuffle %d map %d "
+                        "failed (original continues): %s",
+                        self.shuffle_id, state.map_id, e)
+        finally:
+            state.spec_done.set()
+
+    # -- the inline original attempt ----------------------------------------
+    def run_task(self, map_id: int, batch_iter, mgr) -> None:
+        """Run one map task with speculation armed: the inline attempt
+        executes on the calling thread; the watchdog may race a
+        duplicate against it.  Returns once the map output is
+        committed (by either attempt) and both attempts are settled."""
+        from spark_rapids_tpu.shuffle.manager import (
+            MapOutputRegistry, StaleMapStatusError)
+        from spark_rapids_tpu.utils import watchdog as W
+        t0 = time.monotonic()
+        epoch0 = MapOutputRegistry.epoch(self.shuffle_id)
+        state = _Task(map_id, t0, epoch0, mgr)
+        otok = W.AttemptToken(parent=self._query_token)
+        state.orig_token = otok
+        hb = W.heartbeat(f"map-task:s{self.shuffle_id}m{map_id}",
+                         kind="task", conf=self.conf,
+                         slow_check=self._slow_check(state))
+        orig_error: Optional[BaseException] = None
+        won = False
+        try:
+            try:
+                with W.attempt_scope(otok):
+                    self.write_fn(map_id, batch_iter, mgr, epoch0, True)
+                won = True
+                state.commit_time = state.commit_time or time.monotonic()
+            except StaleMapStatusError:
+                pass  # the duplicate committed first — clean loss
+            except W.TpuQueryTimeout:
+                if otok.race_lost:
+                    # cancelled loser: drop the half-consumed input so
+                    # its pipeline producer (if any) unparks and exits
+                    _note("losers_cancelled")
+                    close = getattr(batch_iter, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:  # noqa: BLE001
+                            pass
+                else:
+                    raise
+            except BaseException as e:  # noqa: BLE001
+                orig_error = e
+        finally:
+            with state.lock:
+                state.settled = True
+            hb.close()
+        # settle the race
+        if won and state.spec_token is not None:
+            state.spec_token.cancel_race_lost(
+                "speculation: original attempt committed first")
+        if state.spec_thread is not None:
+            # prompt: every wait under the attempt is cancellable
+            state.spec_done.wait(timeout=60.0)
+            state.spec_thread.join(timeout=10.0)
+        if not won and not state.spec_won:
+            # nobody published: surface the original's failure (or the
+            # speculative one as a last resort)
+            err = orig_error or state.spec_error
+            if err is not None:
+                raise err
+            raise RuntimeError(
+                f"map task {self.shuffle_id}/{map_id}: no attempt "
+                f"committed and no error was recorded")
+        if orig_error is not None and state.spec_won:
+            log.warning("original attempt for shuffle %d map %d failed "
+                        "but its speculative duplicate won: %s",
+                        self.shuffle_id, map_id, orig_error)
+        end = state.commit_time or time.monotonic()
+        self._note_completion(end - t0)
+
+    def finish(self) -> None:
+        """Join any stray speculative threads (all are settled by
+        run_task; this is belt-and-braces for error paths)."""
+        for t in self._threads:
+            t.join(timeout=10.0)
+
+
+def maybe_create(shuffle_id: int, conf: C.RapidsConf, metrics,
+                 write_fn: Callable, lineage_fn: Callable,
+                 backup_fn: Callable,
+                 num_executors: int) -> Optional[SpeculationManager]:
+    """A SpeculationManager when speculation is on and there is more
+    than one in-process executor to speculate onto; else None (the
+    exchange keeps its plain sequential loop — byte-identical
+    behavior)."""
+    from spark_rapids_tpu.utils import watchdog as W
+    if not conf[C.SPECULATION_ENABLED] or num_executors < 2:
+        return None
+    if not W.enabled(conf):
+        return None  # slow classification rides the watchdog scanner
+    return SpeculationManager(shuffle_id, conf, metrics, write_fn,
+                              lineage_fn, backup_fn)

@@ -22,7 +22,65 @@ from spark_rapids_tpu.columnar.serde import deserialize_batch, serialize_batch
 from spark_rapids_tpu.memory.buffer import (
     BufferId, SpillableBuffer, StorageTier, TableMeta)
 from spark_rapids_tpu.memory.native import (
-    AddressSpaceAllocator, HashedPriorityQueue, HostArena)
+    AddressSpaceAllocator, HashedPriorityQueue, HostArena,
+    SpillCorruptionError)
+
+#: the descriptive integrity failure a corrupted spill file surfaces on
+#: re-read (instead of deserializing garbage) — re-exported here since
+#: the write/verify sites live in this module's disk tier
+SpillCorruption = SpillCorruptionError
+
+
+# ---------------------------------------------------------------------------
+# seeded spill-corruption injection: flips one payload byte in a
+# freshly written spill file (AFTER the CRC frame landed, like real
+# disk rot), proving the CRC-verified re-read raises SpillCorruption
+# rather than handing a poisoned batch downstream.  Keyed per
+# (rate, seed) like the OOM injectors, so concurrent queries with
+# different injection confs drive independent deterministic streams.
+import threading as _threading
+
+_SPILL_INJ_LOCK = _threading.Lock()
+_SPILL_INJ_RNGS: dict = {}
+_SPILL_INJ_COUNT = [0]
+#: spill-file frame header: magic(4) + version(4) + len(8) + crc(4) —
+#: the flipped byte must land in the payload, not the header, so the
+#: CRC check (not a magic/length check) is what catches it
+_SPILL_FRAME_HEADER = 20
+
+
+def reset_spill_corruption() -> None:
+    with _SPILL_INJ_LOCK:
+        _SPILL_INJ_RNGS.clear()
+        _SPILL_INJ_COUNT[0] = 0
+
+
+def injected_spill_corruptions() -> int:
+    with _SPILL_INJ_LOCK:
+        return _SPILL_INJ_COUNT[0]
+
+
+def _maybe_corrupt_spill_file(path: str, payload_len: int) -> None:
+    from spark_rapids_tpu import config as C
+    import random
+    conf = C.get_active_conf()
+    rate = float(conf[C.SPILL_CORRUPT_RATE])
+    if rate <= 0 or payload_len <= 0:
+        return
+    seed = int(conf[C.OOM_INJECT_SEED])
+    with _SPILL_INJ_LOCK:
+        rng = _SPILL_INJ_RNGS.get((rate, seed))
+        if rng is None:
+            rng = _SPILL_INJ_RNGS[(rate, seed)] = random.Random(seed)
+        if rng.random() >= rate:
+            return
+        offset = _SPILL_FRAME_HEADER + rng.randrange(payload_len)
+        _SPILL_INJ_COUNT[0] += 1
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
 
 
 class BufferStore:
@@ -371,6 +429,10 @@ class DiskStore(BufferStore):
         # CRC-framed + fsync'd (native runtime.cpp; the role the JVM's
         # checksummed spill writers play in the reference stack)
         spill_write(path, blob)
+        # seeded integrity-failure injection (device->disk and
+        # host->disk both land here): the re-read must surface
+        # SpillCorruption, never a garbage batch
+        _maybe_corrupt_spill_file(path, len(blob))
         db = DiskBuffer(bid, path, len(blob), meta, spill_priority)
         self._track(db)
         return db

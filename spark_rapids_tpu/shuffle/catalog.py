@@ -68,6 +68,25 @@ class ShuffleBufferCatalog:
         with self.catalog.acquired(bid) as buf:
             return buf.meta
 
+    def remove_buffers(self, bids: list[BufferId]) -> None:
+        """Remove EXACTLY these buffers (a failed/losing attempt's own
+        writes).  Attempt-scoped, unlike `remove_task_buffers`: with
+        speculation or replication two attempts' buffers can share one
+        (map_id, partition) slot in this catalog, and a loser's cleanup
+        must never free the winner's data."""
+        with self._lock:
+            for bid in bids:
+                blocks = self._blocks.get(bid.shuffle_id, {})
+                lst = blocks.get((bid.map_id, bid.partition))
+                if lst is not None and bid in lst:
+                    lst.remove(bid)
+                    if not lst:
+                        del blocks[(bid.map_id, bid.partition)]
+                self._by_table.pop(bid.table_id, None)
+        for bid in bids:
+            if self.catalog.is_registered(bid):
+                self.catalog.remove(bid)
+
     def remove_task_buffers(self, shuffle_id: int, map_id: int) -> None:
         """Failed-task cleanup (reference RapidsCachingWriter cleanup)."""
         with self._lock:
@@ -107,6 +126,14 @@ class ShuffleReceivedBufferCatalog:
 
     def new_buffer_id(self) -> BufferId:
         return BufferId(self.catalog.next_table_id())
+
+    def take_task(self, task_attempt_id: int) -> list[BufferId]:
+        """Detach a task attempt's received buffers WITHOUT freeing
+        them (hedged-fetch winner adoption: the staging attempt's
+        buffers are re-registered under the consuming reader's attempt
+        id, whose release_task then owns their cleanup)."""
+        with self._lock:
+            return self._received.pop(task_attempt_id, [])
 
     def release_task(self, task_attempt_id: int) -> None:
         with self._lock:

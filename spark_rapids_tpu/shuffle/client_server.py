@@ -80,6 +80,45 @@ def inflight_fetches() -> list[dict]:
     return snaps
 
 
+# ---------------------------------------------------------------------------
+# fetch-latency tracking for hedged reads: completed fetch durations
+# feed the hedge trigger's delay quantile, so "straggling" is judged
+# against what fetches in THIS process actually cost, with
+# shuffle.hedge.delayMs as the floor and the cold-start fallback
+_LATENCY_LOCK = threading.Lock()
+_LATENCY_SAMPLES: "list[float]" = []
+_LATENCY_MAX_SAMPLES = 256
+_HEDGE_MIN_SAMPLES = 8
+
+
+def note_fetch_duration(seconds: float) -> None:
+    with _LATENCY_LOCK:
+        _LATENCY_SAMPLES.append(float(seconds))
+        if len(_LATENCY_SAMPLES) > _LATENCY_MAX_SAMPLES:
+            del _LATENCY_SAMPLES[:len(_LATENCY_SAMPLES)
+                                 - _LATENCY_MAX_SAMPLES]
+
+
+def reset_fetch_latency() -> None:
+    with _LATENCY_LOCK:
+        _LATENCY_SAMPLES.clear()
+
+
+def hedge_delay_s(conf: Optional[C.RapidsConf] = None) -> float:
+    """How long a fetch may be outstanding before a hedge fires:
+    max(hedge.delayMs, the hedge.quantile of recent fetch durations)
+    once enough samples exist, else the delayMs floor alone."""
+    conf = conf or C.get_active_conf()
+    floor = float(conf[C.SHUFFLE_HEDGE_DELAY_MS]) / 1e3
+    q = min(1.0, max(0.0, float(conf[C.SHUFFLE_HEDGE_QUANTILE])))
+    with _LATENCY_LOCK:
+        if len(_LATENCY_SAMPLES) < _HEDGE_MIN_SAMPLES:
+            return floor
+        ordered = sorted(_LATENCY_SAMPLES)
+    idx = min(len(ordered) - 1, int(q * len(ordered)))
+    return max(floor, ordered[idx])
+
+
 class ShuffleReceiveHandler:
     """Callback surface the iterator implements (reference
     RapidsShuffleFetchHandler): batchReceived / transferError."""
@@ -94,6 +133,13 @@ class ShuffleReceiveHandler:
         """One assembled wire payload landed: its on-the-wire
         (compressed) and uncompressed sizes, so readers can charge
         per-exchange compression metrics."""
+        ...
+
+    def corruption_detected(self) -> None:
+        """A DATA frame failed its CRC and the transfer will retry —
+        surfaced so the exchange can meter wire damage
+        (numWireCorruptions) instead of it hiding inside the retry
+        path."""
         ...
 
     def transfer_error(self, message: str) -> None:
@@ -217,9 +263,14 @@ class ShuffleClient:
                          kind="task", conf=self.conf) as hb, \
                 P.span(f"shuffle-fetch:{self.address}",
                        cat=P.CAT_SHUFFLE):
+            t0 = time.monotonic()
             try:
-                return self._fetch_blocks(blocks, task_attempt_id,
-                                          handler, hb, fid)
+                out = self._fetch_blocks(blocks, task_attempt_id,
+                                         handler, hb, fid)
+                # completed fetches feed the hedge trigger's latency
+                # quantile (hedge_delay_s)
+                note_fetch_duration(time.monotonic() - t0)
+                return out
             finally:
                 with _INFLIGHT_LOCK:
                     _INFLIGHT.pop(fid, None)
@@ -272,6 +323,14 @@ class ShuffleClient:
                 budget_taken.append(m)
             txn = self.connection.fetch(batch_ids, state.on_chunk)
             if txn.status != TransactionStatus.SUCCESS:
+                if txn.corrupt:
+                    # detected wire damage is first-class: metered on
+                    # the exchange (numWireCorruptions) and correlated
+                    # in the event log, not buried in the retry path
+                    handler.corruption_detected()
+                    from spark_rapids_tpu.utils import profile as _P
+                    _P.event("wire_corruption", address=self.address,
+                             error=str(txn.error)[:200])
                 # return the budget of buffers that did not complete
                 for m in budget_taken:
                     if m.table_id not in state.completed:
@@ -322,12 +381,16 @@ class ShuffleServer:
     acquired from whatever tier they live in (device or spilled)."""
 
     def __init__(self, shuffle_catalog: ShuffleBufferCatalog,
-                 transport: ShuffleTransport, codec=None):
+                 transport: ShuffleTransport, codec=None,
+                 executor_id: Optional[str] = None):
         self.shuffle_catalog = shuffle_catalog
         self.transport = transport
         # payload codec for the wire (reference TableCompressionCodec;
         # conf spark.rapids.shuffle.compression.codec)
         self.codec = codec
+        #: owning executor, so the seeded slow-peer injector can
+        #: target ONE server (faultInjection.slowVictim)
+        self.executor_id = executor_id
 
     def handle_metadata_request(self, blocks: Sequence[BlockIdMsg]
                                 ) -> list[TableMetaMsg]:
@@ -412,6 +475,13 @@ class ShuffleServer:
                               raw_bytes=raw_len,
                               dur_ns=time.perf_counter_ns() - t0,
                               codec=codec.name if codec else "none")
+                    # seeded slow-peer injection: a degraded server
+                    # serves each buffer slowFactor x slower.  After
+                    # the buffer so a hedged winner can land staged
+                    # partial results; cancellable, so a losing hedge
+                    # parked here wakes on its AttemptToken.
+                    W.maybe_slow("shuffle-server", conf=wconf,
+                                 executor_id=self.executor_id)
         except Exception as e:  # noqa: BLE001 — surface as transaction
             return Transaction(TransactionStatus.ERROR, str(e), total)
         return Transaction(TransactionStatus.SUCCESS,

@@ -465,8 +465,7 @@ class ShuffleExchangeExec(UnaryExecBase):
         n_execs = max(1, int(conf[C.SHUFFLE_LOCAL_EXECUTORS]))
         names = (["local"] if n_execs == 1
                  else [f"local-{i}" for i in range(n_execs)])
-        mgrs = [TpuShuffleManager.get(nm) or TpuShuffleManager(nm)
-                for nm in names]
+        mgrs = [TpuShuffleManager.get_or_create(nm) for nm in names]
         primary = mgrs[0]
         health = PeerHealth.get()
         shuffle_id = next(ShuffleExchangeExec._SHUFFLE_IDS)
@@ -487,15 +486,40 @@ class ShuffleExchangeExec(UnaryExecBase):
                                         metrics=self.metrics)
                          for it in self.child.execute_partitions()]
         n = part.num_partitions
+        repl_factor = max(1, int(conf[C.SHUFFLE_REPLICATION_FACTOR]))
 
-        def write_map_task(map_id, batch_iter, mgr, epoch=None):
-            writer = mgr.get_writer(shuffle_id, map_id)
+        def healthy_mgrs():
+            ok = [m for m in mgrs
+                  if not any(health.is_blacklisted(a) for a in
+                             (m.loop_address, m.tcp_address) if a)]
+            return ok or [primary]
+
+        def replicas_for(mgr):
+            """factor-1 backup executors for a map task hosted on
+            `mgr`: the next healthy peers in ring order."""
+            if repl_factor < 2:
+                return ()
+            pool_ = [m for m in healthy_mgrs() if m is not mgr]
+            return tuple(pool_[:repl_factor - 1])
+
+        def write_map_task(map_id, batch_iter, mgr, epoch=None,
+                           first_wins=False):
+            from spark_rapids_tpu.utils import watchdog as W
+            writer = mgr.get_writer(shuffle_id, map_id,
+                                    replicas=replicas_for(mgr))
             sp = P.span(f"shuffle-map:s{shuffle_id}m{map_id}",
                         cat=P.CAT_SHUFFLE) \
                 if P.tracer() is not None else P._NULL_SPAN
             try:
                 with sp:
                     for batch in batch_iter:
+                        # batch boundary = cancellation point: a losing
+                        # speculative attempt stops here, promptly
+                        W.check_cancelled()
+                        # seeded slow-task injection (the straggler
+                        # model speculation must beat)
+                        W.maybe_slow("map-task", conf=conf,
+                                     executor_id=mgr.executor_id)
                         if batch.num_rows == 0:
                             continue
                         with self.metrics.timed(M.TOTAL_TIME):
@@ -508,18 +532,32 @@ class ShuffleExchangeExec(UnaryExecBase):
             except BaseException:
                 writer.abort()
                 raise
-            writer.commit(n, epoch=epoch)
+            writer.commit(n, epoch=epoch, first_wins=first_wins)
+            if writer.replicated_bytes:
+                self.metrics.add(M.REPLICATED_BYTES,
+                                 writer.replicated_bytes)
 
-        def healthy_mgrs():
-            ok = [m for m in mgrs
-                  if not any(health.is_blacklisted(a) for a in
-                             (m.loop_address, m.tcp_address) if a)]
-            return ok or [primary]
+        def lineage(map_id):
+            # retained map-side lineage (shared with recovery): a
+            # FRESH run of exactly this child partition
+            return self.child.execute_partitions()[map_id]
 
+        def backup_for(exclude_mgr):
+            ok = [m for m in healthy_mgrs() if m is not exclude_mgr]
+            return ok[0] if ok else None
+
+        from spark_rapids_tpu.exec import speculation as SPEC
+        spec = SPEC.maybe_create(
+            shuffle_id, conf, self.metrics, write_map_task, lineage,
+            backup_for, num_executors=len(mgrs))
         try:
             pool = healthy_mgrs()
             for map_id, it in enumerate(map_iters):
-                write_map_task(map_id, it, pool[map_id % len(pool)])
+                mgr = pool[map_id % len(pool)]
+                if spec is not None:
+                    spec.run_task(map_id, it, mgr)
+                else:
+                    write_map_task(map_id, it, mgr)
             # arm the partial-read guard: a reduce over fewer outputs
             # than this must FetchFail, never return partial data
             MapOutputRegistry.set_expected_maps(shuffle_id,
@@ -530,6 +568,9 @@ class ShuffleExchangeExec(UnaryExecBase):
             for m in mgrs:
                 m.unregister_shuffle(shuffle_id)
             raise
+        finally:
+            if spec is not None:
+                spec.finish()
 
         driver = None
         if conf[C.SHUFFLE_RECOVERY_ENABLED]:

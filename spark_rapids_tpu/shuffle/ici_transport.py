@@ -356,7 +356,8 @@ class TcpConnection(Connection):
                         return Transaction(TransactionStatus.ERROR,
                                            f"unexpected frame {kind}")
             except WireCorruption as e:
-                return Transaction(TransactionStatus.ERROR, str(e))
+                return Transaction(TransactionStatus.ERROR, str(e),
+                                   corrupt=True)
             except OSError as e:
                 return Transaction(TransactionStatus.ERROR, str(e))
 

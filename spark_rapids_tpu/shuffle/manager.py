@@ -46,11 +46,18 @@ class MapStatus:
 
     def __init__(self, executor_id: str, address: str,
                  partition_sizes: list[int],
-                 tcp_address: str | None = None):
+                 tcp_address: str | None = None,
+                 replicas: Optional[list[tuple]] = None):
         self.executor_id = executor_id
         self.address = address
         self.partition_sizes = partition_sizes
         self.tcp_address = tcp_address
+        #: backup executors holding a serialized copy of this map
+        #: output (spark.rapids.shuffle.replication.factor >= 2):
+        #: [(executor_id, loop_address, tcp_address), ...].  Hedged
+        #: fetches race a replica against a slow primary; recovery
+        #: promotes one to primary on peer loss instead of recomputing.
+        self.replicas = list(replicas or [])
         #: registry epoch this status was registered under (stamped by
         #: MapOutputRegistry.register; stale re-registrations from a
         #: superseded map run are rejected)
@@ -58,6 +65,19 @@ class MapStatus:
 
     def addresses(self) -> list[str]:
         return [a for a in (self.address, self.tcp_address) if a]
+
+    def hedge_address(self, transport, health=None) -> Optional[str]:
+        """A usable replica address to hedge a slow primary fetch
+        against: reachable on this transport and not blacklisted, or
+        None when no replica qualifies."""
+        for _eid, addr, tcp in self.replicas:
+            for a in (addr, tcp):
+                if not a or not transport.can_reach(a):
+                    continue
+                if health is not None and health.is_blacklisted(a):
+                    continue
+                return a
+        return None
 
     def reachable_address(self, transport, health=None) -> str:
         """Pick the lane to fetch from: loopback when it resolves in
@@ -95,7 +115,13 @@ class MapOutputRegistry:
 
     @classmethod
     def register(cls, shuffle_id: int, map_id: int,
-                 status: MapStatus, epoch: Optional[int] = None) -> None:
+                 status: MapStatus, epoch: Optional[int] = None,
+                 first_wins: bool = False) -> None:
+        """`first_wins` (speculative attempts) makes the registration
+        atomic-or-reject: if the map output is already committed at the
+        current epoch, the caller LOST the race and must not publish —
+        the same StaleMapStatusError contract recovery's epoch guard
+        uses, so a losing attempt frees its buffers and stands down."""
         with cls._lock:
             cur = cls._epochs.get(shuffle_id, 0)
             if epoch is not None and epoch != cur:
@@ -104,8 +130,16 @@ class MapOutputRegistry:
                     f"epoch {epoch} but the shuffle is at epoch {cur}: "
                     f"the producing map run was superseded by a "
                     f"recovery invalidation")
+            outs = cls._outputs.setdefault(shuffle_id, {})
+            if first_wins and map_id in outs:
+                err = StaleMapStatusError(
+                    f"map output {shuffle_id}/{map_id} was already "
+                    f"committed by a faster attempt (first-wins "
+                    f"speculation): this attempt lost the race")
+                err.race_lost = True
+                raise err
             status.epoch = cur
-            cls._outputs.setdefault(shuffle_id, {})[map_id] = status
+            outs[map_id] = status
 
     @classmethod
     def outputs_for(cls, shuffle_id: int) -> dict[int, MapStatus]:
@@ -190,7 +224,9 @@ class TpuShuffleManager:
     """Executor-side shuffle environment (reference GpuShuffleEnv +
     RapidsShuffleInternalManagerBase)."""
 
-    _registry_lock = threading.Lock()
+    # RLock: get_or_create constructs under the lock and the
+    # constructor re-acquires it to register itself
+    _registry_lock = threading.RLock()
     _managers: dict[str, "TpuShuffleManager"] = {}
 
     def __init__(self, executor_id: str,
@@ -205,7 +241,8 @@ class TpuShuffleManager:
         self.transport = make_transport(self.conf)
         from spark_rapids_tpu.shuffle.compression import codec_from_conf
         self.server = ShuffleServer(self.shuffle_catalog, self.transport,
-                                    codec=codec_from_conf(self.conf))
+                                    codec=codec_from_conf(self.conf),
+                                    executor_id=executor_id)
         handle = self.transport.make_server(executor_id, self.server)
         self.loop_address = handle.loop_address
         self.tcp_address = handle.tcp_address
@@ -217,6 +254,24 @@ class TpuShuffleManager:
     def get(cls, executor_id: str) -> Optional["TpuShuffleManager"]:
         with cls._registry_lock:
             return cls._managers.get(executor_id)
+
+    @classmethod
+    def get_or_create(cls, executor_id: str,
+                      env: Optional[ResourceEnv] = None,
+                      conf: Optional[C.RapidsConf] = None
+                      ) -> "TpuShuffleManager":
+        """ATOMIC get-or-create.  The old `get(id) or Manager(id)`
+        idiom raced under concurrent queries: two threads both
+        constructed 'local-1', the second's server silently replaced
+        the first's loopback registration, and every map output the
+        first query had registered resolved to a server whose catalog
+        never saw that shuffle — which answered fetches with ZERO
+        tables, a clean-looking empty read, i.e. silent partial data."""
+        with cls._registry_lock:
+            m = cls._managers.get(executor_id)
+            if m is None:
+                m = TpuShuffleManager(executor_id, env, conf)
+            return m
 
     def close(self) -> None:
         self.transport.shutdown()
@@ -231,9 +286,11 @@ class TpuShuffleManager:
         MapOutputRegistry.unregister_shuffle(shuffle_id)
 
     # -- write side ----------------------------------------------------------
-    def get_writer(self, shuffle_id: int, map_id: int
+    def get_writer(self, shuffle_id: int, map_id: int,
+                   replicas: Sequence["TpuShuffleManager"] = ()
                    ) -> "CachingShuffleWriter":
-        return CachingShuffleWriter(self, shuffle_id, map_id)
+        return CachingShuffleWriter(self, shuffle_id, map_id,
+                                    replicas=replicas)
 
     # -- read side -----------------------------------------------------------
     _attempt_ids = itertools.count(1)
@@ -265,53 +322,113 @@ class TpuShuffleManager:
 class CachingShuffleWriter:
     """Stores each partition's batch in the device store via the shuffle
     catalog; degenerate (rows-only) batches store metadata alone
-    (reference RapidsCachingWriter.write :74-191)."""
+    (reference RapidsCachingWriter.write :74-191).
+
+    With `replicas` (spark.rapids.shuffle.replication.factor >= 2) each
+    partition's serialized payload is additionally pushed into every
+    replica executor's catalog at write time — the MapStatus advertises
+    them, so hedged fetches can race a replica against a slow primary
+    and recovery can promote one on peer loss without recompute.
+    Cleanup is attempt-scoped (exact buffer ids), so a losing
+    speculative attempt's abort can never free a winner's buffers that
+    share the same (map_id, partition) slot."""
 
     def __init__(self, manager: TpuShuffleManager, shuffle_id: int,
-                 map_id: int):
+                 map_id: int,
+                 replicas: Sequence[TpuShuffleManager] = ()):
         self.manager = manager
         self.shuffle_id = shuffle_id
         self.map_id = map_id
+        self.replicas = [r for r in replicas if r is not manager]
         self._sizes: dict[int, int] = {}
+        #: every buffer this writer minted, per owning shuffle catalog
+        #: (primary + replicas) — abort removes exactly these
+        self._written: list[tuple] = []
+        self.replicated_bytes = 0
 
     def write_partition(self, partition: int, batch: ColumnarBatch) -> None:
         cat = self.manager.shuffle_catalog
         bid = cat.next_shuffle_buffer_id(self.shuffle_id, self.map_id,
                                          partition)
+        self._written.append((cat, bid))
         if batch.num_columns == 0:
-            buf = DegenerateBuffer(
-                bid, degenerate_meta(batch.schema, batch.num_rows))
-            cat.catalog.register(buf)
+            meta = degenerate_meta(batch.schema, batch.num_rows)
+            cat.catalog.register(DegenerateBuffer(bid, meta))
             self._sizes[partition] = 0
+            for r in self.replicas:
+                rbid = r.shuffle_catalog.next_shuffle_buffer_id(
+                    self.shuffle_id, self.map_id, partition)
+                r.shuffle_catalog.catalog.register(
+                    DegenerateBuffer(rbid, meta))
+                self._written.append((r.shuffle_catalog, rbid))
             return
         buf = self.manager.env.device_store.add_batch(
             bid, batch, OUTPUT_FOR_SHUFFLE_INITIAL_PRIORITY)
         self._sizes[partition] = self._sizes.get(partition, 0) + \
             buf.size_bytes
+        if self.replicas:
+            self._replicate(partition, batch)
+
+    def _replicate(self, partition: int, batch: ColumnarBatch) -> None:
+        """Push one partition slice's serialized payload to every
+        replica executor's host store (serialized once, shared)."""
+        from spark_rapids_tpu.columnar.serde import serialize_batch
+        from spark_rapids_tpu.memory.buffer import meta_for_batch
+        from spark_rapids_tpu.utils import movement as MV
+        blob = serialize_batch(batch)
+        meta = meta_for_batch(batch)
+        for r in self.replicas:
+            rbid = r.shuffle_catalog.next_shuffle_buffer_id(
+                self.shuffle_id, self.map_id, partition)
+            r.env.host_store.add_blob(rbid, blob, meta)
+            self._written.append((r.shuffle_catalog, rbid))
+            self.replicated_bytes += len(blob)
+        if MV.ledger() is not None:
+            MV.record(MV.EDGE_WIRE, len(blob) * len(self.replicas),
+                      site="replicate")
 
     def commit(self, num_partitions: int,
-               epoch: Optional[int] = None) -> MapStatus:
+               epoch: Optional[int] = None,
+               first_wins: bool = False) -> MapStatus:
         """Register the map output.  `epoch` (recovery recomputes only)
         pins the registration to the registry epoch the recompute was
         planned under: if another invalidation raced in, the commit is
         rejected (StaleMapStatusError) and the written buffers freed —
-        a superseded map run must never serve reducers."""
+        a superseded map run must never serve reducers.  `first_wins`
+        (speculative attempts) additionally rejects the commit when a
+        sibling attempt already published this map output."""
         status = MapStatus(
             self.manager.executor_id, self.manager.loop_address,
             [self._sizes.get(p, 0) for p in range(num_partitions)],
-            tcp_address=self.manager.tcp_address)
+            tcp_address=self.manager.tcp_address,
+            replicas=[(r.executor_id, r.loop_address, r.tcp_address)
+                      for r in self.replicas])
         try:
             MapOutputRegistry.register(self.shuffle_id, self.map_id,
-                                       status, epoch=epoch)
-        except StaleMapStatusError:
+                                       status, epoch=epoch,
+                                       first_wins=first_wins)
+        except StaleMapStatusError as e:
             self.abort()
+            if not getattr(e, "race_lost", False):
+                # epoch-stale (superseded by a recovery invalidation):
+                # also sweep the invalidated OLDER run's buffers for
+                # this map task, which nothing else will free until
+                # unregister.  A first-wins race loss must NOT sweep —
+                # the winning sibling's buffers share this slot.
+                self.manager.shuffle_catalog.remove_task_buffers(
+                    self.shuffle_id, self.map_id)
             raise
         return status
 
     def abort(self) -> None:
-        """Failed-task cleanup (reference :159-167)."""
-        self.manager.shuffle_catalog.remove_task_buffers(
-            self.shuffle_id, self.map_id)
+        """Failed-task cleanup (reference :159-167): frees exactly the
+        buffers THIS writer minted, across primary + replica catalogs."""
+        by_cat: dict[int, tuple] = {}
+        for cat, bid in self._written:
+            by_cat.setdefault(id(cat), (cat, []))[1].append(bid)
+        for cat, bids in by_cat.values():
+            cat.remove_buffers(bids)
+        self._written.clear()
 
 
 class _IteratorHandler(ShuffleReceiveHandler):
@@ -322,8 +439,9 @@ class _IteratorHandler(ShuffleReceiveHandler):
         #: is currently draining, so errors carry the REAL peer (the
         #: old literal "remote" hid which executor to invalidate)
         self.current = current
-        #: {"compressed": n, "raw": n} accumulator the owning reader
-        #: charges to the exchange's compression metrics
+        #: {"compressed": n, "raw": n, "corruptions": n} accumulator
+        #: the owning reader charges to the exchange's compression /
+        #: wire-integrity metrics
         self.wire_stats = wire_stats
         self.expected = 0
 
@@ -338,8 +456,42 @@ class _IteratorHandler(ShuffleReceiveHandler):
             self.wire_stats["compressed"] += wire_bytes
             self.wire_stats["raw"] += raw_bytes
 
+    def corruption_detected(self) -> None:
+        if self.wire_stats is not None:
+            self.wire_stats["corruptions"] = \
+                self.wire_stats.get("corruptions", 0) + 1
+
     def transfer_error(self, message: str) -> None:
         self.q.put(("error", (self.current.get("addr"), message)))
+
+
+class _StagingHandler(ShuffleReceiveHandler):
+    """Buffers one hedged attempt's results instead of streaming them:
+    first-wins hedging must deliver EITHER the primary's batches OR the
+    replica's, never an interleaving, so each attempt stages until it
+    completes and only the winner's buffers reach the real handler."""
+
+    def __init__(self):
+        self.bids: list[BufferId] = []
+        self.wire = 0
+        self.raw = 0
+        self.corruptions = 0
+
+    def start(self, expected_batches: int) -> None:
+        pass
+
+    def batch_received(self, bid: BufferId) -> None:
+        self.bids.append(bid)
+
+    def buffer_received(self, wire_bytes: int, raw_bytes: int) -> None:
+        self.wire += wire_bytes
+        self.raw += raw_bytes
+
+    def corruption_detected(self) -> None:
+        self.corruptions += 1
+
+    def transfer_error(self, message: str) -> None:
+        pass  # the attempt's exception carries the failure
 
 
 class CachingShuffleReader:
@@ -357,8 +509,9 @@ class CachingShuffleReader:
         self.timeout = timeout
         self.metrics = metrics
         #: wire bytes this reader's remote fetches pulled, compressed
-        #: vs uncompressed — charged to the exchange on read completion
-        self.wire_stats = {"compressed": 0, "raw": 0}
+        #: vs uncompressed, plus detected wire corruptions — charged to
+        #: the exchange on read completion
+        self.wire_stats = {"compressed": 0, "raw": 0, "corruptions": 0}
         # captured here (the consuming task's thread, session conf
         # installed) because the fetch worker is a raw thread with no
         # conf propagation
@@ -378,8 +531,12 @@ class CachingShuffleReader:
                 f"shuffle {self.shuffle_id} is missing map outputs "
                 f"{missing} (superseded by a recovery invalidation)")
         outputs = MapOutputRegistry.outputs_for(self.shuffle_id)
+        hedging = bool(self.conf[C.SHUFFLE_HEDGE_ENABLED])
         local_bids: list[BufferId] = []
-        remote: dict[str, list[BlockIdMsg]] = {}
+        # groups keyed (primary address, hedge replica address | None):
+        # a hedged group's blocks must all share one replica peer so
+        # the hedge attempt is a single fetch to a single server
+        remote: dict[tuple, list[BlockIdMsg]] = {}
         for map_id, status in sorted(outputs.items()):
             if status.partition_sizes[self.partition] == 0 and \
                     not self._has_degenerate(status, map_id):
@@ -391,25 +548,57 @@ class CachingShuffleReader:
             else:
                 addr = status.reachable_address(self.manager.transport,
                                                 health)
-                remote.setdefault(addr, []).append(
+                hedge_addr = status.hedge_address(
+                    self.manager.transport, health) if hedging else None
+                if hedge_addr == addr:
+                    hedge_addr = None
+                remote.setdefault((addr, hedge_addr), []).append(
                     BlockIdMsg(self.shuffle_id, map_id, self.partition))
+        # maps whose advertised size for THIS partition is nonzero MUST
+        # deliver at least one batch: a peer answering "no such table"
+        # for data the registry advertises (e.g. a replaced/rebuilt
+        # server whose catalog never saw the shuffle) must surface as a
+        # FetchFailed for recovery — never a clean-looking empty read
+        # (silent partial data)
+        expect_nonzero = {
+            m: s for m, s in outputs.items()
+            if s.partition_sizes[self.partition] > 0}
+        delivered: set = set()
         try:
             # local blocks: straight catalog reads with the semaphore held
             sem = TpuSemaphore.get()
             for bid in local_bids:
                 with self.manager.env.catalog.acquired(bid) as buf:
                     sem.acquire_if_necessary()
+                    delivered.add(bid.map_id)
                     yield bid.map_id, buf.get_columnar_batch()
             # remote: issue fetches per peer, consume as they land
-            yield from self._fetch_remote(remote, sem)
+            for map_id, batch in self._fetch_remote(remote, sem):
+                delivered.add(map_id)
+                yield map_id, batch
+            silent = sorted(set(expect_nonzero) - delivered)
+            if silent:
+                st = expect_nonzero[silent[0]]
+                addr = st.reachable_address(self.manager.transport,
+                                            health)
+                raise FetchFailedError(
+                    addr,
+                    BlockIdMsg(self.shuffle_id, silent[0],
+                               self.partition),
+                    f"maps {silent} advertise data for partition "
+                    f"{self.partition} but the fetch returned none "
+                    f"(peer serving a catalog without this shuffle?)")
         finally:
-            if self.metrics is not None and \
-                    self.wire_stats["compressed"]:
+            if self.metrics is not None:
                 from spark_rapids_tpu.utils import metrics as M
-                self.metrics.add(M.SHUFFLE_COMPRESSED_BYTES,
-                                 self.wire_stats["compressed"])
-                self.metrics.add(M.SHUFFLE_RAW_BYTES,
-                                 self.wire_stats["raw"])
+                if self.wire_stats["compressed"]:
+                    self.metrics.add(M.SHUFFLE_COMPRESSED_BYTES,
+                                     self.wire_stats["compressed"])
+                    self.metrics.add(M.SHUFFLE_RAW_BYTES,
+                                     self.wire_stats["raw"])
+                if self.wire_stats["corruptions"]:
+                    self.metrics.add(M.NUM_WIRE_CORRUPTIONS,
+                                     self.wire_stats["corruptions"])
             # received buffers live only for this task (reference
             # ShuffleReceivedBufferCatalog per-task cleanup)
             self.manager.received_catalog.release_task(
@@ -423,7 +612,24 @@ class CachingShuffleReader:
         return bool(self.manager.shuffle_catalog.blocks_for_partition(
             self.shuffle_id, self.partition, [map_id]))
 
-    def _fetch_remote(self, remote: dict[str, list[BlockIdMsg]],
+    def _fetch_one(self, address: str, blocks, handler_,
+                   attempt_id: int) -> None:
+        """One fetch of `blocks` from `address` into `handler_` under
+        the given receive-cleanup attempt id."""
+        conn = self.manager.transport.make_client(address)
+        client = ShuffleClient(
+            conn, self.manager.transport,
+            self.manager.received_catalog,
+            self.manager.env.host_store, address, conf=self.conf)
+        try:
+            client.fetch_blocks(blocks, attempt_id, handler_)
+        finally:
+            # the client may have swapped in a fresh connection on a
+            # retry: close whatever it currently holds, not the
+            # original handle
+            client.connection.close()
+
+    def _fetch_remote(self, remote: dict[tuple, list[BlockIdMsg]],
                       sem) -> Iterator[ColumnarBatch]:
         if not remote:
             return
@@ -431,7 +637,7 @@ class CachingShuffleReader:
         from spark_rapids_tpu.utils import profile as P
         health = PeerHealth.get()
         q: "queue.Queue" = queue.Queue()
-        current = {"addr": next(iter(remote))}
+        current = {"addr": next(iter(remote))[0]}
         handler = _IteratorHandler(q, current, self.wire_stats)
         errors: list[BaseException] = []
         done = threading.Event()
@@ -449,25 +655,15 @@ class CachingShuffleReader:
                 # the session's values, not registry defaults
                 with S.scoped(qc), C.session(self.conf), \
                         P.attach(span_ref):
-                    for address, blocks in remote.items():
+                    for (address, hedge_addr), blocks in remote.items():
                         current["addr"] = address
-                        conn = self.manager.transport.make_client(
-                            address)
-                        client = ShuffleClient(
-                            conn, self.manager.transport,
-                            self.manager.received_catalog,
-                            self.manager.env.host_store, address,
-                            conf=self.conf)
-                        try:
-                            client.fetch_blocks(blocks,
-                                                self.task_attempt_id,
-                                                handler)
-                        finally:
-                            # the client may have swapped in a fresh
-                            # connection on a retry: close whatever it
-                            # currently holds, not the original handle
-                            client.connection.close()
-                        health.record_success(address)
+                        if hedge_addr is not None:
+                            self._hedged_group(address, hedge_addr,
+                                               blocks, handler, health)
+                        else:
+                            self._fetch_one(address, blocks, handler,
+                                            self.task_attempt_id)
+                            health.record_success(address)
             except BaseException as e:  # noqa: BLE001
                 errors.append(e)
                 q.put(("fatal", (current.get("addr"), str(e))))
@@ -476,8 +672,10 @@ class CachingShuffleReader:
                 q.put(("done", None))
 
         def _first_block(addr):
-            blocks = remote.get(addr) or []
-            return blocks[0] if blocks else None
+            for (a, _h), blocks in remote.items():
+                if a == addr and blocks:
+                    return blocks[0]
+            return None
 
         t = threading.Thread(target=fetch_all, daemon=True,
                              name="tpu-shuffle-fetch")
@@ -491,6 +689,129 @@ class CachingShuffleReader:
                                      _first_block, hb, sem)
         finally:
             hb.close()
+
+    def _hedged_group(self, address: str, hedge_addr: str, blocks,
+                      handler, health) -> None:
+        """First-wins hedged fetch of one block group (runs on the
+        fetch worker thread): the primary attempt stages its results;
+        past the hedge delay (quantile of observed fetch latencies,
+        floored by shuffle.hedge.delayMs) — or on early primary
+        failure — the same blocks are requested from the replica peer.
+        The first complete, uncorrupted attempt's buffers are adopted
+        under the reader's attempt id; the loser is cancelled via its
+        AttemptToken, its staged buffers freed, and its wire bytes
+        reclassified to the ledger's wire:wasted site."""
+        from spark_rapids_tpu.exec import scheduler as S
+        from spark_rapids_tpu.shuffle.client_server import hedge_delay_s
+        from spark_rapids_tpu.utils import metrics as M
+        from spark_rapids_tpu.utils import movement as MV
+        from spark_rapids_tpu.utils import profile as P
+        from spark_rapids_tpu.utils import watchdog as W
+        addrs = {"primary": address, "hedge": hedge_addr}
+        staging = {n: _StagingHandler() for n in addrs}
+        attempt_ids = {n: next(TpuShuffleManager._attempt_ids)
+                       for n in addrs}
+        parent_tok = W.current_token()
+        tokens = {n: W.AttemptToken(parent=parent_tok) for n in addrs}
+        done = {n: threading.Event() for n in addrs}
+        results: dict = {}
+        threads: dict = {}
+        qc = S.current()
+        span_ref = P.current_ref()
+
+        def run(name):
+            try:
+                with S.scoped(qc), C.session(self.conf), \
+                        P.attach(span_ref), \
+                        W.attempt_scope(tokens[name]):
+                    self._fetch_one(addrs[name], blocks,
+                                    staging[name], attempt_ids[name])
+                results[name] = None
+            except BaseException as e:  # noqa: BLE001
+                results[name] = e
+            finally:
+                done[name].set()
+
+        def start(name):
+            t = threading.Thread(target=run, args=(name,), daemon=True,
+                                 name=f"tpu-shuffle-hedge-{name}")
+            threads[name] = t
+            t.start()
+
+        start("primary")
+        delay = hedge_delay_s(self.conf)
+        deadline = time.monotonic() + delay
+        while not done["primary"].is_set():
+            parent_tok.check()
+            left = deadline - time.monotonic()
+            if left <= 0:
+                break
+            done["primary"].wait(min(0.02, left))
+        if not (done["primary"].is_set()
+                and results.get("primary") is None):
+            # primary straggling past the hedge delay (or already
+            # failed): race the replica for the same blocks
+            if self.metrics is not None:
+                self.metrics.add(M.NUM_HEDGED_FETCHES, 1)
+            P.event("hedge_fired", address=address, replica=hedge_addr,
+                    blocks=len(blocks), delay_ms=round(delay * 1e3, 1))
+            start("hedge")
+        # first complete, uncorrupted response wins
+        winner = None
+        while winner is None:
+            parent_tok.check()
+            settled = [n for n in threads if done[n].is_set()]
+            ok = [n for n in settled if results.get(n) is None]
+            if ok:
+                # deterministic preference when both landed between
+                # polls: the primary's payload (they are identical
+                # serialized bytes, but the tie-break keeps hedge-win
+                # counts meaningful)
+                winner = "primary" if "primary" in ok else ok[0]
+                break
+            if len(settled) == len(threads):
+                raise results.get("primary") or results.get("hedge")
+            time.sleep(0.01)
+        loser = next((n for n in threads if n != winner), None)
+        if winner == "hedge" and self.metrics is not None:
+            self.metrics.add(M.NUM_HEDGED_WINS, 1)
+        if loser is not None:
+            tokens[loser].cancel_race_lost(
+                f"hedged fetch: {addrs[winner]} answered first")
+        # adopt the winner's staged buffers under the reader's attempt
+        # id (its release_task owns their cleanup now)
+        st = staging[winner]
+        for bid in self.manager.received_catalog.take_task(
+                attempt_ids[winner]):
+            self.manager.received_catalog.add_received(
+                self.task_attempt_id, bid)
+        if st.wire:
+            handler.buffer_received(st.wire, st.raw)
+        for _ in range(st.corruptions):
+            handler.corruption_detected()
+        for bid in st.bids:
+            handler.batch_received(bid)
+        health.record_success(addrs[winner])
+        if loser is not None:
+            # reap the loser: its waits are cancellable (bounded polls
+            # + token checks), so the join is prompt
+            threads[loser].join(timeout=10.0)
+            if threads[loser].is_alive():
+                import logging
+                logging.getLogger("spark_rapids_tpu.shuffle").warning(
+                    "hedged-fetch loser (%s) did not exit after "
+                    "cancellation; skipping its buffer cleanup",
+                    addrs[loser])
+            else:
+                lst = staging[loser]
+                self.manager.received_catalog.release_task(
+                    attempt_ids[loser])
+                if lst.wire and MV.ledger() is not None:
+                    site = ("send:loop"
+                            if addrs[loser].startswith("loop://")
+                            else "send:dcn")
+                    MV.move(MV.EDGE_WIRE, lst.wire, site,
+                            MV.SITE_WASTED, raw_bytes=lst.raw)
 
     def _consume(self, q, current, errors, done, _first_block, hb,
                  sem) -> Iterator[ColumnarBatch]:

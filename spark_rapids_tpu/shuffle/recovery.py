@@ -203,7 +203,13 @@ class ShuffleRecoveryDriver:
                              for a in sorted(addrs)]
                     if any(flags):
                         self.metrics.add(M.NUM_PEERS_BLACKLISTED, 1)
-                todo = sorted(set(lost) | set(
+                # replica promotion first (replication.factor >= 2):
+                # a lost map output whose serialized copy lives on a
+                # surviving executor is re-registered pointing THERE —
+                # no recompute, no device work.  Lineage recompute
+                # remains the fallback for un-replicated outputs.
+                promoted = self._promote_replicas(lost, set(by_exec))
+                todo = sorted((set(lost) - promoted) | set(
                     MapOutputRegistry.missing_maps(self.shuffle_id)))
                 if todo:
                     epoch = MapOutputRegistry.epoch(self.shuffle_id)
@@ -234,3 +240,43 @@ class ShuffleRecoveryDriver:
             finally:
                 self.metrics.add(M.RECOVERY_TIME,
                                  time.perf_counter_ns() - t0)
+
+    def _promote_replicas(self, lost: dict, dead_execs: set) -> set:
+        """Re-register each lost map output whose replica survives on a
+        live executor; returns the promoted map ids.  The promoted
+        MapStatus keeps the primary's partition sizes (zero/nonzero
+        routing is what readers consult) and the remaining replicas."""
+        from spark_rapids_tpu.shuffle.manager import MapStatus
+        promoted: set = set()
+        transport = self.manager.transport
+        for map_id, st in sorted(lost.items()):
+            pick = None
+            for eid, addr, tcp in st.replicas:
+                if eid in dead_execs:
+                    continue
+                cands = [a for a in (addr, tcp)
+                         if a and transport.can_reach(a)
+                         and not self.health.is_blacklisted(a)]
+                if cands:
+                    pick = (eid, addr, tcp)
+                    break
+            if pick is None:
+                continue
+            eid, addr, tcp = pick
+            survivors = [r for r in st.replicas
+                         if r[0] != eid and r[0] not in dead_execs]
+            new_st = MapStatus(eid, addr, list(st.partition_sizes),
+                               tcp_address=tcp, replicas=survivors)
+            try:
+                MapOutputRegistry.register(self.shuffle_id, map_id,
+                                           new_st)
+            except StaleMapStatusError:
+                continue  # a racing invalidation superseded us
+            promoted.add(map_id)
+            self.metrics.add(M.NUM_REPLICA_PROMOTIONS, 1)
+            log.warning("shuffle %d recovery: promoted replica on %s "
+                        "for map %d (no recompute)", self.shuffle_id,
+                        eid, map_id)
+            P.event("replica_promoted", shuffle_id=self.shuffle_id,
+                    map_id=map_id, replica_executor=eid)
+        return promoted

@@ -211,10 +211,14 @@ class TransactionStatus(enum.Enum):
 
 @dataclasses.dataclass
 class Transaction:
-    """Completed-exchange record (reference Transaction trait :311-380)."""
+    """Completed-exchange record (reference Transaction trait :311-380).
+    `corrupt` marks a failure caused by a DATA-frame CRC mismatch
+    (WireCorruption), so the client's retry loop can count detected
+    wire damage separately from plain connection loss."""
     status: TransactionStatus
     error: Optional[str] = None
     bytes_transferred: int = 0
+    corrupt: bool = False
 
 
 class Connection:

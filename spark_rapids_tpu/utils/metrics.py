@@ -54,6 +54,21 @@ NUM_MAP_RECOMPUTES = "numMapRecomputes"
 NUM_STAGE_RETRIES = "numStageRetries"
 NUM_PEERS_BLACKLISTED = "numPeersBlacklisted"
 RECOVERY_TIME = "recoveryTime"
+# tail tolerance (exec/speculation.py + shuffle hedging/replication):
+# duplicate attempts launched for slow tasks and how many of them beat
+# the original; hedged block fetches issued to replica peers and how
+# many completed first; bytes pushed to backup executors at map-output
+# write time; dead-peer map outputs recovered by promoting a live
+# replica (no recompute); wire payloads whose CRC check caught
+# in-flight damage (the retry path used to be invisible in
+# EXPLAIN-with-metrics)
+NUM_SPECULATIVE_TASKS = "numSpeculativeTasks"
+NUM_SPECULATIVE_WINS = "numSpeculativeWins"
+NUM_HEDGED_FETCHES = "numHedgedFetches"
+NUM_HEDGED_WINS = "numHedgedWins"
+REPLICATED_BYTES = "replicatedBytes"
+NUM_REPLICA_PROMOTIONS = "numReplicaPromotions"
+NUM_WIRE_CORRUPTIONS = "numWireCorruptions"
 # data-movement ledger (utils/movement.py) per-node attribution:
 # host->device bytes a scan uploaded, ICI collective payload bytes a
 # mesh exchange moved, and the compressed/uncompressed wire bytes a

@@ -79,6 +79,13 @@ MAX_SAMPLES = 1 << 13
 #: bytes already counted at the sender — see module docstring)
 _RECV_SITE_PREFIX = "recv"
 
+#: wire-edge site for bytes a losing hedged fetch pulled before being
+#: cancelled: the bytes really crossed the edge (they stay in the edge
+#: total — hedging overhead is honest), but reclassified out of the
+#: send:* sites so send:loop/send:dcn keep meaning "bytes the query
+#: actually consumed"
+SITE_WASTED = "wasted"
+
 
 class DataMovementLedger:
     """Byte accounting for one query.  Thread-safe; aggregation is a
@@ -136,6 +143,36 @@ class DataMovementLedger:
                      bytes=nbytes, raw_bytes=raw,
                      **({"dur_ns": int(dur_ns)} if dur_ns else {}),
                      **event_args)
+
+    def move(self, edge: str, nbytes: int, from_site: str,
+             to_site: str, raw_bytes: Optional[int] = None) -> None:
+        """Reclassify already-recorded bytes from one site to another
+        (losing hedged fetches: send:* -> wasted).  Counts and
+        durations stay where they were measured; only bytes (and the
+        raw mirror) migrate, clamped to what the source site actually
+        holds so a racing record can never drive a site negative.
+        Edge cumulative totals are unchanged — the bytes still crossed
+        the edge."""
+        nbytes = int(nbytes)
+        if nbytes <= 0:
+            return
+        raw = int(raw_bytes) if raw_bytes is not None else nbytes
+        with self._lock:
+            src = self._stats.get((edge, from_site))
+            if src is None:
+                return
+            nbytes = min(nbytes, src[0])
+            raw = min(raw, src[1])
+            if nbytes <= 0:
+                return
+            src[0] -= nbytes
+            src[1] -= raw
+            dst = self._stats.get((edge, to_site))
+            if dst is None:
+                dst = self._stats[(edge, to_site)] = [0, 0, 0, 0]
+            dst[0] += nbytes
+            dst[1] += raw
+            dst[2] += 1
 
     # -- views ---------------------------------------------------------------
     def snapshot(self) -> dict:
@@ -230,6 +267,15 @@ def record(edge: str, nbytes: int, site: str = "?",
     if led is not None:
         led.record(edge, nbytes, site=site, raw_bytes=raw_bytes,
                    dur_ns=dur_ns, **event_args)
+
+
+def move(edge: str, nbytes: int, from_site: str, to_site: str,
+         raw_bytes: Optional[int] = None) -> None:
+    """Module-level convenience for `DataMovementLedger.move` on the
+    current query's ledger (a no-op without one)."""
+    led = ledger()
+    if led is not None:
+        led.move(edge, nbytes, from_site, to_site, raw_bytes=raw_bytes)
 
 
 def format_report(report: Optional[dict]) -> str:

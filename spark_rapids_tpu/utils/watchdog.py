@@ -118,6 +118,78 @@ class CancelToken:
                 dump=self.dump)
 
 
+class AttemptToken(CancelToken):
+    """Per-attempt cancellation for racing duplicate work (speculative
+    task attempts, hedged fetches): linked to a parent (the query's
+    token), so a check honors BOTH — the query dying cancels every
+    attempt, while cancelling one losing attempt leaves the query and
+    its sibling attempt untouched.  `race_lost` marks a cancellation
+    that means "a faster attempt won", letting the attempt runner
+    swallow it instead of failing the query."""
+
+    def __init__(self, parent: Optional[CancelToken] = None):
+        super().__init__()
+        self.parent = parent
+        self.race_lost = False
+
+    @property
+    def cancelled(self) -> bool:
+        return self._ev.is_set() or (
+            self.parent is not None and self.parent.cancelled)
+
+    def cancel_race_lost(self, reason: str) -> None:
+        """Cancel because the sibling attempt finished first.  One-shot
+        like cancel(); the flag is set before the event so a woken
+        waiter always sees it."""
+        self.race_lost = True
+        self.cancel(reason)
+
+    def check(self) -> None:
+        if self.parent is not None:
+            self.parent.check()
+        if self._ev.is_set():
+            raise TpuQueryTimeout(
+                f"attempt cancelled: {self.reason}", dump=self.dump)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if self.parent is None:
+            return self._ev.wait(timeout)
+        # poll both events in bounded slices so a parent cancellation
+        # wakes an attempt parked on its own (unfired) token
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            if self._ev.is_set() or self.parent.cancelled:
+                return True
+            if deadline is None:
+                slice_s = _POLL_S
+            else:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                slice_s = min(left, _POLL_S)
+            self._ev.wait(slice_s)
+
+
+#: thread-local attempt-token stack: an attempt runner installs its
+#: AttemptToken here so every cancellation point under it (batch
+#: boundaries, backoff sleeps, injected delays) honors the attempt's
+#: cancellation, not just the query's
+_ATTEMPT_TLS = threading.local()
+
+
+@contextmanager
+def attempt_scope(token: CancelToken):
+    """Install `token` as this thread's innermost cancellation token
+    for the duration (speculative/hedged attempt bodies)."""
+    prev = getattr(_ATTEMPT_TLS, "tok", None)
+    _ATTEMPT_TLS.tok = token
+    try:
+        yield token
+    finally:
+        _ATTEMPT_TLS.tok = prev
+
+
 # ---------------------------------------------------------------------------
 # token management: every query owns its token on its QueryContext
 # (exec/scheduler.py), installed thread-locally by the outermost
@@ -139,6 +211,9 @@ def _current_query_ctx():
 
 
 def current_token() -> CancelToken:
+    tok = getattr(_ATTEMPT_TLS, "tok", None)
+    if tok is not None:
+        return tok
     from spark_rapids_tpu.memory.semaphore import TaskContext
     ctx = TaskContext.get()
     tok = getattr(ctx, "cancel_token", None) if ctx is not None else None
@@ -301,7 +376,9 @@ class Heartbeat:
 
     def __init__(self, name: str, kind: str, deadline: float,
                  poll: float, token: CancelToken, dump: bool,
-                 details: Optional[Callable[[], str]] = None):
+                 details: Optional[Callable[[], str]] = None,
+                 slow_check: Optional[Callable[["Heartbeat", float],
+                                               None]] = None):
         self.name = name
         self.kind = kind
         self.deadline = deadline
@@ -309,6 +386,13 @@ class Heartbeat:
         self.token = token
         self.dump_on_timeout = dump
         self.details = details
+        #: optional *slow* classifier (distinct from hung): the scanner
+        #: calls it every scan with (heartbeat, now) while the activity
+        #: is live — the speculation layer uses it to compare a task's
+        #: elapsed runtime against its stage's completed-task median
+        #: and launch a duplicate attempt.  A beating heartbeat can
+        #: still be slow; only a silent one is hung.
+        self.slow_check = slow_check
         self.thread_name = threading.current_thread().name
         self.thread_id = threading.get_ident()
         self.created = time.monotonic()
@@ -388,7 +472,8 @@ def enabled(conf: Optional[C.RapidsConf] = None) -> bool:
 
 def heartbeat(name: str, kind: str = "task",
               details: Optional[Callable[[], str]] = None,
-              conf: Optional[C.RapidsConf] = None) -> Heartbeat:
+              conf: Optional[C.RapidsConf] = None,
+              slow_check: Optional[Callable] = None) -> Heartbeat:
     """Register a watched activity under the current query's token.
     Returns a no-op handle when the watchdog is disabled, so call
     sites need no conditional."""
@@ -397,7 +482,8 @@ def heartbeat(name: str, kind: str = "task",
         return _NULL_HB
     hb = Heartbeat(name, kind, deadline_for(kind, conf),
                    _poll_for(conf), current_token(),
-                   bool(conf[C.WATCHDOG_DUMP_ON_TIMEOUT]), details)
+                   bool(conf[C.WATCHDOG_DUMP_ON_TIMEOUT]), details,
+                   slow_check=slow_check)
     with _HB_LOCK:
         _HEARTBEATS[hb._id] = hb
     _ensure_scanner()
@@ -441,6 +527,15 @@ def _scan_loop() -> None:
                 # stall once their query is cancelled — re-dumping
                 # each would bury the first (causal) dump
                 continue
+            if hb.slow_check is not None:
+                # slow classification rides the same scan: a beating
+                # but lagging activity is *slow*, never *hung* — the
+                # callback decides (and launches speculation) without
+                # touching the hang deadline below
+                try:
+                    hb.slow_check(hb, now)
+                except Exception:  # noqa: BLE001 — a classifier bug
+                    log.exception("slow_check failed for %s", hb.name)
             gap = now - hb.last_beat
             _note_gap(gap * 1000.0, hb.qc)
             if gap > hb.deadline:
@@ -533,6 +628,13 @@ def build_dump(stuck: Optional[Heartbeat] = None) -> str:
             lines.append(f"  {f}")
     except Exception as e:  # noqa: BLE001
         lines.append(f"  <unavailable: {e}>")
+    lines.append("-- speculation / slow injection --")
+    try:
+        from spark_rapids_tpu.exec.speculation import speculation_stats
+        lines.append(f"  {speculation_stats()} "
+                     f"slow_injected={slow_injection_counts()}")
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"  <unavailable: {e}>")
     lines.append("-- hang injection --")
     try:
         with _INJ_LOCK:
@@ -620,3 +722,66 @@ def maybe_hang(site: str, conf: Optional[C.RapidsConf] = None) -> None:
     raise TpuQueryTimeout(
         f"hang-injected site '{site}' cancelled: {tok.reason}",
         dump=tok.dump)
+
+
+# ---------------------------------------------------------------------------
+# seeded slow (straggler) injection — the *slow* sibling of maybe_hang:
+# the site stays alive and keeps beating, just 10x (slowFactor) slower,
+# so the tail-tolerance layer (speculation, hedged fetches) is what has
+# to save the query, not the hang watchdog
+SLOW_SITES = ("map-task", "shuffle-server")
+
+#: per-unit delay hard cap — a misconfigured factor must never turn a
+#: soak test into a wall-clock sink
+_SLOW_HARD_CAP_S = 2.0
+
+_SLOW_LOCK = threading.Lock()
+_SLOW_COUNTS: dict[str, int] = {}
+_SLOW_RNGS: dict = {}
+
+
+def reset_slow_injection() -> None:
+    with _SLOW_LOCK:
+        _SLOW_COUNTS.clear()
+        _SLOW_RNGS.clear()
+
+
+def slow_injection_counts() -> dict:
+    """{site: units delayed} since the last reset (tests assert the
+    injector actually fired)."""
+    with _SLOW_LOCK:
+        return dict(_SLOW_COUNTS)
+
+
+def maybe_slow(site: str, conf: Optional[C.RapidsConf] = None,
+               executor_id: Optional[str] = None) -> float:
+    """Delay-injection hook, called once per unit of work at each
+    instrumented site.  When `faultInjection.slowSite` names this site
+    (and `slowVictim`, if set, names this executor), sleeps
+    (slowFactor - 1) x slowUnitMs with seeded +/-25% jitter — a
+    deterministic model of a degraded peer.  The sleep is cancellable:
+    a losing speculative/hedged attempt parked here wakes the moment
+    its AttemptToken fires.  Returns the injected delay (0 = none)."""
+    conf = conf if conf is not None else C.get_active_conf()
+    target = str(conf[C.SLOW_INJECT_SITE])
+    if not target or target != site:
+        return 0.0
+    factor = float(conf[C.SLOW_INJECT_FACTOR])
+    if factor <= 1.0:
+        return 0.0
+    victim = str(conf[C.SLOW_INJECT_VICTIM])
+    if victim and executor_id is not None and victim != str(executor_id):
+        return 0.0
+    import random
+    seed = int(conf[C.SLOW_INJECT_SEED])
+    with _SLOW_LOCK:
+        rng = _SLOW_RNGS.get((factor, seed))
+        if rng is None:
+            rng = _SLOW_RNGS[(factor, seed)] = random.Random(seed)
+        jitter = 0.75 + 0.5 * rng.random()
+        _SLOW_COUNTS[site] = _SLOW_COUNTS.get(site, 0) + 1
+    unit_s = float(conf[C.SLOW_INJECT_UNIT_MS]) / 1e3
+    delay = min((factor - 1.0) * unit_s * jitter, _SLOW_HARD_CAP_S)
+    if delay > 0:
+        cancellable_sleep(delay)
+    return delay

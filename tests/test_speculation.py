@@ -1,0 +1,545 @@
+"""Tail-tolerance suite: speculative partition execution, hedged
+shuffle fetches, map-output replication, and the seeded slow/spill
+corruption injectors (exec/speculation.py + the shuffle tail layer).
+
+The failure model is *slow*, not dead: a seeded delay injector
+(faultInjection.slowSite/.slowFactor/.slowVictim/.slowSeed) makes ONE
+executor serve map tasks / shuffle buffers 10-20x slower, and the tail
+layer — first-wins speculation with per-attempt CancelTokens, hedged
+fetches against map-output replicas, replica promotion on peer loss —
+must keep results bit-exact while the straggler loses every race.  The
+soak combines slow-peer + peer-kill + OOM injection under the 4-thread
+query scheduler, mirroring the recovery/watchdog/scheduler suites'
+discipline: bit-exact vs the clean run, wins on the meter, zero leaked
+permits/producers/admissions, losers verifiably cancelled."""
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+from pandas.testing import assert_frame_equal
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec import speculation as SPEC
+from spark_rapids_tpu.exec.basic import LocalBatchSource
+from spark_rapids_tpu.exprs.base import col
+from spark_rapids_tpu.memory.device_manager import DeviceManager
+from spark_rapids_tpu.memory.env import ResourceEnv
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+from spark_rapids_tpu.shuffle.manager import (
+    MapOutputRegistry, TpuShuffleManager)
+from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+from spark_rapids_tpu.shuffle.recovery import PeerHealth
+from spark_rapids_tpu.utils import metrics as M
+from spark_rapids_tpu.utils import watchdog as W
+
+
+@pytest.fixture(autouse=True)
+def clean_world():
+    def reset():
+        MapOutputRegistry.clear()
+        PeerHealth.get().clear()
+        W.reset_slow_injection()
+        SPEC.reset_speculation_stats()
+        for eid in list(TpuShuffleManager._managers):
+            TpuShuffleManager._managers[eid].close()
+    reset()
+    yield
+    reset()
+    ResourceEnv.shutdown()
+
+
+def _reset_world():
+    MapOutputRegistry.clear()
+    PeerHealth.get().clear()
+    W.reset_slow_injection()
+    from spark_rapids_tpu.shuffle.client_server import reset_fetch_latency
+    reset_fetch_latency()
+    for eid in list(TpuShuffleManager._managers):
+        TpuShuffleManager._managers[eid].close()
+
+
+def _df(rows=4000, seed=7):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": rng.integers(0, 50, rows).astype(np.int64),
+        "v": rng.integers(0, 10**6, rows).astype(np.int64)})
+
+
+def _exchange_parts(df, conf, num_partitions=4, reducers=3):
+    with C.session(conf):
+        src = LocalBatchSource.from_pandas(df,
+                                           num_partitions=num_partitions)
+        ex = ShuffleExchangeExec(
+            HashPartitioning([col("k")], reducers), src)
+        parts = [[(b.column("k").to_pylist(b.num_rows),
+                   b.column("v").to_pylist(b.num_rows))
+                  for b in it] for it in ex.execute_partitions()]
+    return parts, ex.metrics.as_dict()
+
+
+def _mgr_conf(**extra):
+    kv = {
+        "spark.rapids.shuffle.enabled": True,
+        "spark.rapids.shuffle.localExecutors": 3,
+        "spark.rapids.sql.watchdog.pollInterval": 0.05,
+    }
+    kv.update({k.replace("__", "."): v for k, v in extra.items()})
+    return C.RapidsConf(kv)
+
+
+SLOW_MAP = {
+    "spark.rapids.memory.faultInjection.slowSite": "map-task",
+    "spark.rapids.memory.faultInjection.slowFactor": 10.0,
+    "spark.rapids.memory.faultInjection.slowUnitMs": 40.0,
+    "spark.rapids.memory.faultInjection.slowVictim": "local-1",
+    "spark.rapids.memory.faultInjection.slowSeed": 11,
+}
+SLOW_SERVER = {
+    "spark.rapids.memory.faultInjection.slowSite": "shuffle-server",
+    "spark.rapids.memory.faultInjection.slowFactor": 20.0,
+    "spark.rapids.memory.faultInjection.slowUnitMs": 30.0,
+    "spark.rapids.memory.faultInjection.slowVictim": "local-1",
+    "spark.rapids.memory.faultInjection.slowSeed": 11,
+}
+SPECULATE = {
+    "spark.rapids.sql.speculation.enabled": True,
+    "spark.rapids.sql.speculation.minTaskRuntimeMs": 50.0,
+    "spark.rapids.sql.speculation.minCompletedTasks": 1,
+    "spark.rapids.sql.speculation.multiplier": 3.0,
+}
+HEDGE = {
+    "spark.rapids.shuffle.replication.factor": 2,
+    "spark.rapids.shuffle.hedge.enabled": True,
+    "spark.rapids.shuffle.hedge.delayMs": 40.0,
+}
+
+
+# -- slow injector -----------------------------------------------------------
+def test_slow_injector_targets_victim_only():
+    conf = _mgr_conf(**SLOW_MAP)
+    with C.session(conf):
+        assert W.maybe_slow("map-task", executor_id="local-0") == 0.0
+        assert W.maybe_slow("shuffle-server",
+                            executor_id="local-1") == 0.0
+        d = W.maybe_slow("map-task", executor_id="local-1")
+    assert d > 0.0
+    assert W.slow_injection_counts() == {"map-task": 1}
+
+
+def test_slow_injector_off_by_default():
+    with C.session(C.RapidsConf()):
+        assert W.maybe_slow("map-task", executor_id="x") == 0.0
+        assert W.maybe_slow("shuffle-server") == 0.0
+    assert W.slow_injection_counts() == {}
+
+
+def test_slow_injector_delay_is_cancellable():
+    conf = _mgr_conf(**{
+        "spark.rapids.memory.faultInjection.slowSite": "map-task",
+        "spark.rapids.memory.faultInjection.slowFactor": 100.0,
+        "spark.rapids.memory.faultInjection.slowUnitMs": 20.0})
+    tok = W.AttemptToken()
+    t = threading.Timer(0.1, lambda: tok.cancel_race_lost("test"))
+    t.start()
+    t0 = time.monotonic()
+    with C.session(conf), W.attempt_scope(tok):
+        with pytest.raises(W.TpuQueryTimeout):
+            W.maybe_slow("map-task", executor_id="any")
+    assert time.monotonic() - t0 < 1.5  # woke on the token, not 2s cap
+    assert tok.race_lost
+
+
+# -- AttemptToken ------------------------------------------------------------
+def test_attempt_token_links_to_parent():
+    parent = W.CancelToken()
+    tok = W.AttemptToken(parent=parent)
+    assert not tok.cancelled
+    parent.cancel("query died")
+    assert tok.cancelled
+    with pytest.raises(W.TpuQueryTimeout):
+        tok.check()
+    # cancelling an attempt never touches the parent
+    tok2 = W.AttemptToken(parent=W.CancelToken())
+    tok2.cancel_race_lost("lost")
+    assert tok2.race_lost and tok2.cancelled
+    assert not tok2.parent.cancelled
+
+
+# -- speculative partition execution -----------------------------------------
+def test_speculation_wins_over_slow_victim_bit_exact():
+    df = _df()
+    base, m0 = _exchange_parts(df, _mgr_conf())
+    assert m0.get("numSpeculativeTasks", 0) == 0
+    _reset_world()
+    got, m1 = _exchange_parts(df, _mgr_conf(**SLOW_MAP, **SPECULATE))
+    assert m1["numSpeculativeTasks"] >= 1, m1
+    assert m1["numSpeculativeWins"] >= 1, m1
+    stats = SPEC.speculation_stats()
+    assert stats["losers_cancelled"] >= 1, stats
+    assert got == base  # bit-exact, same batch order
+
+
+def test_speculation_idle_on_healthy_stage():
+    df = _df()
+    got, m = _exchange_parts(df, _mgr_conf(**SPECULATE))
+    assert m.get("numSpeculativeTasks", 0) == 0, m
+    assert sum(len(k) for p in got for (k, v) in p) == len(df)
+
+
+def test_speculation_defaults_off_even_under_slowdown():
+    df = _df()
+    got, m = _exchange_parts(df, _mgr_conf(**SLOW_MAP))
+    assert m.get("numSpeculativeTasks", 0) == 0, m
+    assert sum(len(k) for p in got for (k, v) in p) == len(df)
+
+
+# -- hedged fetches + replication --------------------------------------------
+def test_hedged_fetch_beats_slow_server_bit_exact():
+    df = _df()
+    base, _ = _exchange_parts(df, _mgr_conf())
+    _reset_world()
+    got, m = _exchange_parts(df, _mgr_conf(**SLOW_SERVER, **HEDGE))
+    assert m["numHedgedFetches"] >= 1, m
+    assert m["numHedgedWins"] >= 1, m
+    assert m["replicatedBytes"] > 0, m
+    assert got == base
+
+
+def test_hedge_without_replicas_never_fires():
+    df = _df()
+    got, m = _exchange_parts(df, _mgr_conf(**SLOW_SERVER, **{
+        "spark.rapids.shuffle.hedge.enabled": True,
+        "spark.rapids.shuffle.hedge.delayMs": 40.0}))
+    assert m.get("numHedgedFetches", 0) == 0, m
+    assert sum(len(k) for p in got for (k, v) in p) == len(df)
+
+
+def test_replica_promotion_recovers_peer_kill_without_recompute():
+    df = _df()
+    base, _ = _exchange_parts(df, _mgr_conf())
+    _reset_world()
+    got, m = _exchange_parts(df, _mgr_conf(**{
+        "spark.rapids.shuffle.localExecutors": 2,
+        "spark.rapids.shuffle.replication.factor": 2,
+        "spark.rapids.shuffle.bounceBuffers.size": 2048,
+        "spark.rapids.shuffle.fetch.maxRetries": 1,
+        "spark.rapids.shuffle.fetch.backoff.baseMs": 1.0,
+        "spark.rapids.shuffle.transport.faultInjection."
+        "peerKillAfterFrames": 4}))
+    assert m["numReplicaPromotions"] >= 1, m
+    assert m.get("numMapRecomputes", 0) == 0, m
+    # same values; batch order may differ only in how maps were placed,
+    # and the recovery driver re-sorts by map id — so exact equality
+    base2, _ = (base, None)
+    flat = sorted((k, v) for p in got for ks, vs in p
+                  for k, v in zip(ks, vs))
+    flat0 = sorted((k, v) for p in base2 for ks, vs in p
+                   for k, v in zip(ks, vs))
+    assert flat == flat0
+
+
+# -- ledger honesty: wire:wasted ---------------------------------------------
+def test_losing_hedge_charged_to_wasted_site():
+    from spark_rapids_tpu.utils import profile as P
+    df = _df()
+    conf = _mgr_conf(**SLOW_SERVER, **HEDGE, **{
+        "spark.rapids.sql.profile.enabled": True})
+    with C.session(conf):
+        src = LocalBatchSource.from_pandas(df, num_partitions=4)
+        ex = ShuffleExchangeExec(HashPartitioning([col("k")], 3), src)
+        from spark_rapids_tpu.plan.overrides import accelerate
+        out = ex.collect().to_pandas()
+    assert len(out) == len(df)
+    prof = P.last_profile()
+    assert prof is not None and prof.movement is not None
+    wire = prof.movement["edges"]["wire"]
+    sites = wire["sites"]
+    assert sites.get("wasted", {}).get("bytes", 0) > 0, sites
+    # conservation with hedging: everything assembled on the receive
+    # side is accounted once on the counted side (send:* + wasted)
+    recv = sum(v["bytes"] for s, v in sites.items()
+               if s.startswith("recv"))
+    counted = sum(v["bytes"] for s, v in sites.items()
+                  if not s.startswith("recv") and s != "replicate")
+    assert counted == recv, sites
+
+
+# -- wire-corruption metric ---------------------------------------------------
+def test_wire_corruption_surfaces_as_metric():
+    conf = C.RapidsConf({
+        "spark.rapids.shuffle.transport.faultInjection.corruptRate":
+            0.05,
+        "spark.rapids.shuffle.transport.faultInjection.seed": 7,
+        "spark.rapids.shuffle.bounceBuffers.size": 2048,
+    })
+    C.set_active_conf(conf)
+    env = ResourceEnv.init(conf)
+    m0 = TpuShuffleManager("wc-a", env, conf)
+    m1 = TpuShuffleManager("wc-b", env, conf)
+    for m in (m0, m1):
+        m.register_shuffle(70)
+    w = m0.get_writer(70, 0)
+    w.write_partition(0, ColumnarBatch.from_numpy({
+        "k": np.arange(4000, dtype=np.int64)}))
+    status = w.commit(1)
+    status.address = m0.tcp_address  # force the wire (TCP) path
+    MapOutputRegistry.register(70, 0, status)
+    metrics = M.MetricSet()
+    got = list(m1.get_reader(70, 0, metrics=metrics))
+    assert sum(b.num_rows for b in got) == 4000
+    assert m0.transport.faults.injected_corruptions > 0
+    vals = metrics.as_dict()
+    assert vals["numWireCorruptions"] >= 1, vals
+    assert vals["numWireCorruptions"] == \
+        m0.transport.faults.injected_corruptions
+
+
+# -- spill corruption ---------------------------------------------------------
+def test_spill_corruption_injection_raises_descriptive_error():
+    from spark_rapids_tpu.memory import stores as ST
+    ST.reset_spill_corruption()
+    conf = C.RapidsConf({
+        "spark.rapids.memory.faultInjection.spillCorruptRate": 1.0,
+        "spark.rapids.memory.faultInjection.seed": 11})
+    with C.session(conf):
+        disk = ST.DiskStore()
+        from spark_rapids_tpu.memory.buffer import BufferId, meta_for_batch
+        batch = ColumnarBatch.from_numpy({
+            "k": np.arange(256, dtype=np.int64)})
+        from spark_rapids_tpu.columnar.serde import serialize_batch
+        blob = serialize_batch(batch)
+        buf = disk.add_blob(BufferId(1), blob, meta_for_batch(batch))
+        assert ST.injected_spill_corruptions() == 1
+        with pytest.raises(ST.SpillCorruption) as ei:
+            buf.get_columnar_batch()
+        assert "spill file" in str(ei.value)
+        disk.close()
+
+
+def test_spill_corruption_off_by_default_roundtrips():
+    from spark_rapids_tpu.memory import stores as ST
+    ST.reset_spill_corruption()
+    with C.session(C.RapidsConf()):
+        disk = ST.DiskStore()
+        from spark_rapids_tpu.memory.buffer import BufferId, meta_for_batch
+        from spark_rapids_tpu.columnar.serde import serialize_batch
+        batch = ColumnarBatch.from_numpy({
+            "k": np.arange(256, dtype=np.int64)})
+        buf = disk.add_blob(BufferId(2), serialize_batch(batch),
+                            meta_for_batch(batch))
+        got = buf.get_columnar_batch()
+        assert got.column("k").to_pylist(got.num_rows) == \
+            list(range(256))
+        assert ST.injected_spill_corruptions() == 0
+        disk.close()
+
+
+# -- silent-partial-data regression (found by this suite's soak) -------------
+def test_manager_get_or_create_is_atomic():
+    """The old `get(id) or Manager(id)` idiom raced under concurrent
+    queries: two threads built the same executor, the second's server
+    replaced the first's loopback registration, and the first query's
+    advertised map outputs resolved to a catalog that never saw the
+    shuffle — clean-looking EMPTY fetches, silent partial data."""
+    conf = C.RapidsConf()
+    C.set_active_conf(conf)
+    ResourceEnv.init(conf)
+    got: list = []
+    barrier = threading.Barrier(8)
+
+    def make():
+        barrier.wait()
+        got.append(TpuShuffleManager.get_or_create("race-x"))
+
+    ts = [threading.Thread(target=make) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert len(got) == 8
+    assert all(m is got[0] for m in got), \
+        "get_or_create constructed more than one manager"
+
+
+def test_advertised_output_missing_from_peer_fetchfails():
+    """A peer answering 'no such table' for a map output the registry
+    advertises as nonzero must surface FetchFailedError (recovery's
+    signal), never a clean empty read."""
+    from spark_rapids_tpu.shuffle.client_server import FetchFailedError
+    from spark_rapids_tpu.shuffle.manager import MapStatus
+    conf = C.RapidsConf()
+    C.set_active_conf(conf)
+    env = ResourceEnv.init(conf)
+    reader_mgr = TpuShuffleManager.get_or_create("sp-reader", env, conf)
+    peer = TpuShuffleManager.get_or_create("sp-peer", env, conf)
+    reader_mgr.register_shuffle(80)
+    # the peer's catalog never saw shuffle 80, but the registry claims
+    # it holds 1234 bytes of partition 0 for map 0
+    MapOutputRegistry.register(80, 0, MapStatus(
+        "sp-peer", peer.loop_address, [1234],
+        tcp_address=peer.tcp_address))
+    with pytest.raises(FetchFailedError) as ei:
+        list(reader_mgr.get_reader(80, 0))
+    assert "advertise data" in str(ei.value)
+
+
+# -- the acceptance soak ------------------------------------------------------
+def _assert_no_leaks():
+    snap = TpuSemaphore.get().snapshot()
+    assert snap["refs"] == {}, f"leaked semaphore permits: {snap}"
+    dm = DeviceManager.get()
+    assert dm.admissions() == {}, \
+        f"leaked HBM admissions: {dm.admissions()}"
+    assert dm.reserved_bytes == 0, \
+        f"leaked HBM reservations: {dm.reserved_bytes}"
+    deadline = time.monotonic() + 5.0
+    live = []
+    while time.monotonic() < deadline:
+        live = [t for t in threading.enumerate()
+                if t.name.startswith("tpu-prefetch")
+                or t.name.startswith("tpu-speculate")
+                or t.name.startswith("tpu-shuffle-hedge")
+                or t.name.startswith("tpu-aqe-stage-fill")]
+        if not live:
+            break
+        time.sleep(0.05)
+    assert not live, f"leaked attempt/producer threads: {live}"
+
+
+def test_soak_scheduler_storm_under_combined_injection():
+    """4-thread scheduler storm of TPC-H q1/q5 under combined seeded
+    slow-peer + peer-kill + OOM injection: every result bit-exact vs
+    the clean run, speculation AND hedging wins on the meter, zero
+    leaked permits/producers/admissions, losers cancelled."""
+    from spark_rapids_tpu.memory import retry as R
+    from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+    from spark_rapids_tpu.models.tpch_data import gen_tables
+    tables = gen_tables(np.random.default_rng(11), 800)
+
+    def conf_for(injected):
+        kv = dict(BENCH_CONF)
+        kv.update({
+            "spark.rapids.shuffle.enabled": True,
+            "spark.rapids.shuffle.localExecutors": 3,
+            "spark.rapids.sql.watchdog.pollInterval": 0.05,
+        })
+        if injected:
+            kv.update(SPECULATE)
+            kv.update(HEDGE)
+            kv.update(SLOW_MAP)
+            kv.update({
+                # peer-kill rides along: replication absorbs it via
+                # promotion, recompute stays the fallback
+                "spark.rapids.shuffle.transport.faultInjection."
+                "peerKillAfterFrames": 24,
+                "spark.rapids.shuffle.fetch.maxRetries": 1,
+                "spark.rapids.shuffle.fetch.backoff.baseMs": 1.0,
+                # seeded OOM pressure on top
+                "spark.rapids.memory.faultInjection.oomRate": 0.05,
+                "spark.rapids.memory.faultInjection.seed": 11,
+                "spark.rapids.memory.faultInjection.maxInjections": 4,
+            })
+        return C.RapidsConf(kv)
+
+    clean = {q: run_query(q, tables, engine="tpu",
+                          conf=conf_for(False)) for q in (1, 5)}
+    _reset_world()
+    R.reset_oom_injection()
+    SPEC.reset_speculation_stats()
+    conf = conf_for(True)
+    mix = [1, 5, 1, 5]
+    results: dict = {}
+    errors: list = []
+
+    def worker(i, q):
+        try:
+            results[i] = (q, run_query(q, tables, engine="tpu",
+                                       conf=conf))
+        except BaseException as e:  # noqa: BLE001 — asserted below
+            errors.append((i, q, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i, q),
+                                name=f"tail-soak-{i}")
+               for i, q in enumerate(mix)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert not errors, errors
+    assert len(results) == len(mix)
+    for i, (q, got) in results.items():
+        e = clean[q].sort_values(list(clean[q].columns)) \
+            .reset_index(drop=True)
+        g = got.sort_values(list(got.columns)).reset_index(drop=True)
+        assert list(e.columns) == list(g.columns)
+        for c in e.columns:
+            np.testing.assert_array_equal(
+                e[c].to_numpy(), g[c].to_numpy(),
+                err_msg=f"q{q} column {c} not bit-exact under "
+                        f"combined injection")
+    stats = SPEC.speculation_stats()
+    assert stats["wins"] >= 1, stats
+    assert stats["losers_cancelled"] >= 1, stats
+    assert W.slow_injection_counts().get("map-task", 0) > 0
+    _assert_no_leaks()
+    R.reset_oom_injection()
+
+
+def test_soak_hedge_wins_under_slow_server_storm():
+    """The hedge half of the acceptance soak: q1/q5 manager-lane under
+    a slow shuffle-server victim with replication — hedged wins on the
+    meter, bit-exact, zero leaks."""
+    from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+    from spark_rapids_tpu.models.tpch_data import gen_tables
+    from spark_rapids_tpu.plan.overrides import ExecutionPlanCapture
+    tables = gen_tables(np.random.default_rng(11), 800)
+
+    def conf_for(injected):
+        kv = dict(BENCH_CONF)
+        kv.update({
+            "spark.rapids.shuffle.enabled": True,
+            "spark.rapids.shuffle.localExecutors": 3,
+        })
+        if injected:
+            kv.update(HEDGE)
+            kv.update(SLOW_SERVER)
+        return C.RapidsConf(kv)
+
+    def hedge_totals(plan):
+        tot = {M.NUM_HEDGED_FETCHES: 0.0, M.NUM_HEDGED_WINS: 0.0}
+
+        def walk(node):
+            if isinstance(node, ShuffleExchangeExec):
+                d = node.metrics.as_dict()
+                for k in tot:
+                    tot[k] += d.get(k, 0)
+            for c in getattr(node, "children", []):
+                walk(c)
+            if hasattr(node, "exchange"):
+                walk(node.exchange)
+            if hasattr(node, "stage"):
+                walk(node.stage)
+        walk(plan)
+        return tot
+
+    for q in (1, 5):
+        _reset_world()
+        expected = run_query(q, tables, engine="tpu",
+                             conf=conf_for(False))
+        _reset_world()
+        got = run_query(q, tables, engine="tpu", conf=conf_for(True))
+        tot = hedge_totals(ExecutionPlanCapture.last_plan)
+        assert tot[M.NUM_HEDGED_FETCHES] >= 1, (q, tot)
+        assert tot[M.NUM_HEDGED_WINS] >= 1, (q, tot)
+        e = expected.sort_values(list(expected.columns)) \
+            .reset_index(drop=True)
+        g = got.sort_values(list(got.columns)).reset_index(drop=True)
+        for c in e.columns:
+            np.testing.assert_array_equal(
+                e[c].to_numpy(), g[c].to_numpy(),
+                err_msg=f"q{q} column {c} not bit-exact under hedging")
+    _assert_no_leaks()
