@@ -29,6 +29,66 @@ run_fast() {
   run_concurrency
   run_fusion
   run_speculation
+  run_telemetry
+}
+
+run_telemetry() {
+  # engine-wide telemetry lane: the registry/exporter/sampler suite,
+  # then one live smoke — a Prometheus scrape against the HTTP
+  # endpoint WHILE a concurrent q1/q5 pair runs, asserting the
+  # operator-facing gauges parse and the utilization timeline names
+  # every sampled instant — with a busy-vs-idle summary line.
+  echo "== telemetry lane (metrics registry, Prometheus export, utilization timeline) =="
+  "${PYTEST[@]}" tests/test_telemetry.py
+  python - <<'PYEOF'
+import threading, time, urllib.request
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+from spark_rapids_tpu.utils import telemetry as T
+
+t = T.start(C.RapidsConf({
+    "spark.rapids.sql.telemetry.enabled": True,
+    "spark.rapids.sql.telemetry.samplePeriodMs": 10.0}), http_port=0)
+tables = gen_tables(np.random.default_rng(11), 1000)
+conf = C.RapidsConf({**BENCH_CONF,
+                     "spark.rapids.sql.profile.enabled": True})
+for q in (1, 5):  # warm compiles outside the scraped window
+    run_query(q, tables, conf=C.RapidsConf(dict(BENCH_CONF)))
+errors = []
+def worker(q):
+    try:
+        run_query(q, tables, conf=conf)
+    except BaseException as e:
+        errors.append((q, repr(e)))
+ts = [threading.Thread(target=worker, args=(q,)) for q in (1, 5, 1, 5)]
+[x.start() for x in ts]
+scrapes = 0
+url = "http://127.0.0.1:%d/metrics" % t.http_port
+text = ""
+while any(x.is_alive() for x in ts):
+    text = urllib.request.urlopen(url, timeout=10).read().decode()
+    scrapes += 1
+    time.sleep(0.05)
+[x.join(300) for x in ts]
+assert not errors, errors
+assert scrapes > 0 and "tpu_rapids_hbm_budget_bytes" in text
+assert "tpu_rapids_semaphore_max_concurrent" in text
+assert "tpu_rapids_scheduler_queue_depth" in text
+assert "tpu_rapids_kernel_cache_entries" in text
+util = t.utilization_summary()
+named = sum(v for k, v in util.items() if k != "samples")
+assert util["samples"] > 10 and named >= 99.0, util
+slow = t.slow_query_log()
+print("telemetry summary: scrapes=%d samples=%d util=%s "
+      "slow_query_fingerprints=%d" % (
+          scrapes, util["samples"],
+          {k: v for k, v in util.items() if k != "samples"}, len(slow)))
+T.stop()
+PYEOF
 }
 
 run_speculation() {
@@ -420,7 +480,8 @@ case "$TIER" in
   concurrency) run_concurrency ;;
   fusion)   run_fusion ;;
   speculation) run_speculation ;;
+  telemetry) run_telemetry ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|speculation|all]" >&2
+  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|speculation|telemetry|all]" >&2
      exit 2 ;;
 esac

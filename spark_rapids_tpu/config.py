@@ -300,6 +300,74 @@ MOVEMENT_MIN_EVENT_BYTES = conf(
     "retries, fetch failures, and watchdog dumps by query id); "
     "smaller records are aggregated into the ledger only, keeping the "
     "event ring for interesting transfers.  0 logs every record.")
+PROFILE_EVENT_LOG_MAX_BYTES = conf(
+    "spark.rapids.sql.profile.eventLog.maxBytes", 134217728,
+    "Size-based rotation bound for the profile event-log JSONL sink "
+    "(and the telemetry snapshot records riding it): when an append "
+    "would push the file past this many bytes it is rotated to "
+    "<path>.1 (older rotations shift to .2, .3, ...) so long-running "
+    "serving never grows one unbounded file.  0 disables rotation "
+    "(the pre-rotation behavior).")
+PROFILE_EVENT_LOG_KEEP_FILES = conf(
+    "spark.rapids.sql.profile.eventLog.keepFiles", 4,
+    "How many rotated event-log files (<path>.1 .. <path>.N) to "
+    "retain; the oldest is dropped at each rotation.  0 discards the "
+    "full file at rotation instead of keeping any history.")
+
+# --- engine-wide telemetry (utils/telemetry.py) -------------------------------
+TELEMETRY_ENABLED = conf(
+    "spark.rapids.sql.telemetry.enabled", False,
+    "Run the process-wide telemetry layer: a live metrics registry "
+    "(HBM budget/in-use and the admission ledger, TPU semaphore "
+    "holds/waiters, scheduler queue depth and admission counters, "
+    "kernel-cache size/evictions/compile time, prefetch hits/stalls, "
+    "in-flight shuffle fetches, speculation/recovery counters, spill "
+    "tier sizes, cumulative data-movement edge bytes) plus a low-rate "
+    "background sampler that builds a device-utilization timeline — "
+    "each sample attributed to busy-compute or a named idle cause "
+    "(queue wait, semaphore wait, pipeline stall, host sync, compile, "
+    "shuffle wait, truly idle).  Surfaced as a Prometheus text "
+    "endpoint (telemetry.port), periodic JSONL snapshots on the "
+    "profile event-log sink, and a slow-query log aggregated by plan "
+    "fingerprint.  Disabled (default) every hook is a single "
+    "module-global read and allocates nothing.")
+TELEMETRY_PORT = conf(
+    "spark.rapids.sql.telemetry.port", 0,
+    "TCP port for the opt-in HTTP exporter (binds 127.0.0.1): GET "
+    "/metrics serves Prometheus text exposition format, GET "
+    "/telemetry a JSON snapshot (gauges + utilization summary + "
+    "slow-query log).  0 (default) starts no server; the in-process "
+    "views (utils.telemetry.prometheus_text / snapshot) are always "
+    "available while telemetry is enabled.")
+TELEMETRY_SAMPLE_PERIOD_MS = conf(
+    "spark.rapids.sql.telemetry.samplePeriodMs", 100.0,
+    "Period of the utilization sampler: each tick attributes the "
+    "instant to busy-compute or a named idle cause using the "
+    "already-instrumented heartbeats, semaphore, scheduler queue, "
+    "prefetch queues, and in-flight fetches.  Low-rate by design — "
+    "at the default 100ms a sample costs a handful of lock-free "
+    "reads, far inside the telemetry overhead budget (<2%).")
+TELEMETRY_TIMELINE_SIZE = conf(
+    "spark.rapids.sql.telemetry.timelineSize", 4096,
+    "Bound on retained utilization-timeline samples (a ring buffer; "
+    "cause PERCENTAGES aggregate over the whole process lifetime "
+    "regardless).  4096 samples at the default period is ~7 minutes "
+    "of full-resolution timeline.")
+TELEMETRY_SNAPSHOT_PERIOD_S = conf(
+    "spark.rapids.sql.telemetry.snapshotPeriodS", 10.0,
+    "Period of the JSONL telemetry snapshots (gauges + utilization "
+    "summary) appended to the profile event-log sink "
+    "(spark.rapids.sql.profile.eventLog.path) with kind="
+    "'telemetry_snapshot'.  0 disables periodic snapshots; snapshots "
+    "also require the event-log path to be set.")
+TELEMETRY_SLOW_QUERY_LOG_SIZE = conf(
+    "spark.rapids.sql.telemetry.slowQueryLog.size", 64,
+    "How many distinct plan fingerprints the slow-query log retains "
+    "(least-recently-updated dropped first).  Each entry aggregates "
+    "the completed QueryProfiles of one plan shape: run count, "
+    "p50/p95/max wall clock, and the top idle cause from the "
+    "wall-clock breakdown.  Requires spark.rapids.sql.profile.enabled "
+    "on the queries to be aggregated.")
 
 # --- concurrent multi-query serving (exec/scheduler.py) ----------------------
 SCHED_ENABLED = conf(

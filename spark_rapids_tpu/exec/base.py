@@ -118,6 +118,25 @@ def kernel_cache_evictions() -> int:
     return _GLOBAL_KERNELS_EVICTIONS
 
 
+#: cumulative trace/compile accounting (telemetry registry): every
+#: `_build_watched` builder run lands here, private-cache and global
+#: alike, so compile cost is visible process-wide even when the profile
+#: span layer is off
+_COMPILE_STATS_LOCK = threading.Lock()
+_COMPILE_NS_TOTAL = 0
+_COMPILE_COUNT = 0
+
+
+def kernel_cache_compiles() -> int:
+    with _COMPILE_STATS_LOCK:
+        return _COMPILE_COUNT
+
+
+def kernel_cache_compile_ms() -> float:
+    with _COMPILE_STATS_LOCK:
+        return _COMPILE_NS_TOTAL / 1e6
+
+
 class KernelCache:
     """Caches jitted executables per (scope, key, signature).
 
@@ -145,7 +164,15 @@ class KernelCache:
         with W.heartbeat(label, kind="compile"), \
                 P.span(label, cat=P.CAT_COMPILE):
             W.maybe_hang("compile")
-            return builder()
+            import time as _time
+            t0 = _time.perf_counter_ns()
+            try:
+                return builder()
+            finally:
+                global _COMPILE_NS_TOTAL, _COMPILE_COUNT
+                with _COMPILE_STATS_LOCK:
+                    _COMPILE_NS_TOTAL += _time.perf_counter_ns() - t0
+                    _COMPILE_COUNT += 1
 
     def get_or_build(self, key: tuple, builder: Callable[[], Callable]):
         if self._scope is None:

@@ -77,10 +77,26 @@ _STATS_LOCK = threading.Lock()
 _STATS = {"producers": 0, "hits": 0, "stalls": 0, "wait_ns": 0,
           "blocked_puts": 0, "leaked_producers": 0}
 
+# LIVE occupancy (vs the cumulative counters above): how many consumers
+# are blocked on an empty queue / producers parked on a full one RIGHT
+# NOW — the telemetry sampler's pipeline_stall classification.  Bumped
+# only on the (already slow) blocking edges, never the hit path.
+_LIVE_STATS = {"stalled_consumers": 0, "blocked_producers": 0}
+
 
 def pipeline_stats() -> dict:
     with _STATS_LOCK:
         return dict(_STATS)
+
+
+def pipeline_live() -> dict:
+    with _STATS_LOCK:
+        return dict(_LIVE_STATS)
+
+
+def _bump_live(name: str, delta: int) -> None:
+    with _STATS_LOCK:
+        _LIVE_STATS[name] += delta
 
 
 def reset_pipeline_stats() -> None:
@@ -176,6 +192,7 @@ class PrefetchIterator:
         # get here when the queue was empty), and a no-op unprofiled
         sp = P.span(f"pipeline-wait:{self._label}", cat=P.CAT_WAIT) \
             if P.tracer() is not None else P._NULL_SPAN
+        _bump_live("stalled_consumers", 1)
         try:
             with sp:
                 while True:
@@ -198,6 +215,7 @@ class PrefetchIterator:
                             except queue.Empty:
                                 return _DONE
         finally:
+            _bump_live("stalled_consumers", -1)
             waited = time.perf_counter_ns() - t0
             _bump("stalls")
             _bump("wait_ns", waited)
@@ -323,6 +341,7 @@ class PrefetchIterator:
         from spark_rapids_tpu.memory.semaphore import TpuSemaphore
         from contextlib import nullcontext
         _bump("blocked_puts")
+        _bump_live("blocked_producers", 1)
         self.blocked.set()
         hb = self._hb
         try:
@@ -343,6 +362,7 @@ class PrefetchIterator:
                         continue
                 return False
         finally:
+            _bump_live("blocked_producers", -1)
             self.blocked.clear()
 
 

@@ -386,6 +386,12 @@ class QueryScheduler:
         with self._cv:
             return dict(self._stats)
 
+    def queue_depth(self) -> int:
+        """Queries parked in the admission queue RIGHT NOW (telemetry
+        gauge + the sampler's queue_wait classification)."""
+        with self._cv:
+            return len(self._queue)
+
     def describe(self) -> str:
         """One-line admission state for watchdog dumps / heartbeats."""
         from spark_rapids_tpu.memory.device_manager import DeviceManager
@@ -425,6 +431,12 @@ class QueryScope:
         self.qc = QueryContext(conf)
         self._prev_tls = getattr(_TLS, "qc", None)
         _TLS.qc = self.qc
+        # engine-wide telemetry (utils/telemetry.py): lazy-started on
+        # the first collect whose conf enables it; the in-flight query
+        # count feeds the utilization sampler's idle/host attribution
+        from spark_rapids_tpu.utils import telemetry as T
+        T.maybe_start(conf)
+        T.note_query_begin()
         try:
             self.prof_owner = P.begin_query(conf)
             QueryScheduler.get().admit(self.qc, conf)
@@ -442,12 +454,14 @@ class QueryScope:
             return
         self.owns = False
         from spark_rapids_tpu.utils import profile as P
+        from spark_rapids_tpu.utils import telemetry as T
         try:
             if end_profile:
                 P.end_query(self.prof_owner, self.qc.report_plan,
                             error=error)
         finally:
             QueryScheduler.get().release(self.qc)
+            T.note_query_end()
             _TLS.qc = self._prev_tls
 
 

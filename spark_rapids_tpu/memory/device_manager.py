@@ -115,6 +115,12 @@ class DeviceManager:
         return cls.initialize()
 
     @classmethod
+    def peek(cls) -> Optional["DeviceManager"]:
+        """The live instance WITHOUT constructing one — telemetry
+        scrapes must never boot the device."""
+        return cls._instance
+
+    @classmethod
     def shutdown(cls) -> None:
         with cls._lock:
             cls._instance = None
@@ -230,3 +236,17 @@ class DeviceManager:
     def admitted_bytes(self) -> int:
         with self._acct:
             return sum(self._admitted.values())
+
+    def telemetry_gauges(self) -> dict:
+        """One consistent HBM accounting snapshot for the telemetry
+        registry: capacity, budget, store-resident vs reserved bytes,
+        and the admission ledger (utils/telemetry.py)."""
+        with self._acct:
+            return {
+                "hbm_total": self.hbm_total,
+                "budget": self.budget,
+                "store_bytes": self._store_bytes,
+                "reserved_bytes": self._reserved,
+                "admitted_bytes": sum(self._admitted.values()),
+                "admitted_queries": len(self._admitted),
+            }

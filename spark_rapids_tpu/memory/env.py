@@ -60,6 +60,13 @@ class ResourceEnv:
             return cls._instance
 
     @classmethod
+    def peek(cls) -> Optional["ResourceEnv"]:
+        """The live environment WITHOUT constructing one (telemetry
+        scrapes must never initialize the store chain)."""
+        with cls._lock:
+            return cls._instance
+
+    @classmethod
     def shutdown(cls) -> None:
         with cls._lock:
             if cls._instance is not None:

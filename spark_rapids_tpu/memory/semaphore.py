@@ -253,6 +253,18 @@ class TpuSemaphore:
         with self._cv:
             return self._permits
 
+    def waiting_count(self) -> int:
+        """Tasks currently blocked waiting for a permit (telemetry
+        gauge; snapshot() renders the full who-waits-on-whom table)."""
+        with self._cv:
+            return len(self._waiters)
+
+    def wait_stats(self) -> dict:
+        """Blocked-acquire counters for the telemetry registry."""
+        with self._cv:
+            return {"longest_wait_ms": self._longest_wait_ms,
+                    "wait_count": self._wait_count}
+
     def snapshot(self) -> dict:
         """Diagnostic copy for the watchdog dump: the per-task refcount
         table, per-query permit holds, the live waiter list (who is

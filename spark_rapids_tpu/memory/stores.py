@@ -138,6 +138,13 @@ class BufferStore:
         with self._lock:
             return self._buffers.get(bid)
 
+    def stats(self) -> dict:
+        """Resident bytes + buffer count for this tier (telemetry
+        gauge; `current_size` alone races the buffer table)."""
+        with self._lock:
+            return {"bytes": self.current_size,
+                    "buffers": len(self._buffers)}
+
     def mark_acquired(self, buf: SpillableBuffer) -> None:
         """Pinned buffers leave the spill queue."""
         h = getattr(buf, "_spill_handle", None)

@@ -80,6 +80,13 @@ def inflight_fetches() -> list[dict]:
     return snaps
 
 
+def inflight_count() -> int:
+    """Fetches in flight right now — the cheap telemetry-gauge /
+    sampler probe (no snapshot copies)."""
+    with _INFLIGHT_LOCK:
+        return len(_INFLIGHT)
+
+
 # ---------------------------------------------------------------------------
 # fetch-latency tracking for hedged reads: completed fetch durations
 # feed the hedge trigger's delay quantile, so "straggling" is judged

@@ -256,6 +256,12 @@ class TpuShuffleManager:
             return cls._managers.get(executor_id)
 
     @classmethod
+    def live_executors(cls) -> int:
+        """Registered in-process shuffle executors (telemetry gauge)."""
+        with cls._registry_lock:
+            return len(cls._managers)
+
+    @classmethod
     def get_or_create(cls, executor_id: str,
                       env: Optional[ResourceEnv] = None,
                       conf: Optional[C.RapidsConf] = None

@@ -87,6 +87,27 @@ _RECV_SITE_PREFIX = "recv"
 SITE_WASTED = "wasted"
 
 
+# process-lifetime cumulative edge totals across EVERY query's ledger
+# (utils/telemetry.py movement_bytes_total gauge): per-query ledgers die
+# with their profiles, but an operator watching a Prometheus scrape
+# needs the fleet-wide trajectory.  Bumped inside record() — only while
+# movement accounting is on, so the disabled path is untouched.
+_PROC_LOCK = threading.Lock()
+_PROC_EDGE_TOTALS: dict[str, int] = {}
+
+
+def process_edge_totals() -> dict:
+    """{edge: cumulative counted bytes} since process start (or the
+    last reset)."""
+    with _PROC_LOCK:
+        return dict(_PROC_EDGE_TOTALS)
+
+
+def reset_process_edge_totals() -> None:
+    with _PROC_LOCK:
+        _PROC_EDGE_TOTALS.clear()
+
+
 class DataMovementLedger:
     """Byte accounting for one query.  Thread-safe; aggregation is a
     dict update per record, so the enabled path stays inside the
@@ -136,6 +157,10 @@ class DataMovementLedger:
                 cum = self._edge_cum.get(edge, 0) + nbytes
                 self._edge_cum[edge] = cum
                 self._samples.append((ts, edge, cum))
+        if counted:
+            with _PROC_LOCK:
+                _PROC_EDGE_TOTALS[edge] = \
+                    _PROC_EDGE_TOTALS.get(edge, 0) + nbytes
         tr = self.tracer
         if tr is not None and not tr.ended \
                 and nbytes >= self.min_event_bytes:

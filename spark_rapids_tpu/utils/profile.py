@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import collections
 import json
+import os
 import threading
 import time
 from typing import Callable, Iterator, Optional
@@ -412,6 +413,10 @@ def end_query(owner: Optional[QueryTracer], plan=None,
     with _HISTORY_LOCK:
         _HISTORY.append(profile)
         del _HISTORY[:max(0, len(_HISTORY) - hist_size)]
+    # engine-wide telemetry: aggregate this profile into the
+    # slow-query log (one global read when telemetry is off)
+    from spark_rapids_tpu.utils import telemetry as T
+    T.note_query_profile(profile, plan)
     try:
         profile.flush_sinks(owner.conf)
     except OSError:
@@ -424,6 +429,40 @@ def end_query(owner: Optional[QueryTracer], plan=None,
 
 _HISTORY_LOCK = threading.Lock()
 _HISTORY: list["QueryProfile"] = []
+
+# ---------------------------------------------------------------------------
+# size-bounded JSONL appends: the profile event-log sink (and the
+# telemetry snapshots riding it) used to grow one file without limit
+# under long-running serving
+_ROTATE_LOCK = threading.Lock()
+
+
+def rotating_append(path: str, text: str, max_bytes: int = 0,
+                    keep: int = 1) -> None:
+    """Append `text` to `path`, rotating first when the append would
+    push the file past `max_bytes` (0 = never rotate): the current
+    file becomes `<path>.1`, existing rotations shift to `.2` ...
+    `.keep`, and the oldest is dropped.  One process-wide lock
+    serializes concurrent queries' appends so a rotation never races a
+    write."""
+    with _ROTATE_LOCK:
+        if max_bytes > 0:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                size = 0
+            if size > 0 and size + len(text) > max_bytes:
+                keep = max(0, int(keep))
+                for i in range(keep - 1, 0, -1):
+                    src = f"{path}.{i}"
+                    if os.path.exists(src):
+                        os.replace(src, f"{path}.{i + 1}")
+                if keep >= 1:
+                    os.replace(path, f"{path}.1")
+                else:
+                    os.remove(path)
+        with open(path, "a") as f:
+            f.write(text)
 
 
 def profile_history() -> list["QueryProfile"]:
@@ -670,11 +709,15 @@ class QueryProfile:
             json.dump(self.chrome_trace(), f)
         return path
 
-    def write_event_log(self, path: str, append: bool = True) -> str:
+    def write_event_log(self, path: str, append: bool = True,
+                        max_bytes: int = 0, keep: int = 1) -> str:
         path = path.replace("{query_id}", self.query_id)
-        with open(path, "a" if append else "w") as f:
-            for rec in self.events:
-                f.write(json.dumps(rec) + "\n")
+        text = "".join(json.dumps(rec) + "\n" for rec in self.events)
+        if not append:
+            with open(path, "w") as f:
+                f.write(text)
+            return path
+        rotating_append(path, text, max_bytes, keep)
         return path
 
     def flush_sinks(self, conf: C.RapidsConf) -> None:
@@ -683,7 +726,10 @@ class QueryProfile:
             self.write_chrome_trace(trace_path)
         log_path = str(conf[C.PROFILE_EVENT_LOG_PATH])
         if log_path:
-            self.write_event_log(log_path)
+            self.write_event_log(
+                log_path,
+                max_bytes=int(conf[C.PROFILE_EVENT_LOG_MAX_BYTES]),
+                keep=int(conf[C.PROFILE_EVENT_LOG_KEEP_FILES]))
 
     def __repr__(self):
         return (f"QueryProfile({self.query_id}, wall={self.wall_s:.3f}s,"

@@ -635,6 +635,15 @@ def build_dump(stuck: Optional[Heartbeat] = None) -> str:
                      f"slow_injected={slow_injection_counts()}")
     except Exception as e:  # noqa: BLE001
         lines.append(f"  <unavailable: {e}>")
+    lines.append("-- telemetry --")
+    try:
+        # engine-wide state (gauges + recent utilization samples) so a
+        # post-mortem shows what the whole process was doing, not just
+        # the stuck query's threads
+        from spark_rapids_tpu.utils import telemetry as T
+        lines.append(T.describe_for_dump())
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"  <unavailable: {e}>")
     lines.append("-- hang injection --")
     try:
         with _INJ_LOCK:

@@ -1,0 +1,423 @@
+"""Engine-wide telemetry suite (ISSUE 10): metrics-registry semantics,
+Prometheus exposition validity, a live HTTP scrape during a concurrent
+q1/q5 storm, utilization-sampler attribution, the slow-query log, the
+event-log rotation bound, and the disabled-path guarantees (single
+global read, bit-exact parity)."""
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+from pandas.testing import assert_frame_equal
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+from spark_rapids_tpu.utils import profile as P
+from spark_rapids_tpu.utils import telemetry as T
+
+# same scale/partitioning/conf as test_scheduler's storm fixtures, so a
+# full-suite run reuses its warm q1/q5 kernels instead of compiling a
+# fresh capacity bucket just for this module
+SCALE = 400
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return gen_tables(np.random.default_rng(11), SCALE)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_cleanup():
+    yield
+    T.stop()
+    P.clear_history()
+
+
+def _conf(**extra) -> C.RapidsConf:
+    settings = dict(BENCH_CONF)
+    settings.update({k.replace("__", "."): v for k, v in extra.items()})
+    return C.RapidsConf(settings)
+
+
+def _tstart(**extra) -> T.Telemetry:
+    return T.start(_conf(**{
+        "spark.rapids.sql.telemetry.enabled": True,
+        "spark.rapids.sql.telemetry.samplePeriodMs": 10.0,
+        **{k.replace("__", "."): v for k, v in extra.items()}}))
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+def test_registry_counter_gauge_histogram():
+    r = T.MetricsRegistry()
+    c = r.counter("t_c_total", "a counter")
+    c.inc()
+    c.inc(2)
+    lc = r.counter("t_lc_total", "labelled counter", label="cause")
+    lc.inc(1, "busy")
+    lc.inc(3, "idle")
+    r.gauge("t_g", "a gauge", fn=lambda: 7)
+    r.gauge("t_lab", "labelled gauge", fn=lambda: {"a": 1, "b": 2},
+            label="k")
+    h = r.histogram("t_h_seconds", "a histogram", (0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = r.snapshot()
+    assert snap["t_c_total"] == 3
+    assert snap["t_lc_total{cause=busy}"] == 1
+    assert snap["t_lc_total{cause=idle}"] == 3
+    assert snap["t_g"] == 7
+    assert snap["t_lab{k=a}"] == 1 and snap["t_lab{k=b}"] == 2
+    assert snap["t_h_seconds_count"] == 3
+    assert snap["t_h_seconds_sum"] == pytest.approx(5.55)
+    # registration is idempotent by name: same object back
+    assert r.counter("t_c_total", "other help") is c
+    # push-style gauge
+    g = r.gauge("t_set", "set gauge")
+    g.set(42)
+    assert r.snapshot()["t_set"] == 42
+
+
+def test_broken_gauge_does_not_break_scrape():
+    r = T.MetricsRegistry()
+
+    def boom():
+        raise RuntimeError("probe died")
+
+    r.gauge("t_broken", "raises", fn=boom)
+    r.gauge("t_ok", "fine", fn=lambda: 1)
+    text = r.prometheus_text()
+    assert "t_ok 1" in text
+    assert "t_broken" not in [ln.split(" ")[0] for ln in
+                              text.splitlines()
+                              if not ln.startswith("#")]
+    assert r.snapshot()["t_ok"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition-format validity
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"\})?'  # optional one-label set
+    r" (-?[0-9.+e(inf)(nan)]+|[0-9]+)$", re.IGNORECASE)
+
+
+def _parse_prom(text: str) -> dict:
+    """{name or name{label="v"}: float} for every sample line; raises
+    on a malformed line."""
+    out = {}
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"malformed exposition line: {ln!r}"
+        key = m.group(1) + (m.group(2) or "")
+        out[key] = float(ln.rsplit(" ", 1)[1])
+    return out
+
+
+def test_prometheus_exposition_valid():
+    r = T.MetricsRegistry()
+    c = r.counter("t_c_total", "counter help\nwith newline")
+    c.inc(5)
+    r.gauge("t_g", "gauge", fn=lambda: 1.5)
+    r.gauge("t_edges", "per-edge", fn=lambda: {"upload": 10, "wire": 3},
+            label="edge")
+    h = r.histogram("t_h_seconds", "hist", (0.1, 1.0, 10.0))
+    for v in (0.05, 0.05, 0.5, 20.0):
+        h.observe(v)
+    text = r.prometheus_text()
+    samples = _parse_prom(text)
+    assert samples["t_c_total"] == 5
+    assert samples["t_g"] == 1.5
+    assert samples['t_edges{edge="upload"}'] == 10
+    # HELP/TYPE lines present, newline escaped
+    assert "# TYPE t_c_total counter" in text
+    assert "# HELP t_c_total counter help\\nwith newline" in text
+    assert "# TYPE t_h_seconds histogram" in text
+    # histogram: buckets cumulative + monotone, +Inf == count
+    buckets = [samples[f't_h_seconds_bucket{{le="{le}"}}']
+               for le in ("0.1", "1", "10")]
+    assert buckets == sorted(buckets) == [2, 3, 3]
+    assert samples['t_h_seconds_bucket{le="+Inf"}'] == 4
+    assert samples["t_h_seconds_count"] == 4
+    assert samples["t_h_seconds_sum"] == pytest.approx(20.6)
+
+
+# ---------------------------------------------------------------------------
+# utilization sampler
+def test_sampler_attribution_sums_to_100(tables):
+    t = _tstart()
+    run_query(1, tables, engine="tpu", conf=_conf())
+    time.sleep(0.2)
+    s = t.utilization_summary()
+    assert s["samples"] > 5
+    total = sum(v for k, v in s.items() if k != "samples")
+    assert 99.0 <= total <= 101.0, s
+    assert all(k in T.CAUSES or k == "samples" for k in s)
+
+
+def test_sampler_idle_when_nothing_runs():
+    t = _tstart()
+    time.sleep(0.3)
+    assert T.active_queries() == 0
+    s = t.utilization_summary()
+    assert s.get("idle", 0) > 50.0, s
+
+
+def test_timeline_bounded():
+    t = _tstart(**{"spark.rapids.sql.telemetry.timelineSize": 16,
+                   "spark.rapids.sql.telemetry.samplePeriodMs": 5.0})
+    time.sleep(0.5)
+    tl = t.utilization_timeline()
+    assert 0 < len(tl) <= 16
+    # percentages still aggregate over ALL samples, not just retained
+    assert t.utilization_summary()["samples"] >= len(tl)
+
+
+# ---------------------------------------------------------------------------
+# live scrape during a concurrent q1/q5 storm
+def test_live_scrape_during_storm(tables):
+    t = T.start(_conf(**{
+        "spark.rapids.sql.telemetry.enabled": True,
+        "spark.rapids.sql.telemetry.samplePeriodMs": 10.0}),
+        http_port=0)
+    conf = _conf(**{"spark.rapids.sql.profile.enabled": True})
+    # serial expected results (also warms the kernel cache)
+    expected = {q: run_query(q, tables, engine="tpu", conf=_conf())
+                for q in (1, 5)}
+
+    mix = [1, 5, 1, 5, 1, 5, 1, 5]
+    results = [None] * len(mix)
+    errors = []
+    storm_live = threading.Event()
+
+    def worker(i, q):
+        try:
+            storm_live.set()
+            results[i] = run_query(q, tables, engine="tpu", conf=conf)
+        except BaseException as e:  # noqa: BLE001
+            errors.append((i, e))
+
+    mark = t.utilization_counts()
+    t0 = time.time()
+    threads = [threading.Thread(target=worker, args=(i, q))
+               for i, q in enumerate(mix)]
+    for th in threads:
+        th.start()
+    storm_live.wait(10.0)
+    # live scrapes WHILE the storm runs
+    scraped = []
+    url = f"http://127.0.0.1:{t.http_port}/metrics"
+    while any(th.is_alive() for th in threads):
+        scraped.append(
+            urllib.request.urlopen(url, timeout=10).read().decode())
+        time.sleep(0.05)
+    for th in threads:
+        th.join(120)
+    wall = time.time() - t0
+    assert not errors, errors
+    for i, q in enumerate(mix):
+        assert_frame_equal(results[i].reset_index(drop=True),
+                           expected[q].reset_index(drop=True))
+    assert scraped, "storm finished before a single scrape"
+    samples = _parse_prom(scraped[-1])
+    # the operator's storm dashboard: HBM, semaphore, queue depth, and
+    # kernel cache must all be present and parseable
+    assert samples["tpu_rapids_hbm_budget_bytes"] > 0
+    assert "tpu_rapids_hbm_admitted_bytes" in samples
+    assert "tpu_rapids_semaphore_max_concurrent" in samples
+    assert "tpu_rapids_scheduler_queue_depth" in samples
+    assert samples["tpu_rapids_kernel_cache_entries"] > 0
+    # >= 95% of query wall-clock attributed to a NAMED cause: every
+    # sample carries exactly one cause from the fixed vocabulary, and
+    # the storm window must actually have been sampled densely
+    during = t.utilization_summary(baseline=mark)
+    assert during["samples"] >= max(5, 0.5 * wall / 0.01), during
+    named = sum(v for k, v in during.items() if k != "samples")
+    assert named >= 95.0, during
+    assert all(k in T.CAUSES or k == "samples" for k in during)
+    # with 8 concurrent sessions the engine must not have looked idle
+    assert during.get("idle", 0.0) < 50.0, during
+
+
+def test_http_endpoint_telemetry_json_and_404():
+    t = _tstart_with_port()
+    base = f"http://127.0.0.1:{t.http_port}"
+    snap = json.loads(urllib.request.urlopen(
+        base + "/telemetry", timeout=5).read())
+    assert set(snap) >= {"gauges", "utilization", "active_queries",
+                         "slow_queries"}
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(base + "/nope", timeout=5)
+    assert ei.value.code == 404
+
+
+def _tstart_with_port() -> T.Telemetry:
+    return T.start(_conf(**{
+        "spark.rapids.sql.telemetry.enabled": True,
+        "spark.rapids.sql.telemetry.samplePeriodMs": 10.0}),
+        http_port=0)
+
+
+# ---------------------------------------------------------------------------
+# slow-query log
+def test_slow_query_log_fingerprint_aggregation(tables):
+    t = _tstart()
+    conf = _conf(**{"spark.rapids.sql.profile.enabled": True})
+    for _ in range(3):
+        run_query(1, tables, engine="tpu", conf=conf)
+    run_query(5, tables, engine="tpu", conf=conf)
+    log = t.slow_query_log()
+    assert len(log) == 2
+    by_count = {e["count"]: e for e in log}
+    assert set(by_count) == {3, 1}
+    q1e = by_count[3]
+    assert q1e["p50_ms"] <= q1e["p95_ms"] <= q1e["max_ms"]
+    assert q1e["p50_ms"] > 0
+    assert isinstance(q1e["top_idle_cause"], str)
+    assert q1e["fingerprint"] != by_count[1]["fingerprint"]
+    # same plan shape -> same fingerprint (aggregated, not 3 entries)
+    assert sum(e["count"] for e in log) == 4
+
+
+def test_slow_query_log_bounded(tables):
+    t = _tstart(**{"spark.rapids.sql.telemetry.slowQueryLog.size": 2})
+    conf = _conf(**{"spark.rapids.sql.profile.enabled": True})
+    run_query(1, tables, engine="tpu", conf=conf)
+    run_query(5, tables, engine="tpu", conf=conf)
+    # third distinct plan SHAPE without new kernel shapes: the same q1
+    # over a different partition count fingerprints differently
+    run_query(1, tables, engine="tpu", conf=conf, num_partitions=4)
+    assert len(t.slow_query_log()) == 2
+
+
+# ---------------------------------------------------------------------------
+# movement edge bytes reach the process-wide gauge
+def test_movement_edge_totals_exported(tables):
+    from spark_rapids_tpu.utils import movement as MV
+    t = _tstart()
+    before = MV.process_edge_totals().get("readback", 0)
+    run_query(1, tables, engine="tpu",
+              conf=_conf(**{"spark.rapids.sql.profile.enabled": True}))
+    assert MV.process_edge_totals().get("readback", 0) > before
+    samples = _parse_prom(t.registry.prometheus_text())
+    assert samples['tpu_rapids_movement_bytes_total{edge="readback"}'] \
+        > 0
+
+
+# ---------------------------------------------------------------------------
+# event-log rotation (satellite: the sink used to grow without limit)
+def test_rotating_append_unit(tmp_path):
+    path = str(tmp_path / "log.jsonl")
+    line = "x" * 99 + "\n"
+    for _ in range(10):
+        P.rotating_append(path, line, max_bytes=250, keep=2)
+    import os
+    assert os.path.getsize(path) <= 250
+    assert os.path.exists(path + ".1")
+    assert os.path.exists(path + ".2")
+    assert not os.path.exists(path + ".3")  # keep-2 bound holds
+    # unbounded mode never rotates
+    path2 = str(tmp_path / "log2.jsonl")
+    for _ in range(10):
+        P.rotating_append(path2, line, max_bytes=0, keep=2)
+    assert os.path.getsize(path2) == 1000
+    assert not os.path.exists(path2 + ".1")
+
+
+def test_event_log_rotation_under_queries(tmp_path, tables):
+    import os
+    path = str(tmp_path / "events.jsonl")
+    max_bytes = 30_000  # ~2-3 queries' events per file at this scale
+    conf = _conf(**{
+        "spark.rapids.sql.profile.enabled": True,
+        "spark.rapids.sql.profile.eventLog.path": path,
+        "spark.rapids.sql.profile.eventLog.maxBytes": max_bytes,
+        "spark.rapids.sql.profile.eventLog.keepFiles": 2})
+    for _ in range(6):
+        run_query(1, tables, engine="tpu", conf=conf)
+    assert os.path.exists(path)
+    # one append may overshoot only if a single query's events exceed
+    # the bound; otherwise the live file stays under it
+    assert os.path.exists(path + ".1"), "rotation never happened"
+    assert not os.path.exists(path + ".3")
+    sizes = [os.path.getsize(p) for p in
+             (path, path + ".1") if os.path.exists(p)]
+    assert all(s <= max_bytes for s in sizes)
+    # rotated files still hold valid JSONL event records
+    with open(path + ".1") as f:
+        first = json.loads(f.readline())
+    assert "query_id" in first and "kind" in first
+
+
+def test_telemetry_snapshot_rides_event_log(tmp_path, tables):
+    path = str(tmp_path / "events.jsonl")
+    _tstart(**{
+        "spark.rapids.sql.profile.eventLog.path": path,
+        "spark.rapids.sql.telemetry.snapshotPeriodS": 0.05,
+        "spark.rapids.sql.telemetry.samplePeriodMs": 10.0})
+    time.sleep(0.4)
+    import os
+    assert os.path.exists(path)
+    kinds = [json.loads(ln)["kind"] for ln in open(path)]
+    assert "telemetry_snapshot" in kinds
+    rec = next(json.loads(ln) for ln in open(path)
+               if json.loads(ln)["kind"] == "telemetry_snapshot")
+    assert "gauges" in rec and "utilization" in rec
+
+
+# ---------------------------------------------------------------------------
+# watchdog dump embeds a telemetry snapshot
+def test_watchdog_dump_embeds_telemetry(tables):
+    from spark_rapids_tpu.utils.watchdog import build_dump
+    _tstart()
+    run_query(1, tables, engine="tpu", conf=_conf())
+    time.sleep(0.05)
+    dump = build_dump()
+    assert "-- telemetry --" in dump
+    assert "tpu_rapids_hbm_budget_bytes" in dump
+    assert "utilization:" in dump
+    T.stop()
+    dump_off = build_dump()
+    assert "<telemetry disabled>" in dump_off
+
+
+# ---------------------------------------------------------------------------
+# disabled path: single global read, no server, bit-exact parity
+def test_disabled_path_and_bit_exact_parity(tables):
+    assert T.live() is None
+    conf_off = _conf()
+    off1 = run_query(1, tables, engine="tpu", conf=conf_off)
+    # a default-conf collect must not have started telemetry
+    assert T.live() is None
+    assert T.maybe_start(conf_off) is None
+    assert T.prometheus_text() == ""
+    assert T.snapshot() is None
+    # the per-query hooks are no-ops that allocate no telemetry state
+    T.note_query_profile(None, None)
+    n0 = T.active_queries()
+    T.note_query_begin()
+    T.note_query_end()
+    assert T.active_queries() == n0
+    # enabled run: bit-exact vs disabled (telemetry observes, never
+    # perturbs), and a following disabled-conf run stays bit-exact too
+    on = run_query(1, tables, engine="tpu", conf=_conf(**{
+        "spark.rapids.sql.telemetry.enabled": True}))
+    assert T.live() is not None
+    off2 = run_query(1, tables, engine="tpu", conf=conf_off)
+    assert_frame_equal(off1.reset_index(drop=True),
+                       on.reset_index(drop=True))
+    assert_frame_equal(off1.reset_index(drop=True),
+                       off2.reset_index(drop=True))
+
+
+def test_active_query_counter_balanced(tables):
+    _tstart()
+    assert T.active_queries() == 0
+    run_query(1, tables, engine="tpu", conf=_conf())
+    assert T.active_queries() == 0  # begin/end balanced per collect
