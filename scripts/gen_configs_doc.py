@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Generate docs/configs.md from the typed conf registry — or, with
+--check, verify the checked-in file matches what the registry would
+generate (the drift gate the lint lane runs: a conf added/edited in
+config.py without regenerating docs fails CI instead of silently
+diverging, giving tpulint's conf-discipline rule a documentation
+counterpart).
+
+    python scripts/gen_configs_doc.py            # (re)write docs/configs.md
+    python scripts/gen_configs_doc.py --check    # exit 1 on drift
+"""
+import argparse
+import difflib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", nargs="?", default="docs/configs.md")
+    ap.add_argument("--check", action="store_true",
+                    help="diff regenerated output against the file "
+                         "and fail on drift instead of writing")
+    args = ap.parse_args(argv)
+
+    from spark_rapids_tpu import config as C
+    want = C.help_text()
+    if not args.check:
+        C.write_docs(args.path)
+        print(f"wrote {args.path}")
+        return 0
+    try:
+        with open(args.path) as f:
+            have = f.read()
+    except OSError as e:
+        print(f"configs drift gate: cannot read {args.path}: {e}")
+        return 1
+    if have == want:
+        n = sum(1 for ln in want.splitlines()
+                if ln.startswith("| `"))
+        print(f"configs drift gate: ok ({n} documented confs)")
+        return 0
+    diff = list(difflib.unified_diff(
+        have.splitlines(), want.splitlines(),
+        fromfile=args.path, tofile="<registry>", lineterm=""))
+    print("\n".join(diff[:60]))
+    print(f"configs drift gate: {args.path} is stale — run "
+          "'python scripts/gen_configs_doc.py' and commit the result")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""tpulint CLI: run the engine-invariant checker over the repo.
+
+    python scripts/lint.py                     # lint spark_rapids_tpu
+    python scripts/lint.py --format json       # CI lane output
+    python scripts/lint.py --disable host-sync # prove a rule is load-bearing
+    python scripts/lint.py --write-baseline    # grandfather current findings
+                                               # (repo policy: keep it empty)
+
+Exits 0 iff there are no active (unsuppressed, unbaselined) findings.
+The linter is pure stdlib-ast — it never imports the engine it checks,
+so it needs no JAX/device environment.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spark_rapids_tpu.analysis import (  # noqa: E402
+    ALL_RULES, format_json, format_text, run_lint, summary_line,
+    write_baseline)
+from spark_rapids_tpu.analysis.core import DEFAULT_BASELINE  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the "
+                         "spark_rapids_tpu package)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--disable", action="append", default=[],
+                    metavar="RULE", help="disable a rule by id")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of grandfathered findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings as active")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current active findings to the "
+                         "baseline file and exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings (text mode)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.rule_id}: {r.doc}")
+        print("bad-suppress: a tpulint disable comment must carry "
+              "' -- <reason>'")
+        return 0
+
+    result = run_lint(
+        paths=args.paths or None, disable=args.disable,
+        baseline_path=None if args.no_baseline else args.baseline)
+
+    if args.write_baseline:
+        write_baseline(args.baseline, result.findings)
+        print(f"wrote {len(result.findings)} finding(s) to "
+              f"{args.baseline}")
+        return 0
+
+    if args.format == "json":
+        print(format_json(result))
+        print(summary_line(result), file=sys.stderr)
+    else:
+        print(format_text(result,
+                          verbose_suppressed=args.show_suppressed))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,7 +14,21 @@ run_gate() {
   python scripts/multichip_check.py 8
 }
 
+run_lint() {
+  # static-analysis lane (budget <30s, no device/JAX needed): tpulint
+  # enforces the engine invariants (host-sync accounting, semaphore
+  # blocking discipline, bounded waits, conf registration, compile-
+  # outside-the-lock) over the whole package, then the configs drift
+  # gate proves docs/configs.md matches the registry.  The JSON run
+  # feeds tooling; the summary line matches the other lanes.
+  echo "== lint lane (tpulint engine invariants + configs drift gate) =="
+  # JSON on stdout for tooling; the summary line rides stderr
+  python scripts/lint.py --format json > /dev/null
+  python scripts/gen_configs_doc.py --check
+}
+
 run_fast() {
+  run_lint
   run_gate
   echo "== fast tier (unit + integration, virtual 8-device CPU mesh) =="
   "${PYTEST[@]}" tests/ -m "not slow" --ignore=tests/test_workloads.py
@@ -466,6 +480,7 @@ run_bench() {
 }
 
 case "$TIER" in
+  lint)     run_lint ;;
   gate)     run_gate ;;
   fast)     run_fast ;;
   slow)     run_slow ;;
@@ -482,6 +497,6 @@ case "$TIER" in
   speculation) run_speculation ;;
   telemetry) run_telemetry ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|speculation|telemetry|all]" >&2
+  *) echo "usage: $0 [lint|gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|speculation|telemetry|all]" >&2
      exit 2 ;;
 esac

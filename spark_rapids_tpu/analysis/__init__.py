@@ -1,0 +1,19 @@
+"""tpulint: AST-based static enforcement of the engine's invariants.
+
+PRs 1-10 accumulated hard-won runtime disciplines — the host-sync diet
+(PR 2), never block while holding the TPU semaphore without
+`yielded()` (PR 2/6), every indefinite wait is a bounded poll + cancel
+check (PR 4), confs resolve at execution time rather than plan build
+(the PR 2 captured-conf bug class), compile outside the lock (PR 2/7).
+Until now they were enforced only by soak tests that catch violations
+probabilistically; this package makes each one a merge-blocking static
+check (Theseus's "engineer the discipline in" applied to correctness
+tooling).  See docs/dev-guide.md for the rule catalogue.
+
+Usage:  python scripts/lint.py [--format json] [paths...]
+"""
+from spark_rapids_tpu.analysis.core import (  # noqa: F401
+    Finding, LintResult, load_baseline, run_lint, write_baseline)
+from spark_rapids_tpu.analysis.reporters import (  # noqa: F401
+    format_json, format_text, summary_line)
+from spark_rapids_tpu.analysis.rules import ALL_RULES, rule_ids  # noqa: F401

@@ -1,0 +1,297 @@
+"""tpulint engine: file walking, suppressions, baseline, rule driving.
+
+The linter is deliberately stdlib-only (`ast` + `re`): it must run in
+the CI lint lane in well under a second with no environment beyond the
+repo checkout, and it must never import the engine it polices — a
+module with a side-effectful import (device probe, thread start) would
+otherwise make the *linter* flaky.
+
+Suppression grammar (reason mandatory, enforced by the `bad-suppress`
+meta rule):
+
+    some_call()  # tpulint: disable=host-sync -- host ndarray, no device value
+
+A standalone comment line suppresses the line directly below it, so
+79-column code does not have to grow a trailing comment:
+
+    # tpulint: disable=unbounded-wait -- server parks awaiting requests
+    frame = _recv_frame(conn)
+
+The baseline file grandfathers pre-existing findings (keyed by rule +
+path + a hash of the offending line's text, so pure line-number churn
+does not invalidate it).  The repo policy is to FIX true positives in
+the PR that finds them — the baseline exists for emergencies and
+should stay empty.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Optional, Sequence
+
+#: package directories whose batch loops are hot paths: a device->host
+#: materialization here must be accounted (utils.checks.note_host_sync)
+HOT_PATH_PACKAGES = ("exec", "ops", "shuffle", "exprs", "plan")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\- *]+?)"
+    r"(?:\s+--\s*(.*?))?\s*$")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, '/'-separated
+    line: int          # 1-based
+    col: int
+    message: str
+    snippet: str = ""
+    suppressed: bool = False
+    reason: str = ""
+    baselined: bool = False
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def fingerprint(self) -> str:
+        """Line-number-independent identity for the baseline file."""
+        h = hashlib.sha1(
+            f"{self.rule}|{self.path}|{self.snippet.strip()}"
+            .encode()).hexdigest()[:16]
+        return h
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet.strip(),
+                "fingerprint": self.fingerprint()}
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int          # the line this suppression applies to
+    rules: frozenset   # rule ids, or {"*"}
+    reason: str
+    comment_line: int  # where the comment physically lives
+
+    def covers(self, rule: str) -> bool:
+        return "*" in self.rules or rule in self.rules
+
+
+@dataclasses.dataclass
+class FileContext:
+    """Everything a rule pass needs about one source file."""
+    path: str                      # absolute
+    relpath: str                   # repo-relative, '/'-separated
+    tree: ast.Module
+    lines: list[str]
+    conf_keys: frozenset           # registered spark.rapids.* keys
+
+    @property
+    def components(self) -> tuple:
+        return tuple(self.relpath.split("/"))
+
+    @property
+    def is_hot_path(self) -> bool:
+        return any(c in HOT_PATH_PACKAGES for c in self.components[:-1])
+
+    def in_package(self, name: str) -> bool:
+        return name in self.components[:-1]
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list        # active (not suppressed, not baselined)
+    suppressed: list
+    baselined: list
+    bad_suppressions: list  # reason-less disables (active findings too)
+    files_scanned: int
+    rules: list
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+
+# ---------------------------------------------------------------------------
+def parse_suppressions(lines: Sequence[str]) -> tuple[list, list]:
+    """Scan raw source lines for tpulint disable comments.  Returns
+    (suppressions, bad_suppress_lines): a comment without the mandatory
+    ` -- reason` is NOT honored and is itself reported."""
+    sups: list[Suppression] = []
+    bad: list[int] = []
+    for i, raw in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(raw)
+        if m is None:
+            continue
+        rules = frozenset(r.strip() for r in m.group(1).split(",")
+                          if r.strip())
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            bad.append(i)
+            continue
+        # a standalone comment covers the next CODE line (continuation
+        # comment lines may carry the rest of a long reason)
+        target = i
+        if raw.strip().startswith("#"):
+            target = i + 1
+            while (target <= len(lines)
+                   and (not lines[target - 1].strip()
+                        or lines[target - 1].lstrip().startswith("#"))):
+                target += 1
+        sups.append(Suppression(target, rules, reason, i))
+    return sups, bad
+
+
+def collect_conf_keys(config_path: str) -> frozenset:
+    """Registered conf keys, read by PARSING config.py (never importing
+    it): the first string argument of every `conf("spark....", ...)`
+    call.  Returns an empty set when config.py is unreadable — rule 4a
+    then reports nothing rather than everything."""
+    try:
+        with open(config_path) as f:
+            tree = ast.parse(f.read(), filename=config_path)
+    except (OSError, SyntaxError):
+        return frozenset()
+    keys = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "conf" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            keys.add(node.args[0].value)
+    return frozenset(keys)
+
+
+# ---------------------------------------------------------------------------
+def _repo_root() -> str:
+    # analysis/ -> spark_rapids_tpu/ -> repo root
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json")
+
+
+def default_paths() -> list[str]:
+    return [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+
+
+def _iter_py_files(paths: Sequence[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__"
+                             and not d.startswith("."))
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    yield os.path.join(root, n)
+
+
+def load_baseline(path: str) -> frozenset:
+    """Set of grandfathered finding fingerprints (empty when the file
+    is absent — absence means nothing is grandfathered)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return frozenset()
+    return frozenset(e.get("fingerprint", "")
+                     for e in data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    data = {"version": 1,
+            "comment": "grandfathered tpulint findings; the repo "
+                       "policy is to FIX violations, so this should "
+                       "stay empty — see docs/dev-guide.md",
+            "findings": sorted(
+                (dict(f.as_dict(), line=f.line) for f in findings),
+                key=lambda e: (e["path"], e["rule"], e["line"]))}
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+def run_lint(paths: Optional[Sequence[str]] = None,
+             disable: Sequence[str] = (),
+             baseline_path: Optional[str] = DEFAULT_BASELINE,
+             root: Optional[str] = None) -> LintResult:
+    """Run every enabled rule over `paths` (default: the
+    spark_rapids_tpu package).  Suppressions and the baseline are
+    applied here, so rules stay pure (AST in, raw findings out)."""
+    from spark_rapids_tpu.analysis.rules import ALL_RULES
+    root = root or _repo_root()
+    paths = list(paths) if paths else default_paths()
+    rules = [r for r in ALL_RULES if r.rule_id not in set(disable)]
+    conf_keys = collect_conf_keys(
+        os.path.join(root, "spark_rapids_tpu", "config.py"))
+    baseline = (load_baseline(baseline_path)
+                if baseline_path else frozenset())
+
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    baselined: list[Finding] = []
+    bad_sup: list[Finding] = []
+    files = 0
+    for path in _iter_py_files(paths):
+        apath = os.path.abspath(path)
+        rel = os.path.relpath(apath, root)
+        if rel.startswith(".."):
+            rel = os.path.basename(apath)
+        rel = rel.replace(os.sep, "/")
+        try:
+            with open(apath) as f:
+                src = f.read()
+            tree = ast.parse(src, filename=apath)
+        except (OSError, SyntaxError) as e:
+            active.append(Finding("parse-error", rel, 1, 0,
+                                  f"could not lint: {e}"))
+            continue
+        files += 1
+        lines = src.splitlines()
+        ctx = FileContext(apath, rel, tree, lines, conf_keys)
+        sups, bad_lines = parse_suppressions(lines)
+        if "bad-suppress" not in set(disable):
+            for ln in bad_lines:
+                bad_sup.append(Finding(
+                    "bad-suppress", rel, ln, 0,
+                    "tpulint suppression without a reason — write "
+                    "'# tpulint: disable=<rule> -- <why>'",
+                    snippet=ctx.snippet(ln)))
+        by_line: dict[int, list[Suppression]] = {}
+        for s in sups:
+            by_line.setdefault(s.line, []).append(s)
+        for rule in rules:
+            for f in rule.check(ctx):
+                f.snippet = f.snippet or ctx.snippet(f.line)
+                cover = next((s for s in by_line.get(f.line, [])
+                              if s.covers(f.rule)), None)
+                if cover is not None:
+                    f.suppressed = True
+                    f.reason = cover.reason
+                    suppressed.append(f)
+                elif f.fingerprint() in baseline:
+                    f.baselined = True
+                    baselined.append(f)
+                else:
+                    active.append(f)
+    active.extend(bad_sup)
+    active.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintResult(active, suppressed, baselined, bad_sup,
+                      files, [r.rule_id for r in rules])

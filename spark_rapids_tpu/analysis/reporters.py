@@ -1,0 +1,42 @@
+"""tpulint output: text (file:line for humans/editors) and JSON (for
+the CI lane and tooling), plus the one-line summary every run_suite.sh
+lane ends with."""
+from __future__ import annotations
+
+import json
+
+from spark_rapids_tpu.analysis.core import LintResult
+
+
+def format_text(result: LintResult, verbose_suppressed: bool = False
+                ) -> str:
+    lines = []
+    for f in result.findings:
+        lines.append(f"{f.location()}: [{f.rule}] {f.message}")
+        if f.snippet.strip():
+            lines.append(f"    {f.snippet.strip()}")
+    if verbose_suppressed:
+        for f in result.suppressed:
+            lines.append(f"{f.location()}: [{f.rule}] suppressed "
+                         f"({f.reason})")
+    lines.append(summary_line(result))
+    return "\n".join(lines)
+
+
+def format_json(result: LintResult) -> str:
+    return json.dumps({
+        "rules": result.rules,
+        "files": result.files_scanned,
+        "findings": [f.as_dict() for f in result.findings],
+        "suppressed": [dict(f.as_dict(), reason=f.reason)
+                       for f in result.suppressed],
+        "baselined": [f.as_dict() for f in result.baselined],
+    }, indent=2)
+
+
+def summary_line(result: LintResult) -> str:
+    return ("tpulint summary: rules=%d files=%d findings=%d "
+            "suppressed=%d baselined=%d" % (
+                len(result.rules), result.files_scanned,
+                len(result.findings), len(result.suppressed),
+                len(result.baselined)))

@@ -198,7 +198,7 @@ class HashAggregateExec(UnaryExecBase):
         if not self._fused_event_done and t0 is not None:
             self._fused_event_done = True
             from spark_rapids_tpu.utils import profile as P
-            P.event("stage_fused",
+            P.event(P.EV_STAGE_FUSED,
                     members=self._pre_stage.member_names()
                     + [type(self).__name__],
                     exprs=self._pre_stage.expr_count,
@@ -674,6 +674,8 @@ class HashAggregateExec(UnaryExecBase):
             else:
                 kmins, kmaxs = probe(batch.columns, batch.num_rows_i32)
             import numpy as _np
+            from spark_rapids_tpu.utils import checks as CK
+            CK.note_host_sync("agg.dict_probe", nbytes=16 * nk)
             kmins = _np.asarray(kmins).reshape(-1)
             kmaxs = _np.asarray(kmaxs).reshape(-1)
             spans = [max(int(hi) - int(lo) + 1, 1) if hi >= lo else 1

@@ -404,7 +404,7 @@ class TpuExec:
                         prof_error = e
                         raise
                     e.recover_all()
-                    P.event("deopt_retry", origin=", ".join(
+                    P.event(P.EV_DEOPT_RETRY, origin=", ".join(
                         c.origin for c in e.checks))
                     CK.drain_since(mark)  # discard this attempt's rest
                 finally:

@@ -243,14 +243,14 @@ class QueryScheduler:
         qc.budget_bytes = budget
         with self._cv:
             if self._try_admit_locked(qc, budget, maxq, dm):
-                P.event("query_admitted", query=qc.query_id,
+                P.event(P.EV_QUERY_ADMITTED, query=qc.query_id,
                         budget_bytes=budget, queued_ms=0)
                 return True
             depth = int(conf[C.SCHED_QUEUE_DEPTH])
             if len(self._queue) >= max(0, depth):
                 self._stats["rejected"] += 1
                 snap = self._snapshot_locked(dm)
-                P.event("query_rejected", query=qc.query_id,
+                P.event(P.EV_QUERY_REJECTED, query=qc.query_id,
                         budget_bytes=budget, **snap)
                 raise TpuQueryRejected(
                     f"query {qc.query_id} rejected: admission queue is "
@@ -267,7 +267,7 @@ class QueryScheduler:
             self._stats["max_queue_depth"] = max(
                 self._stats["max_queue_depth"], len(self._queue))
             position = len(self._queue)
-            P.event("query_queued", query=qc.query_id,
+            P.event(P.EV_QUERY_QUEUED, query=qc.query_id,
                     budget_bytes=budget, position=position)
         return self._wait_admitted(entry, conf, dm)
 
@@ -298,7 +298,7 @@ class QueryScheduler:
                             self._stats["longest_queue_wait_ms"] = max(
                                 self._stats["longest_queue_wait_ms"],
                                 int(waited))
-                            P.event("query_admitted",
+                            P.event(P.EV_QUERY_ADMITTED,
                                     query=qc.query_id,
                                     budget_bytes=entry.budget,
                                     queued_ms=int(waited))
@@ -318,7 +318,7 @@ class QueryScheduler:
                             self._stats["queue_timeouts"] += 1
                             self._stats["rejected"] += 1
                             snap = self._snapshot_locked(dm)
-                            P.event("query_rejected", query=qc.query_id,
+                            P.event(P.EV_QUERY_REJECTED, query=qc.query_id,
                                     budget_bytes=entry.budget,
                                     timeout_s=timeout, **snap)
                             raise TpuQueryRejected(

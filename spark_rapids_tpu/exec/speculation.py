@@ -182,7 +182,7 @@ class SpeculationManager:
         state.spec_token = stok
         self.metrics.add(M.NUM_SPECULATIVE_TASKS, 1)
         _note("launched")
-        P.event("speculation_launched", shuffle_id=self.shuffle_id,
+        P.event(P.EV_SPECULATION_LAUNCHED, shuffle_id=self.shuffle_id,
                 map_id=state.map_id, backup=backup.executor_id,
                 elapsed_ms=round(elapsed * 1e3, 1),
                 stage_median_ms=round(median * 1e3, 1))
@@ -204,7 +204,7 @@ class SpeculationManager:
             state.commit_time = time.monotonic()
             self.metrics.add(M.NUM_SPECULATIVE_WINS, 1)
             _note("wins")
-            P.event("speculation_win", shuffle_id=self.shuffle_id,
+            P.event(P.EV_SPECULATION_WIN, shuffle_id=self.shuffle_id,
                     map_id=state.map_id, backup=backup.executor_id)
             if state.orig_token is not None:
                 state.orig_token.cancel_race_lost(

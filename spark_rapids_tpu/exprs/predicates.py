@@ -251,6 +251,8 @@ class InSet(Expression):
                 _, eq = _string_cmp(c, lv)
                 hit = hit | eq
         else:
+            # tpulint: disable=host-sync -- the IN-list is a python
+            # list of plan literals (host), not a device value
             arr = np.asarray(vals, c.dtype.storage_dtype)
             if len(arr) == 0:
                 hit = jnp.zeros(c.capacity, bool)

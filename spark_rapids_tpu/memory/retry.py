@@ -241,7 +241,7 @@ def _run_reserved(thunk: Callable[[], object], nbytes: int, metrics,
             raise
         except TpuRetryOOM:
             _madd(metrics, M.NUM_RETRIES, 1)
-            P.event("oom_retry", label=label, bytes=nbytes,
+            P.event(P.EV_OOM_RETRY, label=label, bytes=nbytes,
                     retries=retries + 1)
             retries += 1
             continue
@@ -268,7 +268,7 @@ def _floor_fallback(thunk: Callable[[], object], metrics, label: str,
             f"{C.RETRY_FALLBACK.key}=bestEffort to run the batch "
             "unreserved (XLA's allocator then has the final word).")
     _madd(metrics, M.NUM_OOM_FALLBACKS, 1)
-    P.event("oom_fallback", label=label, rows=str(rows))
+    P.event(P.EV_OOM_FALLBACK, label=label, rows=str(rows))
     log.warning(
         "%s: OOM retry floor reached (%s rows); running the batch "
         "unreserved (best effort) — a true device OOM will surface as "
@@ -316,7 +316,7 @@ def with_split_retry(batch, body: Callable[[object], object], *,
                                       rows=b.num_rows)
             else:
                 _madd(metrics, M.NUM_SPLIT_RETRIES, 1)
-                P.event("oom_split_retry", label=label,
+                P.event(P.EV_OOM_SPLIT_RETRY, label=label,
                         rows=b.num_rows)
                 pending[:0] = pieces
 

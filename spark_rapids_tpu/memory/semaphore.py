@@ -156,7 +156,7 @@ class TpuSemaphore:
                     self._longest_wait_ms = waited_ms
         if blocked:
             from spark_rapids_tpu.utils import profile as P
-            P.event("semaphore_wait", group=str(group),
+            P.event(P.EV_SEMAPHORE_WAIT, group=str(group),
                     wait_ms=waited_ms, reacquire=reacquire)
 
     def _return_permit(self, group) -> None:

@@ -85,6 +85,8 @@ def _columnar_on_host(expr: E.Expression, df: pd.DataFrame,
         s = pd.Series(list(vals), index=df.index, dtype=object)
         return s
     s = pd.Series(vals, index=df.index).astype(nullable_dtype(dt))
+    # tpulint: disable=host-sync -- valid came from to_numpy() above,
+    # which is the accounted readback point; this is host numpy
     s[np.asarray(~valid)] = pd.NA
     return s
 

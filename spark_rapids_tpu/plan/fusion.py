@@ -280,7 +280,7 @@ class FusedStageExec(UnaryExecBase):
             # a jit's first call traces + compiles synchronously, so
             # this delta IS the stage's compile cost
             kern._fused_reported = True
-            P.event("stage_fused",
+            P.event(P.EV_STAGE_FUSED,
                     members=self.stage.member_names(),
                     exprs=self.stage.expr_count,
                     compile_ms=round(
@@ -331,7 +331,7 @@ class FusedStageExec(UnaryExecBase):
         self._fusion_deopt = True
         self.metrics.add(M.NUM_FUSION_DEOPTS, 1)
         from spark_rapids_tpu.utils import profile as P
-        P.event("fusion_deopt", members=self.stage.member_names(),
+        P.event(P.EV_FUSION_DEOPT, members=self.stage.member_names(),
                 error=f"{type(err).__name__}: {err}"[:300])
         log.warning(
             "fused stage [%s] failed to build/trace; deopting this "

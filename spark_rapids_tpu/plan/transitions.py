@@ -65,6 +65,9 @@ def series_from_column(field: T.Field, vals, valid) -> pd.Series:
     if field.dtype.is_string:
         return pd.Series(list(vals), dtype=object)
     s = pd.Series(vals).astype(nullable_dtype(field.dtype))
+    # tpulint: disable=host-sync -- valid is host-resident here: every
+    # caller passes the output of to_numpy()/device_get(), which are
+    # the accounted readback points
     s[~np.asarray(valid)] = pd.NA
     return s
 
@@ -218,11 +221,16 @@ class AcceleratedColumnarToRowExec(ColumnarToRowExec):
         import jax
 
         def convert(it):
+            from spark_rapids_tpu.utils import checks as CK
             for batch in it:
                 n = batch.num_rows
-                host = list(jax.device_get(
-                    [(c.data, c.validity) for c in batch.columns
-                     if not c.dtype.is_string]))
+                pairs = [(c.data, c.validity) for c in batch.columns
+                         if not c.dtype.is_string]
+                CK.note_host_sync(
+                    "transition.device_get",
+                    nbytes=sum(int(d.nbytes) + int(v.nbytes)
+                               for d, v in pairs))
+                host = list(jax.device_get(pairs))
                 out = {}
                 for f, c in zip(batch.schema.fields, batch.columns):
                     if f.dtype.is_string:

@@ -144,7 +144,14 @@ class PythonWorkerPool:
         # never strand capacity
         if self._closed:
             raise RuntimeError("PythonWorkerPool is closed")
-        self._slots.acquire()
+        # bounded poll + cancel check: a checkout parked behind a full
+        # pool must die with its query (PR 4 wait discipline), and a
+        # pool closed mid-wait must not strand the waiter
+        from spark_rapids_tpu.utils import watchdog as W
+        while not self._slots.acquire(timeout=0.1):
+            W.check_cancelled()
+            if self._closed:
+                raise RuntimeError("PythonWorkerPool is closed")
         try:
             while True:
                 try:
@@ -194,7 +201,7 @@ class PythonWorkerPool:
             reusable = True
             raise
         except WorkerCrash as e:
-            P.event("udf_worker_crash", pid=w.proc.pid,
+            P.event(P.EV_UDF_WORKER_CRASH, pid=w.proc.pid,
                     error=str(e)[:200])
             raise
         finally:

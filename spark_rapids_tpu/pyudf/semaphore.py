@@ -48,8 +48,13 @@ class PythonWorkerSemaphore:
         outermost = depth == 0
         if outermost and self._sem is not None:
             # acquire before bumping the depth: a failed/interrupted
-            # acquire must not leave this thread marked as holding
-            self._sem.acquire()
+            # acquire must not leave this thread marked as holding.
+            # Bounded poll + cancel check: a task parked behind
+            # concurrentPythonWorkers must die with its query instead
+            # of waiting out a slot forever (PR 4 wait discipline).
+            from spark_rapids_tpu.utils import watchdog as W
+            while not self._sem.acquire(timeout=0.1):
+                W.check_cancelled()
         self._tls.depth = depth + 1
         if outermost:
             with self._alock:

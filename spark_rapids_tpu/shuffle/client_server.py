@@ -336,7 +336,7 @@ class ShuffleClient:
                     # in the event log, not buried in the retry path
                     handler.corruption_detected()
                     from spark_rapids_tpu.utils import profile as _P
-                    _P.event("wire_corruption", address=self.address,
+                    _P.event(_P.EV_WIRE_CORRUPTION, address=self.address,
                              error=str(txn.error)[:200])
                 # return the budget of buffers that did not complete
                 for m in budget_taken:
@@ -349,7 +349,7 @@ class ShuffleClient:
                 from spark_rapids_tpu.utils import profile as P
                 if attempt > self.max_retries:
                     handler.transfer_error(txn.error or "transfer failed")
-                    P.event("fetch_failure", address=self.address,
+                    P.event(P.EV_FETCH_FAILURE, address=self.address,
                             attempts=attempt,
                             error=str(txn.error)[:200])
                     raise FetchFailedError(
@@ -359,7 +359,7 @@ class ShuffleClient:
                         f"{txn.error}")
                 log.warning("shuffle fetch retry %d from %s: %s", attempt,
                             self.address, txn.error)
-                P.event("fetch_retry", address=self.address,
+                P.event(P.EV_FETCH_RETRY, address=self.address,
                         attempt=attempt, error=str(txn.error)[:200])
                 self._backoff(attempt)
                 # a mid-stream abort leaves the socket dead on the

@@ -201,9 +201,13 @@ class TcpServer:
         from spark_rapids_tpu.shuffle.transport import BlockIdMsg
         try:
             while True:
-                frame = _recv_frame(conn)
+                frame = _recv_frame(conn,
+                                    alive=lambda: not self._closing)
                 if frame is None:
                     return
+                # actively serving: restore blocking I/O so a large
+                # response send never trips the idle-poll timeout
+                conn.settimeout(None)
                 if self.faults is not None and self.faults.peer_killed:
                     # a killed peer stops answering — no polite error
                     # frame, the client sees a dead wire
@@ -267,18 +271,36 @@ def _send_all(conn: socket.socket, data: bytes) -> None:
     conn.sendall(data)
 
 
-def _recv_frame(conn: socket.socket) -> Optional[bytes]:
-    hdr = _recv_exact(conn, 4)
+#: idle-poll slice for server-side reads: a handler thread parked on an
+#: idle connection wakes at this cadence to notice server close instead
+#: of blocking on recv forever (the bounded-poll wait discipline)
+_SERVE_POLL_S = 0.25
+
+
+def _recv_frame(conn: socket.socket, alive=None) -> Optional[bytes]:
+    hdr = _recv_exact(conn, 4, alive)
     if hdr is None:
         return None
     (length,) = struct.unpack("<I", hdr)
-    return _recv_exact(conn, length)
+    return _recv_exact(conn, length, alive)
 
 
-def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+def _recv_exact(conn: socket.socket, n: int,
+                alive=None) -> Optional[bytes]:
+    """Read exactly `n` bytes.  With `alive` the read is a bounded
+    poll: the socket gets a short timeout and each timeout slice
+    re-checks alive(), so a closing server reclaims handler threads
+    instead of leaking them parked on idle connections."""
+    if alive is not None:
+        conn.settimeout(_SERVE_POLL_S)
     buf = bytearray()
     while len(buf) < n:
-        chunk = conn.recv(n - len(buf))
+        try:
+            chunk = conn.recv(n - len(buf))
+        except socket.timeout:
+            if alive is not None and not alive():
+                return None
+            continue
         if not chunk:
             return None
         buf += chunk

@@ -759,7 +759,7 @@ class CachingShuffleReader:
             # failed): race the replica for the same blocks
             if self.metrics is not None:
                 self.metrics.add(M.NUM_HEDGED_FETCHES, 1)
-            P.event("hedge_fired", address=address, replica=hedge_addr,
+            P.event(P.EV_HEDGE_FIRED, address=address, replica=hedge_addr,
                     blocks=len(blocks), delay_ms=round(delay * 1e3, 1))
             start("hedge")
         # first complete, uncorrupted response wins

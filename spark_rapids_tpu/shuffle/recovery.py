@@ -85,7 +85,7 @@ class PeerHealth:
                 self.blacklist_events += 1
                 log.warning("shuffle peer %s blacklisted after %d "
                             "consecutive failures", address, st[0])
-                P.event("peer_blacklisted", address=address,
+                P.event(P.EV_PEER_BLACKLISTED, address=address,
                         consecutive_failures=st[0])
                 return True
             return False
@@ -158,11 +158,11 @@ class ShuffleRecoveryDriver:
                 return [b for _, b in items]
             except FetchFailedError as e:
                 self.metrics.add(M.NUM_FETCH_FAILURES, 1)
-                P.event("fetch_failure", shuffle_id=self.shuffle_id,
+                P.event(P.EV_FETCH_FAILURE, shuffle_id=self.shuffle_id,
                         partition=p, address=e.address,
                         attempt=attempt, error=str(e)[:200])
                 if attempt >= self.max_attempts:
-                    P.event("recovery_exhausted",
+                    P.event(P.EV_RECOVERY_EXHAUSTED,
                             shuffle_id=self.shuffle_id, partition=p,
                             attempts=attempt)
                     raise FetchFailedError(
@@ -217,7 +217,7 @@ class ShuffleRecoveryDriver:
                         "shuffle %d recovery: recomputing map tasks %s "
                         "at epoch %d after %s", self.shuffle_id, todo,
                         epoch, e)
-                    P.event("map_recompute",
+                    P.event(P.EV_MAP_RECOMPUTE,
                             shuffle_id=self.shuffle_id,
                             map_ids=list(todo), epoch=epoch,
                             address=e.address)
@@ -235,7 +235,7 @@ class ShuffleRecoveryDriver:
                                     "%s", self.shuffle_id, stale)
                     self.metrics.add(M.NUM_MAP_RECOMPUTES, len(todo))
                 self.metrics.add(M.NUM_STAGE_RETRIES, 1)
-                P.event("stage_retry", shuffle_id=self.shuffle_id,
+                P.event(P.EV_STAGE_RETRY, shuffle_id=self.shuffle_id,
                         recomputed=len(todo))
             finally:
                 self.metrics.add(M.RECOVERY_TIME,
@@ -277,6 +277,6 @@ class ShuffleRecoveryDriver:
             log.warning("shuffle %d recovery: promoted replica on %s "
                         "for map %d (no recompute)", self.shuffle_id,
                         eid, map_id)
-            P.event("replica_promoted", shuffle_id=self.shuffle_id,
+            P.event(P.EV_REPLICA_PROMOTED, shuffle_id=self.shuffle_id,
                     map_id=map_id, replica_executor=eid)
         return promoted

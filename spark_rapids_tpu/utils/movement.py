@@ -164,7 +164,8 @@ class DataMovementLedger:
         tr = self.tracer
         if tr is not None and not tr.ended \
                 and nbytes >= self.min_event_bytes:
-            tr.event("data_movement", edge=edge, site=site,
+            from spark_rapids_tpu.utils.profile import EV_DATA_MOVEMENT
+            tr.event(EV_DATA_MOVEMENT, edge=edge, site=site,
                      bytes=nbytes, raw_bytes=raw,
                      **({"dur_ns": int(dur_ns)} if dur_ns else {}),
                      **event_args)

@@ -66,6 +66,46 @@ CAT_QUEUE = "queue"        # parked in the scheduler's admission queue
 MAX_SPANS = 1 << 16
 MAX_EVENTS = 1 << 14
 
+# ---------------------------------------------------------------------------
+# Event-name registry: every structured event kind the engine can emit,
+# defined ONCE here and imported as a constant by its emitter — the
+# event-log schema analog of config.py's typed conf registry (and
+# enforced the same way tpulint's conf-discipline rule covers confs:
+# `event()` rejects an unregistered kind, so a typo'd or undocumented
+# event name is a test failure, not a silently unqueryable log record).
+EV_SPAN_OPEN = "span_open"
+EV_SPAN_CLOSE = "span_close"
+EV_QUERY_ERROR = "query_error"
+EV_QUERY_QUEUED = "query_queued"            # exec/scheduler.py
+EV_QUERY_ADMITTED = "query_admitted"
+EV_QUERY_REJECTED = "query_rejected"
+EV_SEMAPHORE_WAIT = "semaphore_wait"        # memory/semaphore.py
+EV_OOM_RETRY = "oom_retry"                  # memory/retry.py
+EV_OOM_SPLIT_RETRY = "oom_split_retry"
+EV_OOM_FALLBACK = "oom_fallback"
+EV_DEOPT_RETRY = "deopt_retry"              # exec/base.py
+EV_STAGE_FUSED = "stage_fused"              # plan/fusion.py, exec/aggregate.py
+EV_FUSION_DEOPT = "fusion_deopt"
+EV_SPECULATION_LAUNCHED = "speculation_launched"  # exec/speculation.py
+EV_SPECULATION_WIN = "speculation_win"
+EV_HEDGE_FIRED = "hedge_fired"              # shuffle/manager.py
+EV_FETCH_FAILURE = "fetch_failure"          # shuffle/client_server.py
+EV_FETCH_RETRY = "fetch_retry"
+EV_WIRE_CORRUPTION = "wire_corruption"
+EV_MAP_RECOMPUTE = "map_recompute"          # shuffle/recovery.py
+EV_STAGE_RETRY = "stage_retry"
+EV_RECOVERY_EXHAUSTED = "recovery_exhausted"
+EV_PEER_BLACKLISTED = "peer_blacklisted"
+EV_REPLICA_PROMOTED = "replica_promoted"
+EV_UDF_WORKER_CRASH = "udf_worker_crash"    # pyudf/daemon.py
+EV_CANCEL = "cancel"                        # utils/watchdog.py
+EV_WATCHDOG_TIMEOUT = "watchdog_timeout"
+EV_DATA_MOVEMENT = "data_movement"          # utils/movement.py
+EV_TELEMETRY_SNAPSHOT = "telemetry_snapshot"  # utils/telemetry.py (JSONL)
+
+EVENT_KINDS = frozenset(
+    v for k, v in list(globals().items()) if k.startswith("EV_"))
+
 
 class Span:
     """One closed (or still-open) timeline range.  Times are
@@ -175,7 +215,7 @@ class QueryTracer:
                  parent.sid if parent is not None
                  else (self.root.sid if self.root is not None else None),
                  name, cat, time.perf_counter_ns() - self.t_origin, args)
-        self.event("span_open", name=name, cat=cat, sid=s.sid,
+        self.event(EV_SPAN_OPEN, name=name, cat=cat, sid=s.sid,
                    parent_id=s.parent_id)
         return s
 
@@ -184,11 +224,16 @@ class QueryTracer:
         if len(self._spans) == self._spans.maxlen:
             self.dropped_spans += 1
         self._spans.append(s)
-        self.event("span_close", name=s.name, cat=s.cat, sid=s.sid,
+        self.event(EV_SPAN_CLOSE, name=s.name, cat=s.cat, sid=s.sid,
                    dur_ns=s.dur_ns)
 
     # -- events --------------------------------------------------------------
     def event(self, kind: str, **fields) -> None:
+        if kind not in EVENT_KINDS:
+            raise ValueError(
+                f"unregistered profiler event kind {kind!r}: event "
+                "names are a schema — define an EV_* constant in "
+                "utils/profile.py and emit through it")
         rec = {"ts_ns": time.perf_counter_ns() - self.t_origin,
                "query_id": self.query_id, "kind": kind,
                "thread": threading.current_thread().name}
@@ -391,7 +436,7 @@ def end_query(owner: Optional[QueryTracer], plan=None,
     if owner is None:
         return None
     if error is not None:
-        owner.event("query_error", error=f"{type(error).__name__}: "
+        owner.event(EV_QUERY_ERROR, error=f"{type(error).__name__}: "
                     f"{error}"[:500])
     owner.close_span(owner.root)
     try:

@@ -440,7 +440,7 @@ class Telemetry:
         path = path.replace("{query_id}", "telemetry")
         try:
             from spark_rapids_tpu.utils import profile as P
-            rec = {"kind": "telemetry_snapshot", "ts": time.time(),
+            rec = {"kind": P.EV_TELEMETRY_SNAPSHOT, "ts": time.time(),
                    **self.snapshot()}
             P.rotating_append(
                 path, json.dumps(rec) + "\n",

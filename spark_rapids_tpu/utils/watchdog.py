@@ -106,7 +106,7 @@ class CancelToken:
             self.dump = dump
             self._ev.set()
         from spark_rapids_tpu.utils import profile as P
-        P.event("cancel", reason=reason)
+        P.event(P.EV_CANCEL, reason=reason)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._ev.wait(timeout)
@@ -563,7 +563,7 @@ def _fire(hb: Heartbeat, gap: float) -> None:
     from spark_rapids_tpu.exec import scheduler as S
     from spark_rapids_tpu.utils import profile as P
     with S.scoped(hb.qc):
-        P.event("watchdog_timeout", heartbeat=hb.name,
+        P.event(P.EV_WATCHDOG_TIMEOUT, heartbeat=hb.name,
                 deadline_class=hb.kind, gap_s=round(gap, 2),
                 deadline_s=hb.deadline, stuck_thread=hb.thread_name,
                 reason=reason, dump=dump)

@@ -335,3 +335,18 @@ def test_set_max_interleaved_with_add_fifo_semantics():
     ms.add("m", 4.0)       # 9
     ms.set_max("m", 20.0)  # 20
     assert ms.value("m") == 20.0
+
+
+def test_event_kind_registry_rejects_unregistered():
+    """Event names are a schema: every kind the engine emits is an
+    EV_* constant in utils/profile.py, and emitting an unregistered
+    name is an error (the event-log analog of conf registration)."""
+    tr = P.QueryTracer(C.RapidsConf({
+        "spark.rapids.sql.profile.movement.enabled": False}))
+    tr.event(P.EV_CANCEL, reason="fixture")
+    assert tr.events()[-1]["kind"] == "cancel"
+    with pytest.raises(ValueError, match="unregistered profiler event"):
+        tr.event("totally_made_up_event")
+    # every constant round-trips through the registry
+    assert all(getattr(P, k) in P.EVENT_KINDS
+               for k in dir(P) if k.startswith("EV_"))

@@ -1,0 +1,30 @@
+"""host-sync rule fixture: device->host materializations on a hot path
+(this file's parent dir is named `exec/`) must sit in a function that
+calls utils.checks.note_host_sync, or carry a reasoned suppression."""
+import jax
+import numpy as np
+
+from spark_rapids_tpu.utils import checks as CK
+
+
+def unaccounted_readbacks(dev, vec):
+    a = np.asarray(dev)                     # EXPECT: host-sync
+    b = vec.data.item()                     # EXPECT: host-sync
+    c = jax.device_get(dev)                 # EXPECT: host-sync
+    d = dev.block_until_ready()             # EXPECT: host-sync
+    return a, b, c, d
+
+
+def accounted_readback(dev):
+    CK.note_host_sync("fixture.site", nbytes=4)
+    return np.asarray(dev)                  # accounted: no finding
+
+
+def host_side_literals():
+    # literal-ish arguments cannot hold a device value: no finding
+    return np.asarray([1, 2, 3])
+
+
+def suppressed_readback(host_array):
+    # tpulint: disable=host-sync -- fixture: value is host-resident
+    return np.asarray(host_array)

@@ -1,0 +1,36 @@
+"""sem-blocking rule fixture: blocking calls lexically inside a
+`with ...held():` region must use TpuSemaphore.yielded() or a
+cancellable watchdog wait."""
+import time
+
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.utils import watchdog as W
+
+
+def blocks_while_holding(sem, queue, ev, lock):
+    with sem.held():
+        queue.get()                  # EXPECT: sem-blocking, unbounded-wait
+        queue.put(1, timeout=5)      # EXPECT: sem-blocking
+        ev.wait(0.5)                 # EXPECT: sem-blocking
+        time.sleep(0.1)              # EXPECT: sem-blocking
+        lock.acquire()               # EXPECT: sem-blocking, unbounded-wait
+
+
+def yields_around_the_wait(sem, ev):
+    with sem.held():
+        with TpuSemaphore.get().yielded():
+            ev.wait(0.5)                    # yielded: no finding
+
+
+def cancellable_waits_are_fine(sem, ev):
+    with sem.held():
+        W.cancellable_wait(ev, 5.0)         # sanctioned helper
+        W.check_cancelled()
+        d = {}
+        d.get("key")                        # dict access, not a queue
+        TpuSemaphore.get()                  # Singleton.get(): fine
+
+
+def not_holding(queue):
+    queue.get(timeout=1.0)                  # outside held(): rule 3's
+    return None                             # problem, not rule 2's

@@ -1,0 +1,13 @@
+"""suppression-machinery fixture: a reasoned suppression silences its
+rule; a reason-less one is itself flagged (and does NOT suppress)."""
+import numpy as np
+
+
+def good_suppression(host_array):
+    val = np.asarray(host_array)  # tpulint: disable=host-sync -- fixture: host data
+    return val
+
+
+def reasonless_suppression(dev):
+    val = np.asarray(dev)  # tpulint: disable=host-sync
+    return val

@@ -1,0 +1,25 @@
+"""conf-discipline rule fixture: (a) spark.rapids.* literals must be
+registered in config.py; (b) plan/ constructors and class bodies (this
+file's parent dir is named `plan/`) must not resolve confs."""
+from spark_rapids_tpu import config as C
+
+REGISTERED = "spark.rapids.sql.enabled"                  # registered: fine
+BOGUS = "spark.rapids.sql.tpulintFixture.bogus"          # EXPECT: conf-discipline
+PROSE = "spark.rapids.sql.enabled must be on for this"   # prose, not a key
+
+
+class FixtureNode:
+    captured = C.get_active_conf()                       # EXPECT: conf-discipline
+
+    def __init__(self, child):
+        self.child = child
+        self.conf = C.get_active_conf()                  # EXPECT: conf-discipline
+
+    def execute_partitions(self):
+        conf = C.get_active_conf()                       # execution time: fine
+        return conf
+
+
+class DataclassyNode:
+    def __post_init__(self):
+        self.enabled = C.get_active_conf()               # EXPECT: conf-discipline

@@ -1,0 +1,46 @@
+"""unbounded-wait rule fixture: every wait()/get()/join()/acquire()
+needs a timeout (bounded poll + CancelToken check); recv needs
+settimeout or check_cancelled in scope."""
+from spark_rapids_tpu.utils import watchdog as W
+
+
+def unbounded(ev, queue, thread, lock, conn):
+    ev.wait()                               # EXPECT: unbounded-wait
+    ev.wait(None)                           # EXPECT: unbounded-wait
+    queue.get()                             # EXPECT: unbounded-wait
+    queue.get(True)                         # EXPECT: unbounded-wait
+    queue.get(block=True)                   # EXPECT: unbounded-wait
+    thread.join()                           # EXPECT: unbounded-wait
+    lock.acquire(blocking=True)             # EXPECT: unbounded-wait
+    conn.recv(4)                            # EXPECT: unbounded-wait
+
+
+def bounded(ev, queue, thread, lock):
+    deadline = 5.0
+    while not ev.wait(0.05):
+        W.check_cancelled()
+        deadline -= 0.05
+    queue.get(timeout=1.0)
+    queue.get(block=False)
+    thread.join(timeout=2.0)
+    while not lock.acquire(timeout=0.1):
+        W.check_cancelled()
+
+
+def dictionaries_and_singletons(d):
+    d.get("key")                            # dict access: fine
+    d.get("key", 42)
+
+
+def bounded_recv(conn):
+    conn.settimeout(0.25)
+    while True:
+        try:
+            return conn.recv(4)             # settimeout in scope: fine
+        except OSError:
+            return None
+
+
+def suppressed_wait(ev):
+    # tpulint: disable=unbounded-wait -- fixture: daemon parks by design
+    ev.wait()
