@@ -42,8 +42,51 @@ run_fast() {
   run_movement
   run_concurrency
   run_fusion
+  run_spmd
   run_speculation
   run_telemetry
+}
+
+run_spmd() {
+  # SPMD whole-stage lane: the gang-execution suite (parity, ragged
+  # partitions, deopt, ledger reconciliation), then a q1 parity smoke
+  # over the 8-device mesh whose summary line carries the per-stage
+  # dispatch counts — the O(partitions)->O(1) dispatch evidence.
+  echo "== spmd lane (whole-mesh stage execution: parity + dispatch counts) =="
+  "${PYTEST[@]}" tests/test_spmd.py
+  python - <<'PYEOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from pandas.testing import assert_frame_equal
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.exec import spmd as SP
+from spark_rapids_tpu.exec.scheduler import mesh_gate_stats
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+from spark_rapids_tpu.parallel.mesh import active_mesh, make_mesh
+
+tables = gen_tables(np.random.default_rng(11), 1000)
+off = C.RapidsConf(dict(BENCH_CONF))
+on = C.RapidsConf({**BENCH_CONF,
+                   "spark.rapids.sql.spmd.enabled": True})
+ref = run_query(1, tables, conf=off)
+mesh = make_mesh(min(8, len(jax.devices())))
+SP.reset_spmd_stats()
+with active_mesh(mesh):
+    for parts in (2, 8):
+        got = run_query(1, tables, conf=on, num_partitions=parts)
+        assert_frame_equal(got.reset_index(drop=True),
+                           ref.reset_index(drop=True))
+st = SP.spmd_stats()
+assert st["gang_dispatches"] >= 2 and st["deopts"] == 0, st
+gate = mesh_gate_stats()
+print("spmd summary: q1 bit-exact spmd-vs-per-partition at 2 and 8 "
+      "partitions; gang_dispatches=%d (one per stage) batches=%d "
+      "slots=%d deopts=%d gate_dispatches=%d" % (
+          st["gang_dispatches"], st["gang_batches"], st["gang_slots"],
+          st["deopts"], gate["dispatches"]))
+PYEOF
 }
 
 run_telemetry() {
@@ -494,9 +537,10 @@ case "$TIER" in
   movement) run_movement ;;
   concurrency) run_concurrency ;;
   fusion)   run_fusion ;;
+  spmd)     run_spmd ;;
   speculation) run_speculation ;;
   telemetry) run_telemetry ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [lint|gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|speculation|telemetry|all]" >&2
+  *) echo "usage: $0 [lint|gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|spmd|speculation|telemetry|all]" >&2
      exit 2 ;;
 esac

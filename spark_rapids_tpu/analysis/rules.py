@@ -441,9 +441,105 @@ class CompileUnderLockRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+class CollectiveDisciplineRule(Rule):
+    """Rule 6 (PR 11/12, the mesh lanes): a mesh collective
+    (`lax.all_to_all` / `psum` / `all_gather` / `ppermute`) blocks
+    EVERY participant when one goes dark, so each dispatch must run
+    under the collective-class watchdog (`watched_collective`,
+    parallel/collective_exchange.py) — which also feeds the movement
+    ledger's collective edge.  A call site is sanctioned when it is
+    (a) lexically inside a `watched_collective(...)` argument (the
+    dispatch thunk), or (b) inside an SPMD body registered with the
+    watchdog by construction: a function passed to `shard_map`, any
+    function it (transitively, same file) calls, or a function nested
+    inside one — those run INSIDE a dispatch the caller already
+    watches.  Anything else is a naked collective: a hang there is
+    invisible to the watchdog and unaccounted by the ledger."""
+
+    rule_id = "collective-discipline"
+    doc = ("lax.all_to_all/psum/all_gather/ppermute must run under "
+           "watched_collective or inside a shard_map/SPMD body")
+
+    _COLLECTIVES = {"all_to_all", "psum", "all_gather", "ppermute"}
+
+    def check(self, ctx: FileContext) -> list[Finding]:
+        defs: dict[str, list] = {}          # name -> def nodes
+        calls_in: dict[int, set] = {}       # id(def) -> called names
+        nested_in: dict[int, set] = {}      # id(def) -> nested def names
+        seeds: set = set()                  # shard_map/watched fn names
+        sites: list = []                    # (node, def-name chain, watched?)
+
+        def leaf(call) -> str:
+            d = dotted(call.func)
+            return d.split(".")[-1] if d else ""
+
+        def walk(node, fn_stack, watched):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+                for f in fn_stack:
+                    nested_in.setdefault(id(f), set()).add(node.name)
+                fn_stack = fn_stack + [node]
+            elif isinstance(node, ast.Call):
+                name = leaf(node)
+                if name in ("shard_map", "watched_collective"):
+                    for a in list(node.args) + [k.value
+                                                for k in node.keywords]:
+                        if isinstance(a, ast.Name):
+                            seeds.add(a.id)
+                    if name == "watched_collective":
+                        # the dispatch thunk (usually a lambda) and
+                        # everything lexically inside it is watched
+                        watched = True
+                elif name in self._COLLECTIVES:
+                    sites.append((node, [f.name for f in fn_stack],
+                                  watched))
+                if fn_stack and name:
+                    calls_in.setdefault(id(fn_stack[-1]),
+                                        set()).add(name)
+            for child in ast.iter_child_nodes(node):
+                walk(child, fn_stack, watched)
+
+        walk(ctx.tree, [], False)
+
+        # closure: a seed body sanctions everything it calls (same
+        # file) and every function nested inside it
+        sanctioned: set = set()
+        work = list(seeds)
+        while work:
+            name = work.pop()
+            if name in sanctioned:
+                continue
+            sanctioned.add(name)
+            for d in defs.get(name, []):
+                for callee in calls_in.get(id(d), ()):
+                    if callee in defs and callee not in sanctioned:
+                        work.append(callee)
+                for nested in nested_in.get(id(d), ()):
+                    if nested not in sanctioned:
+                        work.append(nested)
+
+        out: list[Finding] = []
+        for node, chain, watched in sites:
+            if watched or any(n in sanctioned for n in chain):
+                continue
+            out.append(self.finding(
+                ctx, node,
+                f"{leaf_name(node)} is a mesh collective outside "
+                "watched_collective and outside any shard_map/SPMD "
+                "body — a wedged dispatch here blocks every mesh "
+                "participant invisibly; wrap the dispatch in "
+                "parallel.collective_exchange.watched_collective"))
+        return out
+
+
+def leaf_name(call: ast.Call) -> str:
+    d = dotted(call.func)
+    return (d.split(".")[-1] + "()") if d else "<collective>()"
+
+
 ALL_RULES = [HostSyncRule(), BlockingWhileHoldingRule(),
              UnboundedWaitRule(), ConfDisciplineRule(),
-             CompileUnderLockRule()]
+             CompileUnderLockRule(), CollectiveDisciplineRule()]
 
 
 def rule_ids() -> list[str]:

@@ -470,6 +470,24 @@ FUSION_ENABLED = conf(
     "stage containing an expression the fuser cannot compose (e.g. "
     "ANSI-checked casts) deopts to the unfused per-operator lane — "
     "only that stage, never the query.")
+SPMD_ENABLED = conf(
+    "spark.rapids.sql.spmd.enabled", False,
+    "Execute fused stages as ONE sharded XLA program over the active "
+    "device mesh (exec/spmd.py): the stage's partition batches are "
+    "stacked along a leading axis laid out with NamedSharding(mesh, "
+    "P('data')), padded per shard with explicit row-count masks so "
+    "ragged partitions stay bit-exact, and the whole "
+    "project->filter chain runs in one jit-with-shardings dispatch — "
+    "one Python dispatch per stage instead of one per partition, with "
+    "XLA owning the (few) cross-shard collectives.  Requires an "
+    "active mesh (spark_rapids_tpu.parallel.mesh.set_active_mesh); "
+    "without one, or on unsupported stages, uneven batch layouts, or "
+    "trace failure, the stage deopts to the per-partition lane "
+    "(numSpmdDeopts).  Also changes plan shape: fusible chains stay "
+    "standalone FusedStageExec nodes (single-operator chains "
+    "included) instead of folding into the aggregate update lane, so "
+    "the SPMD program sees them.  Off (default): byte-identical to "
+    "the per-partition engine.")
 KERNEL_CACHE_MAX_ENTRIES = conf(
     "spark.rapids.sql.kernelCache.maxEntries", 512,
     "Entry-count bound on the process-global compiled-kernel LRU "

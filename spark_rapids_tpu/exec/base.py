@@ -69,6 +69,22 @@ def batch_signature(batch: ColumnarBatch) -> tuple:
             + (batch.sparse is not None,))
 
 
+def mesh_cache_scope(mesh, axis: str, shardings=()) -> tuple:
+    """Cache-key component for whole-mesh (SPMD) executables: the mesh
+    shape, its device identity, the partitioned axis, and the sharding
+    layout descriptors.  An SPMD program is specialized to all of these
+    — a kernel compiled for one mesh/sharding must never be served for
+    another, and (because this tuple appears in no per-partition key)
+    SPMD and per-partition entries can never collide.  Device identity
+    enters as ids, not Device objects, so a dead mesh is not pinned
+    beyond its cached executables' LRU lifetime."""
+    return ("mesh",
+            tuple((name, int(n)) for name, n in mesh.shape.items()),
+            tuple(d.id for d in mesh.devices.flat),
+            axis,
+            tuple(str(s) for s in shardings))
+
+
 #: process-global executable store (bounded LRU): compiled kernels outlive
 #: plan instances, so per-query plan rebuilds and AQE re-plans over the
 #: same expressions hit warm executables instead of re-tracing

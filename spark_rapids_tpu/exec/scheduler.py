@@ -526,6 +526,54 @@ class CollectScope:
 
 
 # ---------------------------------------------------------------------------
+# whole-mesh dispatch gate (exec/spmd.py SPMD gang dispatches)
+#
+# Task-level device sharing is the TpuSemaphore's job, and per-query
+# HBM admission is the ledger's — but a whole-mesh program (an SPMD
+# gang dispatch, a mesh-exchange all-to-all, or the slicing of their
+# sharded outputs) occupies EVERY device of the active mesh at once.
+# Two threads enqueueing whole-mesh programs concurrently can invert
+# the per-device queue order (program A before B on device 0, B before
+# A on device 4) and DEADLOCK the collective rendezvous — observed on
+# the 8-device virtual CPU mesh with one query in the hand-rolled
+# exchange lane and another in an SPMD gang.  The gate serializes
+# every whole-mesh enqueue region process-wide, with the same
+# cancellable bounded-poll discipline every other engine wait uses: a
+# query cancelled while parked here unwinds instead of queueing a
+# dispatch nobody will consume.  Reentrant, so a lane that composes
+# whole-mesh steps (count + data phases) can hold it across both.
+
+_MESH_GATE = threading.RLock()
+_MESH_GATE_STATS = {"dispatches": 0, "longest_wait_ms": 0}
+_MESH_GATE_STATS_LOCK = threading.Lock()
+
+
+@contextmanager
+def whole_mesh_dispatch(label: str = "spmd"):
+    """Hold the process-wide whole-mesh dispatch slot for one SPMD gang
+    dispatch.  Bounded-poll acquisition honors the calling query's
+    CancelToken; stats feed scheduler_stats()/bench summaries."""
+    from spark_rapids_tpu.utils import watchdog as W
+    t0 = time.monotonic()
+    while not _MESH_GATE.acquire(timeout=0.05):
+        W.check_cancelled()
+    waited_ms = int((time.monotonic() - t0) * 1e3)
+    with _MESH_GATE_STATS_LOCK:
+        _MESH_GATE_STATS["dispatches"] += 1
+        _MESH_GATE_STATS["longest_wait_ms"] = max(
+            _MESH_GATE_STATS["longest_wait_ms"], waited_ms)
+    try:
+        yield
+    finally:
+        _MESH_GATE.release()
+
+
+def mesh_gate_stats() -> dict:
+    with _MESH_GATE_STATS_LOCK:
+        return dict(_MESH_GATE_STATS)
+
+
+# ---------------------------------------------------------------------------
 # plan-fingerprint result cache
 class _CacheKey:
     """Equality = structural fingerprint + conf fingerprint + IDENTITY
@@ -683,4 +731,5 @@ def result_cache() -> ResultCache:
 def scheduler_stats() -> dict:
     """Scheduler + result-cache counters for bench/CI summary lines."""
     return {**QueryScheduler.get().stats(),
-            "result_cache": _RESULT_CACHE.stats()}
+            "result_cache": _RESULT_CACHE.stats(),
+            "mesh_gate": mesh_gate_stats()}

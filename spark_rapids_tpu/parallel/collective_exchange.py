@@ -67,6 +67,22 @@ def watched_collective(thunk, label: str = "all-to-all",
         return out
 
 
+def stacked_payload_bytes(arrs) -> int:
+    """Ledger convention shared by BOTH collective lanes — the
+    hand-rolled mesh exchange (shuffle/exchange.py) and the SPMD
+    whole-stage lane (exec/spmd.py): the payload of a mesh collective
+    is the total bytes of the stacked arrays ENTERING it (data +
+    validity + lengths), regardless of the wire pattern XLA lowers to.
+    Using one formula is what lets the two lanes' `collective` edge
+    numbers reconcile in tests and bench rounds."""
+    total = 0
+    for field in arrs:
+        for a in field:
+            if a is not None:
+                total += a.nbytes
+    return total
+
+
 def _local_split(cols, num_rows, key_idx, n_dev, cap):
     """Sort local rows by destination device; return per-dest counts and
     the [n_dev, cap, ...] send buffers."""

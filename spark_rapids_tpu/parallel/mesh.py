@@ -11,6 +11,7 @@ device list.
 """
 from __future__ import annotations
 
+import functools
 from contextlib import contextmanager
 from typing import Optional, Sequence
 
@@ -25,16 +26,33 @@ def make_mesh(num_devices: Optional[int] = None,
               axis_name: str = DATA_AXIS) -> Mesh:
     devs = jax.devices()
     if num_devices is not None:
+        if num_devices > len(devs):
+            raise ValueError(
+                f"make_mesh(num_devices={num_devices}) exceeds the "
+                f"{len(devs)} visible device(s) on platform "
+                f"'{devs[0].platform if devs else '?'}' — a silently "
+                "truncated mesh would shard programs over fewer chips "
+                "than the caller planned for.  Request at most "
+                f"{len(devs)} devices, or (tests) raise the virtual "
+                "device count via "
+                "--xla_force_host_platform_device_count.")
         devs = devs[:num_devices]
     return Mesh(np.array(devs), (axis_name,))
 
 
+# Shardings are memoized per (mesh, axis): hot dispatch paths (every
+# SPMD gang dispatch, every mesh-exchange round) ask for the same
+# NamedSharding over and over, and constructing one is not free.  The
+# bound keeps dead meshes from being pinned forever; jax Meshes hash by
+# device set + axis names, so a rebuilt-but-identical mesh still hits.
+@functools.lru_cache(maxsize=128)
 def data_sharding(mesh: Mesh, axis_name: str = DATA_AXIS) -> NamedSharding:
     """Leading-axis sharding: element i of the stacked batch lives on
     device i of the data axis."""
     return NamedSharding(mesh, P(axis_name))
 
 
+@functools.lru_cache(maxsize=128)
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

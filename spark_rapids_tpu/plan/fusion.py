@@ -56,6 +56,18 @@ left UNFUSED; a fused stage whose kernel fails to trace at runtime
 deopts this exec to the per-operator lane and keeps going.  Only the
 affected stage ever deopts, never the query.  Gate:
 `spark.rapids.sql.fusion.enabled` (default on).
+
+SPMD mode (`spark.rapids.sql.spmd.enabled`, exec/spmd.py): with the
+gate on, the pass plans for whole-mesh execution instead of
+per-partition dispatch — fusible chains stay standalone
+`FusedStageExec` nodes (single-operator chains included: the SPMD lane
+makes even a lone filter profitable, since one gang dispatch replaces
+one dispatch per partition) rather than folding into the aggregate's
+update lane, so the sharded stage program sees them.  At execution
+time `FusedStageExec.execute_partitions` hands the stage to the SPMD
+lane when a mesh is active; everything else (no mesh, unsupported
+gang layouts, trace failure) deopts back to the per-partition lane
+below.
 """
 from __future__ import annotations
 
@@ -207,6 +219,7 @@ class FusedStageExec(UnaryExecBase):
         self.stage = stage
         self._schema = stage.schema
         self._fusion_deopt = False
+        self._spmd_deopt = False
 
     def output_schema(self) -> T.Schema:
         return self._schema
@@ -228,7 +241,18 @@ class FusedStageExec(UnaryExecBase):
     def describe(self):
         return (f"FusedStageExec({self.stage.describe_ops()}, "
                 f"exprs={self.stage.expr_count}"
-                + (", deopt" if self._fusion_deopt else "") + ")")
+                + (", deopt" if self._fusion_deopt else "")
+                + (", spmd-deopt" if self._spmd_deopt else "") + ")")
+
+    def execute_partitions(self):
+        # whole-mesh SPMD lane (exec/spmd.py): one sharded gang
+        # dispatch for every partition of this stage when the conf
+        # enables it and a mesh is active; None = per-partition lane
+        from spark_rapids_tpu.exec import spmd as SP
+        lane = SP.maybe_execute_spmd(self)
+        if lane is not None:
+            return lane
+        return super().execute_partitions()
 
     def tree_string(self, indent: int = 0) -> str:
         # EXPLAIN prints the fusion group: one `* member` line per
@@ -368,30 +392,35 @@ class FusedStageExec(UnaryExecBase):
 def fuse_plan(plan, conf: Optional[C.RapidsConf] = None):
     """Entry point: fuse every TPU subtree of `plan` (a TpuExec, or a
     CpuNode tree with accelerated islands).  Identity when
-    spark.rapids.sql.fusion.enabled is off."""
+    spark.rapids.sql.fusion.enabled is off.  With
+    spark.rapids.sql.spmd.enabled the pass plans for whole-mesh
+    execution: chains stay standalone FusedStageExec nodes (even
+    single-operator runs) instead of folding into aggregate update
+    lanes, so exec/spmd.py's gang dispatch sees them."""
     conf = conf or C.get_active_conf()
     if not conf[C.FUSION_ENABLED]:
         return plan
+    spmd = bool(conf[C.SPMD_ENABLED])
     if isinstance(plan, TpuExec):
-        return _fuse_node(plan)
-    _fuse_islands(plan)
+        return _fuse_node(plan, spmd)
+    _fuse_islands(plan, spmd)
     return plan
 
 
-def _fuse_islands(node) -> None:
+def _fuse_islands(node, spmd: bool = False) -> None:
     from spark_rapids_tpu.plan.transitions import (ColumnarToRowExec,
                                                    RowToColumnarExec)
     if isinstance(node, ColumnarToRowExec):
-        node.tpu_child = _fuse_node(node.tpu_child)
+        node.tpu_child = _fuse_node(node.tpu_child, spmd)
         return
     for c in getattr(node, "children", []):
-        _fuse_islands(c)
+        _fuse_islands(c, spmd)
 
 
-def _fuse_tpu_islands(node: TpuExec) -> None:
+def _fuse_tpu_islands(node: TpuExec, spmd: bool = False) -> None:
     from spark_rapids_tpu.plan.transitions import RowToColumnarExec
     if isinstance(node, RowToColumnarExec):
-        _fuse_islands(node.cpu_child)
+        _fuse_islands(node.cpu_child, spmd)
 
 
 def _collect_chain(node: TpuExec):
@@ -416,9 +445,12 @@ def _member_fusible(ex: TpuExec) -> bool:
     return not any(_contains_ansi(e) for e in bound)
 
 
-def _fuse_segment(run: list, base: TpuExec) -> Optional[TpuExec]:
+def _fuse_segment(run: list, base: TpuExec,
+                  spmd: bool = False) -> Optional[TpuExec]:
     """Fuse one bottom-up run of fusible members over `base`; None when
-    the segment must stay per-operator."""
+    the segment must stay per-operator.  SPMD mode fuses even a lone
+    operator: the gang dispatch amortizes over partitions, not over
+    chain length."""
     try:
         stage = compose_chain(list(reversed(run)), base.output_schema())
     except Exception as e:  # noqa: BLE001 — per-stage deopt
@@ -428,12 +460,13 @@ def _fuse_segment(run: list, base: TpuExec) -> Optional[TpuExec]:
     if not stage.preds and is_identity_projection(
             stage.out_exprs, stage.in_schema, stage.schema):
         return base  # the whole segment was a no-op projection
-    if len(run) < 2:
+    if len(run) < 2 and not spmd:
         return None  # a lone operator gains nothing from fusing
     return FusedStageExec(stage, base)
 
 
-def _fuse_chain(chain: list, base: TpuExec) -> TpuExec:
+def _fuse_chain(chain: list, base: TpuExec,
+                spmd: bool = False) -> TpuExec:
     """Rebuild a top-down Project/Filter chain over `base`, fusing each
     maximal run of fusible members — a chain mixing supported and
     unsupported expressions fuses its supported runs and leaves only
@@ -446,7 +479,7 @@ def _fuse_chain(chain: list, base: TpuExec) -> TpuExec:
             j = i
             while j < len(members) and _member_fusible(members[j]):
                 j += 1
-            fused = _fuse_segment(members[i:j], cur)
+            fused = _fuse_segment(members[i:j], cur, spmd)
             if fused is not None:
                 cur = fused
                 i = j
@@ -463,9 +496,14 @@ def _fuse_chain(chain: list, base: TpuExec) -> TpuExec:
     return cur
 
 
-def _fuse_node(node: TpuExec) -> TpuExec:
-    _fuse_tpu_islands(node)
-    if _agg_fusible(node):
+def _fuse_node(node: TpuExec, spmd: bool = False) -> TpuExec:
+    _fuse_tpu_islands(node, spmd)
+    if _agg_fusible(node) and not spmd:
+        # SPMD-capable stage detection: in SPMD mode the chain stays a
+        # standalone FusedStageExec below (the gang program runs it
+        # over the mesh; the aggregate's update lane then consumes the
+        # sharded outputs per-partition) instead of folding into the
+        # aggregate's update kernels
         chain, base = _collect_chain(node.child)
         if chain and all(_member_fusible(m) for m in chain):
             stage = None
@@ -477,11 +515,12 @@ def _fuse_node(node: TpuExec) -> TpuExec:
             if stage is not None:
                 return HashAggregateExec(
                     node.group_exprs, node.aggregates,
-                    _fuse_node(base), mode=node.mode, pre_stage=stage)
+                    _fuse_node(base, spmd), mode=node.mode,
+                    pre_stage=stage)
             # fall through: the chain may still fuse standalone below
     if isinstance(node, _FUSIBLE):
         chain, base = _collect_chain(node)
-        return _fuse_chain(chain, _fuse_node(base))
+        return _fuse_chain(chain, _fuse_node(base, spmd), spmd)
     for i, c in enumerate(node.children):
-        node._children[i] = _fuse_node(c)
+        node._children[i] = _fuse_node(c, spmd)
     return node

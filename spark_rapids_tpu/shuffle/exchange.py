@@ -346,7 +346,8 @@ class ShuffleExchangeExec(UnaryExecBase):
         from spark_rapids_tpu.columnar.vector import bucket_capacity
         from spark_rapids_tpu.parallel.collective_exchange import (
             build_all_to_all_exchange, build_count_exchange,
-            stack_batches, unstack_batches, watched_collective)
+            stack_batches, stacked_payload_bytes, unstack_batches,
+            watched_collective)
         n = self.partitioning.num_partitions
         from spark_rapids_tpu import config as C
         max_rows = C.get_active_conf()[C.MAX_BATCH_ROWS]
@@ -397,17 +398,32 @@ class ShuffleExchangeExec(UnaryExecBase):
             key_idx))
         schema = self._schema
         ShuffleExchangeExec._MESH_EXCHANGES_RUN += 1
+        # the whole-mesh dispatch gate covers every enqueue touching
+        # the sharded arrays (count phase, data phase, AND the
+        # unstack slicing): concurrent whole-mesh programs enqueued
+        # from two threads can invert per-device queue order and
+        # deadlock the collective rendezvous (exec/scheduler.py)
+        from spark_rapids_tpu.exec import scheduler as S
         with self.metrics.timed(M.TOTAL_TIME), \
-                P.span("mesh-exchange", cat=P.CAT_SHUFFLE):
+                P.span("mesh-exchange", cat=P.CAT_SHUFFLE), \
+                S.whole_mesh_dispatch(label="mesh-exchange"):
             arrs, num_rows = stack_batches(locals_, cap)
+            # explicit mesh layout (the pjit/GDA pattern): device d of
+            # the data axis owns stacked slot d.  Also REQUIRED for
+            # committed single-device inputs (an upstream SPMD gang's
+            # outputs live on the default device) — shard_map rejects
+            # them without the reshard.
+            import jax
+            from spark_rapids_tpu.parallel import mesh as PM
+            arrs, num_rows = jax.device_put(
+                (arrs, num_rows), PM.data_sharding(mesh, axis))
             # movement ledger: the payload the data-phase all-to-all
             # ships over ICI — every column's stacked data + validity
             # (+ lengths) arrays (the count phase is n_dev ints, noise)
             from spark_rapids_tpu.utils import movement as MV
             payload = 0
             if MV.ledger() is not None:
-                payload = sum(a.nbytes for field in arrs
-                              for a in field if a is not None)
+                payload = stacked_payload_bytes(arrs)
                 self.metrics.add(M.COLLECTIVE_BYTES, payload)
             # two-phase exchange (ADVICE r2): a counts-only all-to-all
             # sizes the data phase's receive buffers from ACTUAL totals
@@ -430,8 +446,8 @@ class ShuffleExchangeExec(UnaryExecBase):
             out_arrs, out_rows = watched_collective(
                 lambda: step(arrs, num_rows), label="mesh-exchange",
                 nbytes=payload)
-        out = unstack_batches(out_arrs, np.asarray(out_rows),
-                              self._schema)
+            out = unstack_batches(out_arrs, np.asarray(out_rows),
+                                  self._schema)
         for b in out:
             self.metrics.add("dataSize", b.device_size_bytes())
 

@@ -49,6 +49,11 @@ SLOWEST_HEARTBEAT = "slowestHeartbeatMs"
 # whole-stage fusion (plan/fusion.py): a fused stage whose kernel
 # failed to build/trace and fell back to the per-operator lane
 NUM_FUSION_DEOPTS = "numFusionDeopts"
+# SPMD whole-stage lane (exec/spmd.py): whole-mesh gang dispatches of
+# a fused stage (one per stage regardless of partition count) and
+# gangs that deopted back to the per-partition lane
+NUM_SPMD_DISPATCHES = "numSpmdDispatches"
+NUM_SPMD_DEOPTS = "numSpmdDeopts"
 NUM_FETCH_FAILURES = "numFetchFailures"
 NUM_MAP_RECOMPUTES = "numMapRecomputes"
 NUM_STAGE_RETRIES = "numStageRetries"

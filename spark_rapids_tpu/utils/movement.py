@@ -31,8 +31,16 @@ Edge classes (the five lanes of ROADMAP item 5):
   (`shuffle/compression.py`).  Edge totals count the send side only —
   in-process soak tests see both directions in one ledger, and summing
   them would double the traffic.
-* ``collective`` — ICI mesh all-to-all payloads
-  (`parallel/collective_exchange.py` via the mesh exchange lane).
+* ``collective`` — ICI mesh collective payloads: the hand-rolled
+  all-to-all of the mesh exchange lane
+  (`parallel/collective_exchange.py`, sites ``mesh-exchange`` /
+  ``mesh-count``) AND the implicit collectives XLA inserts into SPMD
+  whole-stage programs (`exec/spmd.py`, site ``spmd-stage`` — the
+  gang's output gather plus its cross-shard flag/row-count
+  reductions).  Both
+  lanes compute payloads through
+  `collective_exchange.stacked_payload_bytes`-style conventions
+  (bytes entering the collective), so their edge totals reconcile.
 
 Discipline (same as the profiler's): with profiling disabled the hot
 path pays ONE module-global read — `ledger()` resolves through

@@ -86,6 +86,8 @@ EV_OOM_FALLBACK = "oom_fallback"
 EV_DEOPT_RETRY = "deopt_retry"              # exec/base.py
 EV_STAGE_FUSED = "stage_fused"              # plan/fusion.py, exec/aggregate.py
 EV_FUSION_DEOPT = "fusion_deopt"
+EV_STAGE_SPMD = "stage_spmd"                # exec/spmd.py (gang dispatch)
+EV_SPMD_DEOPT = "spmd_deopt"
 EV_SPECULATION_LAUNCHED = "speculation_launched"  # exec/speculation.py
 EV_SPECULATION_WIN = "speculation_win"
 EV_HEDGE_FIRED = "hedge_fired"              # shuffle/manager.py

@@ -1,0 +1,439 @@
+"""SPMD whole-stage execution suite (exec/spmd.py + plan/fusion.py):
+
+* one Python dispatch per fused stage over the 8-device virtual mesh,
+  bit-exact vs the per-partition lane — TPC-H q1 and TPC-DS q3, incl.
+  under seeded OOM injection and with a ragged last partition;
+* `spark.rapids.sql.spmd.enabled` flipped per query across concurrent
+  scheduler sessions (conf isolation holds, results bit-exact);
+* deopt parity: an unsupported stage (trace failure) and an uneven
+  gang layout (mixed narrow shadows) fall back to the per-partition
+  lane with the right answer and `numSpmdDeopts` charged;
+* default-off: no mesh lane engages, plan shape unchanged;
+* ledger: the gang's implicit-collective bytes land on the `collective`
+  edge (site `spmd-stage`) and reconcile with the hand-rolled
+  mesh-exchange lane's accounting;
+* satellites: memoized mesh shardings, make_mesh over-subscription
+  error, the whole-mesh dispatch gate.
+"""
+import threading
+
+import jax
+import numpy as np
+import pandas as pd
+import pytest
+from pandas.testing import assert_frame_equal
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec import spmd as SP
+from spark_rapids_tpu.exec.basic import (FilterExec, LocalBatchSource,
+                                         ProjectExec)
+from spark_rapids_tpu.exprs.base import col, lit
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+from spark_rapids_tpu.parallel.mesh import (active_mesh, data_sharding,
+                                            make_mesh, replicated)
+from spark_rapids_tpu.plan.fusion import FusedStageExec, fuse_plan
+from spark_rapids_tpu.plan.nodes import (CpuFilter, CpuProject, CpuSort,
+                                         CpuSource)
+from spark_rapids_tpu.plan.overrides import accelerate, collect
+
+SPMD_ON = {"spark.rapids.sql.spmd.enabled": True}
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must force 8 cpu devices"
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def tpch_tables():
+    return gen_tables(np.random.default_rng(11), 1500)
+
+
+@pytest.fixture(scope="module")
+def tpcds_tables():
+    from spark_rapids_tpu.models import tpcds_data
+    return tpcds_data.gen_tables(np.random.default_rng(3), 3000)
+
+
+@pytest.fixture(scope="module")
+def q1_ref(tpch_tables):
+    """One per-partition-lane q1 reference shared by every parity
+    test in the module (each run_query is several seconds of suite
+    budget)."""
+    return run_query(1, tpch_tables, conf=_conf())
+
+
+def _conf(**kv):
+    base = dict(BENCH_CONF)
+    base.update({k.replace("__", "."): v for k, v in kv.items()})
+    return C.RapidsConf(base)
+
+
+def _find(plan, name):
+    if type(plan).__name__ == name:
+        return plan
+    for c in getattr(plan, "children", []):
+        r = _find(c, name)
+        if r is not None:
+            return r
+    return None
+
+
+def _chain_plan(df_parts=5, rows=4000, seed=1):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({
+        "a": rng.integers(0, 1000, rows).astype(np.int64),
+        "b": rng.uniform(0, 10, rows),
+    })
+    from spark_rapids_tpu.exec.sort import asc
+    src = CpuSource.from_pandas(df, num_partitions=df_parts)
+    plan = CpuSort(
+        [asc(col("y"))],
+        CpuProject(
+            [(col("x") + col("x")).alias("y"), col("b2")],
+            CpuFilter(col("x") > lit(100),
+                      CpuProject([(col("a") * lit(2)).alias("x"),
+                                  (col("b") * lit(3.0)).alias("b2")],
+                                 src))),
+        global_sort=True)
+    return plan, df
+
+
+# ---------------------------------------------------------------------------
+# dispatch shape: one gang dispatch per stage, flat in partition count
+@pytest.mark.parametrize("parts", [3, 13])
+def test_one_gang_dispatch_per_stage(mesh8, parts):
+    plan, _ = _chain_plan(df_parts=parts, seed=parts)
+    on, off = _conf(**SPMD_ON), _conf()
+    ref = collect(accelerate(plan, off), off)
+    SP.reset_spmd_stats()
+    with active_mesh(mesh8):
+        p = accelerate(plan, on)
+        assert _find(p, "FusedStageExec") is not None, p.tree_string()
+        got = collect(p, on)
+    st = SP.spmd_stats()
+    # ONE Python dispatch for the whole stage, however many partitions
+    assert st["gang_dispatches"] == 1, st
+    assert st["gang_batches"] == parts, st
+    assert st["deopts"] == 0, st
+    fused = _find(p, "FusedStageExec")
+    assert fused.metrics.as_dict().get("numSpmdDispatches") == 1
+    assert_frame_equal(got.reset_index(drop=True),
+                       ref.reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# parity: TPC-H q1 / TPC-DS q3 on the 8-device mesh
+def test_tpch_q1_parity_spmd_vs_per_partition(mesh8, tpch_tables,
+                                              q1_ref):
+    ref = q1_ref
+    SP.reset_spmd_stats()
+    with active_mesh(mesh8):
+        got = run_query(1, tpch_tables, conf=_conf(**SPMD_ON))
+    assert SP.spmd_stats()["gang_dispatches"] >= 1
+    assert_frame_equal(got.reset_index(drop=True),
+                       ref.reset_index(drop=True))
+
+
+def _run_tpcds(name, tables, conf):
+    from spark_rapids_tpu.models import tpcds_data, tpcds_queries
+    t = tpcds_data.sources(tables, 2)
+
+    def runner(p):
+        return collect(accelerate(p, conf), conf)
+    return runner(tpcds_queries.QUERIES[name](t, runner))
+
+
+def test_tpcds_q3_parity_spmd_vs_per_partition(mesh8, tpcds_tables):
+    ref = _run_tpcds("q3", tpcds_tables, _conf())
+    SP.reset_spmd_stats()
+    with active_mesh(mesh8):
+        got = _run_tpcds("q3", tpcds_tables, _conf(**SPMD_ON))
+    assert SP.spmd_stats()["gang_dispatches"] >= 1
+    assert_frame_equal(got.reset_index(drop=True),
+                       ref.reset_index(drop=True))
+
+
+def test_q1_parity_under_seeded_oom_injection(mesh8, tpch_tables,
+                                              q1_ref):
+    from spark_rapids_tpu.memory.retry import reset_oom_injection
+    inject = {
+        "spark__rapids__memory__faultInjection__oomRate": 1.0,
+        "spark__rapids__memory__faultInjection__seed": 7,
+        "spark__rapids__memory__faultInjection__maxInjections": 12}
+    clean = q1_ref
+    reset_oom_injection()
+    with active_mesh(mesh8):
+        got = run_query(1, tpch_tables,
+                        conf=_conf(**SPMD_ON, **inject))
+    reset_oom_injection()
+    assert_frame_equal(got.reset_index(drop=True),
+                       clean.reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# ragged partitions: per-slot masks keep padding bit-exact
+def test_ragged_last_partition(mesh8):
+    rng = np.random.default_rng(21)
+
+    def part(n, tag):
+        return [ColumnarBatch.from_pandas(pd.DataFrame({
+            "v": rng.integers(0, 500, n).astype(np.int64),
+            "w": rng.uniform(0, 1, n)}))] if n else []
+
+    parts = [part(2000, 0), part(700, 1), part(33, 2), [], part(3, 3)]
+    schema = parts[0][0].schema
+    on = _conf(**SPMD_ON)
+
+    def build():
+        src = LocalBatchSource([[b for b in p] for p in parts], schema)
+        return FilterExec(col("v") % lit(3) == lit(0),
+                          ProjectExec([(col("v") * lit(2)).alias("v"),
+                                       col("w")], src))
+
+    off_conf = _conf()
+    with C.session(off_conf):
+        ref = fuse_plan(build(), off_conf).collect().to_pandas()
+    SP.reset_spmd_stats()
+    with C.session(on), active_mesh(mesh8):
+        p = fuse_plan(build(), on)
+        assert isinstance(p, FusedStageExec)
+        got = p.collect().to_pandas()
+    st = SP.spmd_stats()
+    assert st["gang_dispatches"] == 1 and st["deopts"] == 0, st
+    # 4 non-empty partitions padded to 8 mesh slots
+    assert st["gang_batches"] == 4 and st["gang_slots"] == 8, st
+    assert_frame_equal(got.reset_index(drop=True),
+                       ref.reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# per-query conf isolation across concurrent scheduler sessions
+def test_spmd_flipped_per_query_concurrently(mesh8, tpch_tables,
+                                             q1_ref):
+    ref = q1_ref
+    results, errors = {}, []
+
+    def worker(i, conf):
+        try:
+            results[i] = run_query(1, tpch_tables, conf=conf)
+        except BaseException as e:  # noqa: BLE001
+            errors.append((i, repr(e)))
+
+    confs = [_conf(**SPMD_ON), _conf(), _conf(**SPMD_ON), _conf()]
+    SP.reset_spmd_stats()
+    with active_mesh(mesh8):
+        ts = [threading.Thread(target=worker, args=(i, cf))
+              for i, cf in enumerate(confs)]
+        [t.start() for t in ts]
+        [t.join(300) for t in ts]
+    assert not errors, errors
+    assert len(results) == len(confs)
+    for df in results.values():
+        assert_frame_equal(df.reset_index(drop=True),
+                           ref.reset_index(drop=True))
+    # only the SPMD sessions ganged; the gate saw every dispatch
+    assert SP.spmd_stats()["gang_dispatches"] >= 2
+    from spark_rapids_tpu.exec.scheduler import mesh_gate_stats
+    assert mesh_gate_stats()["dispatches"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# deopt lanes
+def test_trace_failure_deopts_to_per_partition_with_parity(mesh8):
+    from spark_rapids_tpu.exprs.base import Expression
+    from spark_rapids_tpu.plan.fusion import compose_chain
+
+    rng = np.random.default_rng(9)
+    df = pd.DataFrame({"v": rng.integers(0, 50, 500).astype(np.int64)})
+    src = LocalBatchSource.from_pandas(df, num_partitions=3)
+    p1 = ProjectExec([(col("v") * lit(2)).alias("w")], src)
+    p2 = ProjectExec([(col("w") + lit(1)).alias("u")], p1)
+    stage = compose_chain([p2, p1], src.output_schema())
+
+    class Poison(Expression):
+        def data_type(self, schema):
+            return T.INT64
+
+        def children(self):
+            return ()
+
+        def eval(self, ctx):
+            raise NotImplementedError("poisoned for the deopt test")
+
+    stage.out_exprs = [Poison()]
+    fused = FusedStageExec(stage, src)
+    fused._schema = p2.output_schema()
+    conf = _conf(**SPMD_ON)
+    SP.reset_spmd_stats()
+    with C.session(conf), active_mesh(mesh8):
+        out = fused.collect().to_pandas()
+    # the gang deopted, then the per-partition fused lane deopted too,
+    # and the per-operator members produced the right answer
+    assert fused._spmd_deopt and fused._fusion_deopt
+    m = fused.metrics.as_dict()
+    assert m.get("numSpmdDeopts", 0) >= 1
+    assert SP.spmd_stats()["deopts"] >= 1
+    assert (out["u"].to_numpy(dtype=np.int64)
+            == df["v"].to_numpy() * 2 + 1).all()
+
+
+def test_mixed_narrow_layout_deopts_with_parity(mesh8):
+    """One partition's int64 column fits int32 (narrow shadow uploaded)
+    and another's does not: the stacker cannot unify the gang layout
+    bit-exactly, so the stage deopts to the per-partition lane."""
+    small = pd.DataFrame({"v": np.arange(100, dtype=np.int64)})
+    big = pd.DataFrame({"v": (np.arange(100, dtype=np.int64)
+                              + (1 << 40))})
+    b_small = ColumnarBatch.from_pandas(small)
+    b_big = ColumnarBatch.from_pandas(big)
+    assert (b_small.column("v").narrow is None) != \
+        (b_big.column("v").narrow is None) or \
+        b_small.column("v").narrow is not None
+    src = LocalBatchSource([[b_small], [b_big]], b_small.schema)
+    plan = ProjectExec([(col("v") + lit(1)).alias("v1")], src)
+    conf = _conf(**SPMD_ON)
+    with C.session(conf):
+        fused = fuse_plan(plan, conf)
+        assert isinstance(fused, FusedStageExec)
+        SP.reset_spmd_stats()
+        with active_mesh(mesh8):
+            got = fused.collect().to_pandas()
+    if b_small.column("v").narrow is not None and \
+            b_big.column("v").narrow is None:
+        assert SP.spmd_stats()["deopts"] == 1
+        assert fused.metrics.as_dict().get("numSpmdDeopts") == 1
+    exp = np.concatenate([small["v"].to_numpy(),
+                          big["v"].to_numpy()]) + 1
+    assert (np.sort(got["v1"].to_numpy(dtype=np.int64))
+            == np.sort(exp)).all()
+
+
+def test_no_mesh_means_per_partition_lane(tpch_tables, q1_ref):
+    """spmd.enabled without an active mesh: the per-partition lane
+    runs (no gang dispatches) and the result is still right."""
+    SP.reset_spmd_stats()
+    got = run_query(1, tpch_tables, conf=_conf(**SPMD_ON))
+    assert SP.spmd_stats()["gang_dispatches"] == 0
+    assert_frame_equal(got.reset_index(drop=True),
+                       q1_ref.reset_index(drop=True))
+
+
+def test_default_off_keeps_plan_and_lane_untouched(mesh8):
+    """spmd.enabled default off: plan shape is the pre-SPMD one (no
+    single-operator stages, agg pre-chains still fold) and no gang
+    ever dispatches, even with a mesh active."""
+    plan, _ = _chain_plan(seed=41)
+    conf = _conf()
+    SP.reset_spmd_stats()
+    with active_mesh(mesh8):
+        p = accelerate(plan, conf)
+        collect(p, conf)
+    assert SP.spmd_stats()["gang_dispatches"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ledger: implicit collectives on the collective edge, reconciling
+# with the hand-rolled mesh-exchange lane's accounting
+def test_gang_collective_bytes_on_ledger(mesh8):
+    from spark_rapids_tpu.utils import profile as P
+    plan, _ = _chain_plan(df_parts=8, seed=5)
+    conf = _conf(**SPMD_ON,
+                 spark__rapids__sql__profile__enabled=True)
+    with active_mesh(mesh8):
+        collect(accelerate(plan, conf), conf)
+    prof = P.last_profile()
+    mv = prof.movement
+    sites = mv["edges"]["collective"]["sites"]
+    assert "spmd-stage" in sites, sites
+    spmd_bytes = sites["spmd-stage"]["bytes"]
+    # the gang's cross-shard payload is its outputs entering the
+    # output gather (plus the tiny flag/row-count reductions): at
+    # least the [8 slots x cap] keep mask for this filtering chain
+    assert spmd_bytes >= 8 * 512, sites
+    assert sites["spmd-stage"]["dur_ns"] > 0
+    ev = [e for e in prof.events if e["kind"] == "stage_spmd"]
+    assert ev and ev[0]["mesh_devices"] == 8, ev
+
+
+def test_collective_edge_reconciles_with_mesh_exchange(mesh8):
+    """The same chain feeding a mesh-routed hash exchange, SPMD on vs
+    off: both lanes' `collective` edge carries the exchange's stacked
+    payload (same stacked_payload_bytes convention), and the SPMD run
+    adds only its tiny implicit-reduction bytes on top."""
+    from spark_rapids_tpu.exprs.base import col as c_
+    from spark_rapids_tpu.shuffle.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partitioning import HashPartitioning
+    from spark_rapids_tpu.utils import profile as P
+
+    def build():
+        rng = np.random.default_rng(77)
+        df = pd.DataFrame({
+            "k": rng.integers(0, 64, 2048).astype(np.int64),
+            "v": rng.uniform(0, 1, 2048)})
+        src = LocalBatchSource.from_pandas(df, num_partitions=4)
+        chain = FilterExec(c_("k") < lit(60),
+                           ProjectExec([c_("k"),
+                                        (c_("v") * lit(2.0)).alias("v2")],
+                                       src))
+        return ShuffleExchangeExec(HashPartitioning([c_("k")], 8),
+                                   chain)
+
+    def run(conf):
+        with C.session(conf), active_mesh(mesh8):
+            plan = fuse_plan(build(), conf)
+            plan.collect()
+        prof = P.last_profile()
+        return prof.movement["edges"]["collective"]
+
+    off = run(_conf(spark__rapids__sql__profile__enabled=True))
+    on = run(_conf(**SPMD_ON,
+                   spark__rapids__sql__profile__enabled=True))
+    assert off["bytes"] > 0
+    spmd_extra = on["sites"].get("spmd-stage", {}).get("bytes", 0)
+    assert spmd_extra > 0
+    # identical exchange payload; only the implicit reduction differs
+    assert on["bytes"] - spmd_extra == pytest.approx(
+        off["bytes"], rel=0.02), (on, off)
+
+
+# ---------------------------------------------------------------------------
+# satellites: mesh helpers + dispatch gate
+def test_make_mesh_rejects_oversubscription():
+    with pytest.raises(ValueError, match="exceeds the"):
+        make_mesh(len(jax.devices()) + 1)
+
+
+def test_shardings_are_memoized(mesh8):
+    assert data_sharding(mesh8) is data_sharding(mesh8)
+    assert replicated(mesh8) is replicated(mesh8)
+    assert data_sharding(mesh8) is not replicated(mesh8)
+
+
+def test_whole_mesh_dispatch_gate_serializes():
+    from spark_rapids_tpu.exec.scheduler import (mesh_gate_stats,
+                                                 whole_mesh_dispatch)
+    inside, overlaps = [0], [0]
+    lock = threading.Lock()
+
+    def body(i):
+        with whole_mesh_dispatch(label=f"t{i}"):
+            with lock:
+                inside[0] += 1
+                if inside[0] > 1:
+                    overlaps[0] += 1
+            import time
+            time.sleep(0.02)
+            with lock:
+                inside[0] -= 1
+
+    before = mesh_gate_stats()["dispatches"]
+    ts = [threading.Thread(target=body, args=(i,)) for i in range(4)]
+    [t.start() for t in ts]
+    [t.join(30) for t in ts]
+    assert overlaps[0] == 0
+    assert mesh_gate_stats()["dispatches"] - before == 4

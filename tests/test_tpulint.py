@@ -32,6 +32,8 @@ RULE_FIXTURES = {
     "conf-discipline": os.path.join(FIXTURES, "plan", "fx_conf.py"),
     "compile-under-lock": os.path.join(FIXTURES, "exec",
                                        "fx_compile_lock.py"),
+    "collective-discipline": os.path.join(FIXTURES, "parallel",
+                                          "fx_collective.py"),
 }
 
 _EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([a-z\-, ]+)$")
@@ -144,8 +146,8 @@ def test_real_tree_lints_clean():
     # the baseline stays empty (repo policy: fix, don't grandfather)
     assert all(f.reason for f in res.suppressed)
     assert not res.baselined
-    assert len(res.rules) == 5
-    assert "rules=5" in summary_line(res)
+    assert len(res.rules) == 6
+    assert "rules=6" in summary_line(res)
 
 
 def test_conf_registry_parse_matches_runtime():

@@ -1,0 +1,57 @@
+"""collective-discipline rule fixture: mesh collectives must run
+under watched_collective (the collective-class watchdog + ledger
+site) or inside a shard_map/SPMD body the dispatch already watches."""
+import jax
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+from spark_rapids_tpu.parallel.collective_exchange import (
+    watched_collective)
+
+
+def naked_collectives(x, axis):
+    y = lax.psum(x, axis)                   # EXPECT: collective-discipline
+    z = jax.lax.all_to_all(x, axis, 0, 0)   # EXPECT: collective-discipline
+    g = lax.all_gather(x, axis)             # EXPECT: collective-discipline
+    p = lax.ppermute(x, axis, [(0, 1)])     # EXPECT: collective-discipline
+    return y, z, g, p
+
+
+def _helper(x, axis):
+    # called (transitively) from the shard_map body below: fine
+    return lax.psum(x, axis)
+
+
+def spmd_body(x):
+    # registered with shard_map below: fine
+    s = lax.all_to_all(x, "data", 0, 0)
+    return _helper(s, "data")
+
+
+def build(mesh):
+    return shard_map(spmd_body, mesh=mesh, in_specs=None,
+                     out_specs=None)
+
+
+def nested_body(mesh):
+    def per_device(x):
+        # nested def passed to shard_map: fine
+        return lax.all_gather(x, "data")
+    return shard_map(per_device, mesh=mesh, in_specs=None,
+                     out_specs=None)
+
+
+def watched_dispatch(x, axis, nbytes):
+    # lexically inside the watched thunk: fine
+    return watched_collective(lambda: lax.psum(x, axis),
+                              label="sum", nbytes=nbytes)
+
+
+def suppressed_collective(x, axis):
+    # tpulint: disable=collective-discipline -- fixture: single-host
+    # debug path, never dispatched on a mesh
+    return lax.psum(x, axis)
