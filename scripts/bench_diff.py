@@ -1,0 +1,368 @@
+#!/usr/bin/env python
+"""bench_diff: compare two BENCH_r*.json rounds and gate regressions.
+
+ROADMAP item 3 demands every slow-lane fix land with an
+instrument-validated before/after, but bench rounds were hand-diffed
+JSON blobs.  This tool makes rounds DIFFABLE and regression-GATED:
+
+  python scripts/bench_diff.py BENCH_r07.json BENCH_r08.json
+
+parses both rounds (the driver's ``{"tail": "...jsonl..."}`` wrapper or
+raw JSON-lines), matches bench lanes by metric name, and reports the
+per-lane delta — direction-aware (rows/s and GB/s up = better, wall_ms
+and overhead down = better).  A lane regressed past ``--threshold``
+percent (default 10) exits non-zero, so CI can gate on it; lanes
+present in only one round (a new bench, a phase the wall-clock cap
+killed, an error-shaped line) are TOLERATED and listed, never failed —
+partial rounds stay comparable.
+
+Attribution: for every regressed (or improved) lane the report names
+what moved underneath it, joining the per-lane and summary-line
+instrument fields both rounds already carry — utilization cause shifts
+(telemetry sampler), per-edge movement deltas (data-movement ledger),
+kernel-catalog/cache counters (kernel_cache_size/evictions,
+host_syncs, pipeline_wait), and per-kernel rows when a round embeds a
+``kernels`` list (utils/kernelprof.py) — so a round-to-round slowdown
+points at a kernel or an edge, not just a number.
+
+``--selftest`` runs the synthetic-round checks (regression detected /
+improvement passes / missing phase tolerated) and is wired into the
+lint tier of scripts/run_suite.sh.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: default regression gate (percent)
+DEFAULT_THRESHOLD = 10.0
+
+#: name fragments marking a metric where LOWER is better
+_LOWER_BETTER = ("_ms", "wall", "overhead", "latency", "host_syncs",
+                 "p95", "p50")
+
+
+def lower_is_better(name: str) -> bool:
+    return any(tok in name for tok in _LOWER_BETTER)
+
+
+# ---------------------------------------------------------------------------
+# parsing
+def _iter_json_lines(text: str):
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or not line.startswith("{"):
+            continue
+        try:
+            yield json.loads(line)
+        except ValueError:
+            continue
+
+
+def parse_round(text: str) -> dict:
+    """Parse one bench round into {"meta", "metrics", "summary"}.
+    Accepts the driver wrapper (a single JSON object whose "tail"
+    holds the final stdout lines), raw JSON-lines, or a JSON list."""
+    meta: dict = {}
+    recs: list = []
+    text = text.strip()
+    obj = None
+    if text.startswith("{") or text.startswith("["):
+        try:
+            obj = json.loads(text)
+        except ValueError:
+            obj = None
+    if isinstance(obj, dict) and "metric" not in obj:
+        meta = {k: obj.get(k) for k in ("n", "cmd", "rc", "note")
+                if k in obj}
+        recs = list(_iter_json_lines(str(obj.get("tail", ""))))
+        # older rounds carry the driver-parsed final summary separately
+        if isinstance(obj.get("parsed"), dict):
+            recs.append(obj["parsed"])
+    elif isinstance(obj, list):
+        recs = [r for r in obj if isinstance(r, dict)]
+    else:
+        recs = list(_iter_json_lines(text))
+    metrics: dict = {}
+    summary = None
+    for r in recs:
+        if "metric" not in r:
+            continue
+        # the driver-facing rolling summary rides extra engine-wide
+        # fields; keep the LAST occurrence of it AND of each lane
+        if "submetrics" in r or ("hbm_probe_gbps" in r
+                                 and "host_syncs" in r):
+            summary = r
+            continue
+        metrics[r["metric"]] = r
+    # a truncated round (the driver keeps a bounded stdout tail) may
+    # have lost its per-lane lines: the rolling summary's compact
+    # submetrics carry every lane measured so far — fold them in
+    # without shadowing full lines
+    for sub in (summary or {}).get("submetrics") or []:
+        if isinstance(sub, dict) and sub.get("metric") not in metrics:
+            metrics[sub["metric"]] = sub
+    return {"meta": meta, "metrics": metrics, "summary": summary}
+
+
+def load_round(path: str) -> dict:
+    with open(path) as f:
+        rnd = parse_round(f.read())
+    rnd["path"] = path
+    return rnd
+
+
+# ---------------------------------------------------------------------------
+# attribution: what moved underneath a lane
+def _util_shift(a: dict, b: dict) -> list:
+    ua, ub = a.get("util") or {}, b.get("util") or {}
+    notes = []
+    for cause in sorted(set(ua) | set(ub)):
+        if cause == "samples":
+            continue
+        d = float(ub.get(cause, 0.0)) - float(ua.get(cause, 0.0))
+        if abs(d) >= 5.0:
+            notes.append(f"util.{cause} {d:+.1f}pp")
+    return notes
+
+
+def _kernel_shift(a: dict, b: dict) -> list:
+    """Per-kernel rows (utils/kernelprof.py report embeds) keyed by
+    label: the biggest device-time movers."""
+    ka = {r.get("label"): r for r in a.get("kernels") or []}
+    kb = {r.get("label"): r for r in b.get("kernels") or []}
+    moves = []
+    for label in set(ka) | set(kb):
+        da = float((ka.get(label) or {}).get("device_ms", 0.0))
+        db = float((kb.get(label) or {}).get("device_ms", 0.0))
+        if da or db:
+            moves.append((abs(db - da), label, da, db))
+    moves.sort(reverse=True)
+    return [f"kernel[{label}] {da:.1f}->{db:.1f}ms"
+            for _, label, da, db in moves[:3] if abs(db - da) > 0.05]
+
+
+def _edge_shift(a: dict, b: dict) -> list:
+    """movement_edges ({edge: [MB, GB/s]}) deltas from summary lines."""
+    ea, eb = a.get("movement_edges") or {}, b.get("movement_edges") or {}
+    notes = []
+    for edge in sorted(set(ea) | set(eb)):
+        mba = float((ea.get(edge) or [0])[0])
+        mbb = float((eb.get(edge) or [0])[0])
+        if abs(mbb - mba) >= max(1.0, 0.25 * max(mba, mbb)) \
+                and (mba or mbb):
+            notes.append(f"edge.{edge} {mba:.1f}->{mbb:.1f}MB")
+    return notes
+
+
+def _summary_shift(a: dict, b: dict) -> list:
+    notes = []
+    for k in ("kernel_cache_size", "kernel_cache_evictions",
+              "host_syncs", "prefetch_hits"):
+        va, vb = a.get(k), b.get(k)
+        if va is None or vb is None or va == vb:
+            continue
+        rel = abs(vb - va) / max(abs(va), 1)
+        if rel >= 0.25:
+            notes.append(f"{k} {va}->{vb}")
+    pa, pb = a.get("pipeline_wait_ms"), b.get("pipeline_wait_ms")
+    if pa is not None and pb is not None \
+            and abs(pb - pa) >= max(1000.0, 0.25 * max(pa, pb)):
+        notes.append(f"pipeline_wait_ms {pa:.0f}->{pb:.0f}")
+    return notes
+
+
+# ---------------------------------------------------------------------------
+def compare_rounds(a: dict, b: dict,
+                   threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """The diff: per-lane deltas with direction-aware classification
+    plus attribution notes.  `threshold` is the regression gate in
+    percent."""
+    ma, mb = a["metrics"], b["metrics"]
+    lanes, regressions = [], []
+    added = sorted(set(mb) - set(ma))
+    removed = sorted(set(ma) - set(mb))
+    for name in sorted(set(ma) & set(mb)):
+        la, lb = ma[name], mb[name]
+        failed_a = bool(la.get("error")) or not la.get("value")
+        failed_b = bool(lb.get("error")) or not lb.get("value")
+        if failed_a or failed_b:
+            # a lane that errored or recorded 0 in either round is a
+            # missing phase, not a measured regression
+            lanes.append({"metric": name, "status": "incomparable",
+                          "a": la.get("value"), "b": lb.get("value"),
+                          "error": (la.get("error")
+                                    or lb.get("error"))})
+            continue
+        va, vb = float(la["value"]), float(lb["value"])
+        lower = lower_is_better(name)
+        delta_pct = 100.0 * (vb - va) / abs(va) if va else 0.0
+        worse = (delta_pct > 0) if lower else (delta_pct < 0)
+        magnitude = abs(delta_pct)
+        status = "flat"
+        if magnitude >= threshold:
+            status = "regressed" if worse else "improved"
+        notes = (_util_shift(la, lb) + _kernel_shift(la, lb)
+                 + _edge_shift(la, lb))
+        lane = {"metric": name, "status": status,
+                "a": va, "b": vb,
+                "delta_pct": round(delta_pct, 2),
+                "lower_is_better": lower,
+                "vs_baseline": [la.get("vs_baseline"),
+                                lb.get("vs_baseline")],
+                "attribution": notes}
+        lanes.append(lane)
+        if status == "regressed":
+            regressions.append(lane)
+    summary_notes = []
+    if a.get("summary") and b.get("summary"):
+        summary_notes = (_summary_shift(a["summary"], b["summary"])
+                         + _util_shift(a["summary"], b["summary"])
+                         + _edge_shift(a["summary"], b["summary"]))
+    return {"threshold_pct": threshold,
+            "lanes": lanes,
+            "regressions": [l["metric"] for l in regressions],
+            "added": added, "removed": removed,
+            "engine_wide": summary_notes}
+
+
+def format_report(rep: dict, a_name: str, b_name: str) -> str:
+    lines = [f"== bench diff: {a_name} -> {b_name} "
+             f"(gate {rep['threshold_pct']:.0f}%) =="]
+    order = {"regressed": 0, "improved": 1, "flat": 2,
+             "incomparable": 3}
+    for l in sorted(rep["lanes"],
+                    key=lambda l: (order[l["status"]],
+                                   -abs(l.get("delta_pct", 0)))):
+        if l["status"] == "incomparable":
+            lines.append(f"  ~ {l['metric']:34s} incomparable "
+                         f"({l['a']!r} -> {l['b']!r})"
+                         + (f"  [{str(l['error'])[:60]}]"
+                            if l.get("error") else ""))
+            continue
+        mark = {"regressed": "-", "improved": "+", "flat": "="}[
+            l["status"]]
+        arrow = "(lower=better)" if l["lower_is_better"] else ""
+        lines.append(
+            f"  {mark} {l['metric']:34s} {l['a']:>14.3f} -> "
+            f"{l['b']:>14.3f}  {l['delta_pct']:+7.2f}%  "
+            f"{l['status']} {arrow}")
+        for n in l["attribution"]:
+            lines.append(f"        attributed: {n}")
+    for name in rep["added"]:
+        lines.append(f"  + {name:34s} new lane (no baseline)")
+    for name in rep["removed"]:
+        lines.append(f"  ~ {name:34s} missing in the newer round "
+                     "(tolerated)")
+    if rep["engine_wide"]:
+        lines.append("  engine-wide: " + "; ".join(rep["engine_wide"]))
+    n_reg = len(rep["regressions"])
+    lines.append(f"  verdict: {n_reg} regression(s) past the gate"
+                 + (f" -> {', '.join(rep['regressions'])}"
+                    if n_reg else ""))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+def _selftest() -> int:
+    """Synthetic-round behavior checks: regression detected, improvement
+    passes, missing phase tolerated, attribution surfaces."""
+    base = "\n".join(json.dumps(r) for r in [
+        {"metric": "tpch_q1_rows_per_sec", "value": 100.0,
+         "vs_baseline": 2.0,
+         "util": {"samples": 100, "busy": 60.0, "idle": 40.0}},
+        {"metric": "groupby_sf1_wall_ms", "value": 50.0,
+         "vs_baseline": 1.0},
+        {"metric": "udf_q27_rows_per_sec", "value": 10.0},
+    ])
+    a = parse_round(base)
+    assert set(a["metrics"]) == {"tpch_q1_rows_per_sec",
+                                 "groupby_sf1_wall_ms",
+                                 "udf_q27_rows_per_sec"}, a["metrics"]
+    # wrapper form parses identically
+    wrapped = parse_round(json.dumps({"n": 1, "rc": 0, "tail": base}))
+    assert set(wrapped["metrics"]) == set(a["metrics"])
+
+    # 1) injected regression on a higher-is-better lane is detected,
+    #    with a utilization attribution note
+    reg = parse_round("\n".join(json.dumps(r) for r in [
+        {"metric": "tpch_q1_rows_per_sec", "value": 70.0,
+         "vs_baseline": 1.4,
+         "util": {"samples": 100, "busy": 30.0, "idle": 70.0},
+         "kernels": [{"label": "agg-update", "device_ms": 900.0}]},
+        {"metric": "groupby_sf1_wall_ms", "value": 50.0},
+        {"metric": "udf_q27_rows_per_sec", "value": 10.0},
+    ]))
+    rep = compare_rounds(a, reg, threshold=10.0)
+    assert rep["regressions"] == ["tpch_q1_rows_per_sec"], rep
+    lane = next(l for l in rep["lanes"]
+                if l["metric"] == "tpch_q1_rows_per_sec")
+    assert any("util." in n for n in lane["attribution"]), lane
+    assert any("kernel[" in n for n in lane["attribution"]), lane
+
+    # 2) improvement (and a lower-is-better improvement) passes
+    imp = parse_round("\n".join(json.dumps(r) for r in [
+        {"metric": "tpch_q1_rows_per_sec", "value": 130.0},
+        {"metric": "groupby_sf1_wall_ms", "value": 40.0},
+        {"metric": "udf_q27_rows_per_sec", "value": 10.5},
+    ]))
+    rep = compare_rounds(a, imp, threshold=10.0)
+    assert rep["regressions"] == [], rep
+    assert {l["status"] for l in rep["lanes"]} == {"improved", "flat"}
+
+    # 3) a wall_ms lane getting SLOWER is a regression
+    slow = parse_round(json.dumps(
+        {"metric": "groupby_sf1_wall_ms", "value": 80.0}) + "\n"
+        + json.dumps({"metric": "tpch_q1_rows_per_sec",
+                      "value": 100.0}) + "\n"
+        + json.dumps({"metric": "udf_q27_rows_per_sec", "value": 10.0}))
+    rep = compare_rounds(a, slow, threshold=10.0)
+    assert rep["regressions"] == ["groupby_sf1_wall_ms"], rep
+
+    # 4) missing / errored phases are tolerated, never gated
+    partial = parse_round("\n".join(json.dumps(r) for r in [
+        {"metric": "tpch_q1_rows_per_sec", "value": 99.0},
+        {"metric": "udf_q27_rows_per_sec", "value": 0,
+         "error": "TimeoutError: wall cap"},
+    ]))
+    rep = compare_rounds(a, partial, threshold=10.0)
+    assert rep["regressions"] == [], rep
+    assert "groupby_sf1_wall_ms" in rep["removed"], rep
+    assert any(l["status"] == "incomparable" for l in rep["lanes"])
+    print("bench_diff selftest: ok (regression gated, improvement "
+          "passed, missing phase tolerated, attribution surfaced)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("rounds", nargs="*",
+                    help="two BENCH_r*.json rounds: old new")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD,
+                    help="regression gate in percent (default 10)")
+    ap.add_argument("--no-gate", action="store_true",
+                    help="report only; always exit 0")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full diff as JSON")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the synthetic-round behavior checks")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if len(args.rounds) != 2:
+        ap.error("expected exactly two rounds (old new)")
+    a, b = load_round(args.rounds[0]), load_round(args.rounds[1])
+    rep = compare_rounds(a, b, threshold=args.threshold)
+    if args.json:
+        print(json.dumps(rep, indent=2))
+    else:
+        print(format_report(rep, args.rounds[0], args.rounds[1]))
+    if args.no_gate:
+        return 0
+    return 1 if rep["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

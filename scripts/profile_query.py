@@ -78,15 +78,27 @@ def main():
                     help="JSONL event log output path")
     ap.add_argument("--top", type=int, default=10,
                     help="slowest spans to print")
+    ap.add_argument("--kernels", action="store_true",
+                    help="per-kernel attribution: time every dispatch "
+                    "(sampleRate=1, pipelining off so the kernel sum "
+                    "is comparable to the compute bucket) and print "
+                    "the '-- kernels --' roofline table")
     args = ap.parse_args()
 
     from spark_rapids_tpu import config as C
     from spark_rapids_tpu.utils import profile as P
-    conf = C.RapidsConf({
+    kv = {
         "spark.rapids.sql.variableFloatAgg.enabled": True,
         "spark.rapids.sql.incompatibleOps.enabled": True,
         "spark.rapids.sql.profile.enabled": True,
-    })
+    }
+    if args.kernels:
+        kv.update({
+            "spark.rapids.sql.profile.kernels.enabled": True,
+            "spark.rapids.sql.profile.kernels.sampleRate": 1,
+            "spark.rapids.sql.pipeline.enabled": False,
+        })
+    conf = C.RapidsConf(kv)
     if args.suite == "tpch":
         _run_tpch(int(args.query), args.scale or 100_000, conf,
                   args.runs)

@@ -25,6 +25,17 @@ run_lint() {
   # JSON on stdout for tooling; the summary line rides stderr
   python scripts/lint.py --format json > /dev/null
   python scripts/gen_configs_doc.py --check
+  # bench-round drift gate: the differ's synthetic-round behavior
+  # checks (regression detected -> non-zero exit, improvement passes,
+  # missing phase tolerated), then a report-only diff of the two
+  # newest committed rounds so round-to-round drift is visible in
+  # every lint run without gating on environmental noise
+  python scripts/bench_diff.py --selftest
+  latest=$(ls BENCH_r*.json 2>/dev/null | sort | tail -2)
+  if [ "$(echo "$latest" | wc -l)" -eq 2 ]; then
+    # shellcheck disable=SC2086
+    python scripts/bench_diff.py $latest --no-gate | tail -5
+  fi
 }
 
 run_fast() {
@@ -45,6 +56,60 @@ run_fast() {
   run_spmd
   run_speculation
   run_telemetry
+  run_kernelprof
+}
+
+run_kernelprof() {
+  # kernel-attribution lane: the kernelprof suite (disabled-path
+  # parity, sampling, per-query isolation, catalog/cost capture,
+  # roofline single-source) + bench_diff units, then one profiled q1
+  # whose '-- kernels --' section must attribute the compute bucket —
+  # the summary line carries coverage, top kernel, and roofline %.
+  echo "== kernelprof lane (per-kernel device timing, cost/roofline attribution) =="
+  "${PYTEST[@]}" tests/test_kernelprof.py tests/test_bench_diff.py
+  python - <<'PYEOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from pandas.testing import assert_frame_equal
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+from spark_rapids_tpu.utils import kernelprof as KP
+from spark_rapids_tpu.utils import profile as P
+
+tables = gen_tables(np.random.default_rng(11), 20000)
+off = C.RapidsConf(dict(BENCH_CONF))
+on = C.RapidsConf({**BENCH_CONF,
+    "spark.rapids.sql.pipeline.enabled": False,
+    "spark.rapids.sql.profile.enabled": True,
+    "spark.rapids.sql.profile.kernels.enabled": True,
+    "spark.rapids.sql.profile.kernels.sampleRate": 1})
+ref = run_query(1, tables, conf=off)
+run_query(1, tables, conf=on)      # warm: first dispatches = compile
+got = run_query(1, tables, conf=on)
+assert_frame_equal(got.reset_index(drop=True),
+                   ref.reset_index(drop=True))
+prof = P.last_profile()
+rows = prof.kernels
+assert rows, "no kernel attribution rows"
+assert "-- kernels --" in prof.explain()
+kernel_ms = sum(r["device_ms"] for r in rows)
+compute_ms = prof.breakdown["compute_s"] * 1e3
+cov = kernel_ms / compute_ms if compute_ms else 0.0
+roofed = [r for r in rows if "roofline_pct" in r]
+assert roofed, "no kernel carried a cost/roofline join"
+assert 0.35 <= cov <= 1.5, f"kernel/compute coverage wildly off: {cov}"
+top = rows[0]
+print("kernelprof summary: kernels=%d dispatches=%d kernel_ms=%.1f "
+      "compute_ms=%.1f coverage=%.2f top=%s@%.1fms roofline=%.3f%% "
+      "(%s-bound) catalog=%d" % (
+          len(rows), sum(r["dispatches"] for r in rows), kernel_ms,
+          compute_ms, cov, top["label"], top["device_ms"],
+          top.get("roofline_pct", 0.0), top.get("bound", "?"),
+          KP.catalog_size()))
+KP.reset()
+PYEOF
 }
 
 run_spmd() {
@@ -540,7 +605,8 @@ case "$TIER" in
   spmd)     run_spmd ;;
   speculation) run_speculation ;;
   telemetry) run_telemetry ;;
+  kernelprof) run_kernelprof ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [lint|gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|spmd|speculation|telemetry|all]" >&2
+  *) echo "usage: $0 [lint|gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|spmd|speculation|telemetry|kernelprof|all]" >&2
      exit 2 ;;
 esac

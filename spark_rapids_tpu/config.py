@@ -287,12 +287,13 @@ MOVEMENT_ENABLED = conf(
 MOVEMENT_ROOFLINE_GBPS = conf(
     "spark.rapids.sql.profile.movement.rooflineGBps", 0.0,
     "Bandwidth ceiling (GB/s) the movement report computes "
-    "utilization against, for every edge.  0 (default) uses the "
-    "per-edge nominal table in utils/movement.py (host link for "
-    "upload/readback/spill, DCN NIC for wire, ICI for collectives); "
-    "set this to a probed number (e.g. bench.py's "
-    "probe_hbm_bandwidth) to judge all edges against measured "
-    "hardware instead.")
+    "utilization against, for every edge.  0 (default) resolves the "
+    "per-edge ceilings through the shared roofline table "
+    "(spark.rapids.sql.profile.roofline.*, utils/roofline.py — the "
+    "same source kernelprof judges kernels against); a non-zero "
+    "value overrides ALL edges at once, e.g. with a probed number "
+    "(bench.py's probe_hbm_bandwidth) to judge every edge against "
+    "measured hardware instead.")
 MOVEMENT_MIN_EVENT_BYTES = conf(
     "spark.rapids.sql.profile.movement.minEventBytes", 65536,
     "Movement records at or above this many bytes also land in the "
@@ -300,6 +301,88 @@ MOVEMENT_MIN_EVENT_BYTES = conf(
     "retries, fetch failures, and watchdog dumps by query id); "
     "smaller records are aggregated into the ledger only, keeping the "
     "event ring for interesting transfers.  0 logs every record.")
+KERNELPROF_ENABLED = conf(
+    "spark.rapids.sql.profile.kernels.enabled", False,
+    "Per-kernel performance attribution (utils/kernelprof.py): every "
+    "compiled executable in the KernelCache is wrapped so a sampled "
+    "fraction of its dispatches is timed with a device sync "
+    "(block_until_ready bracket, accounted via note_host_sync) and "
+    "joined with XLA cost_analysis()/memory_analysis() — FLOPs, bytes "
+    "accessed, temp allocation, captured once per kernel at its first "
+    "dispatch (the actual compile point) — into achieved GFLOP/s and "
+    "GB/s vs the conf-overridable roofline table "
+    "(spark.rapids.sql.profile.roofline.*).  Profiled queries "
+    "additionally get a '-- kernels --' section in their QueryProfile "
+    "(top-N kernels by cumulative device time, roofline %, compile "
+    "ms, dispatch counts, owning plan nodes) plus Perfetto kernel "
+    "tracks, and the slow-query log names each fingerprint's hottest "
+    "kernel.  Off (default): kernels dispatch raw — zero wrappers, "
+    "zero syncs, bit-exact.")
+KERNELPROF_SAMPLE_RATE = conf(
+    "spark.rapids.sql.profile.kernels.sampleRate", 8,
+    "Time every Nth dispatch of each kernel (1 = every dispatch).  "
+    "Each timed dispatch pays one block_until_ready device sync, so "
+    "the rate trades attribution accuracy (unsampled dispatches are "
+    "estimated by scaling the sampled mean) against pipeline-overlap "
+    "perturbation; 8 keeps measured overhead well inside the "
+    "profiler's <2% budget while a rate of 1 makes the per-kernel "
+    "device-time sum directly comparable to the wall-clock "
+    "breakdown's compute category.")
+KERNELPROF_COST_ANALYSIS = conf(
+    "spark.rapids.sql.profile.kernels.costAnalysis", True,
+    "Capture XLA cost_analysis()/memory_analysis() (FLOPs, bytes "
+    "accessed, argument/output/temp sizes) once per kernel at its "
+    "first dispatch, enabling the achieved-GFLOP/s / GB/s roofline "
+    "join.  Capture re-lowers the jitted function once (a second "
+    "trace+compile per kernel); disable to keep timing-only "
+    "attribution on compile-dominated workloads.")
+KERNELPROF_TOP_N = conf(
+    "spark.rapids.sql.profile.kernels.topN", 12,
+    "How many kernels (by cumulative attributed device time) the "
+    "QueryProfile's '-- kernels --' section renders; the full "
+    "per-fingerprint table stays queryable via "
+    "QueryProfile.kernels and utils.kernelprof.catalog().")
+
+# --- shared roofline table (utils/roofline.py) --------------------------------
+# ONE conf-overridable source for every bandwidth/compute ceiling the
+# instruments judge against: the movement ledger's per-edge GB/s
+# utilization AND kernelprof's achieved-GFLOP/s / GB/s join both
+# resolve through utils/roofline.py (two diverging nominal tables was
+# the bug class this replaces).
+ROOFLINE_UPLOAD_GBPS = conf(
+    "spark.rapids.sql.profile.roofline.uploadGBps", 32.0,
+    "Nominal host->device bandwidth ceiling (GB/s) for the movement "
+    "report's upload edge (PCIe-gen4-x16-class / tunnel attachment).")
+ROOFLINE_READBACK_GBPS = conf(
+    "spark.rapids.sql.profile.roofline.readbackGBps", 32.0,
+    "Nominal device->host bandwidth ceiling (GB/s) for the movement "
+    "report's readback edge.")
+ROOFLINE_SPILL_GBPS = conf(
+    "spark.rapids.sql.profile.roofline.spillGBps", 32.0,
+    "Nominal bandwidth ceiling (GB/s) for spill tier migrations "
+    "(device->host->disk hops share the host-link ceiling).")
+ROOFLINE_WIRE_GBPS = conf(
+    "spark.rapids.sql.profile.roofline.wireGBps", 12.5,
+    "Nominal shuffle-wire bandwidth ceiling (GB/s); the default "
+    "models a 100 Gb/s DCN NIC.")
+ROOFLINE_COLLECTIVE_GBPS = conf(
+    "spark.rapids.sql.profile.roofline.collectiveGBps", 400.0,
+    "Nominal ICI collective bandwidth ceiling (GB/s); the default is "
+    "the v5e per-chip ICI nominal.")
+ROOFLINE_HBM_GBPS = conf(
+    "spark.rapids.sql.profile.roofline.hbmGBps", 819.0,
+    "HBM bandwidth ceiling (GB/s) kernelprof judges per-kernel "
+    "achieved GB/s (XLA bytes-accessed / device time) against; the "
+    "default is the v5e nominal.  Set to a probed number (bench.py "
+    "hbm_probe_gbps) to judge against measured hardware.")
+ROOFLINE_PEAK_GFLOPS = conf(
+    "spark.rapids.sql.profile.roofline.peakGflops", 197000.0,
+    "Compute ceiling (GFLOP/s) kernelprof judges per-kernel achieved "
+    "GFLOP/s against; the default is the v5e bf16 nominal (197 "
+    "TFLOP/s).  A kernel's roofline utilization is the max of its "
+    "compute fraction and its HBM-bandwidth fraction — whichever "
+    "resource binds.")
+
 PROFILE_EVENT_LOG_MAX_BYTES = conf(
     "spark.rapids.sql.profile.eventLog.maxBytes", 134217728,
     "Size-based rotation bound for the profile event-log JSONL sink "

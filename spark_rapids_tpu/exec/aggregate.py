@@ -303,6 +303,8 @@ class HashAggregateExec(UnaryExecBase):
         use_banded = self._use_banded(batch, phase)
         key = ("agg", phase, use_hash, use_banded, wcap,
                batch_signature(batch))
+        kp_members = (self._pre_stage.member_names()
+                      if self._pre_stage is not None else None)
 
         def build():
             cap = batch.capacity
@@ -404,7 +406,12 @@ class HashAggregateExec(UnaryExecBase):
 
             return kernel
 
-        return self.kernels.get_or_build(key, build)
+        # update-lane kernels of a fused aggregate carry the composed
+        # pre-stage's member names, so the kernel table attributes the
+        # inlined project/filter work to this kernel too
+        return self.kernels.get_or_build(
+            key, build,
+            meta=self.kp_meta(f"agg-{phase}", members=kp_members))
 
     def _banded_aggregate(self, phase, sorted_per_f, sorted_valid,
                           bounds, seg_ids, grp_valid, cap, out_cap):
@@ -578,7 +585,8 @@ class HashAggregateExec(UnaryExecBase):
 
             return kernel
 
-        return self.kernels.get_or_build(key, build)
+        return self.kernels.get_or_build(
+            key, build, meta=self.kp_meta("agg-eval"))
 
     # -- dictionary fast path (conf-gated) -----------------------------------
     def _dict_plan(self):
@@ -667,7 +675,8 @@ class HashAggregateExec(UnaryExecBase):
         if self._dict_gpad is None:
             probe = self.kernels.get_or_build(
                 ("dict-probe", nk, batch_signature(batch)),
-                lambda: jax.jit(self._build_dict_probe(batch.capacity)))
+                lambda: jax.jit(self._build_dict_probe(batch.capacity)),
+                meta=self.kp_meta("agg-dict-probe"))
             if batch.sparse is not None:
                 kmins, kmaxs = probe(batch.columns, batch.num_rows_i32,
                                      batch.sparse)
@@ -702,16 +711,22 @@ class HashAggregateExec(UnaryExecBase):
                 self._dict_gpad = tuple(pads)
         g_pad = self._dict_gpad
 
+        kp_members = (self._pre_stage.member_names()
+                      if self._pre_stage is not None else None)
         if nk == 1:
             fused = self.kernels.get_or_build(
                 ("dict-fused", g_pad, batch_signature(batch)),
                 lambda: jax.jit(
-                    self._build_dict_fused(batch.capacity, g_pad)))
+                    self._build_dict_fused(batch.capacity, g_pad)),
+                meta=self.kp_meta("agg-dict-fused",
+                                  members=kp_members))
         else:
             fused = self.kernels.get_or_build(
                 ("dict-fused-multi", g_pad, batch_signature(batch)),
                 lambda: jax.jit(self._build_dict_fused_multi(
-                    batch.capacity, list(g_pad))))
+                    batch.capacity, list(g_pad))),
+                meta=self.kp_meta("agg-dict-fused-multi",
+                                  members=kp_members))
         if batch.sparse is not None:
             cols, n, excess = fused(batch.columns, batch.num_rows_i32,
                                     batch.sparse)
@@ -1288,7 +1303,12 @@ class HashAggregateExec(UnaryExecBase):
 
             return kernel
 
-        return self.kernels.get_or_build(key, build)
+        return self.kernels.get_or_build(
+            key, build,
+            meta=self.kp_meta(
+                f"agg-reduce-{phase}",
+                members=(self._pre_stage.member_names()
+                         if self._pre_stage is not None else None)))
 
     def _merge_reduction(self, partials, inter_schema) -> ColumnarBatch:
         merged = concat_batches(partials)

@@ -21,6 +21,7 @@ from spark_rapids_tpu import types as T
 from spark_rapids_tpu.columnar.batch import ColumnarBatch
 from spark_rapids_tpu.columnar.vector import ColumnVector
 from spark_rapids_tpu.exprs.base import EvalContext, Expression
+from spark_rapids_tpu.utils import kernelprof as KP
 from spark_rapids_tpu.utils import metrics as M
 from spark_rapids_tpu.utils.tracing import trace_range
 
@@ -167,13 +168,17 @@ class KernelCache:
         self._cache: dict = {} if scope is None else None
 
     @staticmethod
-    def _build_watched(key, builder: Callable[[], Callable]):
+    def _build_watched(key, builder: Callable[[], Callable],
+                       kp_entry=None):
         """Run the (seconds-to-minutes) trace/compile under a
         compile-class watchdog heartbeat, with the compile hang-
         injection site in front so a wedged XLA compile is testable.
         A profiled query additionally records the compile as a span
         (cat 'compile'), so cold-start cost is attributable in the
-        wall-clock breakdown."""
+        wall-clock breakdown; with kernel attribution on, the builder
+        wall time also lands on the kernel's catalog entry
+        (utils/kernelprof.py — the first DISPATCH, where a lazy jit
+        actually compiles, is timed there separately)."""
         from spark_rapids_tpu.utils import profile as P
         from spark_rapids_tpu.utils import watchdog as W
         label = f"compile:{key!r:.120}"
@@ -186,16 +191,52 @@ class KernelCache:
                 return builder()
             finally:
                 global _COMPILE_NS_TOTAL, _COMPILE_COUNT
+                dt = _time.perf_counter_ns() - t0
                 with _COMPILE_STATS_LOCK:
-                    _COMPILE_NS_TOTAL += _time.perf_counter_ns() - t0
+                    _COMPILE_NS_TOTAL += dt
                     _COMPILE_COUNT += 1
+                if kp_entry is not None:
+                    kp_entry.note_build(dt)
 
-    def get_or_build(self, key: tuple, builder: Callable[[], Callable]):
+    def _kp_identity(self, key: tuple) -> tuple:
+        """Catalog identity for a kernel of this cache: the structural
+        scope when there is one; private caches get a process-unique
+        token so unrelated private kernels never merge."""
+        if self._scope is not None:
+            return (self._scope, key)
+        tok = self.__dict__.get("_kp_token")
+        if tok is None:
+            tok = self.__dict__["_kp_token"] = \
+                ("private", KP.private_token())
+        return (tok, key)
+
+    def get_or_build(self, key: tuple, builder: Callable[[], Callable],
+                     meta: Optional[dict] = None):
+        """`meta` (only read while kernel attribution is enabled —
+        build it via `TpuExec.kp_meta`, which returns None otherwise)
+        attaches dispatch-site context to the kernel's catalog entry:
+        a human label, the owning exec, and fused member names."""
+        kp_on = KP.enabled()
         if self._scope is None:
             fn = self._cache.get(key)
             if fn is None:
-                fn = self._build_watched(key, builder)
+                if kp_on:
+                    ident = self._kp_identity(key)
+                    fn = self._build_watched(key, builder,
+                                             KP.entry_for(ident))
+                    fn = KP.watch(ident, fn)
+                else:
+                    fn = self._build_watched(key, builder)
                 self._cache[key] = fn
+            elif kp_on and callable(fn) \
+                    and not isinstance(fn, KP.WatchedKernel):
+                # cached before attribution was enabled: upgrade in
+                # place — the executable is already warm, so its first
+                # wrapped dispatch is device time, not compile
+                fn = KP.watch(self._kp_identity(key), fn, cold=False)
+                self._cache[key] = fn
+            if kp_on and meta is not None:
+                KP.annotate(fn, meta)
             return fn
         from spark_rapids_tpu.utils import watchdog as W
         gk = (self._scope, key)
@@ -205,13 +246,25 @@ class KernelCache:
                 fn = _GLOBAL_KERNELS.get(gk)
                 if fn is not None:
                     _GLOBAL_KERNELS.move_to_end(gk)
-                    return fn
-                ev = _GLOBAL_KERNELS_BUILDING.get(gk)
-                if ev is None:
-                    # claim the build; compile happens OUTSIDE the lock
-                    claimed = threading.Event()
-                    _GLOBAL_KERNELS_BUILDING[gk] = claimed
-                    break
+                    if kp_on and callable(fn) \
+                            and not isinstance(fn, KP.WatchedKernel):
+                        # cached before attribution was enabled:
+                        # upgrade the shared entry in place (warm —
+                        # its first dispatch is NOT a compile)
+                        fn = KP.watch(gk, fn, cold=False)
+                        _GLOBAL_KERNELS[gk] = fn
+                if fn is None:
+                    ev = _GLOBAL_KERNELS_BUILDING.get(gk)
+                    if ev is None:
+                        # claim the build; compile happens OUTSIDE the
+                        # lock
+                        claimed = threading.Event()
+                        _GLOBAL_KERNELS_BUILDING[gk] = claimed
+                        break
+            if fn is not None:
+                if kp_on and meta is not None:
+                    KP.annotate(fn, meta)
+                return fn
             # another thread is tracing/compiling this exact kernel:
             # wait for it instead of double-compiling, bounded by the
             # watchdog's compile deadline (and cancellable).  On wake,
@@ -229,7 +282,9 @@ class KernelCache:
                     gk[1])
                 break
         try:
-            fn = self._build_watched(key, builder)  # outside the lock
+            # builder runs OUTSIDE the lock
+            fn = self._build_watched(key, builder, KP.entry_for(gk)) \
+                if kp_on else self._build_watched(key, builder)
         except BaseException:
             if claimed is not None:
                 with _GLOBAL_KERNELS_LOCK:
@@ -237,6 +292,8 @@ class KernelCache:
                         _GLOBAL_KERNELS_BUILDING.pop(gk, None)
                 claimed.set()
             raise
+        if kp_on:
+            fn = KP.watch(gk, fn)
         max_entries = _kernel_cache_max_entries()
         with _GLOBAL_KERNELS_LOCK:
             _GLOBAL_KERNELS[gk] = fn
@@ -249,6 +306,8 @@ class KernelCache:
                 _GLOBAL_KERNELS_BUILDING.pop(gk, None)
         if claimed is not None:
             claimed.set()
+        if kp_on and meta is not None:
+            KP.annotate(fn, meta)
         return fn
 
     def __len__(self):
@@ -306,6 +365,17 @@ class TpuExec:
         over (bound expressions, modes, output schema).  None -> private
         cache (no cross-instance sharing)."""
         return None
+
+    def kp_meta(self, label: str, members=None) -> Optional[dict]:
+        """Dispatch-site metadata for the kernel catalog
+        (utils/kernelprof.py): pass as `get_or_build(..., meta=...)`.
+        Returns None — allocating nothing — when kernel attribution is
+        off, so the disabled hot path stays byte-identical."""
+        if not KP.enabled():
+            return None
+        return {"label": label, "owner_id": self.exec_id,
+                "owner": self.describe()[:120],
+                "members": list(members) if members else None}
 
     @property
     def children(self) -> list["TpuExec"]:

@@ -87,7 +87,8 @@ class ProjectExec(UnaryExecBase):
             kernel._ansi_labels = labels
             return kernel
 
-        return self.kernels.get_or_build(key, build)
+        return self.kernels.get_or_build(key, build,
+                                         meta=self.kp_meta("project"))
 
     def process_partition(self, batches) -> Iterator[ColumnarBatch]:
         for batch in batches:
@@ -155,7 +156,8 @@ class FilterExec(UnaryExecBase):
             kernel._ansi_labels = labels
             return kernel
 
-        return self.kernels.get_or_build(key, build)
+        return self.kernels.get_or_build(key, build,
+                                         meta=self.kp_meta("filter"))
 
     def process_partition(self, batches) -> Iterator[ColumnarBatch]:
         for batch in batches:

@@ -117,7 +117,8 @@ class ExpandExec(UnaryExecBase):
 
             return kernel
 
-        return self.kernels.get_or_build(key, build)
+        return self.kernels.get_or_build(
+            key, build, meta=self.kp_meta("expand"))
 
     def process_partition(self, batches) -> Iterator[ColumnarBatch]:
         nproj = len(self._bound)

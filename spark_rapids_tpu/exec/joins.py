@@ -223,7 +223,8 @@ class HashJoinExec(TpuExec):
 
             return kernel
 
-        return self._join_cache.get_or_build(key, build_fn)
+        return self._join_cache.get_or_build(
+            key, build_fn, meta=self.kp_meta("join-match"))
 
     # -- kernel B: pair expansion ----------------------------------------
     def _expand_kernel(self, build: ColumnarBatch, probe: ColumnarBatch,
@@ -266,7 +267,8 @@ class HashJoinExec(TpuExec):
 
             return kernel
 
-        return self._join_cache.get_or_build(key, build_fn)
+        return self._join_cache.get_or_build(
+            key, build_fn, meta=self.kp_meta("join-expand"))
 
     def _semi_kernel(self, probe: ColumnarBatch, anti: bool):
         key = ("join-semi", anti, batch_signature(probe))
@@ -286,7 +288,8 @@ class HashJoinExec(TpuExec):
 
             return kernel
 
-        return self._join_cache.get_or_build(key, build_fn)
+        return self._join_cache.get_or_build(
+            key, build_fn, meta=self.kp_meta("join-semi"))
 
     # -- dense direct-address fast path -----------------------------------
     # Reference capability parallel: the role cuDF's hash-join build
@@ -314,7 +317,8 @@ class HashJoinExec(TpuExec):
             return cached[0]
         probe = self._join_cache.get_or_build(
             ("dense-probe", batch_signature(build)),
-            lambda: jax.jit(self._build_dense_probe(build.capacity)))
+            lambda: jax.jit(self._build_dense_probe(build.capacity)),
+            meta=self.kp_meta("join-dense-probe"))
         kmin, kmax = probe(build.columns, build.num_rows_i32)
         kmin, kmax = int(kmin), int(kmax)
         span = kmax - kmin + 1 if kmax >= kmin else 0
@@ -324,7 +328,8 @@ class HashJoinExec(TpuExec):
             tab_kern = self._join_cache.get_or_build(
                 ("dense-table2", g, batch_signature(build)),
                 lambda: jax.jit(self._build_dense_table_kernel(
-                    build.capacity, g)))
+                    build.capacity, g)),
+                meta=self.kp_meta("join-dense-table"))
             bidx1_tab, vmask_tab, max_cnt = tab_kern(
                 build.columns, build.num_rows_i32, jnp.int64(kmin))
             if int(max_cnt) <= 1:  # unique build keys required
@@ -476,7 +481,8 @@ class HashJoinExec(TpuExec):
                 return bout, matched
             return kernel
 
-        return self._join_cache.get_or_build(key, build_fn)
+        return self._join_cache.get_or_build(
+            key, build_fn, meta=self.kp_meta("join-dense"))
 
     def _execute_dense(self, build, tab) -> Iterator[ColumnarBatch]:
         kmin, g, bidx1_tab, vmask_tab = tab
@@ -731,7 +737,8 @@ class NestedLoopJoinExec(TpuExec):
 
             return kernel
 
-        return self._cache.get_or_build(key, build_fn)
+        return self._cache.get_or_build(
+            key, build_fn, meta=self.kp_meta("join-nlj"))
 
     def execute_columnar(self):
         right_batches = [b.dense() for it in

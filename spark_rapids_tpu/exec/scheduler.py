@@ -437,6 +437,11 @@ class QueryScope:
         from spark_rapids_tpu.utils import telemetry as T
         T.maybe_start(conf)
         T.note_query_begin()
+        # kernel attribution (utils/kernelprof.py): same lazy-start
+        # discipline — sticky process-wide enable on the first query
+        # whose conf asks for it, one global read + one lookup when off
+        from spark_rapids_tpu.utils import kernelprof as KP
+        KP.maybe_enable(conf)
         try:
             self.prof_owner = P.begin_query(conf)
             QueryScheduler.get().admit(self.qc, conf)

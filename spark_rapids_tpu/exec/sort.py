@@ -108,7 +108,10 @@ class SortExec(UnaryExecBase):
 
             return kernel
 
-        return self.kernels.get_or_build(key, build)
+        return self.kernels.get_or_build(
+            key, build,
+            meta=self.kp_meta("sort" if head is None
+                              else f"sort-head{head}"))
 
     def output_partition_count(self) -> int:
         if not self.global_sort:
@@ -233,7 +236,8 @@ class SortedTopNExec(UnaryExecBase):
             return self._sort_one(batch).take_head(self.n)
         kern = self.kernels.get_or_build(
             ("topn-k", self.n, batch_signature(batch)),
-            lambda: jax.jit(self._build_topk(batch.capacity)))
+            lambda: jax.jit(self._build_topk(batch.capacity)),
+            meta=self.kp_meta("topn-k"))
         if batch.sparse is not None:
             cols, count = kern(batch.columns, batch.num_rows_i32,
                                batch.sparse)

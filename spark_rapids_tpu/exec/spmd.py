@@ -301,7 +301,14 @@ def _gang_kernel(exec_, mesh, axis: str, cap: int, n_slots: int,
         kernel._ansi_labels = labels
         return kernel
 
-    return exec_.kernels.get_or_build(key, build), data_shard
+    # gang kernels carry member attribution like the per-partition
+    # fused lane: one catalog entry per (mesh, stage, layout) whose
+    # members name the operators the sharded program evaluates
+    return exec_.kernels.get_or_build(
+        key, build,
+        meta=exec_.kp_meta("spmd-gang",
+                           members=exec_.stage.member_names())), \
+        data_shard
 
 
 def _run_gang(exec_, mesh, axis: str, batches: list) -> list:

@@ -312,7 +312,8 @@ class WindowExec(UnaryExecBase):
 
             return kernel
 
-        return self.kernels.get_or_build(key, build)
+        return self.kernels.get_or_build(
+            key, build, meta=self.kp_meta("window"))
 
     def _eval_fn(self, fn, sv, pos, seg, seg_start, seg_end, obounds,
                  sorted_mask, cap, lo, hi) -> ColumnVector:

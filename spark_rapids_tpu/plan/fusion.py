@@ -289,7 +289,13 @@ class FusedStageExec(UnaryExecBase):
             kernel._has_filter = has_filter
             return kernel
 
-        return self.kernels.get_or_build(key, build)
+        # fused kernels carry member attribution: the catalog entry
+        # names the member operators this one program evaluates, so
+        # the kernel table points back at the fused plan nodes
+        return self.kernels.get_or_build(
+            key, build,
+            meta=self.kp_meta("fused-stage",
+                              members=self.stage.member_names()))
 
     def _run_one(self, batch: ColumnarBatch) -> ColumnarBatch:
         from spark_rapids_tpu.utils import profile as P

@@ -66,18 +66,16 @@ EDGES = (EDGE_UPLOAD, EDGE_READBACK, EDGE_SPILL, EDGE_WIRE,
          EDGE_COLLECTIVE)
 
 #: per-edge nominal bandwidth ceilings (GB/s) used when
-#: spark.rapids.sql.profile.movement.rooflineGBps is 0.  The host-link
-#: edges share one ceiling (PCIe-gen4-x16-class / tunnel attachment);
-#: the wire edge assumes a 100 Gb/s DCN NIC; the collective edge the
-#: v5e per-chip ICI nominal.  bench.py reports utilization against the
-#: PROBED HBM ceiling as well (probe_hbm_bandwidth / V5E_HBM_GBPS).
-NOMINAL_GBPS = {
-    EDGE_UPLOAD: 32.0,
-    EDGE_READBACK: 32.0,
-    EDGE_SPILL: 32.0,
-    EDGE_WIRE: 12.5,
-    EDGE_COLLECTIVE: 400.0,
-}
+#: spark.rapids.sql.profile.movement.rooflineGBps is 0.  This is a
+#: VIEW of the shared roofline table (utils/roofline.py registry
+#: defaults): every ceiling is conf-overridable under
+#: spark.rapids.sql.profile.roofline.* and the SAME source feeds the
+#: per-kernel roofline join (utils/kernelprof.py) — two diverging
+#: nominal tables was the bug class the shared module replaces.
+#: bench.py reports utilization against the PROBED HBM ceiling as well
+#: (probe_hbm_bandwidth / V5E_HBM_GBPS).
+from spark_rapids_tpu.utils.roofline import \
+    DEFAULT_EDGE_GBPS as NOMINAL_GBPS
 
 #: bound on the Chrome-trace counter sample stream — enough resolution
 #: for a long query's counter tracks, bounded against runaway loops
@@ -241,12 +239,19 @@ class DataMovementLedger:
 
     # -- report --------------------------------------------------------------
     def report(self, wall_s: float,
-               roofline_gbps: float = 0.0) -> dict:
+               roofline_gbps: float = 0.0, conf=None) -> dict:
         """The movement report QueryProfile embeds: per-edge totals,
         effective GB/s (bytes / query wall clock — the achieved average
         rate), busy GB/s (bytes / measured transfer time, for edges
         whose records carry durations), utilization vs the roofline,
-        and the per-site breakdown."""
+        and the per-site breakdown.  Ceilings resolve through the
+        shared conf-overridable roofline table (utils/roofline.py):
+        `roofline_gbps` (the legacy all-edges override) wins when
+        non-zero, then the per-edge spark.rapids.sql.profile.roofline.*
+        entries of `conf` (registry defaults when None)."""
+        from spark_rapids_tpu.utils import roofline as RL
+        edge_roof = (dict(NOMINAL_GBPS) if conf is None
+                     else RL.edge_table(conf))
         snap = self.snapshot()
         edges: dict = {}
         for edge in EDGES:
@@ -257,7 +262,7 @@ class DataMovementLedger:
             raw = sum(v["raw_bytes"] for v in counted.values())
             cnt = sum(v["count"] for v in counted.values())
             dur = sum(v["dur_ns"] for v in counted.values())
-            roof = roofline_gbps or NOMINAL_GBPS[edge]
+            roof = roofline_gbps or edge_roof[edge]
             avg = b / wall_s / 1e9 if wall_s > 0 else 0.0
             busy = b / (dur / 1e9) / 1e9 if dur > 0 else 0.0
             edges[edge] = {

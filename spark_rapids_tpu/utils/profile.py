@@ -209,6 +209,16 @@ class QueryTracer:
                 self.query_id, self.t_origin,
                 min_event_bytes=int(conf[C.MOVEMENT_MIN_EVENT_BYTES]))
             self.ledger.tracer = self
+        #: per-query kernel attribution (utils/kernelprof.py): which
+        #: compiled kernels this query dispatched and the device time
+        #: its sampled dispatches measured — the '-- kernels --'
+        #: section's source, isolated per query like the ledger
+        self.kernels = None
+        if conf[C.KERNELPROF_ENABLED]:
+            from spark_rapids_tpu.utils import kernelprof as KP
+            KP.maybe_enable(conf)  # bare paths without a QueryScope
+            self.kernels = KP.QueryKernelLedger(self.query_id,
+                                                self.t_origin)
 
     # -- spans ---------------------------------------------------------------
     def open_span(self, name: str, cat: str,
@@ -530,16 +540,35 @@ def clear_history() -> None:
 
 
 # ---------------------------------------------------------------------------
-def explain_with_metrics(plan, indent: int = 0) -> str:
+def explain_with_metrics(plan, indent: int = 0,
+                         kernel_index: Optional[dict] = None) -> str:
     """The plan `tree_string` with every node annotated by its resolved
     MetricSet values — the Spark UI plan-graph analog.  Resolving reads
-    back lazy device counters; acceptable, profiling is on."""
+    back lazy device counters; acceptable, profiling is on.
+
+    `kernel_index` ({exec_id: [kernelprof report rows]}, built from the
+    query's QueryKernelLedger) additionally annotates owning nodes —
+    and every fused `* member` line — with their hottest kernel's
+    device time and roofline %, so EXPLAIN alone points at the slow
+    kernel without opening a trace."""
     lines: list[str] = []
-    _explain_node(plan, indent, lines)
+    _explain_node(plan, indent, lines, kernel_index)
     return "\n".join(lines)
 
 
-def _explain_node(node, indent: int, lines: list[str]) -> None:
+def _fmt_kernel_annot(rows: list) -> str:
+    """Bracketed per-node kernel summary (the whole annotation stays
+    inside one [..] so every report line still ends with a bracket)."""
+    top = rows[0]
+    roof = (f" {top['roofline_pct']}%-roofline {top['bound']}-bound"
+            if "roofline_pct" in top else "")
+    more = f" +{len(rows) - 1} more" if len(rows) > 1 else ""
+    return (f"  [kernel {top['fingerprint']} {top['device_ms']}ms "
+            f"x{top['dispatches']}{roof}{more}]")
+
+
+def _explain_node(node, indent: int, lines: list[str],
+                  kernel_index: Optional[dict] = None) -> None:
     desc = node.describe() if hasattr(node, "describe") else \
         type(node).__name__
     ms = {}
@@ -551,12 +580,16 @@ def _explain_node(node, indent: int, lines: list[str]) -> None:
         except Exception:  # noqa: BLE001 — a broken metric must not
             ms = {"<metrics unavailable>": 1}  # hide the plan report
     annot = ", ".join(_fmt_metric(k, v) for k, v in ms.items())
+    krows = (kernel_index or {}).get(getattr(node, "exec_id", None))
+    kannot = _fmt_kernel_annot(krows) if krows else ""
     lines.append("  " * indent + desc
-                 + (f"  [{annot}]" if annot else "  [no metrics]"))
+                 + (f"  [{annot}]" if annot else "  [no metrics]")
+                 + kannot)
     # whole-stage fusion groups (plan/fusion.py): render each fused
     # member operator with ITS metric breakdown under the fused node —
     # per-node metrics still resolve even though the operators share
-    # one compiled kernel
+    # one compiled kernel, whose roofline annotation rides each member
+    # line (the members ARE that kernel)
     for mdesc, mmetrics in getattr(node, "fused_members", []) or []:
         try:
             mms = {k: v for k, v in sorted(mmetrics.as_dict().items())
@@ -565,15 +598,16 @@ def _explain_node(node, indent: int, lines: list[str]) -> None:
             mms = {"<metrics unavailable>": 1}
         mannot = ", ".join(_fmt_metric(k, v) for k, v in mms.items())
         lines.append("  " * (indent + 1) + "* " + mdesc
-                     + (f"  [{mannot}]" if mannot else "  [no metrics]"))
+                     + (f"  [{mannot}]" if mannot else "  [no metrics]")
+                     + kannot)
     for c in getattr(node, "children", []) or []:
-        _explain_node(c, indent + 1, lines)
+        _explain_node(c, indent + 1, lines, kernel_index)
     # AQE wrappers hold their plan below non-children attributes
     for attr in ("exchange", "stage"):
         inner = getattr(node, attr, None)
         if inner is not None and inner not in (
                 getattr(node, "children", []) or []):
-            _explain_node(inner, indent + 1, lines)
+            _explain_node(inner, indent + 1, lines, kernel_index)
 
 
 #: metric names holding nanosecond durations (MetricSet.timed and the
@@ -599,7 +633,10 @@ class QueryProfile:
                  spans: list[Span], events: list[dict],
                  plan_report: str, breakdown: dict,
                  dropped_spans: int = 0, movement: Optional[dict] = None,
-                 movement_samples: Optional[list] = None):
+                 movement_samples: Optional[list] = None,
+                 kernels: Optional[list] = None,
+                 kernel_samples: Optional[list] = None,
+                 kernel_top_n: int = 12):
         self.query_id = query_id
         self.wall_start = wall_start
         self.wall_s = wall_s
@@ -615,15 +652,39 @@ class QueryProfile:
         #: (ts_ns, edge, cumulative_bytes) samples backing the Chrome
         #: counter tracks
         self.movement_samples = movement_samples or []
+        #: per-kernel attribution rows (utils/kernelprof.py
+        #: QueryKernelLedger.report — device time, roofline %, compile
+        #: ms per kernel this query dispatched); None when kernel
+        #: attribution was off for this query
+        self.kernels = kernels
+        #: (t0_ns, dur_ns, fingerprint, label, tid) sampled-dispatch
+        #: records backing the Perfetto kernel tracks
+        self.kernel_samples = kernel_samples or []
+        self.kernel_top_n = kernel_top_n
 
     # -- construction --------------------------------------------------------
     @classmethod
     def build(cls, tr: QueryTracer, plan) -> "QueryProfile":
         spans = tr.spans()
+        kernels = None
+        kernel_samples = None
+        kernel_index: Optional[dict] = None
+        if tr.kernels is not None:
+            try:
+                kernels = tr.kernels.report(tr.conf)
+                kernel_samples = tr.kernels.samples()
+                kernel_index = {}
+                for row in kernels:
+                    oid = row.get("owner_id")
+                    if oid is not None:
+                        kernel_index.setdefault(oid, []).append(row)
+            except Exception:  # noqa: BLE001 — assembly must not fail
+                kernels = None
         report = ""
         if plan is not None:
             try:
-                report = explain_with_metrics(plan)
+                report = explain_with_metrics(
+                    plan, kernel_index=kernel_index)
             except Exception as e:  # noqa: BLE001 — profile assembly
                 report = f"<plan report failed: {e}>"  # must never fail
         wall_s = (tr.root.dur_ns if tr.root is not None else 0) / 1e9
@@ -632,7 +693,8 @@ class QueryProfile:
         if tr.ledger is not None:
             try:
                 movement = tr.ledger.report(
-                    wall_s, float(tr.conf[C.MOVEMENT_ROOFLINE_GBPS]))
+                    wall_s, float(tr.conf[C.MOVEMENT_ROOFLINE_GBPS]),
+                    conf=tr.conf)
                 samples = tr.ledger.samples()
             except Exception:  # noqa: BLE001 — same guard as the plan
                 movement = None  # report: assembly must never fail
@@ -640,7 +702,9 @@ class QueryProfile:
                    spans, tr.events(), report,
                    cls._breakdown(spans, tr.root),
                    dropped_spans=tr.dropped_spans,
-                   movement=movement, movement_samples=samples)
+                   movement=movement, movement_samples=samples,
+                   kernels=kernels, kernel_samples=kernel_samples,
+                   kernel_top_n=max(1, int(tr.conf[C.KERNELPROF_TOP_N])))
 
     @staticmethod
     def _breakdown(spans: list[Span], root: Optional[Span]) -> dict:
@@ -722,6 +786,15 @@ class QueryProfile:
             events.append({"name": f"movement:{edge}", "ph": "C",
                            "ts": ts / 1e3, "pid": 0,
                            "args": {"bytes": cum}})
+        # sampled kernel dispatches: complete events on the dispatching
+        # thread's lane, so per-kernel device time lines up with the
+        # operator spans in Perfetto
+        for t0, dur, fp, label, tid in self.kernel_samples:
+            events.append({"name": f"kernel:{label}", "cat": "kernel",
+                           "ph": "X", "ts": t0 / 1e3, "dur": dur / 1e3,
+                           "pid": 0, "tid": tid,
+                           "args": {"fingerprint": fp,
+                                    "query_id": self.query_id}})
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": {"query_id": self.query_id,
                               "wall_s": self.wall_s,
@@ -743,6 +816,11 @@ class QueryProfile:
         for s in self.top_spans():
             lines.append(f"  {s.dur_ns / 1e6:10.1f} ms  [{s.cat}] "
                          f"{s.name}  ({s.thread_name})")
+        if self.kernels is not None:
+            from spark_rapids_tpu.utils import kernelprof as KP
+            lines.append("-- kernels --")
+            lines.append(KP.format_report(self.kernels,
+                                          top_n=self.kernel_top_n))
         if self.movement is not None:
             from spark_rapids_tpu.utils import movement as MV
             lines.append("-- data movement --")

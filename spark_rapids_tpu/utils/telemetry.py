@@ -44,6 +44,7 @@ import collections
 import hashlib
 import json
 import logging
+import re
 import threading
 import time
 from typing import Callable, Optional
@@ -312,6 +313,7 @@ class Telemetry:
         self._wall_hist: Optional[Histogram] = None
         self._completed: Optional[Counter] = None
         self._util_counter: Optional[Counter] = None
+        self._kernel_counter: Optional[Counter] = None
         self._snap_period = float(conf[C.TELEMETRY_SNAPSHOT_PERIOD_S])
         self._next_snap = time.monotonic() + self._snap_period
 
@@ -481,6 +483,20 @@ class Telemetry:
                 out[c] = round(100.0 * n / total, 1)
         return out
 
+    # -- kernel attribution (utils/kernelprof.py) -----------------------------
+    def note_kernel_sample(self, family: str, seconds: float) -> None:
+        """One sampled kernel dispatch: bump the per-family device-time
+        counter and the family's bounded duration histogram (created
+        lazily on the first sample of each family)."""
+        if self._kernel_counter is not None:
+            self._kernel_counter.inc(seconds, family)
+        from spark_rapids_tpu.utils.kernelprof import TIME_BUCKETS
+        name = (PREFIX + "kernel_time_seconds_"
+                + _sanitize_metric(family))
+        self.registry.histogram(
+            name, f"Sampled device-time distribution of the "
+            f"'{family}' kernel family.", TIME_BUCKETS).observe(seconds)
+
     # -- slow-query log -------------------------------------------------------
     def note_profile(self, profile, plan) -> None:
         """Aggregate one completed QueryProfile into the slow-query log
@@ -500,6 +516,7 @@ class Telemetry:
                     "walls": collections.deque(maxlen=_SLOW_LOG_WALLS),
                     "idle_s": {},
                     "wall_sum_s": 0.0,
+                    "kernel_s": {},
                 }
             entry["count"] += 1
             entry["walls"].append(profile.wall_s)
@@ -508,6 +525,15 @@ class Telemetry:
                 if k in ("wall_s", "compute_s") or not v:
                     continue
                 entry["idle_s"][k] = entry["idle_s"].get(k, 0.0) + v
+            # per-kernel attribution: accumulate each kernel's device
+            # seconds so repeat offenders name their hot kernel next
+            # to their top idle cause
+            for row in getattr(profile, "kernels", None) or []:
+                if not row.get("device_ms"):
+                    continue
+                key = (row["fingerprint"], row["label"])
+                ks = entry["kernel_s"]
+                ks[key] = ks.get(key, 0.0) + row["device_ms"] / 1e3
             self._slow.move_to_end(fp)
             while len(self._slow) > self._slow_bound:
                 self._slow.popitem(last=False)
@@ -515,7 +541,9 @@ class Telemetry:
     def slow_query_log(self) -> list[dict]:
         """Aggregated per-fingerprint entries, slowest (p95) first."""
         with self._slow_lock:
-            items = [(fp, dict(e), list(e["walls"]))
+            items = [(fp,
+                      {**e, "kernel_s": dict(e.get("kernel_s") or {})},
+                      list(e["walls"]))
                      for fp, e in self._slow.items()]
         out = []
         for fp, e, walls in items:
@@ -524,7 +552,7 @@ class Telemetry:
             top = max(idle.items(), key=lambda kv: kv[1]) \
                 if idle else ("compute_s", 0.0)
             wall_sum = e["wall_sum_s"]
-            out.append({
+            rec = {
                 "fingerprint": fp,
                 "plan": e["plan"],
                 "count": e["count"],
@@ -534,7 +562,22 @@ class Telemetry:
                 "top_idle_cause": top[0],
                 "top_idle_pct": round(100.0 * top[1] / wall_sum, 1)
                 if wall_sum > 0 else 0.0,
-            })
+            }
+            # hottest kernel of this plan shape (kernelprof rows ride
+            # the aggregated profiles): fingerprint + its share of the
+            # shape's total attributed device time
+            kernel_s = e.get("kernel_s") or {}
+            if kernel_s:
+                (kfp, klabel), ksec = max(kernel_s.items(),
+                                          key=lambda kv: kv[1])
+                ktotal = sum(kernel_s.values())
+                rec["top_kernel"] = {
+                    "fingerprint": kfp,
+                    "label": klabel,
+                    "device_share_pct": round(100.0 * ksec / ktotal, 1)
+                    if ktotal > 0 else 0.0,
+                }
+            out.append(rec)
         out.sort(key=lambda e: e["p95_ms"], reverse=True)
         return out
 
@@ -683,6 +726,20 @@ class Telemetry:
         r.gauge(PREFIX + "watchdog_cancels_total",
                 "CancelTokens fired by the watchdog.",
                 fn=_watchdog_stat("cancels"))
+        # kernel attribution (utils/kernelprof.py)
+        r.gauge(PREFIX + "kernel_catalog_entries",
+                "Kernels in the process-wide attribution catalog.",
+                fn=_kernelprof_catalog_size)
+        r.gauge(PREFIX + "kernel_family_device_seconds",
+                "Cumulative SAMPLED device seconds per kernel family "
+                "(pull-side mirror of kernel_device_seconds_total).",
+                fn=_kernelprof_family_seconds, label="family")
+        self._kernel_counter = r.counter(
+            PREFIX + "kernel_device_seconds_total",
+            "Device seconds measured by sampled kernel dispatches, "
+            "per kernel family (requires "
+            "spark.rapids.sql.profile.kernels.enabled).",
+            label="family")
         # host syncs + movement
         r.gauge(PREFIX + "host_syncs_total",
                 "Blocking device->host readbacks observed.",
@@ -832,6 +889,20 @@ def _movement_totals():
     return process_edge_totals()
 
 
+def _kernelprof_catalog_size():
+    from spark_rapids_tpu.utils.kernelprof import catalog_size
+    return catalog_size()
+
+
+def _kernelprof_family_seconds():
+    from spark_rapids_tpu.utils.kernelprof import family_device_seconds
+    return family_device_seconds()
+
+
+def _sanitize_metric(s: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_]", "_", s).strip("_").lower()
+
+
 def _result_cache_stat(key: str):
     def fn():
         from spark_rapids_tpu.exec.scheduler import result_cache
@@ -926,6 +997,18 @@ def maybe_start(conf: C.RapidsConf) -> Optional[Telemetry]:
     if not conf[C.TELEMETRY_ENABLED]:
         return None
     return start(conf)
+
+
+def note_kernel_sample(family: str, seconds: float) -> None:
+    """Hook for kernelprof's sampled timing lane (no-op when telemetry
+    is off — one module-global read)."""
+    t = _LIVE
+    if t is None:
+        return
+    try:
+        t.note_kernel_sample(family, seconds)
+    except Exception:  # noqa: BLE001 — telemetry must never fail a query
+        log.warning("kernel-sample aggregation failed", exc_info=True)
 
 
 def note_query_profile(profile, plan) -> None:
