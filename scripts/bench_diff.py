@@ -40,7 +40,7 @@ DEFAULT_THRESHOLD = 10.0
 
 #: name fragments marking a metric where LOWER is better
 _LOWER_BETTER = ("_ms", "wall", "overhead", "latency", "host_syncs",
-                 "p95", "p50")
+                 "p95", "p50", "hbm_high_water", "leaks")
 
 
 def lower_is_better(name: str) -> bool:
@@ -156,6 +156,23 @@ def _edge_shift(a: dict, b: dict) -> list:
     return notes
 
 
+def _residency_shift(a: dict, b: dict) -> list:
+    """Per-lane observed HBM high-water deltas (`hbm_high_water`
+    bytes, from the residency ledger).  Direction-aware: residency
+    going DOWN is an improvement — a lane that got faster by holding
+    more HBM (or slower while ballooning) should say so."""
+    ha, hb = a.get("hbm_high_water"), b.get("hbm_high_water")
+    if ha is None or hb is None:
+        return []
+    ha, hb = float(ha), float(hb)
+    if not (ha or hb):
+        return []
+    if abs(hb - ha) < max(float(1 << 20), 0.25 * max(ha, hb)):
+        return []
+    tag = "down=good, shrank" if hb < ha else "down=good, GREW"
+    return [f"hbm_high_water {ha / 1e6:.1f}->{hb / 1e6:.1f}MB ({tag})"]
+
+
 def _summary_shift(a: dict, b: dict) -> list:
     notes = []
     for k in ("kernel_cache_size", "kernel_cache_evictions",
@@ -204,7 +221,7 @@ def compare_rounds(a: dict, b: dict,
         if magnitude >= threshold:
             status = "regressed" if worse else "improved"
         notes = (_util_shift(la, lb) + _kernel_shift(la, lb)
-                 + _edge_shift(la, lb))
+                 + _edge_shift(la, lb) + _residency_shift(la, lb))
         lane = {"metric": name, "status": status,
                 "a": va, "b": vb,
                 "delta_pct": round(delta_pct, 2),
@@ -219,7 +236,8 @@ def compare_rounds(a: dict, b: dict,
     if a.get("summary") and b.get("summary"):
         summary_notes = (_summary_shift(a["summary"], b["summary"])
                          + _util_shift(a["summary"], b["summary"])
-                         + _edge_shift(a["summary"], b["summary"]))
+                         + _edge_shift(a["summary"], b["summary"])
+                         + _residency_shift(a["summary"], b["summary"]))
     return {"threshold_pct": threshold,
             "lanes": lanes,
             "regressions": [l["metric"] for l in regressions],
@@ -270,7 +288,7 @@ def _selftest() -> int:
     passes, missing phase tolerated, attribution surfaces."""
     base = "\n".join(json.dumps(r) for r in [
         {"metric": "tpch_q1_rows_per_sec", "value": 100.0,
-         "vs_baseline": 2.0,
+         "vs_baseline": 2.0, "hbm_high_water": 100e6,
          "util": {"samples": 100, "busy": 60.0, "idle": 40.0}},
         {"metric": "groupby_sf1_wall_ms", "value": 50.0,
          "vs_baseline": 1.0},
@@ -288,7 +306,7 @@ def _selftest() -> int:
     #    with a utilization attribution note
     reg = parse_round("\n".join(json.dumps(r) for r in [
         {"metric": "tpch_q1_rows_per_sec", "value": 70.0,
-         "vs_baseline": 1.4,
+         "vs_baseline": 1.4, "hbm_high_water": 300e6,
          "util": {"samples": 100, "busy": 30.0, "idle": 70.0},
          "kernels": [{"label": "agg-update", "device_ms": 900.0}]},
         {"metric": "groupby_sf1_wall_ms", "value": 50.0},
@@ -300,6 +318,21 @@ def _selftest() -> int:
                 if l["metric"] == "tpch_q1_rows_per_sec")
     assert any("util." in n for n in lane["attribution"]), lane
     assert any("kernel[" in n for n in lane["attribution"]), lane
+    # residency attribution: the slowdown also names the HBM
+    # high-water balloon (direction-aware: GREW)
+    assert any("hbm_high_water" in n and "GREW" in n
+               for n in lane["attribution"]), lane
+
+    # 1b) a lane whose METRIC is an hbm_high_water reading gates
+    #     lower-better: residency growing past the threshold regresses
+    res_a = parse_round(json.dumps(
+        {"metric": "q5_hbm_high_water_bytes", "value": 100e6}))
+    res_b = parse_round(json.dumps(
+        {"metric": "q5_hbm_high_water_bytes", "value": 150e6}))
+    rep = compare_rounds(res_a, res_b, threshold=10.0)
+    assert rep["regressions"] == ["q5_hbm_high_water_bytes"], rep
+    rep = compare_rounds(res_b, res_a, threshold=10.0)  # shrank: good
+    assert rep["regressions"] == [], rep
 
     # 2) improvement (and a lower-is-better improvement) passes
     imp = parse_round("\n".join(json.dumps(r) for r in [

@@ -83,6 +83,11 @@ def main():
                     "(sampleRate=1, pipelining off so the kernel sum "
                     "is comparable to the compute bucket) and print "
                     "the '-- kernels --' roofline table")
+    ap.add_argument("--memory", action="store_true",
+                    help="HBM residency: print the residency report "
+                    "(high-water mark, peak-instant composition by "
+                    "provenance site, leak verdict) plus the "
+                    "DeviceManager accounting snapshot")
     args = ap.parse_args()
 
     from spark_rapids_tpu import config as C
@@ -98,6 +103,8 @@ def main():
             "spark.rapids.sql.profile.kernels.sampleRate": 1,
             "spark.rapids.sql.pipeline.enabled": False,
         })
+    if args.memory:
+        kv["spark.rapids.sql.profile.residency.enabled"] = True
     conf = C.RapidsConf(kv)
     if args.suite == "tpch":
         _run_tpch(int(args.query), args.scale or 100_000, conf,
@@ -112,6 +119,22 @@ def main():
                          "spark.rapids.sql.profile.enabled on?")
     print()
     print(prof.explain())
+    if args.memory:
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
+        from spark_rapids_tpu.utils import residency as RS
+        print("\n== HBM residency ==")
+        print(RS.format_report(prof.residency))
+        dm = DeviceManager.peek()
+        if dm is not None:
+            snap = dm.snapshot()
+            print(f"accounting: store={snap['store_bytes']} "
+                  f"reserved={snap['reserved_bytes']} "
+                  f"in_use={snap['in_use_bytes']} "
+                  f"budget={snap['budget']} "
+                  f"headroom={snap['admission_headroom_bytes']} "
+                  f"underflows={snap['store_bytes_underflow']}")
+        if RS.enabled():
+            print(f"live tracked now: {RS.by_tier() or '(none)'}")
     print(f"\nspan depth: {prof.span_depth()}  spans: "
           f"{len(prof.spans)}  events: {len(prof.events)}  threads: "
           f"{len({s.thread_id for s in prof.spans})}")

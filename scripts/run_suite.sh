@@ -57,6 +57,49 @@ run_fast() {
   run_speculation
   run_telemetry
   run_kernelprof
+  run_residency
+}
+
+run_residency() {
+  # HBM residency lane: the ledger suite (provenance registration,
+  # high-water reconciliation, leak detection, underflow guard, storm
+  # isolation), then one profiled manager-lane q5 whose residency
+  # report must show a NONZERO high-water mark with a peak composition
+  # that sums to it and a clean leak verdict — the summary line
+  # carries peak bytes, top site, and the verdict.
+  echo "== residency lane (HBM provenance ledger, high-water marks, leak check) =="
+  "${PYTEST[@]}" tests/test_residency.py
+  python - <<'PYEOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+from spark_rapids_tpu.utils import profile as P
+from spark_rapids_tpu.utils import residency as RS
+
+tables = gen_tables(np.random.default_rng(11), 1000)
+run_query(5, tables, engine="tpu", conf=C.RapidsConf({
+    **BENCH_CONF,
+    "spark.rapids.sql.profile.enabled": True,
+    "spark.rapids.shuffle.enabled": True,
+    "spark.rapids.shuffle.localExecutors": 2}))
+prof = P.last_profile()
+res = prof.residency
+assert res is not None and res["hbm_high_water"] > 0, res
+comp = res["peak_composition"]
+assert sum(comp.values()) == res["hbm_high_water"], comp
+assert res["leaks"] == 0, res["leaked"]
+assert res["live_end_bytes"] == 0, res
+assert "-- residency --" in prof.explain()
+assert RS.live_records_for_query(prof.query_id) == []
+top = max(comp.items(), key=lambda kv: kv[1])
+print("residency summary: q5 peak_mb=%.2f top_site=%s sites=%d "
+      "allocs=%d leaks=%d verdict=clean" % (
+          res["hbm_high_water"] / 1e6, top[0], len(comp),
+          res["allocs"], res["leaks"]))
+PYEOF
 }
 
 run_kernelprof() {
@@ -606,7 +649,8 @@ case "$TIER" in
   speculation) run_speculation ;;
   telemetry) run_telemetry ;;
   kernelprof) run_kernelprof ;;
+  residency) run_residency ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [lint|gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|spmd|speculation|telemetry|kernelprof|all]" >&2
+  *) echo "usage: $0 [lint|gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|spmd|speculation|telemetry|kernelprof|residency|all]" >&2
      exit 2 ;;
 esac

@@ -301,6 +301,35 @@ MOVEMENT_MIN_EVENT_BYTES = conf(
     "retries, fetch failures, and watchdog dumps by query id); "
     "smaller records are aggregated into the ledger only, keeping the "
     "event ring for interesting transfers.  0 logs every record.")
+RESIDENCY_ENABLED = conf(
+    "spark.rapids.sql.profile.residency.enabled", True,
+    "When profiling is on, additionally run the HBM residency ledger "
+    "(utils/residency.py): every tracked device-resident allocation — "
+    "tiered-store buffers (including shuffle catalog buffers), OOM-"
+    "harness reservations, pinned SPMD gang inputs — registers "
+    "per-buffer provenance (query id, operator site, size, tier) on "
+    "creation and retires it on free/spill.  Profiled queries get a "
+    "'-- residency --' section (HBM high-water mark, peak-instant "
+    "composition by site/tier, leak verdict), Perfetto "
+    "residency:<site> counter tracks, and an end-of-query leak check "
+    "that dumps still-resident buffers with provenance; the "
+    "slow-query log aggregates observed high-water marks per plan "
+    "fingerprint (the feed learned admission budgets consume) and "
+    "telemetry exports hbm_resident_bytes{tier} plus per-site "
+    "gauges.  Tracking is process-sticky once the first residency-"
+    "enabled query runs; off (default until then) every hook is one "
+    "global read and allocates nothing.")
+RESIDENCY_TIMELINE_SIZE = conf(
+    "spark.rapids.sql.profile.residency.timelineSize", 4096,
+    "Bound on per-query residency timeline samples (one per tracked "
+    "alloc/free) backing the Perfetto residency:<site> counter "
+    "tracks; oldest samples are dropped first.  The high-water mark "
+    "and peak composition are exact regardless of this bound.")
+RESIDENCY_LEAK_DUMP = conf(
+    "spark.rapids.sql.profile.residency.leakDump", 8,
+    "How many leaked buffers (still resident at query end) the "
+    "residency report and event log render with full provenance "
+    "(site, tier, kind, size, age); the leak COUNT is always exact.")
 KERNELPROF_ENABLED = conf(
     "spark.rapids.sql.profile.kernels.enabled", False,
     "Per-kernel performance attribution (utils/kernelprof.py): every "

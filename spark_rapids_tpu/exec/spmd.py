@@ -369,8 +369,14 @@ def _run_gang(exec_, mesh, axis: str, batches: list) -> list:
     # the gate covers every whole-mesh enqueue (input scatter, gang
     # program, output gather): concurrent whole-mesh enqueues from two
     # threads can invert per-device queue order and deadlock the
-    # collective rendezvous (exec/scheduler.py)
-    with S.whole_mesh_dispatch(label=stage.describe_ops()):
+    # collective rendezvous (exec/scheduler.py).  The stacked gang
+    # inputs are device-pinned for the dispatch — the residency ledger
+    # carries them so a gang's footprint shows in the owning query's
+    # high-water composition
+    from spark_rapids_tpu.utils import residency as RES
+    with RES.tracked(est_bytes, site="spmd-gang",
+                     kind=RES.KIND_GANG), \
+            S.whole_mesh_dispatch(label=stage.describe_ops()):
         inputs = jax.device_put((cols, num_rows, masks), data_shard)
         t_disp = time.perf_counter_ns()
         out_cols, keep, counts, pend, total = R.with_retry(

@@ -15,10 +15,13 @@ available; a conservative default otherwise.
 """
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Optional
 
 from spark_rapids_tpu import config as C
+
+log = logging.getLogger("spark_rapids_tpu.device_manager")
 
 _DEFAULT_HBM = 16 * 1024**3  # v5p chip-class default when PJRT has no stats
 
@@ -99,6 +102,10 @@ class DeviceManager:
         #: doing the fine-grained real-time accounting within them.
         self._admitted: dict[str, int] = {}
         self._acct = threading.Lock()
+        #: store-byte accounting clamped at zero (double-free
+        #: indicator): count + the sites already logged once
+        self._underflows = 0
+        self._underflow_sites: set[str] = set()
         self.spill_callback: Optional[SpillCallback] = None
 
     # -- singleton lifecycle -------------------------------------------------
@@ -155,9 +162,32 @@ class DeviceManager:
             return self._store_bytes + self._reserved
 
     # -- accounting ------------------------------------------------------------
-    def track_store_bytes(self, delta: int) -> None:
+    def track_store_bytes(self, delta: int, site: str = "?") -> None:
+        """Adjust accounted store-resident bytes.  Negative drift —
+        the total going below zero, i.e. more bytes removed than were
+        ever added, a double-free — is clamped at zero and counted
+        (`store_bytes_underflow` gauge) instead of silently corrupting
+        the admission ledger's headroom math; the offending site is
+        logged once."""
+        log_site = None
         with self._acct:
-            self._store_bytes += delta
+            nxt = self._store_bytes + delta
+            if nxt < 0:
+                self._underflows += 1
+                if site not in self._underflow_sites:
+                    self._underflow_sites.add(site)
+                    log_site = site
+                nxt = 0
+            self._store_bytes = nxt
+        if log_site is not None:
+            log.warning(
+                "store-byte accounting underflow at site %r (delta %d "
+                "past zero): clamped — a double-free is corrupting the "
+                "device store's byte tracking", log_site, delta)
+
+    def store_bytes_underflows(self) -> int:
+        with self._acct:
+            return self._underflows
 
     @property
     def store_bytes(self) -> int:
@@ -239,14 +269,34 @@ class DeviceManager:
 
     def telemetry_gauges(self) -> dict:
         """One consistent HBM accounting snapshot for the telemetry
-        registry: capacity, budget, store-resident vs reserved bytes,
-        and the admission ledger (utils/telemetry.py)."""
+        registry: capacity, budget, the store-resident vs reserved
+        split, the live total, the admission ledger, and — first-class
+        instead of operator-derived — the live admission headroom
+        (budget - store - reserved - sum of admitted budgets: what
+        `try_admit` actually has left to give, negative when the
+        running queries' real footprints outgrow their declarations)
+        plus the store-byte underflow counter (utils/telemetry.py)."""
         with self._acct:
+            admitted = sum(self._admitted.values())
             return {
                 "hbm_total": self.hbm_total,
                 "budget": self.budget,
                 "store_bytes": self._store_bytes,
                 "reserved_bytes": self._reserved,
-                "admitted_bytes": sum(self._admitted.values()),
+                "in_use_bytes": self._store_bytes + self._reserved,
+                "admitted_bytes": admitted,
                 "admitted_queries": len(self._admitted),
+                "admission_headroom_bytes": (
+                    self.budget - self._store_bytes - self._reserved
+                    - admitted),
+                "store_bytes_underflow": self._underflows,
             }
+
+    def snapshot(self) -> dict:
+        """The gauge set plus the per-query admission detail — the
+        one-call accounting view diagnostics (watchdog dumps, the
+        profile_query --memory report) print."""
+        gauges = self.telemetry_gauges()
+        with self._acct:
+            gauges["admissions"] = dict(self._admitted)
+        return gauges

@@ -38,6 +38,7 @@ from typing import Callable, Iterator, Optional
 from spark_rapids_tpu import config as C
 from spark_rapids_tpu.utils import metrics as M
 from spark_rapids_tpu.utils import profile as P
+from spark_rapids_tpu.utils import residency as RES
 
 log = logging.getLogger(__name__)
 
@@ -245,10 +246,19 @@ def _run_reserved(thunk: Callable[[], object], nbytes: int, metrics,
                     retries=retries + 1)
             retries += 1
             continue
+        # residency provenance for the held reservation: the exec's
+        # label names the site, so the ledger's peak composition says
+        # WHICH operator's working set drove the high-water mark
+        res_token = None
+        if RES.enabled():
+            res_token = RES.track(
+                nbytes, site=f"reserve:{label.split('[', 1)[0]}",
+                tier=RES.TIER_DEVICE, kind=RES.KIND_RESERVATION)
         try:
             return thunk()
         finally:
             dm.release_reservation(nbytes)
+            RES.retire(res_token)
 
 
 def _floor_fallback(thunk: Callable[[], object], metrics, label: str,

@@ -24,6 +24,7 @@ from spark_rapids_tpu.memory.buffer import (
 from spark_rapids_tpu.memory.native import (
     AddressSpaceAllocator, HashedPriorityQueue, HostArena,
     SpillCorruptionError)
+from spark_rapids_tpu.utils import residency as RES
 
 #: the descriptive integrity failure a corrupted spill file surfaces on
 #: re-read (instead of deserializing garbage) — re-exported here since
@@ -114,6 +115,14 @@ class BufferStore:
                 self._spill_queue.offer(h, buf.spill_priority)
             if self.catalog is not None:
                 self.catalog.register(buf)
+            # HBM residency ledger (utils/residency.py): every tracked
+            # buffer carries provenance — query id, site, tier — from
+            # birth to free/spill, so "who holds HBM and why" is
+            # answerable without touching the device
+            if RES.enabled():
+                buf._res_token = RES.track(
+                    buf.size_bytes, site=RES.buffer_site(buf.id),
+                    tier=self.tier.name.lower(), kind=RES.KIND_STORE)
 
     def remove(self, bid: BufferId) -> None:
         with self._lock:
@@ -127,6 +136,8 @@ class BufferStore:
                 self._handle_of.pop(h, None)
             self._on_remove(buf)
             buf.free()
+            RES.retire(getattr(buf, "_res_token", None))
+            buf._res_token = None
             if self.catalog is not None:
                 self.catalog.unregister(bid)
 
@@ -186,7 +197,12 @@ class BufferStore:
                     continue
             if self.spill_store is not None:
                 t0 = time.perf_counter_ns()
-                dst = self.spill_store.copy_buffer(buf)
+                # the next-tier copy inherits the ORIGINAL owner's
+                # provenance: a pressure spill triggered by query B
+                # must never re-attribute query A's bytes
+                with RES.inherit_scope(getattr(buf, "_res_token",
+                                               None)):
+                    dst = self.spill_store.copy_buffer(buf)
                 # one ledger record PER HOP: a device->host->disk
                 # migration (host pool full, fell through) lands here
                 # as device->disk — the hop that actually happened —
@@ -216,6 +232,8 @@ class BufferStore:
             if h is not None:
                 self._handle_of.pop(h, None)
             buf.free()
+            RES.retire(getattr(buf, "_res_token", None))
+            buf._res_token = None
 
     def copy_buffer(self, buf: SpillableBuffer) -> SpillableBuffer:
         """Materialize `buf`'s payload at this tier (spill receive path)."""
@@ -268,13 +286,15 @@ class DeviceMemoryStore(BufferStore):
         meta = meta_for_batch(batch)
         buf = DeviceBuffer(bid, batch, meta, spill_priority)
         if self.device_manager is not None:
-            self.device_manager.track_store_bytes(meta.size_bytes)
+            self.device_manager.track_store_bytes(
+                meta.size_bytes, site="device-store.add")
         self._track(buf)
         return buf
 
     def _on_remove(self, buf: SpillableBuffer) -> None:
         if self.device_manager is not None:
-            self.device_manager.track_store_bytes(-buf.size_bytes)
+            self.device_manager.track_store_bytes(
+                -buf.size_bytes, site="device-store.remove")
 
     def copy_buffer(self, buf: SpillableBuffer) -> SpillableBuffer:
         batch = buf.get_columnar_batch()

@@ -206,7 +206,11 @@ class BufferReceiveState:
         bid = BufferId(self.received_catalog.new_buffer_id().table_id,
                        meta_msg.shuffle_id, meta_msg.map_id,
                        meta_msg.partition)
-        self.host_store.add_blob(bid, blob, meta_msg.table_meta())
+        # provenance: received buffers land in the host tier under a
+        # reduce-side site, distinct from the sender's map buffers
+        from spark_rapids_tpu.utils import residency as RES
+        with RES.site_scope("shuffle-recv"):
+            self.host_store.add_blob(bid, blob, meta_msg.table_meta())
         self.received_catalog.add_received(self.task_attempt_id, bid)
         self.limiter.release(meta_msg.size_bytes)  # mirrors the acquire
         self.handler.batch_received(bid)

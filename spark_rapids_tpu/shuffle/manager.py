@@ -381,12 +381,16 @@ class CachingShuffleWriter:
         from spark_rapids_tpu.columnar.serde import serialize_batch
         from spark_rapids_tpu.memory.buffer import meta_for_batch
         from spark_rapids_tpu.utils import movement as MV
+        from spark_rapids_tpu.utils import residency as RES
         blob = serialize_batch(batch)
         meta = meta_for_batch(batch)
         for r in self.replicas:
             rbid = r.shuffle_catalog.next_shuffle_buffer_id(
                 self.shuffle_id, self.map_id, partition)
-            r.env.host_store.add_blob(rbid, blob, meta)
+            # provenance: replica copies are not the primary map
+            # output — their residency shows up under their own site
+            with RES.site_scope("shuffle-replica"):
+                r.env.host_store.add_blob(rbid, blob, meta)
             self._written.append((r.shuffle_catalog, rbid))
             self.replicated_bytes += len(blob)
         if MV.ledger() is not None:

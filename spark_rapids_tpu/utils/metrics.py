@@ -54,6 +54,10 @@ NUM_FUSION_DEOPTS = "numFusionDeopts"
 # gangs that deopted back to the per-partition lane
 NUM_SPMD_DISPATCHES = "numSpmdDispatches"
 NUM_SPMD_DEOPTS = "numSpmdDeopts"
+# HBM residency ledger (utils/residency.py): tracked buffers still
+# attributed to a query when it finished — charged to the collected
+# plan root by the end-of-query leak check
+NUM_RESIDENCY_LEAKS = "numResidencyLeaks"
 NUM_FETCH_FAILURES = "numFetchFailures"
 NUM_MAP_RECOMPUTES = "numMapRecomputes"
 NUM_STAGE_RETRIES = "numStageRetries"

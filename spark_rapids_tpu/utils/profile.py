@@ -103,6 +103,7 @@ EV_UDF_WORKER_CRASH = "udf_worker_crash"    # pyudf/daemon.py
 EV_CANCEL = "cancel"                        # utils/watchdog.py
 EV_WATCHDOG_TIMEOUT = "watchdog_timeout"
 EV_DATA_MOVEMENT = "data_movement"          # utils/movement.py
+EV_RESIDENCY_LEAK = "residency_leak"        # utils/residency.py
 EV_TELEMETRY_SNAPSHOT = "telemetry_snapshot"  # utils/telemetry.py (JSONL)
 
 EVENT_KINDS = frozenset(
@@ -219,6 +220,19 @@ class QueryTracer:
             KP.maybe_enable(conf)  # bare paths without a QueryScope
             self.kernels = KP.QueryKernelLedger(self.query_id,
                                                 self.t_origin)
+        #: per-query HBM residency ledger (utils/residency.py): live
+        #: bytes by provenance site, the high-water mark + peak
+        #: composition, and the end-of-query leak verdict — the
+        #: '-- residency --' section's source.  Creating the first one
+        #: sticky-enables process-wide provenance registration.
+        self.residency = None
+        if conf[C.RESIDENCY_ENABLED]:
+            from spark_rapids_tpu.utils import residency as RS
+            RS.maybe_enable(conf)
+            self.residency = RS.QueryResidencyLedger(
+                self.query_id, self.t_origin,
+                timeline=int(conf[C.RESIDENCY_TIMELINE_SIZE]),
+                leak_dump=int(conf[C.RESIDENCY_LEAK_DUMP]))
 
     # -- spans ---------------------------------------------------------------
     def open_span(self, name: str, cat: str,
@@ -465,6 +479,25 @@ def end_query(owner: Optional[QueryTracer], plan=None,
         _ACTIVE = max(0, _ACTIVE - 1)
     if getattr(_TLS, "ctx", None) is not None and _TLS.ctx[0] is owner:
         _TLS.ctx = None
+    if owner.residency is not None:
+        # leak check: tracked allocations still attributed to this
+        # finished query are flagged, counted, and dumped with full
+        # provenance — before the profile assembles so the report
+        # carries the verdict
+        try:
+            leaked = owner.residency.finalize()
+            for rec in leaked[:owner.residency.leak_dump]:
+                fields = dict(rec)
+                # the record's allocation kind must not shadow the
+                # event-log schema's own `kind` field
+                fields["alloc_kind"] = fields.pop("kind", None)
+                owner.event(EV_RESIDENCY_LEAK, **fields)
+            if leaked and plan is not None \
+                    and getattr(plan, "metrics", None) is not None:
+                from spark_rapids_tpu.utils import metrics as M
+                plan.metrics.add(M.NUM_RESIDENCY_LEAKS, len(leaked))
+        except Exception:  # noqa: BLE001 — diagnostics must never
+            pass           # fail the query
     profile = QueryProfile.build(owner, plan)
     hist_size = max(0, int(owner.conf[C.PROFILE_HISTORY_SIZE]))
     with _HISTORY_LOCK:
@@ -636,7 +669,9 @@ class QueryProfile:
                  movement_samples: Optional[list] = None,
                  kernels: Optional[list] = None,
                  kernel_samples: Optional[list] = None,
-                 kernel_top_n: int = 12):
+                 kernel_top_n: int = 12,
+                 residency: Optional[dict] = None,
+                 residency_samples: Optional[list] = None):
         self.query_id = query_id
         self.wall_start = wall_start
         self.wall_s = wall_s
@@ -661,6 +696,13 @@ class QueryProfile:
         #: records backing the Perfetto kernel tracks
         self.kernel_samples = kernel_samples or []
         self.kernel_top_n = kernel_top_n
+        #: HBM residency report (utils/residency.py): high-water mark,
+        #: peak-instant composition by site/tier, leak verdict; None
+        #: when residency tracking was off for this query
+        self.residency = residency
+        #: (ts_ns, site, site_bytes, total_bytes) samples backing the
+        #: Perfetto residency:<site> counter tracks
+        self.residency_samples = residency_samples or []
 
     # -- construction --------------------------------------------------------
     @classmethod
@@ -698,13 +740,22 @@ class QueryProfile:
                 samples = tr.ledger.samples()
             except Exception:  # noqa: BLE001 — same guard as the plan
                 movement = None  # report: assembly must never fail
+        residency = None
+        res_samples = None
+        if tr.residency is not None:
+            try:
+                residency = tr.residency.report()
+                res_samples = tr.residency.samples()
+            except Exception:  # noqa: BLE001 — same guard again
+                residency = None
         return cls(tr.query_id, tr.wall_start, wall_s,
                    spans, tr.events(), report,
                    cls._breakdown(spans, tr.root),
                    dropped_spans=tr.dropped_spans,
                    movement=movement, movement_samples=samples,
                    kernels=kernels, kernel_samples=kernel_samples,
-                   kernel_top_n=max(1, int(tr.conf[C.KERNELPROF_TOP_N])))
+                   kernel_top_n=max(1, int(tr.conf[C.KERNELPROF_TOP_N])),
+                   residency=residency, residency_samples=res_samples)
 
     @staticmethod
     def _breakdown(spans: list[Span], root: Optional[Span]) -> dict:
@@ -795,6 +846,16 @@ class QueryProfile:
                            "pid": 0, "tid": tid,
                            "args": {"fingerprint": fp,
                                     "query_id": self.query_id}})
+        # HBM residency counter tracks: live bytes per provenance site
+        # plus the query's total device-resident line, renderable
+        # alongside the movement counters in Perfetto
+        for ts, site, site_bytes, total in self.residency_samples:
+            events.append({"name": f"residency:{site}", "ph": "C",
+                           "ts": ts / 1e3, "pid": 0,
+                           "args": {"bytes": site_bytes}})
+            events.append({"name": "residency:total", "ph": "C",
+                           "ts": ts / 1e3, "pid": 0,
+                           "args": {"bytes": total}})
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": {"query_id": self.query_id,
                               "wall_s": self.wall_s,
@@ -825,6 +886,10 @@ class QueryProfile:
             from spark_rapids_tpu.utils import movement as MV
             lines.append("-- data movement --")
             lines.append(MV.format_report(self.movement))
+        if self.residency is not None:
+            from spark_rapids_tpu.utils import residency as RS
+            lines.append("-- residency --")
+            lines.append(RS.format_report(self.residency))
         return "\n".join(lines)
 
     # -- sinks ---------------------------------------------------------------

@@ -517,9 +517,20 @@ class Telemetry:
                     "idle_s": {},
                     "wall_sum_s": 0.0,
                     "kernel_s": {},
+                    "hwm": collections.deque(maxlen=_SLOW_LOG_WALLS),
                 }
             entry["count"] += 1
             entry["walls"].append(profile.wall_s)
+            # observed HBM high-water per plan shape (utils/residency
+            # .py) — the feed ROADMAP item 5's learned admission
+            # budgets consume in place of the static queryBudgetBytes
+            res = getattr(profile, "residency", None) or {}
+            hw = res.get("hbm_high_water")
+            if hw:
+                entry.setdefault(
+                    "hwm",
+                    collections.deque(maxlen=_SLOW_LOG_WALLS)
+                ).append(int(hw))
             entry["wall_sum_s"] += profile.wall_s
             for k, v in b.items():
                 if k in ("wall_s", "compute_s") or not v:
@@ -542,7 +553,8 @@ class Telemetry:
         """Aggregated per-fingerprint entries, slowest (p95) first."""
         with self._slow_lock:
             items = [(fp,
-                      {**e, "kernel_s": dict(e.get("kernel_s") or {})},
+                      {**e, "kernel_s": dict(e.get("kernel_s") or {}),
+                       "hwm": list(e.get("hwm") or [])},
                       list(e["walls"]))
                      for fp, e in self._slow.items()]
         out = []
@@ -563,6 +575,16 @@ class Telemetry:
                 "top_idle_pct": round(100.0 * top[1] / wall_sum, 1)
                 if wall_sum > 0 else 0.0,
             }
+            # observed HBM high-water marks of this plan shape: the
+            # admission-budget sizing feed (p95 + headroom is the
+            # recipe the tuning guide documents)
+            hwm = sorted(e.get("hwm") or [])
+            if hwm:
+                rec["hbm_high_water"] = {
+                    "p50_bytes": int(_quantile(hwm, 0.5)),
+                    "p95_bytes": int(_quantile(hwm, 0.95)),
+                    "max_bytes": int(hwm[-1]),
+                }
             # hottest kernel of this plan shape (kernelprof rows ride
             # the aggregated profiles): fingerprint + its share of the
             # shape's total attributed device time
@@ -586,7 +608,8 @@ class Telemetry:
         return {"gauges": self.registry.snapshot(),
                 "utilization": self.utilization_summary(),
                 "active_queries": active_queries(),
-                "slow_queries": self.slow_query_log()[:8]}
+                "slow_queries": self.slow_query_log()[:8],
+                "residency": _residency_view()}
 
     def describe_for_dump(self, samples: int = 8) -> str:
         """Multi-line rendering for the watchdog dump: every gauge plus
@@ -622,6 +645,36 @@ class Telemetry:
         r.gauge(PREFIX + "hbm_admitted_queries",
                 "Queries holding an admission-ledger slot.",
                 fn=_dm_gauge("admitted_queries"))
+        r.gauge(PREFIX + "hbm_in_use_bytes",
+                "Store-resident + reserved bytes (the accounted "
+                "arena's live total — the reserved-vs-store split's "
+                "sum).",
+                fn=_dm_gauge("in_use_bytes"))
+        r.gauge(PREFIX + "hbm_admission_headroom_bytes",
+                "budget - store - reserved - sum(admitted budgets): "
+                "the admission room try_admit actually has left "
+                "(negative = running queries outgrew their declared "
+                "budgets).",
+                fn=_dm_gauge("admission_headroom_bytes"))
+        r.gauge(PREFIX + "store_bytes_underflow_total",
+                "Store-byte accounting updates clamped at zero "
+                "(double-free indicator) since start.",
+                fn=_dm_gauge("store_bytes_underflow"))
+        # HBM residency ledger (utils/residency.py): populated while
+        # residency tracking is on (sticky from the first
+        # residency-enabled profiled query)
+        r.gauge(PREFIX + "hbm_resident_bytes",
+                "Tracked resident bytes per storage tier "
+                "(provenance-registered buffers, reservations, gang "
+                "pins).",
+                fn=_residency_tiers, label="tier")
+        r.gauge(PREFIX + "hbm_resident_site_bytes",
+                "Tracked device-resident bytes per provenance site.",
+                fn=_residency_device_sites, label="site")
+        r.gauge(PREFIX + "residency_leaks_total",
+                "Tracked buffers flagged still-resident at their "
+                "owning query's end since start.",
+                fn=_residency_leaks)
         r.gauge(PREFIX + "spill_bytes_total",
                 "Bytes spilled by the pressure callback since start.",
                 fn=_spill_gauge("bytes_spilled"))
@@ -887,6 +940,33 @@ def _host_syncs():
 def _movement_totals():
     from spark_rapids_tpu.utils.movement import process_edge_totals
     return process_edge_totals()
+
+
+def _residency_tiers():
+    from spark_rapids_tpu.utils import residency as RS
+    return RS.by_tier() if RS.enabled() else {}
+
+
+def _residency_device_sites():
+    from spark_rapids_tpu.utils import residency as RS
+    return RS.by_site(RS.TIER_DEVICE) if RS.enabled() else {}
+
+
+def _residency_leaks():
+    from spark_rapids_tpu.utils import residency as RS
+    return RS.leaks_total()
+
+
+def _residency_view() -> dict:
+    """The /telemetry JSON residency section: tracking state, per-tier
+    totals, and the top holders (who owns the memory, right now)."""
+    from spark_rapids_tpu.utils import residency as RS
+    if not RS.enabled():
+        return {"enabled": False}
+    return {"enabled": True,
+            "tiers": RS.by_tier(),
+            "leaks_total": RS.leaks_total(),
+            "holders": RS.holders(limit=8)}
 
 
 def _kernelprof_catalog_size():

@@ -635,6 +635,19 @@ def build_dump(stuck: Optional[Heartbeat] = None) -> str:
                      f"slow_injected={slow_injection_counts()}")
     except Exception as e:  # noqa: BLE001
         lines.append(f"  <unavailable: {e}>")
+    lines.append("-- residency --")
+    try:
+        # the HBM holder table (utils/residency.py): an OOM-adjacent
+        # post-mortem shows WHO owned the memory, not just how much
+        # was resident
+        from spark_rapids_tpu.utils import residency as RS
+        lines.append(RS.describe_for_dump())
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
+        dm = DeviceManager.peek()
+        if dm is not None:
+            lines.append(f"  accounting: {dm.snapshot()}")
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"  <unavailable: {e}>")
     lines.append("-- telemetry --")
     try:
         # engine-wide state (gauges + recent utilization samples) so a

@@ -1,0 +1,516 @@
+"""HBM residency ledger tests (utils/residency.py): per-buffer
+provenance registration/retirement, per-query high-water marks with
+peak-instant composition, leak detection with provenance, the
+store-byte underflow guard, admission-headroom gauges, slow-query-log
+high-water aggregation, and the disabled-path/bit-exactness contracts.
+
+Wall-clock discipline (test_movement.py's): ONE profiled manager-lane
+TPC-H q5 run (module fixture) backs the report/reconciliation
+assertions; unit tests drive the registry/stores directly; one
+8-thread mixed TPC-H/TPC-DS storm proves isolation under concurrency.
+"""
+import threading
+
+import numpy as np
+import pytest
+from pandas.testing import assert_frame_equal
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.memory import BufferId
+from spark_rapids_tpu.memory.device_manager import DeviceManager
+from spark_rapids_tpu.memory.env import ResourceEnv
+from spark_rapids_tpu.models import tpcds_data, tpcds_queries
+from spark_rapids_tpu.utils import profile as P
+from spark_rapids_tpu.utils import residency as RS
+from spark_rapids_tpu.utils import telemetry as T
+
+SCALE = 300
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiles():
+    P.clear_history()
+    yield
+    P.clear_history()
+
+
+@pytest.fixture(scope="module")
+def tables():
+    from spark_rapids_tpu.models.tpch_data import gen_tables
+    return gen_tables(np.random.default_rng(11), SCALE)
+
+
+@pytest.fixture(scope="module")
+def ds_tables():
+    return tpcds_data.gen_tables(np.random.default_rng(3), 2000)
+
+
+def _conf(**extra):
+    kv = {
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.sql.incompatibleOps.enabled": True,
+        "spark.rapids.sql.profile.enabled": True,
+    }
+    kv.update({k.replace("__", "."): v for k, v in extra.items()})
+    return C.RapidsConf(kv)
+
+
+def _run_q(query, tables, **extra):
+    from spark_rapids_tpu.models.tpch_bench import run_query
+    return run_query(query, tables, engine="tpu", conf=_conf(**extra))
+
+
+def _run_tpcds(name, ds_tables, conf):
+    from spark_rapids_tpu.plan.overrides import accelerate, collect
+
+    def run(plan):
+        return collect(accelerate(plan, conf), conf)
+    return run(tpcds_queries.QUERIES[name](
+        tpcds_data.sources(ds_tables, 2), run))
+
+
+def _shuffle_reset():
+    from spark_rapids_tpu.shuffle.manager import (
+        MapOutputRegistry, TpuShuffleManager)
+    from spark_rapids_tpu.shuffle.recovery import PeerHealth
+    MapOutputRegistry.clear()
+    PeerHealth.get().clear()
+    for eid in list(TpuShuffleManager._managers):
+        TpuShuffleManager._managers[eid].close()
+
+
+def _batch(rows=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_numpy({
+        "a": rng.integers(0, 100, rows).astype(np.int64),
+        "b": rng.random(rows)})
+
+
+# ---------------------------------------------------------------------------
+# process registry units
+def test_track_retire_registry_unit():
+    RS.reset()
+    RS.enable()
+    try:
+        token = RS.track(1000, site="unit-site")
+        assert token is not None
+        assert RS.resident_bytes() == 1000
+        assert RS.by_tier() == {"device": 1000}
+        assert RS.by_site() == {"unit-site": 1000}
+        snap = RS.lookup(token)
+        assert snap["site"] == "unit-site"
+        assert snap["tier"] == RS.TIER_DEVICE
+        assert snap["kind"] == RS.KIND_STORE
+        assert snap["bytes"] == 1000
+        # host-tier records are separate from the device total
+        t2 = RS.track(500, site="unit-site", tier=RS.TIER_HOST)
+        assert RS.resident_bytes(RS.TIER_DEVICE) == 1000
+        assert RS.by_tier() == {"device": 1000, "host": 500}
+        holders = RS.holders()
+        assert holders[0]["bytes"] == 1000
+        RS.retire(token)
+        RS.retire(token)  # double retire is a no-op
+        RS.retire(t2)
+        assert RS.resident_bytes() == 0
+        # degenerate sizes never register
+        assert RS.track(0, site="x") is None
+    finally:
+        RS.reset()
+
+
+def test_disabled_path_allocation_free():
+    RS.reset()
+    assert not RS.enabled()
+    assert RS.track(1 << 20, site="x") is None
+    RS.retire(None)  # no-op
+    assert RS.resident_bytes() == 0
+    assert RS.by_tier() == {}
+    assert RS.describe_for_dump() == "  <residency tracking off>"
+    with RS.tracked(1 << 20, site="x") as token:
+        assert token is None
+
+
+def test_site_scope_and_buffer_site():
+    assert RS.buffer_site(BufferId(1)) == "store"
+    assert RS.buffer_site(BufferId(1, shuffle_id=3, map_id=0,
+                                   partition=1)) == "shuffle-map"
+    with RS.site_scope("shuffle-recv"):
+        # an explicit scope wins even for shuffle-coordinate ids
+        assert RS.buffer_site(BufferId(1, shuffle_id=3)) == \
+            "shuffle-recv"
+    assert RS.buffer_site(BufferId(1)) == "store"
+
+
+def test_ledger_highwater_peak_and_report_unit():
+    led = RS.QueryResidencyLedger("qunit", 0, timeline=64)
+
+    def rec(size, site, tier=RS.TIER_DEVICE):
+        return RS.ProvenanceRecord(0, "qunit", site, size, tier,
+                                   RS.KIND_STORE, None)
+
+    a = rec(100, "a")
+    b = rec(300, "b")
+    led.on_alloc(a)
+    led.on_alloc(b)
+    assert led.live_bytes == 400
+    assert led.hbm_high_water == 400
+    # host-tier stock records sites but never the HBM mark
+    led.on_alloc(rec(10_000, "spilled", tier=RS.TIER_HOST))
+    assert led.hbm_high_water == 400
+    led.on_free(a)
+    assert led.live_bytes == 300
+    # a new peak snapshots the composition at THAT instant
+    c = rec(500, "c")
+    led.on_alloc(c)
+    assert led.hbm_high_water == 800
+    rep = led.report()
+    assert rep["hbm_high_water"] == 800
+    comp = rep["peak_composition"]
+    assert comp == {"b|device": 300, "c|device": 500}
+    assert sum(comp.values()) == rep["hbm_high_water"]
+    # over-free clamps at zero, never negative
+    led.on_free(b)
+    led.on_free(b)
+    led.on_free(c)
+    assert led.live_bytes == 0
+    assert led.samples()
+    assert "leak verdict" not in ""  # report renders below
+    text = RS.format_report(rep)
+    assert "hbm high water" in text and "at peak" in text
+    assert RS.format_report(None) == "<no residency tracked>"
+
+
+# ---------------------------------------------------------------------------
+# DeviceManager satellites: underflow guard + headroom gauges
+def test_store_bytes_underflow_guard():
+    dm = DeviceManager.get()
+    before = dm.store_bytes
+    uf0 = dm.store_bytes_underflows()
+    dm.track_store_bytes(-(before + 12345), site="test-underflow")
+    assert dm.store_bytes == 0
+    assert dm.store_bytes_underflows() == uf0 + 1
+    # second hit at the same site bumps the counter (logging is
+    # once-per-site, counting is per-event)
+    dm.track_store_bytes(-1, site="test-underflow")
+    assert dm.store_bytes == 0
+    assert dm.store_bytes_underflows() == uf0 + 2
+    assert dm.telemetry_gauges()["store_bytes_underflow"] == uf0 + 2
+    dm.track_store_bytes(before, site="test-underflow-restore")
+    assert dm.store_bytes == before
+
+
+def test_headroom_and_split_gauges():
+    dm = DeviceManager.get()
+    g = dm.telemetry_gauges()
+    assert g["in_use_bytes"] == g["store_bytes"] + g["reserved_bytes"]
+    assert g["admission_headroom_bytes"] == (
+        g["budget"] - g["store_bytes"] - g["reserved_bytes"]
+        - g["admitted_bytes"])
+    snap = dm.snapshot()
+    assert isinstance(snap["admissions"], dict)
+    assert snap["admission_headroom_bytes"] == \
+        g["admission_headroom_bytes"]
+    # admission moves headroom down by exactly the declared budget
+    assert dm.try_admit("residency-test-q", 1 << 20)
+    try:
+        g2 = dm.telemetry_gauges()
+        assert g2["admission_headroom_bytes"] == \
+            g["admission_headroom_bytes"] - (1 << 20)
+    finally:
+        dm.release_admission("residency-test-q")
+
+
+# ---------------------------------------------------------------------------
+# store-chain registration reconciles with DeviceManager accounting
+def test_store_registration_reconciles(tmp_path):
+    prev_conf = C.get_active_conf()
+    env = ResourceEnv.init(spill_dir=str(tmp_path))
+    RS.reset()
+    RS.enable()
+    try:
+        dm = env.device_manager
+        bufs = []
+        for i in range(3):
+            bid = BufferId(env.catalog.next_table_id())
+            bufs.append(env.device_store.add_batch(bid, _batch(seed=i)))
+        # tracked device residency == the admission ledger's view of
+        # store bytes (the acceptance reconciliation, quiescent form)
+        assert RS.resident_bytes(RS.TIER_DEVICE) == dm.store_bytes > 0
+        assert RS.by_site(RS.TIER_DEVICE) == {
+            "store": dm.store_bytes}
+        # spilling moves the provenance to the host tier: device
+        # registrations retire, host copies register under the SAME
+        # site (inherited provenance)
+        freed = env.device_store.synchronous_spill(0)
+        assert freed > 0
+        assert RS.resident_bytes(RS.TIER_DEVICE) == dm.store_bytes == 0
+        assert RS.resident_bytes(RS.TIER_HOST) > 0
+        assert set(RS.by_site(RS.TIER_HOST)) == {"store"}
+        for b in bufs:
+            env.catalog.remove(b.id)
+        assert RS.resident_bytes() == 0
+    finally:
+        RS.reset()
+        ResourceEnv.shutdown()
+        C.set_active_conf(prev_conf)
+
+
+def test_spill_inherits_original_owner(tmp_path):
+    """A spill executed outside the owning query's threads keeps the
+    owner's attribution: the host copy carries query A's id, not the
+    spilling thread's (cross-query pressure must not re-attribute)."""
+    prev_conf = C.get_active_conf()
+    conf = _conf()
+    C.set_active_conf(conf)
+    env = ResourceEnv.init(conf, spill_dir=str(tmp_path))
+    RS.reset()
+    owner = P.begin_query(conf)
+    assert owner is not None and owner.residency is not None
+    bid = BufferId(env.catalog.next_table_id())
+    try:
+        env.device_store.add_batch(bid, _batch())
+        recs = RS.live_records_for_query(owner.query_id)
+        assert len(recs) == 1 and recs[0]["tier"] == "device"
+
+        # spill from a foreign thread with NO query context
+        t = threading.Thread(
+            target=lambda: env.device_store.synchronous_spill(0))
+        t.start()
+        t.join(60)
+        recs = RS.live_records_for_query(owner.query_id)
+        assert len(recs) == 1, recs
+        assert recs[0]["tier"] == "host"
+        assert recs[0]["site"] == "store"
+    finally:
+        env.catalog.remove(bid)
+        P.end_query(owner, None)
+        RS.reset()
+        ResourceEnv.shutdown()
+        C.set_active_conf(prev_conf)
+
+
+# ---------------------------------------------------------------------------
+# leak detection: a deliberately-leaked buffer is caught with provenance
+def test_deliberate_leak_flagged_with_provenance(tmp_path):
+    prev_conf = C.get_active_conf()
+    conf = _conf()
+    C.set_active_conf(conf)
+    env = ResourceEnv.init(conf, spill_dir=str(tmp_path))
+    RS.reset()
+    leaks0 = RS.leaks_total()
+    owner = P.begin_query(conf)
+    assert owner is not None and owner.residency is not None
+    bid = BufferId(env.catalog.next_table_id())
+    buf = env.device_store.add_batch(bid, _batch())
+    try:
+        prof = P.end_query(owner, None)  # buffer still resident: leak
+        res = prof.residency
+        assert res is not None and res["leaks"] == 1
+        leak = res["leaked"][0]
+        assert leak["site"] == "store"
+        assert leak["tier"] == "device"
+        assert leak["kind"] == RS.KIND_STORE
+        assert leak["bytes"] == buf.size_bytes
+        assert leak["query_id"] == prof.query_id
+        assert RS.leaks_total() == leaks0 + 1
+        # the structured event log carries the same provenance
+        evs = [e for e in prof.events
+               if e["kind"] == P.EV_RESIDENCY_LEAK]
+        assert len(evs) == 1 and evs[0]["site"] == "store"
+        # the leaked buffer stays visible in the holder table until
+        # actually freed
+        assert "LEAKED" in RS.format_report(res)
+        assert any(h["query_id"] == prof.query_id
+                   for h in RS.holders())
+    finally:
+        env.catalog.remove(bid)
+        RS.reset()
+        ResourceEnv.shutdown()
+        C.set_active_conf(prev_conf)
+
+
+def test_watchdog_dump_has_residency_holder_table():
+    from spark_rapids_tpu.utils.watchdog import build_dump
+    RS.reset()
+    RS.enable()
+    token = RS.track(1 << 20, site="dump-site")
+    try:
+        dump = build_dump()
+        assert "-- residency --" in dump
+        assert "dump-site" in dump
+        text = RS.describe_for_dump()
+        assert "tracked resident" in text and "dump-site" in text
+    finally:
+        RS.retire(token)
+        RS.reset()
+
+
+# ---------------------------------------------------------------------------
+# the profiled q5 acceptance run (manager lane: store + wire + spill
+# traffic all in one query)
+@pytest.fixture(scope="module")
+def q5_residency(tables):
+    from spark_rapids_tpu.memory import retry as R
+    _shuffle_reset()
+    R.reset_oom_injection()
+    P.clear_history()
+    RS.reset()
+    try:
+        out = _run_q(5, tables, **{
+            "spark.rapids.shuffle.enabled": True,
+            "spark.rapids.shuffle.localExecutors": 2,
+            "spark.rapids.memory.faultInjection.oomRate": 0.5,
+            "spark.rapids.memory.faultInjection.seed": 7,
+            "spark.rapids.memory.faultInjection.maxInjections": 16})
+        prof = P.last_profile()
+        assert prof is not None
+        yield out, prof
+    finally:
+        R.reset_oom_injection()
+        _shuffle_reset()
+        RS.reset()
+        ResourceEnv.shutdown()
+
+
+def test_q5_high_water_nonzero_and_reconciles(q5_residency):
+    """Acceptance: nonzero HBM high-water mark whose peak-instant
+    composition sums exactly to the mark, zero leaks, and every
+    tracked allocation retired by query end."""
+    _, prof = q5_residency
+    res = prof.residency
+    assert res is not None
+    assert res["hbm_high_water"] > 0
+    comp = res["peak_composition"]
+    assert comp, res
+    assert all(k.endswith("|device") for k in comp)
+    assert sum(comp.values()) == res["hbm_high_water"]
+    assert res["leaks"] == 0
+    assert res["live_end_bytes"] == 0
+    assert res["allocs"] == res["frees"] > 0
+    # shuffle catalog buffers showed in the composition sites over the
+    # query's life (manager lane stores map outputs on device)
+    sites = {e[1] for e in prof.residency_samples}
+    assert "shuffle-map" in sites
+    assert any(s.startswith("reserve:") for s in sites)
+
+
+def test_q5_report_renders_everywhere(q5_residency):
+    _, prof = q5_residency
+    text = prof.explain()
+    assert "-- residency --" in text
+    assert "leak verdict: clean" in text
+    trace = prof.chrome_trace()
+    names = {e["name"] for e in trace["traceEvents"]
+             if e["ph"] == "C" and e["name"].startswith("residency:")}
+    assert "residency:total" in names
+    assert len(names) > 2  # per-site tracks alongside the total
+    # nothing tracked for this query is still live
+    assert RS.live_records_for_query(prof.query_id) == []
+
+
+def test_q5_bit_exact_with_residency_off(q5_residency, tables):
+    """Residency accounting never changes results: same q5, ledger
+    disabled, bit-exact frames."""
+    on, _ = q5_residency
+    _shuffle_reset()
+    try:
+        off = _run_q(5, tables, **{
+            "spark.rapids.shuffle.enabled": True,
+            "spark.rapids.shuffle.localExecutors": 2,
+            "spark.rapids.sql.profile.residency.enabled": False})
+    finally:
+        _shuffle_reset()
+    prof = P.last_profile()
+    assert prof.residency is None
+    assert prof.residency_samples == []
+    assert_frame_equal(off.reset_index(drop=True),
+                       on.reset_index(drop=True))
+
+
+# ---------------------------------------------------------------------------
+# slow-query log: per-fingerprint observed high-water aggregation
+def test_slow_query_log_hbm_high_water(tables):
+    T.stop()
+    t = T.start(C.RapidsConf({
+        "spark.rapids.sql.telemetry.enabled": True,
+        "spark.rapids.sql.telemetry.samplePeriodMs": 50.0}))
+    try:
+        for _ in range(2):
+            _run_q(1, tables)
+        entries = [e for e in t.slow_query_log()
+                   if "hbm_high_water" in e]
+        assert entries, t.slow_query_log()
+        hw = entries[0]["hbm_high_water"]
+        assert hw["max_bytes"] >= hw["p95_bytes"] >= hw["p50_bytes"] > 0
+        assert entries[0]["count"] >= 2
+        # the /telemetry residency view is live
+        snap = t.snapshot()
+        assert snap["residency"]["enabled"] is True
+        assert "tiers" in snap["residency"]
+    finally:
+        T.stop()
+        RS.reset()
+
+
+# ---------------------------------------------------------------------------
+# 8-thread mixed TPC-H/TPC-DS storm: bit-exact, zero leaks, isolated
+# per-query high-water marks
+def test_storm_residency_isolated_zero_leaks(tables, ds_tables):
+    conf = _conf()
+    plain = C.RapidsConf({
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.sql.incompatibleOps.enabled": True})
+    mix = [("tpch", 1), ("tpch", 5), ("tpch", 6), ("tpcds", "q3"),
+           ("tpcds", "q42"), ("tpch", 1), ("tpch", 6), ("tpcds", "q3")]
+
+    def run_one(kind, q, cf):
+        if kind == "tpch":
+            from spark_rapids_tpu.models.tpch_bench import run_query
+            return run_query(q, tables, engine="tpu", conf=cf)
+        return _run_tpcds(q, ds_tables, cf)
+
+    serial = {key: run_one(*key, plain) for key in set(mix)}
+    P.clear_history()
+    results: dict = {}
+    errors: list = []
+
+    def worker(i, kind, q):
+        try:
+            results[i] = ((kind, q), run_one(kind, q, conf))
+        except BaseException as e:  # noqa: BLE001 — asserted below
+            errors.append((i, kind, q, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i, kind, q),
+                                name=f"res-storm-{i}")
+               for i, (kind, q) in enumerate(mix)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert not errors, errors
+    assert len(results) == len(mix)
+    for i, (key, got) in results.items():
+        assert_frame_equal(got.reset_index(drop=True),
+                           serial[key].reset_index(drop=True))
+    profs = P.profile_history()
+    assert len(profs) == len(mix)
+    assert len({p.query_id for p in profs}) == len(mix)
+    for p in profs:
+        res = p.residency
+        assert res is not None, p.query_id
+        # every query saw its OWN nonzero high-water mark, reconciled
+        # against its own peak composition — no cross-query bleed
+        assert res["hbm_high_water"] > 0, p.query_id
+        assert sum(res["peak_composition"].values()) == \
+            res["hbm_high_water"], p.query_id
+        assert res["leaks"] == 0, (p.query_id, res["leaked"])
+        assert res["live_end_bytes"] == 0, p.query_id
+        assert RS.live_records_for_query(p.query_id) == []
+    # engine-level cleanliness: no leaked permits/admissions/
+    # reservations after the storm
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    assert TpuSemaphore.get().snapshot()["refs"] == {}
+    dm = DeviceManager.get()
+    assert dm.admissions() == {}
+    assert dm.reserved_bytes == 0
