@@ -915,6 +915,123 @@ _KERNELPROF_SUMMARY = [None]
 #: the profiled q5 HBM high-water mark and leak verdict — BENCH_r09+
 #: tracks per-lane residency trajectory (down is good)
 _RESIDENCY_SUMMARY = [None]
+#: set by bench_out_of_core: graceful-degradation trajectory — the
+#: slowdown and spill traffic of running a sort whose working set is
+#: 2x / 10x the accounted HBM budget — BENCH_r09+ tracks how much the
+#: external lanes cost as the budget shrinks (down is good)
+_OOCORE_SUMMARY = [None]
+
+
+def bench_out_of_core():
+    """Out-of-core graceful-degradation bench (ISSUE 16): one global
+    sort run uncapped, then with `spark.rapids.memory.hbmBudgetBytes`
+    at 1/2 and 1/10 of the measured working set — the capped lanes
+    degrade to the external merge sort (runs streamed down the
+    host->disk spill chain, hierarchical window-sized merges) instead
+    of erroring.  Reports wall clock per lane, spilled run MB, and
+    merge-pass counts; every capped lane is verified bit-exact against
+    the uncapped one, so the numbers are the cost of CORRECT
+    degradation, not of a different answer."""
+    import tempfile
+
+    import pandas as pd
+
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.columnar.batch import ColumnarBatch
+    from spark_rapids_tpu.exec.basic import LocalBatchSource
+    from spark_rapids_tpu.exec.sort import SortExec, asc, desc
+    from spark_rapids_tpu.exprs.base import col
+    from spark_rapids_tpu.memory import ResourceEnv
+    from spark_rapids_tpu.memory import oocore as OC
+    from spark_rapids_tpu.memory import retry as R
+    from spark_rapids_tpu.utils import metrics as M
+
+    n = 4_000 if BENCH_FAST else 12_000
+    rng = np.random.default_rng(11)
+    df = pd.DataFrame({
+        "x": rng.integers(-500, 500, n).astype(np.int64),
+        "y": rng.integers(0, 10**6, n).astype(np.int64)})
+    nb = 8
+    step = -(-n // nb)
+
+    def plan():
+        return SortExec(
+            [asc(col("x")), desc(col("y"))],
+            LocalBatchSource([[ColumnarBatch.from_pandas(
+                df.iloc[i:i + step].reset_index(drop=True))
+                for i in range(0, n, step)]]))
+
+    # working set: the retry lattice's own estimate (2x device bytes)
+    working_set = 2 * n * 2 * 8
+
+    def run_lane(cap):
+        keys = {C.HBM_ALLOC_FRACTION.key: 1.0, C.HBM_RESERVE.key: 0,
+                C.CONCURRENT_TPU_TASKS.key: 1}
+        if cap:
+            keys[C.HBM_BUDGET_BYTES.key] = int(cap)
+        conf = C.RapidsConf(keys)
+        C.set_active_conf(conf)
+        ResourceEnv.init(hbm_total=1 << 30,
+                         spill_dir=tempfile.mkdtemp())
+        R.reset_oom_injection()
+        OC.reset_run_accounting()
+        p = plan()
+        with C.session(conf):
+            p.collect()  # warm the lane's kernels
+        OC.reset_run_accounting()
+        p = plan()
+        t0 = time.perf_counter()
+        with C.session(conf):
+            out = p.collect().to_pandas()
+        wall = time.perf_counter() - t0
+
+        def tree_metric(node):
+            return node.metrics.value(M.NUM_EXTERNAL_MERGE_PASSES) + \
+                sum(tree_metric(ch) for ch in node.children)
+
+        passes = int(tree_metric(p))
+        spill_mb = OC.run_bytes_spilled() / 1e6
+        ResourceEnv.shutdown()
+        C.set_active_conf(C.RapidsConf())
+        return out, wall, spill_mb, passes
+
+    base, wall_full, _, passes_full = run_lane(0)
+    lanes = {}
+    for name, cap in (("half", working_set // 2),
+                      ("tenth", working_set // 10)):
+        out, wall, spill_mb, passes = run_lane(cap)
+        pd.testing.assert_frame_equal(
+            out.reset_index(drop=True), base.reset_index(drop=True),
+            check_exact=True)
+        lanes[name] = {"wall_ms": round(wall * 1e3, 1),
+                       "spill_mb": round(spill_mb, 3),
+                       "merge_passes": passes}
+    slowdown = lanes["tenth"]["wall_ms"] / max(wall_full * 1e3, 1e-9)
+    _OOCORE_SUMMARY[0] = {
+        "tenth_budget_slowdown": round(slowdown, 2),
+        "spill_mb_tenth": lanes["tenth"]["spill_mb"],
+        "merge_passes_tenth": lanes["tenth"]["merge_passes"]}
+    return {
+        "metric": "oocore_tenth_budget_slowdown", "value": round(slowdown, 3),
+        "unit": "x",
+        # not a speed ratio: the uncapped lane is the baseline, and a
+        # degradation within ~8x of it for a 10x-over-budget working
+        # set counts as full marks on the graceful-degradation budget
+        "vs_baseline": round(min(2.0, 8.0 / max(slowdown, 0.1)), 2),
+        "rows": n,
+        "working_set_bytes": working_set,
+        "wall_uncapped_ms": round(wall_full * 1e3, 1),
+        "merge_passes_uncapped": passes_full,
+        "wall_half_ms": lanes["half"]["wall_ms"],
+        "spill_mb_half": lanes["half"]["spill_mb"],
+        "merge_passes_half": lanes["half"]["merge_passes"],
+        "wall_tenth_ms": lanes["tenth"]["wall_ms"],
+        "spill_mb_tenth": lanes["tenth"]["spill_mb"],
+        "merge_passes_tenth": lanes["tenth"]["merge_passes"],
+        "note": "external sort under hbmBudgetBytes caps; capped lanes "
+                "bit-exact vs uncapped",
+        **({"shape": "fast"} if BENCH_FAST else {}),
+    }
 
 
 def bench_movement_ledger():
@@ -1812,6 +1929,9 @@ def main():
             # HBM residency ledger (ISSUE 14): its wall-clock cost and
             # the profiled q5 high-water/leak trajectory
             "residency": _RESIDENCY_SUMMARY[0],
+            # out-of-core degradation (ISSUE 16): slowdown + spill
+            # traffic when the working set is 10x the HBM budget
+            "oocore": _OOCORE_SUMMARY[0],
             "util": (T.live().utilization_summary()
                      if T.live() is not None else None),
         }
@@ -1833,10 +1953,11 @@ def main():
     # summary after every bench so the final stdout line is always a
     # complete, parseable summary of everything measured so far
     print(summary_line(), flush=True)
-    # bench_spmd_stage leads the list: the newest lane's evidence must
+    # bench_out_of_core leads the list: the newest lane's evidence must
     # land inside the driver's wall-clock window even when later
     # benches push past it (the r06 timeout lesson)
-    for fn in (bench_spmd_stage, bench_groupby, bench_groupby_dict_kernel,
+    for fn in (bench_out_of_core,
+               bench_spmd_stage, bench_groupby, bench_groupby_dict_kernel,
                bench_join_sort, bench_exchange_manager,
                bench_pipeline_overlap, bench_profile_overhead,
                bench_kernelprof,

@@ -40,7 +40,8 @@ DEFAULT_THRESHOLD = 10.0
 
 #: name fragments marking a metric where LOWER is better
 _LOWER_BETTER = ("_ms", "wall", "overhead", "latency", "host_syncs",
-                 "p95", "p50", "hbm_high_water", "leaks")
+                 "p95", "p50", "hbm_high_water", "leaks",
+                 "merge_passes", "spill_mb", "slowdown")
 
 
 def lower_is_better(name: str) -> bool:

@@ -58,6 +58,7 @@ run_fast() {
   run_telemetry
   run_kernelprof
   run_residency
+  run_oocore
 }
 
 run_residency() {
@@ -611,6 +612,76 @@ run_oom_soak() {
     "${PYTEST[@]}" tests/test_oom_retry.py -m "not slow"
 }
 
+run_oocore() {
+  # out-of-core lane: the bounded-HBM degradation suite (external
+  # sort / grace join / agg spill bit-exactness, ledger reconciliation,
+  # corruption recovery, watchdog-covered merge passes, the chaos
+  # composite soak including the slow q5 leg), then one TPC-H q5 run
+  # under a budget a fraction of its working set with spill-corruption
+  # injection lit — bit-exact vs the unconstrained lane, overflow bytes
+  # proven onto the movement ledger's oocore spill edges, zero leaked
+  # buffers/admissions/reservations — with a spill-traffic summary line.
+  echo "== out-of-core lane (bounded-HBM external sort/join/agg, spill-tier streaming) =="
+  "${PYTEST[@]}" tests/test_out_of_core.py
+  python - <<'PYEOF'
+import jax
+jax.config.update("jax_platforms", "cpu")
+import tempfile
+import numpy as np
+from pandas.testing import assert_frame_equal
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.memory import ResourceEnv
+from spark_rapids_tpu.memory import oocore as OC
+from spark_rapids_tpu.memory import retry as R
+from spark_rapids_tpu.memory import stores as ST
+from spark_rapids_tpu.models.tpch_bench import BENCH_CONF, run_query
+from spark_rapids_tpu.models.tpch_data import gen_tables
+from spark_rapids_tpu.utils import movement as MV
+from spark_rapids_tpu.utils import profile as P
+
+tables = gen_tables(np.random.default_rng(11), 3000)
+ref = run_query(5, tables, conf=C.RapidsConf(dict(BENCH_CONF)))
+conf = C.RapidsConf({**BENCH_CONF,
+    "spark.rapids.sql.profile.enabled": True,
+    "spark.rapids.memory.hbmBudgetBytes": 1 << 14,
+    "spark.rapids.memory.host.spillStorageSize": 1 << 14,
+    "spark.rapids.memory.faultInjection.spillCorruptRate": 0.005,
+    "spark.rapids.memory.faultInjection.seed": 7,
+    "spark.rapids.memory.oocore.runReplicas": 2,
+    "spark.rapids.memory.gpu.allocFraction": 1.0,
+    "spark.rapids.memory.gpu.reserve": 0})
+C.set_active_conf(conf)
+env = ResourceEnv.init(hbm_total=1 << 26,
+                       spill_dir=tempfile.mkdtemp())
+R.reset_oom_injection()
+ST.reset_spill_corruption()
+OC.reset_run_accounting()
+got = run_query(5, tables, conf=conf)
+assert_frame_equal(got.reset_index(drop=True),
+                   ref.reset_index(drop=True), check_exact=True)
+prof = P.last_profile()
+sites = prof.movement["edges"][MV.EDGE_SPILL]["sites"]
+oocore_mb = sum(v["bytes"] for s, v in sites.items()
+                if s.startswith(OC.SITE_PREFIX)) / 1e6
+assert abs(oocore_mb * 1e6 - OC.run_bytes_spilled()) < 1, \
+    (oocore_mb, OC.run_bytes_spilled())
+assert prof.oocore is not None, "profile lost the out-of-core section"
+dm = env.device_manager
+assert len(env.catalog) == 0, "leaked buffers"
+assert dm.admissions() == {} and dm.reserved_bytes == 0
+assert env.disk_store.orphaned_spill_files() == []
+tot = prof.oocore["totals"]
+print("oocore summary: q5 bit-exact under %dKB budget; runs=%d "
+      "spill_mb=%.2f merge_passes=%d grace_partitions=%d "
+      "corruptions_injected=%d recovered=%d leaks=0" % (
+          (1 << 14) // 1024, OC.runs_spilled(), oocore_mb,
+          tot["merge_passes"], tot["grace_partitions"],
+          ST.injected_spill_corruptions(),
+          tot["corrupt_recovered"]))
+ResourceEnv.shutdown()
+PYEOF
+}
+
 run_slow() {
   echo "== slow tier (multi-batch scale + asserted spill) =="
   "${PYTEST[@]}" tests/test_scale_workloads.py -m slow
@@ -650,7 +721,8 @@ case "$TIER" in
   telemetry) run_telemetry ;;
   kernelprof) run_kernelprof ;;
   residency) run_residency ;;
+  oocore)   run_oocore ;;
   all)      run_fast; run_slow; run_shims; run_bench ;;
-  *) echo "usage: $0 [lint|gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|spmd|speculation|telemetry|kernelprof|residency|all]" >&2
+  *) echo "usage: $0 [lint|gate|fast|slow|shims|bench|oom|pipeline|recovery|watchdog|profile|movement|concurrency|fusion|spmd|speculation|telemetry|kernelprof|residency|oocore|all]" >&2
      exit 2 ;;
 esac

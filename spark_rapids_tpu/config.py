@@ -110,6 +110,17 @@ HBM_ALLOC_FRACTION = conf("spark.rapids.memory.gpu.allocFraction", 0.9,
                           "Fraction of HBM to dedicate to the arena pool.")
 HBM_RESERVE = conf("spark.rapids.memory.gpu.reserve", 1073741824,
                    "HBM bytes kept free for XLA scratch/fusion temporaries.")
+HBM_BUDGET_BYTES = conf(
+    "spark.rapids.memory.hbmBudgetBytes", 0,
+    "Hard cap (bytes) on the accounted HBM arena budget, applied AFTER "
+    "the allocFraction/reserve arithmetic: the effective budget is "
+    "min(total*allocFraction - reserve, this).  0 (default) disables "
+    "the cap.  This is the out-of-core lever: capping the budget below "
+    "an operator's working set makes DeviceManager.try_reserve report "
+    "no headroom, which routes sort/join/aggregate through their "
+    "external (spill-backed) algorithms instead of split-retrying to "
+    "the row floor — bounded-HBM execution on data larger than device "
+    "memory.")
 HOST_SPILL_STORAGE = conf("spark.rapids.memory.host.spillStorageSize",
                           1073741824, "Host memory for spilled device data.")
 PINNED_POOL_SIZE = conf("spark.rapids.memory.pinnedPool.size", 0,
@@ -238,6 +249,55 @@ SPILL_CORRUPT_RATE = conf(
     "proving the disk re-read's integrity check surfaces "
     "SpillCorruptionError instead of deserializing garbage.  Seeded "
     "by faultInjection.seed.  0 disables.", internal=True)
+
+# --- out-of-core execution (memory/oocore.py) --------------------------------
+OOCORE_ENABLED = conf(
+    "spark.rapids.memory.oocore.enabled", True,
+    "Degrade gracefully to external algorithms when an operator's "
+    "working set exceeds the HBM budget's headroom: sort spills sorted "
+    "runs and k-way merges them back in budget-sized windows, hash "
+    "join grace-partitions the build AND probe sides by key hash and "
+    "joins partition pairs that fit, and hash aggregate spills partial "
+    "group state and re-merges it.  Runs travel the existing "
+    "device->host->disk spill tiers (every hop on the movement "
+    "ledger's spill edges).  OOM split-and-retry remains the inner "
+    "lattice; out-of-core is the outer ring engaged BEFORE the "
+    "retry.fallback path.  Off: the pre-out-of-core behavior (split "
+    "to minSplitRows, then bestEffort|error).")
+OOCORE_WINDOW_FRACTION = conf(
+    "spark.rapids.memory.oocore.windowFraction", 0.5,
+    "Fraction of the HBM budget one operator may hold resident before "
+    "degrading to its external algorithm — and the size of each merge "
+    "window when it does.  The working-set estimate is real "
+    "accounting (2x device batch bytes, the same estimate the OOM "
+    "harness reserves with) judged against try_reserve headroom, not "
+    "a guess.  Smaller values spill earlier and merge in more passes; "
+    "larger values risk the inner retry lattice engaging first.")
+OOCORE_GRACE_PARTITIONS = conf(
+    "spark.rapids.memory.oocore.gracePartitions", 8,
+    "Fan-out of one grace-hash partitioning pass: build and probe "
+    "sides split into this many key-hash partitions, each joined "
+    "independently (partition pairs are key-disjoint).  A partition "
+    "whose build side still exceeds the window re-partitions "
+    "recursively with a depth-salted hash, up to "
+    "oocore.maxRecursionDepth.")
+OOCORE_MAX_RECURSION = conf(
+    "spark.rapids.memory.oocore.maxRecursionDepth", 4,
+    "Bound on grace-hash re-partitioning recursion (and on external "
+    "sort/aggregate re-spill rounds).  A partition that cannot be "
+    "made to fit within this depth — pathological key skew, e.g. one "
+    "key carrying the whole build side — fails with a descriptive "
+    "error naming the skewed partition and the knobs, never a hang "
+    "and never partial data.")
+OOCORE_RUN_REPLICAS = conf(
+    "spark.rapids.memory.oocore.runReplicas", 1,
+    "Copies written per spilled run.  At 2+, a SpillCorruption on "
+    "re-read (disk rot, faultInjection.spillCorruptRate) quarantines "
+    "the corrupt buffer and recovers from a replica instead of "
+    "failing the query (numSpillCorruptionsRecovered counts these); "
+    "at 1 recovery needs a recompute closure or the corruption "
+    "surfaces as the descriptive SpillCorruption error.  Replicas "
+    "cost spill-tier capacity, not HBM.")
 
 # --- query profiles (utils/profile.py) ---------------------------------------
 PROFILE_ENABLED = conf(

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import logging
 from typing import Iterator, Optional, Sequence
 
 import jax
@@ -36,6 +37,8 @@ from spark_rapids_tpu.ops.sort_encode import (hash_sort_bounds,
                                               wide_key_set)
 from spark_rapids_tpu.utils import checks as CK
 from spark_rapids_tpu.utils import metrics as M
+
+log = logging.getLogger("spark_rapids_tpu.aggregate")
 
 
 class AggMode(enum.Enum):
@@ -1102,8 +1105,33 @@ class HashAggregateExec(UnaryExecBase):
             yield from self._reduction_path(batches)
             return
 
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.memory import oocore as OC
+        from spark_rapids_tpu.memory import retry as R
+        from spark_rapids_tpu.utils import profile as P
+        conf = C.get_active_conf()
         inter_fields = self._partial_schema()
         partials: list[ColumnarBatch] = []
+        pending_bytes = 0
+        runs: list = []
+        external = False
+        run_target = max(1, OC.window_bytes(conf) // OC.MERGE_FAN_IN)
+
+        def flush_state():
+            """Compact the pending partials to one batch of groups and
+            spill it through the host→disk tiers (merging partial agg
+            state is key-idempotent, so spilled blocks re-merge later
+            in any grouping)."""
+            nonlocal partials, pending_bytes
+            if not partials:
+                return
+            merged = partials[0] if len(partials) == 1 else \
+                self._merge_partials(partials, inter_fields)
+            runs.append(OC.spill_run(merged.dense(), label=self.name(),
+                                     metrics=self.metrics, conf=conf))
+            partials = []
+            pending_bytes = 0
+
         for batch in batches:
             if not batch.maybe_nonempty():
                 continue
@@ -1111,15 +1139,29 @@ class HashAggregateExec(UnaryExecBase):
                 # per-batch grouping is row-local, so halves from a
                 # split-and-retry simply land as extra partials for the
                 # merge below (this phase is a known OOM hotspot)
-                partials.extend(self.oom_retry_batches(
+                pieces = list(self.oom_retry_batches(
                     batch, self._groupby_one,
                     label=f"{self.name()}.groupBatch"))
+            partials.extend(pieces)
+            pending_bytes += sum(R.estimate_batch_bytes(p)
+                                 for p in pieces)
+            if not external and OC.should_go_external(pending_bytes,
+                                                      conf):
+                external = True
+                P.event(P.EV_OOCORE_DEGRADE, op=self.name(),
+                        est_bytes=pending_bytes, algo="agg-spill")
+            if external and pending_bytes > run_target:
+                flush_state()
 
-        if not partials:
+        if not partials and not runs:
             return
-        # concat + re-merge loop until a single batch of groups remains
-        merged = partials[0] if len(partials) == 1 else \
-            self._merge_partials(partials, inter_fields)
+        if runs:
+            flush_state()
+            merged = self._merge_spilled_state(runs, inter_fields, conf)
+        else:
+            # concat + re-merge loop until one batch of groups remains
+            merged = partials[0] if len(partials) == 1 else \
+                self._merge_partials(partials, inter_fields)
 
         if self.mode == AggMode.PARTIAL:
             out = merged
@@ -1183,6 +1225,69 @@ class HashAggregateExec(UnaryExecBase):
             self._merge_exec = me
         return me
 
+    def _merge_spilled_state(self, runs: list, inter_schema,
+                             conf) -> ColumnarBatch:
+        """Windowed re-merge of spilled partial-aggregation state: each
+        pass reads back window-sized groups of runs, merges each to one
+        compacted batch of groups, and re-spills until a single block
+        remains.  Bounded by `oocore.maxRecursionDepth` passes — past
+        it, a descriptive error, never a hang or partial data."""
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.memory import oocore as OC
+        from spark_rapids_tpu.memory.retry import TpuOutOfCoreError
+        from spark_rapids_tpu.utils import profile as P
+        from spark_rapids_tpu.utils import watchdog as W
+        window = OC.window_bytes(conf)
+        max_passes = max(1, int(conf[C.OOCORE_MAX_RECURSION]))
+        passes = 0
+        with W.heartbeat(f"{self.name()}.oocore-merge", kind="task",
+                         conf=conf) as hb:
+            while len(runs) > 1:
+                if passes >= max_passes:
+                    raise TpuOutOfCoreError(
+                        f"{self.name()}: spilled aggregation state "
+                        f"still spans {len(runs)} blocks after "
+                        f"{passes} merge passes "
+                        f"(spark.rapids.memory.oocore.maxRecursionDepth"
+                        f"={max_passes}) — raise the HBM budget or "
+                        f"oocore.windowFraction")
+                passes += 1
+                self.metrics.add(M.NUM_EXTERNAL_MERGE_PASSES, 1)
+                P.event(P.EV_OOCORE_MERGE_PASS, op=self.name(),
+                        num_runs=len(runs))
+                groups: list[list] = [[]]
+                group_bytes = 0
+                for r in runs:
+                    # 2x: payload + merge scratch; each group takes at
+                    # least 2 runs so every pass at least halves the
+                    # run count (the inner split-retry lattice absorbs
+                    # any window overshoot)
+                    if (len(groups[-1]) >= 2
+                            and group_bytes + 2 * r.nbytes > window):
+                        groups.append([])
+                        group_bytes = 0
+                    groups[-1].append(r)
+                    group_bytes += 2 * r.nbytes
+                next_runs = []
+                for group in groups:
+                    W.maybe_hang("oocore-merge", conf)
+                    batches = [r.read(self.metrics) for r in group]
+                    merged = batches[0] if len(batches) == 1 else \
+                        self._merge_partials(batches, inter_schema)
+                    for r in group:
+                        r.free()
+                    hb.beat()
+                    if len(groups) == 1:
+                        return merged  # final merge: no re-spill
+                    next_runs.append(OC.spill_run(
+                        merged.dense(), label=self.name(),
+                        metrics=self.metrics, conf=conf))
+                runs = next_runs
+        final = runs[0]
+        batch = final.read(self.metrics)
+        final.free()
+        return batch
+
     def _merge_partials(self, partials, inter_schema) -> ColumnarBatch:
         # sparse_ok: the merge kernel takes a deferred-selection mask,
         # so the concat can stay gather-free
@@ -1200,6 +1305,20 @@ class HashAggregateExec(UnaryExecBase):
             label=f"{self.name()}.mergePartials"))
         if len(outs) == 1:
             return outs[0]
+        if sum(o.num_rows for o in outs) >= merged.num_rows:
+            # split-retry made no progress: every split half still held
+            # (nearly) every group key, so re-merging the halves would
+            # ping-pong at the same row count forever under a sustained
+            # reservation failure (tiny hbmBudgetBytes).  Fall back to
+            # one unreserved best-effort merge of the whole state — the
+            # same escape hatch the split floor uses.
+            log.warning(
+                "%s.mergePartials: split-retry not converging "
+                "(%d rows -> %d across %d outputs); merging unreserved",
+                self.name(), merged.num_rows,
+                sum(o.num_rows for o in outs), len(outs))
+            whole = concat_batches(outs, sparse_ok=True)
+            return self._merge_one(merge_exec, whole, inter_schema)
         return self._merge_partials(outs, inter_schema)
 
     def _merge_one(self, merge_exec, merged, inter_schema

@@ -541,9 +541,11 @@ class HashJoinExec(TpuExec):
         return [None, RequireSingleBatch()] if not self._flip else \
             [RequireSingleBatch(), None]
 
-    def _build_batch(self) -> ColumnarBatch:
-        batches = [b.dense() for it in self._build.execute_partitions()
-                   for b in it if b.maybe_nonempty()]
+    def _collect_build_batches(self) -> list[ColumnarBatch]:
+        return [b.dense() for it in self._build.execute_partitions()
+                for b in it if b.maybe_nonempty()]
+
+    def _concat_build(self, batches: list[ColumnarBatch]) -> ColumnarBatch:
         if not batches:
             from spark_rapids_tpu.columnar.batch import empty_batch
             return empty_batch(self._build.output_schema())
@@ -558,6 +560,16 @@ class HashJoinExec(TpuExec):
                             out_bytes=nbytes, metrics=self.metrics,
                             label=f"{self.name()}.buildSide")
 
+    def _build_batch(self) -> ColumnarBatch:
+        return self._concat_build(self._collect_build_batches())
+
+    def _grace_candidate_batches(self) -> Optional[list[ColumnarBatch]]:
+        """Raw build batches when the grace-hash lane may apply, None
+        when the build side must be taken whole (broadcast)."""
+        if not self._build_keys or not self._probe_keys:
+            return None
+        return self._collect_build_batches()
+
     def _assemble(self, pout, bout, n) -> ColumnarBatch:
         """Order output columns as (left, right) regardless of probe side."""
         if self._flip:
@@ -567,12 +579,40 @@ class HashJoinExec(TpuExec):
         return ColumnarBatch(self._schema, cols, n)
 
     def execute_columnar(self) -> Iterator[ColumnarBatch]:
-        build = self._build_batch()
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.memory import oocore as OC
+        batches = self._grace_candidate_batches()
+        if batches is not None:
+            conf = C.get_active_conf()
+            est = 2 * sum(b.device_size_bytes() for b in batches)
+            if OC.should_go_external(est, conf):
+                from spark_rapids_tpu.utils import profile as P
+                P.event(P.EV_OOCORE_DEGRADE, op=self.name(),
+                        est_bytes=est, algo="grace-hash")
+                probe_src = (pb for it in self._probe.execute_partitions()
+                             for pb in it if pb.maybe_nonempty())
+                yield from self._grace_join(iter(batches), probe_src,
+                                            0, conf)
+                return
+            build = self._concat_build(batches)
+        else:
+            build = self._build_batch()
         if self._dense_qual:
             tab = self._try_dense_table(build)
             if tab is not None:
                 yield from self._execute_dense(build, tab)
                 return
+        probe_src = (pb for it in self._probe.execute_partitions()
+                     for pb in it)
+        yield from self._join_stream(build, probe_src)
+
+    def _join_stream(self, build: ColumnarBatch,
+                     probe_batches) -> Iterator[ColumnarBatch]:
+        """Sort-path join of one WHOLE build batch against a stream of
+        probe batches (the former execute_columnar body, factored out
+        so the grace-hash lane can run it once per key partition —
+        per-partition FULL_OUTER unmatched-build emission is sound
+        because key-hash partitions are key-disjoint)."""
         jt = self.join_type
         outer_probe = jt in (JoinType.LEFT_OUTER, JoinType.RIGHT_OUTER,
                              JoinType.FULL_OUTER)
@@ -614,24 +654,135 @@ class HashJoinExec(TpuExec):
                     out = self._apply_condition(out)
                 return out
 
-        for it in self._probe.execute_partitions():
-            for pb in it:
-                if not pb.maybe_nonempty():
-                    continue
-                # probe rows are independent given the fixed build side
-                # (FULL_OUTER's unmatched-build flags OR across pieces),
-                # so probe batches split-and-retry freely while the pair
-                # expansion's out_cap shrinks with each piece
-                for out in self.oom_retry_batches(
-                        pb, probe_one, label=f"{self.name()}.probe"):
-                    if out.num_rows > 0:
-                        self.update_output_metrics(out)
-                        yield out
+        for pb in probe_batches:
+            if not pb.maybe_nonempty():
+                continue
+            # probe rows are independent given the fixed build side
+            # (FULL_OUTER's unmatched-build flags OR across pieces),
+            # so probe batches split-and-retry freely while the pair
+            # expansion's out_cap shrinks with each piece
+            for out in self.oom_retry_batches(
+                    pb, probe_one, label=f"{self.name()}.probe"):
+                if out.num_rows > 0:
+                    self.update_output_metrics(out)
+                    yield out
         if jt == JoinType.FULL_OUTER:
             un = self._unmatched_build(build, bmatched_total)
             if un is not None and un.num_rows > 0:
                 self.update_output_metrics(un)
                 yield un
+
+    # -- grace-hash out-of-core lane ---------------------------------------
+    #: base seed for grace partition hashing — deliberately NOT Spark's
+    #: seed 42: an upstream HashPartitioning shuffle on the same keys
+    #: already bucketed rows by murmur3@42 pmod N, and re-hashing with
+    #: the same seed would correlate perfectly and collapse every grace
+    #: partition into one
+    _GRACE_SALT_BASE = 104729
+
+    def _grace_partition_side(self, batches, bound_keys, nparts: int,
+                              depth: int, side: str, conf) -> list[list]:
+        """Hash-partition one side of the join by its key columns and
+        spill every non-empty slice as an out-of-core run.  The salt is
+        a traced kernel argument (one compile serves every recursion
+        depth) that varies per depth, so keys that collided at depth d
+        scatter at depth d+1."""
+        from jax import lax
+        from spark_rapids_tpu.memory import oocore as OC
+        from spark_rapids_tpu.ops.murmur3 import murmur3_row_hash
+        from spark_rapids_tpu.shuffle.partitioning import (
+            _slice_partitions, _split_kernel_for)
+
+        def pid_fn(ctx, salt, extra):
+            keys = [e.eval(ctx) for e in bound_keys]
+            h = murmur3_row_hash(keys, seed=salt)
+            m = lax.rem(h, jnp.int32(nparts))
+            return jnp.where(m < 0, m + nparts, m)
+
+        salt = jnp.uint32(self._GRACE_SALT_BASE + depth)
+        parts: list[list] = [[] for _ in range(nparts)]
+        for batch in batches:
+            kern = _split_kernel_for(self._join_cache, batch, pid_fn,
+                                     nparts, ("grace", side))
+            cols, counts = kern(batch.columns, batch.num_rows_i32,
+                                salt, (), batch.sparse)
+            slices = _slice_partitions(cols, counts, batch.schema,
+                                       batch.capacity, batch.checks)
+            for p, s in enumerate(slices):
+                if s is None or not s.maybe_nonempty():
+                    continue
+                parts[p].append(OC.spill_run(
+                    s.dense(), label=self.name(),
+                    metrics=self.metrics, conf=conf))
+        return parts
+
+    def _read_runs(self, runs) -> Iterator[ColumnarBatch]:
+        for r in runs:
+            b = r.read(self.metrics)
+            r.free()
+            yield b
+
+    def _grace_join(self, build_src, probe_src, depth: int,
+                    conf) -> Iterator[ColumnarBatch]:
+        """Grace-hash join: partition BOTH sides by key hash into
+        spilled runs, join each partition pair that fits the HBM window
+        with the normal sort-path core, and recurse (new salt) on pairs
+        whose build side still does not fit.  Bounded by
+        `oocore.maxRecursionDepth` — irreducible key skew past it is a
+        descriptive error, never a hang and never partial data."""
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.memory import oocore as OC
+        from spark_rapids_tpu.memory.retry import TpuOutOfCoreError
+        from spark_rapids_tpu.utils import profile as P
+        from spark_rapids_tpu.utils import watchdog as W
+        jt = self.join_type
+        nparts = max(2, int(conf[C.OOCORE_GRACE_PARTITIONS]))
+        max_depth = max(1, int(conf[C.OOCORE_MAX_RECURSION]))
+        window = OC.window_bytes(conf)
+        self.metrics.add(M.NUM_GRACE_PARTITIONS, nparts)
+        P.event(P.EV_OOCORE_GRACE_PARTITION, op=self.name(),
+                num_partitions=nparts, depth=depth)
+        build_parts = self._grace_partition_side(
+            build_src, self._build_keys, nparts, depth, "build", conf)
+        probe_parts = self._grace_partition_side(
+            probe_src, self._probe_keys, nparts, depth, "probe", conf)
+        for p in range(nparts):
+            W.check_cancelled()
+            bruns, pruns = build_parts[p], probe_parts[p]
+            if not bruns and not pruns:
+                continue
+            if not pruns and jt != JoinType.FULL_OUTER:
+                # build rows with no probe rows only matter to
+                # FULL_OUTER's unmatched-build emission
+                for r in bruns:
+                    r.free()
+                continue
+            if not bruns and jt in (JoinType.INNER, JoinType.LEFT_SEMI):
+                for r in pruns:
+                    r.free()
+                continue
+            best = 2 * sum(r.meta.size_bytes for r in bruns)
+            if bruns and best > window:
+                if depth + 1 >= max_depth:
+                    raise TpuOutOfCoreError(
+                        f"{self.name()}: grace-hash build partition {p} "
+                        f"is still ~{best} bytes (window {window}) at "
+                        f"recursion depth {depth + 1} with "
+                        f"spark.rapids.memory.oocore.maxRecursionDepth="
+                        f"{max_depth} — the join key is too skewed to "
+                        f"partition further (one hot key larger than "
+                        f"the window); raise the HBM budget, "
+                        f"oocore.windowFraction, or maxRecursionDepth")
+                P.event(P.EV_OOCORE_RECURSE, op=self.name(),
+                        depth=depth + 1, partition=p)
+                yield from self._grace_join(
+                    self._read_runs(bruns), self._read_runs(pruns),
+                    depth + 1, conf)
+                continue
+            build_batches = [b.dense() for b in self._read_runs(bruns)]
+            build = self._concat_build(
+                [b for b in build_batches if b.maybe_nonempty()])
+            yield from self._join_stream(build, self._read_runs(pruns))
 
     def _apply_condition(self, batch: ColumnarBatch) -> ColumnarBatch:
         from spark_rapids_tpu.exec.basic import FilterExec, LocalBatchSource
@@ -684,6 +835,15 @@ class BroadcastHashJoinExec(HashJoinExec):
         if isinstance(self._build, BroadcastExchangeExec):
             return self._build.broadcast_batch()
         return super()._build_batch()
+
+    def _grace_candidate_batches(self) -> Optional[list[ColumnarBatch]]:
+        # a broadcast build side is already materialized whole (and
+        # shared across consumers) — grace repartitioning it here would
+        # not bound anything the broadcast did not already pay
+        from spark_rapids_tpu.shuffle.exchange import BroadcastExchangeExec
+        if isinstance(self._build, BroadcastExchangeExec):
+            return None
+        return super()._grace_candidate_batches()
 
 
 class NestedLoopJoinExec(TpuExec):

@@ -135,13 +135,143 @@ class SortExec(UnaryExecBase):
                           head: Optional[int] = None
                           ) -> Iterator[ColumnarBatch]:
         if self.global_sort:
-            from spark_rapids_tpu.exec.coalesce import coalesce_iterator
-            batches = coalesce_iterator(
-                batches, RequireSingleBatch(), self._schema, self.metrics)
+            yield from self._global_sort(batches, head)
+            return
         for batch in batches:
             out = self._sort_with_retry(batch, head)
             self.update_output_metrics(out)
             yield out
+
+    def _global_sort(self, batches,
+                     head: Optional[int]) -> Iterator[ColumnarBatch]:
+        """Global-sort lane with out-of-core degradation: stream the
+        child, and while the buffered working set fits the HBM window
+        keep the existing coalesce-to-one-batch path; once the
+        accounted estimate says it cannot fit (memory/oocore.py
+        `should_go_external`), switch to an external merge sort —
+        sorted runs spill through the host→disk tiers and k-way merge
+        back in window-sized groups, instead of split-retrying the
+        single giant batch down to the row floor and erroring."""
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.memory import oocore as OC
+        from spark_rapids_tpu.memory import retry as R
+        from spark_rapids_tpu.utils import profile as P
+        conf = C.get_active_conf()
+        pending: list[ColumnarBatch] = []
+        pending_bytes = 0
+        runs: list = []
+        external = False
+        # runs flush at window/fan-in so a merge group of MERGE_FAN_IN
+        # runs fits back inside the window
+        run_target = max(1, OC.window_bytes(conf) // OC.MERGE_FAN_IN)
+
+        def flush_run():
+            nonlocal pending, pending_bytes
+            if not pending:
+                return
+            from spark_rapids_tpu.columnar.batch import concat_batches
+            merged = (concat_batches([p.dense() for p in pending])
+                      if len(pending) > 1 else pending[0])
+            # head pruning per run is sound for top-N: each run's head
+            # is a superset of its contribution to the global head
+            sorted_b = self._sort_with_retry(merged, head)
+            runs.append(OC.spill_run(sorted_b, label=self.name(),
+                                     metrics=self.metrics, conf=conf))
+            pending = []
+            pending_bytes = 0
+
+        for batch in batches:
+            pending.append(batch)
+            pending_bytes += R.estimate_batch_bytes(batch)
+            if not external and OC.should_go_external(pending_bytes, conf):
+                external = True
+                P.event(P.EV_OOCORE_DEGRADE, op=self.name(),
+                        est_bytes=pending_bytes, algo="external-sort")
+            if external and pending_bytes > run_target:
+                flush_run()
+
+        if not external:
+            # working set fit: the original coalesce + one-shot sort
+            from spark_rapids_tpu.exec.coalesce import coalesce_iterator
+            for batch in coalesce_iterator(
+                    iter(pending), RequireSingleBatch(), self._schema,
+                    self.metrics):
+                out = self._sort_with_retry(batch, head)
+                self.update_output_metrics(out)
+                yield out
+            return
+
+        flush_run()
+        out = self._merge_spilled_runs(runs, head, conf)
+        self.update_output_metrics(out)
+        yield out
+
+    def _merge_spilled_runs(self, runs: list, head: Optional[int],
+                            conf) -> ColumnarBatch:
+        """Hierarchical merge of spilled sorted runs: each pass reads
+        back window-sized groups, merges each with one in-window sort
+        (the OOM split-retry lattice stays active inside), and
+        re-spills until one run remains.  Bounded by
+        `oocore.maxRecursionDepth` passes — past it, a descriptive
+        error, never a hang or partial data."""
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.columnar.batch import concat_batches
+        from spark_rapids_tpu.memory import oocore as OC
+        from spark_rapids_tpu.memory.retry import TpuOutOfCoreError
+        from spark_rapids_tpu.utils import profile as P
+        from spark_rapids_tpu.utils import watchdog as W
+        window = OC.window_bytes(conf)
+        max_passes = max(1, int(conf[C.OOCORE_MAX_RECURSION]))
+        passes = 0
+        with W.heartbeat(f"{self.name()}.oocore-merge", kind="task",
+                         conf=conf) as hb:
+            while len(runs) > 1:
+                if passes >= max_passes:
+                    raise TpuOutOfCoreError(
+                        f"{self.name()}: external sort still has "
+                        f"{len(runs)} runs after {passes} merge passes "
+                        f"(spark.rapids.memory.oocore.maxRecursionDepth"
+                        f"={max_passes}) — the merge window "
+                        f"({window} bytes) is too small for the run "
+                        f"count; raise the HBM budget or "
+                        f"oocore.windowFraction")
+                passes += 1
+                self.metrics.add(M.NUM_EXTERNAL_MERGE_PASSES, 1)
+                P.event(P.EV_OOCORE_MERGE_PASS, op=self.name(),
+                        num_runs=len(runs))
+                next_runs = []
+                pending_groups: list[list] = [[]]
+                group_bytes = 0
+                for r in runs:
+                    # 2x: serialized payload + sort scratch must both
+                    # fit the window.  A group always takes at least 2
+                    # runs (progress guarantee — every pass at least
+                    # halves the run count; the inner split-retry
+                    # lattice absorbs any window overshoot)
+                    if (len(pending_groups[-1]) >= 2
+                            and group_bytes + 2 * r.nbytes > window):
+                        pending_groups.append([])
+                        group_bytes = 0
+                    pending_groups[-1].append(r)
+                    group_bytes += 2 * r.nbytes
+                for group in pending_groups:
+                    W.maybe_hang("oocore-merge", conf)
+                    merged = concat_batches(
+                        [r.read(self.metrics).dense() for r in group])
+                    sorted_b = self._sort_with_retry(merged, head)
+                    for r in group:
+                        r.free()
+                    hb.beat()
+                    if len(pending_groups) == 1:
+                        return sorted_b  # final merge: no re-spill
+                    next_runs.append(OC.spill_run(
+                        sorted_b, label=self.name(),
+                        metrics=self.metrics, conf=conf))
+                runs = next_runs
+        final = runs[0]
+        batch = final.read(self.metrics)
+        final.free()
+        return batch
 
     def _sort_one_batch(self, batch: ColumnarBatch,
                         head: Optional[int]) -> ColumnarBatch:

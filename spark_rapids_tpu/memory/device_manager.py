@@ -93,6 +93,12 @@ class DeviceManager:
         reserve = conf[C.HBM_RESERVE]
         # pool arithmetic mirrors GpuDeviceManager.scala:159-196
         self.budget = max(0, int(total * frac) - reserve)
+        # conf-capped arena (out-of-core lever): hbmBudgetBytes caps
+        # the derived budget so try_reserve headroom — the signal the
+        # external sort/join/agg degradation reads — reflects the cap
+        cap = int(conf[C.HBM_BUDGET_BYTES])
+        if cap > 0:
+            self.budget = min(self.budget, cap)
         self.hbm_total = total
         self._store_bytes = 0
         self._reserved = 0

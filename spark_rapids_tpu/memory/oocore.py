@@ -1,0 +1,273 @@
+"""Out-of-core execution support: spilled runs + the degradation decision.
+
+ROADMAP item 6 (bounded-HBM graceful degradation): when an operator's
+working set cannot fit the conf-capped HBM budget
+(`spark.rapids.memory.hbmBudgetBytes`), sort / hash join / hash aggregate
+stop split-retrying toward the `minSplitRows` floor and switch to external
+algorithms that stream state through the existing device→host→disk spill
+tiers.  This module is the shared substrate those three lanes use:
+
+- `should_go_external(est_bytes)` — the degradation decision, driven by
+  real accounting: the per-operator window (`oocore.windowFraction` of
+  `DeviceManager.budget`) plus a live `try_reserve` probe, never a guess.
+- `spill_run(batch)` / `SpilledRun.read()` — one unit of spilled operator
+  state (a sorted run, a grace-hash partition piece, a merged partial-agg
+  block), serialized and pushed down the host→disk chain with optional
+  replicas, every hop landing on the movement ledger's spill edges.
+- Corruption recovery: a `SpillCorruption` on re-read quarantines the
+  poisoned file (provenance-logged), falls back to a replica if one was
+  written, else to a bounded recompute closure if the producer supplied
+  one — and only then fails, descriptively (satellite: a corrupt spill
+  re-read must not kill the query when a recovery path exists).
+
+Theseus (PAPERS.md) frames the design: an accelerator engine's scalability
+story is how it degrades past device memory, not how fast it runs inside
+it.  The reference stack's analog rails are RapidsBufferStore spill
+chaining + RmmRapidsRetryIterator; here out-of-core is the OUTER ring
+around the OOM split-retry lattice — retry shrinks batches inside the
+window, oocore bounds how much state is in the window at all.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.columnar.serde import deserialize_batch, serialize_batch
+from spark_rapids_tpu.memory.buffer import BufferId, TableMeta
+from spark_rapids_tpu.memory.stores import SpillCorruption
+from spark_rapids_tpu.utils import metrics as M
+from spark_rapids_tpu.utils import movement as MV
+from spark_rapids_tpu.utils import profile as P
+
+log = logging.getLogger("spark_rapids_tpu.oocore")
+
+#: movement-ledger site prefix for out-of-core run traffic, so the
+#: reconciliation tests can split oocore spill bytes from pressure-spill
+#: bytes sharing the same EDGE_SPILL edge
+SITE_PREFIX = "oocore:"
+
+#: external-sort merge fan-in target: runs flush at window/MERGE_FAN_IN
+#: so one merge group of this many runs fits back inside the window —
+#: maxRecursionDepth merge passes then cover MERGE_FAN_IN**depth runs
+MERGE_FAN_IN = 8
+
+# process-wide run accounting (the SpillCallback.bytes_spilled analog
+# for the out-of-core lane): the second leg of the three-way
+# reconciliation — movement-ledger oocore spill edges == this counter
+# == the per-node spillRunBytes metric sums
+_ACCT_LOCK = threading.Lock()
+_RUN_BYTES = [0]
+_RUN_COUNT = [0]
+
+
+def reset_run_accounting() -> None:
+    with _ACCT_LOCK:
+        _RUN_BYTES[0] = 0
+        _RUN_COUNT[0] = 0
+
+
+def run_bytes_spilled() -> int:
+    """Serialized bytes written as out-of-core runs process-wide
+    (replica copies included) since the last reset."""
+    with _ACCT_LOCK:
+        return _RUN_BYTES[0]
+
+
+def runs_spilled() -> int:
+    with _ACCT_LOCK:
+        return _RUN_COUNT[0]
+
+
+# ---------------------------------------------------------------------------
+# degradation decision
+def window_bytes(conf: Optional[C.RapidsConf] = None,
+                 dm=None) -> int:
+    """Bytes one operator may hold in HBM at a time: the working window
+    external sort/join/agg size their runs, merge fan-ins, and grace
+    partitions against."""
+    conf = conf or C.get_active_conf()
+    if dm is None:
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
+        dm = DeviceManager.peek()
+    if dm is None:
+        return 1 << 62  # no device manager: effectively unbounded
+    frac = float(conf[C.OOCORE_WINDOW_FRACTION])
+    return max(1, int(dm.budget * frac))
+
+
+def should_go_external(est_bytes: int,
+                       conf: Optional[C.RapidsConf] = None,
+                       dm=None) -> bool:
+    """The degradation decision.  True when `est_bytes` of operator
+    working set should stream through the spill tiers instead of
+    materializing in HBM.  Two gates, both from real accounting:
+
+    1. the estimate exceeds the per-operator window (windowFraction of
+       the conf-capped `DeviceManager.budget`), and
+    2. a live `try_reserve` probe confirms the arena really has no
+       headroom for it right now — a generous arena with idle budget
+       does not degrade on a pessimistic estimate.
+    """
+    conf = conf or C.get_active_conf()
+    if not bool(conf[C.OOCORE_ENABLED]):
+        return False
+    if dm is None:
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
+        dm = DeviceManager.peek()
+    if dm is None:
+        return False
+    if est_bytes <= window_bytes(conf, dm):
+        return False
+    if dm.try_reserve(est_bytes):
+        dm.release_reservation(est_bytes)
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# spilled runs
+class SpilledRun:
+    """Handle to one unit of spilled operator state: the primary copy
+    plus any replicas, all registered in the buffer catalog and resident
+    at whatever tier (host arena, falling through to disk) took them."""
+
+    __slots__ = ("bids", "meta", "nbytes", "num_rows", "label",
+                 "recompute", "_freed")
+
+    def __init__(self, bids: list[BufferId], meta: TableMeta, nbytes: int,
+                 num_rows: int, label: str,
+                 recompute: Optional[Callable[[], ColumnarBatch]]):
+        self.bids = bids
+        self.meta = meta
+        #: serialized size of ONE copy (what a merge window budgets for)
+        self.nbytes = nbytes
+        self.num_rows = num_rows
+        self.label = label
+        self.recompute = recompute
+        self._freed = False
+
+    def read(self, metrics=None) -> ColumnarBatch:
+        """Materialize the run back to a device batch, recovering from
+        spill corruption via replicas / recompute (see module doc)."""
+        from spark_rapids_tpu.memory.env import ResourceEnv
+        env = ResourceEnv.get()
+        corrupt = 0
+        for i, bid in enumerate(self.bids):
+            if not env.catalog.is_registered(bid):
+                continue  # quarantined by an earlier read of this run
+            try:
+                with env.catalog.acquired(bid) as buf:
+                    batch = buf.get_columnar_batch()
+                if corrupt and metrics is not None:
+                    metrics.add(M.NUM_SPILL_CORRUPTIONS_RECOVERED, 1)
+                if corrupt:
+                    P.event(P.EV_OOCORE_CORRUPT_RECOVERED,
+                            op=self.label, via=f"replica{i}")
+                return batch
+            except SpillCorruption as e:
+                corrupt += 1
+                self._quarantine(env, bid, e)
+        if self.recompute is not None:
+            batch = self.recompute()
+            if corrupt:
+                if metrics is not None:
+                    metrics.add(M.NUM_SPILL_CORRUPTIONS_RECOVERED, 1)
+                P.event(P.EV_OOCORE_CORRUPT_RECOVERED,
+                        op=self.label, via="recompute")
+            return batch
+        raise SpillCorruption(
+            f"out-of-core run {self.label} ({self.num_rows} rows, "
+            f"{self.nbytes} bytes) unreadable: all {len(self.bids)} "
+            f"cop{'ies' if len(self.bids) > 1 else 'y'} failed CRC "
+            f"verification and no recompute lineage is available — "
+            f"raise spark.rapids.memory.oocore.runReplicas to keep a "
+            f"redundant copy of spilled runs")
+
+    def _quarantine(self, env, bid: BufferId, err: Exception) -> None:
+        """Provenance-logged quarantine of a corrupt copy: the poisoned
+        file is set aside (never unlinked, never re-readable) and the
+        buffer leaves the catalog."""
+        from spark_rapids_tpu.utils import residency as RES
+        site = RES.buffer_site(bid)
+        qpath = None
+        if hasattr(env.disk_store, "quarantine"):
+            qpath = env.disk_store.quarantine(bid)
+        if qpath is None:
+            env.catalog.remove(bid)  # not at disk tier: just drop it
+        log.warning(
+            "quarantined corrupt spill of out-of-core run %s "
+            "(buffer %s, provenance %s) at %s: %s",
+            self.label, bid, site, qpath, err)
+        P.event(P.EV_OOCORE_CORRUPT_QUARANTINE, op=self.label,
+                site=site, path=str(qpath))
+
+    def free(self) -> None:
+        """Drop every copy from whatever tier holds it (and its spill
+        file, for disk-resident copies)."""
+        if self._freed:
+            return
+        self._freed = True
+        from spark_rapids_tpu.memory.env import ResourceEnv
+        env = ResourceEnv.peek()
+        if env is None:
+            return
+        for bid in self.bids:
+            env.catalog.remove(bid)
+
+
+def spill_run(batch: ColumnarBatch, *, label: str, metrics=None,
+              conf: Optional[C.RapidsConf] = None,
+              recompute: Optional[Callable[[], ColumnarBatch]] = None
+              ) -> SpilledRun:
+    """Serialize `batch` and push it down the host→disk spill chain as
+    one out-of-core run (plus `oocore.runReplicas - 1` replica copies).
+    Records one movement-ledger spill edge per copy (site
+    `oocore:device->host|disk`) and charges the exec's `spillRunBytes`.
+    """
+    from spark_rapids_tpu.memory.buffer import meta_for_batch
+    from spark_rapids_tpu.memory.env import ResourceEnv
+    conf = conf or C.get_active_conf()
+    env = ResourceEnv.get()
+    blob = serialize_batch(batch)
+    meta = meta_for_batch(batch)
+    copies = max(1, int(conf[C.OOCORE_RUN_REPLICAS]))
+    bids = []
+    for _ in range(copies):
+        bid = BufferId(env.catalog.next_table_id())
+        t0 = time.perf_counter_ns()
+        # spill_priority 0 keeps runs ahead of hot shuffle buffers in
+        # the host arena's eviction order — they are cold by design
+        buf = env.host_store.add_blob(bid, blob, meta, spill_priority=0.0)
+        # add_blob records no ledger edge (shuffle receives reuse it);
+        # an out-of-core run IS a spill hop — record the hop that
+        # actually happened, host or fell-through-to-disk
+        if MV.ledger() is not None:
+            MV.record(MV.EDGE_SPILL, len(blob),
+                      site=f"{SITE_PREFIX}device->{buf.tier.name.lower()}",
+                      raw_bytes=len(blob),
+                      dur_ns=time.perf_counter_ns() - t0)
+        bids.append(bid)
+        with _ACCT_LOCK:
+            _RUN_BYTES[0] += len(blob)
+            _RUN_COUNT[0] += 1
+        if metrics is not None:
+            metrics.add(M.SPILL_RUN_BYTES, len(blob))
+    P.event(P.EV_OOCORE_SPILL_RUN, op=label, nbytes=len(blob) * copies,
+            rows=batch.num_rows, copies=copies)
+    return SpilledRun(bids, meta, len(blob), batch.num_rows, label,
+                      recompute)
+
+
+def read_run(run: SpilledRun, metrics=None) -> ColumnarBatch:
+    return run.read(metrics)
+
+
+__all__ = [
+    "SpilledRun", "spill_run", "read_run", "should_go_external",
+    "window_bytes", "run_bytes_spilled", "runs_spilled",
+    "reset_run_accounting", "deserialize_batch",
+]

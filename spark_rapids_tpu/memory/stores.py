@@ -425,8 +425,16 @@ class DiskBuffer(SpillableBuffer):
         super().free()
         try:
             os.unlink(self._path)
-        except OSError:
+        except FileNotFoundError:
             pass
+        except OSError:
+            # teardown race (directory concurrently swept, file still
+            # mapped, ...): hand the path to the owning store so
+            # close() retries the unlink instead of leaking the spill
+            # file on disk forever
+            store = self.store
+            if store is not None and hasattr(store, "_note_orphan"):
+                store._note_orphan(self._path)
 
     @property
     def size_bytes(self) -> int:
@@ -444,6 +452,65 @@ class DiskStore(BufferStore):
                  catalog=None):
         super().__init__(catalog)
         self.block_manager = block_manager or DiskBlockManager()
+        #: unlink-failed paths from freed buffers (teardown races) —
+        #: close() retries these so nothing leaks on disk
+        self._orphans: set[str] = set()
+        #: corrupt spill files set aside by quarantine(): preserved
+        #: for triage until close(), never re-readable as data
+        self._quarantined: set[str] = set()
+
+    def _note_orphan(self, path: str) -> None:
+        with self._lock:
+            self._orphans.add(path)
+
+    def quarantine(self, bid: BufferId) -> Optional[str]:
+        """Corrupt-spill handling (memory/oocore.py): pull the buffer
+        out of the store and rename its file to `*.quarantined`, so
+        the poisoned bytes survive for triage but can never be
+        re-read as data.  Returns the quarantined path, or None when
+        the buffer is not resident at this tier."""
+        with self._lock:
+            buf = self._buffers.pop(bid, None)
+            if buf is None:
+                return None
+            self.current_size -= buf.size_bytes
+            h = getattr(buf, "_spill_handle", None)
+            if h is not None:
+                self._spill_queue.remove(h)
+                self._handle_of.pop(h, None)
+        qpath = buf._path + ".quarantined"
+        try:
+            os.replace(buf._path, qpath)
+        except OSError:
+            qpath = buf._path  # rename failed: track the original
+        with self._lock:
+            self._quarantined.add(qpath)
+        # mark closed WITHOUT DiskBuffer.free()'s unlink — the
+        # quarantined file must survive until close()
+        SpillableBuffer.free(buf)
+        RES.retire(getattr(buf, "_res_token", None))
+        buf._res_token = None
+        if self.catalog is not None:
+            self.catalog.unregister(bid)
+        return qpath
+
+    def orphaned_spill_files(self) -> list[str]:
+        """Spill files in the block manager's directory that no live
+        buffer owns and that are not quarantined — freed-buffer unlink
+        leaks.  The teardown leak checks assert this is empty."""
+        with self._lock:
+            owned = {b._path for b in self._buffers.values()}
+            quarantined = set(self._quarantined)
+        try:
+            names = os.listdir(self.block_manager.root)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            p = os.path.join(self.block_manager.root, name)
+            if p not in owned and p not in quarantined:
+                out.append(p)
+        return sorted(out)
 
     def copy_buffer(self, buf: SpillableBuffer) -> SpillableBuffer:
         return self.add_blob(buf.id, buf.get_host_bytes(), buf.meta,
@@ -466,4 +533,17 @@ class DiskStore(BufferStore):
 
     def close(self) -> None:
         super().close()
+        # explicitly drain quarantined + orphaned files: cleanup()'s
+        # ignore_errors rmtree used to hide these leaks — now the
+        # directory is emptied file-by-file first, so a post-close
+        # scan (or a failed rmtree) can prove it really drained
+        with self._lock:
+            leftovers = self._orphans | self._quarantined
+            self._orphans.clear()
+            self._quarantined.clear()
+        for p in leftovers | set(self.orphaned_spill_files()):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
         self.block_manager.cleanup()

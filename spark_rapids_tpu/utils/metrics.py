@@ -24,6 +24,17 @@ NUM_SPLIT_RETRIES = "numSplitRetries"
 NUM_OOM_FALLBACKS = "numOomFallbacks"
 SPILL_BYTES = "spillBytes"
 RETRY_BLOCK_TIME = "retryBlockTime"
+# out-of-core lane (memory/oocore.py): spillRunBytes is the serialized
+# bytes an exec pushed through the spill tiers as sorted-run / grace
+# partition / partial-agg state, numExternalMergePasses counts windowed
+# merge/re-merge rounds, numGracePartitions the hash-partition fan-outs
+# (summed across recursion depths), numSpillCorruptionsRecovered the
+# corrupt spill re-reads that recovered from a replica or recompute
+# instead of failing the query
+SPILL_RUN_BYTES = "spillRunBytes"
+NUM_EXTERNAL_MERGE_PASSES = "numExternalMergePasses"
+NUM_GRACE_PARTITIONS = "numGracePartitions"
+NUM_SPILL_CORRUPTIONS_RECOVERED = "numSpillCorruptionsRecovered"
 # async pipeline layer (exec/pipeline.py PrefetchIterator): hostSyncs is
 # the number of blocking device->host readbacks charged to an exec,
 # pipelineWaitTime the ns a consumer spent blocked on an empty prefetch

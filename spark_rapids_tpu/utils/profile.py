@@ -105,6 +105,13 @@ EV_WATCHDOG_TIMEOUT = "watchdog_timeout"
 EV_DATA_MOVEMENT = "data_movement"          # utils/movement.py
 EV_RESIDENCY_LEAK = "residency_leak"        # utils/residency.py
 EV_TELEMETRY_SNAPSHOT = "telemetry_snapshot"  # utils/telemetry.py (JSONL)
+EV_OOCORE_DEGRADE = "oocore_degrade"        # memory/oocore.py: operator
+EV_OOCORE_SPILL_RUN = "oocore_spill_run"    # left the in-core lane
+EV_OOCORE_MERGE_PASS = "oocore_merge_pass"
+EV_OOCORE_GRACE_PARTITION = "oocore_grace_partition"
+EV_OOCORE_RECURSE = "oocore_recurse"
+EV_OOCORE_CORRUPT_QUARANTINE = "oocore_corrupt_quarantine"
+EV_OOCORE_CORRUPT_RECOVERED = "oocore_corrupt_recovered"
 
 EVENT_KINDS = frozenset(
     v for k, v in list(globals().items()) if k.startswith("EV_"))
@@ -671,7 +678,8 @@ class QueryProfile:
                  kernel_samples: Optional[list] = None,
                  kernel_top_n: int = 12,
                  residency: Optional[dict] = None,
-                 residency_samples: Optional[list] = None):
+                 residency_samples: Optional[list] = None,
+                 oocore: Optional[dict] = None):
         self.query_id = query_id
         self.wall_start = wall_start
         self.wall_s = wall_s
@@ -703,6 +711,11 @@ class QueryProfile:
         #: (ts_ns, site, site_bytes, total_bytes) samples backing the
         #: Perfetto residency:<site> counter tracks
         self.residency_samples = residency_samples or []
+        #: out-of-core execution summary (memory/oocore.py EV_OOCORE_*
+        #: events rolled up): runs/bytes spilled, merge passes, grace
+        #: partitions, recursion depth, corruption recoveries per
+        #: operator; None when no operator degraded out of core
+        self.oocore = oocore
 
     # -- construction --------------------------------------------------------
     @classmethod
@@ -748,6 +761,11 @@ class QueryProfile:
                 res_samples = tr.residency.samples()
             except Exception:  # noqa: BLE001 — same guard again
                 residency = None
+        oocore = None
+        try:
+            oocore = cls._oocore_summary(tr.events())
+        except Exception:  # noqa: BLE001 — same guard again
+            oocore = None
         return cls(tr.query_id, tr.wall_start, wall_s,
                    spans, tr.events(), report,
                    cls._breakdown(spans, tr.root),
@@ -755,7 +773,60 @@ class QueryProfile:
                    movement=movement, movement_samples=samples,
                    kernels=kernels, kernel_samples=kernel_samples,
                    kernel_top_n=max(1, int(tr.conf[C.KERNELPROF_TOP_N])),
-                   residency=residency, residency_samples=res_samples)
+                   residency=residency, residency_samples=res_samples,
+                   oocore=oocore)
+
+    @staticmethod
+    def _oocore_summary(events: list[dict]) -> Optional[dict]:
+        """Roll the EV_OOCORE_* stream up into the '-- out-of-core --'
+        section: per-operator spilled runs/bytes, merge passes, grace
+        fan-outs, max recursion depth, corruption quarantines and
+        recoveries.  None when nothing degraded (the common case — the
+        section only prints when out-of-core execution actually ran)."""
+        per_op: dict[str, dict] = {}
+        totals = {"spill_runs": 0, "spill_run_bytes": 0,
+                  "merge_passes": 0, "grace_partitions": 0,
+                  "max_recursion_depth": 0,
+                  "corrupt_quarantined": 0, "corrupt_recovered": 0}
+
+        def op(rec):
+            name = rec.get("op", "?")
+            return per_op.setdefault(name, {
+                "spill_runs": 0, "spill_run_bytes": 0, "merge_passes": 0,
+                "grace_partitions": 0, "max_recursion_depth": 0,
+                "corrupt_quarantined": 0, "corrupt_recovered": 0})
+
+        for rec in events:
+            kind = rec.get("kind")
+            if kind == EV_OOCORE_SPILL_RUN:
+                row = op(rec)
+                row["spill_runs"] += 1
+                row["spill_run_bytes"] += int(rec.get("nbytes", 0))
+                totals["spill_runs"] += 1
+                totals["spill_run_bytes"] += int(rec.get("nbytes", 0))
+            elif kind == EV_OOCORE_MERGE_PASS:
+                op(rec)["merge_passes"] += 1
+                totals["merge_passes"] += 1
+            elif kind == EV_OOCORE_GRACE_PARTITION:
+                n = int(rec.get("num_partitions", 0))
+                op(rec)["grace_partitions"] += n
+                totals["grace_partitions"] += n
+            elif kind == EV_OOCORE_RECURSE:
+                d = int(rec.get("depth", 0))
+                row = op(rec)
+                row["max_recursion_depth"] = max(
+                    row["max_recursion_depth"], d)
+                totals["max_recursion_depth"] = max(
+                    totals["max_recursion_depth"], d)
+            elif kind == EV_OOCORE_CORRUPT_QUARANTINE:
+                op(rec)["corrupt_quarantined"] += 1
+                totals["corrupt_quarantined"] += 1
+            elif kind == EV_OOCORE_CORRUPT_RECOVERED:
+                op(rec)["corrupt_recovered"] += 1
+                totals["corrupt_recovered"] += 1
+        if not per_op:
+            return None
+        return {"operators": per_op, "totals": totals}
 
     @staticmethod
     def _breakdown(spans: list[Span], root: Optional[Span]) -> dict:
@@ -890,6 +961,25 @@ class QueryProfile:
             from spark_rapids_tpu.utils import residency as RS
             lines.append("-- residency --")
             lines.append(RS.format_report(self.residency))
+        if self.oocore is not None:
+            lines.append("-- out-of-core --")
+            t = self.oocore["totals"]
+            lines.append(
+                f"  total: {t['spill_runs']} runs "
+                f"({t['spill_run_bytes'] / 1e6:.1f} MB spilled), "
+                f"{t['merge_passes']} merge passes, "
+                f"{t['grace_partitions']} grace partitions "
+                f"(max depth {t['max_recursion_depth']}), "
+                f"{t['corrupt_recovered']}/{t['corrupt_quarantined']} "
+                f"corrupt reads recovered")
+            for name, row in sorted(self.oocore["operators"].items()):
+                lines.append(
+                    f"  {name}: runs={row['spill_runs']} "
+                    f"bytes={row['spill_run_bytes']} "
+                    f"merges={row['merge_passes']} "
+                    f"grace={row['grace_partitions']} "
+                    f"depth={row['max_recursion_depth']} "
+                    f"recovered={row['corrupt_recovered']}")
         return "\n".join(lines)
 
     # -- sinks ---------------------------------------------------------------

@@ -1,0 +1,566 @@
+"""Out-of-core graceful degradation suite (memory/oocore.py, ISSUE 16).
+
+The contract: when the conf-capped HBM budget
+(`spark.rapids.memory.hbmBudgetBytes`) cannot hold an operator's
+working set, sort / hash join / hash aggregate degrade to external
+algorithms that stream runs through the device→host→disk spill tiers —
+bit-exact vs the unconstrained lane, every spill hop on the movement
+ledger, watchdog deadlines covering the merge passes, corruption on
+re-read recovered via replicas / recompute (quarantining the poisoned
+file), and a descriptive `TpuOutOfCoreError` (never a hang, never
+partial data) when recursion bounds are exhausted.
+"""
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar.batch import ColumnarBatch
+from spark_rapids_tpu.exec.aggregate import HashAggregateExec
+from spark_rapids_tpu.exec.basic import LocalBatchSource
+from spark_rapids_tpu.exec.joins import HashJoinExec, JoinType
+from spark_rapids_tpu.exec.sort import SortExec, asc, desc
+from spark_rapids_tpu.exprs.aggregates import Count, Sum
+from spark_rapids_tpu.exprs.base import col
+from spark_rapids_tpu.memory import ResourceEnv
+from spark_rapids_tpu.memory import oocore as OC
+from spark_rapids_tpu.memory import retry as R
+from spark_rapids_tpu.memory import stores as ST
+from spark_rapids_tpu.utils import metrics as M
+from spark_rapids_tpu.utils import movement as MV
+from spark_rapids_tpu.utils import profile as P
+from spark_rapids_tpu.utils import watchdog as W
+from tests.parity import norm_frame
+
+#: a real (uncapped) device budget for the simulated arena — big
+#: enough that the UNCONSTRAINED baseline lane never degrades
+HBM_TOTAL = 1 << 26
+
+
+class _Env:
+    """One bounded-HBM ResourceEnv: active conf with the budget cap +
+    injection knobs, fresh injection/accounting state, and teardown
+    that proves nothing leaked."""
+
+    def __init__(self, tmp_path, name, cap=0, host_spill=1 << 22,
+                 **extra):
+        keys = {C.HBM_ALLOC_FRACTION.key: 1.0, C.HBM_RESERVE.key: 0,
+                C.HOST_SPILL_STORAGE.key: host_spill,
+                C.CONCURRENT_TPU_TASKS.key: 1}
+        if cap:
+            keys[C.HBM_BUDGET_BYTES.key] = cap
+        keys.update(extra)
+        self.conf = C.RapidsConf(keys)
+        C.set_active_conf(self.conf)
+        self.env = ResourceEnv.init(hbm_total=HBM_TOTAL,
+                                    spill_dir=str(tmp_path / name))
+        R.reset_oom_injection()
+        ST.reset_spill_corruption()
+        OC.reset_run_accounting()
+        W.reset_hang_injection()
+        W.begin_query()
+
+    def __enter__(self):
+        return self
+
+    def run(self, plan):
+        with C.session(self.conf):
+            return plan.collect().to_pandas()
+
+    def assert_clean(self):
+        """Zero leaked buffers / admissions / reservations / spill
+        files after a successful run."""
+        env, dm = self.env, self.env.device_manager
+        assert len(env.catalog) == 0, \
+            f"leaked buffers: {list(env.catalog.ids())}"
+        assert dm.admissions() == {}, dm.admissions()
+        assert dm.reserved_bytes == 0
+        assert env.disk_store.orphaned_spill_files() == []
+
+    def __exit__(self, *exc):
+        ResourceEnv.shutdown()
+        C.set_active_conf(C.RapidsConf())
+        W.reset_hang_injection()
+        W.begin_query()
+        return False
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    yield
+    ResourceEnv.shutdown()
+    C.set_active_conf(C.RapidsConf())
+    W.reset_hang_injection()
+    W.begin_query()
+
+
+def _batches(df, nb):
+    n = len(df)
+    step = -(-n // nb)
+    return LocalBatchSource([[ColumnarBatch.from_pandas(
+        df.iloc[i:i + step].reset_index(drop=True))
+        for i in range(0, n, step)]])
+
+
+def _tree_metric(exec_, name):
+    total = exec_.metrics.value(name)
+    for ch in exec_.children:
+        total += _tree_metric(ch, name)
+    return total
+
+
+def _assert_bit_exact(expected, got, label):
+    pd.testing.assert_frame_equal(norm_frame(expected), norm_frame(got),
+                                  check_exact=True, obj=label)
+
+
+# -- plan builders ----------------------------------------------------------
+def _orders(seed=5, n=5000):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "x": rng.integers(-500, 500, n).astype(np.int64),
+        "y": rng.integers(0, 1_000_000, n).astype(np.int64)})
+
+
+def _sort_plan(df, nb=8):
+    return SortExec([asc(col("x")), desc(col("y"))], _batches(df, nb))
+
+
+def _sales(seed=3, n=4000, nkeys=600):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame({
+        "k": rng.integers(0, nkeys, n).astype(np.int64),
+        "v": rng.integers(-1000, 1000, n).astype(np.int64)})
+
+
+def _agg_plan(df, nb=6):
+    return HashAggregateExec(
+        [col("k")], [Sum(col("v")).alias("s"), Count(col("v")).alias("c")],
+        _batches(df, nb))
+
+
+def _join_frames(seed=3, n=1000, m=200):
+    rng = np.random.default_rng(seed)
+    left = pd.DataFrame({
+        "k": rng.integers(0, m, n).astype(np.int64),
+        "v": rng.integers(-1000, 1000, n).astype(np.int64)})
+    # duplicate build keys: disqualifies the dense-table fast path so
+    # the sort-path core (the one the grace lane wraps) runs
+    right = pd.DataFrame({
+        "rk": rng.integers(0, m // 2, m).astype(np.int64),
+        "w": rng.integers(0, 100, m).astype(np.int64)})
+    return left, right
+
+
+def _join_plan(left, right, jt=JoinType.INNER, nb=4):
+    return HashJoinExec(jt, [col("k")], [col("rk")], _batches(left, nb),
+                        LocalBatchSource.from_pandas(right,
+                                                     num_partitions=2))
+
+
+def _baseline(tmp_path, plan_fn):
+    with _Env(tmp_path, "base") as e:
+        out = e.run(plan_fn())
+        e.assert_clean()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# external merge sort
+def test_external_sort_bit_exact_across_budget_ladder(tmp_path):
+    """Tightening the HBM budget walks the sort from one external
+    flush+merge to multiple hierarchical passes — bit-exact at every
+    rung, with the spill-run metrics proving the degradation ran."""
+    df = _orders()
+    base = _baseline(tmp_path, lambda: _sort_plan(df))
+    passes_at = {}
+    for cap in (1 << 17, 1 << 15):
+        with _Env(tmp_path, f"cap{cap}", cap=cap) as e:
+            plan = _sort_plan(df)
+            got = e.run(plan)
+            _assert_bit_exact(base, got, f"external sort @cap={cap}")
+            assert OC.runs_spilled() > 0
+            assert _tree_metric(plan, M.SPILL_RUN_BYTES) == \
+                OC.run_bytes_spilled()
+            passes_at[cap] = _tree_metric(plan,
+                                          M.NUM_EXTERNAL_MERGE_PASSES)
+            assert passes_at[cap] >= 1, \
+                f"cap={cap} never entered the external merge"
+            e.assert_clean()
+    # a tighter window means smaller runs and more hierarchical passes
+    assert passes_at[1 << 15] > passes_at[1 << 17], passes_at
+
+
+def test_sort_stays_in_core_when_budget_fits(tmp_path):
+    """A budget with headroom must not degrade: the live try_reserve
+    probe keeps the in-core lane even above the window heuristic."""
+    df = _orders(n=2000)
+    base = _baseline(tmp_path, lambda: _sort_plan(df))
+    with _Env(tmp_path, "fit", cap=1 << 24) as e:
+        plan = _sort_plan(df)
+        got = e.run(plan)
+        _assert_bit_exact(base, got, "in-core sort under loose cap")
+        assert OC.runs_spilled() == 0
+        assert _tree_metric(plan, M.NUM_EXTERNAL_MERGE_PASSES) == 0
+        e.assert_clean()
+
+
+def test_external_sort_exhausted_passes_raise_descriptive(tmp_path):
+    """Merge passes are bounded by oocore.maxRecursionDepth: past it, a
+    TpuOutOfCoreError naming the knobs — never a hang."""
+    df = _orders()
+    with _Env(tmp_path, "exh", cap=1 << 15,
+              **{C.OOCORE_MAX_RECURSION.key: 1}) as e:
+        with pytest.raises(R.TpuOutOfCoreError,
+                           match="maxRecursionDepth"):
+            e.run(_sort_plan(df))
+
+
+# ---------------------------------------------------------------------------
+# grace-hash join
+@pytest.mark.parametrize("jt", [JoinType.INNER, JoinType.FULL_OUTER])
+def test_grace_join_bit_exact(tmp_path, jt):
+    """Build side over budget: partition both sides by (salted) key
+    hash, join each pair in-window — bit-exact, including FULL_OUTER's
+    unmatched emission (sound because partitions are key-disjoint)."""
+    left, right = _join_frames()
+    base = _baseline(tmp_path, lambda: _join_plan(left, right, jt))
+    with _Env(tmp_path, "grace", cap=1 << 13) as e:
+        plan = _join_plan(left, right, jt)
+        got = e.run(plan)
+        _assert_bit_exact(base, got, f"grace {jt.name} join")
+        assert _tree_metric(plan, M.NUM_GRACE_PARTITIONS) > 0
+        assert OC.runs_spilled() > 0
+        e.assert_clean()
+
+
+def test_grace_join_recurses_on_oversized_partitions(tmp_path):
+    """Grace partitions that still overflow the window recurse with a
+    fresh salt: the partition metric exceeds one level's fan-out and
+    the result stays bit-exact."""
+    left, right = _join_frames()
+    base = _baseline(tmp_path, lambda: _join_plan(left, right))
+    with _Env(tmp_path, "grrec", cap=1 << 13) as e:
+        plan = _join_plan(left, right)
+        got = e.run(plan)
+        _assert_bit_exact(base, got, "recursive grace join")
+        nparts = int(e.conf[C.OOCORE_GRACE_PARTITIONS])
+        assert _tree_metric(plan, M.NUM_GRACE_PARTITIONS) > nparts, \
+            "join never recursed past the first partitioning level"
+        e.assert_clean()
+
+
+def test_grace_join_irreducible_skew_raises_descriptive(tmp_path):
+    """One hot key bigger than the window cannot be partitioned down:
+    at maxRecursionDepth the join fails descriptively (naming the skew
+    and the knobs), never hangs, never emits partial data."""
+    rng = np.random.default_rng(11)
+    left = pd.DataFrame({"k": np.zeros(500, np.int64),
+                         "v": rng.integers(0, 10, 500).astype(np.int64)})
+    right = pd.DataFrame({"rk": np.zeros(2000, np.int64),
+                          "w": rng.integers(0, 10, 2000).astype(np.int64)})
+    with _Env(tmp_path, "skew", cap=1 << 13,
+              **{C.OOCORE_MAX_RECURSION.key: 1}) as e:
+        with pytest.raises(R.TpuOutOfCoreError, match="skew") as ei:
+            for _ in e.run(_join_plan(left, right)):
+                pass
+        assert "maxRecursionDepth" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# aggregate spill-and-re-merge
+def test_agg_spill_bit_exact_across_budget_ladder(tmp_path):
+    """Partial aggregation state over budget spills as merged runs and
+    re-merges in window-sized groups — group keys are merge-idempotent,
+    so the result is bit-exact at any pass count."""
+    df = _sales()
+    base = _baseline(tmp_path, lambda: _agg_plan(df))
+    for cap in (1 << 16, 1 << 15):
+        with _Env(tmp_path, f"agg{cap}", cap=cap) as e:
+            plan = _agg_plan(df)
+            got = e.run(plan)
+            _assert_bit_exact(base, got, f"agg spill @cap={cap}")
+            assert OC.runs_spilled() > 0
+            assert _tree_metric(plan, M.NUM_EXTERNAL_MERGE_PASSES) >= 1
+            assert _tree_metric(plan, M.SPILL_RUN_BYTES) == \
+                OC.run_bytes_spilled()
+            e.assert_clean()
+
+
+def test_oocore_composes_with_oom_split_retry(tmp_path):
+    """The inner OOM split-retry lattice stays live inside the outer
+    out-of-core ring: seeded retry OOMs during an external-sort run
+    still converge bit-exact."""
+    df = _orders(n=3000)
+    base = _baseline(tmp_path, lambda: _sort_plan(df))
+    with _Env(tmp_path, "compose", cap=1 << 16,
+              **{C.OOM_INJECT_RATE.key: 0.15,
+                 C.OOM_INJECT_SEED.key: 7,
+                 C.RETRY_MIN_SPLIT_ROWS.key: 64}) as e:
+        plan = _sort_plan(df)
+        got = e.run(plan)
+        _assert_bit_exact(base, got, "external sort + injected OOMs")
+        assert OC.runs_spilled() > 0
+        assert R.injected_oom_count() > 0, \
+            "injection never fired; the compose test is vacuous"
+        e.assert_clean()
+
+
+# ---------------------------------------------------------------------------
+# ledger reconciliation + profile section
+def test_three_way_spill_reconciliation(tmp_path):
+    """Movement-ledger oocore spill edges == process run accounting ==
+    per-node spillRunBytes: three independent legs, one byte count."""
+    df = _orders()
+    P.clear_history()
+    with _Env(tmp_path, "ledger", cap=1 << 16,
+              **{"spark.rapids.sql.profile.enabled": True}) as e:
+        plan = _sort_plan(df)
+        e.run(plan)
+        prof = P.last_profile()
+        assert prof is not None
+        sites = prof.movement["edges"][MV.EDGE_SPILL]["sites"]
+        ledger_leg = sum(v["bytes"] for s, v in sites.items()
+                         if s.startswith(OC.SITE_PREFIX))
+        acct_leg = OC.run_bytes_spilled()
+        metric_leg = _tree_metric(plan, M.SPILL_RUN_BYTES)
+        assert ledger_leg > 0
+        assert ledger_leg == acct_leg == metric_leg, \
+            (ledger_leg, acct_leg, metric_leg)
+        # the profile's out-of-core section rolls the same story up
+        assert prof.oocore is not None
+        assert prof.oocore["totals"]["spill_run_bytes"] == acct_leg
+        assert prof.oocore["totals"]["merge_passes"] == \
+            _tree_metric(plan, M.NUM_EXTERNAL_MERGE_PASSES)
+        assert "-- out-of-core --" in prof.explain()
+        e.assert_clean()
+
+
+# ---------------------------------------------------------------------------
+# watchdog over merge passes
+def test_watchdog_covers_hung_merge_pass(tmp_path):
+    """A hang injected inside an external merge pass must be detected
+    by the heartbeat watchdog and killed with a dump naming the site —
+    the out-of-core lane may be slow, never silently stuck."""
+    df = _orders()
+    with _Env(tmp_path, "hang", cap=1 << 16,
+              **{C.HANG_INJECT_SITE.key: "oocore-merge",
+                 C.HANG_INJECT_AFTER.key: 0,
+                 "spark.rapids.sql.watchdog.taskTimeout": 1.5,
+                 "spark.rapids.sql.watchdog.pollInterval": 0.1}) as e:
+        with pytest.raises(W.TpuQueryTimeout) as ei:
+            e.run(_sort_plan(df))
+        msg = str(ei.value)
+        assert "oocore-merge" in msg, msg[:400]
+        assert "watchdog" in msg
+
+
+# ---------------------------------------------------------------------------
+# spill-corruption recovery (runs forced down to disk)
+def _disk_batch():
+    rng = np.random.default_rng(17)
+    return ColumnarBatch.from_pandas(pd.DataFrame({
+        "a": rng.integers(0, 1000, 2000).astype(np.int64),
+        "b": rng.integers(-50, 50, 2000).astype(np.int64)}))
+
+
+def _corrupt_payload(path):
+    with open(path, "r+b") as f:
+        f.seek(ST._SPILL_FRAME_HEADER + 7)
+        b = f.read(1)
+        f.seek(ST._SPILL_FRAME_HEADER + 7)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_run_replica_recovers_corrupt_primary(tmp_path):
+    """runReplicas=2: a corrupt primary is quarantined (file preserved
+    for triage) and the replica satisfies the read."""
+    with _Env(tmp_path, "rep", host_spill=1 << 10,
+              **{C.OOCORE_RUN_REPLICAS.key: 2}) as e:
+        batch = _disk_batch()
+        run = OC.spill_run(batch, label="t", conf=e.conf)
+        assert len(run.bids) == 2
+        primary = e.env.disk_store._buffers[run.bids[0]]._path
+        _corrupt_payload(primary)
+        ms = M.MetricSet()
+        got = run.read(ms)
+        pd.testing.assert_frame_equal(batch.to_pandas(), got.to_pandas(),
+                                      check_exact=True)
+        assert ms.value(M.NUM_SPILL_CORRUPTIONS_RECOVERED) == 1
+        qpath = primary + ".quarantined"
+        assert os.path.exists(qpath), "poisoned file not preserved"
+        assert not e.env.catalog.is_registered(run.bids[0])
+        run.free()
+        e.assert_clean()
+        # satellite: teardown must also unlink quarantined files
+        e.env.close()
+        assert not os.path.exists(qpath)
+
+
+def test_run_recompute_fallback_when_all_copies_corrupt(tmp_path):
+    """No readable copy but a recompute lineage: bounded recompute
+    satisfies the read instead of failing the query."""
+    with _Env(tmp_path, "rec", host_spill=1 << 10) as e:
+        batch = _disk_batch()
+        run = OC.spill_run(batch, label="t", conf=e.conf,
+                           recompute=lambda: batch)
+        _corrupt_payload(e.env.disk_store._buffers[run.bids[0]]._path)
+        ms = M.MetricSet()
+        got = run.read(ms)
+        pd.testing.assert_frame_equal(batch.to_pandas(), got.to_pandas(),
+                                      check_exact=True)
+        assert ms.value(M.NUM_SPILL_CORRUPTIONS_RECOVERED) == 1
+        run.free()
+        e.assert_clean()
+
+
+def test_run_unreadable_raises_descriptive(tmp_path):
+    """All copies corrupt, no lineage: a descriptive SpillCorruption
+    that names the runReplicas knob — never a garbage batch."""
+    with _Env(tmp_path, "bad", host_spill=1 << 10) as e:
+        run = OC.spill_run(_disk_batch(), label="t", conf=e.conf)
+        _corrupt_payload(e.env.disk_store._buffers[run.bids[0]]._path)
+        with pytest.raises(ST.SpillCorruption, match="runReplicas"):
+            run.read()
+
+
+def test_query_recovers_from_injected_spill_corruption(tmp_path):
+    """End to end under faultInjection.spillCorruptRate: an external
+    sort whose runs land on disk re-reads through corrupt frames via
+    replicas, stays bit-exact, and charges the recovery metric."""
+    df = _orders(n=3000)
+    base = _baseline(tmp_path, lambda: _sort_plan(df))
+    with _Env(tmp_path, "inj", cap=1 << 16, host_spill=1 << 12,
+              **{C.SPILL_CORRUPT_RATE.key: 0.05,
+                 C.OOM_INJECT_SEED.key: 7,
+                 C.OOCORE_RUN_REPLICAS.key: 2}) as e:
+        plan = _sort_plan(df)
+        got = e.run(plan)
+        _assert_bit_exact(base, got, "external sort + spill corruption")
+        assert ST.injected_spill_corruptions() > 0, \
+            "corruption never fired; the recovery test is vacuous"
+        assert _tree_metric(plan, M.NUM_SPILL_CORRUPTIONS_RECOVERED) > 0
+        # quarantined copies are gone from the catalog, not leaked
+        assert len(e.env.catalog) == 0
+        assert e.env.disk_store.orphaned_spill_files() == []
+
+
+# ---------------------------------------------------------------------------
+# chaos-composite soak: TPC-H through the full engine under a tiny
+# budget with every fault injector lit at once
+CHAOS_SCALE = 3000
+
+
+@pytest.fixture(scope="module")
+def tables():
+    from spark_rapids_tpu.models.tpch_data import gen_tables
+    return gen_tables(np.random.default_rng(11), CHAOS_SCALE)
+
+
+def _chaos_conf(cap, hang=None):
+    """Tiny HBM budget + seeded OOM + slowdown + spill corruption
+    (replicated runs land on disk via the tiny host arena), plus an
+    optional hang site."""
+    from spark_rapids_tpu.models.tpch_bench import BENCH_CONF
+    kv = {**BENCH_CONF,
+          C.OOM_INJECT_RATE.key: 0.05,
+          C.OOM_INJECT_SEED.key: 7,
+          C.RETRY_MIN_SPLIT_ROWS.key: 64,
+          C.SLOW_INJECT_SITE.key: "map-task",
+          C.SLOW_INJECT_FACTOR.key: 2,
+          C.SPILL_CORRUPT_RATE.key: 0.005,
+          C.OOCORE_RUN_REPLICAS.key: 2}
+    if hang is not None:
+        kv.update({C.HANG_INJECT_SITE.key: hang,
+                   C.HANG_INJECT_AFTER.key: 1,
+                   "spark.rapids.sql.watchdog.taskTimeout": 2.0,
+                   "spark.rapids.sql.watchdog.pollInterval": 0.1})
+    return kv
+
+
+def _run_q(e, query, tables):
+    from spark_rapids_tpu.models.tpch_bench import run_query
+    with C.session(e.conf):
+        return run_query(query, tables, engine="tpu", conf=e.conf)
+
+
+def _leaked_producers():
+    from spark_rapids_tpu.exec import pipeline as PL
+    return PL.pipeline_stats()["leaked_producers"]
+
+
+def _assert_no_process_leaks(producers_before):
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    assert TpuSemaphore.get().holders() == 0, TpuSemaphore.get().snapshot()
+    assert _leaked_producers() == producers_before
+
+
+@pytest.mark.parametrize("query", [
+    1,
+    pytest.param(5, marks=pytest.mark.slow),  # join-heavy: cold
+    # compiles + thousands of grace runs ride the soak tier
+])
+def test_chaos_composite_tpch(tmp_path, tables, query):
+    """The acceptance soak: TPC-H under a budget a fraction of the
+    working set with OOM + slowdown + spill-corruption injection all
+    seeded at once — completes bit-exact vs the unconstrained
+    uninjected lane, with zero leaked permits / admissions / buffers /
+    producers and the overflow bytes proven onto the spill edges."""
+    producers_before = _leaked_producers()
+    with _Env(tmp_path, f"q{query}-base") as e:
+        base = _run_q(e, query, tables)
+        e.assert_clean()
+    with _Env(tmp_path, f"q{query}-chaos", cap=1 << 14,
+              host_spill=1 << 14, **_chaos_conf(cap=1 << 14)) as e:
+        got = _run_q(e, query, tables)
+        _assert_bit_exact(base, got, f"chaos q{query}")
+        assert OC.runs_spilled() > 0, \
+            "budget never forced the out-of-core lane; soak is vacuous"
+        assert R.injected_oom_count() > 0
+        if query == 5:  # q1 spills too few runs to guarantee a hit
+            assert ST.injected_spill_corruptions() > 0
+        e.assert_clean()
+        _assert_no_process_leaks(producers_before)
+
+
+def test_chaos_hang_times_out_then_reruns_clean(tmp_path, tables):
+    """Chaos + a seeded hang: the watchdog kills the wedged query with
+    a descriptive dump, and the SAME process then re-runs the query
+    bit-exact under the remaining injection — no lingering state."""
+    producers_before = _leaked_producers()
+    with _Env(tmp_path, "hang-base") as e:
+        base = _run_q(e, 1, tables)
+    with _Env(tmp_path, "hang-chaos", cap=1 << 14, host_spill=1 << 14,
+              **_chaos_conf(cap=1 << 14, hang="producer")) as e:
+        with pytest.raises(W.TpuQueryTimeout) as ei:
+            _run_q(e, 1, tables)
+        assert "producer" in str(ei.value)
+    with _Env(tmp_path, "hang-rerun", cap=1 << 14, host_spill=1 << 14,
+              **_chaos_conf(cap=1 << 14)) as e:
+        got = _run_q(e, 1, tables)
+        _assert_bit_exact(base, got, "q1 after chaos hang timeout")
+        e.assert_clean()
+        _assert_no_process_leaks(producers_before)
+
+
+# ---------------------------------------------------------------------------
+# DiskStore teardown hygiene (satellite: spill-file teardown race)
+def test_disk_store_close_drains_orphans_and_quarantine(tmp_path):
+    """close() must unlink quarantined and orphaned spill files
+    file-by-file — the rmtree used to hide these leaks."""
+    with _Env(tmp_path, "drain", host_spill=1 << 10) as e:
+        ds = e.env.disk_store
+        run = OC.spill_run(_disk_batch(), label="t", conf=e.conf)
+        path = ds._buffers[run.bids[0]]._path
+        qpath = ds.quarantine(run.bids[0])
+        assert qpath == path + ".quarantined" and os.path.exists(qpath)
+        stray = os.path.join(ds.block_manager.root, "stray.bin")
+        with open(stray, "wb") as f:
+            f.write(b"leftover")
+        assert stray in ds.orphaned_spill_files()
+        assert qpath not in ds.orphaned_spill_files()
+        e.env.close()
+        assert not os.path.exists(qpath)
+        assert not os.path.exists(stray)
